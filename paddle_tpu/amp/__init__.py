@@ -1,0 +1,187 @@
+"""AMP (ref: python/paddle/amp/auto_cast.py:664 auto_cast, :726 decorate;
+grad_scaler.py:581 GradScaler, AmpScaler:38).
+
+TPU-native policy: bf16-first. O1 = op-list-based autocast at dispatch time
+(mirrors the reference's white/black lists from
+python/paddle/fluid/dygraph/amp/auto_cast.py); O2 = cast the model to bf16
+with fp32 master weights in the optimizer (multi_precision). Loss scaling is
+a no-op for bf16 (same dynamic range as fp32) but fully implemented for fp16
+parity — found_inf short-circuits the step exactly like AmpScaler.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.dtype import convert_dtype
+
+# Ref: fluid/dygraph/amp/auto_cast.py WHITE_LIST/BLACK_LIST
+WHITE_LIST = {"matmul", "conv2d", "conv1d", "conv3d", "einsum", "linear", "bmm", "mm",
+              "flash_attention", "sdpa"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+              "cross_entropy", "c_softmax_with_cross_entropy", "layer_norm", "group_norm",
+              "rms_norm", "reduce_sum", "log_softmax"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.level = "O0"
+        self.dtype = jnp.bfloat16
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp_state = _AmpState()
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity."""
+    prev = (_amp_state.level, _amp_state.dtype, _amp_state.custom_white,
+            _amp_state.custom_black)
+    _amp_state.level = level if enable else "O0"
+    _amp_state.dtype = convert_dtype(dtype)
+    _amp_state.custom_white = set(custom_white_list or [])
+    _amp_state.custom_black = set(custom_black_list or [])
+    try:
+        yield
+    finally:
+        (_amp_state.level, _amp_state.dtype, _amp_state.custom_white,
+         _amp_state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def should_cast_to_low_precision(op_name: str) -> bool:
+    if _amp_state.level == "O0":
+        return False
+    if op_name in _amp_state.custom_black or op_name in BLACK_LIST:
+        return False
+    if _amp_state.level == "O2":
+        return True
+    return op_name in WHITE_LIST or op_name in _amp_state.custom_white
+
+
+def amp_dtype():
+    return _amp_state.dtype
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """paddle.amp.decorate parity (ref auto_cast.py:726): O2 casts model params
+    to the low-precision dtype; optimizer keeps fp32 master weights."""
+    d = convert_dtype(dtype)
+    models_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in models_list:
+            m._convert_dtype(d)
+            m._casted_by_pure_fp16 = True
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for o in opts:
+                if hasattr(o, "_multi_precision"):
+                    o._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Ref grad_scaler.py:581 / AmpScaler:38 — dynamic loss scaling."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._get_params():
+            if p.grad is not None:
+                g = p.grad.value.astype(jnp.float32) * inv
+                if not bool(jnp.isfinite(g).all()):
+                    found = True
+                p.grad = Tensor(g)
+        self._found_inf = found
+
+    def minimize(self, optimizer, loss):
+        loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state_dict):
+        self._scale = state_dict.get("scale", self._scale)
+        self._good_steps = state_dict.get("incr_count", 0)
+        self._bad_steps = state_dict.get("decr_count", 0)
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler

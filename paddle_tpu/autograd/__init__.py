@@ -1,0 +1,122 @@
+"""Autograd public API (ref: python/paddle/autograd/__init__.py).
+
+backward/grad come from the tape engine; PyLayer (custom autograd,
+ref python/paddle/autograd/py_layer.py + paddle/fluid/eager/pylayer/) is a
+thin class over the same tape — forward runs eagerly, backward is the
+user-supplied function registered as the tape node's vjp.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+import weakref
+
+from ..framework.core import (Tensor, TapeNode, backward, grad, is_grad_enabled, no_grad,
+                              to_array)
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad", "hessian", "jacobian"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+        self._non_diff = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff.update(id(a) for a in args)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = value
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *a, **k):
+        raise RuntimeError("PyLayer must be used via .apply(), not instantiated")
+
+
+class PyLayer:
+    """Custom autograd function: subclass with static forward/backward."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        if is_grad_enabled() and diff_inputs:
+            n_in = len(diff_inputs)
+
+            def vjp_fn(cts):
+                cts_t = cts if isinstance(cts, tuple) else (cts,)
+                gin = cls.backward(ctx, *[Tensor(c) for c in cts_t])
+                gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+                out = []
+                for g in gin:
+                    out.append(None if g is None else to_array(g))
+                # pad/truncate to match diff inputs
+                return tuple(out[:n_in]) + (None,) * (n_in - len(out))
+
+            node = TapeNode(
+                vjp_fn,
+                inputs=diff_inputs,
+                out_avals=[(tuple(t.shape), t.dtype) for t in out_tensors],
+                name=cls.__name__,
+            )
+            for k_, t in enumerate(out_tensors):
+                if id(t) not in ctx._non_diff:
+                    t._node = node
+                    t._idx = k_
+                    t.stop_gradient = False
+                node.out_tensors[k_] = weakref.ref(t)
+        if multi:
+            return tuple(out_tensors)
+        return out_tensors[0]
+
+
+LegacyPyLayer = PyLayer
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.incubate.autograd.jacobian parity via jax.jacrev on the traced fn."""
+    import jax
+
+    from ..framework.core import to_array
+
+    if not isinstance(xs, (list, tuple)):
+        xs = [xs]
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.Jacobian with an explicit function; "
+        "tape-based jacobian of already-computed outputs is not supported.")
+
+
+def hessian(func, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.Hessian with an explicit function.")

@@ -1,0 +1,139 @@
+"""Device API (ref: python/paddle/device/__init__.py).
+
+On TPU the device model is trivial compared to the reference's
+DeviceManager/DeviceContextPool (ref paddle/phi/backends/device_manager.h):
+XLA owns placement; this module surfaces enumeration + the stream/event API
+as no-op-compatible shims (XLA streams are compiler-managed).
+"""
+from __future__ import annotations
+
+import jax
+
+_current_device = None
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        return "cpu"
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    p = _platform()
+    if p in ("tpu", "axon"):
+        return "tpu:0"
+    if p == "gpu":
+        return "gpu:0"
+    return "cpu"
+
+
+def set_device(device: str) -> str:
+    global _current_device
+    _current_device = device
+    return device
+
+
+def get_all_custom_device_type():
+    return ["tpu"] if _platform() in ("tpu", "axon") else []
+
+
+def device_count() -> int:
+    try:
+        return jax.device_count()
+    except RuntimeError:
+        return 0
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type == "tpu" and _platform() in ("tpu", "axon")
+
+
+class Stream:
+    """Compat shim: XLA schedules its own streams on TPU (ref
+    paddle/phi/backends/stream.h). Exists so stream-annotated user code runs."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        for d in jax.live_arrays():
+            pass
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+
+    return _guard()
+
+
+class cuda:
+    """paddle.device.cuda shim — reports no CUDA (we are a TPU build)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    Stream = Stream
+    Event = Event

@@ -1,0 +1,20 @@
+"""paddle.distributed parity (ref: python/paddle/distributed/).
+
+TPU-native mapping (SURVEY §5.8): one XLA-collectives backend over ICI/DCN;
+mesh axes replace process groups; jax.distributed.initialize replaces
+TCPStore+NCCL bootstrap; pjit/GSPMD sharding replaces per-rank program
+slicing.
+"""
+from .collective import (Group, ReduceOp, all_gather, all_gather_object, all_reduce,
+                         all_to_all, alltoall, barrier, broadcast, broadcast_object_list,
+                         destroy_process_group, get_backend, get_global_mesh, get_group,
+                         irecv, isend, new_group, recv, reduce, reduce_scatter, scatter,
+                         send, set_global_mesh, wait)
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized)
+from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh
+from .parallel import DataParallel
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .launch_util import spawn  # noqa: F401
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,116 @@
+"""Distributed environment (ref: python/paddle/distributed/parallel.py:108
+init_parallel_env — TCPStore rendezvous at :279 + NCCL comm init).
+
+TPU-native: jax.distributed.initialize() replaces TCPStore+NCCL bootstrap
+(the TPU runtime does its own rendezvous over the coordinator address), and
+process identity comes from jax.process_index(). Within a process all local
+devices are visible, so "world" here = processes × local devices when
+counting chips (the reference counts 1 GPU per process).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Ref python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+    @property
+    def world_size(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+    @property
+    def dev_id(self) -> int:
+        return self.local_rank
+
+    @property
+    def device_type(self) -> str:
+        try:
+            return jax.devices()[0].platform
+        except RuntimeError:
+            return "cpu"
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nrings(self) -> int:
+        return 1
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """paddle.distributed.init_parallel_env parity.
+
+    Multi-host: wires jax.distributed.initialize from either explicit args or
+    PADDLE_TRAINER_ENDPOINTS/PADDLE_TRAINER_ID env (as set by the launch CLI).
+    Single-host: no-op (all local chips already visible).
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    eps = env.trainer_endpoints
+    n = num_processes if num_processes is not None else (len(eps) or None)
+    if coordinator_address is None and eps:
+        coordinator_address = eps[0]
+    if coordinator_address and (n or 1) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=n,
+            process_id=process_id if process_id is not None else env.rank,
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def device_count() -> int:
+    try:
+        return jax.device_count()
+    except RuntimeError:
+        return 1
+
+
+def local_device_count() -> int:
+    try:
+        return jax.local_device_count()
+    except RuntimeError:
+        return 1

@@ -1,0 +1,48 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/fleet.py:169 init,
+model.py:30 distributed_model, fleet.py:1044 distributed_optimizer).
+"""
+from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+                   fleet_instance)
+from . import meta_parallel  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+_fleet = fleet_instance
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    return _fleet.init(role_maker=role_maker, is_collective=is_collective,
+                       strategy=strategy)
+
+
+def distributed_model(model):
+    return _fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _fleet.hcg
+
+
+def get_mesh():
+    return _fleet.mesh
+
+
+def worker_index():
+    return _fleet.worker_index()
+
+
+def worker_num():
+    return _fleet.worker_num()
+
+
+def is_first_worker():
+    return _fleet.worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()

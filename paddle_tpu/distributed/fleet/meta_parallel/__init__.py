@@ -1,0 +1,11 @@
+"""Hybrid-parallel building blocks (ref: fleet/meta_parallel/)."""
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+                        VocabParallelEmbedding)
+from .parallel_model import ShardedDataParallel, TensorParallel
+from .pipeline_parallel import PipelineParallel
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+from .hybrid_optimizer import HybridParallelClipGrad, HybridParallelOptimizer
+from .sharding_optimizer import DygraphShardingOptimizer, GroupShardedOptimizerStage2
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,89 @@
+"""Hybrid-parallel optimizer wrapper (ref: fleet/meta_parallel/
+dygraph_optimizer/hybrid_parallel_optimizer.py — HybridParallelOptimizer:186,
+HybridParallelClipGrad:45; hybrid_parallel_util.py fused_allreduce_gradients:206).
+
+TPU-native: under pjit, DP grad reduction and cross-group norm reduction are
+GSPMD-inserted; eagerly (multi-process) we reduce via the collectives API.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....nn.clip import ClipGradByGlobalNorm
+from ...collective import ReduceOp, all_reduce
+from ...env import get_world_size
+
+
+class HybridParallelClipGrad:
+    """Global-norm clip with the norm allreduced across mp/pp/sharding groups
+    (ref hybrid_parallel_optimizer.py:45). On a single-controller mesh all
+    params are visible, so the global norm is already global; multi-process
+    eager adds the cross-process reduction."""
+
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        sq = sum(float(jnp.sum(jnp.square(g.value.astype(jnp.float32)))) for g in grads)
+        if get_world_size() > 1:
+            t = Tensor(jnp.asarray(sq))
+            all_reduce(t, op=ReduceOp.SUM)
+            sq = float(t.value)
+        global_norm = sq ** 0.5
+        clip_norm = getattr(self._clip, "clip_norm", 1.0)
+        scale = min(clip_norm / max(global_norm, 1e-12), 1.0)
+        return [(p, None if g is None else Tensor(g.value * scale))
+                for p, g in params_grads]
+
+
+class HybridParallelOptimizer:
+    """Ref hybrid_parallel_optimizer.py:186."""
+
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and isinstance(
+                optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def _dp_sync(self):
+        """fused_allreduce_gradients parity (hybrid_parallel_util.py:206)."""
+        if get_world_size() <= 1:
+            return
+        for p in self._inner_opt._get_params():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG)
+
+    def step(self):
+        self._dp_sync()
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,118 @@
+"""Tensor-parallel layers (ref: fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:35, ColumnParallelLinear:173, RowParallelLinear:332,
+ParallelCrossEntropy:498; mp_ops.py _c_identity/_c_concat/_mp_allreduce).
+
+TPU-native redesign: these layers do NOT slice weights per rank. They carry
+full logical shapes + a GSPMD PartitionSpec on each parameter; under pjit the
+compiler assigns each chip its shard and inserts the same collectives the
+reference issues by hand (allreduce after RowParallel ≈ psum XLA inserts;
+identity-with-allreduce-backward of ColumnParallel ≈ GSPMD's reverse-mode
+resharding). Eagerly (one process) they behave exactly like dense layers, so
+numerics match the single-device reference — the parallelism appears when
+the surrounding train step is pjit-ed over a mesh with a "tensor" axis.
+
+For the explicit shard_map variant (needed by e.g. ParallelCrossEntropy's
+vocab-sharded softmax), ``paddle_tpu.parallel.api`` provides psum/all_gather
+helpers that are no-ops off-mesh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn.initializer import Constant, Normal, XavierUniform
+from ....nn.layer_base import Layer
+from ....framework.core import Tensor
+from ....framework.dispatch import apply_op
+from ....parallel.api import shard_constraint
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding sharded over the vocab dim (ref mp_layers.py:35)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.pspec = P("tensor", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return shard_constraint(out, P("data", None, None))
+
+
+class ColumnParallelLinear(Layer):
+    """Weight sharded on output dim (ref mp_layers.py:173)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight.pspec = P(None, "tensor")
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias or has_bias is None:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P("tensor")
+            self.bias.is_distributed = True
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            # replicate activations (GSPMD all-gathers the tensor-dim shards)
+            return shard_constraint(out, P("data"))
+        return shard_constraint(out, P("data", None, "tensor"))
+
+
+class RowParallelLinear(Layer):
+    """Weight sharded on input dim; output psum (ref mp_layers.py:332)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.weight.pspec = P("tensor", None)
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P()
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_constraint(x, P("data", None, "tensor"))
+        out = F.linear(x, self.weight, self.bias)
+        # contraction over the sharded dim → XLA inserts the psum the
+        # reference does via _mp_allreduce (mp_ops.py:219)
+        return shard_constraint(out, P("data"))
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE (ref mp_layers.py:498,
+    mp_ops.py:_c_softmax_with_cross_entropy:375).
+
+    Under pjit with logits sharded on the vocab axis, the log-softmax's
+    reduction over vocab becomes an XLA cross-shard reduction automatically;
+    eager single-process path is plain CE.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,42 @@
+"""Model wrappers per parallel mode (ref fleet/meta_parallel/model wrappers
+chosen in fleet/model.py:125-172)."""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """TP wrapper (ref meta_parallel/tensor_parallel.py). On TPU the TP
+    collectives come from the mp layers' shardings under pjit; this wrapper
+    only marks the model and syncs non-distributed params at init (the
+    reference broadcasts them over the mp group — replication under GSPMD)."""
+
+
+class ShardedDataParallel(_MetaParallelBase):
+    """ZeRO wrapper (ref sharding_parallel.py + group_sharded_*). Param/opt
+    sharding over the 'sharding' mesh axis is applied by the ParallelEngine
+    (fsdp=True); eager behavior is identical to DataParallel."""

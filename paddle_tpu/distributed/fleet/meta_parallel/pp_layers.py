@@ -1,0 +1,160 @@
+"""Pipeline model description (ref: fleet/meta_parallel/parallel_layers/
+pp_layers.py — PipelineLayer:209, LayerDesc/SharedLayerDesc, SegmentLayers:93).
+
+PipelineLayer holds the full layer list plus a segmentation into stages.
+TPU twist: every process can see all stages (single-controller SPMD), so the
+"local stage" concept is a *slice view* used by the 1F1B host schedule and by
+the compiled stage-scan path; there is no per-rank module surgery.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer_base import Layer
+from ....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layers across stages (ref pp_layers.py SharedLayerDesc — e.g.
+    tied embeddings). On TPU the weight is simply the same Parameter object
+    in both stages; gradient summation happens naturally in jax.grad, which
+    replaces allreduce_shared_weight_gradients."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Ref pp_layers.py:93 — split N layers into M stages uniformly or by
+    parameter count."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            pat = self.method.split(":", 1)[1]
+            matches = [i for i, d in enumerate(self.layers_desc)
+                       if re.search(pat, getattr(d, "layer_cls", type(d)).__name__
+                                    if isinstance(d, LayerDesc) else type(d).__name__)]
+            assert len(matches) >= self.num_parts
+            per = len(matches) // self.num_parts
+            result = [0]
+            for i in range(1, self.num_parts):
+                result.append(matches[i * per])
+            result.append(n)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """Ref pp_layers.py:209."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self.segment_parts = SegmentLayers(self._layers_desc, self._num_stages,
+                                           seg_method).do_segment()
+        # build ALL layers (single-controller SPMD: no per-rank pruning)
+        built = []
+        self._shared_layers = {}
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    built.append(_SharedView(self._shared_layers[d.layer_name],
+                                             d.forward_func))
+                else:
+                    layer = d.build_layer()
+                    self._shared_layers[d.layer_name] = layer
+                    built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FuncLayer(d))
+            else:
+                raise TypeError(f"unsupported pipeline item {d!r}")
+        self.run_function = LayerList(built)
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage_id: int) -> List[Layer]:
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return list(self.run_function)[lo:hi]
+
+    def forward_stage(self, x, stage_id: int):
+        for layer in self.stage_layers(stage_id):
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+
+class _FuncLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _SharedView(Layer):
+    def __init__(self, shared: Layer, forward_func: Optional[Callable]):
+        super().__init__()
+        self.add_sublayer("shared", shared)
+        self._forward_func = forward_func
+
+    def forward(self, *args):
+        if self._forward_func is not None:
+            return self._forward_func(self.shared, *args)
+        return self.shared(*args)

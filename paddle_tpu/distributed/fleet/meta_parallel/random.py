@@ -1,0 +1,72 @@
+"""TP-aware RNG (ref: fleet/meta_parallel/parallel_layers/random.py:35
+RNGStatesTracker — per-mode seeds so TP ranks agree on replicated dropout and
+differ on sharded dropout)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework.random import Generator
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from ....framework import random as global_random
+
+        orig = global_random._default_generator
+        global_random._default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            global_random._default_generator = orig
+
+
+RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from ...fleet import get_hybrid_communicate_group
+
+    try:
+        hcg = get_hybrid_communicate_group()
+        rank = hcg.get_model_parallel_rank() if hcg else 0
+    except Exception:
+        rank = 0
+    seed = seed or (pyrandom.randint(0, 100000) + 100)
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    RNG_STATE_TRACKER.reset()
+    RNG_STATE_TRACKER.add("global_seed", global_seed)
+    RNG_STATE_TRACKER.add("local_seed", local_seed)

@@ -1,0 +1,79 @@
+"""Activation recompute (ref: fleet/recompute/recompute.py —
+RecomputeFunction:69 PyLayer-based with RNG-state restore :57).
+
+TPU-native: in compiled training, recompute == jax.checkpoint (XLA remat) —
+strictly better than the reference's PyLayer replay because the compiler
+schedules the recomputation. Eagerly we provide the same API: forward runs
+under no_grad, backward replays with grad via a PyLayer.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...autograd import PyLayer
+from ...framework.core import Tensor, enable_grad, no_grad_ctx
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.inputs = args
+        from ...framework.random import get_rng_state
+
+        ctx.rng_state = get_rng_state() if preserve_rng_state else None
+        with no_grad_ctx():
+            out = run_function(*args)
+        return out
+
+    @staticmethod
+    def backward(ctx, *grads):
+        from ...framework.random import set_rng_state
+
+        if ctx.rng_state is not None:
+            saved = ctx.rng_state
+            set_rng_state(saved)
+        detached = [a.detach() if isinstance(a, Tensor) else a for a in ctx.inputs]
+        for d, orig in zip(detached, ctx.inputs):
+            if isinstance(orig, Tensor) and not orig.stop_gradient:
+                d.stop_gradient = False
+        with enable_grad():
+            out = ctx.run_function(*detached)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        from ...framework.core import backward as run_backward
+
+        diff_outs = [o for o in outs if isinstance(o, Tensor) and not o.stop_gradient]
+        gs = list(grads)[: len(diff_outs)]
+        run_backward(diff_outs, gs)
+        return tuple(d.grad if isinstance(d, Tensor) and d.grad is not None else None
+                     for d in detached)
+
+
+def recompute(function, *args, **kwargs):
+    """Ref recompute.py recompute(). kwargs: use_reentrant, preserve_rng_state."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    if kwargs:
+        raise ValueError(f"unsupported kwargs {list(kwargs)}")
+    return _RecomputeFunction.apply(function, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """Ref recompute_sequential — chunk a Sequential into recompute segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+    out = args
+    for i in range(0, n, per):
+        seg = layers[i:i + per]
+
+        def run_seg(*xs, _seg=seg):
+            y = xs
+            for l in _seg:
+                y = (l(*y),) if isinstance(y, tuple) else (l(y),)
+            return y[0] if len(y) == 1 else y
+
+        out = (recompute(run_seg, *out),) if isinstance(out, tuple) else \
+            (recompute(run_seg, out),)
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

@@ -1,0 +1,38 @@
+"""paddle.distributed.spawn parity (ref: python/paddle/distributed/spawn.py).
+
+On TPU, one process drives all local chips, so spawn(nprocs=N) for local
+multi-chip is an anti-pattern; it exists for multi-host simulation in tests
+(CPU backend) and API parity.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Callable
+
+
+def _worker(fn, rank, nprocs, args, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func: Callable, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs == 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    env = dict(os.environ)
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned process exited with code {p.exitcode}")
+    return procs

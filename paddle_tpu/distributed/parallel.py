@@ -1,0 +1,62 @@
+"""DataParallel wrapper (ref: python/paddle/fluid/dygraph/parallel.py:399 +
+C++ EagerReducer reducer.cc:462).
+
+TPU-native: gradient bucketing + async NCCL allreduce is unnecessary — under
+pjit with a sharded batch, XLA inserts the gradient psum and overlaps it with
+backward compute automatically. Eager mode on a single host already sees all
+chips, so DataParallel reduces to: (a) marking the module, (b) providing
+no_sync()/gradient averaging semantics for API parity when processes > 1.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer_base import Layer
+from .collective import ReduceOp, all_reduce
+from .env import get_world_size
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.add_sublayer("_layers", layers)
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layers(*inputs, **kwargs)
+        return out
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Ref parallel.py no_sync — skip grad allreduce inside the context."""
+        prev = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = prev
+
+    def sync_gradients(self):
+        """Average grads across data-parallel workers (explicit, called by the
+        optimizer wrapper or user after backward in multi-process eager)."""
+        if not self._grad_sync_enabled or get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.AVG, group=self._group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        self.sync_gradients()

@@ -1,0 +1,171 @@
+"""Process topology → jax Mesh.
+
+Ref: python/paddle/distributed/fleet/base/topology.py:53 CommunicateTopology
+(dims [dp, pp, sharding, mp]) and :139 HybridCommunicateGroup (per-axis
+process groups). TPU-native: the topology IS a jax.sharding.Mesh with named
+axes; "communication groups" are mesh axis names — XLA lowers collectives
+onto ICI rings per axis, so there is no per-group NCCL communicator to build.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: pipe outermost (cross-host OK: only p2p crosses it),
+# then data/sharding (gradient reduction rides fast ICI within host when
+# possible), then tensor innermost (latency-critical, needs fastest links),
+# then context/expert as optional extra axes.
+AXIS_ORDER = ("pipe", "data", "sharding", "sep", "expert", "tensor", "context")
+
+
+def build_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1, sep: int = 1,
+               ep: int = 1, cp: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """CommunicateTopology(dims=[dp,pp,sharding,mp]) → Mesh."""
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = {"pipe": pp, "data": dp, "sharding": sharding, "sep": sep, "expert": ep,
+             "tensor": mp, "context": cp}
+    total = int(np.prod(list(sizes.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"topology {sizes} needs {total} devices, have {len(devices)}")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+class CommunicateTopology:
+    """Ref topology.py:53 — coordinate math over hybrid dims."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in self._dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for c, r in self._coord2rank.items() if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in itertools.product(*[range(d) for d in other_dims]):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, k)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Ref topology.py:139 — exposes per-axis rank/degree queries; on TPU the
+    "groups" are mesh axes, so this only carries coordinate bookkeeping."""
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        names = topology.get_hybrid_group_names()
+
+        def dim(n):
+            return topology.get_dim(n) if n in names else 1
+
+        self._dp_degree = dim("data")
+        self._mp_degree = dim("model")
+        self._pp_degree = dim("pipe")
+        self._sharding_degree = dim("sharding")
+        self._sep_degree = dim("sep")
+        coord = topology.get_coord(global_rank)
+        self._coord = dict(zip(names, coord))
+
+    # ranks within each parallel dimension
+    def get_data_parallel_rank(self):
+        return self._coord.get("data", 0)
+
+    def get_model_parallel_rank(self):
+        return self._coord.get("model", 0)
+
+    def get_stage_id(self):
+        return self._coord.get("pipe", 0)
+
+    def get_sharding_parallel_rank(self):
+        return self._coord.get("sharding", 0)
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def topology(self):
+        return self._topo
+
+    # group objects are axis-name handles on TPU
+    def get_data_parallel_group(self):
+        from .collective import Group
+
+        return Group(axis="data", nranks=self._dp_degree,
+                     rank=self.get_data_parallel_rank())
+
+    def get_model_parallel_group(self):
+        from .collective import Group
+
+        return Group(axis="tensor", nranks=self._mp_degree,
+                     rank=self.get_model_parallel_rank())
+
+    def get_pipe_parallel_group(self):
+        from .collective import Group
+
+        return Group(axis="pipe", nranks=self._pp_degree, rank=self.get_stage_id())
+
+    def get_sharding_parallel_group(self):
+        from .collective import Group
+
+        return Group(axis="sharding", nranks=self._sharding_degree,
+                     rank=self.get_sharding_parallel_rank())
+
+    def get_check_parallel_group(self, *a, **k):
+        from .collective import Group
+
+        return Group(axis=None, nranks=1, rank=0)
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

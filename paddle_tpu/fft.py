@@ -1,0 +1,71 @@
+"""paddle.fft parity (ref: python/paddle/fft.py) over jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.dispatch import apply_op
+
+
+def _mk1(fn_name):
+    fn = getattr(jnp.fft, fn_name)
+
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda v: fn(v, n=n, axis=axis, norm=norm), x)
+
+    op.__name__ = fn_name
+    return op
+
+
+def _mkn(fn_name):
+    fn = getattr(jnp.fft, fn_name)
+
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply_op(lambda v: fn(v, s=s, axes=ax, norm=norm), x)
+
+    op.__name__ = fn_name
+    return op
+
+
+fft = _mk1("fft")
+ifft = _mk1("ifft")
+rfft = _mk1("rfft")
+irfft = _mk1("irfft")
+hfft = _mk1("hfft")
+ihfft = _mk1("ihfft")
+fft2 = _mkn("fft2")
+ifft2 = _mkn("ifft2")
+rfft2 = _mkn("rfft2")
+irfft2 = _mkn("irfft2")
+fftn = _mkn("fftn")
+ifftn = _mkn("ifftn")
+rfftn = _mkn("rfftn")
+irfftn = _mkn("irfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=tuple(axes), norm=norm), x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=tuple(axes), norm=norm)), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x)

@@ -1,0 +1,16 @@
+"""Framework core: Tensor, autograd tape, dtypes, flags, RNG."""
+from .core import (EagerParamBase, Parameter, Tensor, backward, enable_grad, grad,
+                   is_grad_enabled, no_grad, to_array)
+from .dispatch import apply_op, defop
+from .dtype import (bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
+                    float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
+                    uint8)
+from .flags import GLOBAL_FLAGS, get_flags, set_flags
+from .random import Generator, default_generator, get_rng_state, seed, set_rng_state
+
+__all__ = [
+    "Tensor", "Parameter", "EagerParamBase", "backward", "grad", "no_grad", "enable_grad",
+    "is_grad_enabled", "apply_op", "defop", "convert_dtype", "set_default_dtype",
+    "get_default_dtype", "set_flags", "get_flags", "GLOBAL_FLAGS", "seed", "Generator",
+    "get_rng_state", "set_rng_state", "default_generator", "to_array",
+]

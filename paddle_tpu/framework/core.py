@@ -1,0 +1,576 @@
+"""Core Tensor type and eager autograd engine.
+
+TPU-native redesign of the reference's eager stack:
+
+- ``Tensor`` wraps an immutable ``jax.Array`` (replacing phi::DenseTensor +
+  AllocatorFacade — XLA owns memory on TPU; ref paddle/phi/core/dense_tensor.h:38,
+  paddle/fluid/memory/allocation/allocator_facade.h).
+- Eager autograd is a *tape* of ``jax.vjp`` closures instead of generated
+  GradNode classes (ref paddle/fluid/eager/grad_node_info.h:168 and the
+  queue-based engine in paddle/fluid/eager/backward.cc:105).  Because the tape
+  is recorded sequentially, node-id order IS a topological order, so
+  ``backward`` is a reverse sweep with cotangent accumulation — no in-degree
+  map needed (ref backward.cc:216 builds one because its graph is not a tape).
+- The jit path bypasses the tape entirely: pure functions + ``jax.grad``.
+
+Everything here is eager-mode UX; under ``paddle_tpu.jit.to_static`` the same
+ops trace into one jaxpr and XLA compiles/fuses them (the analogue of the
+reference's InterpreterCore + CINN, which has no runtime equivalent on TPU).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtype import convert_dtype, get_default_dtype, is_floating_point
+
+# --------------------------------------------------------------------------- #
+# Grad-mode state
+# --------------------------------------------------------------------------- #
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+        self.tape_counter = 0
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    prev = _grad_state.enabled
+    _grad_state.enabled = False
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
+
+
+class no_grad:
+    """paddle.no_grad parity: usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad_ctx():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _grad_state.enabled
+    _grad_state.enabled = True
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
+
+
+# --------------------------------------------------------------------------- #
+# Tape
+# --------------------------------------------------------------------------- #
+
+
+class TapeNode:
+    """One recorded op: holds the vjp closure and links to differentiable inputs.
+
+    Analogue of a generated GradNode (ref grad_node_info.h:168) — but generic:
+    jax.vjp supplies the gradient rule for any traced computation, so there is
+    no per-op codegen (ref eager_gen.py:192).
+    """
+
+    __slots__ = (
+        "id",
+        "vjp_fn",
+        "inputs",
+        "n_out",
+        "out_ct",
+        "out_avals",
+        "out_tensors",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        _grad_state.tape_counter += 1
+        self.id = _grad_state.tape_counter
+        self.vjp_fn = vjp_fn
+        self.inputs: Tuple["Tensor", ...] = tuple(inputs)
+        self.n_out = len(out_avals)
+        self.out_avals = out_avals  # list of (shape, dtype)
+        self.out_ct: List[Optional[jax.Array]] = [None] * self.n_out
+        self.out_tensors: List[Optional[weakref.ref]] = [None] * self.n_out
+        self.name = name
+
+    def add_ct(self, idx: int, ct) -> None:
+        if self.out_ct[idx] is None:
+            self.out_ct[idx] = ct
+        else:
+            self.out_ct[idx] = self.out_ct[idx] + ct
+
+
+# --------------------------------------------------------------------------- #
+# Tensor
+# --------------------------------------------------------------------------- #
+
+TensorLike = Union["Tensor", jax.Array, np.ndarray, int, float, bool, list, tuple]
+
+
+class Tensor:
+    """Eager tensor: a jax.Array plus autograd metadata.
+
+    API modelled on paddle.Tensor (ref python/paddle/fluid/dygraph/ math-op
+    patches + pybind/eager.cc:1148), storage is always a device-resident
+    jax.Array.
+    """
+
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_node",
+        "_idx",
+        "_retain_grads",
+        "_backward_hooks",
+        "name",
+        "persistable",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._node: Optional[TapeNode] = None
+        self._idx: int = 0
+        self._retain_grads = False
+        self._backward_hooks: List[Callable] = []
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def place(self) -> str:
+        try:
+            dev = list(self._value.devices())[0]
+            return str(dev)
+        except Exception:
+            return "cpu"
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def numel(self) -> int:
+        return int(self._value.size)
+
+    def dim(self) -> int:
+        return self._value.ndim
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        """Run ``hook(grad)`` on this tensor's gradient during backward
+        (ref eager grad hooks; returns a removable handle)."""
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                try:
+                    self._hooks.remove(self._h)
+                except ValueError:
+                    pass
+
+        return _Handle(self._backward_hooks, hook)
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        """Reverse-mode sweep from this tensor (ref eager/backward.cc:105)."""
+        backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .dispatch import apply_op
+
+        return apply_op(lambda x: x + 0, self)
+
+    # -- mutation (in-place, breaks tape links deliberately) ---------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def _update_value(self, value):
+        """Internal: replace storage (optimizer updates)."""
+        self._value = value
+
+    # -- dtype / device -----------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .dispatch import apply_op
+
+        d = convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(d), self)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        # Accepts dtype or device strings; device moves are XLA-managed.
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "gpu", "tpu", "xpu") or a is None:
+                continue
+            try:
+                return self.astype(a)
+            except (ValueError, TypeError):
+                continue
+        return self
+
+    def cpu(self) -> "Tensor":
+        return Tensor(np.asarray(self._value), stop_gradient=self.stop_gradient)
+
+    def cuda(self, *a, **k) -> "Tensor":
+        return self
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        from .dispatch import apply_op
+
+        idx = _normalize_index(idx)
+        return apply_op(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, val):
+        idx = _normalize_index(idx)
+        if isinstance(val, Tensor):
+            val = val._value
+        self._value = self._value.at[idx].set(val)
+
+    def __repr__(self):
+        grad_flag = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={np.dtype(self.dtype).name}"
+            f"{grad_flag},\n       {np.asarray(self._value)!r})"
+        )
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _normalize_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref python/paddle/fluid/framework.py Parameter).
+
+    ``pspec`` carries the GSPMD PartitionSpec for this parameter — the TPU
+    analogue of TensorDistAttr (ref paddle/fluid/distributed/auto_parallel/
+    dist_attr.h); consumed by the parallel engine when building sharded
+    train steps.
+    """
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "pspec")
+
+    def __init__(self, value, trainable: bool = True, name: str = ""):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.pspec = None
+        self.persistable = True
+
+
+class EagerParamBase(Parameter):
+    """Alias matching the reference's eager parameter class name."""
+
+
+# Pytree registration: lets jitted functions take/return Tensors transparently.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), t.stop_gradient),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux),
+)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), t.trainable),
+    lambda aux, children: Parameter(children[0], trainable=aux),
+)
+
+
+# --------------------------------------------------------------------------- #
+# Backward engine
+# --------------------------------------------------------------------------- #
+
+
+def _requires_grad(t: Any) -> bool:
+    return isinstance(t, Tensor) and not t.stop_gradient
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = False):
+    """paddle.autograd.backward parity (ref eager/backward.cc:383).
+
+    Tape order is topological, so we sweep nodes by descending id.
+    """
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    roots: List[TapeNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            raise RuntimeError("backward() on a tensor that does not require grad")
+        if g is None:
+            if t.size != 1 and not is_floating_point(t.dtype):
+                raise RuntimeError("backward() root must be scalar or have grad_tensor")
+            g_val = jnp.ones_like(t._value)
+        else:
+            g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is not None:
+            t._node.add_ct(t._idx, g_val)
+            roots.append(t._node)
+        if t._retain_grads or t._node is None:
+            _accum_grad(t, g_val)
+
+    # Collect reachable nodes.
+    seen = {}
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if n.id in seen:
+            continue
+        seen[n.id] = n
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+
+    for nid in sorted(seen.keys(), reverse=True):
+        node = seen[nid]
+        cts = []
+        pending = False
+        for i in range(node.n_out):
+            ct = node.out_ct[i]
+            if ct is None:
+                shape, dtype = node.out_avals[i]
+                ct = jnp.zeros(shape, dtype)
+            else:
+                pending = True
+            # apply hooks registered on the output tensor
+            ref = node.out_tensors[i]
+            out_t = ref() if ref is not None else None
+            if out_t is not None:
+                for hook in out_t._backward_hooks:
+                    res = hook(Tensor(ct))
+                    if res is not None:
+                        ct = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+                if out_t._retain_grads and node.out_ct[i] is not None:
+                    _accum_grad(out_t, ct)
+            cts.append(ct)
+        if not pending:
+            continue
+        in_cts = node.vjp_fn(tuple(cts) if node.n_out > 1 else cts[0])
+        for inp, ict in zip(node.inputs, in_cts):
+            if ict is None:
+                continue
+            if inp._node is not None:
+                inp._node.add_ct(inp._idx, ict)
+            if inp._node is None or inp._retain_grads:
+                for hook in inp._backward_hooks:
+                    res = hook(Tensor(ict))
+                    if res is not None:
+                        ict = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+                _accum_grad(inp, ict)
+        node.out_ct = [None] * node.n_out
+        if not retain_graph:
+            node.vjp_fn = _used_vjp
+
+
+def _used_vjp(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "pass retain_graph=True to backward() to allow this.")
+
+
+def _accum_grad(t: Tensor, g) -> None:
+    if t.stop_gradient and not t._retain_grads:
+        return
+    if t._grad is None:
+        t._grad = Tensor(g)
+    else:
+        t._grad = Tensor(t._grad._value + g)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad parity (ref eager GeneralGrad, general_grad.h).
+
+    Computes grads of ``outputs`` wrt ``inputs`` without touching ``.grad``
+    slots of other leaves.
+    """
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t, t._grad, t._retain_grads) for t in inputs]
+    for t in inputs:
+        t._grad = None
+        t._retain_grads = True
+    try:
+        backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph) or create_graph)
+        results = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "set allow_unused=True to return None for it.")
+            results.append(t._grad)
+    finally:
+        for t, g, r in saved:
+            t._retain_grads = r
+        # restore .grad of inputs to pre-call values only if caller had them
+    for (t, g, r), _res in zip(saved, results):
+        t._grad = g
+    return results
+
+
+# --------------------------------------------------------------------------- #
+# Helpers for converting arbitrary input to raw arrays
+# --------------------------------------------------------------------------- #
+
+
+def to_array(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, jax.Array):
+        return x
+    return jnp.asarray(x)
+
+
+def to_tensor_out(val) -> Tensor:
+    return Tensor(val)

@@ -1,0 +1,110 @@
+"""Eager op dispatch: run a pure jax function over Tensor args, recording
+the tape when gradients are required.
+
+This replaces the reference's entire per-op generated dispatch chain
+(ref paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:192
+FORWARD_FUNCTION_TEMPLATE + phi KernelFactory selection,
+paddle/phi/core/kernel_factory.cc:140): on TPU there is exactly one
+"kernel" per op — the jax/XLA lowering — and the grad rule comes from
+jax.vjp instead of a hand-registered GradNode.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import weakref
+
+from .core import Tensor, TapeNode, is_grad_enabled, to_array
+from .dtype import is_floating_point
+from .flags import GLOBAL_FLAGS
+
+
+def _check_nan_inf(name, arrays):
+    import numpy as np
+
+    for a in arrays:
+        if is_floating_point(a.dtype):
+            x = np.asarray(a)
+            if not np.isfinite(x).all():
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op {name!r} "
+                    f"(FLAGS_check_nan_inf=1; ref nan_inf_utils_detail.cc)")
+
+
+def apply_op(fn: Callable, *args, n_outputs: Optional[int] = None, op_name: str = "", **kwargs):
+    """Apply ``fn(*raw_arrays, **kwargs)``; record tape node if needed.
+
+    Positional args may be Tensors, jax arrays, or python scalars; kwargs are
+    static. Returns Tensor (or tuple of Tensors when fn returns a sequence).
+    """
+    raw = [to_array(a) if isinstance(a, Tensor) else a for a in args]
+
+    # AMP O1/O2 autocast at dispatch time (ref eager_gen.py:415 AMP_LOGIC_TEMPLATE;
+    # lists in paddle_tpu.amp). Cast fp inputs to the amp dtype for white-listed
+    # ops, to fp32 for black-listed ones when inputs are low-precision.
+    try:
+        from ..amp import amp_dtype, amp_state, should_cast_to_low_precision
+
+        if amp_state().level != "O0":
+            name = op_name or getattr(fn, "__name__", "")
+            if should_cast_to_low_precision(name):
+                tgt = amp_dtype()
+                raw = [a.astype(tgt)
+                       if hasattr(a, "dtype") and is_floating_point(a.dtype) and
+                       a.dtype != tgt else a for a in raw]
+    except ImportError:
+        pass
+
+    diff_idx = [
+        i
+        for i, a in enumerate(args)
+        if isinstance(a, Tensor)
+        and not a.stop_gradient
+        and is_floating_point(a.dtype)
+    ]
+    record = is_grad_enabled() and len(diff_idx) > 0
+
+    if record:
+        def f(*dvals):
+            full = list(raw)
+            for i, v in zip(diff_idx, dvals):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        out, vjp_fn = jax.vjp(f, *(raw[i] for i in diff_idx))
+    else:
+        out = fn(*raw, **kwargs)
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+
+    if GLOBAL_FLAGS.get("check_nan_inf"):
+        _check_nan_inf(op_name or getattr(fn, "__name__", "op"), outs)
+
+    out_tensors = [Tensor(o, stop_gradient=not record) for o in outs]
+    if record:
+        node = TapeNode(
+            vjp_fn,
+            inputs=[args[i] for i in diff_idx],
+            out_avals=[(o.shape, o.dtype) for o in outs],
+            name=op_name or getattr(fn, "__name__", "op"),
+        )
+        for k, t in enumerate(out_tensors):
+            t._node = node
+            t._idx = k
+            node.out_tensors[k] = weakref.ref(t)
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def defop(fn: Callable, op_name: str = ""):
+    """Lift a pure jax function into an eager op over Tensors."""
+
+    def op(*args, **kwargs):
+        return apply_op(fn, *args, op_name=op_name, **kwargs)
+
+    op.__name__ = op_name or getattr(fn, "__name__", "op")
+    return op

@@ -1,0 +1,89 @@
+"""Dtype system.
+
+Maps the reference's phi::DataType (ref: paddle/phi/common/data_type.h) onto
+jnp dtypes. On TPU, bfloat16 is the preferred half-precision type.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (exported at paddle_tpu top level).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize str/np/jnp dtype to a jnp dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in _FLOATING:
+        raise TypeError(f"Default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,100 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's three-tier flag system
+(ref: paddle/phi/core/flags.cc — 89 PADDLE_DEFINE_EXPORTED_* gflags,
+surfaced to Python via paddle.set_flags/get_flags in
+python/paddle/fluid/framework.py:7629). We keep a single typed registry
+with env-var overrides (``FLAGS_<name>``) instead of C++ gflags.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+    value: Any = None
+
+
+def _parse_bool(s: str) -> bool:
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+class FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        if isinstance(default, bool):
+            parser: Callable[[str], Any] = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+        with self._lock:
+            if name in self._flags:
+                return
+            flag = _Flag(name=name, default=default, help=help, parser=parser)
+            env = os.environ.get(f"FLAGS_{name}")
+            flag.value = parser(env) if env is not None else default
+            self._flags[name] = flag
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"Unknown flag: {name!r}")
+            f = self._flags[name]
+            f.value = f.parser(value) if isinstance(value, str) else value
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"Unknown flag: {name!r}")
+            return self._flags[name].value
+
+    def has(self, name: str) -> bool:
+        return name in self._flags
+
+    def all(self) -> Dict[str, Any]:
+        with self._lock:
+            return {k: f.value for k, f in self._flags.items()}
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+# Core flags (subset mirroring the reference's most-used ones).
+GLOBAL_FLAGS.define("check_nan_inf", False, "Scan op outputs for NaN/Inf (ref FLAGS_check_nan_inf)")
+GLOBAL_FLAGS.define("deterministic", False, "Force deterministic execution")
+GLOBAL_FLAGS.define("default_dtype", "float32", "Default floating dtype")
+GLOBAL_FLAGS.define("eager_delete_tensor_gb", 0.0, "Compat no-op: XLA manages memory")
+GLOBAL_FLAGS.define("use_pallas_kernels", True, "Use Pallas kernels for hot ops when on TPU")
+GLOBAL_FLAGS.define("log_level", "WARNING", "Python logging level for paddle_tpu")
+GLOBAL_FLAGS.define("profiler_trace_dir", "", "Directory for profiler trace dumps")
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity (ref python/paddle/fluid/framework.py:7629)."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        GLOBAL_FLAGS.set(name, v)
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """paddle.get_flags parity."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        out[k] = GLOBAL_FLAGS.get(name)
+    return out

@@ -1,0 +1,66 @@
+"""paddle.save / paddle.load parity (ref: python/paddle/framework/io.py:637,879).
+
+Pickle-based object serialization handling Tensor / state_dict / nested
+containers. Sharded & async checkpointing lives in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .core import Parameter, Tensor
+
+
+class _TensorPayload:
+    def __init__(self, array: np.ndarray, trainable: bool, name: str = "", is_param=False):
+        self.array = array
+        self.trainable = trainable
+        self.name = name
+        self.is_param = is_param
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Parameter):
+        return _TensorPayload(np.asarray(obj.value), obj.trainable, obj.name, True)
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.value), not obj.stop_gradient, obj.name, False)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.is_param:
+            p = Parameter(obj.array, trainable=obj.trainable, name=obj.name)
+            return p
+        return Tensor(obj.array, stop_gradient=not obj.trainable, name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,68 @@
+"""Global RNG state.
+
+JAX PRNG is explicit/functional; eager mode keeps a global splitting key so the
+paddle-style stateful API (`paddle.seed`, implicit randomness in dropout etc.)
+works (ref: python/paddle/fluid/framework.py default_main_program random seed,
+paddle.seed). Distributed per-mode seeds (TP-aware RNG) live in
+paddle_tpu.distributed.fleet.meta_parallel.random (ref:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py:35
+RNGStatesTracker).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    _default_generator.manual_seed(s)
+    np.random.seed(int(s) % (2 ** 32))
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+def next_key():
+    return _default_generator.next_key()

@@ -1,0 +1,6 @@
+"""High-level API (ref: python/paddle/hapi/model.py Model:1004, fit:1696)."""
+from .model import Model
+from . import callbacks  # noqa: F401
+from .summary import summary
+
+__all__ = ["Model", "callbacks", "summary"]

@@ -1,0 +1,13 @@
+"""paddle.incubate parity (ref: python/paddle/incubate/).
+
+Currently: autograd (functional jacobian/hessian/vjp/jvp over jax transforms),
+nn fused layers (incubate/nn/layer/fused_transformer.py analogues live in
+paddle_tpu.incubate.nn), autotune config shim.
+"""
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+
+
+def autotune(config=None):
+    """Kernel/layout autotune shim: XLA autotunes on TPU at compile time."""
+    return None

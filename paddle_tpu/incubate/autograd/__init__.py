@@ -1,0 +1,110 @@
+"""Functional autograd (ref: python/paddle/incubate/autograd/functional.py —
+jacobian/hessian/jvp/vjp; primapi.py forward_grad).
+
+These are direct jax transforms over a pure function of Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, no_grad_ctx, to_array
+
+
+def _pure(func):
+    def fn(*vals):
+        with no_grad_ctx():
+            out = func(*[Tensor(v) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value if isinstance(o, Tensor) else o for o in out)
+        return out.value if isinstance(out, Tensor) else out
+
+    return fn
+
+
+def _vals(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [to_array(x) for x in xs]
+
+
+def vjp(func, xs, v=None):
+    vals = _vals(xs)
+    out, vjp_fn = jax.vjp(_pure(func), *vals)
+    if v is None:
+        v_val = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        v_val = to_array(v) if isinstance(v, Tensor) else jax.tree_util.tree_map(to_array, v)
+    grads = vjp_fn(v_val)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    gs = [Tensor(g) for g in grads]
+    return outs, gs if len(gs) > 1 else gs[0]
+
+
+def jvp(func, xs, v=None):
+    vals = _vals(xs)
+    if v is None:
+        v_vals = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        v_vals = tuple(to_array(t) for t in v_list)
+    out, tangent = jax.jvp(_pure(func), tuple(vals), v_vals)
+    outs = Tensor(out) if not isinstance(out, tuple) else tuple(Tensor(o) for o in out)
+    tans = Tensor(tangent) if not isinstance(tangent, tuple) else tuple(
+        Tensor(t) for t in tangent)
+    return outs, tans
+
+
+forward_grad = jvp
+
+
+class Jacobian:
+    """Ref autograd/functional.py Jacobian — lazy row/col evaluation skipped;
+    computes the full jacobian via jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals = _vals(xs)
+        jac = jax.jacrev(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+        self._jac = jac if len(vals) > 1 else (jac,)
+        self._single = len(vals) == 1
+
+    def __getitem__(self, idx):
+        j = self._jac[0] if self._single else self._jac
+        return Tensor(j[idx] if not self._single else self._jac[0][idx])
+
+    @property
+    def shape(self):
+        return list(self._jac[0].shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac[0])
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        vals = _vals(xs)
+        h = jax.hessian(_pure(func))(*vals)
+        self._h = h
+
+    def __getitem__(self, idx):
+        return Tensor(self._h[idx])
+
+    @property
+    def shape(self):
+        return list(self._h.shape)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    vals = _vals(xs)
+    jac = jax.jacrev(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    if len(vals) == 1:
+        return Tensor(jac[0] if isinstance(jac, tuple) else jac)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    vals = _vals(xs)
+    h = jax.hessian(_pure(func))(*vals)
+    return Tensor(h)

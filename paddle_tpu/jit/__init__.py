@@ -1,0 +1,236 @@
+"""paddle.jit parity (ref: python/paddle/jit/api.py:222 to_static,
+dy2static/program_translator.py).
+
+TPU-native redesign: there is no AST transformation pipeline (the reference's
+~20 *_transformer.py rewrite Python into ProgramDesc ops). Here ``to_static``
+= trace the layer/function with jax.jit over a functional view of its
+parameters.  The traced jaxpr plays the role of ProgramDesc; XLA plays the
+role of the static executor (ref interpretercore.cc — no runtime equivalent
+needed).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Parameter, Tensor, no_grad_ctx, to_array
+
+
+class InputSpec:
+    """Ref python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        from ..framework.dtype import convert_dtype
+
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# --------------------------------------------------------------------------- #
+# functional view of a Layer: swap param values, run, restore.
+# --------------------------------------------------------------------------- #
+
+
+@contextlib.contextmanager
+def _swapped_params(layer, named_values: Dict[str, Any]):
+    saved = {}
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    store = {**params, **buffers}
+    try:
+        for name, val in named_values.items():
+            t = store.get(name)
+            if t is None:
+                continue
+            saved[name] = t._value
+            t._value = val
+        yield
+    finally:
+        for name, val in saved.items():
+            store[name]._value = val
+
+
+def state_values(layer) -> Dict[str, jax.Array]:
+    """Extract {name: raw array} for params + buffers."""
+    out = {}
+    for name, p in layer.named_parameters():
+        out[name] = p.value
+    for name, b in layer.named_buffers():
+        out[name] = b.value
+    return out
+
+
+def param_values(layer) -> Dict[str, jax.Array]:
+    return {name: p.value for name, p in layer.named_parameters() if p.trainable}
+
+
+def functional_call(layer, named_values: Dict[str, Any], *args, **kwargs):
+    """Run ``layer(*args)`` with parameters/buffers temporarily replaced by
+    ``named_values`` (possibly tracers). The tape is disabled: gradients on
+    this path come from jax.grad over this function."""
+    with _swapped_params(layer, named_values), no_grad_ctx():
+        out = layer(*args, **kwargs)
+    return out
+
+
+def _unwrap(o):
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, Tensor) else x, o,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(o):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(x, jax.Array) else x, o)
+
+
+class StaticFunction:
+    """Ref dy2static/program_translator.py:282 StaticFunction: a callable that
+    runs the jit-compiled program while looking like the original method."""
+
+    def __init__(self, fn: Callable, layer=None, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jitted = None
+        self._donate = False
+        functools.update_wrapper(self, fn)
+
+    @property
+    def forward_fn(self):
+        return self._fn
+
+    def _build(self):
+        layer = self._layer
+
+        if layer is not None:
+            def pure(params, arg_vals, kw_vals):
+                out = functional_call(layer, params, *_wrap(arg_vals), **_wrap(kw_vals))
+                return _unwrap(out)
+        else:
+            fn = self._fn
+
+            def pure(params, arg_vals, kw_vals):
+                with no_grad_ctx():
+                    out = fn(*_wrap(arg_vals), **_wrap(kw_vals))
+                return _unwrap(out)
+
+        self._pure = pure
+        self._jitted = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        params = state_values(self._layer) if self._layer is not None else {}
+        arg_vals = _unwrap(args)
+        kw_vals = _unwrap(kwargs)
+        out = self._jitted(params, arg_vals, kw_vals)
+        return _wrap(out)
+
+    def concrete_program(self, *args, **kwargs):
+        params = state_values(self._layer) if self._layer is not None else {}
+        return jax.make_jaxpr(self._pure if self._jitted else self._build() or self._pure)(
+            params, _unwrap(args), _unwrap(kwargs))
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static parity (ref jit/api.py:222)."""
+
+    def decorate(fn):
+        from ..nn.layer_base import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: persists state_dict + an input-spec manifest.
+
+    The reference serializes a translated ProgramDesc (jit/translated_layer.py);
+    our compiled artifact is re-derivable from code + weights, so we save
+    weights + spec, and `jit.load` restores a callable wrapper. For true AOT
+    serving export use paddle_tpu.inference (StableHLO export).
+    """
+    import os
+    import pickle
+
+    from ..framework.io_state import save as _save
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _save(layer.state_dict(), path + ".pdiparams")
+    meta = {
+        "class": type(layer).__name__,
+        "input_spec": [
+            {"shape": s.shape, "dtype": str(jnp.dtype(s.dtype)), "name": s.name}
+            for s in (input_spec or [])
+        ],
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded inference layer (ref jit/translated_layer.py)."""
+
+    def __init__(self, state_dict, meta):
+        self._state_dict = state_dict
+        self._meta = meta
+        self._layer = None
+
+    def bind(self, layer):
+        layer.set_state_dict(self._state_dict)
+        self._layer = layer
+        return layer
+
+    def state_dict(self):
+        return self._state_dict
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None:
+            raise RuntimeError(
+                "TranslatedLayer.bind(model) must be called with a model instance first "
+                "(program reconstruction from serialized IR is replaced by code+weights).")
+        return self._layer(*args, **kwargs)
+
+
+def load(path, **configs):
+    import pickle
+
+    from ..framework.io_state import load as _load
+
+    sd = _load(path + ".pdiparams")
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    return TranslatedLayer(sd, meta)

@@ -1,0 +1,10 @@
+"""paddle.linalg namespace (ref: python/paddle/linalg.py re-exports)."""
+from ..tensor.linalg import (cdist, cholesky, cholesky_solve, cond, det, dist, eig, eigh,
+                             eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack,
+                             matrix_exp, matrix_norm, matrix_power, matrix_rank, multi_dot,
+                             norm, ormqr, pca_lowrank, pinv, qr, slogdet, solve, svd,
+                             svd_lowrank, svdvals, triangular_solve, vector_norm, matmul, bmm,
+                             mm, dot, corrcoef)
+from ..tensor.math import cross
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,38 @@
+"""paddle.nn parity surface (ref: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
+                   clip_grad_value_)
+from .layer_base import Layer
+from .layer.activation import (CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish,
+                               Hardtanh, LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish,
+                               PReLU, ReLU, ReLU6, RReLU, SELU, Sigmoid, Silu, Softmax,
+                               Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                               ThresholdedReLU)
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+                           Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear,
+                           Pad1D, Pad2D, Pad3D, PixelShuffle, PixelUnshuffle, Unfold,
+                           Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
+                         Conv3DTranspose)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, GaussianNLLLoss, HingeEmbeddingLoss, HuberLoss,
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                         MultiLabelSoftMarginLoss, MultiMarginLoss, NLLLoss, PoissonNLLLoss,
+                         SmoothL1Loss, SoftMarginLoss, TripletMarginLoss)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+                         InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+                         LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+                            AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+                            AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+                            MaxUnPool2D)
+from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
+                        SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
+                                TransformerDecoderLayer, TransformerEncoder,
+                                TransformerEncoderLayer)
+from ..framework.param_attr import ParamAttr  # noqa: F401  (paddle.ParamAttr alias)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

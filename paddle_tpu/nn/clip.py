@@ -1,0 +1,105 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py ClipGradByGlobalNorm etc.)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dispatch import apply_op
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, apply_op(lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+
+            def f(v):
+                n = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                return (v * scale).astype(v.dtype)
+
+            out.append((p, apply_op(f, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Ref fluid/clip.py ClipGradByGlobalNorm. In hybrid-parallel the global
+    norm is additionally reduced across model-parallel groups — see
+    distributed.fleet HybridParallelClipGrad (ref
+    hybrid_parallel_optimizer.py:45)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        sq = sum(float(jnp.sum(jnp.square(g.value.astype(jnp.float32)))) for g in grads)
+        global_norm = sq ** 0.5
+        scale = min(self.clip_norm / max(global_norm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, apply_op(lambda v: (v * scale).astype(v.dtype), g)))
+        return out
+
+
+GradientClipBase = ClipGradBase
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(g.value))) for g in grads)
+    else:
+        total = sum(float(jnp.sum(jnp.power(jnp.abs(g.value.astype(jnp.float32)),
+                                            norm_type))) for g in grads) ** (1.0 / norm_type)
+    scale = max_norm / (total + 1e-6)
+    if scale < 1.0:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad = Tensor(p.grad.value * scale)
+    return Tensor(jnp.asarray(total))
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad.value, -clip_value, clip_value))

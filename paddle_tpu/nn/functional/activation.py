@@ -1,0 +1,147 @@
+"""Activation functions (ref: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply_op, defop
+
+relu = defop(jax.nn.relu, "relu")
+relu6 = defop(lambda x: jnp.clip(x, 0, 6), "relu6")
+sigmoid = defop(jax.nn.sigmoid, "sigmoid")
+tanh = defop(jnp.tanh, "tanh")
+silu = defop(jax.nn.silu, "silu")
+swish = silu
+mish = defop(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+hardswish = defop(lambda x: x * jnp.clip(x + 3, 0, 6) / 6, "hardswish")
+hardsigmoid = defop(lambda x: jnp.clip(x / 6 + 0.5, 0, 1), "hardsigmoid")
+tanhshrink = defop(lambda x: x - jnp.tanh(x), "tanhshrink")
+softsign = defop(jax.nn.soft_sign, "softsign")
+log_sigmoid = defop(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), x, op_name="gelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha=alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha=alpha), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope=negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return apply_op(f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...framework.random import next_key
+
+    def f(v):
+        if training:
+            a = jax.random.uniform(next_key(), v.shape, jnp.float32, lower, upper).astype(v.dtype)
+        else:
+            a = (lower + upper) / 2.0
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply_op(f, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(lambda v: jnp.where(v > threshold, v, value), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply_op(f, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply_op(f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply_op(f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+
+    def f(v):
+        g = jax.random.gumbel(next_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                jnp.zeros_like(y).at[...].set(jax.nn.one_hot(
+                    jnp.argmax(y, axis=axis), y.shape[axis], axis=axis, dtype=y.dtype))
+            y = y_hard + jax.lax.stop_gradient(-y) + y
+        return y
+
+    return apply_op(f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda v: jax.nn.glu(v, axis=axis), x)

@@ -1,0 +1,316 @@
+"""Common nn ops: linear, dropout, pad, interpolate, etc.
+(ref: python/paddle/nn/functional/common.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+from ...framework.random import next_key
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W stored [in, out] (paddle layout,
+    ref python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply_op(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+    return apply_op(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return apply_op(lambda v: v, x)
+    key = next_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op(f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return apply_op(lambda v: v, x)
+    key = next_key()
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / (1.0 - p) / (1 + p * alpha_p ** 2 / (1.0 - p))) ** 0.5 \
+            if p < 1 else 0.0
+        a = ((1.0 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op(f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            # full-form pads, paddle order is per-axis ascending
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to spatial dims per data_format
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C") or data_format in ("NLC", "NHWC", "NDHWC"):
+                spatial = list(range(1, 1 + n_spatial))
+            else:
+                spatial = list(range(nd - n_spatial, nd))
+            # paddle pad order: last-dim first pair? For NCHW pad=[l,r,t,b]:
+            # pads W then H — i.e. reversed spatial order
+            for i, dim in enumerate(reversed(spatial)):
+                widths[dim] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+
+    return apply_op(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    if isinstance(scale_factor, Tensor):
+        scale_factor = scale_factor.tolist()
+
+    def f(v):
+        channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+        nd = v.ndim
+        n_spatial = nd - 2
+        if channel_last:
+            spatial = list(range(1, 1 + n_spatial))
+        else:
+            spatial = list(range(2, nd))
+        in_sizes = [v.shape[d] for d in spatial]
+        if size is not None:
+            out_sizes = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * n_spatial
+            out_sizes = [int(i * s) for i, s in zip(in_sizes, sf)]
+        out_shape = list(v.shape)
+        for d, s in zip(spatial, out_sizes):
+            out_shape[d] = s
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest":
+            # jax.image nearest matches paddle (floor) semantics
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # build index grid with align_corners semantics per spatial dim
+            out = v
+            for d, s_out in zip(spatial, out_sizes):
+                s_in = out.shape[d]
+                if s_out == s_in:
+                    continue
+                if s_out == 1 or s_in == 1:
+                    idx = jnp.zeros((s_out,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0.0, s_in - 1.0, s_out)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, s_in - 1)
+                w = (idx - lo).astype(v.dtype)
+                lo_v = jnp.take(out, lo, axis=d)
+                hi_v = jnp.take(out, hi, axis=d)
+                bshape = [1] * out.ndim
+                bshape[d] = s_out
+                w = w.reshape(bshape)
+                out = lo_v * (1 - w) + hi_v * w
+            return out.astype(v.dtype)
+        return jax.image.resize(v, out_shape, method=jmode).astype(v.dtype)
+
+    return apply_op(f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+
+    return apply_op(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            v = v.transpose(0, 2, 1, 3, 4)
+            return v.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        v = v.transpose(0, 1, 2, 4, 3)
+        return v.reshape(n, h, w, c)
+
+    return apply_op(f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref unfold op)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        out_h = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        out_w = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = v[:, :, i * dl[0]: i * dl[0] + out_h * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + out_w * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], out_h * out_w)
+
+    return apply_op(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        out_h = os_[0] + 2 * pd[0]
+        out_w = os_[1] + 2 * pd[1]
+        n_h = (out_h - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        n_w = (out_w - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], n_h, n_w)
+        out = jnp.zeros((n, c, out_h, out_w), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + n_h * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + n_w * st[1]: st[1]].add(v[:, :, i, j])
+        return out[:, :, pd[0]: out_h - pd[0], pd[1]: out_w - pd[1]]
+
+    return apply_op(f, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply_op(f, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    if bias is None:
+        return apply_op(lambda a, b, w: f(a, b, w), x1, x2, weight)
+    return apply_op(f, x1, x2, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+    return apply_op(f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lbl, *pd):
+        k = lbl.shape[-1]
+        if pd:
+            return (1 - epsilon) * lbl + epsilon * pd[0]
+        return (1 - epsilon) * lbl + epsilon / k
+
+    if prior_dist is None:
+        return apply_op(f, label)
+    return apply_op(f, label, prior_dist)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-style API, out of TPU MVP scope")

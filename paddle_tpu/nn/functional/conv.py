@@ -1,0 +1,167 @@
+"""Convolutions (ref: python/paddle/nn/functional/conv.py).
+
+All lower to lax.conv_general_dilated, which XLA tiles onto the MXU.
+Weights use paddle layout [out_c, in_c/groups, *spatial].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.dispatch import apply_op
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(i) for i in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _pad_spec(padding, n, stride=None, dilation=None, ksize=None):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (list, tuple)) and len(padding) == n and \
+            isinstance(padding[0], (list, tuple)):
+        return [tuple(p) for p in padding]
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    p = _tup(padding, n)
+    return [(int(i), int(i)) for i in p]
+
+
+def _dimnums(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    pad = _pad_spec(padding, n)
+    lhs_spec, _, out_spec = _dimnums(n, channel_last)
+
+    def f(v, w, *b):
+        # weight always [out, in/groups, *k] (paddle layout); convert per spec
+        if n == 1:
+            wj = w.transpose(2, 1, 0) if channel_last else w
+        elif n == 2:
+            wj = w.transpose(2, 3, 1, 0) if channel_last else w
+        else:
+            wj = w.transpose(2, 3, 4, 1, 0) if channel_last else w
+        rhs_spec = _dimnums(n, channel_last)[1]
+        out = jax.lax.conv_general_dilated(
+            v, wj,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
+        )
+        out = out.astype(v.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if bias is None:
+        return apply_op(f, x, weight, op_name=f"conv{n}d")
+    return apply_op(f, x, weight, bias, op_name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NLC" if data_format == "NLC" else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n,
+                    data_format, output_size):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    stride = _tup(stride, n)
+    dilation = _tup(dilation, n)
+    opad = _tup(output_padding, n) if output_padding is not None else (0,) * n
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pads = _pad_spec(padding, n)
+
+    def f(v, w, *b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # grad-of-conv formulation: lhs-dilate input by stride
+        if channel_last:
+            perm = [0, n + 1] + list(range(1, n + 1))
+            v_nc = v.transpose(perm)  # to NC...
+        else:
+            v_nc = v
+        in_c = v_nc.shape[1]
+        # build the forward-conv weight [in_c, out_c/groups, *k] -> use as
+        # conv with flipped kernel: out = conv(dilated_x, flip(w^T))
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))  # flip spatial
+        # w: [in, out/g, *k] -> conv weight [out, in/g, *k]
+        wc = jnp.reshape(wt, (groups, in_c // groups) + wt.shape[1:])
+        wc = jnp.swapaxes(wc, 1, 2)  # [g, out/g, in/g, *k]
+        wc = jnp.reshape(wc, (-1,) + wc.shape[2:])  # [out, in/g, *k]
+        conv_pads = []
+        for i in range(n):
+            k_eff = dilation[i] * (w.shape[2 + i] - 1)
+            lo, hi = pads[i]
+            conv_pads.append((k_eff - lo, k_eff - hi + opad[i]))
+        out = jax.lax.conv_general_dilated(
+            v_nc, wc,
+            window_strides=(1,) * n,
+            padding=conv_pads,
+            lhs_dilation=stride,
+            rhs_dilation=dilation,
+            dimension_numbers=_dimnums(n, False),
+            feature_group_count=groups,
+        ).astype(v.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        if channel_last:
+            perm_back = [0] + list(range(2, n + 2)) + [1]
+            out = out.transpose(perm_back)
+        return out
+
+    if bias is None:
+        return apply_op(f, x, weight, op_name=f"conv{n}d_transpose")
+    return apply_op(f, x, weight, bias, op_name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+                     dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           1, "NLC" if data_format == "NLC" else "NCW", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+                     dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+                     dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                           3, data_format, output_size)

@@ -1,0 +1,397 @@
+"""Loss functions (ref: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Ref softmax_with_cross_entropy / F.cross_entropy semantics."""
+
+    def f(logits, lbl, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30, None))
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            if w:
+                loss = loss * jnp.sum(tgt * w[0], axis=axis)
+            return _reduce(loss, reduction)
+        lbl_i = lbl.astype(jnp.int32)
+        if lbl_i.ndim == logits.ndim:
+            lbl_i = jnp.squeeze(lbl_i, axis=axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            onehot = jax.nn.one_hot(lbl_i, k, axis=axis, dtype=jnp.float32)
+            tgt = onehot * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl_i, axis), axis=axis)
+            loss = jnp.squeeze(loss, axis=axis)
+        valid = (lbl_i != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lbl_i, 0, None), axis=0)
+            wt = jnp.where(valid, wt, 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if loss.ndim < len(logits.shape) else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lbl, *w):
+        lbl_i = lbl.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl_i, 1), axis=1).squeeze(1)
+        valid = lbl_i != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lbl_i, 0, None))
+            wt = jnp.where(valid, wt, 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                    op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * d - 0.5 * delta * delta)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, t, *w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(t * jnp.log(p32) + (1 - t) * jnp.log1p(-p32))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, t, *extra):
+        z32 = z.astype(jnp.float32)
+        t32 = t.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*t + log(1+exp(-|z|)), with pos_weight on the t term
+        if pw is not None:
+            log_w = (pw - 1) * t32 + 1
+            loss = (1 - t32) * z32 + log_w * (jnp.logaddexp(0.0, -jnp.abs(z32))
+                                              + jnp.maximum(-z32, 0.0))
+        else:
+            loss = jnp.maximum(z32, 0) - z32 * t32 + jnp.logaddexp(0.0, -jnp.abs(z32))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply_op(f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, t: _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction),
+        input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, t: _reduce(jnp.where(t == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, t):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) *
+                                    jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), -1), 1 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(f, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin, swap=swap,
+                                   reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        dn = apply_op(jnp.minimum, dn, dpn)
+    return apply_op(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction), dp, dn)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, t: _reduce(jnp.log1p(jnp.exp(-t * a)), reduction), input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def f(z, t, *w):
+        loss = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        loss = jnp.mean(loss, axis=-1)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, t, *nrm):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        ce = jnp.maximum(z, 0) - z * t + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op(f, *args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, t: -t * jnp.log(p + epsilon) - (1 - t) * jnp.log1p(epsilon - p + 1e-30),
+        input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
+             norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+
+    Ref: warpctc op. log_probs: (T, B, C) already log-softmaxed or raw logits.
+    """
+
+    def f(lp, lbl, in_len, lbl_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended labels: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lbl_len > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+            emit = lp_t[jnp.arange(B)[:, None], ext]
+            return new + emit, None
+
+        def scan_fn(carry, t):
+            alpha = carry
+            new, _ = step(alpha, lp[t])
+            # freeze past input_length
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(scan_fn, alpha0, jnp.arange(1, T))
+        end1 = alpha[jnp.arange(B), 2 * lbl_len.astype(jnp.int32)]
+        end2 = alpha[jnp.arange(B), jnp.maximum(2 * lbl_len.astype(jnp.int32) - 1, 0)]
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op(f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, lbl):
+        logits = a @ p.T
+        t = (lbl[:, None] == lbl[None, :]).astype(jnp.float32)
+        t = t / jnp.sum(t, -1, keepdims=True)
+        ce = -jnp.sum(t * jax.nn.log_softmax(logits, -1), -1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1)) + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return jnp.mean(ce) + reg
+
+    return apply_op(f, anchor, positive, labels)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, t):
+        t1 = jax.nn.one_hot(t.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * t1, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + \
+            jnp.sum(t1, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - 2 * inter / (union + epsilon))
+
+    return apply_op(f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean",
+                      name=None):
+    def f(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(t - mu) / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label, variance)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, t):
+        if log_input:
+            loss = jnp.exp(z) - t * z
+        else:
+            loss = z - t * jnp.log(z + epsilon)
+        if full:
+            stirling = t * jnp.log(jnp.maximum(t, 1.0)) - t + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(t, 1.0))
+            loss = loss + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply_op(f, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean",
+                      name=None):
+    def f(z, t, *w):
+        n, c = z.shape
+        correct = jnp.take_along_axis(z, t.astype(jnp.int32)[:, None], 1)
+        diff = jnp.maximum(0.0, margin - correct + z)
+        diff = jnp.power(diff, p)
+        if w:
+            wt = jnp.take(w[0], t.astype(jnp.int32))[:, None]
+            diff = diff * wt
+        mask = jax.nn.one_hot(t.astype(jnp.int32), c) == 0
+        loss = jnp.sum(diff * mask, -1) / c
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def rnnt_loss(*args, **kwargs):
+    raise NotImplementedError("rnnt_loss: planned (transducer loss via lax.scan)")

@@ -1,0 +1,193 @@
+"""Normalization ops (ref: python/paddle/nn/functional/norm.py).
+
+On TPU these fuse into surrounding element-wise chains via XLA; the Pallas
+fused rms/layer-norm kernels in paddle_tpu.ops are used by the transformer
+fast path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        norm = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+
+    return apply_op(f, x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else \
+        [normalized_shape]
+    n_axes = len(ns)
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args, op_name="layer_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    def f(v, rm, rv, *wb):
+        axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
+        shape = [1] * v.ndim
+        shape[channel_axis % v.ndim] = v.shape[channel_axis % v.ndim]
+        if use_stats:
+            mean, var = rm, rv
+        else:
+            xf = v.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+        out = (v.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape).astype(jnp.float32) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    out = apply_op(f, x, running_mean, running_var, *args, op_name="batch_norm")
+
+    # update running stats (stateful side effect, eager semantics)
+    if training and not use_stats and isinstance(running_mean, Tensor):
+        v = to_array(x)
+        axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
+        batch_mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+        batch_var = jnp.var(v.astype(jnp.float32), axis=axes)
+        n = 1
+        for i in axes:
+            n *= v.shape[i]
+        unbiased = batch_var * (n / max(n - 1, 1))
+        running_mean._value = (momentum * running_mean.value
+                               + (1 - momentum) * batch_mean).astype(running_mean.dtype)
+        running_var._value = (momentum * running_var.value
+                              + (1 - momentum) * unbiased).astype(running_var.dtype)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    def f(v, *wb):
+        # normalize over spatial dims per (N, C)
+        axes = tuple(range(2, v.ndim))
+        xf = v.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    def f(v, *wb):
+        channel_last = not data_format.startswith("NC")
+        if channel_last:
+            v_nc = jnp.moveaxis(v, -1, 1)
+        else:
+            v_nc = v
+        n, c = v_nc.shape[:2]
+        spatial = v_nc.shape[2:]
+        g = v_nc.reshape(n, num_groups, c // num_groups, *spatial).astype(jnp.float32)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_nc.shape)
+        shape = [1, c] + [1] * (v_nc.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        out = out.astype(v.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args, op_name="group_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (LLaMA-style). Not in the reference's 2.4 API — added because
+    our flagship models need it; the Pallas fused version lives in
+    paddle_tpu.ops.fused_norm."""
+
+    def f(v, *w):
+        xf = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        out = xf * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [weight] if weight is not None else []
+    return apply_op(f, x, *args, op_name="rms_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(v):
+        channel_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v.astype(jnp.float32))
+        c = v.shape[channel_axis]
+        half = size // 2
+        sq_m = jnp.moveaxis(sq, channel_axis, 0)
+        padded = jnp.pad(sq_m, [(half, size - 1 - half)] + [(0, 0)] * (sq_m.ndim - 1))
+        acc = jnp.zeros_like(sq_m)
+        for i in range(size):
+            acc = acc + padded[i:i + c]
+        acc = jnp.moveaxis(acc, 0, channel_axis)
+        return (v / jnp.power(k + alpha * acc / size, beta).astype(v.dtype)).astype(v.dtype)
+
+    return apply_op(f, x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    def f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), jnp.float32)
+        v = None
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v if v is not None else jnp.linalg.norm(wm, 2)
+        return w / sigma
+
+    return apply_op(f, weight)

@@ -1,0 +1,224 @@
+"""Pooling ops (ref: python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.dispatch import apply_op
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (v if len(v) == n else list(v) * n)[:n])
+    return tuple(int(v) for _ in range(n))
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    p = _tup(padding, n)
+    return [(i, i) for i in p]
+
+
+def _pool(x, ksize, stride, padding, n, data_format, reducer, init, ceil_mode=False,
+          avg_exclusive=True, count_include_pad=False):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    ksize = _tup(ksize, n)
+    stride = _tup(stride, n) if stride is not None else ksize
+    pads = _pads(padding, n)
+
+    def f(v):
+        nd = v.ndim
+        if channel_last:
+            spatial = list(range(1, 1 + n))
+        else:
+            spatial = list(range(2, nd))
+        window = [1] * nd
+        strides = [1] * nd
+        for d, k, s in zip(spatial, ksize, stride):
+            window[d] = k
+            strides[d] = s
+        if isinstance(pads, str):
+            pad_cfg = pads
+        else:
+            pad_cfg = [(0, 0)] * nd
+            for d, p in zip(spatial, pads):
+                pad_cfg[d] = p
+            if ceil_mode:
+                pad_cfg = list(pad_cfg)
+                for i, d in enumerate(spatial):
+                    size = v.shape[d] + pad_cfg[d][0] + pad_cfg[d][1]
+                    rem = (size - ksize[i]) % stride[i]
+                    if rem != 0:
+                        pad_cfg[d] = (pad_cfg[d][0], pad_cfg[d][1] + stride[i] - rem)
+        if reducer == "max":
+            out = jax.lax.reduce_window(v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                                        else jnp.iinfo(v.dtype).min,
+                                        jax.lax.max, window, strides, pad_cfg)
+            return out
+        # avg
+        summed = jax.lax.reduce_window(v.astype(jnp.float32), 0.0, jax.lax.add, window,
+                                       strides, pad_cfg)
+        if count_include_pad or isinstance(pad_cfg, str):
+            denom = float(np.prod(ksize))
+            return (summed / denom).astype(v.dtype)
+        ones = jnp.ones(v.shape, jnp.float32)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+        return (summed / counts).astype(v.dtype)
+
+    return apply_op(f, x, op_name=f"{reducer}_pool{n}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "NCL", "max", None, ceil_mode)
+    if return_mask:
+        return out, None
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, ceil_mode)
+    if return_mask:
+        return out, None
+    return out
+
+
+def _max_pool_indices(x, ksize, stride, padding, data_format):
+    from .common import unfold as _unfold
+
+    # indices over flattened spatial dims, paddle-style; eager helper
+    k = _tup(ksize, 2)
+    s = _tup(stride, 2) if stride is not None else k
+
+    def f(v):
+        n, c, h, w = v.shape
+        cols = []
+        idxs = []
+        p = _pads(padding, 2)
+        vp = jnp.pad(v, [(0, 0), (0, 0), p[0], p[1]],
+                     constant_values=-jnp.inf)
+        pos = jnp.arange(h * w).reshape(1, 1, h, w).astype(jnp.float32)
+        posp = jnp.pad(pos, [(0, 0), (0, 0), p[0], p[1]], constant_values=-1)
+        oh = (vp.shape[2] - k[0]) // s[0] + 1
+        ow = (vp.shape[3] - k[1]) // s[1] + 1
+        patches, ppos = [], []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(vp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]])
+                ppos.append(jnp.broadcast_to(
+                    posp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]], (n, c, oh, ow)))
+        stacked = jnp.stack(patches, 0)
+        spos = jnp.stack(ppos, 0)
+        am = jnp.argmax(stacked, axis=0)
+        return jnp.take_along_axis(spos, am[None], axis=0)[0].astype(jnp.int32)
+
+    return apply_op(f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", "avg", None, ceil_mode,
+                 count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", None, ceil_mode,
+                 count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", None, ceil_mode,
+                 count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, n, data_format, mode):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    os_ = output_size if isinstance(output_size, (list, tuple)) else [output_size] * n
+    os_ = [int(o) if o is not None else None for o in os_]
+
+    def f(v):
+        nd = v.ndim
+        spatial = list(range(1, 1 + n)) if channel_last else list(range(2, nd))
+        out = v.astype(jnp.float32) if mode == "avg" else v
+        for d, o in zip(spatial, os_):
+            if o is None:
+                continue
+            in_s = out.shape[d]
+            # paddle adaptive pooling: bin i covers [floor(i*in/o), ceil((i+1)*in/o))
+            starts = [int(np.floor(i * in_s / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * in_s / o)) for i in range(o)]
+            segs = []
+            for s_, e_ in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, s_, e_, axis=d)
+                if mode == "avg":
+                    segs.append(jnp.mean(sl, axis=d, keepdims=True))
+                else:
+                    segs.append(jnp.max(sl, axis=d, keepdims=True))
+            out = jnp.concatenate(segs, axis=d)
+        return out.astype(v.dtype)
+
+    return apply_op(f, x, op_name=f"adaptive_{mode}_pool{n}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCL", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return (out, None) if return_mask else out
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+    k = _tup(kernel_size, 2)
+    s = _tup(stride, 2) if stride is not None else k
+
+    def f(v, idx):
+        n, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = int(output_size[-2]), int(output_size[-1])
+        else:
+            oh = (h - 1) * s[0] + k[0]
+            ow = (w - 1) * s[1] + k[1]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        out = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1).astype(jnp.int32)].set(v.reshape(n, c, -1))
+        return out.reshape(n, c, oh, ow)
+
+    return apply_op(f, x, indices)

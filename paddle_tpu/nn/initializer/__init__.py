@@ -1,0 +1,193 @@
+"""Weight initializers (ref: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax.Array`` drawing from
+the global generator (paddle.seed-controlled).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.random import next_key
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle uses [out_c, in_c, *spatial]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(tuple(shape), self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return (jax.random.normal(next_key(), tuple(shape), jnp.float32) * self.std
+                + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=jnp.float32):
+        lo = (self.a - self.mean) / self.std
+        hi = (self.b - self.mean) / self.std
+        x = jax.random.truncated_normal(next_key(), lo, hi, tuple(shape), jnp.float32)
+        return (x * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(next_key(), tuple(shape), jnp.float32, self.low,
+                                  self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(next_key(), tuple(shape), jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), tuple(shape), jnp.float32, -limit,
+                                  limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if \
+            self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(next_key(), tuple(shape), jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if \
+            self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), tuple(shape), jnp.float32, -limit,
+                                  limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        from ...framework.core import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.value
+        arr = jnp.asarray(v, dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign initializer shape {arr.shape} != {tuple(shape)}"
+        return arr
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (for transposed conv)."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        weight = np.zeros(tuple(shape), dtype=np.float32)
+        f = math.ceil(shape[-1] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape[-2:])):
+            x = i % shape[-1]
+            y = (i // shape[-1]) % shape[-2]
+            val = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[..., y, x] = val
+        return jnp.asarray(weight, dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.nn.initializers.orthogonal(self.gain)(next_key(), tuple(shape),
+                                                         jnp.float32).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.nn.initializers.delta_orthogonal()(next_key(), tuple(shape),
+                                                      jnp.float32).astype(dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0
+
+
+# functional aliases matching paddle.nn.initializer names
+constant = Constant
+normal = Normal
+uniform = Uniform

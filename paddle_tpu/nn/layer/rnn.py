@@ -1,0 +1,314 @@
+"""RNN layers (ref: python/paddle/nn/layer/rnn.py).
+
+Recurrence is a lax.scan over time — the XLA-native loop form (the reference's
+cudnn RNN kernels have no TPU analogue; scan compiles to a single fused while
+loop that keeps weights resident in VMEM).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import functional as F
+from ..initializer import Uniform
+from ..layer_base import Layer
+from ...framework.core import Tensor
+from ...framework.dispatch import apply_op
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        from ...tensor.creation import full
+
+        if isinstance(self.state_shape, (list, tuple)) and \
+                isinstance(self.state_shape[0], (list, tuple)):
+            return tuple(full([B] + list(s), init_value, dtype or "float32")
+                         for s in self.state_shape)
+        return full([B] + list(self.state_shape), init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, *biases):
+            z = x @ wi.T + h @ wh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = apply_op(f, *args)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, *biases):
+            z = x @ wi.T + hh @ wh.T
+            for b in biases:
+                z = z + b
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgate = jax.nn.sigmoid(fgate)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = fgate * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        new_h, new_c = apply_op(f, *args)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + (bi if bi is not None else 0)
+            gh = h @ wh.T + (bh if bh is not None else 0)
+            ir, iz, ig = jnp.split(gi, 3, -1)
+            hr, hz, hg = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * h
+
+        if self.bias_ih is not None:
+            h = apply_op(lambda x, hh, wi, wh, bi, bh: f(x, hh, wi, wh, bi, bh),
+                         inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+                         self.bias_hh)
+        else:
+            h = apply_op(lambda x, hh, wi, wh: f(x, hh, wi, wh, None, None),
+                         inputs, states, self.weight_ih, self.weight_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a time-loop (ref nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        states = initial_states
+        outs = []
+        rng = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in rng:
+            x_t = inputs[(slice(None),) * time_axis + (t,)] if False else (
+                inputs[t] if self.time_major else inputs[:, t])
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+
+        outputs = stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            fw_states = bw_states = None
+        else:
+            fw_states, bw_states = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states, sequence_length)
+        from ...tensor.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = 2 if direction == "bidirect" or direction == "bidirectional" else 1
+        self.num_directions = bidirect
+
+        def make_cell(isize):
+            if mode == "LSTM":
+                return LSTMCell(isize, hidden_size, weight_ih_attr, weight_hh_attr,
+                                bias_ih_attr, bias_hh_attr)
+            if mode == "GRU":
+                return GRUCell(isize, hidden_size, weight_ih_attr, weight_hh_attr,
+                               bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(isize, hidden_size, "tanh", weight_ih_attr, weight_hh_attr,
+                                 bias_ih_attr, bias_hh_attr)
+
+        from .container import LayerList
+
+        self.rnns = LayerList()
+        for layer_i in range(num_layers):
+            isize = input_size if layer_i == 0 else hidden_size * bidirect
+            if bidirect == 2:
+                self.rnns.append(BiRNN(make_cell(isize), make_cell(isize), time_major))
+            else:
+                self.rnns.append(RNN(make_cell(isize), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn_l in enumerate(self.rnns):
+            init_i = None
+            if initial_states is not None:
+                init_i = self._slice_states(initial_states, i)
+            out, st = rnn_l(out, init_i, sequence_length)
+            final_states.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, self._merge_states(final_states)
+
+    def _slice_states(self, initial_states, i):
+        # initial_states: (num_layers*dirs, B, H) or tuple of two for LSTM
+        d = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if d == 1:
+                return (h[i], c[i])
+            return ((h[2 * i], c[2 * i]), (h[2 * i + 1], c[2 * i + 1]))
+        h = initial_states
+        if d == 1:
+            return h[i]
+        return (h[2 * i], h[2 * i + 1])
+
+    def _merge_states(self, final_states):
+        from ...tensor.manipulation import stack
+
+        d = self.num_directions
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for st in final_states:
+                if d == 1:
+                    hs.append(st[0])
+                    cs.append(st[1])
+                else:
+                    (h_f, c_f), (h_b, c_b) = st
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+            return stack(hs, 0), stack(cs, 0)
+        hs = []
+        for st in final_states:
+            if d == 1:
+                hs.append(st)
+            else:
+                hs += [st[0], st[1]]
+        return stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)

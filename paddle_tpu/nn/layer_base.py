@@ -1,0 +1,356 @@
+"""Layer: the module base class.
+
+Ref: python/paddle/fluid/dygraph/layers.py (state_dict :1555,
+set_state_dict :1593, hooks, sublayers, create_parameter). Parameters are
+mutable ``Parameter`` objects owned by the layer; the jit/pjit path extracts
+them into a pytree and swaps traced values in (functional-call pattern) —
+see paddle_tpu.jit.functional_call.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, to_array
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks: dict):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------------ attrs
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            params[name] = value
+            layers.pop(name, None) if layers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__() before assigning sublayers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                else:
+                    params[name] = value
+                return
+            if buffers is not None and name in buffers:
+                buffers[name] = value if (value is None or isinstance(value, Tensor)) \
+                    else Tensor(value)
+                return
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + \
+            list(self._buffers)
+
+    # -------------------------------------------------------------- creation
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from .initializer import Constant, XavierUniform
+        from . import initializer as I
+
+        dtype = convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        name = None
+        learning_rate = 1.0
+        trainable = True
+        if attr is not None and attr is not False:
+            from ..framework.param_attr import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer or init
+                name = attr.name
+                learning_rate = attr.learning_rate
+                trainable = attr.trainable
+            elif isinstance(attr, str):
+                name = attr
+            elif callable(attr):
+                init = attr
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        value = init(shape, dtype)
+        p = Parameter(value, trainable=trainable, name=name or "")
+        p.optimize_attr["learning_rate"] = learning_rate
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros([], convert_dtype(dtype) or self._dtype))
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+
+    # ------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True,
+                         include_self: bool = True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self or prefix == "":
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(prefix=sub_prefix, include_self=True,
+                                             layers_set=layers_set)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True):
+        """Ref layers.py:1555 — returns OrderedDict of params + persistable buffers."""
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                full = f"{name}.{bname}" if name else bname
+                if structured_name_prefix:
+                    full = structured_name_prefix + full
+                dest[full] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Ref layers.py:1593."""
+        missing, unexpected = [], []
+        own = self.state_dict()
+        matched = set()
+        for k, v in state_dict.items():
+            if k in own:
+                tgt = own[k]
+                val = to_array(v) if isinstance(v, Tensor) else np.asarray(v)
+                if tuple(val.shape) != tuple(tgt.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: ckpt {tuple(val.shape)} vs "
+                        f"model {tuple(tgt.shape)}")
+                import jax.numpy as jnp
+
+                tgt._value = jnp.asarray(val).astype(tgt.dtype)
+                matched.add(k)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ----------------------------------------------------------------- modes
+    def train(self):
+        self.training = True
+        for l in self.sublayers(include_self=False):
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers(include_self=False):
+            l.training = False
+        return self
+
+    # ----------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        h = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[h._id] = hook
+        return h
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        h = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[h._id] = hook
+        return h
+
+    # ------------------------------------------------------------------ call
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- utilities
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._convert_dtype(convert_dtype(dtype))
+        return self
+
+    def _convert_dtype(self, dtype, only_float=True):
+        import jax.numpy as jnp
+
+        from ..framework.dtype import is_floating_point
+
+        for layer in self.sublayers(include_self=True):
+            layer._dtype = dtype
+            for p in layer._parameters.values():
+                if p is not None and (not only_float or is_floating_point(p.dtype)):
+                    p._value = p._value.astype(dtype)
+            for b in layer._buffers.values():
+                if b is not None and (not only_float or is_floating_point(b.dtype)):
+                    b._value = b._value.astype(dtype)
+
+    def float(self):
+        self._convert_dtype(convert_dtype("float32"))
+        return self
+
+    def bfloat16(self):
+        self._convert_dtype(convert_dtype("bfloat16"))
+        return self
+
+    def half(self):
+        self._convert_dtype(convert_dtype("float16"))
+        return self
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"

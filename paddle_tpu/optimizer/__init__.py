@@ -1,0 +1,7 @@
+"""paddle.optimizer parity (ref: python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, Momentum,
+                        Optimizer, RMSProp, SGD)
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "RMSProp",
+           "Adadelta", "Lamb", "Lars", "lr"]

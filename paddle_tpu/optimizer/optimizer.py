@@ -1,0 +1,457 @@
+"""Optimizers (ref: python/paddle/optimizer/optimizer.py base :294 state_dict;
+adam.py, adamw.py, momentum.py, lamb.py ...).
+
+Eager API parity: ``opt.step()`` reads ``param.grad`` slots and updates
+``param._value`` in place.  Each parameter's update rule is a pure jitted
+function, so the math runs fused on-device; the jit/pjit training path uses
+the same rules through ``functional_update`` (no tape, no .grad slots).
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Parameter, Tensor
+from ..framework.dtype import convert_dtype
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._l2_coeff = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+            self._l2_coeff = getattr(weight_decay, "_coeff",
+                                     getattr(weight_decay, "_regularization_coeff", 0.0)) \
+                if weight_decay is not None else 0.0
+        # per-param slot state: name -> dict of arrays
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._global_step = 0
+
+    # ----------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("optimizer's learning rate can't be LRScheduler when invoke"
+                               " this API, because this will lead to conflict.")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    # --------------------------------------------------------------- state
+    def _slots_for(self, p: Parameter) -> Dict[str, jax.Array]:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._create_slots(p)
+            self._accumulators[key]["__param_ref"] = p
+        return self._accumulators[key]
+
+    def _create_slots(self, p: Parameter) -> Dict[str, jax.Array]:
+        return {}
+
+    def state_dict(self) -> dict:
+        """Ref optimizer.py:294 — accumulator tensors + LR scheduler state."""
+        sd = {}
+        for i, (key, slots) in enumerate(self._accumulators.items()):
+            p = slots.get("__param_ref")
+            pname = p.name if p is not None and p.name else f"param_{i}"
+            for sname, val in slots.items():
+                if sname.startswith("__"):
+                    continue
+                sd[f"{pname}.{sname}"] = Tensor(val) if not isinstance(val, Tensor) else val
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        params = self._get_params()
+        by_name = {}
+        for i, p in enumerate(params):
+            pname = p.name if p.name else f"param_{i}"
+            by_name[pname] = p
+        for k, v in state_dict.items():
+            if k in ("global_step", "LR_Scheduler"):
+                continue
+            if "." not in k:
+                continue
+            pname, sname = k.rsplit(".", 1)
+            p = by_name.get(pname)
+            if p is None:
+                continue
+            slots = self._slots_for(p)
+            slots[sname] = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+    # ---------------------------------------------------------------- step
+    def _get_params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("Optimizer created without explicit parameters; pass "
+                             "parameters=model.parameters()")
+        out = []
+        for item in self._parameter_list:
+            if isinstance(item, dict):
+                out.extend(item["params"])
+            else:
+                out.append(item)
+        return out
+
+    def step(self):
+        params = [p for p in self._get_params() if p.trainable]
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            slots = self._slots_for(p)
+            g_val = g.value.astype(jnp.float32)
+            if self._l2_coeff and self._use_l2_decay():
+                g_val = g_val + self._l2_coeff * p.value.astype(jnp.float32)
+            new_val, new_slots = self._apply_one(
+                p.value, g_val, lr, self._global_step,
+                {k: v for k, v in slots.items() if not k.startswith("__")})
+            p._value = new_val
+            slots.update(new_slots)
+
+    def _use_l2_decay(self) -> bool:
+        return True  # L2 regularization folded into grads (paddle weight_decay semantics)
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        raise NotImplementedError
+
+    # ---------------------------------------------------- functional (jit/pjit)
+    def init_state(self, params: Dict[str, jax.Array]) -> Dict[str, Dict[str, jax.Array]]:
+        """Pure slot-state init for the compiled path (params: name → array)."""
+
+        class _P:
+            def __init__(self, v):
+                self.shape = tuple(v.shape)
+                self.dtype = v.dtype
+                self.value = v
+
+        return {name: self._create_slots(_P(v)) for name, v in params.items()}
+
+    def pure_update(self, params, grads, state, lr, step, pnames=None):
+        """One optimizer step as a pure function — used inside pjit train steps
+        (the ZeRO/master-weight sharding comes from the state's shardings)."""
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state.get(name, {})
+                continue
+            g = g.astype(jnp.float32)
+            if self._l2_coeff and self._use_l2_decay():
+                g = g + self._l2_coeff * p.astype(jnp.float32)
+            np_, ns = self._apply_one(p, g, lr, step, state.get(name, {}))
+            new_params[name] = np_
+            new_state[name] = ns
+        return new_params, new_state
+
+    def clear_grad(self, set_to_zero: bool = True):
+        for p in self._get_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        return (param.astype(jnp.float32) - lr * grad).astype(param.dtype), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_slots(self, p):
+        return {"velocity": jnp.zeros(tuple(p.shape), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        v = slots["velocity"] * self._momentum + grad
+        if self._nesterov:
+            upd = grad + self._momentum * v
+        else:
+            upd = v
+        return (param.astype(jnp.float32) - lr * upd).astype(param.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _create_slots(self, p):
+        slots = {
+            "moment1": jnp.zeros(tuple(p.shape), jnp.float32),
+            "moment2": jnp.zeros(tuple(p.shape), jnp.float32),
+        }
+        if self._multi_precision and p.dtype != jnp.float32:
+            slots["master_weight"] = p.value.astype(jnp.float32)
+        return slots
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment1"] + (1 - b1) * grad
+        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        master = slots.get("master_weight", param.astype(jnp.float32))
+        new_master = master - lr * mhat / (jnp.sqrt(vhat) + eps)
+        out = {"moment1": m, "moment2": v}
+        if "master_weight" in slots:
+            out["master_weight"] = new_master
+        return new_master.astype(param.dtype), out
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, name)
+        self._wd_coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param = None
+
+    def _use_l2_decay(self):
+        return False
+
+    def step(self):
+        params = [p for p in self._get_params() if p.trainable]
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            slots = self._slots_for(p)
+            decay = self._wd_coeff
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(p.name):
+                decay = 0.0
+            lr_r = self._lr_ratio(p) if self._lr_ratio is not None else 1.0
+            new_val, new_slots = self._apply_adamw(
+                p.value, g.value.astype(jnp.float32), lr * lr_r, self._global_step, decay,
+                {k: v for k, v in slots.items() if not k.startswith("__")})
+            p._value = new_val
+            slots.update(new_slots)
+
+    def pure_update(self, params, grads, state, lr, step, pnames=None):
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state.get(name, {})
+                continue
+            decay = self._wd_coeff
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(name):
+                decay = 0.0
+            np_, ns = self._apply_adamw(p, g.astype(jnp.float32), lr, step, decay,
+                                        state.get(name, {}))
+            new_params[name] = np_
+            new_state[name] = ns
+        return new_params, new_state
+
+    def _apply_adamw(self, param, grad, lr, step, decay, slots):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        master = slots.get("master_weight", param.astype(jnp.float32))
+        master = master * (1 - lr * decay)
+        m = b1 * slots["moment1"] + (1 - b1) * grad
+        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        new_master = master - lr * mhat / (jnp.sqrt(vhat) + eps)
+        out = {"moment1": m, "moment2": v}
+        if "master_weight" in slots:
+            out["master_weight"] = new_master
+        return new_master.astype(param.dtype), out
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        return {"moment": jnp.zeros(tuple(p.shape), jnp.float32),
+                "inf_norm": jnp.zeros(tuple(p.shape), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(grad))
+        upd = lr / (1 - b1 ** step) * m / (u + eps)
+        return (param.astype(jnp.float32) - upd).astype(param.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_slots(self, p):
+        return {"moment": jnp.full(tuple(p.shape), self._init_acc, jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        acc = slots["moment"] + grad * grad
+        return (param.astype(jnp.float32) - lr * grad / (jnp.sqrt(acc) + self._epsilon)
+                ).astype(param.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, \
+            centered
+
+    def _create_slots(self, p):
+        s = {"mean_square": jnp.zeros(tuple(p.shape), jnp.float32),
+             "momentum_acc": jnp.zeros(tuple(p.shape), jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(tuple(p.shape), jnp.float32)
+        return s
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * grad * grad
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * grad
+            out["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum_acc"] + lr * grad / denom
+        out["momentum_acc"] = mom
+        return (param.astype(jnp.float32) - mom).astype(param.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros(tuple(p.shape), jnp.float32),
+                "avg_squared_update": jnp.zeros(tuple(p.shape), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        g2 = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * grad * grad
+        upd = grad * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(g2 + self._epsilon)
+        u2 = self._rho * slots["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (param.astype(jnp.float32) - lr * upd).astype(param.dtype), \
+            {"avg_squared_grad": g2, "avg_squared_update": u2}
+
+
+class Lamb(Optimizer):
+    """Ref python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_slots(self, p):
+        return {"moment1": jnp.zeros(tuple(p.shape), jnp.float32),
+                "moment2": jnp.zeros(tuple(p.shape), jnp.float32)}
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * slots["moment1"] + (1 - b1) * grad
+        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        p32 = param.astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_wd * p32
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class Lars(Momentum):
+    """LARS momentum (ref fluid LarsMomentumOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None, name=None,
+                 exclude_from_weight_decay=None, epsilon=0):
+        super().__init__(learning_rate, momentum, parameters, False, None, grad_clip,
+                         name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def _apply_one(self, param, grad, lr, step, slots):
+        p32 = param.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(grad * grad))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm / (g_norm + self._lars_wd * p_norm + self._lars_eps),
+            1.0)
+        upd = grad + self._lars_wd * p32
+        v = self._momentum * slots["velocity"] + lr * local_lr * upd
+        return (p32 - v).astype(param.dtype), {"velocity": v}

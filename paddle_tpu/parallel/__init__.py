@@ -1,0 +1,13 @@
+"""TPU parallel engine: mesh context, sharding annotations, and the sharded
+train-step builder. This is the GSPMD-native replacement for the reference's
+auto_parallel Engine/Partitioner/Resharder (ref
+python/paddle/distributed/auto_parallel/engine.py:58, partitioner.py,
+reshard.py) — propagation/partition/reshard all happen inside XLA.
+"""
+from .api import (current_mesh, mesh_context, shard_constraint, shard_tensor, psum,
+                  all_gather_axis, axis_index, axis_size)
+from .engine import ParallelEngine, parallelize, make_train_step
+
+__all__ = ["current_mesh", "mesh_context", "shard_constraint", "shard_tensor", "psum",
+           "all_gather_axis", "axis_index", "axis_size", "ParallelEngine", "parallelize",
+           "make_train_step"]

@@ -1,0 +1,130 @@
+"""Sharding annotation primitives.
+
+``shard_constraint`` is the workhorse: inside a pjit-traced program with an
+active mesh it applies jax.lax.with_sharding_constraint (the analogue of the
+reference's reshard-op insertion, reshard.py); outside it is the identity, so
+the same layer code runs eagerly on one chip and partitioned on a pod.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+class _MeshState(threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+        self.in_spmd = False
+
+
+_state = _MeshState()
+
+
+def current_mesh() -> Optional[Mesh]:
+    if _state.mesh is not None:
+        return _state.mesh
+    from ..distributed.collective import get_global_mesh
+
+    return get_global_mesh()
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh, in_spmd: bool = True):
+    prev, prev_flag = _state.mesh, _state.in_spmd
+    _state.mesh = mesh
+    _state.in_spmd = in_spmd
+    try:
+        yield mesh
+    finally:
+        _state.mesh, _state.in_spmd = prev, prev_flag
+
+
+def in_spmd_region() -> bool:
+    return _state.in_spmd
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have / size-1 axes."""
+    parts = []
+    for p in spec:
+        if p is None:
+            parts.append(None)
+        elif isinstance(p, (list, tuple)):
+            kept = [a for a in p if a in mesh.shape and mesh.shape[a] > 1]
+            parts.append(tuple(kept) if kept else None)
+        else:
+            parts.append(p if (p in mesh.shape and mesh.shape[p] > 1) else None)
+    return P(*parts)
+
+
+def shard_constraint(x, spec: P):
+    """Annotate intermediate sharding; identity outside SPMD tracing."""
+    mesh = current_mesh()
+    if mesh is None or not _state.in_spmd:
+        return x
+    spec = _filter_spec(spec, mesh)
+    # trim spec to rank
+    nd = x.ndim if hasattr(x, "ndim") else len(x.shape)
+    parts = list(spec)[:nd]
+    spec = P(*parts)
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(x, Tensor):
+        return apply_op(lambda v: jax.lax.with_sharding_constraint(v, sharding), x)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def shard_tensor(x, mesh: Optional[Mesh] = None, spec: P = P(), process_mesh=None,
+                 shard_spec=None):
+    """paddle.distributed.shard_tensor parity (ref auto_parallel/interface.py:28):
+    eagerly places the array with a NamedSharding."""
+    mesh = mesh or current_mesh()
+    if shard_spec is not None:
+        spec = P(*[s if s else None for s in shard_spec])
+    if mesh is None:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    val = to_array(x)
+    spec = _filter_spec(spec, mesh)
+    out = jax.device_put(val, NamedSharding(mesh, spec))
+    if isinstance(x, Tensor):
+        x._value = out
+        return x
+    return Tensor(out)
+
+
+def axis_size(name: str) -> int:
+    mesh = current_mesh()
+    if mesh is None or name not in mesh.shape:
+        return 1
+    return int(mesh.shape[name])
+
+
+def axis_index(name: str):
+    """Inside shard_map: this shard's index on the axis; 0 otherwise."""
+    try:
+        return jax.lax.axis_index(name)
+    except NameError:
+        return jnp.zeros((), jnp.int32)
+
+
+def psum(x, axis_name: str):
+    """psum that is identity when the axis isn't bound (eager path)."""
+    try:
+        return jax.lax.psum(x, axis_name)
+    except NameError:
+        return x
+
+
+def all_gather_axis(x, axis_name: str, tiled=True):
+    try:
+        return jax.lax.all_gather(x, axis_name, tiled=tiled)
+    except NameError:
+        return x

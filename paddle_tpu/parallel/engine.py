@@ -1,0 +1,216 @@
+"""Sharded train-step builder — the GSPMD-native auto-parallel Engine.
+
+Ref: python/paddle/distributed/auto_parallel/engine.py:58 (Engine.fit :811,
+_build :515 → _parallel :700) + parallelizer_v2.py: the reference completes
+dist attrs, slices per-rank programs (Partitioner) and inserts reshard comm.
+Here all three steps are XLA's job: we (1) collect per-parameter
+PartitionSpecs (layer-provided, e.g. ColumnParallelLinear, or FSDP-style
+auto-sharding), (2) jit the (loss, grads, opt-update) step with those
+shardings as in/out shardings over the mesh, (3) let GSPMD propagate and
+insert collectives. ZeRO == param/opt-state sharding over the "sharding"
+axis (ref dygraph_sharding_optimizer.py:29, group_sharded_stage{2,3}.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Parameter, Tensor, no_grad_ctx
+from ..jit import functional_call, state_values
+from .api import _filter_spec, mesh_context
+
+
+def _auto_fsdp_spec(name: str, arr, axis: str = "sharding", min_size: int = 1024) -> P:
+    """ZeRO-3-style: shard the largest dim over the sharding axis when the
+    param is big enough and divisible (ref group_sharded_stage3.py:60 —
+    param sharding with fwd allgather, which GSPMD emits automatically)."""
+    if arr.size < min_size:
+        return P()
+    shape = arr.shape
+    if not shape:
+        return P()
+    best = int(np.argmax(shape))
+    parts = [None] * len(shape)
+    parts[best] = axis
+    return P(*parts)
+
+
+def param_specs(model, mesh: Mesh, fsdp: bool = False, fsdp_axis: str = "sharding"
+                ) -> Dict[str, P]:
+    specs: Dict[str, P] = {}
+    for name, p in model.named_parameters():
+        spec = getattr(p, "pspec", None)
+        if spec is None:
+            spec = _auto_fsdp_spec(name, p.value, fsdp_axis) if fsdp else P()
+        specs[name] = _filter_spec(spec, mesh)
+    for name, b in model.named_buffers():
+        specs[name] = P()
+    return specs
+
+
+def _sharding_of(mesh, spec):
+    return NamedSharding(mesh, spec)
+
+
+class ParallelEngine:
+    """Owns sharded params + optimizer state and a compiled train step.
+
+    Stateful on purpose (donated buffers): eager model params are copied in
+    once, updated on-device every step, and synced back on demand
+    (`sync_to_model`) for checkpointing through the normal state_dict path.
+    """
+
+    def __init__(self, model, optimizer=None, loss_fn: Optional[Callable] = None,
+                 mesh: Optional[Mesh] = None, fsdp: bool = False, remat: bool = False,
+                 batch_spec: Any = P("data"), donate: bool = True):
+        from ..distributed.collective import get_global_mesh
+
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or get_global_mesh()
+        if self.mesh is None:
+            devs = np.array(jax.devices()[:1])
+            self.mesh = Mesh(devs.reshape(1), ("data",))
+        self.fsdp = fsdp
+        self.remat = remat
+        self.batch_spec = batch_spec
+        self._donate = donate
+        self._build_state()
+        self._train_step = None
+        self._eval_step = None
+
+    # ------------------------------------------------------------------ state
+    def _build_state(self):
+        mesh = self.mesh
+        self.specs = param_specs(self.model, mesh, fsdp=self.fsdp)
+        vals = state_values(self.model)
+        self.params = {
+            name: jax.device_put(v, _sharding_of(mesh, self.specs.get(name, P())))
+            for name, v in vals.items()
+        }
+        self._trainable = {name for name, p in self.model.named_parameters()
+                           if p.trainable}
+        if self.optimizer is not None:
+            train_params = {n: v for n, v in self.params.items() if n in self._trainable}
+            state = self.optimizer.init_state(train_params)
+            # opt state shards like its param (ZeRO-1/2: ref
+            # dygraph_sharding_optimizer.py — state lives sharded)
+            self.opt_state = {
+                n: {k: jax.device_put(v, _sharding_of(mesh, self.specs.get(n, P())))
+                    for k, v in slots.items()}
+                for n, slots in state.items()
+            }
+        else:
+            self.opt_state = {}
+
+    # ------------------------------------------------------------- train step
+    def _loss_from_batch(self, params, batch):
+        model, loss_fn = self.model, self.loss_fn
+
+        def call(p, *args):
+            with mesh_context(self.mesh):
+                out = functional_call(model, p, *[Tensor(a) for a in args])
+            return out
+
+        if isinstance(batch, dict):
+            inputs = batch.get("inputs", ())
+            labels = batch.get("labels", ())
+            inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+            labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        else:
+            *inputs, label = batch
+            labels = (label,)
+        out = call(params, *inputs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        with mesh_context(self.mesh):
+            loss = loss_fn(*outs, *[Tensor(l) for l in labels])
+        return loss.value if isinstance(loss, Tensor) else loss
+
+    def build_train_step(self):
+        mesh = self.mesh
+        opt = self.optimizer
+
+        def step_fn(params, opt_state, step_count, lr, batch):
+            train = {n: v for n, v in params.items() if n in self._trainable}
+            frozen = {n: v for n, v in params.items() if n not in self._trainable}
+
+            def loss_of(tr):
+                return self._loss_from_batch({**tr, **frozen}, batch)
+
+            loss_of_ = jax.checkpoint(loss_of) if self.remat else loss_of
+            loss, grads = jax.value_and_grad(loss_of_)(train)
+            new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
+                                                   step_count + 1)
+            # keep shardings stable across steps
+            new_train = {
+                n: jax.lax.with_sharding_constraint(
+                    v, _sharding_of(mesh, self.specs.get(n, P())))
+                for n, v in new_train.items()
+            }
+            return {**new_train, **frozen}, new_state, step_count + 1, loss
+
+        self._step_count = jnp.zeros((), jnp.int32)
+        donate = (0, 1, 2) if self._donate else ()
+        self._train_step = jax.jit(step_fn, donate_argnums=donate)
+        return self._train_step
+
+    def train_batch(self, *batch):
+        """Run one compiled, sharded train step; returns host loss."""
+        if self._train_step is None:
+            self.build_train_step()
+        lr = self.optimizer.get_lr()
+        batch_vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                           for b in batch)
+        batch_vals = tuple(
+            jax.device_put(b, _sharding_of(self.mesh, _filter_spec(
+                self.batch_spec if not isinstance(self.batch_spec, (list, tuple))
+                else self.batch_spec[i], self.mesh)))
+            for i, b in enumerate(batch_vals))
+        self.params, self.opt_state, self._step_count, loss = self._train_step(
+            self.params, self.opt_state, self._step_count, lr, batch_vals)
+        if isinstance(self.optimizer._learning_rate, object) and hasattr(
+                self.optimizer._learning_rate, "step"):
+            try:
+                self.optimizer._learning_rate.step()
+            except TypeError:
+                pass
+        return Tensor(loss)
+
+    def eval_batch(self, *batch):
+        if self._eval_step is None:
+            def ev(params, batch):
+                return self._loss_from_batch(params, batch)
+
+            self._eval_step = jax.jit(ev)
+        batch_vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                           for b in batch)
+        return Tensor(self._eval_step(self.params, batch_vals))
+
+    # ------------------------------------------------------------------- sync
+    def sync_to_model(self):
+        store = {**dict(self.model.named_parameters()),
+                 **dict(self.model.named_buffers())}
+        for name, v in self.params.items():
+            if name in store:
+                store[name]._value = v
+
+    def state_dict(self):
+        self.sync_to_model()
+        return self.model.state_dict()
+
+
+def parallelize(model, optimizer=None, loss_fn=None, mesh=None, **kwargs) -> ParallelEngine:
+    return ParallelEngine(model, optimizer=optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)
+
+
+def make_train_step(model, loss_fn, optimizer, mesh=None, **kwargs):
+    eng = ParallelEngine(model, optimizer=optimizer, loss_fn=loss_fn, mesh=mesh, **kwargs)
+    eng.build_train_step()
+    return eng

@@ -1,0 +1,252 @@
+"""Profiler (ref: python/paddle/profiler/profiler.py:344 Profiler,
+ProfilerState:79, timer.py benchmark).
+
+TPU-native: device-side tracing delegates to jax.profiler (XLA/TPU trace →
+TensorBoard); host-side RecordEvent spans are kept in-process and dumped as
+chrome-trace JSON (ref chrometracing_logger.cc) so the runtime layers we own
+are observable without TensorBoard.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class _HostEventRecorder:
+    """Thread-local host event store (ref host_event_recorder.h)."""
+
+    def __init__(self):
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def add(self, name: str, ts: float, dur: float, cat: str = "op"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "X", "ts": ts * 1e6, "dur": dur * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(), "cat": cat,
+            })
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            ev, self._events = self._events, []
+            return ev
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """RAII span (ref platform/profiler RecordEvent; usable as ctx or decorator)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def end(self):
+        if self._start is not None:
+            _recorder.add(self.name, self._start, time.perf_counter() - self._start)
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Ref profiler.py make_scheduler."""
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat > 0 and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_{int(time.time())}.pt.trace.json")
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": prof._events}, f)
+        return fname
+
+    return handler
+
+
+class Profiler:
+    """Ref profiler.py:344."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else (lambda _: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._events: List[dict] = []
+        self._state = ProfilerState.CLOSED
+        self._timer_only = timer_only
+        self._jax_tracing = False
+        self._trace_dir = None
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        _recorder.enabled = self._state in (ProfilerState.RECORD,
+                                            ProfilerState.RECORD_AND_RETURN)
+        if _recorder.enabled and not self._timer_only:
+            self._maybe_start_jax_trace()
+
+    def _maybe_start_jax_trace(self):
+        from ..framework.flags import GLOBAL_FLAGS
+
+        trace_dir = GLOBAL_FLAGS.get("profiler_trace_dir")
+        if trace_dir:
+            try:
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+                self._jax_tracing = True
+                self._trace_dir = trace_dir
+            except Exception:
+                self._jax_tracing = False
+
+    def step(self, num_samples=None):
+        self._step += 1
+        new_state = self._scheduler(self._step)
+        if new_state != self._state:
+            if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self._events.extend(_recorder.drain())
+                if self._state == ProfilerState.RECORD_AND_RETURN and self._on_trace_ready:
+                    self._on_trace_ready(self)
+            self._state = new_state
+            _recorder.enabled = new_state in (ProfilerState.RECORD,
+                                              ProfilerState.RECORD_AND_RETURN)
+
+    def stop(self):
+        self._events.extend(_recorder.drain())
+        _recorder.enabled = False
+        if self._jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path: str, format: str = "json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        """Op-summary table (ref profiler_statistic.py)."""
+        agg = {}
+        for e in self._events:
+            a = agg.setdefault(e["name"], {"calls": 0, "total": 0.0})
+            a["calls"] += 1
+            a["total"] += e["dur"] / 1e3  # ms
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
+            lines.append(f"{name:<40}{a['calls']:>8}{a['total']:>12.3f}"
+                         f"{a['total'] / a['calls']:>12.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+class Timer:
+    """Throughput meter (ref profiler/timer.py benchmark())."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start = None
+        self._samples = 0
+        self._elapsed = 0.0
+        self._reader_elapsed = 0.0
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def end(self, num_samples=1):
+        if self._start is not None:
+            self._elapsed += time.perf_counter() - self._start
+            self._samples += num_samples
+            self._start = None
+
+    def ips(self):
+        return self._samples / self._elapsed if self._elapsed > 0 else 0.0
+
+
+def benchmark():
+    return Timer()
+
+
+@contextlib.contextmanager
+def trace(name: str):
+    """jax.profiler.TraceAnnotation + host RecordEvent in one."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name), RecordEvent(name):
+        yield
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,80 @@
+"""paddle.sparse (ref: python/paddle/sparse/ — COO/CSR tensors + ops).
+
+TPU-native: XLA has no native sparse storage; we use the standard JAX
+approach (jax.experimental.sparse BCOO) wrapped in paddle's API names.
+Sparse compute lowers to gather/scatter + dense MXU matmuls, which is also
+how TPUs execute sparsity best.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, to_array
+
+
+class SparseCooTensor(Tensor):
+    """COO tensor (ref paddle/phi/core/sparse_coo_tensor.h)."""
+
+    __slots__ = ("_bcoo",)
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = to_array(indices) if isinstance(indices, Tensor) else jnp.asarray(indices)
+    vals = to_array(values) if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1).astype(jnp.int32)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    # convert CSR to COO rows
+    crows_np = np.asarray(to_array(crows) if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(to_array(cols) if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype, place, stop_gradient)
+
+
+def matmul(x, y, name=None):
+    from ..framework.dispatch import apply_op
+
+    if isinstance(x, SparseCooTensor):
+        bcoo = x._bcoo
+        return apply_op(lambda yv: bcoo @ yv, y)
+    return apply_op(jnp.matmul, x, y)
+
+
+def add(x, y, name=None):
+    from ..tensor.math import add as _add
+
+    return _add(x.to_dense() if isinstance(x, SparseCooTensor) else x,
+                y.to_dense() if isinstance(y, SparseCooTensor) else y)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

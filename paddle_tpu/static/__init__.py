@@ -1,0 +1,48 @@
+"""paddle.static shim.
+
+The reference's static graph (ProgramDesc IR + Executor,
+ref python/paddle/static/) is replaced by jaxpr + XLA under
+paddle_tpu.jit.to_static. This module keeps the most-used static symbols
+importable so user code ports cleanly; Program-building APIs raise with
+guidance.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+class Program:
+    def __init__(self):
+        raise NotImplementedError(
+            "paddle_tpu has no ProgramDesc IR; use paddle_tpu.jit.to_static (jaxpr/XLA) "
+            "for compiled execution.")
+
+
+def default_main_program():
+    raise NotImplementedError("No static graph: see paddle_tpu.jit.to_static")
+
+
+def default_startup_program():
+    raise NotImplementedError("No static graph: see paddle_tpu.jit.to_static")
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "The standalone executor (ref interpretercore.cc) is replaced by XLA; "
+            "run models eagerly or under paddle_tpu.jit.to_static.")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    raise NotImplementedError("Use paddle_tpu.jit.save / paddle_tpu.inference export")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError("Use paddle_tpu.jit.load")
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,8 @@
+"""paddle.static.nn shim — static-graph layer builders have no TPU analogue;
+the dynamic `paddle_tpu.nn` layers cover the capability."""
+
+
+def __getattr__(name):
+    raise NotImplementedError(
+        f"paddle.static.nn.{name} is a ProgramDesc builder; use the paddle_tpu.nn layer "
+        "equivalent under jit.to_static instead.")

@@ -1,0 +1,147 @@
+"""Functional tensor op surface (ref: python/paddle/tensor/).
+
+Also patches the ops onto Tensor as methods + operator overloads, the
+analogue of the reference's math-op monkey patches
+(ref: python/paddle/fluid/dygraph/math_op_patch.py)."""
+from __future__ import annotations
+
+from . import attribute, creation, einsum as _einsum_mod, linalg, logic, manipulation, math, \
+    random, search, stat
+from .attribute import imag, rank, real, shape
+from .creation import (arange, assign, clone, complex, diag, diagflat, empty, empty_like, eye,
+                       full, full_like, linspace, logspace, meshgrid, ones, ones_like, polar,
+                       to_tensor, tril, tril_indices, triu, triu_indices, zeros, zeros_like)
+from .einsum import einsum
+from .linalg import (cdist, cholesky, cholesky_solve, cond, cross, det, dist, eig, eigh,
+                     eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack,
+                     matrix_exp, matrix_norm, matrix_power, matrix_rank, multi_dot, norm, pinv,
+                     qr, slogdet, solve, svd, svd_lowrank, svdvals, triangular_solve,
+                     vector_norm)
+from .logic import (allclose, bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,
+                    bitwise_right_shift, bitwise_xor, equal, equal_all, greater_equal,
+                    greater_than, is_empty, is_tensor, isclose, less_equal, less_than,
+                    logical_and, logical_not, logical_or, logical_xor, not_equal)
+from .manipulation import (as_complex, as_real, broadcast_tensors, broadcast_to, chunk, concat,
+                           crop, expand, expand_as, flatten, flip, gather, gather_nd,
+                           index_add, index_put, index_sample, index_select, masked_fill,
+                           masked_scatter, masked_select, moveaxis, pad, put_along_axis,
+                           repeat_interleave, reshape, reshape_, roll, rot90, scatter, scatter_,
+                           scatter_nd, scatter_nd_add, shard_index, slice, split, squeeze,
+                           stack, strided_slice, swapaxes, t, take_along_axis, tensor_split,
+                           tensordot, tile, transpose, unfold, unique, unique_consecutive,
+                           unsqueeze, unstack, view, view_as)
+from .math import (abs, acos, acosh, add, addmm, all, amax, amin, angle, any, asin, asinh, atan,
+                   atan2, atanh, bmm, broadcast_shape, ceil, clip, conj, copysign, cos, cosh,
+                   count_nonzero, cross, cummax, cummin, cumprod, cumsum, deg2rad, diff,
+                   digamma, divide, dot, erf, erfinv, exp, expm1, floor, floor_divide,
+                   floor_mod, fmax, fmin, frac, gcd, heaviside, hypot, i0, imag, increment,
+                   inner, inverse, isfinite, isinf, isnan, kron, lcm, lerp, lgamma, log, log1p,
+                   log2, log10, logaddexp, logit, logsumexp, matmul, max, maximum, mean,
+                   min, minimum, mm, mod, multiplex, multiply, nan_to_num, nanmean,
+                   nansum, neg, nextafter, outer, pow, prod, rad2deg, real, reciprocal,
+                   remainder, renorm, round, rsqrt, scale, sigmoid, sign, sin, sinh, sqrt,
+                   square, stanh, subtract, sum, take, tan, tanh, trace, trapezoid, trunc)
+from .random import (bernoulli, bernoulli_, binomial, exponential_, gaussian, multinomial,
+                     normal, normal_, poisson, rand, randint, randint_like, randn, randperm,
+                     standard_gamma, standard_normal, uniform, uniform_)
+from .search import (argmax, argmin, argsort, bucketize, index_fill, kthvalue, mode, nonzero,
+                     searchsorted, sort, topk, where)
+from .stat import (bincount, corrcoef, cov, histogram, histogramdd, median, nanmedian,
+                   nanquantile, numel, quantile, std, var)
+
+from ..framework.core import Tensor
+
+
+def _patch_tensor_methods():
+    import operator as _op
+
+    from ..framework.dispatch import apply_op
+    import jax.numpy as jnp
+
+    T = Tensor
+
+    # ---- arithmetic operators ----
+    def _binop(fn, reverse=False):
+        def method(self, other):
+            if reverse:
+                return fn(other if isinstance(other, Tensor) else to_tensor(other), self)
+            return fn(self, other)
+
+        return method
+
+    T.__add__ = _binop(add)
+    T.__radd__ = _binop(add, True)
+    T.__sub__ = _binop(subtract)
+    T.__rsub__ = _binop(subtract, True)
+    T.__mul__ = _binop(multiply)
+    T.__rmul__ = _binop(multiply, True)
+    T.__truediv__ = _binop(divide)
+    T.__rtruediv__ = _binop(divide, True)
+    T.__floordiv__ = _binop(floor_divide)
+    T.__rfloordiv__ = _binop(floor_divide, True)
+    T.__mod__ = _binop(mod)
+    T.__rmod__ = _binop(mod, True)
+    T.__pow__ = _binop(pow)
+    T.__rpow__ = _binop(pow, True)
+    T.__matmul__ = _binop(matmul)
+    T.__rmatmul__ = _binop(matmul, True)
+    T.__neg__ = lambda self: neg(self)
+    T.__abs__ = lambda self: abs(self)
+    T.__invert__ = lambda self: apply_op(jnp.invert, self)
+    T.__eq__ = lambda self, o: equal(self, o if isinstance(o, Tensor) else to_tensor(o))
+    T.__ne__ = lambda self, o: not_equal(self, o if isinstance(o, Tensor) else to_tensor(o))
+    T.__lt__ = _binop(less_than)
+    T.__le__ = _binop(less_equal)
+    T.__gt__ = _binop(greater_than)
+    T.__ge__ = _binop(greater_equal)
+    T.__and__ = _binop(logical_and)
+    T.__or__ = _binop(logical_or)
+    T.__xor__ = _binop(logical_xor)
+
+    # ---- methods from functional modules ----
+    import sys
+
+    this = sys.modules[__name__]
+    method_names = [
+        "abs", "acos", "acosh", "add", "addmm", "all", "allclose", "amax", "amin", "angle",
+        "any", "argmax", "argmin", "argsort", "asin", "asinh", "atan", "atan2", "atanh",
+        "bincount", "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "bmm",
+        "broadcast_to", "ceil", "cholesky", "chunk", "clip", "concat", "conj", "cos", "cosh",
+        "count_nonzero", "cross", "cumprod", "cumsum", "cummax", "cummin", "deg2rad", "det",
+        "diagflat", "diff", "digamma", "dist", "divide", "dot", "equal", "equal_all", "erf",
+        "erfinv", "exp", "expand", "expand_as", "expm1", "flatten", "flip", "floor",
+        "floor_divide", "floor_mod", "fmax", "fmin", "frac", "gather", "gather_nd",
+        "greater_equal", "greater_than", "histogram", "imag", "increment", "index_add",
+        "index_fill", "index_put", "index_sample", "index_select", "inner", "inverse",
+        "isclose", "isfinite", "isinf", "isnan", "kron", "kthvalue", "lcm", "lerp", "lgamma",
+        "less_equal", "less_than", "log", "log1p", "log2", "log10", "logical_and",
+        "logical_not", "logical_or", "logical_xor", "logit", "logsumexp", "masked_fill",
+        "masked_select", "matmul", "matrix_power", "max", "maximum", "mean", "median", "min",
+        "minimum", "mm", "mod", "moveaxis", "multiplex", "multiply", "nan_to_num", "nanmean",
+        "nanmedian", "nansum", "neg", "nonzero", "norm", "not_equal", "numel", "outer", "pow",
+        "prod", "put_along_axis", "quantile", "rad2deg", "rank", "real", "reciprocal",
+        "remainder", "repeat_interleave", "reshape", "reshape_", "roll", "rot90", "round",
+        "rsqrt", "scale", "scatter", "scatter_", "scatter_nd_add", "sigmoid", "sign", "sin",
+        "sinh", "slice", "sort", "split", "sqrt", "square", "squeeze", "stanh", "std",
+        "strided_slice", "subtract", "sum", "t", "take", "take_along_axis", "tanh",
+        "tensor_split", "tile", "topk", "trace", "transpose", "tril", "triu", "trunc",
+        "unbind" if hasattr(this, "unbind") else "unstack", "unfold", "unique",
+        "unique_consecutive", "unsqueeze", "unstack", "var", "view", "view_as", "where",
+        "bernoulli_", "exponential_", "normal_", "uniform_", "tan", "acos",
+    ]
+    for nm in method_names:
+        fn = getattr(this, nm, None)
+        if fn is not None and not hasattr(T, nm):
+            setattr(T, nm, fn)
+
+    # Paddle 'T' property
+    T.T = property(lambda self: transpose(self, list(range(self.ndim))[::-1]))
+    T.mT = property(lambda self: swapaxes(self, -1, -2))
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+_patch_tensor_methods()
+Tensor.unbind = unbind

@@ -1,0 +1,36 @@
+"""Tensor attribute ops (ref: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..framework.dtype import is_complex, is_floating_point, is_integer
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, jnp.int64))
+
+
+def is_floating_point_fn(x):
+    return is_floating_point(x.dtype)
+
+
+def is_integer_fn(x):
+    return is_integer(x.dtype)
+
+
+def is_complex_fn(x):
+    return is_complex(x.dtype)
+
+
+def real(x, name=None):
+    return apply_op(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply_op(jnp.imag, x)

@@ -1,0 +1,181 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..framework.dtype import convert_dtype, get_default_dtype, is_floating_point
+
+
+def _resolve_dtype(dtype, data=None):
+    if dtype is not None:
+        return convert_dtype(dtype)
+    return None
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        val = data.value
+    else:
+        val = jnp.asarray(data)
+    dtype = _resolve_dtype(dtype)
+    if dtype is not None:
+        val = val.astype(dtype)
+    elif val.dtype == jnp.float64:
+        # paddle defaults python floats to the default float dtype
+        val = val.astype(get_default_dtype())
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros(_shape_list(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.ones(_shape_list(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    else:
+        dtype = convert_dtype(dtype)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(lambda v: jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)), x)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            v = v.item()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if dtype is None:
+        dtype = jnp.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) else get_default_dtype()
+    else:
+        dtype = convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = num.item() if isinstance(num, Tensor) else num
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=dtype))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[to_array(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v, k=offset) - jnp.diag(
+                jnp.full((v.shape[0],), padding_value, v.dtype), k=offset)
+        return jnp.diag(v, k=offset)
+
+    return apply_op(f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    val = to_array(x)
+    if output is not None:
+        output.set_value(val)
+        return output
+    return Tensor(jnp.asarray(val))
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: r + 1j * i.astype(jnp.result_type(i, jnp.complex64)), real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return apply_op(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)), abs_t, angle)

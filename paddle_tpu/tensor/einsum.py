@@ -1,0 +1,13 @@
+"""Einsum (ref: python/paddle/tensor/einsum.py) — delegates to jnp.einsum,
+which XLA maps onto MXU dot_generals."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.dispatch import apply_op
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(lambda *vs: jnp.einsum(equation, *vs), *operands, op_name="einsum")

@@ -1,0 +1,278 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py).
+
+All lower to XLA's native decompositions — on TPU these run on the MXU where
+possible (matmul-rich algorithms) with fp32 accumulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from .math import matmul, dot, bmm, mm  # re-exported by paddle.linalg
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(v)))
+            return jnp.linalg.norm(v, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(v, compute_uv=False), axis=-1)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=_ax(axis), keepdims=keepdim) if axis is not None \
+                else jnp.max(jnp.abs(v))
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=_ax(axis), keepdims=keepdim) if axis is not None \
+                else jnp.min(jnp.abs(v))
+        if axis is None:
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p)), 1.0 / p)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=_ax(axis), keepdims=keepdim),
+                         1.0 / p)
+
+    def _ax(a):
+        if isinstance(a, (list, tuple)):
+            return tuple(int(i) for i in a)
+        return int(a)
+
+    return apply_op(f, x, op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op(lambda v: jnp.linalg.norm(v, ord=None if p == "fro" else p,
+                                              axis=tuple(axis), keepdims=keepdim), x)
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    t = to_array(tol) if isinstance(tol, Tensor) else tol
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, rtol=None if t is None else t), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return apply_op(f, x)
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return apply_op(f, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply_op(f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply_op(f, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    outs = apply_op(f, x)
+    if get_infos:
+        return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
+    return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_v = to_array(x)
+    piv = np.asarray(to_array(y)) - 1
+    n = lu_v.shape[-2]
+    P = np.eye(n)
+    perm = np.arange(n)
+    for i, p in enumerate(piv.reshape(-1)[:n]):
+        perm[[i, p]] = perm[[p, i]]
+    P = P[perm]
+    L = jnp.tril(lu_v, -1) + jnp.eye(lu_v.shape[-2], lu_v.shape[-1])
+    U = jnp.triu(lu_v)
+    return Tensor(jnp.asarray(P.T)), Tensor(L), Tensor(U)
+
+
+def qr(x, mode="reduced", name=None):
+    def f(v):
+        q, r = jnp.linalg.qr(v, mode=mode)
+        return q, r
+
+    if mode == "r":
+        return apply_op(lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return apply_op(f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+    return apply_op(f, x)
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, v = svd(x)
+    return u[..., :q], s[..., :q], v[..., :q]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    qq = q if q is not None else min(6, *x.shape[-2:])
+
+    def f(v):
+        if center:
+            v = v - jnp.mean(v, axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(v, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vh, -1, -2)[..., :qq]
+
+    return apply_op(f, x)
+
+
+def eig(x, name=None):
+    v = np.asarray(to_array(x))
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigvals(x, name=None):
+    v = np.asarray(to_array(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigh(x, UPLO="L", name=None):
+    def f(v):
+        w, vec = jnp.linalg.eigh(v, UPLO=UPLO)
+        return w, vec
+
+    return apply_op(f, x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    sol, res, rank, sv = apply_op(f, x, y)
+    return sol, res, rank, sv
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+def matrix_exp(x, name=None):
+    return apply_op(jax.scipy.linalg.expm, x)
+
+
+def householder_product(x, tau, name=None):
+    def f(v, t):
+        m, n = v.shape[-2], v.shape[-1]
+        eye = jnp.eye(m, dtype=v.dtype)
+        Q = jnp.broadcast_to(eye, v.shape[:-2] + (m, m))
+
+        def body(i, Q):
+            w = jnp.where(jnp.arange(m)[:, None] > i, v[..., :, i:i + 1], 0.0)
+            w = w.at[..., 0, 0].set(0.0)
+            w = w + jnp.eye(m, 1, -int(0), dtype=v.dtype) * 0
+            e = jax.nn.one_hot(i, m, dtype=v.dtype)[:, None]
+            w = jnp.where(jnp.arange(m)[:, None] == i, 1.0, w)
+            w = jnp.where(jnp.arange(m)[:, None] < i, 0.0, w)
+            H = jnp.eye(m, dtype=v.dtype) - t[..., i] * (w @ jnp.swapaxes(w, -1, -2))
+            return Q @ H
+
+        for i in range(t.shape[-1]):
+            Q = body(i, Q)
+        return Q[..., :, :n]
+
+    return apply_op(f, x, tau)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cross(x, y, axis=9, name=None):
+    from .math import cross as _cross
+
+    return _cross(x, y, axis)
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+    return apply_op(f, x, y)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    v = np.asarray(to_array(x))
+    rng = None if (min == 0 and max == 0) else (min, max)
+    return Tensor(jnp.asarray(np.histogram_bin_edges(v, bins=bins, range=rng)))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    Q = householder_product(x, tau)
+    Qv = Q.value if isinstance(Q, Tensor) else Q
+
+    def f(q, o):
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return jnp.matmul(qm, o) if left else jnp.matmul(o, qm)
+
+    return apply_op(f, Q, other)

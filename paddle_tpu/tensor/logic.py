@@ -1,0 +1,93 @@
+"""Comparison & logical ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def equal(x, y, name=None):
+    return apply_op(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply_op(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply_op(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply_op(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return apply_op(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply_op(jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply_op(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply_op(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply_op(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply_op(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply_op(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply_op(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_op(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply_op(jnp.right_shift, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(to_array(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

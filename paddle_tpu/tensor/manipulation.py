@@ -1,0 +1,440 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def _ints(x):
+    if isinstance(x, Tensor):
+        x = x.tolist()
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    return [int(v.item()) if isinstance(v, Tensor) else int(v) for v in x]
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply_op(lambda v: jnp.reshape(v, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x.value, _ints(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(v.shape[:s]) + [-1] + list(v.shape[e + 1:])
+        return jnp.reshape(v, new_shape)
+
+    return apply_op(f, x, op_name="flatten")
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return apply_op(lambda v: jnp.transpose(v, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, axis1, axis2), x)
+
+
+def t(x, name=None):
+    return apply_op(lambda v: v.T if v.ndim >= 2 else v, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply_op(f, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    def f(v):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = v
+        for a in sorted(_ints(axes)):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply_op(f, x, op_name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=axis), *x, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), *x, op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    outs = apply_op(
+        lambda v: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis)), x)
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        secs = _ints(num_or_sections)
+        total = v.shape[axis]
+        known = [s for s in secs if s != -1]
+        secs = [s if s != -1 else total - int(np.sum(known)) for s in secs]
+        idxs = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(v, idxs, axis=axis))
+
+    return list(apply_op(f, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(v):
+        return tuple(jnp.array_split(v, num_or_indices if isinstance(num_or_indices, int)
+                                     else _ints(num_or_indices), axis=axis))
+
+    return list(apply_op(f, x))
+
+
+def slice(x, axes, starts, ends):
+    import builtins
+
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return v[tuple(idx)]
+
+    return apply_op(f, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+
+    return apply_op(f, x)
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+
+    def f(v):
+        tgt = list(shape)
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tgt)
+
+    return apply_op(f, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return apply_op(lambda v: jnp.broadcast_to(v, tgt), x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs)
+    return list(outs)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply_op(lambda v: jnp.tile(v, reps), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = to_array(repeats) if isinstance(repeats, Tensor) else repeats
+    return apply_op(lambda v: jnp.repeat(v, r, axis=axis), x)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda v: jnp.flip(v, axis=tuple(_ints(axes))), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+    return apply_op(lambda v: jnp.roll(v, sh, axis=tuple(ax) if isinstance(ax, list) else ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis_i = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis_i), x, index,
+                    op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply_op(f, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(v.ndim)])
+                for k, s in enumerate(v.shape)]
+        idx = [jnp.broadcast_to(d, i.shape) for d in dims]
+        idx[axis] = i
+        if reduce == "add":
+            return v.at[tuple(idx)].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[tuple(idx)].multiply(val)
+        return v.at[tuple(idx)].set(val)
+
+    return apply_op(f, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        base = v.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return apply_op(f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value = out.value
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _ints(shape)
+
+    def f(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        i = i.astype(jnp.int32)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(f, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_sample(x, index):
+    def f(v, i):
+        return jnp.take_along_axis(v, i.astype(jnp.int32), axis=1)
+
+    return apply_op(f, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, val):
+        i = i.astype(jnp.int32)
+        vm = jnp.moveaxis(v, axis, 0)
+        valm = jnp.moveaxis(val, axis, 0)
+        out = vm.at[i].add(valm)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(v, val, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i
+                    for i in idx)
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(val)
+
+    return apply_op(lambda v, val, *idx: f(v, val, *idx), x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (not jittable) — same restriction XLA has.
+    v = np.asarray(to_array(x))
+    m = np.asarray(to_array(mask)).astype(bool)
+    return Tensor(jnp.asarray(v[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = to_array(value) if isinstance(value, Tensor) else value
+    return apply_op(lambda v, m: jnp.where(m, jnp.asarray(val, v.dtype), v), x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    v = np.asarray(to_array(x))
+    m = np.asarray(to_array(mask)).astype(bool)
+    val = np.asarray(to_array(value)).reshape(-1)
+    out = v.copy()
+    out[m] = val[: int(m.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    v = np.asarray(to_array(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    v = np.asarray(to_array(x))
+    if axis is None:
+        v = v.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    n = v.shape[ax]
+    if n == 0:
+        outs = [Tensor(v)]
+    else:
+        first = np.ones(n, dtype=bool)
+        sl = [slice(None)] * v.ndim
+        sl_prev = list(sl)
+        sl[ax] = slice(1, None)
+        sl_prev[ax] = slice(None, -1)
+        neq = np.any(v[tuple(sl)] != v[tuple(sl_prev)],
+                     axis=tuple(i for i in range(v.ndim) if i != ax)) if v.ndim > 1 else (
+            v[1:] != v[:-1])
+        first[1:] = neq
+        idx = np.where(first)[0]
+        taken = np.take(v, idx, axis=ax)
+        outs = [Tensor(jnp.asarray(taken))]
+        if return_inverse:
+            inv = np.cumsum(first) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            counts = np.diff(np.append(idx, n))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(v):
+        n = (v.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm[idx]  # (n, size, ...)
+        out = jnp.moveaxis(out, 0, axis)
+        return jnp.moveaxis(out, axis + 1 if axis >= 0 else axis, -1)
+
+    return apply_op(f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn.functional.common import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(_ints(a)) if isinstance(a, (list, tuple, Tensor)) else a for a in ax)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else [0] * len(shape)
+
+    def f(v):
+        idx = tuple(builtins.slice(o, o + s if s != -1 else None)
+                    for o, s in zip(offsets, shape))
+        return v[idx]
+
+    return apply_op(f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+
+    return apply_op(f, input)

@@ -1,0 +1,415 @@
+"""Elementwise & reduction math ops (ref: python/paddle/tensor/math.py).
+
+Each op is a thin eager wrapper over the jnp lowering; under jit these trace
+straight into the jaxpr, and XLA fuses chains of them into single TPU loops
+(replacing the reference's hand-fused CUDA kernels in phi/kernels/fusion/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op, defop
+from ..framework.dtype import convert_dtype
+
+
+def _axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+# ---- binary elementwise ----------------------------------------------------
+
+def add(x, y, name=None):
+    return apply_op(jnp.add, x, y, op_name="add")
+
+
+def subtract(x, y, name=None):
+    return apply_op(jnp.subtract, x, y, op_name="subtract")
+
+
+def multiply(x, y, name=None):
+    return apply_op(jnp.multiply, x, y, op_name="multiply")
+
+
+def divide(x, y, name=None):
+    return apply_op(jnp.divide, x, y, op_name="divide")
+
+
+def floor_divide(x, y, name=None):
+    return apply_op(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return apply_op(jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    return apply_op(jnp.power, x, y, op_name="pow")
+
+
+def maximum(x, y, name=None):
+    return apply_op(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return apply_op(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return apply_op(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return apply_op(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return apply_op(jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return apply_op(jnp.hypot, x, y)
+
+
+def copysign(x, y, name=None):
+    return apply_op(jnp.copysign, x, y)
+
+
+def nextafter(x, y, name=None):
+    return apply_op(jnp.nextafter, x, y)
+
+
+def heaviside(x, y, name=None):
+    return apply_op(jnp.heaviside, x, y)
+
+
+def gcd(x, y, name=None):
+    return apply_op(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply_op(jnp.lcm, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return apply_op(jnp.logaddexp, x, y)
+
+
+# ---- unary elementwise -----------------------------------------------------
+
+exp = defop(jnp.exp, "exp")
+expm1 = defop(jnp.expm1, "expm1")
+log = defop(jnp.log, "log")
+log2 = defop(jnp.log2, "log2")
+log10 = defop(jnp.log10, "log10")
+log1p = defop(jnp.log1p, "log1p")
+sqrt = defop(jnp.sqrt, "sqrt")
+rsqrt = defop(jax.lax.rsqrt, "rsqrt")
+abs = defop(jnp.abs, "abs")
+ceil = defop(jnp.ceil, "ceil")
+floor = defop(jnp.floor, "floor")
+round = defop(jnp.round, "round")
+trunc = defop(jnp.trunc, "trunc")
+frac = defop(lambda x: x - jnp.trunc(x), "frac")
+sin = defop(jnp.sin, "sin")
+cos = defop(jnp.cos, "cos")
+tan = defop(jnp.tan, "tan")
+asin = defop(jnp.arcsin, "asin")
+acos = defop(jnp.arccos, "acos")
+atan = defop(jnp.arctan, "atan")
+sinh = defop(jnp.sinh, "sinh")
+cosh = defop(jnp.cosh, "cosh")
+tanh = defop(jnp.tanh, "tanh")
+asinh = defop(jnp.arcsinh, "asinh")
+acosh = defop(jnp.arccosh, "acosh")
+atanh = defop(jnp.arctanh, "atanh")
+square = defop(jnp.square, "square")
+reciprocal = defop(lambda x: 1.0 / x, "reciprocal")
+sign = defop(jnp.sign, "sign")
+neg = defop(jnp.negative, "neg")
+erf = defop(jax.scipy.special.erf, "erf")
+erfinv = defop(jax.scipy.special.erfinv, "erfinv")
+lgamma = defop(jax.scipy.special.gammaln, "lgamma")
+digamma = defop(jax.scipy.special.digamma, "digamma")
+i0 = defop(jnp.i0, "i0")
+deg2rad = defop(jnp.deg2rad, "deg2rad")
+rad2deg = defop(jnp.rad2deg, "rad2deg")
+angle = defop(jnp.angle, "angle")
+conj = defop(jnp.conj, "conj")
+real = defop(jnp.real, "real")
+imag = defop(jnp.imag, "imag")
+sigmoid = defop(jax.nn.sigmoid, "sigmoid")
+logit = defop(jax.scipy.special.logit, "logit")
+exponent = defop(lambda x: jnp.frexp(x)[1], "exponent")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = to_array(min) if isinstance(min, Tensor) else min
+    hi = to_array(max) if isinstance(max, Tensor) else max
+    return apply_op(lambda v: jnp.clip(v, lo, hi), x, op_name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = to_array(scale) if isinstance(scale, Tensor) else scale
+
+    def f(v):
+        out = v * s + bias if bias_after_scale else (v + bias) * s
+        return out
+
+    return apply_op(f, x, op_name="scale")
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(x.value + value)
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def multiplex(inputs, index, name=None):
+    arrs = [to_array(i) for i in inputs]
+
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32), axis=0
+        )[0]
+
+    return apply_op(lambda idx, *xs: f(idx, *xs), index, *inputs)
+
+
+# ---- reductions ------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.sum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x,
+                    op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x, op_name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x, op_name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.prod(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=d, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+# ---- cumulative ------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+
+    return apply_op(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.cumprod(v, axis=int(dim), dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        ax = -1 if axis is None else int(axis)
+        vals = jax.lax.associative_scan(jnp.maximum, v, axis=ax)
+        return vals
+
+    return apply_op(f, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        ax = -1 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.minimum, v, axis=ax)
+
+    return apply_op(f, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = to_array(prepend) if prepend is not None else None
+    app = to_array(append) if append is not None else None
+    return apply_op(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+# ---- matmul family ---------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(f, x, y, op_name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply_op(f, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def isfinite(x, name=None):
+    return apply_op(jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return apply_op(jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return apply_op(jnp.isnan, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_op(lambda a, b: a + weight * (b - a), x, y)
+    return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def inverse(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=dims, keepdims=True), 1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return apply_op(f, x)
+
+
+def take(x, index, mode="raise", name=None):
+    def f(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = jnp.mod(idx, n)
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, n - 1)
+        else:
+            idx = jnp.where(idx < 0, idx + n, idx)
+        return flat[idx]
+
+    return apply_op(f, x, index)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import numpy as _np
+
+    if x is not None:
+        return apply_op(lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis), y, x)
+    return apply_op(
+        lambda yy: jax.scipy.integrate.trapezoid(yy, dx=(1.0 if dx is None else dx), axis=axis), y)
+
+
+def log_normalize(x, axis=-1):
+    return apply_op(lambda v: v - jax.scipy.special.logsumexp(v, axis=axis, keepdims=True), x)

@@ -1,0 +1,140 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.random import next_key
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(next_key(), tuple(x.shape), x.dtype, minval=min, maxval=max)
+    return x
+
+
+def randn(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = to_array(mean) if isinstance(mean, Tensor) else mean
+        s = to_array(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, "shape") else (), s.shape if hasattr(s, "shape") else ())
+        return Tensor(jax.random.normal(next_key(), shp, get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), shp, get_default_dtype()) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def standard_gamma(alpha, name=None):
+    return apply_op(lambda a: jax.random.gamma(next_key(), a), alpha)
+
+
+def poisson(x, name=None):
+    return apply_op(lambda lam: jax.random.poisson(next_key(), lam).astype(lam.dtype), x)
+
+
+def bernoulli(x, name=None):
+    return apply_op(lambda p: jax.random.bernoulli(next_key(), p).astype(p.dtype), x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)).astype(x.dtype)
+    return x
+
+
+def binomial(count, prob, name=None):
+    def f(n, p):
+        return jax.random.binomial(next_key(), n.astype(jnp.float32), p).astype(jnp.int64)
+
+    return apply_op(f, count, prob)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def f(p):
+        logits = jnp.log(jnp.clip(p, 1e-30, None))
+        return jax.random.categorical(
+            next_key(), logits, axis=-1,
+            shape=(num_samples,) + p.shape[:-1]).T if p.ndim > 1 else jax.random.categorical(
+            next_key(), logits, shape=(num_samples,))
+
+    out = apply_op(lambda p: f(p).astype(jnp.int64), x)
+    return out
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype)
+    return Tensor(jax.random.randint(next_key(), _shape(shape), int(low), int(high), dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), int(low), int(high)).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    dtype = convert_dtype(dtype)
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(dtype))
+
+
+def rand_like(x, dtype=None, name=None):
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), d))
+
+
+def randn_like(x, dtype=None, name=None):
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), d))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = (jax.random.exponential(next_key(), tuple(x.shape), x.dtype) / lam)
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jnp.exp(jax.random.normal(next_key(), shp, get_default_dtype()) * std + mean))

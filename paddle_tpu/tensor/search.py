@@ -1,0 +1,154 @@
+"""Search/sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(jnp.int64)
+
+    return apply_op(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis))
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(jnp.int64)
+
+    return apply_op(f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=True)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+
+    return apply_op(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply_op(f, x, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(v):
+        ax = -1 if axis is None else int(axis)
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply_op(f, x, op_name="topk")
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(to_array(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n.astype(np.int64))) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def f(v, s):
+        out = jnp.searchsorted(s, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op(f, x, sorted_sequence)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vm = jnp.moveaxis(v, axis, -1)
+        s = jnp.sort(vm, axis=-1)
+        si = jnp.argsort(vm, axis=-1, stable=True)
+        vals = s[..., k - 1]
+        idx = si[..., k - 1].astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply_op(f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(to_array(x))
+    vm = np.moveaxis(v, axis, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts[::-1][::-1])]
+        cands = np.where(row == uniq[np.argmax(counts)])[0]
+        vals[i] = uniq[np.argmax(counts)]
+        idxs[i] = cands[-1]
+    out_shape = vm.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, i):
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[i.astype(jnp.int32)].set(value)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, x, index)

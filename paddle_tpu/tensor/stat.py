@@ -1,0 +1,103 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middles
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(v.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        s = jnp.sort(v, axis=ax)
+        idx = (v.shape[ax] - 1) // 2
+        out = jnp.take(s, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply_op(f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(lambda v: jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = to_array(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(
+        lambda v: jnp.quantile(v.astype(jnp.float32), qv, axis=_axis(axis), keepdims=keepdim,
+                               method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = to_array(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(
+        lambda v: jnp.nanquantile(v.astype(jnp.float32), qv, axis=_axis(axis), keepdims=keepdim,
+                                  method=interpolation), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    v = np.asarray(to_array(input))
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = (float(v.min()), float(v.max())) if v.size else (0.0, 1.0)
+    w = np.asarray(to_array(weight)) if weight is not None else None
+    h, _ = np.histogram(v, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(h if density or w is not None else h.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(to_array(x))
+    w = np.asarray(to_array(weights)) if weights is not None else None
+    h, edges = np.histogramdd(v, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return apply_op(lambda v: jnp.bincount(v.astype(jnp.int32), minlength=minlength,
+                                               length=None).astype(jnp.int64), x)
+    return apply_op(
+        lambda v, w: jnp.bincount(v.astype(jnp.int32), weights=w, minlength=minlength), x, weights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = np.asarray(to_array(fweights)) if fweights is not None else None
+    aw = np.asarray(to_array(aweights)) if aweights is not None else None
+    return apply_op(
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw), x)

@@ -1,0 +1,68 @@
+"""paddle.utils parity (subset)."""
+from __future__ import annotations
+
+import importlib
+import sys
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is required but not installed")
+
+
+def run_check():
+    """paddle.utils.run_check parity: verifies the TPU stack end-to-end."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+
+    x = pt.ones([2, 3])
+    y = pt.matmul(x, pt.ones([3, 4]))
+    assert y.shape == [2, 4]
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! devices={devs}")
+    return True
+
+
+def unique_name_generator(prefix="tmp"):
+    counter = {}
+
+    def gen(p=None):
+        p = p or prefix
+        counter[p] = counter.get(p, 0) + 1
+        return f"{p}_{counter[p]}"
+
+    return gen
+
+
+class unique_name:
+    _counter = {}
+
+    @classmethod
+    def generate(cls, prefix="tmp"):
+        cls._counter[prefix] = cls._counter.get(prefix, 0) + 1
+        return f"{prefix}_{cls._counter[prefix]}"
+
+
+def flatten(nest):
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten(nest)
+    return leaves
+
+
+def pack_sequence_as(structure, flat):
+    import jax
+
+    _, treedef = jax.tree_util.tree_flatten(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def deprecated(update_to="", since="", reason=""):
+    def decorator(fn):
+        return fn
+
+    return decorator

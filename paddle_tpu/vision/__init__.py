@@ -1,0 +1,7 @@
+"""paddle.vision parity (ref: python/paddle/vision/)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+
+__all__ = ["datasets", "models", "ops", "transforms"]

@@ -1,0 +1,94 @@
+"""Vision ops (ref: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv2d, box utilities)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Host-side NMS (dynamic output — eager only, like the reference op)."""
+    b = np.asarray(to_array(boxes))
+    s = np.asarray(to_array(scores)) if scores is not None else np.arange(
+        len(b), 0, -1, dtype=np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i_ in order:
+        if suppressed[i_]:
+            continue
+        keep.append(i_)
+        xx1 = np.maximum(b[i_, 0], b[:, 0])
+        yy1 = np.maximum(b[i_, 1], b[:, 1])
+        xx2 = np.minimum(b[i_, 2], b[:, 2])
+        yy2 = np.minimum(b[i_, 3], b[:, 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / (areas[i_] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i_] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder: planned")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, name=None):
+    """RoIAlign via bilinear gather (XLA-friendly dense gather)."""
+    os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,
+                                                                      output_size)
+
+    def f(feat, rois):
+        n_rois = rois.shape[0]
+        oh, ow = os_
+        offset = 0.5 if aligned else 0.0
+
+        def one_roi(roi, batch_idx):
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            x1, y1 = x1 * spatial_scale - offset, y1 * spatial_scale - offset
+            x2, y2 = x2 * spatial_scale - offset, y2 * spatial_scale - offset
+            rh = jnp.maximum(y2 - y1, 1e-6) / oh
+            rw = jnp.maximum(x2 - x1, 1e-6) / ow
+            ys = y1 + (jnp.arange(oh) + 0.5) * rh
+            xs = x1 + (jnp.arange(ow) + 0.5) * rw
+            fm = feat[batch_idx]  # C,H,W
+            C, H, W = fm.shape
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(ys - y0, 0, 1)
+            wx = jnp.clip(xs - x0, 0, 1)
+            v00 = fm[:, y0][:, :, x0]
+            v01 = fm[:, y0][:, :, x1i]
+            v10 = fm[:, y1i][:, :, x0]
+            v11 = fm[:, y1i][:, :, x1i]
+            top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+            bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+            return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+        batch_ids = jnp.zeros((n_rois,), jnp.int32)
+        return jax.vmap(one_roi)(rois, batch_ids)
+
+    return apply_op(f, x, boxes)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    raise NotImplementedError(
+        "deform_conv2d: planned as a Pallas gather kernel (ref deformable_conv_op.cu)")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError("generate_proposals: detection pipeline op, planned")

@@ -1,0 +1,187 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/) — numpy-based
+host-side preprocessing (HWC uint8 in, CHW float out by ToTensor)."""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _to_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img.value)
+    return np.asarray(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        if self.data_format == "CHW":
+            mean = self.mean.reshape(-1, 1, 1)
+            std = self.std.reshape(-1, 1, 1)
+        else:
+            mean = self.mean
+            std = self.std
+        out = (arr - mean) / std
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        import jax
+        import jax.numpy as jnp
+
+        h, w = self.size
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+        if chw:
+            out_shape = (arr.shape[0], h, w)
+        elif arr.ndim == 3:
+            out_shape = (h, w, arr.shape[2])
+        else:
+            out_shape = (h, w)
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape, "linear")
+        return np.asarray(out).astype(arr.dtype)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            arr = _to_np(img)
+            return np.ascontiguousarray(arr[..., ::-1]) if arr.ndim == 3 and \
+                arr.shape[0] in (1, 3) else np.ascontiguousarray(np.fliplr(arr))
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.ascontiguousarray(np.flipud(_to_np(img)))
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else \
+                [self.padding] * 4
+            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2))
+        h, w = arr.shape[0], arr.shape[1]
+        th, tw = self.size
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        h, w = arr.shape[0], arr.shape[1]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return np.ascontiguousarray(np.fliplr(_to_np(img)))
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.flipud(_to_np(img)))

@@ -1,0 +1,25 @@
+"""Test config: virtual 8-device CPU mesh (SURVEY §4 test plan — the analogue
+of the reference's multi-process subprocess trick, cheaper + deterministic)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# the axon TPU plugin overrides JAX_PLATFORMS env; force the config knob too
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

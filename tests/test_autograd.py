@@ -1,0 +1,155 @@
+"""Autograd engine tests (ref: eager backward semantics, numeric grad checks
+à la op_test.py check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0], rtol=1e-5)
+
+    def test_numeric_grad_check(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+
+        def f(x):
+            return float(np.sum(np.tanh(x @ x.T)))
+
+        t = paddle.to_tensor(a, stop_gradient=False)
+        out = paddle.tanh(paddle.matmul(t, t, transpose_y=True)).sum()
+        out.backward()
+        ref = numeric_grad(f, a.astype(np.float64))
+        np.testing.assert_allclose(t.grad.numpy(), ref, rtol=1e-2, atol=1e-3)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                             stop_gradient=False)
+        parts = paddle.split(x, 3, axis=1)
+        loss = parts[0].sum() + 2 * parts[2].sum()
+        loss.backward()
+        ref = np.array([[1, 0, 2], [1, 0, 2]], np.float32)
+        np.testing.assert_allclose(x.grad.numpy(), ref)
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_hooks(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)
+        assert x.grad is None  # paddle.grad must not pollute .grad slots
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_backward_nonscalar_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor
+                return grad * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-5)
+
+    def test_recompute(self):
+        from paddle_tpu.distributed.fleet import recompute
+
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32),
+                             stop_gradient=False)
+
+        def block(v):
+            return paddle.tanh(paddle.matmul(v, v)).sum()
+
+        y = recompute(block, x)
+        y.backward()
+        g1 = x.grad.numpy().copy()
+
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        block(x2).backward()
+        np.testing.assert_allclose(g1, x2.grad.numpy(), rtol=1e-4, atol=1e-5)

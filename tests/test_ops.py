@@ -1,0 +1,202 @@
+"""Op tests vs numpy references (ref test pattern: unittests/op_test.py:327 —
+numpy forward reference + numeric grad checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def allclose(t, ref, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(t.numpy(), np.float64), ref, rtol=rtol, atol=atol)
+
+
+class TestElementwise:
+    def test_add_broadcast(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        allclose(paddle.add(paddle.to_tensor(a), paddle.to_tensor(b)), a + b)
+
+    def test_arith_ops(self):
+        a = np.random.rand(5, 3).astype(np.float32) + 0.5
+        b = np.random.rand(5, 3).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        allclose(paddle.subtract(ta, tb), a - b)
+        allclose(paddle.multiply(ta, tb), a * b)
+        allclose(paddle.divide(ta, tb), a / b, rtol=1e-4)
+        allclose(paddle.maximum(ta, tb), np.maximum(a, b))
+        allclose(paddle.pow(ta, 2.0), a ** 2, rtol=1e-4)
+
+    def test_unary(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 0.1
+        t = paddle.to_tensor(a)
+        allclose(paddle.exp(t), np.exp(a), rtol=1e-3, atol=1e-5)
+        allclose(paddle.log(t), np.log(a), rtol=1e-3, atol=1e-4)
+        allclose(paddle.sqrt(t), np.sqrt(a), rtol=1e-3, atol=1e-5)
+        allclose(paddle.tanh(t), np.tanh(a), rtol=1e-3, atol=1e-5)
+        allclose(paddle.abs(-t), a, rtol=1e-5)
+
+    def test_operator_overloads(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        allclose(t + 1.0, a + 1.0)
+        allclose(1.0 - t, 1.0 - a)
+        allclose(t * t, a * a)
+        allclose(t @ t, a @ a, rtol=1e-4)
+        assert bool((t == t).all())
+
+
+class TestReduce:
+    def test_sum_mean(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        allclose(paddle.sum(t), a.sum(), rtol=1e-4)
+        allclose(paddle.sum(t, axis=1), a.sum(1), rtol=1e-4)
+        allclose(paddle.mean(t, axis=[0, 2], keepdim=True), a.mean((0, 2), keepdims=True),
+                 rtol=1e-4)
+        allclose(paddle.max(t, axis=-1), a.max(-1))
+        allclose(paddle.prod(t, axis=0), np.prod(a, 0), rtol=1e-4)
+
+    def test_cumsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        allclose(paddle.cumsum(paddle.to_tensor(a), axis=1), np.cumsum(a, 1), rtol=1e-4)
+
+    def test_logsumexp(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as ref
+
+        allclose(paddle.logsumexp(paddle.to_tensor(a), axis=1), ref(a, axis=1), rtol=1e-4)
+
+
+class TestMatmul:
+    def test_matmul_transpose(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(5, 4).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b), transpose_y=True)
+        allclose(out, a @ b.T, rtol=1e-4)
+
+    def test_bmm(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        allclose(paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b)), a @ b, rtol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        allclose(paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)),
+                 a @ b, rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(a)
+        allclose(paddle.reshape(t, [4, 6]), a.reshape(4, 6))
+        allclose(paddle.transpose(t, [2, 0, 1]), a.transpose(2, 0, 1))
+        allclose(paddle.flatten(t, 1), a.reshape(2, 12))
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        allclose(paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0),
+                 np.concatenate([a, b], 0))
+        allclose(paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1),
+                 np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+        allclose(parts[0], a[:, :1])
+        allclose(parts[1], a[:, 1:])
+
+    def test_squeeze_unsqueeze_expand(self):
+        a = np.random.randn(1, 3, 1).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.unsqueeze(t, [0]).shape == [1, 1, 3, 1]
+        assert paddle.expand(paddle.to_tensor(np.zeros((1, 3), np.float32)),
+                             [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        allclose(paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx)), a[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(paddle.to_tensor(a), paddle.to_tensor(np.array([1, 3])),
+                             paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[[1, 3]] = 1.0
+        allclose(out, ref)
+
+    def test_indexing(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        allclose(t[1:3, ::2], a[1:3, ::2])
+        t[0, 0] = 42.0
+        assert t.numpy()[0, 0] == 42.0
+
+
+class TestSearchSort:
+    def test_argmax_topk(self):
+        a = np.random.randn(3, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(), a.argmax(1))
+        vals, idx = paddle.topk(t, 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        allclose(vals, ref, rtol=1e-5)
+
+    def test_sort_where(self):
+        a = np.random.randn(10).astype(np.float32)
+        t = paddle.to_tensor(a)
+        allclose(paddle.sort(t), np.sort(a))
+        c = a > 0
+        allclose(paddle.where(paddle.to_tensor(c), t, -t), np.where(c, a, -a))
+
+
+class TestLinalg:
+    def test_inv_det_solve(self):
+        a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        t = paddle.to_tensor(a)
+        allclose(paddle.linalg.inv(t), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        allclose(paddle.linalg.det(t), np.linalg.det(a), rtol=1e-3)
+        b = np.random.randn(3, 2).astype(np.float32)
+        allclose(paddle.linalg.solve(t, paddle.to_tensor(b)), np.linalg.solve(a, b),
+                 rtol=1e-3, atol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
+        allclose(paddle.to_tensor(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T), a, rtol=1e-3, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        allclose(paddle.to_tensor(L.numpy() @ L.numpy().T), spd, rtol=1e-3, atol=1e-4)
+
+    def test_norm(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        allclose(paddle.linalg.norm(paddle.to_tensor(a)), np.linalg.norm(a), rtol=1e-4)
+        allclose(paddle.linalg.norm(paddle.to_tensor(a), p=1, axis=1),
+                 np.abs(a).sum(1), rtol=1e-4)
+
+
+class TestCreation:
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int64").dtype == np.int64
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        allclose(paddle.linspace(0, 1, 5), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        allclose(paddle.full([2, 2], 3.5), np.full((2, 2), 3.5, np.float32))
+        t = paddle.to_tensor([1, 2, 3])
+        np.testing.assert_array_equal(paddle.tril(paddle.ones([3, 3])).numpy(),
+                                      np.tril(np.ones((3, 3), np.float32)))
+
+    def test_random_shapes(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        assert paddle.randn([2]).shape == [2]
+        assert paddle.randint(0, 10, [5]).dtype == np.int64
+        r = paddle.randperm(10).numpy()
+        assert sorted(r.tolist()) == list(range(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(42)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
