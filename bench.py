@@ -1,0 +1,80 @@
+"""Benchmark: Llama pretraining step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric = MFU of a bf16 Llama train step (fwd+bwd+AdamW) — comparable against
+the north-star target of 40% MFU (BASELINE.md); vs_baseline = MFU / 0.40.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}.get(gen, 197e12)
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                          num_hidden_layers=8, num_attention_heads=16,
+                          num_key_value_heads=8, max_position_embeddings=2048,
+                          dtype="bfloat16", use_flash_attention=True)
+        B, S, steps, warmup = 4, 2048, 10, 3
+    else:  # CPU smoke path for local runs
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=256,
+                          dtype="float32", use_flash_attention=False)
+        B, S, steps, warmup = 2, 128, 3, 1
+
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    engine = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                            remat=on_tpu)
+    engine.build_train_step()
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
+
+    for _ in range(warmup):
+        loss = engine.train_batch(ids, labels)
+    jax.block_until_ready(loss.value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(ids, labels)
+    jax.block_until_ready(loss.value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * steps / dt
+    flops_per_token = 6.0 * n_params  # fwd+bwd matmul FLOPs approximation
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "llama_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": f"MFU (tokens/s={tokens_per_sec:.0f}, params={n_params/1e6:.0f}M, "
+                f"B={B}, S={S}, loss={float(np.asarray(loss.value)):.3f})",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

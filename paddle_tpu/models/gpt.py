@@ -1,0 +1,111 @@
+"""GPT-2-style decoder (the auto_parallel test fixture family —
+ref python/paddle/fluid/tests/unittests/auto_parallel_gpt_model.py)."""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.dispatch import apply_op
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.manipulation import reshape
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+
+def gpt2_small_config(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt_tiny_config(**kw):
+    return GPTConfig(**{**dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                               num_attention_heads=4, intermediate_size=512,
+                               max_position_embeddings=256,
+                               hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0), **kw})
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = Normal(0.0, 0.02)
+        self.ln_1 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.c_attn = Linear(h, 3 * h, weight_attr=init)
+        self.c_proj = Linear(h, h, weight_attr=init)
+        self.ln_2 = LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.c_fc = Linear(h, cfg.intermediate_size, weight_attr=init)
+        self.c_out = Linear(cfg.intermediate_size, h, weight_attr=init)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+        self.n_head = cfg.num_attention_heads
+        self.c_attn.weight.pspec = P(None, "tensor")
+        self.c_proj.weight.pspec = P("tensor", None)
+        self.c_fc.weight.pspec = P(None, "tensor")
+        self.c_out.weight.pspec = P("tensor", None)
+
+    def forward(self, x):
+        B, S, H = x.shape[0], x.shape[1], x.shape[2]
+        qkv = self.c_attn(self.ln_1(x))
+        qkv = reshape(qkv, [B, S, 3, self.n_head, H // self.n_head])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=self.training)
+        attn = reshape(attn, [B, S, H])
+        x = x + self.drop(self.c_proj(attn))
+        x = x + self.drop(self.c_out(F.gelu(self.c_fc(self.ln_2(x)), approximate=True)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = Dropout(cfg.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        import paddle_tpu as paddle
+
+        pos = paddle.arange(S, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.transformer = GPTModel(cfg)
+
+    def forward(self, input_ids):
+        h = self.transformer(input_ids)
+        return apply_op(lambda v, w: jnp.matmul(v, w.T), h, self.transformer.wte.weight)
+
+    def loss_fn(self, logits, labels):
+        return F.cross_entropy(logits, labels, reduction="mean")

@@ -1,0 +1,309 @@
+"""Llama model family — the flagship for the BASELINE.json pretraining
+configs (Llama-3 8B DP-only; 8B full recipe ≥40% MFU; 70B 4D hybrid).
+
+TPU-first design decisions:
+- bf16 parameters/activations, fp32 softmax + norms (master weights live in
+  the optimizer, ref AdamW multi_precision).
+- GQA attention through the Pallas flash kernel (ops/flash_attention.py);
+  ring attention over the 'context' mesh axis for long sequences
+  (parallel/ring_attention.py) when config.context_parallel.
+- TP via GSPMD PartitionSpecs on weights (mp_layers pattern): qkv/gate/up
+  column-sharded, o/down row-sharded over 'tensor'; embeddings vocab-sharded.
+- Sequence-parallel residual stream: activations carry P('data', 'sep')
+  constraints between blocks when the mesh has a 'sep' axis (ref absent —
+  SURVEY §5.7 new design).
+
+The reference has no Llama in-tree (it lives in PaddleNLP, which builds on
+the surveyed primitives: fleet mp_layers + fused_multi_transformer); this
+implementation targets the same recipe surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor
+from ..framework.dispatch import apply_op
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..parallel.api import shard_constraint
+from ..tensor.manipulation import concat, reshape
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # parallelism knobs
+    context_parallel: bool = False  # ring attention over 'context' axis
+    sequence_parallel: bool = False  # shard activations over 'sep'
+    use_flash_attention: bool = True
+    recompute: bool = False
+
+
+def llama3_8b_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8), **kw})
+
+
+def llama3_70b_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8), **kw})
+
+
+def llama_tiny_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=1024, hidden_size=256, intermediate_size=704,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=512, dtype="float32"), **kw})
+
+
+# --------------------------------------------------------------------------- #
+# RoPE
+# --------------------------------------------------------------------------- #
+
+
+def _rope_tables(head_dim: int, max_pos: int, theta: float):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_pos, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope(x, cos, sin, pos_offset=0):
+    """x: (B, S, H, D); rotate pairs (x[..., :D/2], x[..., D/2:])."""
+    S = x.shape[1]
+    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, S, 0)[None, :, None, :]
+    s = jax.lax.dynamic_slice_in_dim(sin, pos_offset, S, 0)[None, :, None, :]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    xf1 = x1.astype(jnp.float32)
+    xf2 = x2.astype(jnp.float32)
+    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Modules
+# --------------------------------------------------------------------------- #
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, hidden_size, eps):
+        super().__init__()
+        from ..nn.initializer import Constant
+
+        self.weight = self.create_parameter([hidden_size],
+                                            default_initializer=Constant(1.0))
+        self.weight.pspec = P()
+        self._eps = eps
+
+    def forward(self, x):
+        from ..ops.fused_norm import fused_rms_norm
+
+        return apply_op(lambda v, w: fused_rms_norm(v, w, self._eps), x, self.weight,
+                        op_name="rms_norm")
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        h = cfg.hidden_size
+        init = Normal(0.0, 0.02)
+        self.q_proj = Linear(h, self.num_heads * self.head_dim, bias_attr=False,
+                             weight_attr=init)
+        self.k_proj = Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False,
+                             weight_attr=init)
+        self.v_proj = Linear(h, self.num_kv_heads * self.head_dim, bias_attr=False,
+                             weight_attr=init)
+        self.o_proj = Linear(self.num_heads * self.head_dim, h, bias_attr=False,
+                             weight_attr=init)
+        # TP shardings (mp_layers pattern: column for qkv, row for o)
+        self.q_proj.weight.pspec = P(None, "tensor")
+        self.k_proj.weight.pspec = P(None, "tensor")
+        self.v_proj.weight.pspec = P(None, "tensor")
+        self.o_proj.weight.pspec = P("tensor", None)
+
+    def forward(self, x, cos, sin, cache=None, pos_offset=0):
+        B, S = x.shape[0], x.shape[1]
+        q = reshape(self.q_proj(x), [B, S, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(x), [B, S, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(x), [B, S, self.num_kv_heads, self.head_dim])
+
+        def attn(qv, kv, vv, cv, sv, *cache_vals):
+            qr = _apply_rope(qv, cv, sv, pos_offset)
+            kr = _apply_rope(kv, cv, sv, pos_offset)
+            if cache_vals:
+                ck, cvv = cache_vals
+                kr = jnp.concatenate([ck, kr], axis=1)
+                vv = jnp.concatenate([cvv, vv], axis=1)
+            # GQA: expand kv heads to q heads
+            rep = self.num_heads // self.num_kv_heads
+            if rep > 1:
+                kr = jnp.repeat(kr, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            causal = cache_vals == ()
+            if self.cfg.context_parallel:
+                from ..parallel.ring_attention import ring_attention_bshd
+
+                try:
+                    return ring_attention_bshd(qr, kr, vv, "context", causal=causal)
+                except NameError:
+                    pass
+            from ..ops.flash_attention import flash_attention_bshd
+
+            if self.cfg.use_flash_attention:
+                return flash_attention_bshd(qr, kr, vv, causal=causal)
+            d = qr.shape[-1]
+            logits = jnp.einsum("bshd,bthd->bhst", qr, kr).astype(jnp.float32) \
+                / math.sqrt(d)
+            if causal:
+                mask = jnp.tril(jnp.ones((S, kr.shape[1]), bool), k=kr.shape[1] - S)
+                logits = jnp.where(mask, logits, -1e30)
+            p = jax.nn.softmax(logits, -1).astype(qr.dtype)
+            return jnp.einsum("bhst,bthd->bshd", p, vv)
+
+        args = [q, k, v, Tensor(cos), Tensor(sin)]
+        if cache is not None:
+            args += [cache[0], cache[1]]
+        out = apply_op(attn, *args, op_name="flash_attention")
+        out = reshape(out, [B, S, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if self.cfg.sequence_parallel:
+            out = shard_constraint(out, P("data", "sep", None))
+        return out
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        init = Normal(0.0, 0.02)
+        self.gate_proj = Linear(cfg.hidden_size, cfg.intermediate_size, bias_attr=False,
+                                weight_attr=init)
+        self.up_proj = Linear(cfg.hidden_size, cfg.intermediate_size, bias_attr=False,
+                              weight_attr=init)
+        self.down_proj = Linear(cfg.intermediate_size, cfg.hidden_size, bias_attr=False,
+                                weight_attr=init)
+        self.gate_proj.weight.pspec = P(None, "tensor")
+        self.up_proj.weight.pspec = P(None, "tensor")
+        self.down_proj.weight.pspec = P("tensor", None)
+        self._sp = cfg.sequence_parallel
+
+    def forward(self, x):
+        def mlp(v, wg, wu, wd):
+            return jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
+
+        out = apply_op(mlp, x, self.gate_proj.weight, self.up_proj.weight,
+                       self.down_proj.weight, op_name="linear")
+        if self._sp:
+            out = shard_constraint(out, P("data", "sep", None))
+        return out
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+        self._recompute = cfg.recompute
+
+    def forward(self, x, cos, sin, cache=None, pos_offset=0):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, cache, pos_offset)
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        from ..framework.dtype import convert_dtype
+
+        self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.embed_tokens.weight.pspec = P("tensor", None)
+        self.layers = LayerList([LlamaDecoderLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = _rope_tables(head_dim, cfg.max_position_embeddings, cfg.rope_theta)
+        self._cos = cos
+        self._sin = sin
+        if cfg.dtype != "float32":
+            self._convert_dtype(convert_dtype(cfg.dtype))
+
+    def forward(self, input_ids, caches=None, pos_offset=0):
+        x = self.embed_tokens(input_ids)
+        if self.cfg.sequence_parallel:
+            x = shard_constraint(x, P("data", "sep", None))
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            if self._should_recompute():
+                from ..distributed.fleet.recompute import recompute
+
+                x = recompute(lambda v, l=layer: l(v, self._cos, self._sin, cache,
+                                                   pos_offset), x)
+            else:
+                x = layer(x, self._cos, self._sin, cache, pos_offset)
+        return self.norm(x)
+
+    def _should_recompute(self):
+        from ..framework.core import is_grad_enabled
+
+        return self.cfg.recompute and self.training and is_grad_enabled()
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            init = Normal(0.0, 0.02)
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False,
+                                  weight_attr=init)
+            self.lm_head.weight.pspec = P(None, "tensor")
+            if cfg.dtype != "float32":
+                from ..framework.dtype import convert_dtype
+
+                self.lm_head._convert_dtype(convert_dtype(cfg.dtype))
+
+    def forward(self, input_ids):
+        h = self.model(input_ids)
+        if self.cfg.tie_word_embeddings:
+            return apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                            self.model.embed_tokens.weight)
+        return self.lm_head(h)
+
+    def loss_fn(self, logits, labels):
+        """Next-token CE with fp32 softmax (ParallelCrossEntropy math)."""
+        return F.cross_entropy(logits, labels, reduction="mean")
+
+
+def llama_pretrain_loss(model: LlamaForCausalLM, input_ids, labels):
+    logits = model(input_ids)
+    return model.loss_fn(logits, labels)

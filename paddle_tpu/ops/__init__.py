@@ -1,0 +1,9 @@
+"""Pallas TPU kernel library — the replacement for the reference's fused CUDA
+ops (ref paddle/fluid/operators/fused/: fused_attention_op.cu,
+fused_multi_transformer_op.cu, fmha_ref.h) and hand-written PHI GPU kernels.
+"""
+from .flash_attention import flash_attention, flash_attention_bshd
+from .fused_norm import fused_rms_norm, fused_layer_norm
+
+__all__ = ["flash_attention", "flash_attention_bshd", "fused_rms_norm",
+           "fused_layer_norm"]

@@ -1,0 +1,124 @@
+"""Fused RMS/Layer norm Pallas kernels (ref: the reference fuses norms into
+decoder layers inside fused_multi_transformer_op.cu; standalone layer_norm is
+phi/kernels/gpu/layer_norm_kernel.cu).
+
+Single-pass row kernels: mean/var computed in VMEM, scaled output written
+once. Fall back to jnp on non-TPU. Backward via recompute (jnp composition),
+same policy as flash_attention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _rms_ref(x, w, eps):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _ln_ref(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(ms + eps) * w_ref[:].astype(jnp.float32)
+                ).astype(o_ref.dtype)
+
+
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    o_ref[:] = ((x - mu) * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _on_tpu(x):
+    return jax.default_backend() in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_rms_norm(x, weight, eps=1e-6):
+    if _on_tpu(x):
+        from jax.experimental import pallas as pl
+
+        try:
+            D = x.shape[-1]
+            flat = x.reshape(-1, D)
+            rows = flat.shape[0]
+            block_rows = max(min(512, rows), 8)
+            if rows % block_rows == 0:
+                out = pl.pallas_call(
+                    functools.partial(_rms_kernel, eps=eps),
+                    grid=(rows // block_rows,),
+                    in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                              pl.BlockSpec((D,), lambda i: (0,))],
+                    out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
+                )(flat, weight)
+                return out.reshape(x.shape)
+        except Exception:
+            pass
+    return _rms_ref(x, weight, eps)
+
+
+def _rms_fwd(x, w, eps):
+    return fused_rms_norm(x, w, eps), (x, w)
+
+
+def _rms_bwd(eps, res, g):
+    x, w = res
+    _, vjp_fn = jax.vjp(lambda x_, w_: _rms_ref(x_, w_, eps), x, w)
+    return vjp_fn(g)
+
+
+fused_rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, weight, bias, eps=1e-5):
+    if _on_tpu(x):
+        from jax.experimental import pallas as pl
+
+        try:
+            D = x.shape[-1]
+            flat = x.reshape(-1, D)
+            rows = flat.shape[0]
+            block_rows = max(min(512, rows), 8)
+            if rows % block_rows == 0:
+                out = pl.pallas_call(
+                    functools.partial(_ln_kernel, eps=eps),
+                    grid=(rows // block_rows,),
+                    in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                              pl.BlockSpec((D,), lambda i: (0,)),
+                              pl.BlockSpec((D,), lambda i: (0,))],
+                    out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
+                )(flat, weight, bias)
+                return out.reshape(x.shape)
+        except Exception:
+            pass
+    return _ln_ref(x, weight, bias, eps)
+
+
+def _ln_fwd(x, w, b, eps):
+    return fused_layer_norm(x, w, b, eps), (x, w, b)
+
+
+def _ln_bwd(eps, res, g):
+    x, w, b = res
+    _, vjp_fn = jax.vjp(lambda x_, w_, b_: _ln_ref(x_, w_, b_, eps), x, w, b)
+    return vjp_fn(g)
+
+
+fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)

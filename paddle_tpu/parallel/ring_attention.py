@@ -1,0 +1,116 @@
+"""Ring attention — context parallelism for long sequences.
+
+NEW DESIGN: the reference has no sequence/context parallelism at all
+(SURVEY §5.7 — grep-verified absent); its TP all-gathers full activations so
+sequence length is bounded by one chip's HBM. Here the sequence axis is
+sharded over the mesh's 'context' axis and K/V blocks rotate around the ring
+via lax.ppermute, overlapping each hop with the blockwise-softmax compute of
+the resident block (the standard ring-attention recipe on ICI).
+
+Used inside shard_map (the explicit-collectives region); composes with the
+Pallas flash kernel for the per-block compute.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One KV block's contribution: returns (m, l, acc) pieces.
+
+    q: (B,H,Sq,D) k/v: (B,H,Sk,D) mask: (Sq,Sk) bool or None.
+    """
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # (B,H,Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhst,bhtd->bhsd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(q, k, v, axis_name: str = "context", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Per-shard ring attention body (call inside shard_map).
+
+    q, k, v: local shards (B, H, S_local, D); sequence dim sharded over
+    `axis_name`. Returns local output shard (B, H, S_local, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    q_pos = my_idx * S + jnp.arange(S)  # global positions of local queries
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kb, vb = carry
+        src = (my_idx - t) % n  # which shard's block we currently hold
+        k_pos = src * S + jnp.arange(S)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        bm, bl, bacc = _block_attn(q, kb, vb, sc, mask)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = l * alpha + bl * beta
+        acc_new = acc * alpha[..., None] + bacc * beta[..., None]
+        # rotate K/V to the next shard (overlapped with compute by XLA since
+        # the ppermute has no data dependence on this step's attention)
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        return (m_new, l_new, acc_new, kb_next, vb_next), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_bshd(q, k, v, axis_name: str = "context", causal: bool = True,
+                        scale: Optional[float] = None):
+    """(B, S, H, D) layout wrapper."""
+    out = ring_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                         jnp.swapaxes(v, 1, 2), axis_name, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def ulysses_attention_bshd(q, k, v, axis_name: str = "sep", causal: bool = True,
+                           scale: Optional[float] = None, attn_fn=None):
+    """Ulysses/DeepSpeed-style sequence parallelism: all_to_all swaps the
+    sharded dim from sequence→heads, runs full-sequence attention locally on
+    H/n heads, then swaps back (NEW design; absent in reference, SURVEY §2.3).
+
+    q,k,v local: (B, S/n, H, D) → output (B, S/n, H, D).
+    """
+    def a2a_seq_to_heads(x):
+        # (B, S/n, H, D) -> (B, S, H/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def a2a_heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg = a2a_seq_to_heads(q)
+    kg = a2a_seq_to_heads(k)
+    vg = a2a_seq_to_heads(v)
+    if attn_fn is None:
+        from ..ops.flash_attention import flash_attention_bshd
+
+        out = flash_attention_bshd(qg, kg, vg, causal=causal, scale=scale)
+    else:
+        out = attn_fn(qg, kg, vg)
+    return a2a_heads_to_seq(out)
