@@ -60,10 +60,12 @@ class AutoCheckpoint:
     """Periodic train-loop snapshots with exactly-once epoch bookkeeping
     (ref fluid/incubate/checkpoint/auto_checkpoint.py)."""
 
-    def __init__(self, save_dir: str, every_n_steps: int = 1000, keep_last: int = 3):
+    def __init__(self, save_dir: str, every_n_steps: int = 1000, keep_last: int = 3,
+                 async_save: bool = False):
         self.save_dir = save_dir
         self.every_n_steps = every_n_steps
         self.keep_last = keep_last
+        self.async_save = async_save
         self._step = 0
         self._saved = []
 
@@ -78,7 +80,7 @@ class AutoCheckpoint:
         if optimizer is not None:
             state["optimizer"] = optimizer.state_dict()
         state["meta"] = {"step": np.asarray(self._step), **(extra or {})}
-        save_state_dict(state, tag, async_save=True)
+        save_state_dict(state, tag, async_save=self.async_save)
         self._saved.append(tag)
         while len(self._saved) > self.keep_last:
             old = self._saved.pop(0)

@@ -173,8 +173,9 @@ class Model:
         loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
             test_data, batch_size=batch_size, num_workers=num_workers)
         outputs = []
+        has_label = self._loss is not None
         for batch in loader:
-            ins, _ = self._split_batch(batch, has_label=False)
+            ins, _ = self._split_batch(batch, has_label=has_label)
             outputs.append(self.predict_batch(ins))
         if stack_outputs:
             n_out = len(outputs[0])

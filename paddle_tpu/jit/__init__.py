@@ -73,12 +73,13 @@ def param_values(layer) -> Dict[str, jax.Array]:
     return {name: p.value for name, p in layer.named_parameters() if p.trainable}
 
 
-def functional_call(layer, named_values: Dict[str, Any], *args, **kwargs):
+def functional_call(layer, named_values: Dict[str, Any], *args, call_fn=None, **kwargs):
     """Run ``layer(*args)`` with parameters/buffers temporarily replaced by
     ``named_values`` (possibly tracers). The tape is disabled: gradients on
-    this path come from jax.grad over this function."""
+    this path come from jax.grad over this function. ``call_fn`` overrides the
+    callable (used by to_static to avoid re-entering a patched forward)."""
     with _swapped_params(layer, named_values), no_grad_ctx():
-        out = layer(*args, **kwargs)
+        out = (call_fn or layer)(*args, **kwargs)
     return out
 
 
@@ -114,8 +115,11 @@ class StaticFunction:
         layer = self._layer
 
         if layer is not None:
+            orig_forward = self._fn
+
             def pure(params, arg_vals, kw_vals):
-                out = functional_call(layer, params, *_wrap(arg_vals), **_wrap(kw_vals))
+                out = functional_call(layer, params, *_wrap(arg_vals),
+                                      call_fn=orig_forward, **_wrap(kw_vals))
                 return _unwrap(out)
         else:
             fn = self._fn

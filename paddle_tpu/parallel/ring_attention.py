@@ -51,9 +51,12 @@ def ring_attention(q, k, v, axis_name: str = "context", causal: bool = True,
 
     q_pos = my_idx * S + jnp.arange(S)  # global positions of local queries
 
-    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, S), jnp.float32)
-    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    # derive the carries from q so they inherit the 'varying over axis_name'
+    # type shard_map's scan check requires
+    zero = (q[..., 0] * 0.0).astype(jnp.float32)  # (B,H,S)
+    m0 = zero + NEG_INF
+    l0 = zero
+    acc0 = (q * 0.0).astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, t):

@@ -1,0 +1,268 @@
+"""End-to-end model tests (ref: book/ end-to-end small models + hapi tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def npt(x):
+    return np.asarray(x.numpy(), np.float64)
+
+
+class TestLlama:
+    def test_forward_shapes(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        cfg = llama_tiny_config()
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 16)))
+        logits = model(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+
+    def test_train_step_reduces_loss(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        paddle.seed(0)
+        cfg = llama_tiny_config()
+        model = LlamaForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 32)))
+        labels = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 32)))
+        losses = []
+        for _ in range(5):
+            loss = model.loss_fn(model(ids), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+    def test_recompute_same_grads(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        ids = paddle.to_tensor(np.random.randint(0, 1024, (1, 16)))
+        labels = paddle.to_tensor(np.random.randint(0, 1024, (1, 16)))
+
+        paddle.seed(11)
+        m1 = LlamaForCausalLM(llama_tiny_config(recompute=False))
+        m1.loss_fn(m1(ids), labels).backward()
+        paddle.seed(11)
+        m2 = LlamaForCausalLM(llama_tiny_config(recompute=True))
+        m2.loss_fn(m2(ids), labels).backward()
+        g1 = npt(m1.model.layers[0].self_attn.q_proj.weight.grad)
+        g2 = npt(m2.model.layers[0].self_attn.q_proj.weight.grad)
+        np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-6)
+
+
+class TestGPTErnie:
+    def test_gpt_forward_backward(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+
+        cfg = gpt_tiny_config()
+        m = GPTForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (2, 12)))
+        logits = m(ids)
+        assert logits.shape == [2, 12, cfg.vocab_size]
+        m.loss_fn(logits, ids).backward()
+        assert m.transformer.wte.weight.grad is not None
+
+    def test_ernie_classification(self):
+        from paddle_tpu.models import ErnieForSequenceClassification, ernie_tiny_config
+
+        cfg = ernie_tiny_config()
+        m = ErnieForSequenceClassification(cfg, num_classes=3)
+        ids = paddle.to_tensor(np.random.randint(0, cfg.vocab_size, (4, 10)))
+        logits = m(ids)
+        assert logits.shape == [4, 3]
+
+
+class TestVisionModels:
+    def test_resnet18_forward(self):
+        from paddle_tpu.vision.models import resnet18
+
+        m = resnet18(num_classes=10)
+        x = paddle.randn([1, 3, 32, 32])
+        assert m(x).shape == [1, 10]
+
+    def test_lenet_train(self):
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        m = LeNet()
+        opt = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+        x = paddle.randn([4, 1, 28, 28])
+        y = paddle.to_tensor(np.random.randint(0, 10, 4))
+        l0 = None
+        for _ in range(3):
+            loss = nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss.item())
+        assert float(loss.item()) <= l0
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self, tmp_path):
+        from paddle_tpu.io import Dataset
+        from paddle_tpu.metric import Accuracy
+
+        paddle.seed(0)
+
+        class ToyDS(Dataset):
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                x = rng.randn(4).astype(np.float32)
+                return x, np.asarray(int(x[0] > 0), np.int64)
+
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(optimizer.Adam(learning_rate=0.05,
+                                     parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ToyDS(), epochs=4, batch_size=16, verbose=0)
+        res = model.evaluate(ToyDS(), batch_size=16)
+        assert res["acc"] > 0.9
+        preds = model.predict(ToyDS(), batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (64, 2)
+        # save/load roundtrip
+        path = os.path.join(tmp_path, "ckpt")
+        model.save(path)
+        w_before = npt(net[0].weight)
+        net[0].weight.set_value(np.zeros_like(w_before))
+        model.load(path)
+        np.testing.assert_allclose(npt(net[0].weight), w_before)
+
+
+class TestCheckpoint:
+    def test_save_load_state(self, tmp_path):
+        m = nn.Linear(3, 3)
+        p = os.path.join(tmp_path, "model.pdparams")
+        paddle.save(m.state_dict(), p)
+        sd = paddle.load(p)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(sd)
+        np.testing.assert_array_equal(npt(m.weight), npt(m2.weight))
+
+    def test_orbax_sharded_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+        m = nn.Linear(4, 4)
+        sd = dict(m.state_dict())
+        path = os.path.join(tmp_path, "ckpt1")
+        save_state_dict(sd, path)
+        restored = load_state_dict(path)
+        np.testing.assert_allclose(npt(restored["weight"]), npt(m.weight))
+
+    def test_auto_checkpoint_resume(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+
+        m = nn.Linear(2, 2)
+        opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+        ac = AutoCheckpoint(str(tmp_path / "ac"), every_n_steps=2)
+        for _ in range(4):
+            m(paddle.randn([2, 2])).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            ac.step(m, opt)
+        w = npt(m.weight)
+        m2 = nn.Linear(2, 2)
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+        ac2 = AutoCheckpoint(str(tmp_path / "ac"), every_n_steps=2)
+        step = ac2.resume(m2, opt2)
+        assert step == 4
+        np.testing.assert_allclose(npt(m2.weight), w)
+
+
+class TestJit:
+    def test_to_static_matches_eager(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        eager_out = npt(net(x))
+        from paddle_tpu.jit import to_static
+
+        snet = to_static(net)
+        static_out = npt(snet(x))
+        np.testing.assert_allclose(static_out, eager_out, rtol=1e-5, atol=1e-6)
+
+    def test_to_static_function(self):
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+
+        a = paddle.randn([2, 3])
+        b = paddle.randn([3, 2])
+        np.testing.assert_allclose(npt(f(a, b)), npt(a) @ npt(b) + 1, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_jit_save_load(self, tmp_path):
+        import os
+
+        net = nn.Linear(2, 2)
+        from paddle_tpu import jit
+
+        path = os.path.join(tmp_path, "m")
+        jit.save(net, path)
+        loaded = jit.load(path)
+        net2 = nn.Linear(2, 2)
+        loaded.bind(net2)
+        np.testing.assert_array_equal(npt(net.weight), npt(net2.weight))
+
+
+class TestDataLoader:
+    def test_batching_shuffle_drop_last(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full(2, i, np.float32), np.asarray(i, np.int64)
+
+        dl = DataLoader(DS(), batch_size=3, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[0][0].shape == [3, 2]
+        dl2 = DataLoader(DS(), batch_size=3, drop_last=False)
+        assert len(list(dl2)) == 4
+
+    def test_distributed_batch_sampler_shards(self):
+        from paddle_tpu.io import DistributedBatchSampler, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return i
+
+        s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert set(i0) | set(i1) == set(range(8))
+        assert not (set(i0) & set(i1))
+
+    def test_prefetch_workers(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 6
+
+            def __getitem__(self, i):
+                return np.asarray([i], np.float32)
+
+        dl = DataLoader(DS(), batch_size=2, num_workers=2)
+        out = [npt(b)[0] if isinstance(b, list) else npt(b) for b in dl]
+        assert len(out) == 3

@@ -1,0 +1,189 @@
+"""Optimizer + LR scheduler tests (ref unittests/test_adam_op.py etc. pattern:
+compare against hand-rolled numpy updates; plus convergence smoke)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def npt(x):
+    return np.asarray(x.numpy(), np.float64)
+
+
+class TestSGDAdam:
+    def test_sgd_update_rule(self):
+        p = paddle.framework.Parameter(np.ones(3, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(npt(p), [0.9, 0.8, 0.7], rtol=1e-5)
+
+    def test_momentum_rule(self):
+        p = paddle.framework.Parameter(np.zeros(1, np.float32))
+        opt = optimizer.Momentum(learning_rate=1.0, momentum=0.9, parameters=[p])
+        for expected_v in [1.0, 1.9, 2.71]:
+            p.grad = paddle.to_tensor(np.ones(1, np.float32))
+            opt.step()
+        # velocity after 3 steps: 1, 1.9, 2.71 → param = -(1+1.9+2.71)
+        np.testing.assert_allclose(npt(p), [-5.61], rtol=1e-5)
+
+    def test_adam_matches_numpy(self):
+        w0 = np.random.randn(4).astype(np.float32)
+        p = paddle.framework.Parameter(w0.copy())
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        m = np.zeros(4)
+        v = np.zeros(4)
+        w = w0.astype(np.float64).copy()
+        for t in range(1, 4):
+            g = np.random.randn(4).astype(np.float32)
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            mhat = m / (1 - 0.9 ** t)
+            vhat = v / (1 - 0.999 ** t)
+            w -= 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(npt(p), w, rtol=1e-4, atol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        w0 = np.full(2, 10.0, np.float32)
+        p = paddle.framework.Parameter(w0.copy())
+        opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt.step()
+        # zero grad → pure decay: w *= (1 - lr*wd)
+        np.testing.assert_allclose(npt(p), w0 * 0.95, rtol=1e-5)
+
+    def test_optimizer_state_dict_roundtrip(self):
+        layer = nn.Linear(3, 3)
+        opt = optimizer.Adam(learning_rate=0.01, parameters=layer.parameters())
+        x = paddle.randn([2, 3])
+        layer(x).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=layer.parameters())
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 1
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.framework.Parameter(np.zeros(2, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        p.grad = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(npt(p), [-0.6, -0.8], rtol=1e-5)
+
+
+class TestConvergence:
+    def test_linear_regression_converges(self):
+        paddle.seed(0)
+        true_w = np.array([[2.0], [-3.0]], np.float32)
+        X = np.random.randn(64, 2).astype(np.float32)
+        y = X @ true_w + 0.5
+        layer = nn.Linear(2, 1)
+        opt = optimizer.Adam(learning_rate=0.1, parameters=layer.parameters())
+        for _ in range(150):
+            out = layer(paddle.to_tensor(X))
+            loss = nn.functional.mse_loss(out, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(npt(layer.weight), true_w, atol=0.05)
+        np.testing.assert_allclose(npt(layer.bias), [0.5], atol=0.05)
+
+    def test_classification_with_scheduler(self):
+        paddle.seed(0)
+        X = np.random.randn(128, 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        sched = optimizer.lr.StepDecay(0.05, step_size=50, gamma=0.5)
+        opt = optimizer.AdamW(learning_rate=sched, parameters=model.parameters())
+        for _ in range(100):
+            logits = model(paddle.to_tensor(X))
+            loss = nn.functional.cross_entropy(logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            sched.step()
+        acc = (npt(model(paddle.to_tensor(X))).argmax(-1) == y).mean()
+        assert acc > 0.95
+        assert sched() == pytest.approx(0.0125)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optimizer.lr.StepDecay(1.0, step_size=2, gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01], rtol=1e-6)
+
+    def test_cosine(self):
+        s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup_then_target(self):
+        s = optimizer.lr.LinearWarmup(0.8, warmup_steps=4, start_lr=0.0, end_lr=0.8)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:5], [0.0, 0.2, 0.4, 0.6, 0.8], rtol=1e-5)
+        assert vals[5] == pytest.approx(0.8)
+
+    def test_reduce_on_plateau(self):
+        s = optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)  # no improvement #1
+        s.step(1.0)  # no improvement #2 → reduce
+        assert s() == pytest.approx(0.5)
+
+    def test_noam(self):
+        s = optimizer.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        s.step(5)
+        ref = (512 ** -0.5) * min(5 ** -0.5, 5 * 10 ** -1.5)
+        assert s() == pytest.approx(ref)
+
+
+class TestAmp:
+    def test_grad_scaler_skips_on_inf(self):
+        p = paddle.framework.Parameter(np.zeros(1, np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+        from paddle_tpu.amp import GradScaler
+
+        scaler = GradScaler(init_loss_scaling=4.0)
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(npt(p), [0.0])  # step skipped
+
+    def test_grad_scaler_unscales(self):
+        p = paddle.framework.Parameter(np.zeros(1, np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+        from paddle_tpu.amp import GradScaler
+
+        scaler = GradScaler(init_loss_scaling=4.0)
+        loss = (paddle.to_tensor([3.0], stop_gradient=False) * p).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(npt(p), [-3.0], rtol=1e-5)
+
+    def test_auto_cast_o1(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.amp import auto_cast
+
+        a = paddle.randn([4, 4])
+        with auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+            assert out.dtype == jnp.bfloat16
+            s = paddle.exp(a)  # black list stays fp32
+            assert s.dtype == jnp.float32
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == jnp.float32
