@@ -53,6 +53,7 @@ from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
 from . import sparse  # noqa: F401
 from . import fft  # noqa: F401
+from . import distribution  # noqa: F401
 
 from .framework.io_state import save, load  # paddle.save/paddle.load
 

@@ -1,0 +1,117 @@
+"""Elastic / fault tolerance (ref: fleet/elastic/manager.py:126
+ElasticManager — etcd3 lease heartbeats :260, node watch, ElasticLevel :41,
+scale in/out :498/:521 + endpoint rewrite and relaunch).
+
+TPU-native reality (SURVEY §2.3): TPU pods can't change slice size in-job, so
+ELASTIC-level scale in/out is replaced by job-level restart + checkpoint
+resume. What survives from the reference design:
+- heartbeat + failure detection (KV-store leases instead of etcd3),
+- endpoint registry + rank rewrite on restart,
+- the LauncherInterface watch/stop/relaunch loop (the launch CLI's
+  --max_restart path is the actuator).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..launch.rendezvous import KVClient, KVServer
+
+
+class ElasticLevel(enum.IntEnum):  # ref manager.py:41
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, endpoint: str, job_id: str = "default", np: int = 1,
+                 heartbeat_interval: float = 2.0, lease_ttl: float = 10.0,
+                 is_master: bool = False):
+        self.job_id = job_id
+        self.np = np
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.server = KVServer(int(endpoint.rsplit(":", 1)[1])) if is_master else None
+        self.kv = KVClient(endpoint)
+        self.my_host = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                      f"node-{os.getpid()}")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.enabled = True
+
+    # -- heartbeats (ref lease_heartbeat :260) ------------------------------
+    def start_heartbeat(self):
+        def beat():
+            while not self._stop.is_set():
+                self.kv.set(f"beat/{self.job_id}/{self.my_host}", str(time.time()))
+                self._stop.wait(self.heartbeat_interval)
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self.server:
+            self.server.stop()
+
+    # -- membership ---------------------------------------------------------
+    def alive_nodes(self) -> List[str]:
+        beats: Dict[str, str] = self.kv.list(f"beat/{self.job_id}/")
+        now = time.time()
+        return sorted(k.rsplit("/", 1)[1] for k, v in beats.items()
+                      if now - float(v) < self.lease_ttl)
+
+    def health_check(self) -> ElasticStatus:
+        """Ref _match/_update loop: all registered nodes beating → HOLD (run);
+        any lease expired → RESTART (checkpoint-resume relaunch)."""
+        alive = self.alive_nodes()
+        if len(alive) >= self.np:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART
+
+    def wait_for_np(self, timeout: float = 120.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if len(self.alive_nodes()) >= self.np:
+                return True
+            time.sleep(0.5)
+        return False
+
+    def update_endpoints(self) -> List[str]:
+        """Rank rewrite on restart (ref _update_fault_tolrance :469): new
+        sorted membership becomes PADDLE_TRAINER_ENDPOINTS."""
+        eps = self.alive_nodes()
+        os.environ["DISTRIBUTED_TRAINER_ENDPOINTS"] = ",".join(eps)
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
+        if self.my_host in eps:
+            os.environ["PADDLE_TRAINER_ID"] = str(eps.index(self.my_host))
+        return eps
+
+
+def run_with_fault_tolerance(train_fn: Callable[[int], None], checkpoint,
+                             max_restarts: int = 3):
+    """Convenience loop: run train_fn(resume_step); on failure, resume from
+    the latest AutoCheckpoint snapshot (the recovery story, SURVEY §5.3/5.4)."""
+    attempts = 0
+    while True:
+        try:
+            step = checkpoint.resume() if hasattr(checkpoint, "resume") else 0
+            train_fn(step)
+            return
+        except Exception:
+            attempts += 1
+            if attempts > max_restarts:
+                raise

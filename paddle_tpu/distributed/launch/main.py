@@ -1,0 +1,171 @@
+"""Cluster launcher CLI (ref: python/paddle/distributed/launch/main.py:18 +
+controllers/collective.py:21 build_pod + job/ Pod/Container).
+
+Usage parity:
+    python -m paddle_tpu.distributed.launch [--nnodes N] [--master ip:port]
+        [--nproc_per_node M] [--log_dir d] [--max_restart K] train.py args...
+
+TPU semantics: one process drives all local chips, so nproc_per_node defaults
+to 1 (the reference defaults to #GPUs). Multi-node: rendezvous over the KV
+master, then each process gets PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env
+(same contract as collective.py:75-78) and jax.distributed.initialize is
+driven from them by init_parallel_env.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+from .rendezvous import HTTPMaster
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="master endpoint ip:port")
+    p.add_argument("--nnodes", default="1", help="N or min:max (elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--rank", type=int, default=-1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--devices", "--gpus", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Container:
+    """One managed process (ref launch/job/container.py)."""
+
+    def __init__(self, cmd: List[str], env: dict, log_path: str):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(self.cmd, env={**os.environ, **self.env},
+                                     stdout=self._log, stderr=subprocess.STDOUT)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class Pod:
+    """All containers on this node (ref launch/job/pod.py)."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def join(self) -> int:
+        while True:
+            codes = [c.poll() for c in self.containers]
+            if all(code is not None for code in codes):
+                return max(code or 0 for code in codes)
+            if any(code not in (None, 0) for code in codes):
+                for c in self.containers:
+                    c.terminate()
+                return max(code or 0 for code in codes if code is not None)
+            time.sleep(1)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+def build_pod(args, node_rank: int, endpoints: List[str]) -> Pod:
+    """Ref controllers/collective.py:32: assign ranks + env per process."""
+    pod = Pod()
+    nnodes = len(endpoints)
+    n = args.nproc_per_node
+    for local_rank in range(n):
+        global_rank = node_rank * n + local_rank
+        env = {
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(nnodes * n),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[node_rank],
+            "PADDLE_MASTER": endpoints[0],
+            "FLAGS_selected_devices": str(local_rank),
+        }
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        log = os.path.join(args.log_dir, f"workerlog.{global_rank}")
+        pod.containers.append(Container(cmd, env, log))
+    return pod
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+
+    if nnodes <= 1 and args.master is None:
+        endpoints = [f"127.0.0.1:{_free_port()}"]
+        node_rank = 0
+        master = None
+    else:
+        master_ep = args.master or f"{_local_ip()}:{_free_port()}"
+        is_master = args.rank in (0, -1) and (args.master is None or
+                                              master_ep.startswith(_local_ip()))
+        master = HTTPMaster(master_ep, is_master, nnodes)
+        my_ep = f"{_local_ip()}:{_free_port()}"
+        endpoints = master.sync_peers(my_ep, args.job_id)
+        node_rank = endpoints.index(my_ep) if args.rank < 0 else args.rank
+
+    restarts = 0
+    try:
+        while True:
+            pod = build_pod(args, node_rank, endpoints)
+            pod.deploy()
+            code = pod.join()
+            if code == 0:
+                return 0
+            restarts += 1
+            if restarts > args.max_restart:
+                print(f"[launch] giving up after {restarts - 1} restarts, exit {code}",
+                      file=sys.stderr)
+                return code
+            print(f"[launch] restart {restarts}/{args.max_restart} (exit {code})",
+                  file=sys.stderr)
+    finally:
+        if master is not None:
+            master.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,116 @@
+"""Rendezvous masters (ref: launch/controllers/master.py — HTTPMaster:65
+KV-barrier sync_peers, ETCDMaster:177).
+
+TPU-native: a small threaded TCP KV store on node 0 (the TCPStore role, ref
+paddle/phi/core/distributed/store/tcp_store.cc) used only for peer discovery;
+the actual collective bootstrap is jax.distributed.initialize, which has its
+own coordinator.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            line = self.rfile.readline().decode().strip()
+            req = json.loads(line)
+            store: Dict[str, str] = self.server.kv  # type: ignore
+            with self.server.lock:  # type: ignore
+                if req["op"] == "set":
+                    store[req["key"]] = req["value"]
+                    resp = {"ok": True}
+                elif req["op"] == "get":
+                    resp = {"ok": req["key"] in store,
+                            "value": store.get(req["key"])}
+                elif req["op"] == "add":
+                    store[req["key"]] = str(int(store.get(req["key"], "0"))
+                                            + int(req["value"]))
+                    resp = {"ok": True, "value": store[req["key"]]}
+                elif req["op"] == "list":
+                    prefix = req["key"]
+                    resp = {"ok": True, "value": {k: v for k, v in store.items()
+                                                  if k.startswith(prefix)}}
+                else:
+                    resp = {"ok": False}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+        except Exception:
+            pass
+
+
+class KVServer:
+    def __init__(self, port: int):
+        self.server = socketserver.ThreadingTCPServer(("0.0.0.0", port), _KVHandler,
+                                                      bind_and_activate=False)
+        self.server.allow_reuse_address = True
+        self.server.server_bind()
+        self.server.server_activate()
+        self.server.kv = {}
+        self.server.lock = threading.Lock()
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+
+    def _req(self, **kw):
+        for _ in range(300):
+            try:
+                with socket.create_connection(self.addr, timeout=5) as s:
+                    s.sendall((json.dumps(kw) + "\n").encode())
+                    data = s.makefile().readline()
+                    return json.loads(data)
+            except (ConnectionError, socket.timeout, OSError):
+                time.sleep(0.2)
+        raise TimeoutError(f"KV store at {self.addr} unreachable")
+
+    def set(self, key, value):
+        return self._req(op="set", key=key, value=value)
+
+    def get(self, key):
+        r = self._req(op="get", key=key)
+        return r.get("value") if r.get("ok") else None
+
+    def add(self, key, value=1):
+        return int(self._req(op="add", key=key, value=value)["value"])
+
+    def list(self, prefix):
+        return self._req(op="list", key=prefix)["value"]
+
+
+class HTTPMaster:
+    """sync_peers barrier (ref master.py:54,65): every node publishes its
+    endpoint, waits until all N are present, gets a deterministic rank."""
+
+    def __init__(self, master_endpoint: str, is_master: bool, nnodes: int):
+        self.endpoint = master_endpoint
+        self.nnodes = nnodes
+        self.server: Optional[KVServer] = None
+        if is_master:
+            self.server = KVServer(int(master_endpoint.rsplit(":", 1)[1]))
+        self.client = KVClient(master_endpoint)
+
+    def sync_peers(self, my_endpoint: str, job_id: str = "default") -> List[str]:
+        key = f"peers/{job_id}/{my_endpoint}"
+        self.client.set(key, my_endpoint)
+        while True:
+            peers = self.client.list(f"peers/{job_id}/")
+            if len(peers) >= self.nnodes:
+                return sorted(peers.values())
+            time.sleep(0.3)
+
+    def stop(self):
+        if self.server:
+            self.server.stop()

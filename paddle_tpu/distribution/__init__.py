@@ -1,0 +1,653 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/ — 20+
+distributions, kl registry, transforms)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..framework.random import next_key
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale), self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.normal(next_key(), s))
+
+    def log_prob(self, value):
+        def f(v):
+            var = jnp.square(self.scale)
+            return -jnp.square(v - self.loc) / (2 * var) - jnp.log(self.scale) \
+                - 0.5 * math.log(2 * math.pi)
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v: 0.5 * (1 + jax.scipy.special.erf(
+                (v - self.loc) / (self.scale * math.sqrt(2)))), value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(self.high - self.low) / 12)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), s)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        def f(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _v(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _v(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(next_key(), self.probs, s).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: v * jax.nn.log_sigmoid(self.logits)
+            + (1 - v) * jax.nn.log_sigmoid(-self.logits), value)
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(jnp.clip(p, 1e-12, None))
+                        + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12, None))))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _v(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_v(probs), 1e-30, None))
+        self._probs = jax.nn.softmax(self.logits, -1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(next_key(), self.logits, shape=s))
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jax.nn.log_softmax(self.logits, -1)
+            return jnp.take_along_axis(logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+
+        return apply_op(f, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(self._probs * logp, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs_, 1e-30, None))
+        draws = jax.random.categorical(next_key(), logits,
+                                       shape=(self.total_count,) + s)
+        k = self.probs_.shape[-1]
+        return Tensor(jnp.sum(jax.nn.one_hot(draws, k), axis=0))
+
+    def log_prob(self, value):
+        def f(v):
+            logp = jnp.log(jnp.clip(self.probs_, 1e-30, None))
+            coeff = jax.scipy.special.gammaln(self.total_count + 1.0) - jnp.sum(
+                jax.scipy.special.gammaln(v + 1.0), -1)
+            return coeff + jnp.sum(v * logp, -1)
+
+        return apply_op(f, value)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (t * t * (t + 1)))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta, s))
+
+    def log_prob(self, value):
+        def f(v):
+            return ((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(self.alpha)
+                       + jax.scipy.special.gammaln(self.beta)
+                       - jax.scipy.special.gammaln(self.alpha + self.beta)))
+
+        return apply_op(f, value)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(next_key(), self.concentration, s) / self.rate)
+
+    def log_prob(self, value):
+        def f(v):
+            a, b = self.concentration, self.rate
+            return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v \
+                - jax.scipy.special.gammaln(a)
+
+        return apply_op(f, value)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration, s))
+
+    def log_prob(self, value):
+        def f(v):
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(a), -1))
+
+        return apply_op(f, value)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(next_key(), s) / self.rate)
+
+    def log_prob(self, value):
+        return apply_op(lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * jnp.square(self.scale), self.batch_shape))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(next_key(), s))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: -jnp.abs(v - self.loc) / self.scale
+            - jnp.log(2 * self.scale), value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    def sample(self, shape=()):
+        return apply_op(jnp.exp, self._normal.sample(shape))
+
+    def log_prob(self, value):
+        def f(v):
+            logv = jnp.log(v)
+            var = jnp.square(self.scale)
+            return -jnp.square(logv - self.loc) / (2 * var) - logv \
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+
+        return apply_op(f, value)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(next_key(), s))
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+        return apply_op(f, value)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs_)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), s)
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)) + 1)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: (v - 1) * jnp.log1p(-self.probs_) + jnp.log(self.probs_), value)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(next_key(), s))
+
+    def log_prob(self, value):
+        def f(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + z * z))
+
+        return apply_op(f, value)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _v(df)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.t(next_key(), self.df, s))
+
+    def log_prob(self, value):
+        def f(v):
+            d = self.df
+            z = (v - self.loc) / self.scale
+            return (jax.scipy.special.gammaln((d + 1) / 2)
+                    - jax.scipy.special.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return apply_op(f, value)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(next_key(), self.rate, s).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v: v * jnp.log(self.rate) - self.rate
+            - jax.scipy.special.gammaln(v + 1), value)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape, self.probs_.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    def sample(self, shape=()):
+        s = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.binomial(next_key(), self.total_count, self.probs_, s))
+
+    def log_prob(self, value):
+        def f(v):
+            n, p = self.total_count, self.probs_
+            return (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+        return apply_op(f, value)
+
+
+# --------------------------------------------------------------------------- #
+# Transforms + TransformedDistribution (subset of ref transform.py)
+# --------------------------------------------------------------------------- #
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def forward(self, x):
+        return apply_op(lambda v: self.loc + self.scale * v, x)
+
+    def inverse(self, y):
+        return apply_op(lambda v: (v - self.loc) / self.scale, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                                   v.shape), x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.exp, x)
+
+    def inverse(self, y):
+        return apply_op(jnp.log, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: v, x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return apply_op(jax.nn.sigmoid, x)
+
+    def inverse(self, y):
+        return apply_op(lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: jax.nn.log_sigmoid(v) + jax.nn.log_sigmoid(-v), x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return apply_op(jnp.tanh, x)
+
+    def inverse(self, y):
+        return apply_op(jnp.arctanh, y)
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(lambda v: 2.0 * (math.log(2.0) - v - jax.nn.softplus(-2.0 * v)),
+                        x)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) \
+            else [transforms]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        ldj_total = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            ldj_total = ldj if ldj_total is None else ldj_total + ldj
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp - ldj_total
+
+
+# --------------------------------------------------------------------------- #
+# KL divergence registry (ref kl.py)
+# --------------------------------------------------------------------------- #
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(f"KL({type(p).__name__} || {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pr = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qr = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return Tensor(pr * (jnp.log(pr) - jnp.log(qr))
+                  + (1 - pr) * (jnp.log1p(-pr) - jnp.log1p(-qr)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(1.0 / r) + r - 1.0)

@@ -1,0 +1,4 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .moe_layer import ExpertMLP, MoELayer
+
+__all__ = ["MoELayer", "ExpertMLP", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

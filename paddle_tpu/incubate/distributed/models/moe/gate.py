@@ -1,0 +1,84 @@
+"""MoE gates (ref: python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.dispatch import apply_op
+from .....nn.initializer import XavierUniform
+from .....nn.layer_base import Layer
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.loss = None  # aux load-balance loss, read by the trainer
+
+    def gate_logits(self, x_val, w_val):
+        return jnp.matmul(x_val.astype(jnp.float32), w_val.astype(jnp.float32))
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no aux loss (ref naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2, num_experts=None):
+        n = num_experts if num_experts is not None else (num_expert or 1) * world_size
+        super().__init__(d_model, n, topk)
+        self.weight = self.create_parameter([d_model, n],
+                                            default_initializer=XavierUniform())
+
+    def routing(self, x_val, w_val):
+        """Pure: returns (combine_weights, dispatch_mask_idx, aux_loss)."""
+        logits = self.gate_logits(x_val, w_val)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, self.top_k)
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        return topv, topi, jnp.zeros((), jnp.float32)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with GShard load-balance aux loss (ref gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2, capacity=(1.2, 2.4),
+                 group=None, num_experts=None):
+        super().__init__(d_model, num_expert, world_size, topk, num_experts)
+        self.capacity = capacity
+
+    def routing(self, x_val, w_val):
+        logits = self.gate_logits(x_val, w_val)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, self.top_k)
+        topv = topv / jnp.clip(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+        # aux: mean_prob_e * frac_tokens_e summed over experts
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(topi[:, 0], self.num_experts), axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        return topv, topi, aux
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch-transformer gate (ref switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=1, switch_eps=0.1,
+                 capacity=(1.2, 2.4), group=None, num_experts=None):
+        super().__init__(d_model, num_expert, world_size, 1, num_experts)
+        self.switch_eps = switch_eps
+
+    def routing(self, x_val, w_val):
+        logits = self.gate_logits(x_val, w_val)
+        if self.training:
+            from .....framework.random import next_key
+
+            noise = jax.random.uniform(next_key(), logits.shape, jnp.float32,
+                                       1.0 - self.switch_eps, 1.0 + self.switch_eps)
+            logits = logits * noise
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, 1)
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(topi[:, 0], self.num_experts), axis=0)
+        aux = jnp.sum(me * ce) * self.num_experts
+        return topv, topi, aux

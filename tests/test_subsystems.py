@@ -1,0 +1,238 @@
+"""Tests for MoE, distributions, launch CLI, elastic, flags, profiler."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def npt(x):
+    return np.asarray(x.numpy(), np.float64)
+
+
+class TestMoE:
+    def test_forward_backward_and_aux(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, num_experts=4, d_hidden=32, top_k=2,
+                       capacity_factor=2.0)
+        x = paddle.randn([2, 8, 16])
+        x.stop_gradient = False
+        out = moe(x)
+        assert out.shape == [2, 8, 16]
+        out.sum().backward()
+        assert moe.experts.w1.grad is not None
+        assert moe.gate.weight.grad is not None
+        aux = float(np.asarray(moe.gate.loss))
+        assert 0.5 < aux < 4.0  # ~1 when balanced
+
+    def test_top1_switch_gate(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer, SwitchGate
+
+        moe = MoELayer(d_model=8, num_experts=2, d_hidden=16,
+                       gate={"type": "switch", "top_k": 1}, capacity_factor=4.0)
+        moe.eval()
+        x = paddle.randn([4, 8])
+        assert moe(x).shape == [4, 8]
+
+    def test_capacity_drops_tokens(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        paddle.seed(0)
+        # capacity_factor tiny → most tokens dropped → output mostly zero rows
+        moe = MoELayer(d_model=8, num_experts=2, d_hidden=16, top_k=1,
+                       capacity_factor=0.1)
+        x = paddle.randn([16, 8])
+        out = npt(moe(x))
+        zero_rows = (np.abs(out).sum(-1) < 1e-9).sum()
+        assert zero_rows >= 8
+
+    def test_expert_sharding_spec(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        moe = MoELayer(d_model=8, num_experts=4, d_hidden=16)
+        assert "expert" in str(moe.experts.w1.pspec)
+
+
+class TestDistributions:
+    def test_normal_moments_and_logprob(self):
+        from paddle_tpu.distribution import Normal
+
+        n = Normal(2.0, 3.0)
+        s = n.sample([20000])
+        assert abs(float(s.mean().item()) - 2.0) < 0.1
+        assert abs(float(s.std().item()) - 3.0) < 0.1
+        lp = float(n.log_prob(paddle.to_tensor(2.0)).item())
+        assert lp == pytest.approx(-np.log(3) - 0.5 * np.log(2 * np.pi), rel=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        c = Categorical(probs=[0.1, 0.2, 0.7])
+        s = npt(c.sample([5000]))
+        assert abs((s == 2).mean() - 0.7) < 0.05
+        assert float(c.log_prob(paddle.to_tensor(2)).item()) == pytest.approx(
+            np.log(0.7), rel=1e-4)
+
+    def test_kl_registry(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 2.0))
+        ref = np.log(2) + (1 + 1) / 8 - 0.5
+        assert float(kl.item()) == pytest.approx(ref, rel=1e-5)
+
+    def test_transformed_matches_lognormal(self):
+        from paddle_tpu.distribution import (ExpTransform, LogNormal, Normal,
+                                             TransformedDistribution)
+
+        td = TransformedDistribution(Normal(0.0, 1.0), ExpTransform())
+        ln = LogNormal(0.0, 1.0)
+        x = paddle.to_tensor(1.7)
+        assert float(td.log_prob(x).item()) == pytest.approx(
+            float(ln.log_prob(x).item()), rel=1e-4)
+
+    def test_beta_gamma_dirichlet(self):
+        from paddle_tpu.distribution import Beta, Dirichlet, Gamma
+
+        b = Beta(2.0, 3.0)
+        assert float(b.mean.item()) == pytest.approx(0.4)
+        g = Gamma(3.0, 2.0)
+        assert float(g.mean.item()) == pytest.approx(1.5)
+        d = Dirichlet(paddle.to_tensor([1.0, 1.0, 2.0]))
+        s = d.sample()
+        assert float(s.sum().item()) == pytest.approx(1.0, rel=1e-5)
+
+
+class TestLaunch:
+    def test_single_node_launch(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+            "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+            "print('OK')\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120)
+        assert r.returncode == 0
+        assert "OK" in (tmp_path / "log" / "workerlog.0").read_text()
+
+    def test_max_restart_on_failure(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--max_restart", "1", "--log_dir", str(tmp_path / "log"), str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120)
+        assert r.returncode == 3
+        assert "restart 1/1" in r.stderr
+
+    def test_kv_store(self):
+        from paddle_tpu.distributed.launch.rendezvous import KVClient, KVServer
+
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        srv = KVServer(port)
+        try:
+            c = KVClient(f"127.0.0.1:{port}")
+            c.set("a", "1")
+            assert c.get("a") == "1"
+            assert c.add("ctr", 2) == 2
+            assert c.add("ctr", 3) == 5
+            assert c.list("a") == {"a": "1"}
+        finally:
+            srv.stop()
+
+
+class TestElastic:
+    def test_heartbeat_and_membership(self):
+        import socket
+        import time
+
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        em = ElasticManager(f"127.0.0.1:{port}", np=1, heartbeat_interval=0.1,
+                            lease_ttl=2.0, is_master=True)
+        try:
+            em.start_heartbeat()
+            assert em.wait_for_np(timeout=5)
+            assert em.health_check() == ElasticStatus.HOLD
+            eps = em.update_endpoints()
+            assert len(eps) == 1
+        finally:
+            em.stop()
+
+
+class TestFlagsProfiler:
+    def test_set_get_flags(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check_raises(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor([-1.0]))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_profiler_records_and_summary(self, tmp_path, capsys):
+        import paddle_tpu.profiler as profiler
+
+        with profiler.Profiler() as prof:
+            with profiler.RecordEvent("my_op"):
+                paddle.matmul(paddle.randn([32, 32]), paddle.randn([32, 32]))
+        prof.summary()
+        out = capsys.readouterr().out
+        assert "my_op" in out
+        f = tmp_path / "trace.json"
+        prof.export(str(f))
+        import json
+
+        data = json.loads(f.read_text())
+        assert any(e["name"] == "my_op" for e in data["traceEvents"])
+
+    def test_scheduler_states(self):
+        import paddle_tpu.profiler as profiler
+
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == profiler.ProfilerState.CLOSED
+        assert states[1] == profiler.ProfilerState.READY
+        assert states[2] == profiler.ProfilerState.RECORD
+        assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+
+
+class TestSparseFFT:
+    def test_sparse_coo(self):
+        import paddle_tpu.sparse as sparse
+
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        t = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        dense = npt(t.to_dense())
+        assert dense[0, 1] == 1.0 and dense[2, 0] == 3.0
+        assert t.nnz() == 3
+        y = sparse.matmul(t, paddle.ones([3, 2]))
+        np.testing.assert_allclose(npt(y)[:, 0], [1.0, 2.0, 3.0])
+
+    def test_fft_roundtrip(self):
+        import paddle_tpu.fft as fft
+
+        x = paddle.randn([16])
+        y = fft.ifft(fft.fft(x))
+        np.testing.assert_allclose(npt(y.real()) if hasattr(y, "real") else
+                                   np.real(npt(y)), npt(x), rtol=1e-4, atol=1e-6)
