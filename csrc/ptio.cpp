@@ -1,0 +1,186 @@
+// paddle_tpu native IO core.
+//
+// TPU-native replacement for the reference's C++ data pipeline
+// (ref paddle/fluid/framework/data_feed.cc + the multiprocess DataLoader
+// workers in python/paddle/fluid/dataloader/): an mmap-backed token-dataset
+// reader with a multithreaded prefetch ring buffer. The host CPU assembles
+// fixed-shape (batch, seq_len) token blocks concurrently with TPU compute;
+// Python receives them zero-copy via ctypes into caller-owned numpy buffers.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libptio.so ptio.cpp -lpthread
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct TokenFile {
+  const uint8_t* data = nullptr;
+  size_t bytes = 0;
+  int fd = -1;
+  int dtype_size = 4;
+
+  size_t n_tokens() const { return bytes / dtype_size; }
+
+  bool open_file(const char* path, int dsize) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0) return false;
+    bytes = static_cast<size_t>(st.st_size);
+    dtype_size = dsize;
+    void* p = mmap(nullptr, bytes, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) return false;
+    madvise(p, bytes, MADV_WILLNEED);
+    data = static_cast<const uint8_t*>(p);
+    return true;
+  }
+
+  void close_file() {
+    if (data) munmap(const_cast<uint8_t*>(data), bytes);
+    if (fd >= 0) ::close(fd);
+    data = nullptr;
+    fd = -1;
+  }
+
+  int64_t token_at(size_t i) const {
+    switch (dtype_size) {
+      case 2: return reinterpret_cast<const uint16_t*>(data)[i];
+      case 4: return reinterpret_cast<const int32_t*>(data)[i];
+      case 8: return reinterpret_cast<const int64_t*>(data)[i];
+      default: return 0;
+    }
+  }
+};
+
+struct Batch {
+  std::vector<int32_t> tokens;  // (batch, seq_len + 1): inputs + shifted labels
+};
+
+class Reader {
+ public:
+  Reader(const char* path, int dtype_size, int seq_len, int batch_size,
+         int num_threads, int capacity, uint64_t seed, int shard_id,
+         int num_shards)
+      : seq_len_(seq_len),
+        batch_size_(batch_size),
+        capacity_(capacity < 2 ? 2 : capacity),
+        seed_(seed),
+        shard_id_(shard_id),
+        num_shards_(num_shards < 1 ? 1 : num_shards) {
+    ok_ = file_.open_file(path, dtype_size);
+    if (!ok_) return;
+    // number of non-overlapping (seq_len+1) samples in this shard
+    size_t n_samples = file_.n_tokens() / (seq_len_ + 1);
+    shard_samples_ = n_samples / num_shards_;
+    if (shard_samples_ == 0) {
+      ok_ = false;
+      return;
+    }
+    stop_.store(false);
+    int nt = num_threads < 1 ? 1 : num_threads;
+    for (int t = 0; t < nt; ++t)
+      threads_.emplace_back([this, t] { worker(t); });
+  }
+
+  ~Reader() {
+    stop_.store(true);
+    cv_not_full_.notify_all();
+    cv_not_empty_.notify_all();
+    for (auto& th : threads_) th.join();
+    file_.close_file();
+  }
+
+  bool ok() const { return ok_; }
+  size_t samples_per_shard() const { return shard_samples_; }
+
+  // Blocks until a batch is ready; copies (batch, seq_len+1) int32 into out.
+  bool next(int32_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_not_empty_.wait(lk, [this] { return !queue_.empty() || stop_.load(); });
+    if (queue_.empty()) return false;
+    Batch b = std::move(queue_.front());
+    queue_.pop();
+    lk.unlock();
+    cv_not_full_.notify_one();
+    std::memcpy(out, b.tokens.data(), b.tokens.size() * sizeof(int32_t));
+    return true;
+  }
+
+ private:
+  void worker(int tid) {
+    std::mt19937_64 rng(seed_ + 0x9e3779b97f4a7c15ULL * (tid + 1));
+    const size_t stride = seq_len_ + 1;
+    while (!stop_.load()) {
+      Batch b;
+      b.tokens.resize(static_cast<size_t>(batch_size_) * stride);
+      for (int i = 0; i < batch_size_; ++i) {
+        size_t local = rng() % shard_samples_;
+        size_t sample = shard_id_ * shard_samples_ + local;
+        size_t base = sample * stride;
+        for (size_t j = 0; j < stride; ++j)
+          b.tokens[i * stride + j] =
+              static_cast<int32_t>(file_.token_at(base + j));
+      }
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_not_full_.wait(
+          lk, [this] { return queue_.size() < capacity_ || stop_.load(); });
+      if (stop_.load()) return;
+      queue_.push(std::move(b));
+      lk.unlock();
+      cv_not_empty_.notify_one();
+    }
+  }
+
+  TokenFile file_;
+  int seq_len_, batch_size_;
+  size_t capacity_;
+  uint64_t seed_;
+  int shard_id_, num_shards_;
+  size_t shard_samples_ = 0;
+  bool ok_ = false;
+  std::atomic<bool> stop_{true};
+  std::mutex mu_;
+  std::condition_variable cv_not_empty_, cv_not_full_;
+  std::queue<Batch> queue_;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptio_create_reader(const char* path, int dtype_size, int seq_len,
+                         int batch_size, int num_threads, int capacity,
+                         uint64_t seed, int shard_id, int num_shards) {
+  auto* r = new Reader(path, dtype_size, seq_len, batch_size, num_threads,
+                       capacity, seed, shard_id, num_shards);
+  if (!r->ok()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+long ptio_samples_per_shard(void* reader) {
+  return static_cast<long>(static_cast<Reader*>(reader)->samples_per_shard());
+}
+
+int ptio_next_batch(void* reader, int32_t* out) {
+  return static_cast<Reader*>(reader)->next(out) ? 1 : 0;
+}
+
+void ptio_destroy_reader(void* reader) { delete static_cast<Reader*>(reader); }
+
+}  // extern "C"

@@ -54,6 +54,28 @@ from . import incubate  # noqa: F401
 from . import sparse  # noqa: F401
 from . import fft  # noqa: F401
 from . import distribution  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import inference  # noqa: F401
+from . import quantization  # noqa: F401
+from . import signal  # noqa: F401
+from . import text  # noqa: F401
+
+
+def sysconfig_get_include():
+    import os as _o
+
+    return _o.path.join(_o.path.dirname(__file__), "include")
+
+
+class sysconfig:
+    get_include = staticmethod(sysconfig_get_include)
+
+    @staticmethod
+    def get_lib():
+        import os as _o
+
+        return _o.path.join(_o.path.dirname(__file__), "..", "csrc")
 
 from .framework.io_state import save, load  # paddle.save/paddle.load
 

@@ -1,0 +1,4 @@
+"""paddle.audio parity (ref: python/paddle/audio/ — features + functional)."""
+from . import features, functional
+
+__all__ = ["features", "functional"]

@@ -1,0 +1,174 @@
+"""Semi-auto SPMD API (ref: python/paddle/distributed/auto_parallel/ —
+Engine engine.py:58, interface.py shard_tensor:28/shard_op:108,
+process_mesh.py, Partitioner/Resharder).
+
+TPU-native: ProcessMesh == jax Mesh; shard_tensor == device_put with a
+NamedSharding; the Partitioner+Resharder pipeline == GSPMD (XLA propagates
+dist attrs and inserts resharding collectives); Engine == ParallelEngine.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.api import shard_constraint, shard_tensor as _shard_tensor
+from ...parallel.engine import ParallelEngine
+
+
+class ProcessMesh:
+    """Ref auto_parallel/process_mesh.py — ndarray of ranks with dim names."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        self._mesh_arr = np.asarray(mesh)
+        self._dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(self._mesh_arr.ndim)]
+
+    @property
+    def shape(self):
+        return list(self._mesh_arr.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh_arr.reshape(-1).tolist()
+
+    def to_jax_mesh(self) -> Mesh:
+        devs = np.asarray(jax.devices())[self._mesh_arr]
+        return Mesh(devs, tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._mesh_arr, other._mesh_arr)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None, placements=None):
+    """Ref interface.py:28. shard_spec: list of dim names or None per axis."""
+    jmesh = None
+    if isinstance(process_mesh, ProcessMesh):
+        jmesh = process_mesh.to_jax_mesh()
+    elif isinstance(process_mesh, Mesh):
+        jmesh = process_mesh
+    elif mesh is not None:
+        jmesh = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else mesh
+    return _shard_tensor(x, mesh=jmesh, shard_spec=shard_spec)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    """Ref interface.py:108 — annotate an op's in/out shardings; on TPU a
+    wrapper adding with_sharding_constraint on the outputs."""
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs:
+            spec = out_shard_specs[0] if isinstance(out_shard_specs, (list, tuple)) \
+                else out_shard_specs
+            out = shard_constraint(out, P(*[s if s else None for s in spec]))
+        return out
+
+    return wrapped
+
+
+class Strategy:
+    """Ref auto_parallel/strategy.py."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = _Cfg(enable=False, dtype="bfloat16")
+        self.recompute = _Cfg(enable=False)
+        self.sharding = _Cfg(enable=False, degree=1, stage=1)
+        self.gradient_merge = _Cfg(enable=False, k_steps=1)
+
+
+class _Cfg:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class Engine:
+    """Ref engine.py:58 — fit/evaluate/predict driving the sharded step.
+
+    Wraps ParallelEngine: _build+_parallel (engine.py:515,:700) are replaced
+    by jit-with-shardings."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy or Strategy()
+        self._engine: Optional[ParallelEngine] = None
+
+    def _ensure(self):
+        if self._engine is None:
+            fsdp = bool(self.strategy.sharding.enable)
+            remat = bool(self.strategy.recompute.enable)
+            loss_fn = self.loss
+            if hasattr(loss_fn, "forward"):  # Layer-style loss
+                layer = loss_fn
+
+                def loss_fn(*args):
+                    return layer(*args)
+
+            self._engine = ParallelEngine(self.model, optimizer=self.optimizer,
+                                          loss_fn=loss_fn, fsdp=fsdp, remat=remat,
+                                          donate=False)
+        return self._engine
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1):
+        from ...io import DataLoader
+
+        eng = self._ensure()
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size)
+        history = []
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                loss = eng.train_batch(*batch)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step} loss "
+                          f"{float(np.asarray(loss.value)):.4f}")
+                history.append(float(np.asarray(loss.value)))
+        return history
+
+    def evaluate(self, eval_data, batch_size=1):
+        from ...io import DataLoader
+
+        eng = self._ensure()
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size)
+        losses = [float(np.asarray(eng.eval_batch(*batch).value)) for batch in loader]
+        return {"loss": float(np.mean(losses))}
+
+    def save(self, path, training=True):
+        from ...framework.io_state import save
+
+        eng = self._ensure()
+        save(eng.state_dict(), path + ".pdparams")
+
+    def load(self, path):
+        from ...framework.io_state import load
+
+        sd = load(path + ".pdparams")
+        self.model.set_state_dict(sd)
+        if self._engine is not None:
+            self._engine._build_state()
+
+
+def get_mesh():
+    from ...distributed.collective import get_global_mesh
+
+    return get_global_mesh()

@@ -1,0 +1,115 @@
+"""paddle.geometric parity (ref: python/paddle/geometric/ — message passing
+send_u_recv/send_ue_recv, segment ops, sample_neighbors)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+
+def _seg(op):
+    return {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+            "min": jax.ops.segment_min}[op]
+
+
+def segment_sum(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(np.asarray(to_array(segment_ids)).max()) + 1 if True else None
+        return jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        n = int(np.asarray(to_array(segment_ids)).max()) + 1
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s, num_segments=n)
+        return tot / jnp.maximum(cnt, 1.0)[..., None] if d.ndim > 1 else \
+            tot / jnp.maximum(cnt, 1.0)
+
+    return apply_op(f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(np.asarray(to_array(segment_ids)).max()) + 1
+        return jax.ops.segment_max(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    def f(d, s):
+        n = int(np.asarray(to_array(segment_ids)).max()) + 1
+        return jax.ops.segment_min(d, s.astype(jnp.int32), num_segments=n)
+
+    return apply_op(f, data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather features at src, scatter-reduce at dst (ref geometric/message_passing)."""
+
+    def f(xv, src, dst):
+        n = out_size or xv.shape[0]
+        msgs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        seg = dst.astype(jnp.int32)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, seg, num_segments=n)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, seg, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(seg, xv.dtype), seg,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1.0)[:, None]
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, seg, num_segments=n)
+        if reduce_op == "min":
+            return jax.ops.segment_min(msgs, seg, num_segments=n)
+        raise ValueError(reduce_op)
+
+    return apply_op(f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    def f(xv, yv, src, dst):
+        n = out_size or xv.shape[0]
+        msgs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            msgs = msgs + yv
+        elif message_op == "mul":
+            msgs = msgs * yv
+        seg = dst.astype(jnp.int32)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, seg, num_segments=n)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, seg, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(seg, xv.dtype), seg,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1.0)[:, None]
+        raise ValueError(reduce_op)
+
+    return apply_op(f, x, y, src_index, dst_index)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """CSC neighbor sampling (host-side, dynamic shapes — eager only)."""
+    rng = np.random.RandomState(0)
+    rows = np.asarray(to_array(row))
+    cptr = np.asarray(to_array(colptr))
+    nodes = np.asarray(to_array(input_nodes))
+    out_n, out_count = [], []
+    for v in nodes:
+        lo, hi = cptr[v], cptr[v + 1]
+        neigh = rows[lo:hi]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, sample_size, replace=False)
+        out_n.append(neigh)
+        out_count.append(len(neigh))
+    return (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else np.zeros(0))),
+            Tensor(jnp.asarray(np.asarray(out_count, np.int64))))

