@@ -45,7 +45,7 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
     engine = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
-                            remat=on_tpu)
+                            remat=on_tpu, remat_policy="dots")
     engine.build_train_step()
 
     rng = np.random.RandomState(0)

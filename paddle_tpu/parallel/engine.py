@@ -68,7 +68,8 @@ class ParallelEngine:
 
     def __init__(self, model, optimizer=None, loss_fn: Optional[Callable] = None,
                  mesh: Optional[Mesh] = None, fsdp: bool = False, remat: bool = False,
-                 batch_spec: Any = P("data"), donate: bool = True):
+                 remat_policy: Optional[str] = "dots", batch_spec: Any = P("data"),
+                 donate: bool = True):
         from ..distributed.collective import get_global_mesh
 
         self.model = model
@@ -80,6 +81,7 @@ class ParallelEngine:
             self.mesh = Mesh(devs.reshape(1), ("data",))
         self.fsdp = fsdp
         self.remat = remat
+        self.remat_policy = remat_policy
         self.batch_spec = batch_spec
         self._donate = donate
         self._build_state()
@@ -144,7 +146,18 @@ class ParallelEngine:
             def loss_of(tr):
                 return self._loss_from_batch({**tr, **frozen}, batch)
 
-            loss_of_ = jax.checkpoint(loss_of) if self.remat else loss_of
+            if self.remat:
+                # keep MXU outputs, recompute elementwise (the reference's
+                # recompute granularity is whole-layer; saving dot outputs is
+                # the better HBM/FLOP tradeoff on TPU)
+                policy = None
+                if self.remat_policy == "dots":
+                    policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                elif self.remat_policy == "nothing":
+                    policy = jax.checkpoint_policies.nothing_saveable
+                loss_of_ = jax.checkpoint(loss_of, policy=policy)
+            else:
+                loss_of_ = loss_of
             loss, grads = jax.value_and_grad(loss_of_)(train)
             new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
                                                    step_count + 1)
