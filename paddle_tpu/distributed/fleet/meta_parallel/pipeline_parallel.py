@@ -159,6 +159,10 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
     """
 
     def per_shard(params_shard, micro_batches):
+        # mark replicated inputs as varying over the pipe axis so scan/cond
+        # type-check against the ppermute-produced (varying) activations
+        micro_batches = jax.tree_util.tree_map(
+            lambda x: jax.lax.pvary(x, (axis_name,)), micro_batches)
         stage = jax.lax.axis_index(axis_name)
         T = num_micro + num_stages - 1  # fill + drain ticks
 
@@ -189,8 +193,10 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
         # run one stage fwd to get output shape
         out_shape = jax.eval_shape(lambda a: stage_fn(0, params_shard, a), act0)
         outputs0 = jax.tree_util.tree_map(
-            lambda s: jnp.zeros((num_micro,) + tuple(s.shape), s.dtype), out_shape)
+            lambda s: jax.lax.pvary(jnp.zeros((num_micro,) + tuple(s.shape), s.dtype),
+                                    (axis_name,)), out_shape)
         (act, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
-        return outputs
+        # only the last stage wrote real values; psum replicates them ring-wide
+        return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
 
     return per_shard

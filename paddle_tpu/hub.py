@@ -1,0 +1,37 @@
+"""paddle.hub parity (ref: python/paddle/hub.py). Zero-egress environment:
+only local-dir sources work; github sources raise with guidance."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    if source != "local":
+        raise RuntimeError("paddle_tpu.hub supports source='local' only (no egress)")
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod) if not n.startswith("_") and callable(getattr(mod, n))]
+
+
+def help(repo_dir: str, model: str, source: str = "local", force_reload: bool = False):
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local", force_reload: bool = False,
+         **kwargs):
+    if source != "local":
+        raise RuntimeError("paddle_tpu.hub supports source='local' only (no egress)")
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(**kwargs)

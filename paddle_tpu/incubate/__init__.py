@@ -4,8 +4,12 @@ Currently: autograd (functional jacobian/hessian/vjp/jvp over jax transforms),
 nn fused layers (incubate/nn/layer/fused_transformer.py analogues live in
 paddle_tpu.incubate.nn), autotune config shim.
 """
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
 
 
 def autotune(config=None):

@@ -1,0 +1,91 @@
+"""ASP — 2:4 structured sparsity (ref: python/paddle/incubate/asp/ —
+calculate_density, create_mask, prune_model, decorate/ASPOptimizer).
+
+TPU note: 2:4 sparsity is an Ampere tensor-core feature; on TPU the masks
+give model-compression parity (pruned weights stay zero through training),
+executed as dense-with-zeros on the MXU.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, to_array
+
+_MASKS: Dict[int, jnp.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    v = np.asarray(to_array(x) if isinstance(x, Tensor) else x)
+    return float((v != 0).sum() / v.size)
+
+
+def _mask_2to4_1d(row: np.ndarray) -> np.ndarray:
+    out = np.zeros_like(row, dtype=bool)
+    for i in range(0, len(row) - len(row) % 4, 4):
+        blk = np.abs(row[i:i + 4])
+        keep = np.argsort(-blk)[:2]
+        out[i + keep] = True
+    out[len(row) - len(row) % 4:] = True
+    return out
+
+
+def create_mask(tensor, func_name="mask_2d_best", n=2, m=4) -> np.ndarray:
+    v = np.asarray(to_array(tensor) if isinstance(tensor, Tensor) else tensor)
+    flat = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(1, -1)
+    mask = np.stack([_mask_2to4_1d(r) for r in flat])
+    return mask.reshape(v.shape)
+
+
+def check_sparsity(tensor, n=2, m=4, func_name=None) -> bool:
+    v = np.asarray(to_array(tensor) if isinstance(tensor, Tensor) else tensor)
+    flat = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(1, -1)
+    for row in flat:
+        for i in range(0, len(row) - len(row) % m, m):
+            if (row[i:i + m] != 0).sum() > n:
+                return False
+    return True
+
+
+def _supported(p: Parameter) -> bool:
+    return p.ndim == 2 and p.shape[0] % 4 == 0 or (p.ndim == 2 and p.shape[-1] % 4 == 0)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best", with_mask=True):
+    """Apply 2:4 masks to all eligible weights; registers masks so
+    ASP-decorated optimizers re-apply them after each step."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if p.ndim != 2 or p.shape[-1] % 4 != 0:
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        _MASKS[id(p)] = jnp.asarray(mask, p.dtype)
+        p._value = p.value * _MASKS[id(p)]
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """ASPOptimizer parity: re-mask after every optimizer step."""
+    orig_step = optimizer.step
+
+    def step(*a, **k):
+        out = orig_step(*a, **k)
+        for p in optimizer._get_params():
+            m = _MASKS.get(id(p))
+            if m is not None:
+                p._value = p.value * m
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    _MASKS.clear()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    pass

@@ -1,0 +1,178 @@
+"""Incubate optimizers (ref: python/paddle/incubate/optimizer/ — LBFGS,
+Lookahead, ModelAverage; distributed_fused_lamb).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor
+from ..optimizer.optimizer import Lamb, Optimizer
+
+
+class LookAhead(Optimizer):
+    """Ref incubate/optimizer/lookahead.py — k inner steps then interpolate
+    toward slow weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step_count = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner_optimizer._get_params():
+                key = id(p)
+                if key not in self._slow:
+                    self._slow[key] = p.value
+                slow = self._slow[key].astype(jnp.float32)
+                fast = p.value.astype(jnp.float32)
+                new_slow = slow + self.alpha * (fast - slow)
+                self._slow[key] = new_slow.astype(p.dtype)
+                p._value = self._slow[key]
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+
+class ModelAverage(Optimizer):
+    """Ref incubate/optimizer/modelaverage.py — maintain running average of
+    params; apply()/restore() swap them in/out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None, min_average_window=
+                 10000, max_average_window=10000, name=None):
+        super().__init__(0.0, parameters)
+        self._sums = {}
+        self._counts = {}
+        self._backup = {}
+
+    def step(self):
+        for p in self._get_params():
+            key = id(p)
+            self._sums[key] = self._sums.get(key, jnp.zeros_like(
+                p.value, jnp.float32)) + p.value.astype(jnp.float32)
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._apply_now()
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def _apply_now(self):
+        for p in self._get_params():
+            key = id(p)
+            if key in self._sums and self._counts[key] > 0:
+                self._backup[key] = p.value
+                p._value = (self._sums[key] / self._counts[key]).astype(p.dtype)
+
+    def restore(self, executor=None):
+        for p in self._get_params():
+            key = id(p)
+            if key in self._backup:
+                p._value = self._backup.pop(key)
+
+
+class LBFGS(Optimizer):
+    """Ref incubate/optimizer/lbfgs.py — full-batch L-BFGS with closure."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.max_iter = max_iter
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self._s: List[np.ndarray] = []
+        self._y: List[np.ndarray] = []
+        self._prev_flat_grad = None
+        self._prev_flat_param = None
+
+    def _flatten(self, vals):
+        return np.concatenate([np.asarray(v, np.float64).reshape(-1) for v in vals])
+
+    def _unflatten_to_params(self, flat):
+        ofs = 0
+        for p in self._get_params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._value = jnp.asarray(flat[ofs:ofs + n].reshape(p.shape), p.dtype)
+            ofs += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the loss")
+        loss = closure()
+        params = self._get_params()
+        g = self._flatten([p.grad.value for p in params])
+        x = self._flatten([p.value for p in params])
+
+        if self._prev_flat_grad is not None:
+            s = x - self._prev_flat_param
+            y = g - self._prev_flat_grad
+            if float(y @ s) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+
+        # two-loop recursion
+        q = g.copy()
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / (y @ s)
+            a = rho * (s @ q)
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if self._s:
+            gamma = (self._s[-1] @ self._y[-1]) / (self._y[-1] @ self._y[-1])
+            q *= gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * (y @ q)
+            q += (a - b) * s
+        direction = -q
+
+        lr = self.get_lr()
+        self._prev_flat_grad = g
+        self._prev_flat_param = x
+        self._unflatten_to_params(x + lr * direction)
+        for p in params:
+            p.clear_grad()
+        return loss
+
+
+class DistributedFusedLamb(Lamb):
+    """Ref incubate/optimizer/distributed_fused_lamb.py — on TPU the fusion +
+    cross-replica sharding comes from the compiled pure_update (one fused XLA
+    program over all params), so this is Lamb with the engine path."""

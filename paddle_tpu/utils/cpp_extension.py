@@ -1,0 +1,128 @@
+"""Custom C++ op SDK (ref: python/paddle/utils/cpp_extension/ — PD_BUILD_OP
+user ops JIT-compiled and loaded at runtime via
+paddle/fluid/framework/custom_operator.cc).
+
+TPU-native design: device-side custom kernels are Pallas (Python-authored);
+this SDK covers HOST custom ops — C++ compiled to a shared lib and invoked
+from traced programs through jax.pure_callback (CPU callback ring), or
+eagerly via ctypes. The C ABI convention replaces PD_BUILD_OP:
+
+    extern "C" void my_op(const float* in, float* out, long n);
+
+`load(name, sources)` compiles with g++ and returns a module-like object
+whose attributes are the exported functions wrapped as paddle ops.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import re
+import subprocess
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_BUILD_DIR = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+
+
+class CppExtension:
+    def __init__(self, sources, extra_compile_args=None, **kwargs):
+        self.sources = sources
+        self.extra_compile_args = extra_compile_args or []
+
+
+CUDAExtension = CppExtension  # no CUDA in a TPU build; kept for import parity
+
+
+def _compile(name: str, sources: Sequence[str], extra_args: Sequence[str],
+             build_directory: Optional[str], verbose: bool) -> str:
+    build_dir = build_directory or _BUILD_DIR
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.sha1("".join(
+        open(s).read() for s in sources).encode()).hexdigest()[:12]
+    out = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out,
+               *sources, *extra_args]
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return out
+
+
+_SIG_RE = re.compile(
+    r'extern\s+"C"\s+void\s+(\w+)\s*\(([^)]*)\)')
+
+
+class _LoadedOp:
+    """Wraps one exported C function as an eager+traceable op.
+
+    Convention: pointer args alternate (const T* input..., T* output...) and a
+    trailing `long n` element count. The wrapper passes all inputs, allocates
+    one like-shaped output, and calls back on host (jax.pure_callback under
+    trace — the TPU analogue of a CPU custom kernel).
+    """
+
+    def __init__(self, cfunc, name):
+        self._c = cfunc
+        self.__name__ = name
+
+    def _run_np(self, *arrays):
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        out = np.empty_like(arrays[0])
+        ptrs = [a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrays]
+        self._c(*ptrs, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_long(arrays[0].size))
+        return out
+
+    def __call__(self, *tensors):
+        import jax
+
+        from ..framework.core import Tensor, to_array
+        from ..framework.dispatch import apply_op
+
+        def f(*vals):
+            shape_dtype = jax.ShapeDtypeStruct(vals[0].shape, np.float32)
+            return jax.pure_callback(
+                lambda *np_vals: self._run_np(*[np.asarray(v) for v in np_vals]),
+                shape_dtype, *vals)
+
+        return apply_op(f, *tensors, op_name=self.__name__)
+
+
+class _ExtensionModule:
+    def __init__(self, lib, names):
+        self._lib = lib
+        for n in names:
+            cf = getattr(lib, n)
+            cf.restype = None
+            setattr(self, n, _LoadedOp(cf, n))
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags: Sequence[str] = (),
+         extra_cuda_cflags=None, extra_ldflags: Sequence[str] = (),
+         extra_include_paths: Sequence[str] = (), build_directory=None,
+         verbose: bool = False, interpreter=None):
+    """paddle.utils.cpp_extension.load parity."""
+    inc = [f"-I{p}" for p in extra_include_paths]
+    so = _compile(name, sources, [*extra_cxx_cflags, *inc, *extra_ldflags],
+                  build_directory, verbose)
+    lib = ctypes.CDLL(so)
+    names = []
+    for s in sources:
+        names += [m.group(1) for m in _SIG_RE.finditer(open(s).read())]
+    if not names:
+        raise RuntimeError(
+            'no extern "C" void functions found; custom ops must use the C ABI '
+            "convention (see module docstring)")
+    return _ExtensionModule(lib, names)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Shim of the setuptools-based build: compiles immediately."""
+    mods = []
+    for ext in ext_modules or []:
+        mods.append(load(name or "ext", ext.sources,
+                         extra_cxx_cflags=ext.extra_compile_args))
+    return mods

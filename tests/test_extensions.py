@@ -1,0 +1,173 @@
+"""Tests: custom C++ op SDK, incubate optimizers, ASP, cost model, hub,
+SPMD pipeline function."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def npt(x):
+    return np.asarray(x.numpy(), np.float64)
+
+
+class TestCppExtension:
+    def test_load_and_run_custom_op(self, tmp_path):
+        src = tmp_path / "myops.cpp"
+        src.write_text(textwrap.dedent("""
+            extern "C" void relu_offset(const float* in, float* out, long n) {
+              for (long i = 0; i < n; ++i)
+                out[i] = in[i] > 0 ? in[i] + 1.0f : 0.0f;
+            }
+        """))
+        from paddle_tpu.utils.cpp_extension import load
+
+        mod = load("myops", [str(src)], build_directory=str(tmp_path))
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
+        out = mod.relu_offset(x)
+        np.testing.assert_allclose(npt(out), [0.0, 1.5, 3.0])
+
+    def test_custom_op_under_jit(self, tmp_path):
+        src = tmp_path / "sq.cpp"
+        src.write_text('extern "C" void square_op(const float* a, float* o, long n)'
+                       "{ for (long i=0;i<n;++i) o[i]=a[i]*a[i]; }")
+        from paddle_tpu.utils.cpp_extension import load
+
+        mod = load("sq", [str(src)], build_directory=str(tmp_path))
+        import jax
+        import jax.numpy as jnp
+
+        def f(v):
+            return mod.square_op(paddle.Tensor(v)).value * 2
+
+        out = jax.jit(f)(jnp.asarray([3.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [18.0])
+
+
+class TestIncubateOptimizers:
+    def test_lookahead(self):
+        paddle.seed(0)
+        m = nn.Linear(2, 1)
+        inner = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        from paddle_tpu.incubate import LookAhead
+
+        la = LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.ones([4, 2])
+        y = paddle.zeros([4, 1])
+        for _ in range(4):
+            loss = nn.functional.mse_loss(m(x), y)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert float(nn.functional.mse_loss(m(x), y).item()) < 1.0
+
+    def test_model_average(self):
+        p = paddle.framework.Parameter(np.zeros(1, np.float32))
+        from paddle_tpu.incubate import ModelAverage
+
+        ma = ModelAverage(parameters=[p])
+        for v in [1.0, 2.0, 3.0]:
+            p._value = paddle.to_tensor(np.array([v], np.float32)).value
+            ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(npt(p), [2.0])
+        np.testing.assert_allclose(npt(p), [3.0])  # restored
+
+    def test_lbfgs_quadratic(self):
+        paddle.seed(0)
+        p = paddle.framework.Parameter(np.array([5.0, -3.0], np.float32))
+        from paddle_tpu.incubate import LBFGS
+
+        opt = LBFGS(learning_rate=0.5, parameters=[p])
+
+        def closure():
+            loss = ((p - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(20):
+            opt.step(closure)
+        np.testing.assert_allclose(npt(p), [1.0, 2.0], atol=1e-2)
+
+
+class TestASP:
+    def test_prune_and_check(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        m = nn.Linear(8, 8)
+        asp.prune_model(m)
+        assert asp.check_sparsity(m.weight)
+        assert asp.calculate_density(m.weight) == pytest.approx(0.5)
+
+    def test_masks_survive_optimizer_step(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        m = nn.Linear(8, 8, bias_attr=False)
+        asp.prune_model(m)
+        opt = asp.decorate(optimizer.SGD(learning_rate=0.1,
+                                         parameters=m.parameters()))
+        x = paddle.randn([4, 8])
+        m(x).sum().backward()
+        opt.step()
+        assert asp.check_sparsity(m.weight)
+
+
+class TestCostModel:
+    def test_flops_linear(self):
+        from paddle_tpu.cost_model import flops
+
+        m = nn.Linear(64, 32, bias_attr=False)
+        total = flops(m, [1, 64])
+        assert total >= 2 * 64 * 32 * 0.9  # ~2*in*out FLOPs
+
+
+class TestHub:
+    def test_local_hub(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(out=3):\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(2, out)\n")
+        import paddle_tpu.hub as hub
+
+        assert "tiny_model" in hub.list(str(tmp_path))
+        m = hub.load(str(tmp_path), "tiny_model", out=5)
+        assert m(paddle.randn([1, 2])).shape == [1, 5]
+
+
+class TestSpmdPipeline:
+    def test_gpipe_scan_matches_sequential(self):
+        """Compiled pipeline (ppermute stage rotation) == sequential apply."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from jax.experimental.shard_map import shard_map
+
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import \
+            spmd_pipeline_fn
+        from paddle_tpu.distributed.topology import build_mesh
+
+        num_stages, num_micro, D = 2, 4, 8
+        mesh = build_mesh(pp=num_stages, dp=4)
+        rng = np.random.RandomState(0)
+        # per-stage weights, stacked on stage axis
+        Ws = rng.randn(num_stages, D, D).astype(np.float32) * 0.3
+        xs = rng.randn(num_micro, 3, D).astype(np.float32)
+
+        def stage_fn(stage, w_shard, x):
+            return jnp.tanh(x @ w_shard[0])
+
+        per_shard = spmd_pipeline_fn(stage_fn, num_stages, num_micro, "pipe")
+        f = shard_map(per_shard, mesh=mesh,
+                      in_specs=(P("pipe"), P()), out_specs=P())
+        out = np.asarray(jax.jit(f)(Ws, xs))
+
+        ref = xs
+        for s in range(num_stages):
+            ref = np.tanh(ref @ Ws[s])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
