@@ -1,0 +1,48 @@
+"""ResNet on CIFAR-10 via the hapi Model API (BASELINE config 1 recipe).
+
+python examples/resnet_cifar10.py --epochs 1 --batch 64
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--model", default="resnet18")
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision import models, transforms
+    from paddle_tpu.vision.datasets import Cifar10
+
+    tfm = transforms.Compose([
+        transforms.RandomHorizontalFlip(),
+        transforms.ToTensor(),
+        transforms.Normalize([0.4914, 0.4822, 0.4465], [0.247, 0.243, 0.262]),
+    ])
+    train_ds = Cifar10(mode="train", transform=tfm)
+    eval_ds = Cifar10(mode="test", transform=transforms.Compose(
+        [transforms.ToTensor(),
+         transforms.Normalize([0.4914, 0.4822, 0.4465], [0.247, 0.243, 0.262])]))
+
+    net = getattr(models, args.model)(num_classes=10)
+    model = paddle.Model(net)
+    sched = optimizer.lr.CosineAnnealingDecay(0.1, T_max=args.epochs)
+    opt = optimizer.Momentum(learning_rate=sched, momentum=0.9,
+                             parameters=net.parameters(), weight_decay=5e-4)
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    model.fit(train_ds, eval_ds, epochs=args.epochs, batch_size=args.batch,
+              log_freq=10, num_workers=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,8 @@ from .device import get_device, set_device, is_compiled_with_cuda, is_compiled_w
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .hapi import summary  # noqa: F401
+from . import hub  # noqa: F401
+from .cost_model import flops  # noqa: F401
 
 
 def is_compiled_with_tpu() -> bool:

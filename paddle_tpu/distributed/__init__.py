@@ -13,8 +13,11 @@ from .collective import (Group, ReduceOp, all_gather, all_gather_object, all_red
 from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized)
 from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh
 from .parallel import DataParallel
-from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import communication  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .launch_util import spawn  # noqa: F401
 
 __all__ = [n for n in dir() if not n.startswith("_")]

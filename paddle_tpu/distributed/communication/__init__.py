@@ -1,0 +1,22 @@
+"""paddle.distributed.communication parity — re-exports the collectives;
+`stream` submodule keeps the explicit-stream API importable (XLA owns stream
+scheduling on TPU, ref SURVEY §5.8)."""
+from ..collective import (all_gather, all_reduce, all_to_all, barrier, broadcast,
+                          reduce, reduce_scatter, scatter)
+from . import stream  # noqa: F401
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Ref communication/batch_isend_irecv.py. Host-driven p2p is not a TPU
+    primitive — pipeline comm lives inside compiled programs (ppermute)."""
+    raise NotImplementedError(
+        "batch_isend_irecv: use the compiled pipeline path "
+        "(paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel) — "
+        "host-driven NCCL-style p2p has no TPU analogue.")
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer

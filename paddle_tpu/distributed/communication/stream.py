@@ -1,0 +1,43 @@
+"""Explicit-stream collective variants (ref: communication/stream/*.py).
+On TPU, XLA schedules collective streams; these alias the sync API with the
+use_calc_stream flag accepted and ignored."""
+from ..collective import (all_gather as _ag, all_reduce as _ar, all_to_all as _a2a,
+                          broadcast as _bc, reduce as _rd, reduce_scatter as _rs,
+                          scatter as _sc)
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True, use_calc_stream=False):
+    from ..collective import ReduceOp
+
+    return _ar(tensor, op if op is not None else ReduceOp.SUM, group, sync_op)
+
+
+def all_gather(tensor_or_list, tensor, group=None, sync_op=True, use_calc_stream=False):
+    return _ag(tensor_or_list, tensor, group, sync_op)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True, use_calc_stream=False):
+    from ..collective import ReduceOp
+
+    return _rd(tensor, dst, op if op is not None else ReduceOp.SUM, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _bc(tensor, src, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=None, group=None, sync_op=True,
+                   use_calc_stream=False):
+    from ..collective import ReduceOp
+
+    return _rs(tensor, tensor_list, op if op is not None else ReduceOp.SUM, group,
+               sync_op)
+
+
+def alltoall(out_list, in_list, group=None, sync_op=True, use_calc_stream=False):
+    return _a2a(out_list, in_list, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _sc(tensor, tensor_list, src, group, sync_op)

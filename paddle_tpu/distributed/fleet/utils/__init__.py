@@ -1,0 +1,16 @@
+"""fleet.utils parity (ref: fleet/utils/ — fs.py HDFS client,
+hybrid_parallel_util.py, recompute re-export)."""
+from ..recompute import recompute  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Ref hybrid_parallel_util.py:206 — average grads across DP workers."""
+    from ...collective import ReduceOp, all_reduce
+    from ...env import get_world_size
+
+    if get_world_size() <= 1:
+        return
+    for p in parameter_list:
+        if p.grad is not None:
+            all_reduce(p.grad, op=ReduceOp.AVG)

@@ -1,0 +1,125 @@
+"""Filesystem abstraction (ref: fleet/utils/fs.py — LocalFS + HDFSClient).
+HDFS requires an external hadoop client binary; LocalFS covers the
+checkpointing paths in this environment."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+
+class FS:
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_dir(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_file(self, path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, path):
+        if not os.path.isdir(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def touch(self, path, exist_ok=True):
+        open(path, "a").close()
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """Ref fs.py HDFSClient — shells out to `hadoop fs`."""
+
+    def __init__(self, hadoop_home: str, configs: Optional[dict] = None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self.hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self.configs = configs or {}
+        self._pre = [self.hadoop_bin, "fs"]
+        for k, v in self.configs.items():
+            self._pre += [f"-D{k}={v}"]
+
+    def _run(self, *args) -> Tuple[int, str]:
+        try:
+            out = subprocess.run(self._pre + list(args), capture_output=True,
+                                 text=True, timeout=300)
+            return out.returncode, out.stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            return 1, str(e)
+
+    def is_exist(self, path):
+        code, _ = self._run("-test", "-e", path)
+        return code == 0
+
+    def is_dir(self, path):
+        code, _ = self._run("-test", "-d", path)
+        return code == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls_dir(self, path):
+        code, out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", path)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        self._run("-put", "-f" if overwrite else "", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1, overwrite=False):
+        self._run("-get", fs_path, local_path)

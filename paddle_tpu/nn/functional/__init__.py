@@ -7,7 +7,8 @@ from .activation import (celu, elu, gelu, glu, gumbel_softmax, hardshrink, hards
 from .attention import scaled_dot_product_attention
 from .common import (alpha_dropout, bilinear, channel_shuffle, cosine_similarity, dropout,
                      dropout2d, dropout3d, embedding, fold, interpolate, label_smooth, linear,
-                     one_hot, pad, pixel_shuffle, pixel_unshuffle, unfold, upsample, zeropad2d)
+                     one_hot, pad, pixel_shuffle, pixel_unshuffle, sequence_mask,
+                     temporal_shift, unfold, upsample, zeropad2d)
 from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
                    conv3d_transpose)
 from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,

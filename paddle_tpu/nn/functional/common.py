@@ -314,3 +314,34 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 def class_center_sample(label, num_classes, num_samples, group=None):
     raise NotImplementedError("class_center_sample: PS-style API, out of TPU MVP scope")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Ref fluid sequence_mask op: lengths → boolean/int mask."""
+    from ...framework.dtype import convert_dtype
+
+    d = convert_dtype(dtype)
+
+    def f(lengths):
+        m = maxlen if maxlen is not None else int(jnp.max(lengths))
+        rng = jnp.arange(m)
+        return (rng[None, :] < lengths[..., None]).astype(d)
+
+    return apply_op(f, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """Ref temporal_shift op (video models): shift channels across time."""
+
+    def f(v):
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v5[:, 1:, :fold], jnp.zeros_like(v5[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v5[:, :1, fold:2 * fold]),
+                                 v5[:, :-1, fold:2 * fold]], 1)
+        rest = v5[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply_op(f, x)
