@@ -88,6 +88,15 @@ from .hapi import callbacks  # noqa: F401
 from .hapi import summary  # noqa: F401
 from . import hub  # noqa: F401
 from .cost_model import flops  # noqa: F401
+from .compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, NPUPlace, TPUPlace,
+                     add_n, batch, cast, check_shape, create_parameter, diagonal,
+                     disable_signal_handler, dsplit, dtype, finfo, frexp,
+                     get_cuda_rng_state, hsplit, iinfo, index_add_, is_complex,
+                     is_floating_point, is_integer, logcumsumexp, mv, reverse,
+                     set_cuda_rng_state, set_grad_enabled, set_printoptions, sgn,
+                     squeeze_, tanh_, tolist, unsqueeze_, vsplit)
+from .distributed.parallel import DataParallel  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
 
 
 def is_compiled_with_tpu() -> bool:

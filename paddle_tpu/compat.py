@@ -1,0 +1,287 @@
+"""Remaining top-level paddle API names (parity sweep vs reference
+python/paddle/__init__.py __all__)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Parameter, Tensor, to_array
+from .framework.dispatch import apply_op
+from .framework.dtype import convert_dtype, is_complex as _is_complex_d, \
+    is_floating_point as _is_fp_d, is_integer as _is_int_d
+from .framework.random import get_rng_state, set_rng_state
+
+
+def dtype(d):
+    return convert_dtype(d)
+
+
+class iinfo:
+    def __init__(self, d):
+        info = np.iinfo(np.dtype(convert_dtype(d)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = info.bits
+        self.dtype = str(np.dtype(convert_dtype(d)))
+
+
+class finfo:
+    def __init__(self, d):
+        info = np.finfo(np.dtype(convert_dtype(d)) if convert_dtype(d) != jnp.bfloat16
+                        else np.float32)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.bits = info.bits
+        self.dtype = str(d)
+
+
+def is_floating_point(x):
+    return _is_fp_d(x.dtype)
+
+
+def is_integer(x):
+    return _is_int_d(x.dtype)
+
+
+def is_complex(x):
+    return _is_complex_d(x.dtype)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def mv(x, vec, name=None):
+    return apply_op(lambda a, b: a @ b, x, vec)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        m = jax.lax.cummax(v, axis=ax)
+        return jnp.log(jnp.cumsum(jnp.exp(v - m), axis=ax)) + m
+
+    return apply_op(f, x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+                    x)
+
+
+def sgn(x, name=None):
+    def f(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.maximum(mag, 1e-30))
+        return jnp.sign(v)
+
+    return apply_op(f, x)
+
+
+def frexp(x, name=None):
+    outs = apply_op(lambda v: tuple(jnp.frexp(v)), x)
+    return outs[0], outs[1]
+
+
+def reverse(x, axis, name=None):
+    from .tensor.manipulation import flip
+
+    return flip(x, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    from .tensor.manipulation import tensor_split
+
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    from .tensor.manipulation import tensor_split
+
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    from .tensor.manipulation import tensor_split
+
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+# ---- in-place aliases (functional under the hood) -------------------------
+
+def squeeze_(x, axis=None, name=None):
+    from .tensor.manipulation import squeeze
+
+    x._value = squeeze(x, axis).value
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    from .tensor.manipulation import unsqueeze
+
+    x._value = unsqueeze(x, axis).value
+    return x
+
+
+def tanh_(x, name=None):
+    x._value = jnp.tanh(x.value)
+    return x
+
+
+def index_add_(x, index, axis, value, name=None):
+    from .tensor.manipulation import index_add
+
+    x._value = index_add(x, index, axis, value).value
+    return x
+
+
+# ---- RNG aliases (no CUDA on TPU; global generator state) ------------------
+
+def get_cuda_rng_state():
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+# ---- places ----------------------------------------------------------------
+
+
+class Place:
+    def __init__(self, kind, device_id=0):
+        self._kind = kind
+        self._id = device_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def is_gpu_place(self):
+        return self._kind == "gpu"
+
+    def is_cpu_place(self):
+        return self._kind == "cpu"
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu")
+
+
+class CUDAPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("gpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("cuda_pinned")
+
+
+class NPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("npu", device_id)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+# ---- misc ------------------------------------------------------------------
+
+
+class LazyGuard:
+    """Ref lazy init: delay parameter materialization. Eager JAX init is cheap
+    so this is a transparent context manager."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    import contextlib
+
+    from .framework.core import _grad_state
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = _grad_state.enabled
+        _grad_state.enabled = bool(mode)
+        try:
+            yield
+        finally:
+            _grad_state.enabled = prev
+
+    return ctx()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None,
+                     linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    pass
+
+
+def check_shape(x):
+    return list(x.shape)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """fluid-style reader decorator (ref python/paddle/batch.py)."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .nn.initializer import Constant, XavierUniform
+
+    init = default_initializer or (Constant(0.0) if is_bias else XavierUniform())
+    return Parameter(init(shape, convert_dtype(dtype)), name=name or "")

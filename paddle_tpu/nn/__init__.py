@@ -33,6 +33,9 @@ from .layer.rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, S
 from .layer.transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                                 TransformerDecoderLayer, TransformerEncoder,
                                 TransformerEncoderLayer)
+from .layer.extras import (BeamSearchDecoder, HSigmoidLoss, MaxUnPool1D, MaxUnPool3D,
+                           PairwiseDistance, RNNTLoss, Softmax2D,
+                           TripletMarginWithDistanceLoss, dynamic_decode)
 from ..framework.param_attr import ParamAttr  # noqa: F401  (paddle.ParamAttr alias)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

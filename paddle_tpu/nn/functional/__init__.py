@@ -21,6 +21,10 @@ from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,
                    triplet_margin_with_distance_loss)
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
                    normalize, rms_norm, spectral_norm)
+from .extras import (affine_grid, class_center_sample, diag_embed, elu_, gather_tree,
+                     grid_sample, hsigmoid_loss, margin_cross_entropy, max_unpool1d,
+                     max_unpool3d, pairwise_distance, relu_, rnnt_loss, softmax_,
+                     sparse_attention, tanh_)
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
                       avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,

@@ -1,0 +1,255 @@
+"""Remaining functional ops for parity (affine_grid/grid_sample, diag_embed,
+margin_cross_entropy, gather_tree, inplace aliases...)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1,
+                                       keepdims=keepdim), 1.0 / p), x, y)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        # move the two new dims to dim1/dim2
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return out.transpose(perm)
+
+    return apply_op(f, input)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    from ..layer.extras import HSigmoidLoss
+
+    layer = HSigmoidLoss.__new__(HSigmoidLoss)
+    from ..layer_base import Layer
+
+    Layer.__init__(layer)
+    layer.num_classes = num_classes
+    layer.is_custom = path_table is not None
+    layer.weight = weight
+    layer.bias = bias
+    if not layer.is_custom:
+        import numpy as np
+
+        n_nodes = num_classes - 1
+        depth = max(int(math.ceil(math.log2(num_classes))), 1)
+        table = np.full((num_classes, depth), -1, np.int32)
+        codes = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + n_nodes
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for d, (nid, code) in enumerate(reversed(path)):
+                if d < depth and nid < n_nodes:
+                    table[c, d] = nid
+                    codes[c, d] = code
+        layer._table = jnp.asarray(table)
+        layer._codes = jnp.asarray(codes)
+    return layer.forward(input, label, path_table, path_code)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-style margin softmax (ref margin_cross_entropy op)."""
+
+    def f(z, lbl):
+        lbl_i = lbl.astype(jnp.int32)
+        theta = jnp.arccos(jnp.clip(z, -1 + 1e-7, 1 - 1e-7))
+        target_theta = margin1 * theta + margin2
+        target_logit = jnp.cos(target_theta) - margin3
+        onehot = jax.nn.one_hot(lbl_i, z.shape[-1], dtype=z.dtype)
+        adj = z * (1 - onehot) + target_logit[..., None] * 0  # placeholder
+        tgt = jnp.take_along_axis(target_logit, lbl_i[:, None], 1) \
+            if False else None
+        mod = jnp.where(onehot > 0, jnp.cos(margin1 * theta + margin2) - margin3, z)
+        logits_s = mod * scale
+        logp = jax.nn.log_softmax(logits_s, -1)
+        loss = -jnp.take_along_axis(logp, lbl_i[:, None], 1)[:, 0]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(logits_s, -1)
+        return loss
+
+    return apply_op(f, logits, label)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref gather_tree op). ids/parents: (T, B, beam)."""
+
+    def f(idv, par):
+        T = idv.shape[0]
+        idv = idv.astype(jnp.int32)
+        par = par.astype(jnp.int32)
+
+        def step(carry, t):
+            beams = carry  # (B, beam) current beam indices
+            tok = jnp.take_along_axis(idv[t], beams, axis=-1)
+            new_beams = jnp.take_along_axis(par[t], beams, axis=-1)
+            return new_beams, tok
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2]), idv.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, 0).astype(jnp.int64)
+
+    return apply_op(f, ids, parents)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Ref affine_grid op: 2D affine θ (N,2,3) → sampling grid (N,H,W,2)."""
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in out_shape]
+
+    def f(th):
+        N, _, H, W = shape
+
+        def lin(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            return (jnp.arange(n) * 2 + 1) / n - 1
+
+        ys = lin(H)
+        xs = lin(W)
+        gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # (HW, 3)
+        out = jnp.einsum("nij,pj->npi", th, base)  # (N, HW, 2)
+        return out.reshape(N, H, W, 2)
+
+    return apply_op(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    """Ref grid_sample op: sample NCHW input at grid (N,H,W,2) in [-1,1]."""
+
+    def f(v, g):
+        N, C, H, W = v.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1) / 2 * (size - 1)
+            return ((coord + 1) * size - 1) / 2
+
+        gx = unnorm(g[..., 0], W)
+        gy = unnorm(g[..., 1], H)
+
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            gx = jnp.abs(jnp.mod(gx, 2 * (W - 1)) - (W - 1)) if W > 1 else gx * 0
+            gy = jnp.abs(jnp.mod(gy, 2 * (H - 1)) - (H - 1)) if H > 1 else gy * 0
+
+        if mode == "nearest":
+            xi = jnp.round(gx).astype(jnp.int32)
+            yi = jnp.round(gy).astype(jnp.int32)
+            valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+            xi = jnp.clip(xi, 0, W - 1)
+            yi = jnp.clip(yi, 0, H - 1)
+            out = v[jnp.arange(N)[:, None, None], :, yi, xi]
+            out = jnp.where(valid[..., None], out, 0.0)
+            return jnp.moveaxis(out, -1, 1)
+
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = gx - x0
+        wy = gy - y0
+
+        def sample(xi, yi):
+            valid = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+            xi_c = jnp.clip(xi, 0, W - 1)
+            yi_c = jnp.clip(yi, 0, H - 1)
+            out = v[jnp.arange(N)[:, None, None], :, yi_c, xi_c]  # (N,h,w,C)
+            return jnp.where(valid[..., None], out, 0.0)
+
+        v00 = sample(x0, y0)
+        v01 = sample(x1, y0)
+        v10 = sample(x0, y1)
+        v11 = sample(x1, y1)
+        top = v00 * (1 - wx)[..., None] + v01 * wx[..., None]
+        bot = v10 * (1 - wx)[..., None] + v11 * wx[..., None]
+        out = top * (1 - wy)[..., None] + bot * wy[..., None]
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_op(f, x, grid)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+    from ..layer.extras import MaxUnPool1D
+
+    return MaxUnPool1D(kernel_size, stride, padding, data_format, output_size)(
+        x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    from ..layer.extras import MaxUnPool3D
+
+    return MaxUnPool3D(kernel_size, stride, padding, data_format, output_size)(
+        x, indices)
+
+
+def sparse_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "sparse_attention: use flash attention (dense blockwise beats the "
+        "reference's CUDA block-sparse op on TPU) or ring attention for long "
+        "sequences")
+
+
+def rnnt_loss(*args, **kwargs):
+    raise NotImplementedError("rnnt_loss: planned (lattice scan)")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-style API, out of scope")
+
+
+# in-place activation aliases
+def relu_(x, name=None):
+    x._value = jax.nn.relu(x.value)
+    return x
+
+
+def elu_(x, alpha=1.0, name=None):
+    x._value = jax.nn.elu(x.value, alpha)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._value = jax.nn.softmax(x.value, axis=axis)
+    return x
+
+
+def tanh_(x, name=None):
+    x._value = jnp.tanh(x.value)
+    return x

