@@ -128,12 +128,48 @@ def stream_guard(stream):
     return _guard()
 
 
+def memory_stats(device=None) -> dict:
+    """Device memory statistics (ref memory/stats.h) via PJRT."""
+    try:
+        d = jax.devices()[0]
+        return dict(d.memory_stats() or {})
+    except (RuntimeError, AttributeError):
+        return {}
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(memory_stats().get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats().get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    return int(memory_stats().get("bytes_limit", 0))
+
+
+def memory_reserved(device=None) -> int:
+    return int(memory_stats().get("bytes_reserved",
+                                  memory_stats().get("bytes_in_use", 0)))
+
+
+def empty_cache():
+    pass
+
+
 class cuda:
-    """paddle.device.cuda shim — reports no CUDA (we are a TPU build)."""
+    """paddle.device.cuda shim — reports no CUDA (we are a TPU build); the
+    memory-stat APIs report the TPU's PJRT stats so monitoring code ports."""
 
     @staticmethod
     def device_count():
         return 0
 
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_reserved = staticmethod(memory_reserved)
+    empty_cache = staticmethod(empty_cache)
     Stream = Stream
     Event = Event

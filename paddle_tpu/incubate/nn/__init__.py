@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import functional  # noqa: F401
+
 from ...nn import functional as F
 from ...nn.initializer import Constant
 from ...nn.layer_base import Layer

@@ -1,0 +1,151 @@
+"""incubate.nn.functional fused ops (ref: python/paddle/incubate/nn/functional/
+— fused_multi_head_attention, fused_feedforward, fused_matmul_bias,
+fused_linear, fused_multi_transformer).
+
+On TPU "fused" = one jnp composition XLA fuses + the Pallas attention core.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply_op
+from ...nn import functional as F
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, op_name="matmul")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_linear_activation(x, weight, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = fused_matmul_bias(x, weight, bias, trans_x, trans_y)
+    return getattr(F, activation)(out)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, name=None):
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    out = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = fused_matmul_bias(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    out = residual + out
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """Ref fused_attention_op.cu capability as one composition."""
+    from ...tensor.manipulation import reshape
+
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    B, S, H = x.shape[0], x.shape[1], x.shape[2]
+    # qkv_weight: ref layout (3, num_heads, head_dim, embed) or (embed, 3*embed)
+    if len(qkv_weight.shape) == 4:
+        nh = qkv_weight.shape[1]
+        hd = qkv_weight.shape[2]
+
+        def qkv_f(v, w, *b):
+            out = jnp.einsum("bse,khde->bskhd", v, w)
+            if b:
+                out = out + b[0].reshape(3, nh, hd)
+            return out
+
+        args = [x, qkv_weight] + ([qkv_bias] if qkv_bias is not None else [])
+        qkv = apply_op(qkv_f, *args)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        nh = num_heads or 8
+        hd = H // nh
+        qkv = fused_matmul_bias(x, qkv_weight, qkv_bias)
+        qkv = reshape(qkv, [B, S, 3, nh, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate if training
+                                         else 0.0, training=training)
+    out = reshape(out, [B, S, -1])
+    out = fused_matmul_bias(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None,
+                                           ln_bias=None, dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode="upscale_in_train", name=None):
+    out = x if bias is None else x + bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    out = residual + out
+    if ln_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_rms_norm(x, weight, epsilon=1e-6):
+    from ...ops.fused_norm import fused_rms_norm as _k
+
+    return apply_op(lambda v, w: _k(v, w, epsilon), x, weight)
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5):
+    from ...ops.fused_norm import fused_layer_norm as _k
+
+    return apply_op(lambda v, w, b: _k(v, w, b, epsilon), x, weight, bias)
+
+
+def fused_ec_moe(x, gate_weight, expert_w1, expert_b1, expert_w2, expert_b2,
+                 act_type="gelu"):
+    """Ref fused_ec_moe op — dense top-1 MoE FFN."""
+
+    def f(v, gw, w1, b1, w2, b2):
+        B, S, H = v.shape
+        flat = v.reshape(-1, H)
+        probs = jax.nn.softmax(flat @ gw, -1)
+        top = jnp.argmax(probs, -1)
+        topw = jnp.take_along_axis(probs, top[:, None], 1)
+        oh = jax.nn.one_hot(top, gw.shape[-1], dtype=v.dtype)
+        buckets = jnp.einsum("te,td->etd", oh, flat)
+        act = jax.nn.gelu if act_type == "gelu" else jax.nn.relu
+        h = act(jnp.einsum("etd,edh->eth", buckets, w1) + b1[:, None])
+        out_e = jnp.einsum("eth,ehd->etd", h, w2) + b2[:, None]
+        out = jnp.einsum("te,etd->td", oh, out_e) * topw
+        return out.reshape(B, S, H)
+
+    return apply_op(f, x, gate_weight, expert_w1, expert_b1, expert_w2, expert_b2)

@@ -175,6 +175,54 @@ def not_to_static(fn):
     return fn
 
 
+# ---------------------------------------------------------------------------
+# Structured control flow for compiled code — the replacement for the
+# reference's dy2static AST transformers (ref dy2static/*_transformer.py):
+# instead of rewriting Python if/while into conditional_block/while ops, user
+# code calls these directly (lax.cond / lax.while_loop / lax.scan on Tensors).
+# ---------------------------------------------------------------------------
+
+
+def cond(pred, true_fn, false_fn, *operands):
+    """paddle.static.nn.cond capability (traceable branch select)."""
+    import jax
+
+    pred_v = pred.value if isinstance(pred, Tensor) else pred
+    ops = _unwrap(operands)
+    out = jax.lax.cond(pred_v, lambda o: _unwrap(true_fn(*_wrap(o))),
+                       lambda o: _unwrap(false_fn(*_wrap(o))), ops)
+    return _wrap(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """paddle.static.nn.while_loop capability."""
+    import jax
+
+    init = _unwrap(loop_vars)
+
+    def c(vals):
+        out = cond_fn(*_wrap(vals))
+        return out.value if isinstance(out, Tensor) else out
+
+    def b(vals):
+        return _unwrap(body_fn(*_wrap(vals)))
+
+    out = jax.lax.while_loop(c, b, init)
+    return _wrap(out)
+
+
+def scan(body_fn, init, xs, length=None):
+    """lax.scan over Tensors: body_fn(carry, x) -> (carry, y)."""
+    import jax
+
+    def b(carry, x):
+        c2, y = body_fn(_wrap(carry), _wrap(x))
+        return _unwrap(c2), _unwrap(y)
+
+    carry, ys = jax.lax.scan(b, _unwrap(init), _unwrap(xs), length=length)
+    return _wrap(carry), _wrap(ys)
+
+
 def ignore_module(modules):
     pass
 

@@ -160,23 +160,25 @@ class LlamaAttention(Layer):
                 ck, cvv = cache_vals
                 kr = jnp.concatenate([ck, kr], axis=1)
                 vv = jnp.concatenate([cvv, vv], axis=1)
-            # GQA: expand kv heads to q heads
-            rep = self.num_heads // self.num_kv_heads
-            if rep > 1:
-                kr = jnp.repeat(kr, rep, axis=2)
-                vv = jnp.repeat(vv, rep, axis=2)
             causal = cache_vals == ()
+            rep = self.num_heads // self.num_kv_heads
+            from ..ops.flash_attention import flash_attention_bshd
+
             if self.cfg.context_parallel:
                 from ..parallel.ring_attention import ring_attention_bshd
 
                 try:
-                    return ring_attention_bshd(qr, kr, vv, "context", causal=causal)
+                    kx = jnp.repeat(kr, rep, axis=2) if rep > 1 else kr
+                    vx = jnp.repeat(vv, rep, axis=2) if rep > 1 else vv
+                    return ring_attention_bshd(qr, kx, vx, "context", causal=causal)
                 except NameError:
                     pass
-            from ..ops.flash_attention import flash_attention_bshd
-
             if self.cfg.use_flash_attention:
+                # GQA handled inside the kernel (no KV repeat)
                 return flash_attention_bshd(qr, kr, vv, causal=causal)
+            if rep > 1:
+                kr = jnp.repeat(kr, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
             d = qr.shape[-1]
             logits = jnp.einsum("bshd,bthd->bhst", qr, kr).astype(jnp.float32) \
                 / math.sqrt(d)

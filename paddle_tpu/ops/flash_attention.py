@@ -26,7 +26,12 @@ NEG_INF = -1e30
 
 
 def _ref_bhsd(q, k, v, causal: bool, scale: float):
-    """Reference composition, (B, H, S, D) layout, fp32 softmax."""
+    """Reference composition, (B, H, S, D) layout, fp32 softmax. GQA: k/v may
+    have Hkv | H heads."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     logits = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
@@ -86,23 +91,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
 
 def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
                     block_k: int = 128):
+    """GQA-native: k/v may have fewer heads (Hkv | Hq); the kv BlockSpec
+    index map routes each q head to its shared kv head — zero HBM copies
+    (the reference materializes repeated KV; ref fmha_ref.h)."""
     from jax.experimental import pallas as pl
 
     B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
     Sk = k.shape[2]
     bq = min(block_q, Sq)
     bk = min(block_k, Sk)
     q_r = q.reshape(B * H, Sq, D)
-    k_r = k.reshape(B * H, Sk, D)
-    v_r = v.reshape(B * H, Sk, D)
+    k_r = k.reshape(B * Hkv, Sk, D)
+    v_r = v.reshape(B * Hkv, Sk, D)
+
+    def kv_index(b, i):
+        return (b // H) * Hkv + (b % H) // rep, 0, 0
+
     grid = (B * H, Sq // bq)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk, seq_k=Sk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), kv_index),
+            pl.BlockSpec((1, Sk, D), kv_index),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
@@ -139,7 +153,9 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None):
-    """Paddle head layout (B, S, H, D) wrapper."""
+    """Paddle head layout (B, S, H, D) wrapper. GQA-aware: k/v may carry
+    fewer heads (Hkv | Hq) — the kernel routes q heads to shared kv heads via
+    its index map, no repeat."""
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
