@@ -128,30 +128,55 @@ def stream_guard(stream):
     return _guard()
 
 
+def _resolve_jax_device(device=None):
+    """None | int | 'tpu:3'/'gpu:1'/'xpu:0' | jax.Device → a jax.Device."""
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        plat, _, idx = device.partition(":")
+        if plat == "cpu":
+            try:
+                pool = jax.devices("cpu")
+            except RuntimeError:
+                pool = jax.devices()
+        else:
+            # shim convention: 'gpu'/'xpu'/'tpu' all mean "the accelerator"
+            # (Tensor.cuda() is likewise a no-op on the TPU array)
+            pool = jax.devices()
+        return pool[int(idx) if idx else 0]
+    return device  # already a jax.Device
+
+
 def memory_stats(device=None) -> dict:
-    """Device memory statistics (ref memory/stats.h) via PJRT."""
+    """Per-device memory statistics (ref memory/stats.h) via PJRT."""
     try:
-        d = jax.devices()[0]
+        d = _resolve_jax_device(device)
         return dict(d.memory_stats() or {})
-    except (RuntimeError, AttributeError):
+    except (RuntimeError, AttributeError, IndexError, ValueError):
         return {}
 
 
 def max_memory_allocated(device=None) -> int:
-    return int(memory_stats().get("peak_bytes_in_use", 0))
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
 
 
 def memory_allocated(device=None) -> int:
-    return int(memory_stats().get("bytes_in_use", 0))
+    return int(memory_stats(device).get("bytes_in_use", 0))
 
 
 def max_memory_reserved(device=None) -> int:
-    return int(memory_stats().get("bytes_limit", 0))
+    # PJRT has no allocator-reservation counter distinct from usage; the peak
+    # in-use high-water mark is the closest honest analogue (NOT bytes_limit,
+    # which is the constant device capacity).
+    stats = memory_stats(device)
+    return int(stats.get("peak_bytes_reserved", stats.get("peak_bytes_in_use", 0)))
 
 
 def memory_reserved(device=None) -> int:
-    return int(memory_stats().get("bytes_reserved",
-                                  memory_stats().get("bytes_in_use", 0)))
+    stats = memory_stats(device)
+    return int(stats.get("bytes_reserved", stats.get("bytes_in_use", 0)))
 
 
 def empty_cache():

@@ -17,6 +17,7 @@ from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .launch_util import spawn  # noqa: F401
 

@@ -53,11 +53,11 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
                            segment_size=2 ** 20, sync_comm=False):
-    """Ref python/paddle/distributed/sharding/group_sharded.py entry.
+    """Ref python/paddle/distributed/sharding/group_sharded.py entry; real
+    implementation in paddle_tpu.distributed.sharding."""
+    from ...sharding import group_sharded_parallel as _impl
 
-    Returns (model, optimizer, scaler); the sharded execution itself is
-    engaged by running the model through ParallelEngine(fsdp=True) (compiled)
-    — eager multi-chip ZeRO has no TPU analogue because a single process
-    addresses all chips.
-    """
-    return model, optimizer, scaler
+    return _impl(model, optimizer, level=level, scaler=scaler, group=group,
+                 offload=offload, sync_buffers=sync_buffers,
+                 buffer_max_size=buffer_max_size, segment_size=segment_size,
+                 sync_comm=sync_comm)

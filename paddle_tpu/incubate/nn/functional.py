@@ -88,7 +88,13 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
         qkv = apply_op(qkv_f, *args)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     else:
-        nh = num_heads or 8
+        if num_heads is None:
+            raise ValueError(
+                "fused_multi_head_attention: num_heads is required when "
+                "qkv_weight is 2-D (the head split cannot be inferred)")
+        nh = num_heads
+        if H % nh:
+            raise ValueError(f"embed dim {H} not divisible by num_heads {nh}")
         hd = H // nh
         qkv = fused_matmul_bias(x, qkv_weight, qkv_bias)
         qkv = reshape(qkv, [B, S, 3, nh, hd])

@@ -66,6 +66,38 @@ def _filter_spec(spec: P, mesh: Mesh) -> P:
     return P(*parts)
 
 
+def auto_shard_spec(shape, axis_size: int, axis: str = "sharding",
+                    min_size: int = 1024, allow_uneven: bool = False) -> P:
+    """Canonical ZeRO layout policy (ref group_sharded_stage3.py:60 even
+    param split): lay the largest axis-size-divisible dim over ``axis``;
+    tiny arrays stay replicated. Shared by ParallelEngine (fsdp) and
+    distributed.sharding so eager and compiled ZeRO agree.
+
+    ``allow_uneven``: jit in/out shardings tolerate ragged splits (XLA pads),
+    so callers that only feed specs to jit may pass True; eager
+    ``jax.device_put`` rejects them, hence the safe default False."""
+    shape = tuple(shape)
+    size = 1
+    for s in shape:
+        size *= s
+    if axis_size <= 1 or not shape or size < min_size:
+        return P()
+    for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        if shape[i] % axis_size == 0 and shape[i] >= axis_size:
+            parts = [None] * len(shape)
+            parts[i] = axis
+            return P(*parts)
+    # no evenly-divisible dim: still shard the largest (GSPMD pads the ragged
+    # tail) — replicating e.g. a [50257] vocab row would be a memory regression
+    if allow_uneven:
+        best = max(range(len(shape)), key=lambda i: shape[i])
+        if shape[best] >= axis_size:
+            parts = [None] * len(shape)
+            parts[best] = axis
+            return P(*parts)
+    return P()
+
+
 def shard_constraint(x, spec: P):
     """Annotate intermediate sharding; identity outside SPMD tracing."""
     mesh = current_mesh()

@@ -23,31 +23,22 @@ from jax.sharding import PartitionSpec as P
 
 from ..framework.core import Parameter, Tensor, no_grad_ctx
 from ..jit import functional_call, state_values
-from .api import _filter_spec, mesh_context
-
-
-def _auto_fsdp_spec(name: str, arr, axis: str = "sharding", min_size: int = 1024) -> P:
-    """ZeRO-3-style: shard the largest dim over the sharding axis when the
-    param is big enough and divisible (ref group_sharded_stage3.py:60 —
-    param sharding with fwd allgather, which GSPMD emits automatically)."""
-    if arr.size < min_size:
-        return P()
-    shape = arr.shape
-    if not shape:
-        return P()
-    best = int(np.argmax(shape))
-    parts = [None] * len(shape)
-    parts[best] = axis
-    return P(*parts)
+from .api import _filter_spec, auto_shard_spec, mesh_context
 
 
 def param_specs(model, mesh: Mesh, fsdp: bool = False, fsdp_axis: str = "sharding"
                 ) -> Dict[str, P]:
+    """fsdp=True applies the canonical ZeRO-3 layout policy, shared with
+    distributed.sharding (ref group_sharded_stage3.py:60 — param sharding
+    with fwd allgather, which GSPMD emits automatically). Even splits only:
+    these specs are applied eagerly via device_put in _build_state."""
+    axis_size = mesh.shape[fsdp_axis] if fsdp_axis in mesh.axis_names else 1
     specs: Dict[str, P] = {}
     for name, p in model.named_parameters():
         spec = getattr(p, "pspec", None)
         if spec is None:
-            spec = _auto_fsdp_spec(name, p.value, fsdp_axis) if fsdp else P()
+            spec = (auto_shard_spec(p.value.shape, axis_size, axis=fsdp_axis)
+                    if fsdp else P())
         specs[name] = _filter_spec(spec, mesh)
     for name, b in model.named_buffers():
         specs[name] = P()
