@@ -1,0 +1,139 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ...tensor.manipulation import chunk, concat
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, ChannelShuffle, Conv2D, Flatten, Linear,
+                   MaxPool2D, ReLU, Sequential, Swish)
+from ...nn.layer_base import Layer
+
+
+def _conv_bn_act(inp, oup, k, stride, padding, groups=1, act=ReLU):
+    layers = [Conv2D(inp, oup, k, stride=stride, padding=padding, groups=groups,
+                     bias_attr=False), BatchNorm2D(oup)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_channels, out_channels, stride, act=ReLU):
+        super().__init__()
+        self._stride = stride
+        branch_features = out_channels // 2
+        self._conv_pw = _conv_bn_act(in_channels // 2, branch_features, 1, 1, 0, act=act)
+        self._conv_dw = _conv_bn_act(branch_features, branch_features, 3, stride, 1,
+                                     groups=branch_features, act=None)
+        self._conv_linear = _conv_bn_act(branch_features, branch_features, 1, 1, 0, act=act)
+        self._shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        x1, x2 = chunk(x, 2, axis=1)
+        out = concat([x1, self._conv_linear(self._conv_dw(self._conv_pw(x2)))], axis=1)
+        return self._shuffle(out)
+
+
+class InvertedResidualDS(Layer):
+    """Downsampling variant: both branches convolve, stride 2."""
+
+    def __init__(self, in_channels, out_channels, stride, act=ReLU):
+        super().__init__()
+        branch_features = out_channels // 2
+        self._conv_dw_1 = _conv_bn_act(in_channels, in_channels, 3, stride, 1,
+                                       groups=in_channels, act=None)
+        self._conv_linear_1 = _conv_bn_act(in_channels, branch_features, 1, 1, 0, act=act)
+        self._conv_pw_2 = _conv_bn_act(in_channels, branch_features, 1, 1, 0, act=act)
+        self._conv_dw_2 = _conv_bn_act(branch_features, branch_features, 3, stride, 1,
+                                       groups=branch_features, act=None)
+        self._conv_linear_2 = _conv_bn_act(branch_features, branch_features, 1, 1, 0, act=act)
+        self._shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        x1 = self._conv_linear_1(self._conv_dw_1(x))
+        x2 = self._conv_linear_2(self._conv_dw_2(self._conv_pw_2(x)))
+        return self._shuffle(concat([x1, x2], axis=1))
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        act_layer = Swish if act == "swish" else ReLU
+        if scale == 0.25:
+            stage_out = [-1, 24, 24, 48, 96, 512]
+        elif scale == 0.33:
+            stage_out = [-1, 24, 32, 64, 128, 512]
+        elif scale == 0.5:
+            stage_out = [-1, 24, 48, 96, 192, 1024]
+        elif scale == 1.0:
+            stage_out = [-1, 24, 116, 232, 464, 1024]
+        elif scale == 1.5:
+            stage_out = [-1, 24, 176, 352, 704, 1024]
+        elif scale == 2.0:
+            stage_out = [-1, 24, 244, 488, 976, 2048]
+        else:
+            raise NotImplementedError(f"unsupported scale {scale}")
+
+        self._conv1 = _conv_bn_act(3, stage_out[1], 3, 2, 1, act=act_layer)
+        self._max_pool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        blocks = []
+        in_c = stage_out[1]
+        for stage_id, num_repeat in enumerate(stage_repeats):
+            out_c = stage_out[stage_id + 2]
+            for i in range(num_repeat):
+                if i == 0:
+                    blocks.append(InvertedResidualDS(in_c, out_c, 2, act=act_layer))
+                else:
+                    blocks.append(InvertedResidual(out_c, out_c, 1, act=act_layer))
+            in_c = out_c
+        self._blocks = Sequential(*blocks)
+        self._last_conv = _conv_bn_act(in_c, stage_out[-1], 1, 1, 0, act=act_layer)
+        if with_pool:
+            self._pool2d_avg = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self._fc = Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self._max_pool(self._conv1(x))
+        x = self._last_conv(self._blocks(x))
+        if self.with_pool:
+            x = self._pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self._fc(self._flatten(x))
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled; load via state_dict")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

@@ -1,5 +1,12 @@
-"""Vision ops (ref: python/paddle/vision/ops.py — roi_align, nms,
-deform_conv2d, box utilities)."""
+"""Vision ops (ref: python/paddle/vision/ops.py — roi_align/roi_pool/psroi_pool,
+nms/matrix_nms, deform_conv2d, box utilities).
+
+TPU-native notes: RoI ops are dense bilinear gathers (vmap over RoIs);
+deform_conv2d is bilinear sampling + one big einsum so the contraction lands
+on the MXU (the reference's deformable_conv_op.cu im2col+gemm, re-expressed
+for XLA). NMS variants are host-side (dynamic output shapes), matching the
+reference's eager semantics.
+"""
 from __future__ import annotations
 
 import jax
@@ -8,6 +15,20 @@ import numpy as np
 
 from ..framework.core import Tensor, to_array
 from ..framework.dispatch import apply_op
+from ..nn.initializer import Uniform
+from ..nn.layer_base import Layer
+
+
+# ---------------------------------------------------------------- NMS family
+
+def _np_iou_matrix(b):
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    xx1 = np.maximum(b[:, None, 0], b[None, :, 0])
+    yy1 = np.maximum(b[:, None, 1], b[None, :, 1])
+    xx2 = np.minimum(b[:, None, 2], b[None, :, 2])
+    yy2 = np.minimum(b[:, None, 3], b[None, :, 3])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    return inter / (areas[:, None] + areas[None, :] - inter + 1e-10)
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
@@ -16,41 +37,317 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
     b = np.asarray(to_array(boxes))
     s = np.asarray(to_array(scores)) if scores is not None else np.arange(
         len(b), 0, -1, dtype=np.float32)
-    order = np.argsort(-s)
-    keep = []
-    suppressed = np.zeros(len(b), bool)
-    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    for i_ in order:
-        if suppressed[i_]:
-            continue
-        keep.append(i_)
-        xx1 = np.maximum(b[i_, 0], b[:, 0])
-        yy1 = np.maximum(b[i_, 1], b[:, 1])
-        xx2 = np.minimum(b[i_, 2], b[:, 2])
-        yy2 = np.minimum(b[i_, 3], b[:, 3])
-        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
-        iou = inter / (areas[i_] + areas - inter + 1e-10)
-        suppressed |= iou > iou_threshold
-        suppressed[i_] = True
+
+    def _single(idxs):
+        bb, ss = b[idxs], s[idxs]
+        order = np.argsort(-ss)
+        keep = []
+        suppressed = np.zeros(len(bb), bool)
+        iou = _np_iou_matrix(bb)
+        for i_ in order:
+            if suppressed[i_]:
+                continue
+            keep.append(idxs[i_])
+            suppressed |= iou[i_] > iou_threshold
+            suppressed[i_] = True
+        return keep
+
+    if category_idxs is None:
+        keep = _single(np.arange(len(b)))
+    else:
+        cats = np.asarray(to_array(category_idxs))
+        keep = []
+        for c in (categories if categories is not None else np.unique(cats)):
+            keep.extend(_single(np.nonzero(cats == int(c))[0]))
+        keep.sort(key=lambda i_: -s[i_])
     keep = np.asarray(keep, np.int64)
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(jnp.asarray(keep))
 
 
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0, nms_top_k=400,
+               keep_top_k=200, use_gaussian=False, gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — decayed scores instead of hard suppression.
+    Ref: paddle/phi/kernels/cpu/matrix_nms_kernel.cc; host-side here."""
+    bxs = np.asarray(to_array(bboxes))  # [N, M, 4]
+    scs = np.asarray(to_array(scores))  # [N, C, M]
+    out, out_idx, rois_num = [], [], []
+    for n in range(bxs.shape[0]):
+        dets = []
+        for c in range(scs.shape[1]):
+            if c == background_label:
+                continue
+            s = scs[n, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            sel = sel[np.argsort(-s[sel])][:nms_top_k]
+            bb, ss = bxs[n, sel], s[sel]
+            iou = _np_iou_matrix(bb)
+            # iou_max[j] = max IoU of box j with any higher-scored box
+            low = np.tril(iou, -1)
+            iou_max = np.concatenate([[0.0], low[1:, :].max(axis=1) if len(bb) > 1
+                                      else np.zeros(0)])
+            if use_gaussian:
+                decay_m = np.exp((iou_max[None, :] ** 2 - iou ** 2) * gaussian_sigma)
+            else:
+                decay_m = (1 - iou) / (1 - iou_max[None, :] + 1e-10)
+            # decay for box i = min(1, min_{j<i} decay(iou_ij, iou_max_j))
+            decay_m = np.where(np.tril(np.ones_like(iou), -1) > 0, decay_m, 1.0)
+            decay = np.minimum(decay_m.min(axis=1), 1.0)
+            ds = ss * decay
+            for j in range(len(sel)):
+                if ds[j] > post_threshold:
+                    dets.append((c, ds[j], bb[j], n * scs.shape[2] + sel[j]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        rois_num.append(len(dets))
+        for c, sc, bb, gi in dets:
+            out.append([c, sc, *bb])
+            out_idx.append(gi)
+    out_t = Tensor(jnp.asarray(np.asarray(out, np.float32).reshape(-1, 6)))
+    res = [out_t]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(out_idx, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out_t
+
+
+# ------------------------------------------------------------- box utilities
+
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0):
-    raise NotImplementedError("box_coder: planned")
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes vs priors (ref phi box_coder kernel)."""
+    def f(pb, tb, pbv=None):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[..., 2] - pb[..., 0] + norm
+        ph = pb[..., 3] - pb[..., 1] + norm
+        pcx = pb[..., 0] + pw * 0.5
+        pcy = pb[..., 1] + ph * 0.5
+        if pbv is None:
+            pbv = jnp.ones(4, pb.dtype)
+        if code_type == "encode_center_size":
+            tw = tb[..., 2] - tb[..., 0] + norm
+            th = tb[..., 3] - tb[..., 1] + norm
+            tcx = tb[..., 0] + tw * 0.5
+            tcy = tb[..., 1] + th * 0.5
+            out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                             jnp.log(tw[:, None] / pw[None, :]),
+                             jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            return out / jnp.broadcast_to(pbv, out.shape)
+        # decode_center_size: target [N, M, 4] deltas vs priors broadcast on `axis`
+        d = tb * jnp.broadcast_to(pbv, tb.shape)
+        exp = (lambda v: jnp.expand_dims(v, axis=axis))
+        dcx = d[..., 0] * exp(pw) + exp(pcx)
+        dcy = d[..., 1] * exp(ph) + exp(pcy)
+        dw = jnp.exp(d[..., 2]) * exp(pw)
+        dh = jnp.exp(d[..., 3]) * exp(ph)
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], axis=-1)
+
+    if prior_box_var is None:
+        return apply_op(f, prior_box, target_box)
+    return apply_op(f, prior_box, target_box, prior_box_var)
 
 
-def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
-              aligned=True, name=None):
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,), variance=(0.1,
+              0.1, 0.2, 0.2), flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (ref phi prior_box kernel)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        sz = float(np.sqrt(ms * max_sizes[k]))
+                        cell.append((cx, cy, sz, sz))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                    if max_sizes:
+                        sz = float(np.sqrt(ms * max_sizes[k]))
+                        cell.append((cx, cy, sz, sz))
+            boxes.extend(cell)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    out = np.stack([(arr[..., 0] - arr[..., 2] / 2) / iw, (arr[..., 1] - arr[..., 3] / 2) / ih,
+                    (arr[..., 0] + arr[..., 2] / 2) / iw, (arr[..., 1] + arr[..., 3] / 2) / ih],
+                   axis=-1)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio, clip_bbox=True,
+             name=None, scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes/scores (ref phi yolo_box kernel)."""
+    def f(xv, imgs):
+        n, _, h, w = xv.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+        sig = jax.nn.sigmoid
+        ioup = None
+        if iou_aware:
+            # layout per GetIoUIndex: first na channels are IoU maps, rest regular
+            ioup, xv = xv[:, :na], xv[:, na:]
+        xv = xv.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=xv.dtype)[None, :]
+        gy = jnp.arange(h, dtype=xv.dtype)[:, None]
+        bx = (sig(xv[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gx) / w
+        by = (sig(xv[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + gy) / h
+        bw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / (w * downsample_ratio)
+        bh = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / (h * downsample_ratio)
+        conf = sig(xv[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * sig(ioup) ** iou_aware_factor
+        probs = sig(xv[:, :, 5:]) * conf[:, :, None]
+        conf_mask = (conf > conf_thresh).astype(xv.dtype)
+        imgh = imgs[:, 0].astype(xv.dtype)[:, None, None, None]
+        imgw = imgs[:, 1].astype(xv.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, -1, 4)
+        scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(n, -1, class_num)
+        return boxes, scores
+
+    return apply_op(f, x, img_size, n_outputs=2)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (host-side; ref phi
+    distribute_fpn_proposals kernel)."""
+    rois = np.asarray(to_array(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0] + off) *
+                               (rois[:, 3] - rois[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    # per-RoI image id (for per-image counts per level, ref MultiLevelRoIsNum)
+    if rois_num is not None:
+        rn = np.asarray(to_array(rois_num)).astype(np.int64)
+        img_ids = np.repeat(np.arange(len(rn)), rn)
+    else:
+        rn, img_ids = None, None
+    outs, idxs, res_num = [], [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+        if rn is not None:
+            per_img = np.bincount(img_ids[sel], minlength=len(rn)).astype(np.int32)
+            res_num.append(Tensor(jnp.asarray(per_img)))
+    order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
+    restore = Tensor(jnp.asarray(np.argsort(order).astype(np.int32)))
+    return outs, restore, (res_num if rn is not None else None)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (host-side pipeline: decode→clip→filter→NMS).
+    Ref phi generate_proposals_v2 kernel."""
+    sc = np.asarray(to_array(scores))          # [N, A, H, W]
+    bd = np.asarray(to_array(bbox_deltas))     # [N, 4A, H, W]
+    im = np.asarray(to_array(img_size))        # [N, 2]
+    an = np.asarray(to_array(anchors)).reshape(-1, 4)
+    va = np.asarray(to_array(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_num = [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, v = s[order], d[order], an[order], va[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000. / 16))) * aw
+        bh = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000. / 16))) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - off, cy + bh / 2 - off], 1)
+        ih, iw = im[i, 0], im[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = np.nonzero((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                          (boxes[:, 3] - boxes[:, 1] + off >= min_size))[0]
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            iou = _np_iou_matrix(boxes)
+            order2 = np.argsort(-s)
+            sup = np.zeros(len(boxes), bool)
+            kept = []
+            for j in order2:
+                if sup[j]:
+                    continue
+                kept.append(j)
+                if len(kept) >= post_nms_top_n:
+                    break
+                sup |= iou[j] > nms_thresh
+                sup[j] = True
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes)
+        all_num.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0) if all_rois else
+                              np.zeros((0, 4), np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(all_num, np.int32)))
+    if return_rois_num:
+        return rois, nums
+    return rois
+
+
+# ---------------------------------------------------------------- RoI family
+
+def _roi_batch_ids(boxes_num, n_rois):
+    if boxes_num is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    bn = np.asarray(to_array(boxes_num)).astype(np.int64)
+    return jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign via bilinear gather (XLA-friendly dense gather)."""
     os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,
                                                                       output_size)
+    batch_ids = _roi_batch_ids(boxes_num, int(boxes.shape[0]))
 
     def f(feat, rois):
-        n_rois = rois.shape[0]
         oh, ow = os_
         offset = 0.5 if aligned else 0.0
 
@@ -78,17 +375,215 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_rati
             bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
             return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
 
-        batch_ids = jnp.zeros((n_rois,), jnp.int32)
         return jax.vmap(one_roi)(rois, batch_ids)
 
     return apply_op(f, x, boxes)
 
 
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, name=None):
+    """RoIPool: max over quantized bins (ref phi roi_pool kernel)."""
+    os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,
+                                                                      output_size)
+    batch_ids = _roi_batch_ids(boxes_num, int(boxes.shape[0]))
+
+    def f(feat, rois):
+        oh, ow = os_
+        _, _, H, W = feat.shape
+
+        def one_roi(roi, batch_idx):
+            x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1) / oh
+            rw = jnp.maximum(x2 - x1 + 1, 1) / ow
+            fm = feat[batch_idx]
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            hstart = jnp.clip(y1 + jnp.floor(jnp.arange(oh) * rh).astype(jnp.int32), 0, H)
+            hend = jnp.clip(y1 + jnp.ceil((jnp.arange(oh) + 1) * rh).astype(jnp.int32), 0, H)
+            wstart = jnp.clip(x1 + jnp.floor(jnp.arange(ow) * rw).astype(jnp.int32), 0, W)
+            wend = jnp.clip(x1 + jnp.ceil((jnp.arange(ow) + 1) * rw).astype(jnp.int32), 0, W)
+            hm = (ys >= hstart[:, None]) & (ys < hend[:, None])        # [oh, H]
+            wm = (xs >= wstart[:, None]) & (xs < wend[:, None])        # [ow, W]
+            m = hm[:, None, :, None] & wm[None, :, None, :]            # [oh,ow,H,W]
+            vals = jnp.where(m[None], fm[:, None, None, :, :],
+                             jnp.asarray(-jnp.inf, feat.dtype))
+            out = vals.max(axis=(-1, -2))
+            empty = ~m.any(axis=(-1, -2))
+            return jnp.where(empty[None], jnp.zeros((), feat.dtype), out)
+
+        return jax.vmap(one_roi)(rois, batch_ids)
+
+    return apply_op(f, x, boxes)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (R-FCN; ref phi psroi_pool kernel).
+    Input channels must equal C_out * oh * ow; bin (i,j) averages channel slice."""
+    os_ = output_size if isinstance(output_size, (list, tuple)) else (output_size,
+                                                                      output_size)
+    batch_ids = _roi_batch_ids(boxes_num, int(boxes.shape[0]))
+
+    def f(feat, rois):
+        oh, ow = os_
+        N, C, H, W = feat.shape
+        c_out = C // (oh * ow)
+
+        def one_roi(roi, batch_idx):
+            x1 = roi[0] * spatial_scale
+            y1 = roi[1] * spatial_scale
+            x2 = roi[2] * spatial_scale
+            y2 = roi[3] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1) / oh
+            rw = jnp.maximum(x2 - x1, 0.1) / ow
+            fm = feat[batch_idx].reshape(c_out, oh, ow, H, W)
+            ys = jnp.arange(H, dtype=feat.dtype)[None, :]
+            xs = jnp.arange(W, dtype=feat.dtype)[None, :]
+            hstart = jnp.floor(y1 + jnp.arange(oh) * rh)
+            hend = jnp.ceil(y1 + (jnp.arange(oh) + 1) * rh)
+            wstart = jnp.floor(x1 + jnp.arange(ow) * rw)
+            wend = jnp.ceil(x1 + (jnp.arange(ow) + 1) * rw)
+            hm = (ys >= hstart[:, None]) & (ys < hend[:, None])
+            wm = (xs >= wstart[:, None]) & (xs < wend[:, None])
+            m = (hm[:, None, :, None] & wm[None, :, None, :]).astype(feat.dtype)
+            s = jnp.einsum("cijhw,ijhw->cij", fm, m)
+            cnt = jnp.maximum(m.sum(axis=(-1, -2)), 1.0)
+            return s / cnt
+
+        return jax.vmap(one_roi)(rois, batch_ids)
+
+    return apply_op(f, x, boxes)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size, self._spatial_scale,
+                         aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size, self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size, self._spatial_scale)
+
+
+# ----------------------------------------------------------- deformable conv
+
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
                   deformable_groups=1, groups=1, mask=None, name=None):
-    raise NotImplementedError(
-        "deform_conv2d: planned as a Pallas gather kernel (ref deformable_conv_op.cu)")
+    """Deformable conv v1/v2 (ref deformable_conv_op.cu im2col+gemm), expressed
+    as bilinear gathers + einsum so the contraction runs on the MXU.
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo];
+    mask (v2): [N, dg*kh*kw, Ho, Wo]; weight: [Cout, Cin/groups, kh, kw].
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xv, off, w, *rest):
+        msk = rest[0] if mask is not None else None
+        N, Cin, H, W = xv.shape
+        Cout, _, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+        Wo = (W + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+        K = kh * kw
+        dg = deformable_groups
+        # base sampling grid [K, Ho, Wo]
+        base_y = (jnp.arange(Ho) * s[0] - p[0])[None, :, None] + \
+            (jnp.arange(kh) * d[0]).repeat(kw)[:, None, None]
+        base_x = (jnp.arange(Wo) * s[1] - p[1])[None, None, :] + \
+            jnp.tile(jnp.arange(kw) * d[1], kh)[:, None, None]
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        sy = base_y[None, None].astype(xv.dtype) + off[:, :, :, 0]
+        sx = base_x[None, None].astype(xv.dtype) + off[:, :, :, 1]
+
+        def sample(fm, yy, xx):
+            # fm: [Cg, H, W]; yy/xx: [K, Ho, Wo] → [Cg, K, Ho, Wo]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy = yy - y0
+            wx = xx - x0
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+
+            def tap(yi, xi):
+                valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                v = fm[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                return v * valid[None].astype(fm.dtype)
+
+            return (tap(y0i, x0i) * ((1 - wy) * (1 - wx))[None] +
+                    tap(y0i, x0i + 1) * ((1 - wy) * wx)[None] +
+                    tap(y0i + 1, x0i) * (wy * (1 - wx))[None] +
+                    tap(y0i + 1, x0i + 1) * (wy * wx)[None])
+
+        xg = xv.reshape(N, dg, Cin // dg, H, W)
+        cols = jax.vmap(jax.vmap(sample))(xg, sy, sx)      # [N, dg, Cg, K, Ho, Wo]
+        cols = cols.reshape(N, Cin, K, Ho, Wo)
+        if msk is not None:
+            m = msk.reshape(N, dg, K, Ho, Wo)
+            m = jnp.repeat(m, Cin // dg, axis=1).reshape(N, Cin, K, Ho, Wo)
+            cols = cols * m
+        cols = cols.reshape(N, groups, Cin // groups, K, Ho, Wo)
+        wg = w.reshape(groups, Cout // groups, Cin // groups, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", cols, wg,
+                         preferred_element_type=jnp.float32).astype(xv.dtype)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if bias is not None and mask is None and len(rest) == 1:
+            out = out + rest[0][None, :, None, None]
+        elif bias is not None and mask is not None and len(rest) == 2:
+            out = out + rest[1][None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args)
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError("generate_proposals: detection pipeline op, planned")
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        k = float(np.sqrt(1.0 / fan_in))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks],
+            default_initializer=Uniform(-k, k))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True,
+                                           default_initializer=Uniform(-k, k)))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._deformable_groups,
+                             self._groups, mask)

@@ -1,0 +1,223 @@
+"""Vision models + ops tests (ref test strategy: OpTest numpy references for
+ops in unittests/test_roi_pool_op.py etc.; model forward smoke à la
+python/paddle/tests/test_vision_models.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision import ops as V
+
+
+class TestModelFamilies:
+    @pytest.mark.parametrize("name,ctor", [
+        ("alexnet", lambda: M.alexnet(num_classes=10)),
+        ("mobilenet_v1", lambda: M.mobilenet_v1(scale=0.25, num_classes=10)),
+        ("mobilenet_v3_small", lambda: M.mobilenet_v3_small(scale=0.5, num_classes=10)),
+        ("mobilenet_v3_large", lambda: M.mobilenet_v3_large(scale=0.5, num_classes=10)),
+        ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=10)),
+        ("shufflenet_v2_x0_25", lambda: M.shufflenet_v2_x0_25(num_classes=10)),
+        ("shufflenet_v2_swish", lambda: M.shufflenet_v2_swish(num_classes=10)),
+        ("densenet121", lambda: M.densenet121(num_classes=10)),
+    ])
+    def test_forward_64(self, name, ctor):
+        m = ctor()
+        m.eval()
+        out = m(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 10]
+
+    def test_squeezenet_feature_extractor(self):
+        m = M.squeezenet1_1(num_classes=0, with_pool=False)
+        m.eval()
+        out = m(paddle.randn([1, 3, 64, 64]))
+        assert out.shape[1] == 512 and len(out.shape) == 4
+
+    def test_inception_v3(self):
+        m = M.inception_v3(num_classes=7)
+        m.eval()
+        assert m(paddle.randn([1, 3, 96, 96])).shape == [1, 7]
+
+    def test_googlenet_aux_heads(self):
+        m = M.googlenet(num_classes=5)
+        m.eval()
+        out, out1, out2 = m(paddle.randn([1, 3, 224, 224]))
+        assert out.shape == [1, 5] and out1.shape == [1, 5] and out2.shape == [1, 5]
+
+
+class TestRoIOps:
+    def test_roi_align_constant(self):
+        x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+        out = V.roi_align(x, boxes, output_size=4)
+        assert out.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-6)
+
+    def test_roi_pool_max(self):
+        fm = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        x = paddle.to_tensor(fm)
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 3.0, 3.0]], np.float32))
+        out = V.roi_pool(x, boxes, output_size=2)
+        # quantized bins [0,2)x[0,2) etc. → maxes 5,7,13,15
+        np.testing.assert_allclose(out.numpy().reshape(4), [5, 7, 13, 15])
+
+    def test_psroi_pool_constant(self):
+        # C = c_out * oh * ow = 2*2*2 = 8
+        x = paddle.to_tensor(np.full((1, 8, 8, 8), 2.5, np.float32))
+        boxes = paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+        out = V.psroi_pool(x, boxes, output_size=2)
+        assert out.shape == [1, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), 2.5, rtol=1e-6)
+
+    def test_roi_batch_routing(self):
+        x = paddle.to_tensor(np.stack([np.full((1, 4, 4), 1.0, np.float32),
+                                       np.full((1, 4, 4), 9.0, np.float32)]))
+        boxes = paddle.to_tensor(np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32))
+        bn = paddle.to_tensor(np.array([1, 1], np.int32))
+        out = V.roi_align(x, boxes, boxes_num=bn, output_size=1)
+        np.testing.assert_allclose(out.numpy().reshape(2), [1.0, 9.0], rtol=1e-6)
+
+
+class TestNMSFamily:
+    def test_nms_suppresses_overlap(self):
+        boxes = paddle.to_tensor(np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                                           [50, 50, 60, 60]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = V.nms(boxes, 0.5, scores)
+        assert sorted(keep.numpy().tolist()) == [0, 2]
+
+    def test_nms_categories(self):
+        boxes = paddle.to_tensor(np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+        cats = paddle.to_tensor(np.array([0, 1], np.int64))
+        keep = V.nms(boxes, 0.5, scores, category_idxs=cats, categories=[0, 1])
+        assert sorted(keep.numpy().tolist()) == [0, 1]  # different class → both kept
+
+    def test_matrix_nms(self):
+        bxs = paddle.to_tensor(np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                                          [40, 40, 50, 50]]], np.float32))
+        scs = paddle.to_tensor(np.array([[[0.1, 0.05, 0.02],
+                                          [0.9, 0.85, 0.7]]], np.float32))
+        out, idx, num = V.matrix_nms(bxs, scs, score_threshold=0.1, post_threshold=0.0,
+                                     background_label=0, return_index=True)
+        assert int(num.numpy()[0]) == 3
+        assert out.shape[1] == 6
+        o = out.numpy()
+        assert float(o[0, 1]) == pytest.approx(0.9)  # top score first, undecayed
+        # heavily-overlapping 2nd box must be decayed: 0.85 * (1-iou)/(1-0)
+        b = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        inter = 9.0 * 9.0
+        iou = inter / (100 + 100 - inter)
+        decayed = min(o[:, 1].tolist())
+        assert decayed == pytest.approx(0.85 * (1 - iou), rel=1e-4)
+        # far-away box is not decayed
+        assert pytest.approx(0.7, rel=1e-5) in o[:, 1].tolist()
+
+
+class TestBoxOps:
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.abs(rng.rand(5, 4).astype(np.float32))
+        priors[:, 2:] += priors[:, :2] + 0.5
+        targets = np.abs(rng.rand(3, 4).astype(np.float32))
+        targets[:, 2:] += targets[:, :2] + 0.5
+        enc = V.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(targets),
+                          code_type="encode_center_size")
+        assert enc.shape == [3, 5, 4]
+        dec = V.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(enc.numpy()),
+                          code_type="decode_center_size", axis=0)
+        # decoding the encoding of target j vs prior i recovers target j
+        np.testing.assert_allclose(dec.numpy()[0, 0], targets[0], rtol=1e-4, atol=1e-5)
+
+    def test_prior_box(self):
+        x = paddle.randn([1, 8, 4, 4])
+        img = paddle.randn([1, 3, 32, 32])
+        boxes, var = V.prior_box(x, img, min_sizes=[8.0], aspect_ratios=[2.0], flip=True,
+                                 clip=True)
+        assert boxes.shape == [4, 4, 3, 4]
+        assert var.shape == [4, 4, 3, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_yolo_box_shapes(self):
+        x = paddle.randn([2, 3 * 7, 4, 4])  # anchors=3, classes=2 → 5+2 per anchor
+        img = paddle.to_tensor(np.array([[32, 32], [32, 32]], np.int32))
+        boxes, scores = V.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                                   conf_thresh=0.01, downsample_ratio=8)
+        assert boxes.shape == [2, 48, 4]
+        assert scores.shape == [2, 48, 2]
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 120, 120], [0, 0, 500, 500]], np.float32)
+        outs, restore, num = V.distribute_fpn_proposals(paddle.to_tensor(rois), 2, 5, 4, 224)
+        assert len(outs) == 4 and num is None
+        total = sum(int(o.shape[0]) for o in outs)
+        assert total == 3
+        assert sorted(restore.numpy().tolist()) == [0, 1, 2]
+
+    def test_distribute_fpn_proposals_rois_num(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 500, 500], [0, 0, 10, 10]], np.float32)
+        outs, restore, num = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224,
+            rois_num=paddle.to_tensor(np.array([2, 1], np.int32)))
+        # per-level counts are per image (shape [batch])
+        assert all(n.shape == [2] for n in num)
+        lvl2 = num[0].numpy()  # small rois land on min level
+        np.testing.assert_array_equal(lvl2, [1, 1])
+        np.testing.assert_array_equal(num[-1].numpy(), [1, 0])
+
+    def test_yolo_box_iou_aware(self):
+        # C = na*(6+cls) with first na channels the IoU maps
+        x = paddle.randn([1, 3 * 9 + 3, 2, 2])
+        img = paddle.to_tensor(np.array([[16, 16]], np.int32))
+        boxes, scores = V.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23], class_num=4,
+                                   conf_thresh=0.0, downsample_ratio=8, iou_aware=True,
+                                   iou_aware_factor=0.5)
+        assert boxes.shape == [1, 12, 4]
+        assert scores.shape == [1, 12, 4]
+
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(0)
+        scores = paddle.to_tensor(rng.rand(1, 3, 4, 4).astype(np.float32))
+        deltas = paddle.to_tensor(0.1 * rng.randn(1, 12, 4, 4).astype(np.float32))
+        anchors = paddle.to_tensor(np.tile(np.array([[0, 0, 16, 16]], np.float32),
+                                           (48, 1)).reshape(4, 4, 3, 4) +
+                                   rng.rand(4, 4, 3, 4).astype(np.float32) * 4)
+        var = paddle.to_tensor(np.ones((4, 4, 3, 4), np.float32))
+        img = paddle.to_tensor(np.array([[64.0, 64.0]], np.float32))
+        rois, num = V.generate_proposals(scores, deltas, img, anchors, var,
+                                         pre_nms_top_n=12, post_nms_top_n=5,
+                                         return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(num.numpy()[0]) == rois.shape[0] <= 5
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w))
+        ref = nn.functional.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_mask_and_layer(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 4, 6, 6).astype(np.float32))
+        layer = V.DeformConv2D(4, 8, 3, padding=1)
+        off = paddle.to_tensor(0.1 * rng.randn(2, 18, 6, 6).astype(np.float32))
+        mask = paddle.to_tensor(rng.rand(2, 9, 6, 6).astype(np.float32))
+        out = layer(x, off, mask)
+        assert out.shape == [2, 8, 6, 6]
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32))
+        layer = V.DeformConv2D(2, 3, 3)
+        off = paddle.to_tensor(np.zeros((1, 18, 3, 3), np.float32))
+        out = layer(x, off)
+        out.sum().backward()
+        assert layer.weight.grad is not None
+        assert float(np.abs(layer.weight.grad.numpy()).sum()) > 0
