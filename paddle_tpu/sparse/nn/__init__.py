@@ -1,0 +1,128 @@
+"""paddle.sparse.nn (ref: python/paddle/sparse/nn/ — sparse layers)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from ...nn.initializer import Uniform
+from . import functional
+from .functional import (relu, relu6, leaky_relu, softmax, conv3d, subm_conv3d,
+                         max_pool3d, attention)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return softmax(x, self._axis)
+
+
+class _Conv3DBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, subm=False, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        assert data_format == "NDHWC", "sparse conv3d is NDHWC (channels-last) only"
+        ks = ((kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size))
+        self._kernel_size = ks
+        self._stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self._padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        self._dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+        self._groups = groups
+        self._subm = subm
+        fan_in = in_channels * int(np.prod(ks))
+        k = float(np.sqrt(1.0 / fan_in))
+        # kernel layout [kd, kh, kw, in, out] (ref sparse conv3d kernel layout)
+        self.weight = self.create_parameter([*ks, in_channels // groups, out_channels],
+                                            default_initializer=Uniform(-k, k))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], is_bias=True,
+                                           default_initializer=Uniform(-k, k)))
+
+    def forward(self, x):
+        if self._subm:
+            return subm_conv3d(x, self.weight, self.bias, self._stride, self._padding,
+                               self._dilation, self._groups)
+        return conv3d(x, self.weight, self.bias, self._stride, self._padding,
+                      self._dilation, self._groups)
+
+
+class Conv3D(_Conv3DBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, False, padding_mode, weight_attr, bias_attr,
+                         data_format)
+
+
+class SubmConv3D(_Conv3DBase):
+    """Submanifold conv: output sites == input sites (ref sparse subm_conv3d)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", key=None, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, True, padding_mode, weight_attr, bias_attr,
+                         data_format)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC"):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride if stride is not None else kernel_size
+        self._padding = padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._kernel_size, self._stride, self._padding)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the nse values' channel dim (ref sparse/nn/layer/norm.py:
+    normalizes only active sites)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC", use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum, epsilon=epsilon,
+                               weight_attr=weight_attr, bias_attr=bias_attr)
+
+    def forward(self, x):
+        vals = x.values()
+        out = self._bn(vals)
+        return x._replace_values(out.value if hasattr(out, "value") else out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """On TPU, batch norm inside pjit already reduces across the data mesh axis
+    (GSPMD inserts the cross-replica psum) — identical semantics to the
+    reference's SyncBatchNorm (ref sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer

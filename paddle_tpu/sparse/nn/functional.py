@@ -1,0 +1,194 @@
+"""paddle.sparse.nn.functional (ref: python/paddle/sparse/nn/functional/).
+
+Sparse conv/pool run as dense XLA ops over the densified voxel grid, then
+re-sparsify (see package docstring for the TPU rationale). All compute goes
+through apply_op so autograd reaches layer parameters. Activations are
+structure-preserving and run on the nse value vector.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+
+def relu(x, name=None):
+    from .. import _is_sparse, _tape_values, _with_values
+
+    if _is_sparse(x):
+        return _with_values(x, apply_op(jax.nn.relu, _tape_values(x)))
+    from ...nn.functional import relu as _relu
+
+    return _relu(x)
+
+
+def relu6(x, name=None):
+    from .. import _is_sparse, _tape_values, _with_values
+
+    if _is_sparse(x):
+        return _with_values(x, apply_op(lambda v: jnp.clip(v, 0, 6), _tape_values(x)))
+    from ...nn.functional import relu6 as _relu6
+
+    return _relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .. import _is_sparse, _tape_values, _with_values
+
+    if _is_sparse(x):
+        return _with_values(x, apply_op(
+            lambda v: jax.nn.leaky_relu(v, negative_slope), _tape_values(x)))
+    from ...nn.functional import leaky_relu as _lr
+
+    return _lr(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored entries of each row (ref phi sparse softmax:
+    only non-zero entries participate). Rows are all-but-last sparse dims."""
+    from .. import _is_sparse, _tape_values, _with_values
+
+    if not _is_sparse(x):
+        from ...nn.functional import softmax as _sm
+
+        return _sm(x, axis)
+    n_sparse = x._bcoo.indices.shape[1]
+    assert axis in (-1, len(x._bcoo.shape) - 1), \
+        "sparse softmax supports the last axis only (like the reference)"
+    idx = np.asarray(x._bcoo.indices)
+    if n_sparse == 1:
+        seg = np.zeros(idx.shape[0], np.int32)
+        n_seg = 1
+    else:
+        # composite row key over all sparse dims except the last
+        row_dims = idx[:, :-1]
+        shape = np.asarray(x._bcoo.shape[:n_sparse - 1], np.int64)
+        seg = np.ravel_multi_index(tuple(row_dims.T), tuple(shape)).astype(np.int32)
+        n_seg = int(np.prod(shape))
+    seg_j = jnp.asarray(seg)
+
+    def f(vals):
+        row_max = jax.ops.segment_max(vals, seg_j, num_segments=n_seg)
+        ex = jnp.exp(vals - row_max[seg_j])
+        denom = jax.ops.segment_sum(ex, seg_j, num_segments=n_seg)
+        return ex / denom[seg_j]
+
+    return _with_values(x, apply_op(f, _tape_values(x)))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d: dense XLA conv over the voxel grid, re-sparsified.
+    x: SparseCooTensor [N, D, H, W, C]; weight [kd, kh, kw, Cin/g, Cout]."""
+    from .. import _coo_from_dense_tensor
+
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    d = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+
+    def f(dense, w, *b):
+        out = jax.lax.conv_general_dilated(
+            dense, w, window_strides=s, padding=[(pi, pi) for pi in p], rhs_dilation=d,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"), feature_group_count=groups)
+        if b:
+            out = out + b[0]
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    out = apply_op(f, *args, op_name="sparse_conv3d")
+    return _coo_from_dense_tensor(out, n_dense=1)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+                data_format="NDHWC", key=None, name=None):
+    """Submanifold conv3d (ref sparse subm_conv3d): conv with the given
+    stride/padding, output restricted to the input's active sites (mapped
+    through the same window when strided)."""
+    from .. import SparseCooTensor, _adopt_tape
+
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dil = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    ks = tuple(int(k) for k in to_array(weight).shape[:3])
+
+    def f(dense, w, *b):
+        out = jax.lax.conv_general_dilated(
+            dense, w, window_strides=s, padding=[(pi, pi) for pi in p],
+            rhs_dilation=dil, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            feature_group_count=groups)
+        if b:
+            out = out + b[0]
+        # active-site mask, pushed through the same window geometry
+        active = (dense != 0).any(axis=-1, keepdims=True).astype(out.dtype)
+        act_out = jax.lax.reduce_window(
+            active, jnp.zeros((), active.dtype), jax.lax.max,
+            window_dimensions=(1, *ks, 1),
+            window_strides=(1, *s, 1),
+            padding=[(0, 0), *[(pi, pi) for pi in p], (0, 0)],
+            window_dilation=(1, *dil, 1))
+        if s == (1, 1, 1) and all(pi == (dil_ * (k - 1)) // 2
+                                  for pi, k, dil_ in zip(p, ks, dil)):
+            # true submanifold case: exactly the input's sites
+            act_out = active
+        return jnp.where(act_out > 0, out, jnp.zeros((), out.dtype))
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    out = apply_op(f, *args, op_name="sparse_subm_conv3d")
+    from jax.experimental import sparse as jsparse
+
+    return _adopt_tape(SparseCooTensor(jsparse.BCOO.fromdense(out.value, n_dense=1),
+                                       out.stop_gradient), out)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    from .. import _coo_from_dense_tensor
+
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                    else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+
+    def f(dense):
+        out = jax.lax.reduce_window(
+            dense, -jnp.inf, jax.lax.max, window_dimensions=(1, *ks, 1),
+            window_strides=(1, *st, 1),
+            padding=[(0, 0), *[(pi, pi) for pi in p], (0, 0)])
+        return jnp.where(jnp.isfinite(out), out, jnp.zeros((), dense.dtype))
+
+    out = apply_op(f, x, op_name="sparse_max_pool3d")
+    return _coo_from_dense_tensor(out, n_dense=1)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None, attn_mask=None,
+              name=None):
+    """Sparse-masked scaled-dot-product attention (ref
+    sparse/nn/functional/transformer.py). The sparse mask gives the attended
+    pattern; key_padding_mask [B, S] and attn_mask [S, S] apply additively like
+    the reference. Computed densely (flash-attention covers the dense path)."""
+    def f(q, k, v, m, *extra):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+        i = 0
+        if key_padding_mask is not None:
+            kp = extra[i]
+            i += 1
+            scores = scores + kp[:, None, None, :]
+        if attn_mask is not None:
+            scores = scores + extra[i][None, None, :, :]
+        neg = jnp.asarray(-1e9, scores.dtype)
+        scores = jnp.where(m != 0, scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        probs = jnp.where(m != 0, probs, 0.0)
+        return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+    mask_dense = sparse_mask.to_dense() if hasattr(sparse_mask, "to_dense") else sparse_mask
+    args = [query, key, value, mask_dense]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply_op(f, *args, op_name="sparse_attention")

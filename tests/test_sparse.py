@@ -1,0 +1,270 @@
+"""paddle.sparse tests (ref test strategy: numpy-reference per op, à la
+unittests/test_sparse_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def make_coo():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        s = make_coo()
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        assert s.nnz() == 3
+        assert s.is_sparse_coo() and not s.is_sparse_csr()
+        np.testing.assert_array_equal(s.indices().numpy(), [[0, 1, 2], [1, 2, 0]])
+        np.testing.assert_allclose(s.values().numpy(), [1, 2, 3])
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        values = [1, 2, 3, 4, 5]
+        s = sparse.sparse_csr_tensor(crows, cols, values, [3, 4], dtype="float32")
+        assert s.is_sparse_csr()
+        dense = np.zeros((3, 4), np.float32)
+        dense[0, 1], dense[0, 3], dense[1, 2], dense[2, 0], dense[2, 1] = 1, 2, 3, 4, 5
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        np.testing.assert_array_equal(s.crows().numpy(), crows)
+        np.testing.assert_array_equal(s.cols().numpy(), cols)
+
+    def test_dense_to_sparse_and_back(self):
+        x = paddle.to_tensor(np.array([[0, 1.5], [0, 0]], np.float32))
+        coo = sparse.to_sparse_coo(x)
+        assert coo.nnz() == 1
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), x.numpy())
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), x.numpy())
+
+    def test_coalesce(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], shape=[2, 2])
+        c = sparse.coalesce(s)
+        assert c.nnz() == 1
+        np.testing.assert_allclose(c.values().numpy(), [3.0])
+
+
+class TestUnary:
+    @pytest.mark.parametrize("name,ref", [
+        ("sin", np.sin), ("tanh", np.tanh), ("sqrt", np.sqrt), ("square", np.square),
+        ("log1p", np.log1p), ("abs", np.abs), ("neg", np.negative), ("expm1", np.expm1),
+    ])
+    def test_structure_preserving(self, name, ref):
+        s = make_coo()
+        out = getattr(sparse, name)(s)
+        assert out.nnz() == 3  # zeros stay implicit
+        np.testing.assert_allclose(out.values().numpy(), ref(np.array([1.0, 2.0, 3.0])),
+                                   rtol=1e-6)
+
+    def test_pow_cast(self):
+        s = make_coo()
+        np.testing.assert_allclose(sparse.pow(s, 2).values().numpy(), [1, 4, 9])
+        c = sparse.cast(s, value_dtype="float64")
+        assert "float64" in str(c.values().numpy().dtype)
+
+
+class TestBinary:
+    def test_spmm(self):
+        s = make_coo()
+        d = paddle.to_tensor(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        out = sparse.matmul(s, d)
+        np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ d.numpy(),
+                                   rtol=1e-5)
+
+    def test_mv(self):
+        s = make_coo()
+        v = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(sparse.mv(s, v).numpy(),
+                                   s.to_dense().numpy() @ v.numpy(), rtol=1e-6)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 5).astype(np.float32)
+        y = rng.randn(5, 3).astype(np.float32)
+        mask = sparse.to_sparse_csr(paddle.to_tensor(
+            np.array([[1, 0, 0], [0, 1, 0], [0, 0, 1]], np.float32)))
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        full = x @ y
+        np.testing.assert_allclose(out.to_dense().numpy(), np.diag(np.diag(full)),
+                                   rtol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.RandomState(2)
+        inp = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+        s = make_coo()
+        y = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+        out = sparse.addmm(inp, s, y, beta=0.5, alpha=2.0)
+        ref = 0.5 * inp.numpy() + 2.0 * (s.to_dense().numpy() @ y.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_add_multiply(self):
+        a = make_coo()
+        b = make_coo()
+        out = sparse.add(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(), 2 * a.to_dense().numpy())
+        out = sparse.multiply(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(), a.to_dense().numpy() ** 2)
+
+    def test_transpose_reshape_is_same_shape(self):
+        s = make_coo()
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(), s.to_dense().numpy().T)
+        r = sparse.reshape(s, [1, 9])
+        assert list(r.shape) == [1, 9]
+        assert sparse.is_same_shape(s, t)  # 3x3 both
+
+
+class TestSparseNN:
+    def test_relu_values(self):
+        s = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0], shape=[2, 2])
+        out = sparse.nn.functional.relu(s)
+        np.testing.assert_allclose(out.values().numpy(), [0.0, 2.0])
+        layer_out = sparse.nn.ReLU()(s)
+        np.testing.assert_allclose(layer_out.values().numpy(), [0.0, 2.0])
+
+    def test_softmax_rows(self):
+        # two rows with different nnz; softmax over stored entries per row
+        s = sparse.sparse_coo_tensor([[0, 0, 1], [0, 2, 1]], [1.0, 3.0, 5.0],
+                                     shape=[2, 3])
+        out = sparse.nn.functional.softmax(s)
+        v = out.values().numpy()
+        e = np.exp([1.0, 3.0])
+        np.testing.assert_allclose(v[:2], e / e.sum(), rtol=1e-6)
+        np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+    def _voxels(self):
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+        sites = [(0, 1, 1, 1), (0, 2, 2, 2), (0, 3, 0, 1)]
+        for b, d, h, w in sites:
+            dense[b, d, h, w] = rng.randn(2)
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+
+        from paddle_tpu.sparse import SparseCooTensor
+
+        return SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1)), dense
+
+    def test_conv3d(self):
+        import jax
+
+        x, dense = self._voxels()
+        conv = sparse.nn.Conv3D(2, 4, kernel_size=3, padding=1)
+        out = conv(x)
+        # reference: dense conv over the same grid
+        ref = jax.lax.conv_general_dilated(
+            dense, np.asarray(conv.weight.numpy()), (1, 1, 1),
+            [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        ref = ref + conv.bias.numpy()
+        np.testing.assert_allclose(out.to_dense().numpy(), np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_subm_conv3d_preserves_sites(self):
+        x, dense = self._voxels()
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1, bias_attr=False)
+        out = conv(x)
+        out_active = (np.abs(out.to_dense().numpy()) > 0).any(axis=-1)
+        in_active = (np.abs(dense) > 0).any(axis=-1)
+        assert (out_active <= in_active).all()  # no dilation of the active set
+
+    def test_subm_conv3d_strided_shape(self):
+        x, dense = self._voxels()
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, stride=2, padding=1,
+                                    bias_attr=False)
+        out = conv(x)
+        assert list(out.shape) == [1, 2, 2, 2, 3]  # stride honored
+
+    def test_max_pool3d(self):
+        x, dense = self._voxels()
+        out = sparse.nn.MaxPool3D(kernel_size=2)(x)
+        assert list(out.shape) == [1, 2, 2, 2, 2]
+        ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6)
+
+    def test_batch_norm(self):
+        x, dense = self._voxels()
+        bn = sparse.nn.BatchNorm(2)
+        bn.eval()
+        out = bn(x)
+        assert out.nnz() == x.nnz()
+
+    def test_conv3d_grads_flow(self):
+        x, dense = self._voxels()
+        conv = sparse.nn.Conv3D(2, 4, kernel_size=3, padding=1)
+        out = conv(x)
+        loss = out.to_dense().sum()
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+        assert conv.bias.grad is not None
+
+    def test_subm_conv3d_grads_and_values_tape(self):
+        x, dense = self._voxels()
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(x)
+        # loss through values() must also reach the weights
+        out.values().sum().backward()
+        assert conv.weight.grad is not None
+        assert float(np.abs(conv.weight.grad.numpy()).sum()) > 0
+
+    def test_divide_union_pattern(self):
+        a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [4.0, 9.0], shape=[3, 3])
+        b = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], shape=[3, 3])
+        out = sparse.divide(a, b)
+        d = out.to_dense().numpy()
+        assert np.isfinite(d).all()  # no NaN at implicit-zero positions
+        np.testing.assert_allclose(d[0, 0], 2.0)
+        np.testing.assert_allclose(d[1, 1], 3.0)
+        assert out.nnz() == 2
+
+    def test_sparse_sparse_matmul_returns_sparse(self):
+        a = make_coo()
+        b = make_coo()
+        out = sparse.matmul(a, b)
+        assert out.is_sparse_coo()
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   a.to_dense().numpy() @ b.to_dense().numpy(),
+                                   rtol=1e-6)
+
+    def test_masked_matmul_grads(self):
+        rng = np.random.RandomState(4)
+        x = paddle.to_tensor(rng.randn(3, 5).astype(np.float32), stop_gradient=False)
+        y = paddle.to_tensor(rng.randn(5, 3).astype(np.float32), stop_gradient=False)
+        mask = sparse.to_sparse_csr(paddle.to_tensor(np.eye(3, dtype=np.float32)))
+        out = sparse.masked_matmul(x, y, mask)
+        out.values().sum().backward()
+        assert x.grad is not None and float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_softmax_3d(self):
+        # [2, 2, 3] COO, softmax groups by (dim0, dim1)
+        idx = [[0, 0, 1], [0, 0, 1], [0, 2, 1]]
+        s = sparse.sparse_coo_tensor(idx, [1.0, 3.0, 5.0], shape=[2, 2, 3])
+        v = sparse.nn.functional.softmax(s).values().numpy()
+        e = np.exp([1.0, 3.0])
+        np.testing.assert_allclose(v[:2], e / e.sum(), rtol=1e-6)
+        np.testing.assert_allclose(v[2], 1.0, rtol=1e-6)
+
+    def test_cast_crows_dtype(self):
+        s = sparse.sparse_csr_tensor([0, 1, 2], [0, 1], [1.0, 2.0], [2, 2])
+        c = sparse.cast(s, index_dtype="int32")
+        assert "int32" in str(c.crows().numpy().dtype)
+        assert "int32" in str(c.cols().numpy().dtype)
+
+    def test_attention(self):
+        rng = np.random.RandomState(3)
+        q = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(1, 1, 4, 8).astype(np.float32))
+        mask = paddle.to_tensor(np.tril(np.ones((1, 1, 4, 4), np.float32)))
+        out = sparse.nn.functional.attention(q, k, v, mask)
+        assert out.shape == [1, 1, 4, 8]
+        # first query attends only to first key
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], v.numpy()[0, 0, 0], rtol=1e-5)
