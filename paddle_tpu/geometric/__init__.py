@@ -113,3 +113,64 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
         out_count.append(len(neigh))
     return (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else np.zeros(0))),
             Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from x[src] ⊕ y[dst] (ref geometric/message_passing/send_recv.py
+    send_uv)."""
+
+    def f(xv, yv, src, dst):
+        xs = jnp.take(xv, src.astype(jnp.int32), axis=0)
+        yd = jnp.take(yv, dst.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            return xs + yd
+        if message_op == "sub":
+            return xs - yd
+        if message_op == "mul":
+            return xs * yd
+        if message_op == "div":
+            return xs / yd
+        raise ValueError(message_op)
+
+    return apply_op(f, x, y, src_index, dst_index)
+
+
+def _reindex(x_np, neighbor_list, count_list):
+    """Shared reindex core: nodes = unique(x ++ neighbors), x first by first
+    occurrence; edges (neighbor → repeated center) relabeled."""
+    all_ids = np.concatenate([x_np] + neighbor_list)
+    uniq, first_pos = np.unique(all_ids, return_index=True)
+    out_nodes = all_ids[np.sort(first_pos)]
+    lut = {int(v): i for i, v in enumerate(out_nodes)}
+    reindex_src = np.asarray([lut[int(v)] for v in np.concatenate(neighbor_list)],
+                             np.int64) if neighbor_list else np.zeros(0, np.int64)
+    dst = np.concatenate([np.repeat(x_np, c) for c in count_list]) \
+        if count_list else np.zeros(0, np.int64)
+    reindex_dst = np.asarray([lut[int(v)] for v in dst], np.int64)
+    return reindex_src, reindex_dst, out_nodes
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """Relabel sampled subgraph node ids to 0..n-1 (ref geometric/reindex.py:24).
+    Host-side (dynamic output shapes — eager only)."""
+    x_np = np.asarray(to_array(x)).astype(np.int64)
+    nb = np.asarray(to_array(neighbors)).astype(np.int64)
+    cnt = np.asarray(to_array(count)).astype(np.int64)
+    src, dst, out = _reindex(x_np, [nb], [cnt])
+    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), Tensor(jnp.asarray(out))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                        name=None):
+    """Heterogeneous variant: lists of neighbors/count per edge type
+    (ref geometric/reindex.py reindex_heter_graph)."""
+    x_np = np.asarray(to_array(x)).astype(np.int64)
+    nbs = [np.asarray(to_array(n)).astype(np.int64) for n in neighbors]
+    cnts = [np.asarray(to_array(c)).astype(np.int64) for c in count]
+    src, dst, out = _reindex(x_np, nbs, cnts)
+    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), Tensor(jnp.asarray(out))
+
+
+__all__ = ['send_u_recv', 'send_ue_recv', 'send_uv', 'segment_sum', 'segment_mean',
+           'segment_min', 'segment_max', 'reindex_graph', 'reindex_heter_graph',
+           'sample_neighbors']

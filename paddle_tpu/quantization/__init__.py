@@ -83,12 +83,19 @@ class QuantedLinear(Layer):
 
 
 class QAT:
-    """Quantization-aware training transform (ref quantization/qat.py)."""
+    """Quantization-aware training transform (ref quantization/qat.py).
+    Accepts a QuantConfig (reference API) or a simple {"bits": n} dict."""
 
-    def __init__(self, config: Optional[dict] = None):
-        self.config = config or {"bits": 8}
+    def __init__(self, config=None):
+        self.config = config if config is not None else {"bits": 8}
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        if not isinstance(self.config, dict):
+            return QATv2(self.config).quantize(model, inplace=True)
         from ..nn.layer.common import Linear
 
         bits = self.config.get("bits", 8)
@@ -140,3 +147,234 @@ class PTQ:
                 q, s = quantize_absmax(p, self.config.get("bits", 8))
                 out[name] = (q, s)
         return out
+
+
+# --------------------------------------------------------------------------
+# Reference-shaped config/quanter architecture (ref quantization/config.py,
+# base_quanter.py, factory.py, quanters/abs_max.py)
+
+class BaseQuanter(Layer):
+    """A quanter is a Layer that simulates quantization in forward and
+    exposes its scales (ref base_quanter.py)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class QuanterFactory:
+    """Partial holding quanter kwargs; instantiated per wrapped layer
+    (ref factory.py ObserverFactory/QuanterFactory)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _get_class(self):
+        raise NotImplementedError
+
+    def _instance(self, layer):
+        return self._get_class()(layer, **self._kwargs)
+
+
+def quanter(class_name):
+    """Decorator: register a BaseQuanter subclass and synthesize its factory
+    under ``class_name`` (ref factory.py:quanter)."""
+
+    def wrapper(cls):
+        class _Factory(QuanterFactory):
+            def _get_class(self):
+                return cls
+
+        _Factory.__name__ = class_name
+        import sys
+
+        setattr(sys.modules[cls.__module__], class_name, _Factory)
+        return cls
+
+    return wrapper
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Moving-average abs-max fake quanter (ref quanters/abs_max.py:94):
+        state = rate * state + 1;  accum = rate * accum + max|x|
+        scale = accum / state;  out = round(x/scale*range)*scale/range (STE)
+    """
+
+    def __init__(self, layer=None, name=None, moving_rate=0.9, bit_length=8,
+                 dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self.register_buffer("_scale", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("_state", Tensor(jnp.zeros([], jnp.float32)))
+        self.register_buffer("_accum", Tensor(jnp.zeros([], jnp.float32)))
+
+    def forward(self, x):
+        qrange = 2.0 ** (self._bit_length - 1) - 1
+        if self.training:
+            amax = float(jnp.max(jnp.abs(to_array(x))))
+            state = self._moving_rate * float(self._state.item()) + 1.0
+            accum = self._moving_rate * float(self._accum.item()) + amax
+            self._buffers["_state"] = Tensor(jnp.asarray(state, jnp.float32))
+            self._buffers["_accum"] = Tensor(jnp.asarray(accum, jnp.float32))
+            scale = accum / state
+            self._buffers["_scale"] = Tensor(jnp.asarray(scale, jnp.float32))
+        else:
+            scale = float(self._scale.item())
+        scale = max(scale, 1e-8)
+
+        @jax.custom_vjp
+        def _fq(v):
+            return jnp.round(jnp.clip(v / scale, -1.0, 1.0) * qrange) * scale / qrange
+
+        def _fwd(v):
+            return _fq(v), None
+
+        def _bwd(res, g):
+            return (g,)  # straight-through
+
+        _fq.defvjp(_fwd, _bwd)
+        return apply_op(_fq, x)
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver(QuanterFactory):
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        super().__init__(moving_rate=moving_rate, bit_length=bit_length, dtype=dtype)
+
+    def _get_class(self):
+        return FakeQuanterWithAbsMaxObserverLayer
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers → quanter settings (ref quantization/config.py:59)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global_config = (SingleLayerConfig(activation, weight)
+                               if activation is not None or weight is not None else None)
+        self._layer2config = {}
+        self._prefix2config = {}
+        self._type2config = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer2config[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._prefix2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def _config_for(self, layer, full_name):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for prefix, cfg in self._prefix2config.items():
+            if full_name.startswith(prefix):
+                return cfg
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+
+class QuantedConv2D(Layer):
+    """Conv2D with weight+activation fake-quant (ref nn/quant/qat/conv.py)."""
+
+    def __init__(self, conv, cfg: SingleLayerConfig):
+        super().__init__()
+        self.inner = conv
+        self.weight_quanter = (cfg.weight._instance(conv) if cfg.weight else None)
+        self.activation_quanter = (cfg.activation._instance(conv)
+                                   if cfg.activation else None)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.conv2d(x, w, self.inner.bias, stride=self.inner._stride,
+                        padding=self.inner._padding, dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+class QuantedLinearV2(Layer):
+    """Linear wrapped with configured quanters (ref nn/quant/qat/linear.py)."""
+
+    def __init__(self, linear, cfg: SingleLayerConfig):
+        super().__init__()
+        self.inner = linear
+        self.weight_quanter = (cfg.weight._instance(linear) if cfg.weight else None)
+        self.activation_quanter = (cfg.activation._instance(linear)
+                                   if cfg.activation else None)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QATv2:
+    """Config-driven QAT (ref quantization/qat.py QAT). Usage:
+        q = QAT(QuantConfig(activation=quanter, weight=quanter))
+        qmodel = q.quantize(model)
+    """
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        def walk(layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                full = f"{prefix}.{name}" if prefix else name
+                cfg = self.config._config_for(sub, full)
+                if cfg is not None and isinstance(sub, Linear):
+                    layer._sub_layers[name] = QuantedLinearV2(sub, cfg)
+                elif cfg is not None and isinstance(sub, Conv2D):
+                    layer._sub_layers[name] = QuantedConv2D(sub, cfg)
+                else:
+                    walk(sub, full)
+
+        walk(model)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False):
+        """Freeze observers for inference (scales stop updating)."""
+        model.eval()
+        return model
+
+
+QAT.convert = QATv2.convert

@@ -311,12 +311,14 @@ def mv(x, vec, name=None):
 def masked_matmul(x, y, mask, name=None):
     """Dense@dense sampled at mask's sparsity pattern (SDDMM,
     ref phi sparse masked_matmul_kernel)."""
-    idx = mask._bcoo.indices  # [nse, 2]
-    rows, cols = idx[:, 0], idx[:, 1]
+    idx = mask._bcoo.indices  # [nse, ndim] — trailing two dims are (row, col)
+    lead = tuple(idx[:, i] for i in range(idx.shape[1] - 2))
+    rows, cols = idx[:, -2], idx[:, -1]
 
     def f(xv, yv):
-        return jnp.einsum("nk,nk->n", xv[rows, :],
-                          jnp.swapaxes(yv, -1, -2)[cols, :]).astype(xv.dtype)
+        xg = xv[(*lead, rows)]                        # [nse, K]
+        yg = jnp.swapaxes(yv, -1, -2)[(*lead, cols)]  # [nse, K]
+        return jnp.einsum("nk,nk->n", xg, yg).astype(xv.dtype)
 
     vals = apply_op(f, x, y, op_name="sddmm")
     cls = SparseCsrTensor if isinstance(mask, SparseCsrTensor) else SparseCooTensor
@@ -366,16 +368,21 @@ def coalesce(x, name=None):
     return x.coalesce()
 
 
+def _structure_op(x, fn, op_name):
+    """Dense-roundtrip structural op, tape preserved."""
+    out = apply_op(fn, x, op_name=op_name)
+    if isinstance(x, SparseCsrTensor):
+        return _adopt_tape(SparseCsrTensor._from_coo(
+            jsparse.BCOO.fromdense(out.value)), out)
+    return _coo_from_dense_tensor(out)
+
+
 def transpose(x, perm, name=None):
     if not _is_sparse(x):
         from ..tensor.manipulation import transpose as _t
 
         return _t(x, perm)
-    arr = jnp.transpose(x._bcoo.todense(), perm)
-    out = to_sparse_coo(Tensor(arr))
-    if isinstance(x, SparseCsrTensor):
-        return SparseCsrTensor._from_coo(out._bcoo, x.stop_gradient)
-    return out
+    return _structure_op(x, lambda a: jnp.transpose(a, perm), "sparse_transpose")
 
 
 def reshape(x, shape, name=None):
@@ -383,11 +390,8 @@ def reshape(x, shape, name=None):
         from ..tensor.manipulation import reshape as _r
 
         return _r(x, shape)
-    arr = jnp.reshape(x._bcoo.todense(), [int(s) for s in shape])
-    out = to_sparse_coo(Tensor(arr))
-    if isinstance(x, SparseCsrTensor):
-        return SparseCsrTensor._from_coo(out._bcoo, x.stop_gradient)
-    return out
+    return _structure_op(x, lambda a: jnp.reshape(a, [int(s) for s in shape]),
+                         "sparse_reshape")
 
 
 def is_same_shape(x, y):

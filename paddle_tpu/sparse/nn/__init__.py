@@ -113,9 +113,7 @@ class BatchNorm(Layer):
                                weight_attr=weight_attr, bias_attr=bias_attr)
 
     def forward(self, x):
-        vals = x.values()
-        out = self._bn(vals)
-        return x._replace_values(out.value if hasattr(out, "value") else out)
+        return x._replace_values(self._bn(x.values()))
 
 
 class SyncBatchNorm(BatchNorm):

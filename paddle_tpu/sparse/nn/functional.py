@@ -153,11 +153,19 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
 
     def f(dense):
+        # pool only over ACTIVE sites: implicit zeros must not win the max
+        # (an all-negative active window pools to its max, not 0)
+        active = (dense != 0).any(axis=-1, keepdims=True)
+        neg_inf = jnp.asarray(-jnp.inf, dense.dtype)
+        masked = jnp.where(active, dense, neg_inf)
+        pad = [(0, 0), *[(pi, pi) for pi in p], (0, 0)]
         out = jax.lax.reduce_window(
-            dense, -jnp.inf, jax.lax.max, window_dimensions=(1, *ks, 1),
-            window_strides=(1, *st, 1),
-            padding=[(0, 0), *[(pi, pi) for pi in p], (0, 0)])
-        return jnp.where(jnp.isfinite(out), out, jnp.zeros((), dense.dtype))
+            masked, neg_inf, jax.lax.max, window_dimensions=(1, *ks, 1),
+            window_strides=(1, *st, 1), padding=pad)
+        act_out = jax.lax.reduce_window(
+            active, False, jax.lax.bitwise_or, window_dimensions=(1, *ks, 1),
+            window_strides=(1, *st, 1), padding=pad)
+        return jnp.where(act_out, out, jnp.zeros((), dense.dtype))
 
     out = apply_op(f, x, op_name="sparse_max_pool3d")
     return _coo_from_dense_tensor(out, n_dense=1)

@@ -119,6 +119,52 @@ class TestGeometric:
         # node2 receives node1 + node0
         np.testing.assert_allclose(out[2], [1., 1., 0.])
 
+    def test_send_uv(self):
+        import paddle_tpu.geometric as G
+
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        y = paddle.to_tensor(np.array([[10.0], [20.0], [30.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 2]))
+        dst = paddle.to_tensor(np.array([1, 0]))
+        np.testing.assert_allclose(npt(G.send_uv(x, y, src, dst, "add")),
+                                   [[21.0], [13.0]])
+        np.testing.assert_allclose(npt(G.send_uv(x, y, src, dst, "mul")),
+                                   [[20.0], [30.0]])
+
+    def test_reindex_graph(self):
+        import paddle_tpu.geometric as G
+
+        # reference docstring example (geometric/reindex.py:24)
+        x = paddle.to_tensor(np.array([0, 1, 2]))
+        nb = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7]))
+        cnt = paddle.to_tensor(np.array([2, 3, 2]))
+        src, dst, out = G.reindex_graph(x, nb, cnt)
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(out.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_reindex_heter_graph(self):
+        import paddle_tpu.geometric as G
+
+        x = paddle.to_tensor(np.array([0, 1]))
+        nbs = [paddle.to_tensor(np.array([5, 1])), paddle.to_tensor(np.array([0, 7]))]
+        cnts = [paddle.to_tensor(np.array([1, 1])), paddle.to_tensor(np.array([1, 1]))]
+        src, dst, out = G.reindex_heter_graph(x, nbs, cnts)
+        np.testing.assert_array_equal(out.numpy(), [0, 1, 5, 7])
+        np.testing.assert_array_equal(src.numpy(), [2, 1, 0, 3])
+        np.testing.assert_array_equal(dst.numpy(), [0, 1, 0, 1])
+
+    def test_sample_neighbors(self):
+        import paddle_tpu.geometric as G
+
+        # CSC graph: node0 ← {1,2}, node1 ← {0}, node2 ← {0,1}
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1]))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5]))
+        nodes = paddle.to_tensor(np.array([0, 2]))
+        neigh, cnt = G.sample_neighbors(row, colptr, nodes)
+        np.testing.assert_array_equal(cnt.numpy(), [2, 2])
+        np.testing.assert_array_equal(np.sort(neigh.numpy()[:2]), [1, 2])
+
 
 class TestAudio:
     def test_mel_pipeline(self):
@@ -156,6 +202,51 @@ class TestQuantization:
         assert isinstance(qm[0], QuantedLinear)
         x = paddle.randn([2, 4])
         assert qm(x).shape == [2, 2]
+
+    def test_quant_config_driven_qat(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver, QAT,
+                                             QuantConfig, QuantedLinearV2)
+
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9, bit_length=8)
+        cfg = QuantConfig(activation=q, weight=q)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        qm = QAT(cfg).quantize(m)
+        assert isinstance(qm[0], QuantedLinearV2)
+        out = qm(paddle.randn([2, 4]))
+        assert out.shape == [2, 2]
+        out.sum().backward()
+        assert qm[0].inner.weight.grad is not None
+
+    def test_observer_moving_average(self):
+        from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserverLayer
+
+        obs = FakeQuanterWithAbsMaxObserverLayer(moving_rate=0.5)
+        obs.train()
+        x1 = paddle.to_tensor(np.array([2.0, -1.0], np.float32))
+        obs(x1)
+        # state = 1, accum = 2 → scale = 2
+        np.testing.assert_allclose(float(obs.scales().item()), 2.0, rtol=1e-6)
+        obs(x1)
+        # state = 1.5, accum = 3 → scale = 2
+        np.testing.assert_allclose(float(obs.scales().item()), 2.0, rtol=1e-6)
+        obs.eval()
+        out = obs(paddle.to_tensor(np.array([1.0], np.float32)))
+        # quantized with frozen scale 2: round(1/2*127)*2/127
+        np.testing.assert_allclose(npt(out), [round(1 / 2 * 127) * 2 / 127], rtol=1e-6)
+
+    def test_quant_config_type_rules(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver, QAT,
+                                             QuantConfig, QuantedConv2D)
+
+        q = FakeQuanterWithAbsMaxObserver()
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Conv2D, activation=q, weight=q)
+        m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU(), nn.Linear(4, 2))
+        qm = QAT(cfg).quantize(m)
+        assert isinstance(qm[0], QuantedConv2D)
+        assert isinstance(qm[2], nn.Linear)  # linear untouched: no rule for it
+        out = qm[0](paddle.randn([1, 3, 8, 8]))
+        assert out.shape == [1, 4, 8, 8]
 
     def test_ptq_observes_ranges(self):
         from paddle_tpu.quantization import PTQ

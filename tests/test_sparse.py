@@ -186,8 +186,53 @@ class TestSparseNN:
         x, dense = self._voxels()
         out = sparse.nn.MaxPool3D(kernel_size=2)(x)
         assert list(out.shape) == [1, 2, 2, 2, 2]
-        ref = dense.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+        # reference semantics: max over ACTIVE sites only; empty windows → 0
+        active = (dense != 0).any(axis=-1, keepdims=True)
+        masked = np.where(active, dense, -np.inf)
+        pooled = masked.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+        ref = np.where(np.isfinite(pooled), pooled, 0.0)
         np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6)
+
+    def test_max_pool3d_negative_active_site(self):
+        dense = np.zeros((1, 2, 2, 2, 1), np.float32)
+        dense[0, 0, 0, 0, 0] = -5.0
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+
+        x = sparse.SparseCooTensor(jsparse.BCOO.fromdense(jnp.asarray(dense), n_dense=1))
+        out = sparse.nn.MaxPool3D(kernel_size=2)(x)
+        # the all-negative active window pools to -5, not 0
+        np.testing.assert_allclose(out.to_dense().numpy().reshape(1), [-5.0])
+
+    def test_batchnorm_grads(self):
+        x, dense = self._voxels()
+        bn = sparse.nn.BatchNorm(2)
+        out = bn(x)
+        out.values().sum().backward()
+        g = bn._bn.weight.grad
+        assert g is not None
+
+    def test_transpose_grads(self):
+        a = make_coo()
+        a.stop_gradient = False
+        t = sparse.transpose(a, [1, 0])
+        t.to_dense().sum().backward()
+        assert a.grad is not None
+
+    def test_masked_matmul_batched(self):
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(2, 4, 3).astype(np.float32))
+        mask_dense = np.zeros((2, 3, 3), np.float32)
+        mask_dense[0, 0, 1] = 1
+        mask_dense[1, 2, 2] = 1
+        mask = sparse.to_sparse_coo(paddle.to_tensor(mask_dense))
+        out = sparse.masked_matmul(x, y, mask)
+        full = np.einsum("bmk,bkn->bmn", x.numpy(), y.numpy())
+        d = out.to_dense().numpy()
+        np.testing.assert_allclose(d[0, 0, 1], full[0, 0, 1], rtol=1e-5)
+        np.testing.assert_allclose(d[1, 2, 2], full[1, 2, 2], rtol=1e-5)
+        assert out.nnz() == 2
 
     def test_batch_norm(self):
         x, dense = self._voxels()
