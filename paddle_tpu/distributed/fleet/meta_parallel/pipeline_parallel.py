@@ -133,13 +133,16 @@ class PipelineParallel:
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Ref pipeline_parallel.py:461 — virtual pipeline stages. On TPU the
-    schedule collapse (see forward_backward_pipeline) makes the interleaved
-    order equivalent; the class exists for API parity and future per-vstage
-    remat policies."""
+    """Ref pipeline_parallel.py:461 — virtual pipeline stages. The eager path
+    collapses to the same per-microbatch dataflow (single-controller SPMD);
+    the compiled path is `spmd_interleaved_pipeline_fn`, which implements the
+    true virtual-stage ring schedule (bubble (N-1)/(M·C) instead of (N-1)/M)."""
 
     def __init__(self, layers, hcg, strategy):
         super().__init__(layers, hcg, strategy)
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.num_model_chunks = cfg.get("num_model_chunks",
+                                        getattr(layers, "_num_virtual_pipeline_stages", 1))
 
 
 # ---------------------------------------------------------------------------
@@ -197,6 +200,97 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
                                     (axis_name,)), out_shape)
         (act, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
         # only the last stage wrote real values; psum replicates them ring-wide
+        return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
+
+    return per_shard
+
+
+def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
+                                 num_chunks: int, axis_name: str = "pipe"):
+    """Compiled INTERLEAVED pipeline (virtual stages, ref
+    PipelineParallelWithInterleave pipeline_parallel.py:461,:535).
+
+    Each device holds ``num_chunks`` model chunks; logical stage
+    L = chunk * num_stages + device, S = num_stages*num_chunks logical stages.
+    Per tick every device runs all of its resident chunks (at most one
+    microbatch each); activations ring-rotate via a single ppermute, and on
+    wrap-around (device N-1 → device 0) they advance to the next chunk —
+    the interleaved fill/drain with bubble (N-1)/(M*C) instead of (N-1)/M.
+
+    stage_fn(chunk_id, params_chunk, activation) -> activation
+    params_shard: per-shard pytree whose leaves are [1, num_chunks, ...] —
+    axis 0 is the size-1 pipe-shard dim shard_map leaves in place (pass the
+    global leaves as [num_stages, num_chunks, ...] with in_specs P("pipe")).
+    Returns the final outputs for all microbatches, replicated ring-wide.
+    """
+
+    def per_shard(params_shard, micro_batches):
+        micro_batches = jax.tree_util.tree_map(
+            lambda x: jax.lax.pvary(x, (axis_name,)), micro_batches)
+        dev = jax.lax.axis_index(axis_name)
+        S = num_stages * num_chunks
+        T = num_micro + S - 1
+
+        def chunk_params(c):
+            # leaves arrive as [1 (pipe shard), num_chunks, ...] under shard_map
+            return jax.tree_util.tree_map(lambda p: p[0][c], params_shard)
+
+        def tick(carry, t):
+            acts, outputs = carry  # acts: [num_chunks] pytree-of-stacked slots
+
+            def run_chunk(c, acts, outputs):
+                L = c * num_stages + dev
+                mb_idx = t - L
+                valid = (mb_idx >= 0) & (mb_idx < num_micro)
+                mb = jax.tree_util.tree_map(
+                    lambda x: x[jnp.clip(mb_idx, 0, num_micro - 1)], micro_batches)
+                act_c = jax.tree_util.tree_map(lambda a: a[c], acts)
+                first = (L == 0)
+                inp = jax.tree_util.tree_map(
+                    lambda m, a: jnp.where(first, m, a), mb, act_c)
+                out = stage_fn(c, chunk_params(c), inp)  # c is static (unrolled)
+                out = jax.tree_util.tree_map(
+                    lambda o, a: jnp.where(valid, o, a), out, act_c)
+                done = (L == S - 1) & valid
+                outputs = jax.tree_util.tree_map(
+                    lambda os, o: os.at[jnp.clip(mb_idx, 0, num_micro - 1)].set(
+                        jnp.where(done, o,
+                                  os[jnp.clip(mb_idx, 0, num_micro - 1)])),
+                    outputs, out)
+                return out, outputs
+
+            outs = []
+            for c in range(num_chunks):
+                o, outputs = run_chunk(c, acts, outputs)
+                outs.append(o)
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *outs)
+            # one ring rotation for all chunks
+            rotated = jax.lax.ppermute(
+                stacked, axis_name,
+                [(i, (i + 1) % num_stages) for i in range(num_stages)])
+            # device 0 receives from device N-1: that activation advances to
+            # the NEXT chunk; other devices stay within the same chunk
+            def reroute(r):
+                shifted = jnp.concatenate(
+                    [jnp.zeros_like(r[:1]), r[:-1]], axis=0)  # chunk c ← c-1
+                return jnp.where(dev == 0, shifted, r)
+
+            acts_new = jax.tree_util.tree_map(reroute, rotated)
+            return (acts_new, outputs), None
+
+        act0 = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((num_chunks,) + tuple(x.shape[1:]), x.dtype) +
+            jnp.zeros_like(x[0]),  # inherit vma (pipe-varying) from the input
+            micro_batches)
+        out_shape = jax.eval_shape(
+            lambda a: stage_fn(0, chunk_params(0), a),
+            jax.tree_util.tree_map(lambda x: x[0], micro_batches))
+        outputs0 = jax.tree_util.tree_map(
+            lambda s: jax.lax.pvary(
+                jnp.zeros((num_micro,) + tuple(s.shape), s.dtype), (axis_name,)),
+            out_shape)
+        (acts, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
         return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
 
     return per_shard

@@ -228,12 +228,12 @@ def ignore_module(modules):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persists state_dict + an input-spec manifest.
+    """paddle.jit.save parity (ref jit/api.py jit.save → TranslatedLayer).
 
-    The reference serializes a translated ProgramDesc (jit/translated_layer.py);
-    our compiled artifact is re-derivable from code + weights, so we save
-    weights + spec, and `jit.load` restores a callable wrapper. For true AOT
-    serving export use paddle_tpu.inference (StableHLO export).
+    Persists state_dict + an input-spec manifest; when ``input_spec`` is
+    given, ALSO serializes the traced forward as StableHLO (jax.export) so
+    ``jit.load`` returns a standalone runnable TranslatedLayer — the direct
+    analogue of the reference's serialized ProgramDesc + params files.
     """
     import os
     import pickle
@@ -251,15 +251,62 @@ def save(layer, path, input_spec=None, **configs):
     }
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
+    if input_spec:
+        import numpy as np
+        from jax import export as jexport
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            params = state_values(layer)
+
+            def fn(params, *args):
+                out = functional_call(layer, params, *[Tensor(a) for a in args])
+                return jax.tree_util.tree_map(
+                    lambda t: t.value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            # None/-1 dims (the canonical dynamic-batch InputSpec) export as
+            # jax.export symbolic dimensions — batch-polymorphic StableHLO
+            scope = jexport.SymbolicScope()
+            in_avals = []
+            n_sym = 0
+            for s in input_spec:
+                if any(d is None or d == -1 for d in s.shape):
+                    dims = []
+                    for d in s.shape:
+                        if d is None or d == -1:
+                            dims.append(f"b{n_sym}")
+                            n_sym += 1
+                        else:
+                            dims.append(str(d))
+                    shape = jexport.symbolic_shape(", ".join(dims), scope=scope)
+                else:
+                    shape = tuple(s.shape)
+                in_avals.append(jax.ShapeDtypeStruct(shape, s.dtype))
+            exported = jexport.export(jax.jit(fn))(
+                jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+                                       params), *in_avals)
+            with open(path + ".stablehlo", "wb") as f:
+                f.write(exported.serialize())
+            with open(path + ".pdexport", "wb") as f:
+                pickle.dump(jax.tree_util.tree_map(np.asarray, params), f)
+        finally:
+            if was_training:
+                layer.train()
 
 
 class TranslatedLayer:
-    """Loaded inference layer (ref jit/translated_layer.py)."""
+    """Loaded inference layer (ref jit/translated_layer.py). Standalone
+    runnable when the save included a StableHLO export; otherwise bind a
+    model instance to supply the code."""
 
-    def __init__(self, state_dict, meta):
+    def __init__(self, state_dict, meta, exported=None, params=None):
         self._state_dict = state_dict
         self._meta = meta
         self._layer = None
+        self._exported = exported
+        self._params = params
 
     def bind(self, layer):
         layer.set_state_dict(self._state_dict)
@@ -269,15 +316,28 @@ class TranslatedLayer:
     def state_dict(self):
         return self._state_dict
 
+    def eval(self):
+        return self
+
     def __call__(self, *args, **kwargs):
-        if self._layer is None:
-            raise RuntimeError(
-                "TranslatedLayer.bind(model) must be called with a model instance first "
-                "(program reconstruction from serialized IR is replaced by code+weights).")
-        return self._layer(*args, **kwargs)
+        if self._layer is not None:
+            return self._layer(*args, **kwargs)
+        if self._exported is not None:
+            if kwargs:
+                raise TypeError(
+                    "exported TranslatedLayer takes positional inputs only "
+                    f"(got kwargs {sorted(kwargs)}); re-save with those folded into "
+                    "input_spec, or bind() a model instance")
+            raw = [a.value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+            out = self._exported.call(self._params, *raw)
+            return jax.tree_util.tree_map(Tensor, out)
+        raise RuntimeError(
+            "this artifact was saved without input_spec; call "
+            "TranslatedLayer.bind(model) with a model instance first")
 
 
 def load(path, **configs):
+    import os
     import pickle
 
     from ..framework.io_state import load as _load
@@ -285,4 +345,12 @@ def load(path, **configs):
     sd = _load(path + ".pdiparams")
     with open(path + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
-    return TranslatedLayer(sd, meta)
+    exported = params = None
+    if os.path.exists(path + ".stablehlo"):
+        from jax import export as jexport
+
+        with open(path + ".stablehlo", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        with open(path + ".pdexport", "rb") as f:
+            params = pickle.load(f)
+    return TranslatedLayer(sd, meta, exported, params)

@@ -93,6 +93,8 @@ class QAT:
         if not inplace:
             import copy
 
+            if not isinstance(self.config, dict):
+                _pin_layer_rules(self.config, model)
             model = copy.deepcopy(model)
         if not isinstance(self.config, dict):
             return QATv2(self.config).quantize(model, inplace=True)
@@ -296,48 +298,58 @@ class QuantConfig:
         return self._global_config
 
 
-class QuantedConv2D(Layer):
+class _QuantedModule(Layer):
+    """Shared quanter wiring for QAT layer wrappers (ref nn/quant/qat/)."""
+
+    def __init__(self, inner, cfg: SingleLayerConfig):
+        super().__init__()
+        self.inner = inner
+        self.weight_quanter = (cfg.weight._instance(inner) if cfg.weight else None)
+        self.activation_quanter = (cfg.activation._instance(inner)
+                                   if cfg.activation else None)
+
+    def _quantized(self, x):
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return x, w
+
+
+class QuantedConv2D(_QuantedModule):
     """Conv2D with weight+activation fake-quant (ref nn/quant/qat/conv.py)."""
 
-    def __init__(self, conv, cfg: SingleLayerConfig):
-        super().__init__()
-        self.inner = conv
-        self.weight_quanter = (cfg.weight._instance(conv) if cfg.weight else None)
-        self.activation_quanter = (cfg.activation._instance(conv)
-                                   if cfg.activation else None)
-
     def forward(self, x):
         from ..nn import functional as F
 
-        w = self.inner.weight
-        if self.weight_quanter is not None:
-            w = self.weight_quanter(w)
-        if self.activation_quanter is not None:
-            x = self.activation_quanter(x)
+        x, w = self._quantized(x)
         return F.conv2d(x, w, self.inner.bias, stride=self.inner._stride,
                         padding=self.inner._padding, dilation=self.inner._dilation,
-                        groups=self.inner._groups)
+                        groups=self.inner._groups,
+                        data_format=self.inner._data_format)
 
 
-class QuantedLinearV2(Layer):
+class QuantedLinearV2(_QuantedModule):
     """Linear wrapped with configured quanters (ref nn/quant/qat/linear.py)."""
-
-    def __init__(self, linear, cfg: SingleLayerConfig):
-        super().__init__()
-        self.inner = linear
-        self.weight_quanter = (cfg.weight._instance(linear) if cfg.weight else None)
-        self.activation_quanter = (cfg.activation._instance(linear)
-                                   if cfg.activation else None)
 
     def forward(self, x):
         from ..nn import functional as F
 
-        w = self.inner.weight
-        if self.weight_quanter is not None:
-            w = self.weight_quanter(w)
-        if self.activation_quanter is not None:
-            x = self.activation_quanter(x)
+        x, w = self._quantized(x)
         return F.linear(x, w, self.inner.bias)
+
+
+def _pin_layer_rules(config: "QuantConfig", model: Layer):
+    """id-keyed layer rules would dangle after deepcopy: pin them to the
+    layer's name path first."""
+    if config._layer2config:
+        for full, sub in model.named_sublayers(include_self=False):
+            if not full:
+                continue
+            cfg = config._layer2config.get(id(sub))
+            if cfg is not None:
+                config._prefix2config[full] = cfg
 
 
 class QATv2:
@@ -353,6 +365,7 @@ class QATv2:
         if not inplace:
             import copy
 
+            _pin_layer_rules(self.config, model)
             model = copy.deepcopy(model)
         from ..nn.layer.common import Linear
         from ..nn.layer.conv import Conv2D

@@ -248,6 +248,19 @@ class TestQuantization:
         out = qm[0](paddle.randn([1, 3, 8, 8]))
         assert out.shape == [1, 4, 8, 8]
 
+    def test_layer_config_survives_copy(self):
+        from paddle_tpu.quantization import (FakeQuanterWithAbsMaxObserver, QAT,
+                                             QuantConfig, QuantedLinearV2)
+
+        q = FakeQuanterWithAbsMaxObserver()
+        m = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        cfg = QuantConfig()
+        cfg.add_layer_config(m[0], activation=q, weight=q)
+        qm = QAT(cfg).quantize(m)  # default inplace=False deep-copies
+        assert isinstance(qm[0], QuantedLinearV2)
+        assert isinstance(qm[1], nn.Linear)
+        assert isinstance(m[0], nn.Linear)  # original untouched
+
     def test_ptq_observes_ranges(self):
         from paddle_tpu.quantization import PTQ
 
