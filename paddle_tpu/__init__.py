@@ -60,6 +60,11 @@ from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .batch import batch  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 
 def sysconfig_get_include():

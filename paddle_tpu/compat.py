@@ -263,20 +263,7 @@ def check_shape(x):
     return list(x.shape)
 
 
-def batch(reader, batch_size, drop_last=False):
-    """fluid-style reader decorator (ref python/paddle/batch.py)."""
-
-    def batched():
-        buf = []
-        for item in reader():
-            buf.append(item)
-            if len(buf) == batch_size:
-                yield buf
-                buf = []
-        if buf and not drop_last:
-            yield buf
-
-    return batched
+from .batch import batch  # noqa: F401  (ref python/paddle/batch.py)
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None, is_bias=False,

@@ -1,0 +1,16 @@
+"""paddle.dataset parity (ref: python/paddle/dataset/__init__.py).
+
+Legacy reader-creator dataset modules. Zero-egress: each module parses the
+real on-disk format when the file is cached under
+``~/.cache/paddle_tpu/dataset/<name>/`` and otherwise serves deterministic
+synthetic data with the same schema (see module docstrings).
+"""
+from . import (  # noqa: F401
+    cifar, common, conll05, flowers, image, imdb, imikolov, mnist, movielens,
+    uci_housing, voc2012, wmt14, wmt16,
+)
+
+__all__ = [
+    'mnist', 'imikolov', 'imdb', 'cifar', 'movielens', 'conll05',
+    'uci_housing', 'wmt14', 'wmt16', 'flowers', 'voc2012', 'image', 'common',
+]

@@ -1,0 +1,77 @@
+"""CIFAR-10/100 reader-creator API (ref: python/paddle/dataset/cifar.py).
+
+Parses the real python-pickle tarballs when cached; synthetic fallback
+otherwise. Samples: (image float32[3072] in [0,1], label int).
+"""
+from __future__ import annotations
+
+import itertools
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+
+def _tar_reader(filename, sub_name, cycle=False):
+    def reader():
+        while True:
+            with tarfile.open(filename, mode='r') as f:
+                names = [n for n in f.getnames() if sub_name in n]
+                for name in names:
+                    batch = pickle.load(f.extractfile(name), encoding='bytes')
+                    data = batch[b'data']
+                    labels = batch.get(b'labels', batch.get(b'fine_labels'))
+                    for sample, label in zip(data, labels):
+                        yield (np.asarray(sample, dtype=np.float32) / 255.0,
+                               int(label))
+            if not cycle:
+                break
+
+    return reader
+
+
+def _synth(n_classes, cycle=False):
+    def reader():
+        rng = np.random.RandomState(n_classes)
+        it = itertools.count() if cycle else range(500)
+        for i in it:
+            yield (rng.uniform(0, 1, size=(3072,)).astype(np.float32),
+                   int(rng.randint(0, n_classes)))
+
+    return reader
+
+
+def reader_creator(filename, sub_name, cycle=False):
+    if filename:
+        return _tar_reader(filename, sub_name, cycle)
+    return _synth(100 if '100' in sub_name else 10, cycle)
+
+
+def train100():
+    return reader_creator(
+        common.cached_path('cifar', 'cifar-100-python.tar.gz'), 'train')
+
+
+def test100():
+    return reader_creator(
+        common.cached_path('cifar', 'cifar-100-python.tar.gz'), 'test')
+
+
+def train10(cycle=False):
+    return reader_creator(
+        common.cached_path('cifar', 'cifar-10-python.tar.gz'),
+        'data_batch', cycle=cycle)
+
+
+def test10(cycle=False):
+    return reader_creator(
+        common.cached_path('cifar', 'cifar-10-python.tar.gz'),
+        'test_batch', cycle=cycle)
+
+
+def fetch():
+    pass

@@ -1,0 +1,55 @@
+"""Dataset cache/helpers (ref: python/paddle/dataset/common.py).
+
+Zero-egress environment: ``download`` never fetches from the network; it
+returns the cached path when the file is already on disk and raises a clear
+error otherwise. Dataset modules fall back to deterministic synthetic data with
+the real schema so recipes still run end-to-end (same convention as
+paddle_tpu.vision.datasets).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = []
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+must_mkdirs(DATA_HOME)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Return the cached local path for a dataset file (no network egress).
+
+    Ref common.py download(): fetches over HTTP with md5 retry. Here the cache
+    dir is checked; a missing file raises with guidance to place it manually.
+    """
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, url.split("/")[-1] if save_name is None else save_name)
+    if os.path.exists(filename) and (
+            not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"dataset file {filename} not present and network egress is disabled; "
+        f"place the file from {url} at that path, or use the module's "
+        f"synthetic fallback readers")
+
+
+def cached_path(module_name, filename):
+    """Path under DATA_HOME/<module>/<filename>, or None if absent."""
+    p = os.path.join(DATA_HOME, module_name, filename)
+    return p if os.path.exists(p) else None

@@ -1,0 +1,92 @@
+"""CoNLL-05 SRL dataset (ref: python/paddle/dataset/conll05.py).
+
+Synthetic fallback producing the 9-field SRL sample schema:
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_ids, mark, label_ids).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+UNK_IDX = 0
+
+_WORDS = ["the", "company", "said", "it", "will", "buy", "shares", "today",
+          "market", "price"]
+_LABELS = ["B-A0", "I-A0", "B-A1", "I-A1", "B-V", "O"]
+
+
+def load_label_dict(filename=None):
+    d = {}
+    for lab in _LABELS:
+        if lab.startswith("B-") or lab.startswith("I-"):
+            d[lab] = len(d)
+    d["O"] = len(d)
+    return d
+
+
+def load_dict(filename=None):
+    return {w: i for i, w in enumerate(_WORDS)}
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — ref conll05.py:208."""
+    word_dict = load_dict()
+    verb_dict = {"said": 0, "buy": 1, "will": 2}
+    label_dict = load_label_dict()
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic synthetic embedding table (ref downloads emb file)."""
+    rng = np.random.RandomState(0)
+    return rng.normal(size=(len(_WORDS), 32)).astype(np.float32)
+
+
+def corpus_reader(n=200, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(4, 15)
+            sentence = [_WORDS[rng.randint(len(_WORDS))]
+                        for _ in range(length)]
+            labels = [_LABELS[rng.randint(len(_LABELS))]
+                      for _ in range(length)]
+            yield sentence, labels
+
+    return reader
+
+
+def reader_creator(corpus_rdr, word_dict, verb_dict, label_dict):
+    def pad_ctx(ids, i, off):
+        j = i + off
+        return ids[j] if 0 <= j < len(ids) else UNK_IDX
+
+    def reader():
+        for sentence, labels in corpus_rdr():
+            word_ids = [word_dict.get(w, UNK_IDX) for w in sentence]
+            lab_ids = [label_dict.get(l, label_dict["O"]) for l in labels]
+            verb_positions = [i for i, l in enumerate(labels) if l == "B-V"]
+            vi = verb_positions[0] if verb_positions else 0
+            pred_id = verb_dict.get(sentence[vi], 0)
+            n = len(word_ids)
+            ctx_n2 = [pad_ctx(word_ids, vi, -2)] * n
+            ctx_n1 = [pad_ctx(word_ids, vi, -1)] * n
+            ctx_0 = [word_ids[vi]] * n
+            ctx_p1 = [pad_ctx(word_ids, vi, 1)] * n
+            ctx_p2 = [pad_ctx(word_ids, vi, 2)] * n
+            mark = [1 if i == vi else 0 for i in range(n)]
+            yield (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+                   [pred_id] * n, mark, lab_ids)
+
+    return reader
+
+
+def test():
+    word_dict, verb_dict, label_dict = get_dict()
+    return reader_creator(corpus_reader(seed=1), word_dict, verb_dict,
+                          label_dict)
+
+
+def fetch():
+    pass

@@ -1,0 +1,55 @@
+"""Flowers-102 reader-creator API (ref: python/paddle/dataset/flowers.py).
+
+Delegates to paddle_tpu.vision.datasets.Flowers (which parses the real files
+when cached, synthetic otherwise) and re-exposes the legacy reader interface.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..reader import map_readers, xmap_readers
+from ..vision.datasets import Flowers
+
+__all__ = []
+
+
+def default_mapper(is_train, sample):
+    img, label = sample
+    return img, label
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def reader_creator(mode, mapper, buffered_size=1024, use_xmap=True,
+                   cycle=False):
+    ds = Flowers(mode=mode, download=False)
+
+    def reader():
+        while True:
+            for i in range(len(ds)):
+                img, label = ds[i]
+                yield img, int(label)
+            if not cycle:
+                break
+
+    if use_xmap:
+        return xmap_readers(mapper, reader, 4, buffered_size)
+    return map_readers(mapper, reader)
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True, cycle=False):
+    return reader_creator('train', mapper, buffered_size, use_xmap, cycle)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True, cycle=False):
+    return reader_creator('test', mapper, buffered_size, use_xmap, cycle)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator('valid', mapper, buffered_size, use_xmap)
+
+
+def fetch():
+    pass

@@ -1,0 +1,85 @@
+"""Image preprocessing helpers (ref: python/paddle/dataset/image.py).
+
+numpy-only implementations (the reference shells out to cv2); these are host
+-side and feed the device pipeline with contiguous CHW float arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+
+def _resize_nn(im, h, w):
+    """Nearest-neighbour resize, HWC or HW."""
+    src_h, src_w = im.shape[:2]
+    rows = (np.arange(h) * src_h / h).astype(np.int64).clip(0, src_h - 1)
+    cols = (np.arange(w) * src_w / w).astype(np.int64).clip(0, src_w - 1)
+    return im[rows][:, cols]
+
+
+def resize_short(im, size):
+    """Resize so the short edge == size, keeping aspect (ref image.py)."""
+    h, w = im.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(round(w * size / h))
+    else:
+        new_h, new_w = int(round(h * size / w)), size
+    return _resize_nn(im, new_h, new_w)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short → crop (+flip when training) → CHW → mean-subtract."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    try:
+        from PIL import Image
+
+        im = np.asarray(Image.open(filename).convert(
+            "RGB" if is_color else "L"))
+    except ImportError:
+        raise ImportError("PIL is required to load image files")
+    return simple_transform(im, resize_size, crop_size, is_train, is_color,
+                            mean)

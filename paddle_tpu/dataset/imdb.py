@@ -1,0 +1,97 @@
+"""IMDB sentiment dataset (ref: python/paddle/dataset/imdb.py).
+
+Real aclImdb tarball parsing when cached; deterministic synthetic corpus
+otherwise. Samples: (word-id list, label 0/1).
+"""
+from __future__ import annotations
+
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+_SYNTH_VOCAB = ["the", "movie", "film", "great", "bad", "plot", "acting",
+                "good", "terrible", "wonderful", "boring", "classic"]
+
+
+def _synth_docs(pattern_is_pos, n=200, seed=0):
+    rng = np.random.RandomState(seed + int(pattern_is_pos))
+    pos_words = ["great", "good", "wonderful", "classic"]
+    neg_words = ["bad", "terrible", "boring"]
+    bias = pos_words if pattern_is_pos else neg_words
+    for _ in range(n):
+        length = rng.randint(5, 30)
+        words = [
+            _SYNTH_VOCAB[rng.randint(len(_SYNTH_VOCAB))] if rng.rand() < 0.7
+            else bias[rng.randint(len(bias))] for _ in range(length)
+        ]
+        yield words
+
+
+def tokenize(pattern):
+    """Yield token lists for docs matching ``pattern`` inside the tarball."""
+    tarball = common.cached_path('imdb', 'aclImdb_v1.tar.gz')
+    if tarball is None:
+        is_pos = 'pos' in getattr(pattern, 'pattern', str(pattern))
+        yield from _synth_docs(is_pos)
+        return
+    with tarfile.open(tarball) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                data = tarf.extractfile(tf).read().decode('latin-1')
+                yield (data.lower()
+                       .translate(str.maketrans("", "", string.punctuation))
+                       .split())
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff):
+    """Word frequency dict over docs matching pattern, freq > cutoff."""
+    word_freq = {}
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] = word_freq.get(word, 0) + 1
+    word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary)) if dictionary else ((), ())
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx['<unk>'] = len(words)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx):
+    unk = word_idx['<unk>']
+
+    def reader():
+        for doc in tokenize(pos_pattern):
+            yield [word_idx.get(w, unk) for w in doc], 0
+        for doc in tokenize(neg_pattern):
+            yield [word_idx.get(w, unk) for w in doc], 1
+
+    return reader
+
+
+def train(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx)
+
+
+def test(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx)
+
+
+def word_dict():
+    return build_dict(re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"), 150 if common.cached_path('imdb', 'aclImdb_v1.tar.gz') else 0)
+
+
+def fetch():
+    pass

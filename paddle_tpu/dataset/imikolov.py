@@ -1,0 +1,99 @@
+"""imikolov (PTB) language-model dataset (ref: python/paddle/dataset/imikolov.py)."""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _synth_lines(n=500, seed=0):
+    rng = np.random.RandomState(seed)
+    vocab = ["the", "a", "market", "stock", "traders", "said", "on",
+             "monday", "rose", "fell", "points", "percent"]
+    for _ in range(n):
+        yield " ".join(vocab[rng.randint(len(vocab))]
+                       for _ in range(rng.randint(4, 20)))
+
+
+def _lines(which):
+    tarball = common.cached_path('imikolov', 'simple-examples.tgz')
+    if tarball is None:
+        yield from _synth_lines(seed=0 if 'train' in which else 1)
+        return
+    with tarfile.open(tarball) as tf:
+        f = tf.extractfile(f"./simple-examples/data/ptb.{which}.txt")
+        for line in f:
+            yield line.decode().strip()
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        for w in line.strip().split():
+            word_freq[w] += 1
+        word_freq['<s>'] += 1
+        word_freq['<e>'] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    """Word→id dict over the train corpus, '<unk>' last (ref :53)."""
+    if common.cached_path('imikolov', 'simple-examples.tgz') is None:
+        min_word_freq = 0
+    word_freq = word_count(_lines('train'))
+    word_freq = [x for x in word_freq.items()
+                 if x[1] > min_word_freq and x[0] != '<unk>']
+    word_freq_sorted = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*word_freq_sorted)) if word_freq_sorted else ((), ())
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx['<unk>'] = len(words)
+    return word_idx
+
+
+def reader_creator(which, word_idx, n, data_type):
+    def reader():
+        UNK = word_idx['<unk>']
+        for line in _lines(which):
+            if DataType.NGRAM == data_type:
+                assert n > -1, 'Invalid gram length'
+                line_ids = ['<s>'] + line.strip().split() + ['<e>']
+                line_ids = [word_idx.get(w, UNK) for w in line_ids]
+                if len(line_ids) >= n:
+                    line_ids = np.asarray(line_ids, dtype='int64')
+                    for i in range(n, len(line_ids) + 1):
+                        yield tuple(line_ids[i - n:i])
+            elif DataType.SEQ == data_type:
+                line_ids = line.strip().split()
+                line_ids = [word_idx.get(w, UNK) for w in line_ids]
+                src_seq = [word_idx['<s>']] + line_ids
+                trg_seq = line_ids + [word_idx['<e>']]
+                if n > 0 and len(line_ids) > n:
+                    continue
+                yield src_seq, trg_seq
+            else:
+                assert False, 'Unknown data type'
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator('train', word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator('valid', word_idx, n, data_type)
+
+
+def fetch():
+    pass

@@ -1,0 +1,63 @@
+"""MNIST reader-creator API (ref: python/paddle/dataset/mnist.py).
+
+Parses real idx-format gz files when cached; synthetic fallback otherwise.
+Samples: (image float32[784] scaled to [-1, 1], label int).
+"""
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+
+def _idx_reader(image_path, label_path, buffer_size):
+    with gzip.open(image_path, 'rb') as fi, gzip.open(label_path, 'rb') as fl:
+        magic, n, rows, cols = struct.unpack('>IIII', fi.read(16))
+        _, n_lab = struct.unpack('>II', fl.read(8))
+        for start in range(0, n, buffer_size):
+            cnt = min(buffer_size, n - start)
+            images = np.frombuffer(
+                fi.read(cnt * rows * cols), dtype=np.uint8
+            ).reshape(cnt, rows * cols).astype(np.float32)
+            images = images / 255.0 * 2.0 - 1.0
+            labels = np.frombuffer(fl.read(cnt), dtype=np.uint8).astype('int64')
+            for i in range(cnt):
+                yield images[i, :], int(labels[i])
+
+
+def _synth_reader(n, seed):
+    rng = np.random.RandomState(seed)
+    for i in range(n):
+        yield (rng.uniform(-1, 1, size=(784,)).astype(np.float32),
+               int(rng.randint(0, 10)))
+
+
+def reader_creator(image_filename, label_filename, buffer_size):
+    def reader():
+        if image_filename and label_filename:
+            yield from _idx_reader(image_filename, label_filename, buffer_size)
+        else:
+            yield from _synth_reader(buffer_size * 10, 0)
+
+    return reader
+
+
+def train():
+    return reader_creator(
+        common.cached_path('mnist', 'train-images-idx3-ubyte.gz'),
+        common.cached_path('mnist', 'train-labels-idx1-ubyte.gz'), 100)
+
+
+def test():
+    return reader_creator(
+        common.cached_path('mnist', 't10k-images-idx3-ubyte.gz'),
+        common.cached_path('mnist', 't10k-labels-idx1-ubyte.gz'), 100)
+
+
+def fetch():
+    pass

@@ -1,0 +1,179 @@
+"""MovieLens-1M dataset (ref: python/paddle/dataset/movielens.py).
+
+Real ml-1m zip parsing when cached; deterministic synthetic catalog otherwise.
+Sample: movie.value() + user.value() + [rating].
+"""
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+RATINGS = None
+
+
+def _synth_meta():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, RATINGS
+    rng = np.random.RandomState(0)
+    cats = ["Action", "Comedy", "Drama", "Thriller", "Sci-Fi"]
+    CATEGORIES_DICT = {c: i for i, c in enumerate(cats)}
+    words = ["the", "of", "return", "night", "story", "king", "day", "lost"]
+    MOVIE_TITLE_DICT = {w: i for i, w in enumerate(words)}
+    MOVIE_INFO, USER_INFO, RATINGS = {}, {}, []
+    for i in range(1, 201):
+        title = " ".join(words[rng.randint(len(words))] for _ in range(3))
+        mcats = [cats[rng.randint(len(cats))]]
+        MOVIE_INFO[i] = MovieInfo(i, mcats, title)
+    for i in range(1, 101):
+        USER_INFO[i] = UserInfo(
+            i, 'M' if rng.rand() < 0.5 else 'F',
+            age_table[rng.randint(len(age_table))], rng.randint(0, 21))
+    for _ in range(2000):
+        RATINGS.append((rng.randint(1, 101), rng.randint(1, 201),
+                        float(rng.randint(1, 6))))
+
+
+def _parse_zip(fn):
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO, RATINGS
+    pattern = re.compile(r'^(.*)\((\d+)\)$')
+    MOVIE_INFO, categories_set, title_word_set = {}, set(), set()
+    with zipfile.ZipFile(fn) as package:
+        for info in package.infolist():
+            assert isinstance(info, zipfile.ZipInfo)
+        with package.open('ml-1m/movies.dat') as movie_file:
+            for line in movie_file:
+                line = line.decode(encoding='latin1')
+                movie_id, title, categories = line.strip().split('::')
+                categories = categories.split('|')
+                for c in categories:
+                    categories_set.add(c)
+                title = pattern.match(title).group(1)
+                MOVIE_INFO[int(movie_id)] = MovieInfo(
+                    index=movie_id, categories=categories, title=title)
+                for w in title.split():
+                    title_word_set.add(w.lower())
+        MOVIE_TITLE_DICT = {w: i for i, w in enumerate(title_word_set)}
+        CATEGORIES_DICT = {c: i for i, c in enumerate(categories_set)}
+        USER_INFO = {}
+        with package.open('ml-1m/users.dat') as user_file:
+            for line in user_file:
+                line = line.decode(encoding='latin1')
+                uid, gender, age, job, _ = line.strip().split("::")
+                USER_INFO[int(uid)] = UserInfo(
+                    index=uid, gender=gender, age=age, job_id=job)
+        RATINGS = []
+        with package.open('ml-1m/ratings.dat') as rating:
+            for line in rating:
+                line = line.decode(encoding='latin1')
+                uid, mov_id, rat, _ = line.strip().split("::")
+                RATINGS.append((int(uid), int(mov_id), float(rat)))
+
+
+def __initialize_meta_info__():
+    if MOVIE_INFO is None:
+        fn = common.cached_path('movielens', 'ml-1m.zip')
+        if fn is None:
+            _synth_meta()
+        else:
+            _parse_zip(fn)
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    __initialize_meta_info__()
+    rng = np.random.RandomState(rand_seed)
+    for uid, mov_id, rating in RATINGS:
+        if (rng.rand() < test_ratio) == is_test:
+            mov = MOVIE_INFO[mov_id]
+            usr = USER_INFO[uid]
+            yield usr.value() + mov.value() + [[rating]]
+
+
+def __reader_creator__(**kwargs):
+    return lambda: __reader__(**kwargs)
+
+
+train = __reader_creator__(is_test=False)
+test = __reader_creator__(is_test=True)
+
+
+def get_movie_title_dict():
+    __initialize_meta_info__()
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id():
+    __initialize_meta_info__()
+    return max(MOVIE_INFO.values(), key=lambda m: m.index).index
+
+
+def max_user_id():
+    __initialize_meta_info__()
+    return max(USER_INFO.values(), key=lambda u: u.index).index
+
+
+def max_job_id():
+    __initialize_meta_info__()
+    return max(USER_INFO.values(), key=lambda u: u.job_id).job_id
+
+
+def movie_categories():
+    __initialize_meta_info__()
+    return CATEGORIES_DICT
+
+
+def user_info():
+    __initialize_meta_info__()
+    return USER_INFO
+
+
+def movie_info():
+    __initialize_meta_info__()
+    return MOVIE_INFO
+
+
+def fetch():
+    __initialize_meta_info__()

@@ -1,0 +1,79 @@
+"""UCI housing dataset (ref: python/paddle/dataset/uci_housing.py).
+
+Parses the real whitespace-separated 14-column file when cached locally;
+otherwise serves a deterministic synthetic sample with the same schema
+(13 normalized features, 1 target).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+feature_names = [
+    'CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS', 'RAD', 'TAX',
+    'PTRATIO', 'B', 'LSTAT', 'convert',
+]
+
+UCI_TRAIN_DATA = None
+UCI_TEST_DATA = None
+
+
+def load_data(filename, feature_num=14, ratio=0.8):
+    global UCI_TRAIN_DATA, UCI_TEST_DATA
+    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None:
+        return
+    if filename is not None:
+        data = np.fromfile(filename, sep=' ')
+    else:
+        rng = np.random.RandomState(0)
+        data = rng.uniform(0.0, 10.0, size=506 * feature_num)
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums, minimums, avgs = (data.max(axis=0), data.min(axis=0),
+                                data.sum(axis=0) / data.shape[0])
+    for i in range(feature_num - 1):
+        span = maximums[i] - minimums[i]
+        data[:, i] = (data[:, i] - avgs[i]) / (span if span else 1.0)
+    offset = int(data.shape[0] * ratio)
+    UCI_TRAIN_DATA = data[:offset]
+    UCI_TEST_DATA = data[offset:]
+
+
+def _ensure_loaded():
+    load_data(common.cached_path('uci_housing', 'housing.data'))
+
+
+def train():
+    """Reader creator yielding (features[13], price[1]) samples."""
+    _ensure_loaded()
+
+    def reader():
+        for d in UCI_TRAIN_DATA:
+            yield d[:-1], d[-1:]
+
+    return reader
+
+
+def test():
+    _ensure_loaded()
+
+    def reader():
+        for d in UCI_TEST_DATA:
+            yield d[:-1], d[-1:]
+
+    return reader
+
+
+def predict_reader():
+    _ensure_loaded()
+
+    def reader():
+        yield (UCI_TEST_DATA[0][:-1],)
+
+    return reader
+
+
+def fetch():
+    _ensure_loaded()

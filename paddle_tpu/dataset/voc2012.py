@@ -1,0 +1,39 @@
+"""VOC2012 segmentation reader API (ref: python/paddle/dataset/voc2012.py).
+
+Delegates to paddle_tpu.vision.datasets.VOC2012 (real files when cached,
+synthetic fallback otherwise). Samples: (image CHW uint8, label map HW).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.datasets import VOC2012
+
+__all__ = []
+
+
+def reader_creator(mode):
+    ds = VOC2012(mode=mode, download=False)
+
+    def reader():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield np.asarray(img), np.asarray(label)
+
+    return reader
+
+
+def train():
+    return reader_creator('train')
+
+
+def test():
+    return reader_creator('test')
+
+
+def val():
+    return reader_creator('valid')
+
+
+def fetch():
+    pass

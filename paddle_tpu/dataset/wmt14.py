@@ -1,0 +1,78 @@
+"""WMT14 en→fr translation dataset (ref: python/paddle/dataset/wmt14.py).
+
+Synthetic parallel corpus fallback with the reference's token conventions:
+<s>=0 (START), <e>=1 (END), <unk>=2 (UNK).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def _synth_pairs(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    en = ["the", "cat", "dog", "house", "runs", "sees", "a", "red"]
+    fr = ["le", "chat", "chien", "maison", "court", "voit", "un", "rouge"]
+    for _ in range(n):
+        length = rng.randint(3, 12)
+        idxs = [int(rng.randint(len(en))) for _ in range(length)]
+        yield ([en[i] for i in idxs], [fr[i] for i in idxs])
+
+
+def __read_to_dict(dict_size):
+    words = sorted({w for s, t in _synth_pairs() for w in s})
+    twords = sorted({w for s, t in _synth_pairs() for w in t})
+
+    def to_dict(ws):
+        d = {START: 0, END: 1, UNK: 2}
+        for w in ws[:dict_size - 3]:
+            d[w] = len(d)
+        return d
+
+    return to_dict(words), to_dict(twords)
+
+
+def reader_creator(which, dict_size):
+    def reader():
+        src_dict, trg_dict = __read_to_dict(dict_size)
+        seed = 0 if which == 'train' else 1
+        for src_words, trg_words in _synth_pairs(seed=seed):
+            src_ids = [src_dict.get(w, UNK_IDX) for w in src_words]
+            trg_ids = [trg_dict.get(w, UNK_IDX) for w in trg_words]
+            trg_ids_next = trg_ids + [trg_dict[END]]
+            trg_ids = [trg_dict[START]] + trg_ids
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size):
+    return reader_creator('train', dict_size)
+
+
+def test(dict_size):
+    return reader_creator('test', dict_size)
+
+
+def gen(dict_size):
+    return reader_creator('gen', dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    src_dict, trg_dict = __read_to_dict(dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    pass

@@ -1,0 +1,102 @@
+"""WMT16 multimodal en/de translation (ref: python/paddle/dataset/wmt16.py).
+
+Synthetic fallback; same token conventions as the reference: <s>=0, <e>=1,
+<unk>=2, configurable src/trg dict sizes and language direction.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = []
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+_EN = ["the", "cat", "dog", "house", "runs", "sees", "a", "red", "man", "tree"]
+_DE = ["die", "katze", "hund", "haus", "läuft", "sieht", "ein", "rot",
+       "mann", "baum"]
+
+
+def _synth_pairs(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        length = rng.randint(3, 12)
+        idxs = [int(rng.randint(len(_EN))) for _ in range(length)]
+        yield ([_EN[i] for i in idxs], [_DE[i] for i in idxs])
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = min(src_dict_size,
+                        TOTAL_EN_WORDS if src_lang == "en" else TOTAL_DE_WORDS)
+    trg_dict_size = min(trg_dict_size,
+                        TOTAL_DE_WORDS if src_lang == "en" else TOTAL_EN_WORDS)
+    return src_dict_size, trg_dict_size
+
+
+def __load_dict(dict_size, lang, reverse=False):
+    base = _EN if lang == "en" else _DE
+    d = {START_MARK: 0, END_MARK: 1, UNK_MARK: 2}
+    for w in base[:max(0, dict_size - 3)]:
+        d[w] = len(d)
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def reader_creator(which, src_dict_size, trg_dict_size, src_lang):
+    def reader():
+        src_dict = __load_dict(src_dict_size, src_lang)
+        trg_dict = __load_dict(trg_dict_size,
+                               "de" if src_lang == "en" else "en")
+        unk = src_dict[UNK_MARK]
+        seed = {"train": 0, "test": 1, "val": 2}.get(which, 0)
+        for en_words, de_words in _synth_pairs(seed=seed):
+            s, t = (en_words, de_words) if src_lang == "en" else (de_words,
+                                                                  en_words)
+            src_ids = [src_dict.get(w, unk) for w in s]
+            trg_ids = [trg_dict.get(w, trg_dict[UNK_MARK]) for w in t]
+            trg_ids_next = trg_ids + [trg_dict[END_MARK]]
+            trg_ids = [trg_dict[START_MARK]] + trg_ids
+            yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator('train', src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator('test', src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    if src_lang not in ["en", "de"]:
+        raise ValueError("An error language type. Only support: "
+                         "en (for English); de(for Germany).")
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator('val', src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    dict_size = min(dict_size,
+                    TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS)
+    return __load_dict(dict_size, lang, reverse)
+
+
+def fetch():
+    pass

@@ -1,6 +1,7 @@
 """paddle.nn parity surface (ref: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,
                    clip_grad_value_)
 from .layer_base import Layer

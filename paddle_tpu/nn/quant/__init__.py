@@ -1,0 +1,15 @@
+"""paddle.nn.quant parity (ref: python/paddle/nn/quant/__init__.py)."""
+from . import functional_layers  # noqa: F401
+from .functional_layers import (  # noqa: F401
+    add, concat, divide, flatten, matmul, multiply, reshape, subtract,
+    transpose,
+)
+from .lsq import FakeQuantActLSQPlus, FakeQuantWeightLSQPlus  # noqa: F401
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax, FakeQuantChannelWiseAbsMax, FakeQuantMAOutputScaleLayer,
+    FakeQuantMovingAverageAbsMax, MAOutputScaleLayer, MovingAverageAbsMaxScale,
+    QuantizedColumnParallelLinear, QuantizedConv2D, QuantizedConv2DTranspose,
+    QuantizedLinear, QuantizedMatmul, QuantizedRowParallelLinear,
+)
+
+__all__ = []

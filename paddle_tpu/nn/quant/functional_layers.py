@@ -1,0 +1,58 @@
+"""Layer wrappers of functional ops so QAT passes can hook their outputs
+(ref: python/paddle/nn/quant/functional_layers.py)."""
+from __future__ import annotations
+
+from ... import tensor as T
+from ..layer_base import Layer
+
+__all__ = []
+
+
+class FloatFunctionalLayer(Layer):
+    def __init__(self):
+        super().__init__()
+
+
+class add(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return T.add(x, y)
+
+
+class subtract(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return T.subtract(x, y)
+
+
+class multiply(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return T.multiply(x, y)
+
+
+class divide(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return T.divide(x, y)
+
+
+class reshape(FloatFunctionalLayer):
+    def forward(self, x, shape, name=None):
+        return T.reshape(x, shape)
+
+
+class transpose(FloatFunctionalLayer):
+    def forward(self, x, perm, name=None):
+        return T.transpose(x, perm)
+
+
+class concat(FloatFunctionalLayer):
+    def forward(self, x, axis=0, name=None):
+        return T.concat(x, axis)
+
+
+class flatten(FloatFunctionalLayer):
+    def forward(self, x, start_axis=0, stop_axis=-1, name=None):
+        return T.flatten(x, start_axis, stop_axis)
+
+
+class matmul(FloatFunctionalLayer):
+    def forward(self, x, y, transpose_x=False, transpose_y=False, name=None):
+        return T.matmul(x, y, transpose_x, transpose_y)

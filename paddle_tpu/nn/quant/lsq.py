@@ -1,0 +1,96 @@
+"""LSQ/LSQ+ learned-step-size quantizers (ref: python/paddle/nn/quant/lsq.py).
+
+TPU design: the straight-through estimator with learned scale (and offset for
+activations) is expressed with jnp + stop_gradient, so the whole quantizer
+stays inside the jitted graph — no PyLayer needed.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Parameter, Tensor
+from ...framework.dispatch import apply_op
+from ..initializer import Constant
+from ..layer_base import Layer
+
+__all__ = ["FakeQuantActLSQPlus", "FakeQuantWeightLSQPlus"]
+
+
+def _round_ste(x):
+    return x + lax.stop_gradient(jnp.round(x) - x)
+
+
+def _grad_scale(x, scale):
+    # y = x in value, but grad(y) = grad(x) * scale (LSQ gradient scaling)
+    return x * scale + lax.stop_gradient(x - x * scale)
+
+
+class FakeQuantActLSQPlus(Layer):
+    """Activation LSQ+ quantizer with learned scale + offset (ref lsq.py:137)."""
+
+    def __init__(self, quant_bits=8, all_postive=False, symmetric=True,
+                 batch_init=20, dtype='float32', name=None, reduce_type=None):
+        super().__init__()
+        if all_postive:
+            self.qmin, self.qmax = 0, 2 ** quant_bits - 1
+        else:
+            self.qmin = -2 ** (quant_bits - 1)
+            self.qmax = 2 ** (quant_bits - 1) - 1
+        self.symmetric = symmetric
+        self.s = self.create_parameter([1], default_initializer=Constant(1.0))
+        self.beta = self.create_parameter(
+            [1], default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        def _q(xv, s, beta):
+            g = 1.0 / math.sqrt(xv.size * self.qmax) if xv.size else 1.0
+            s_ = jnp.maximum(_grad_scale(s, g), 1e-7)
+            if self.symmetric:
+                q = jnp.clip(_round_ste(xv / s_), self.qmin, self.qmax)
+                return q * s_
+            b_ = _grad_scale(beta, g)
+            q = jnp.clip(_round_ste((xv - b_) / s_), self.qmin, self.qmax)
+            return q * s_ + b_
+
+        return apply_op(_q, x, self.s, self.beta)
+
+
+class FakeQuantWeightLSQPlus(Layer):
+    """Weight LSQ+ quantizer, optionally per-channel (ref lsq.py:248)."""
+
+    def __init__(self, quant_bits=8, all_postive=False, per_channel=False,
+                 batch_init=20, channel_num=None, quant_linear=False,
+                 dtype='float32', name=None, reduce_type=None):
+        super().__init__()
+        self.qmin = -2 ** (quant_bits - 1)
+        self.qmax = 2 ** (quant_bits - 1) - 1
+        self.per_channel = per_channel
+        n = channel_num if (per_channel and channel_num) else 1
+        self.s = self.create_parameter([n], default_initializer=Constant(1.0))
+        self._initialized = False
+
+    def forward(self, w):
+        wv = w.value if isinstance(w, Tensor) else jnp.asarray(w)
+        if not self._initialized:
+            # LSQ init: s = 2*mean(|w|)/sqrt(qmax)
+            if self.per_channel and self.s.shape[0] > 1:
+                axes = tuple(range(1, wv.ndim))
+                init = 2 * jnp.mean(jnp.abs(wv), axis=axes) / math.sqrt(self.qmax)
+            else:
+                init = jnp.full((self.s.shape[0],),
+                                2 * jnp.mean(jnp.abs(wv)) / math.sqrt(self.qmax))
+            self.s._value = init.astype(self.s.value.dtype)
+            self._initialized = True
+
+        def _q(wv, s):
+            g = 1.0 / math.sqrt(wv.size * self.qmax) if wv.size else 1.0
+            s_ = jnp.maximum(_grad_scale(s, g), 1e-7)
+            if self.per_channel and s_.shape[0] > 1:
+                s_ = s_.reshape((-1,) + (1,) * (wv.ndim - 1))
+            q = jnp.clip(_round_ste(wv / s_), self.qmin, self.qmax)
+            return q * s_
+
+        return apply_op(_q, w, self.s)

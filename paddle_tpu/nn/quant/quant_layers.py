@@ -1,0 +1,198 @@
+"""QAT layer wrappers (ref: python/paddle/nn/quant/quant_layers.py).
+
+TPU design: fake-quant is a straight-through-estimator elementwise op that XLA
+fuses into the surrounding matmul/conv; "quantized" layers are their float
+layers with weight/activation fake-quant applied in forward. The
+moving-average observers reuse paddle_tpu.quantization's observer machinery.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...quantization import FakeQuanterWithAbsMaxObserverLayer, fake_quant
+from .. import functional as F
+from ..layer_base import Layer
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax", "MovingAverageAbsMaxScale",
+    "QuantizedConv2D", "QuantizedConv2DTranspose", "QuantizedLinear",
+    "QuantizedColumnParallelLinear", "QuantizedRowParallelLinear",
+    "QuantizedMatmul", "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+]
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quant (ref quant_layers.py:50)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype='float32',
+                 quant_on_weight=False, reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        return fake_quant(x, bits=self._quant_bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel abs-max fake quant (ref quant_layers.py:289)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype='float32', quant_on_weight=False,
+                 reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+
+    def forward(self, x):
+        axes = tuple(i for i in range(x.ndim) if i != self._quant_axis)
+        return fake_quant(x, bits=self._quant_bits, axis=axes)
+
+
+class FakeQuantMovingAverageAbsMax(FakeQuanterWithAbsMaxObserverLayer):
+    """Moving-average abs-max activation fake quant (ref quant_layers.py:150)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype='float32', reduce_type=None):
+        super().__init__(moving_rate=moving_rate, bit_length=quant_bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Records moving-average output scale without quantizing
+    (ref quant_layers.py:399)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype='float32',
+                 reduce_type=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.scale = 0.0
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(jnp.asarray(
+            x.value if hasattr(x, "value") else x))))
+        self.scale = (self._moving_rate * self.scale
+                      + (1 - self._moving_rate) * cur)
+        return x
+
+
+class _QuantPair(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max'):
+        super().__init__()
+        self.inner = layer
+        if activation_quantize_type == 'moving_average_abs_max':
+            self._fake_quant_input = FakeQuantMovingAverageAbsMax(
+                moving_rate=moving_rate, quant_bits=activation_bits)
+        else:
+            self._fake_quant_input = FakeQuantAbsMax(quant_bits=activation_bits)
+        if weight_quantize_type == 'channel_wise_abs_max':
+            self._fake_quant_weight = FakeQuantChannelWiseAbsMax(
+                quant_bits=weight_bits)
+        else:
+            self._fake_quant_weight = FakeQuantAbsMax(quant_bits=weight_bits)
+
+    def _qw(self):
+        return self._fake_quant_weight(self.inner.weight)
+
+    def _qx(self, x):
+        return self._fake_quant_input(x)
+
+
+class QuantizedConv2D(_QuantPair):
+    """Conv2D with fake-quant on weight + input (ref quant_layers.py:515)."""
+
+    def forward(self, x):
+        c = self.inner
+        return F.conv2d(self._qx(x), self._qw(), c.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups, data_format=c._data_format)
+
+
+class QuantizedConv2DTranspose(_QuantPair):
+    """Conv2DTranspose with fake quant (ref quant_layers.py:614)."""
+
+    def forward(self, x):
+        c = self.inner
+        return F.conv2d_transpose(
+            self._qx(x), self._qw(), c.bias, stride=c._stride,
+            padding=c._padding, dilation=c._dilation, groups=c._groups,
+            output_padding=getattr(c, "_output_padding", 0),
+            data_format=c._data_format)
+
+
+class QuantizedLinear(_QuantPair):
+    """Linear with fake quant (ref quant_layers.py:730)."""
+
+    def forward(self, x):
+        return F.linear(self._qx(x), self._qw(), self.inner.bias)
+
+
+class QuantizedColumnParallelLinear(_QuantPair):
+    """TP column-parallel linear with fake quant (ref quant_layers.py:807).
+    Quantization is per-shard; the gather/allreduce stays in the inner layer."""
+
+    def forward(self, x):
+        inner = self.inner
+        w = self._qw()
+        orig_w = inner.weight
+        try:
+            inner.weight = w
+            return inner(self._qx(x))
+        finally:
+            inner.weight = orig_w
+
+
+class QuantizedRowParallelLinear(QuantizedColumnParallelLinear):
+    """TP row-parallel linear with fake quant (ref quant_layers.py:903)."""
+
+
+class QuantizedMatmul(Layer):
+    """matmul with fake quant on both operands (ref quant_layers.py:1003)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 **kw):
+        super().__init__()
+        self._bits = activation_bits
+
+    def forward(self, x, y, transpose_x=False, transpose_y=False, name=None):
+        from ... import tensor as T
+
+        return T.matmul(fake_quant(x, self._bits), fake_quant(y, self._bits),
+                        transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer, record its output moving-average scale
+    (ref quant_layers.py:1062)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, name=None,
+                 dtype='float32', reduce_type=None):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(
+            name, moving_rate, dtype)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)) and len(out) > 1:
+            return out
+        return self._ma_output_scale(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer, fake-quant its output with a moving-average scale
+    (ref quant_layers.py:1100)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, *args, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, (list, tuple)) and len(out) > 1:
+            return out
+        return self._fake_quant_output(out)

@@ -29,11 +29,14 @@ class Optimizer:
         if isinstance(weight_decay, (int, float)):
             self._weight_decay = float(weight_decay)
             self._l2_coeff = float(weight_decay)
+            self._reg_mode = "l2"
         else:
             self._weight_decay = weight_decay
             self._l2_coeff = getattr(weight_decay, "_coeff",
                                      getattr(weight_decay, "_regularization_coeff", 0.0)) \
                 if weight_decay is not None else 0.0
+            # L1Decay folds coeff*sign(w); L2Decay folds coeff*w (paddle semantics)
+            self._reg_mode = getattr(weight_decay, "_mode", "l2")
         # per-param slot state: name -> dict of arrays
         self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
         self._global_step = 0
@@ -128,7 +131,7 @@ class Optimizer:
             slots = self._slots_for(p)
             g_val = g.value.astype(jnp.float32)
             if self._l2_coeff and self._use_l2_decay():
-                g_val = g_val + self._l2_coeff * p.value.astype(jnp.float32)
+                g_val = g_val + self._reg_grad(p.value.astype(jnp.float32), p)
             new_val, new_slots = self._apply_one(
                 p.value, g_val, lr, self._global_step,
                 {k: v for k, v in slots.items() if not k.startswith("__")})
@@ -137,6 +140,16 @@ class Optimizer:
 
     def _use_l2_decay(self) -> bool:
         return True  # L2 regularization folded into grads (paddle weight_decay semantics)
+
+    def _reg_grad(self, pval, p=None):
+        """d(penalty)/d(w), honouring a per-param ParamAttr regularizer override
+        (ref: python/paddle/fluid/regularizer.py append_regularization_ops)."""
+        reg = getattr(p, "regularizer", None) if p is not None else None
+        if reg is not None:
+            return reg(pval)
+        if self._reg_mode == "l1":
+            return self._l2_coeff * jnp.sign(pval)
+        return self._l2_coeff * pval
 
     def _apply_one(self, param, grad, lr, step, slots):
         raise NotImplementedError
@@ -165,7 +178,7 @@ class Optimizer:
                 continue
             g = g.astype(jnp.float32)
             if self._l2_coeff and self._use_l2_decay():
-                g = g + self._l2_coeff * p.astype(jnp.float32)
+                g = g + self._reg_grad(p.astype(jnp.float32))
             np_, ns = self._apply_one(p, g, lr, step, state.get(name, {}))
             new_params[name] = np_
             new_state[name] = ns

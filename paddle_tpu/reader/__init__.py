@@ -1,0 +1,7 @@
+"""paddle.reader parity (ref: python/paddle/reader/__init__.py)."""
+from .decorator import (  # noqa: F401
+    ComposeNotAligned, buffered, cache, chain, compose, firstn, map_readers,
+    multiprocess_reader, shuffle, xmap_readers,
+)
+
+__all__ = []

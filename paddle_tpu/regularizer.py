@@ -1,0 +1,51 @@
+"""Weight-decay regularizers (ref: python/paddle/regularizer.py).
+
+Paddle semantics: a regularizer set on an optimizer's ``weight_decay`` (or on a
+parameter's ``ParamAttr.regularizer``, which takes precedence) is folded into
+the gradient before the update rule runs: ``grad += coeff * d penalty / d w``.
+For L2 that is ``coeff * w``; for L1, ``coeff * sign(w)``.
+
+TPU note: the fold happens inside the jitted update step, so XLA fuses it into
+the optimizer elementwise kernel — no extra HBM pass.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base class (ref: python/paddle/fluid/regularizer.py)."""
+
+    _mode = "l2"
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        # legacy alias used by fluid-era code paths
+        self._regularization_coeff = self._coeff
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param):
+        """Return d(penalty)/d(param) to be added to the gradient."""
+        if self._mode == "l1":
+            return self._coeff * jnp.sign(param)
+        return self._coeff * param
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: loss += coeff * sum(|w|) (ref regularizer.py L1Decay)."""
+
+    _mode = "l1"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: loss += 0.5 * coeff * sum(w^2) (ref regularizer.py L2Decay)."""
+
+    _mode = "l2"

