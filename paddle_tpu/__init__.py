@@ -114,23 +114,24 @@ def is_compiled_with_tpu() -> bool:
 
 
 def in_dynamic_mode() -> bool:
-    """Eager unless inside a jax trace (to_static / jit)."""
-    import jax.core as jcore
+    """False while static-graph building is enabled (paddle.enable_static)."""
+    from .static.graph import in_static_mode
 
-    try:
-        return not isinstance(jcore.get_aval(0), type(None)) and True
-    except Exception:
-        return True
+    return not in_static_mode()
 
 
 def disable_static(place=None):
-    return None
+    from .static.graph import disable_static_mode
+
+    disable_static_mode()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled execution "
-        "(the ProgramDesc static graph is replaced by jaxpr/XLA).")
+    """Switch to static-graph building: subsequent ops on static Variables
+    record into the default main Program (see paddle_tpu/static/graph.py)."""
+    from .static.graph import enable_static_mode
+
+    enable_static_mode()
 
 
 def grad(*args, **kwargs):

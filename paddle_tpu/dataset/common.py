@@ -17,10 +17,9 @@ DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
 
 
 def must_mkdirs(path):
+    # called from download()/cached_path(), NOT at import time: importing
+    # paddle_tpu must not write to the filesystem (read-only $HOME safe)
     os.makedirs(path, exist_ok=True)
-
-
-must_mkdirs(DATA_HOME)
 
 
 def md5file(fname):

@@ -110,7 +110,9 @@ def _parse_zip(fn):
             for line in rating:
                 line = line.decode(encoding='latin1')
                 uid, mov_id, rat, _ = line.strip().split("::")
-                RATINGS.append((int(uid), int(mov_id), float(rat)))
+                # ref python/paddle/dataset/movielens.py:167 — ratings are
+                # rescaled from [1,5] to [-3,5]
+                RATINGS.append((int(uid), int(mov_id), float(rat) * 2 - 5.0))
 
 
 def __initialize_meta_info__():

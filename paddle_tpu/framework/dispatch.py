@@ -20,6 +20,8 @@ from .core import Tensor, TapeNode, is_grad_enabled, to_array
 from .dtype import is_floating_point
 from .flags import GLOBAL_FLAGS
 
+_static_graph = None  # lazily bound paddle_tpu.static.graph module
+
 
 def _check_nan_inf(name, arrays):
     import numpy as np
@@ -38,7 +40,22 @@ def apply_op(fn: Callable, *args, n_outputs: Optional[int] = None, op_name: str 
 
     Positional args may be Tensors, jax arrays, or python scalars; kwargs are
     static. Returns Tensor (or tuple of Tensors when fn returns a sequence).
+
+    Static-graph build: when a paddle_tpu.static program is being built and a
+    static Variable is among the inputs, the op is RECORDED into the current
+    Program instead of executed (the analogue of LayerHelper.append_op in
+    every reference tensor function, ref python/paddle/tensor/*).
     """
+    global _static_graph
+    if _static_graph is None:
+        from ..static import graph as _sg
+
+        _static_graph = _sg
+    if _static_graph.static_build_active() and any(
+            isinstance(a, _static_graph.Variable) for a in args):
+        return _static_graph.record_op(fn, args, kwargs,
+                                       op_name or getattr(fn, "__name__", "op"))
+
     raw = [to_array(a) if isinstance(a, Tensor) else a for a in args]
 
     # AMP O1/O2 autocast at dispatch time (ref eager_gen.py:415 AMP_LOGIC_TEMPLATE;

@@ -68,16 +68,20 @@ class FakeQuantWeightLSQPlus(Layer):
         self.qmin = -2 ** (quant_bits - 1)
         self.qmax = 2 ** (quant_bits - 1) - 1
         self.per_channel = per_channel
+        # channel axis: conv weights are [out, in, ...] (axis 0); Linear
+        # weights in this codebase are [in, out] (quant_linear -> last axis)
+        self.quant_axis = -1 if quant_linear else 0
         n = channel_num if (per_channel and channel_num) else 1
         self.s = self.create_parameter([n], default_initializer=Constant(1.0))
         self._initialized = False
 
     def forward(self, w):
         wv = w.value if isinstance(w, Tensor) else jnp.asarray(w)
+        axis = self.quant_axis % wv.ndim
         if not self._initialized:
             # LSQ init: s = 2*mean(|w|)/sqrt(qmax)
             if self.per_channel and self.s.shape[0] > 1:
-                axes = tuple(range(1, wv.ndim))
+                axes = tuple(i for i in range(wv.ndim) if i != axis)
                 init = 2 * jnp.mean(jnp.abs(wv), axis=axes) / math.sqrt(self.qmax)
             else:
                 init = jnp.full((self.s.shape[0],),
@@ -89,7 +93,9 @@ class FakeQuantWeightLSQPlus(Layer):
             g = 1.0 / math.sqrt(wv.size * self.qmax) if wv.size else 1.0
             s_ = jnp.maximum(_grad_scale(s, g), 1e-7)
             if self.per_channel and s_.shape[0] > 1:
-                s_ = s_.reshape((-1,) + (1,) * (wv.ndim - 1))
+                bshape = [1] * wv.ndim
+                bshape[axis] = -1
+                s_ = s_.reshape(bshape)
             q = jnp.clip(_round_ste(wv / s_), self.qmin, self.qmax)
             return q * s_
 

@@ -65,13 +65,18 @@ class MovingAverageAbsMaxScale(Layer):
                  reduce_type=None):
         super().__init__()
         self._moving_rate = moving_rate
-        self.scale = 0.0
+        self.register_buffer("scale", jnp.zeros([], dtype=dtype))
 
     def forward(self, x):
-        cur = float(jnp.max(jnp.abs(jnp.asarray(
-            x.value if hasattr(x, "value") else x))))
-        self.scale = (self._moving_rate * self.scale
-                      + (1 - self._moving_rate) * cur)
+        import jax
+
+        cur = jnp.max(jnp.abs(jnp.asarray(
+            x.value if hasattr(x, "value") else x))).astype(self.scale.dtype)
+        # trace-safe: under jit the update is skipped (a tracer must not leak
+        # into layer state); eagerly the scale stays on-device, no host sync
+        if not isinstance(cur, jax.core.Tracer):
+            self.scale._value = (self._moving_rate * self.scale.value
+                                 + (1 - self._moving_rate) * cur)
         return x
 
 

@@ -130,7 +130,8 @@ class Optimizer:
                 continue
             slots = self._slots_for(p)
             g_val = g.value.astype(jnp.float32)
-            if self._l2_coeff and self._use_l2_decay():
+            if self._use_l2_decay() and (
+                    self._l2_coeff or getattr(p, "regularizer", None) is not None):
                 g_val = g_val + self._reg_grad(p.value.astype(jnp.float32), p)
             new_val, new_slots = self._apply_one(
                 p.value, g_val, lr, self._global_step,
@@ -191,6 +192,18 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import Variable as _StaticVar
+
+        if isinstance(loss, _StaticVar):
+            # static-graph branch (ref Optimizer.minimize appending backward +
+            # update ops): mark the program; Executor.run fuses jax.grad +
+            # pure_update into one XLA train step.
+            prog = loss.program
+            prog.loss_name = loss.var_name
+            prog.optimizer = self
+            prog._version += 1
+            return None, [(p, f"{getattr(p, 'name', 'param')}@GRAD")
+                          for p in prog.params.values()]
         loss.backward()
         self.step()
         return None, None

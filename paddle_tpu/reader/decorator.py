@@ -152,10 +152,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     (ref :406). With order=True output order matches input order."""
     end = XmapEndSignal()
 
-    in_queue = _queue.Queue(buffer_size)
-    out_queue = _queue.Queue(buffer_size)
-    out_order = [0]
-
     def read_worker(r, q):
         for i in r():
             q.put(i)
@@ -188,6 +184,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q.put(end)
 
     def xreader():
+        # fresh queues/order counter per call — the reader must be re-iterable
+        # across epochs (ref decorator.py xreader creates them per invocation)
+        in_queue = _queue.Queue(buffer_size)
+        out_queue = _queue.Queue(buffer_size)
+        out_order = [0]
         target = order_read_worker if order else read_worker
         t = threading.Thread(target=target, args=(reader, in_queue))
         t.daemon = True

@@ -1,48 +1,42 @@
-"""paddle.static shim.
+"""paddle.static — working static-graph facade.
 
-The reference's static graph (ProgramDesc IR + Executor,
-ref python/paddle/static/) is replaced by jaxpr + XLA under
-paddle_tpu.jit.to_static. This module keeps the most-used static symbols
-importable so user code ports cleanly; Program-building APIs raise with
-guidance.
+The reference's ProgramDesc IR + standalone executor
+(ref python/paddle/static/, fluid/executor.py:921,
+framework/new_executor/interpretercore.h:42) are re-designed TPU-first:
+a Program is a recorded op list captured at the central eager dispatch
+point; Executor.run replays it as ONE pure function under jax.jit, so
+XLA does dependency analysis / scheduling / memory planning.  See
+paddle_tpu/static/graph.py for the design notes.
 """
 from __future__ import annotations
 
-from ..jit import InputSpec
+from ..jit import InputSpec  # noqa: F401
+from .graph import (CompiledProgram, Executor, GradMarker,  # noqa: F401
+                    ParallelExecutor, Program, Scope, Variable,
+                    append_backward, data, default_main_program,
+                    default_startup_program, global_scope, gradients,
+                    load_inference_model, program_guard,
+                    reset_default_programs, save_inference_model, scope_guard)
+from . import nn  # noqa: F401
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape=shape, dtype=dtype, name=name)
+def name_scope(prefix=None):
+    import contextlib
+
+    return contextlib.nullcontext()
 
 
-class Program:
-    def __init__(self):
-        raise NotImplementedError(
-            "paddle_tpu has no ProgramDesc IR; use paddle_tpu.jit.to_static (jaxpr/XLA) "
-            "for compiled execution.")
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    import jax.numpy as jnp
+
+    from ..framework.core import Parameter
+    from .graph import _register_param, current_programs
+
+    p = Parameter(jnp.full(shape, value, dtype=dtype), trainable=False,
+                  name=name or "")
+    main, startup = current_programs()
+    _register_param(main, p, startup)
+    return p
 
 
-def default_main_program():
-    raise NotImplementedError("No static graph: see paddle_tpu.jit.to_static")
-
-
-def default_startup_program():
-    raise NotImplementedError("No static graph: see paddle_tpu.jit.to_static")
-
-
-class Executor:
-    def __init__(self, place=None):
-        raise NotImplementedError(
-            "The standalone executor (ref interpretercore.cc) is replaced by XLA; "
-            "run models eagerly or under paddle_tpu.jit.to_static.")
-
-
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
-    raise NotImplementedError("Use paddle_tpu.jit.save / paddle_tpu.inference export")
-
-
-def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError("Use paddle_tpu.jit.load")
-
-
-from . import nn  # noqa: E402,F401

@@ -1,0 +1,670 @@
+"""Working static-graph facade: Program / Variable / Executor.
+
+Reference surface: python/paddle/static (Program/Executor API,
+python/paddle/fluid/executor.py:921 Executor, fluid/framework.py
+Program/Block/Variable/Parameter) and the static training idiom::
+
+    paddle.enable_static()
+    with static.program_guard(main, startup):
+        x = static.data('x', [None, 4])
+        out = static.nn.fc(x, 8)
+        loss = paddle.mean(out)
+        paddle.optimizer.SGD(0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    exe.run(main, feed={'x': ...}, fetch_list=[loss])
+
+TPU-native redesign: there is no ProgramDesc protobuf IR and no
+InterpreterCore (ref framework/new_executor/interpretercore.h:42).  A
+``Program`` here is a recorded list of pure-jax op closures captured at the
+central dispatch point (framework/dispatch.py:apply_op) — every op of our
+~300-op surface is recordable with zero per-op work, the analogue of the
+reference's LayerHelper.append_op happening inside every tensor function.
+``Executor.run`` replays the op list as ONE pure function and hands it to
+``jax.jit`` — XLA is the standalone executor: dependency analysis, stream
+assignment and memory planning (ref interpreter/dependency_builder.cc,
+stream_analyzer.cc) all happen inside the compiler.  ``minimize`` on a
+Program records the optimizer; grads come from ``jax.grad`` of the replayed
+loss (the analogue of fluid/backward.py append_backward) and the update uses
+the optimizer's ``pure_update`` — so a static train step is a single fused
+XLA program: feeds+params in, fetches+new params out.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor
+
+
+class _BuildState(threading.local):
+    def __init__(self):
+        self.static_mode = False
+        self.guard_stack: List[Tuple["Program", Optional["Program"]]] = []
+        self.counter = 0
+
+    def fresh_name(self, prefix="tmp"):
+        self.counter += 1
+        return f"{prefix}_{self.counter}"
+
+
+_STATE = _BuildState()
+
+
+def enable_static_mode():
+    _STATE.static_mode = True
+
+
+def disable_static_mode():
+    _STATE.static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _STATE.static_mode
+
+
+def current_programs() -> Tuple["Program", Optional["Program"]]:
+    if _STATE.guard_stack:
+        return _STATE.guard_stack[-1]
+    return default_main_program(), default_startup_program()
+
+
+def static_build_active() -> bool:
+    return _STATE.static_mode or bool(_STATE.guard_stack)
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (ref fluid/framework.py Variable).
+
+    Carries only shape/dtype metadata; ``_value`` holds a zero placeholder
+    (dynamic dims -> 1) so shape-dependent Python in layer code keeps
+    working during graph build."""
+
+    def __init__(self, name: str, shape, dtype, program: "Program",
+                 is_feed: bool = False):
+        self.sym_shape = [(-1 if d in (None, -1) else int(d)) for d in shape]
+        placeholder = jnp.zeros([1 if d == -1 else d for d in self.sym_shape],
+                               dtype=dtype)
+        super().__init__(placeholder, stop_gradient=True, name=name)
+        self.var_name = name
+        self.program = program
+        self.is_feed = is_feed
+        self.persistable = False
+
+    def __repr__(self):
+        return (f"Variable(name={self.var_name}, shape={self.sym_shape}, "
+                f"dtype={self.dtype})")
+
+
+class Operator:
+    """One recorded op: pure fn + input refs + static kwargs + output names."""
+
+    __slots__ = ("fn", "in_refs", "kwargs", "out_names", "op_name", "multi")
+
+    def __init__(self, fn, in_refs, kwargs, out_names, op_name, multi):
+        self.fn = fn
+        self.in_refs = in_refs        # list of ("var", name)|("param", name)|("const", np)
+        self.kwargs = kwargs
+        self.out_names = out_names
+        self.op_name = op_name
+        self.multi = multi
+
+    @property
+    def type(self):
+        return self.op_name
+
+
+class Block:
+    def __init__(self, program):
+        self.program = program
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+    @property
+    def vars(self):
+        return self.program.vars
+
+    def var(self, name):
+        return self.program.vars[name]
+
+    def all_parameters(self):
+        return list(self.program.params.values())
+
+
+class Program:
+    """Recorded op graph (ref fluid/framework.py Program; no protobuf IR —
+    jaxpr/XLA takes that role at Executor.run time)."""
+
+    def __init__(self):
+        self.ops: List[Operator] = []
+        self.vars: Dict[str, Variable] = {}
+        self.params: Dict[str, Parameter] = {}
+        self.feeds: List[str] = []
+        self.loss_name: Optional[str] = None
+        self.optimizer = None
+        self._block = Block(self)
+        self.random_seed = None
+        self._version = 0
+
+    def global_block(self):
+        return self._block
+
+    def blocks(self):
+        return [self._block]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def clone(self, for_test: bool = False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p.params = dict(self.params)
+        p.feeds = list(self.feeds)
+        p.loss_name = self.loss_name
+        p.optimizer = None if for_test else self.optimizer
+        return p
+
+    def __str__(self):
+        lines = [f"Program({len(self.ops)} ops, {len(self.params)} params)"]
+        for op in self.ops:
+            ins = ", ".join(f"{k}:{v if k != 'const' else '<const>'}"
+                            for k, v in op.in_refs)
+            lines.append(f"  {op.op_name}({ins}) -> {', '.join(op.out_names)}")
+        return "\n".join(lines)
+
+
+_DEFAULT_MAIN: Optional[Program] = None
+_DEFAULT_STARTUP: Optional[Program] = None
+
+
+def default_main_program() -> Program:
+    global _DEFAULT_MAIN
+    if _DEFAULT_MAIN is None:
+        _DEFAULT_MAIN = Program()
+    return _DEFAULT_MAIN
+
+
+def default_startup_program() -> Program:
+    global _DEFAULT_STARTUP
+    if _DEFAULT_STARTUP is None:
+        _DEFAULT_STARTUP = Program()
+    return _DEFAULT_STARTUP
+
+
+def reset_default_programs():
+    global _DEFAULT_MAIN, _DEFAULT_STARTUP
+    _DEFAULT_MAIN = Program()
+    _DEFAULT_STARTUP = Program()
+
+
+class program_guard:
+    """ref paddle.static.program_guard"""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.pair = (main_program, startup_program)
+
+    def __enter__(self):
+        _STATE.guard_stack.append(self.pair)
+        return self.pair[0]
+
+    def __exit__(self, *exc):
+        _STATE.guard_stack.pop()
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """ref paddle.static.data — declare a feed Variable."""
+    from ..framework.dtype import convert_dtype
+
+    prog, _ = current_programs()
+    v = Variable(name, shape, convert_dtype(dtype), prog, is_feed=True)
+    prog.vars[name] = v
+    if name not in prog.feeds:
+        prog.feeds.append(name)
+    return v
+
+
+def _register_param(prog: Program, p: Parameter,
+                    startup: Optional[Program] = None) -> str:
+    name = getattr(p, "name", "") or ""
+    if not name or (name in prog.params and prog.params[name] is not p):
+        name = _STATE.fresh_name("param")
+        p.name = name
+    prog.params[name] = p
+    if startup is not None:
+        startup.params[name] = p
+    return name
+
+
+def record_op(fn: Callable, args: Sequence[Any], kwargs: Dict[str, Any],
+              op_name: str):
+    """Called from apply_op when a Variable is among the inputs: append an
+    Operator to the current main program and return symbolic outputs (shape
+    inference via jax.eval_shape — the analogue of phi/infermeta)."""
+    prog, startup = current_programs()
+    in_refs = []
+    avals = []
+    for a in args:
+        if isinstance(a, Variable):
+            in_refs.append(("var", a.var_name))
+            if a.var_name not in prog.vars:
+                prog.vars[a.var_name] = a
+            avals.append(jax.ShapeDtypeStruct(a._value.shape, a.dtype))
+        elif isinstance(a, Parameter):
+            in_refs.append(("param", _register_param(prog, a, startup)))
+            avals.append(jax.ShapeDtypeStruct(a.value.shape, a.value.dtype))
+        elif isinstance(a, Tensor):
+            c = np.asarray(a.value)
+            in_refs.append(("const", c))
+            avals.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+        else:
+            in_refs.append(("const", a))
+            avals.append(a)
+
+    out_shapes = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *avals)
+    multi = isinstance(out_shapes, (tuple, list))
+    outs = list(out_shapes) if multi else [out_shapes]
+
+    out_vars = []
+    for o in outs:
+        name = _STATE.fresh_name(op_name or "tmp")
+        v = Variable(name, o.shape, o.dtype, prog)
+        prog.vars[name] = v
+        out_vars.append(v)
+    prog.ops.append(Operator(fn, in_refs, dict(kwargs),
+                             [v.var_name for v in out_vars], op_name, multi))
+    prog._version += 1
+    return tuple(out_vars) if multi else out_vars[0]
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None):
+    """ref fluid/backward.py append_backward — here it only marks the loss;
+    gradients materialize inside Executor.run via jax.grad over the replay."""
+    prog = loss.program
+    prog.loss_name = loss.var_name
+    params = parameter_list or list(prog.params.values())
+    return [(p, f"{getattr(p, 'name', 'param')}@GRAD") for p in params]
+
+
+class GradMarker:
+    """Symbolic gradient handle returned by static.gradients; resolvable by
+    Executor.run fetch_list (grad of sum(target) w.r.t. a feed var or param)."""
+
+    __slots__ = ("target", "wrt_kind", "wrt_ref", "name")
+
+    def __init__(self, target: str, wrt_kind: str, wrt_ref: str):
+        self.target = target
+        self.wrt_kind = wrt_kind  # "feed" | "param"
+        self.wrt_ref = wrt_ref
+        self.name = f"{wrt_ref}@GRAD"
+
+    def __repr__(self):
+        return f"GradMarker(d({self.target})/d({self.wrt_ref}))"
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref paddle.static.gradients — symbolic grads of targets w.r.t. inputs.
+    Returns one GradMarker per (target, input); fetch them via Executor.run
+    on an inference program."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = []
+    for t in targets:
+        if not isinstance(t, Variable):
+            raise TypeError(f"gradients target must be a static Variable, got {type(t)}")
+        for x in inputs:
+            if isinstance(x, Variable):
+                out.append(GradMarker(t.var_name, "feed", x.var_name))
+            elif isinstance(x, Parameter):
+                out.append(GradMarker(t.var_name, "param",
+                                      getattr(x, "name", "") or ""))
+            else:
+                raise TypeError(f"gradients input must be Variable|Parameter, got {type(x)}")
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# Executor
+# --------------------------------------------------------------------------- #
+
+
+class Scope:
+    """Name -> value store (ref paddle/fluid/framework/scope.h). Holds param
+    values and optimizer state between Executor.run calls."""
+
+    def __init__(self):
+        self.store: Dict[str, Any] = {}
+        # per-program optimizer state: prog_id -> {"state","step","pnames"}
+        self.opt_state: Dict[int, Dict[str, Any]] = {}
+
+    def find_var(self, name):
+        return self.store.get(name)
+
+
+_GLOBAL_SCOPE = Scope()
+
+
+def global_scope() -> Scope:
+    return _GLOBAL_SCOPE
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+        self._saved = None
+
+    def __enter__(self):
+        global _GLOBAL_SCOPE
+        self._saved, _GLOBAL_SCOPE = _GLOBAL_SCOPE, self.scope
+
+    def __exit__(self, *exc):
+        global _GLOBAL_SCOPE
+        _GLOBAL_SCOPE = self._saved
+        return False
+
+
+def _prune_ops(program: Program, fetch_names: Sequence[str]) -> List[Operator]:
+    """Backward slice: keep only ops that (transitively) produce the fetches —
+    the analogue of Program pruning in Executor._prune (ref fluid/executor.py).
+    Makes clone(for_test=True) inference runs independent of label feeds."""
+    needed = set(fetch_names)
+    kept: List[Operator] = []
+    for op in reversed(program.ops):
+        if any(n in needed for n in op.out_names):
+            kept.append(op)
+            for kind, ref in op.in_refs:
+                if kind == "var":
+                    needed.add(ref)
+    kept.reverse()
+    return kept
+
+
+def _replay(program: Program, param_vals: Dict[str, Any],
+            feed_vals: Dict[str, Any], fetch_names: Sequence[str],
+            ops: Optional[List[Operator]] = None):
+    """Execute the recorded ops as a pure function."""
+    env: Dict[str, Any] = dict(feed_vals)
+    for op in (program.ops if ops is None else ops):
+        ins = []
+        for kind, ref in op.in_refs:
+            if kind == "var":
+                if ref not in env:
+                    v = program.vars.get(ref)
+                    if v is not None and v.is_feed:
+                        raise KeyError(
+                            f"feed Variable {ref!r} was not fed (feed keys: "
+                            f"{sorted(k for k in feed_vals)}); pass it in "
+                            "Executor.run(feed=...)")
+                    env[ref] = v._value
+                ins.append(env[ref])
+            elif kind == "param":
+                ins.append(param_vals[ref])
+            else:
+                ins.append(ref)
+        out = op.fn(*ins, **op.kwargs)
+        outs = list(out) if op.multi else [out]
+        for name, o in zip(op.out_names, outs):
+            env[name] = o
+    return [env[n] for n in fetch_names]
+
+
+class Executor:
+    """ref fluid/executor.py:921 Executor — replay + jit with a plan cache
+    (the analogue of _ExecutorCache at executor.py:750)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Callable] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(self, program: Optional[Program] = None, feed=None, fetch_list=None,
+            scope: Optional[Scope] = None, return_numpy: bool = True, **kwargs):
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+
+        if isinstance(program, _LoadedInferenceModel):
+            # load_inference_model returns this in the program slot — keep the
+            # reference idiom exe.run(program, feed, fetch_list) working
+            return program.run_feed(feed, fetch_list, return_numpy)
+        if isinstance(program, CompiledProgram):
+            program = program.program
+
+        # startup program: (re)materialize initial parameter values into scope
+        if not program.ops and not program.loss_name:
+            main = default_main_program()
+            reinit = {}
+            for name, p in list(main.params.items()) + list(program.params.items()):
+                reinit[name] = p.value
+            scope.store.update(reinit)
+            # drop optimizer state only for programs whose params were re-init'd
+            for pid in [pid for pid, ent in scope.opt_state.items()
+                        if ent["pnames"] & set(reinit)]:
+                del scope.opt_state[pid]
+            return []
+
+        for name, p in program.params.items():
+            if name not in scope.store:
+                scope.store[name] = p.value
+
+        fetch_list = fetch_list or []
+        fetch_names: List[str] = []
+        grad_markers: List[GradMarker] = []
+        for f in fetch_list:
+            if isinstance(f, GradMarker):
+                grad_markers.append(f)
+            elif isinstance(f, Variable):
+                fetch_names.append(f.var_name)
+            elif isinstance(f, str):
+                fetch_names.append(f)
+            else:
+                raise TypeError(f"fetch_list entries must be Variable|str, got {type(f)}")
+
+        feed_vals = {k: jnp.asarray(v.value if isinstance(v, Tensor) else v)
+                     for k, v in feed.items()}
+        param_vals = {k: scope.store[k] for k in program.params}
+        trainable = {k for k, p in program.params.items()
+                     if getattr(p, "trainable", True)}
+
+        opt = program.optimizer
+        if opt is not None and program.loss_name:
+            if grad_markers:
+                raise NotImplementedError(
+                    "static.gradients fetches are supported on inference "
+                    "programs (clone(for_test=True)); a train program already "
+                    "applies its own backward")
+            train_vals = {k: v for k, v in param_vals.items() if k in trainable}
+            frozen_vals = {k: v for k, v in param_vals.items() if k not in trainable}
+            pid = id(program)
+            if pid not in scope.opt_state:
+                scope.opt_state[pid] = {
+                    "state": opt.init_state(train_vals), "step": 0,
+                    "pnames": set(train_vals)}
+            ent = scope.opt_state[pid]
+            key = (pid, program._version, "train", tuple(fetch_names),
+                   tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed_vals.items())))
+            if key not in self._cache:
+                loss_name = program.loss_name
+                pruned = _prune_ops(program, [loss_name] + list(fetch_names))
+
+                def train_step(params, frozen, feeds, state, lr, step):
+                    def loss_fn(ps):
+                        outs = _replay(program, {**ps, **frozen}, feeds,
+                                       [loss_name] + list(fetch_names), pruned)
+                        return outs[0], outs[1:]
+
+                    (loss, fetches), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params)
+                    new_params, new_state = opt.pure_update(
+                        params, grads, state, lr, step)
+                    return fetches, new_params, new_state
+
+                self._cache[key] = jax.jit(train_step)
+            lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+            ent["step"] += 1
+            fetches, new_params, new_state = self._cache[key](
+                train_vals, frozen_vals, feed_vals, ent["state"], lr,
+                jnp.asarray(ent["step"], dtype=jnp.int32))
+            scope.store.update(new_params)
+            ent["state"] = new_state
+        else:
+            marker_keys = tuple((m.target, m.wrt_kind, m.wrt_ref)
+                                for m in grad_markers)
+            key = (id(program), program._version, "infer", tuple(fetch_names),
+                   marker_keys,
+                   tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed_vals.items())))
+            if key not in self._cache:
+                pruned = _prune_ops(
+                    program,
+                    list(fetch_names) + [m.target for m in grad_markers])
+
+                def infer_step(params, feeds):
+                    outs = _replay(program, params, feeds, fetch_names, pruned)
+                    grads = []
+                    for m in grad_markers:
+                        if m.wrt_kind == "feed":
+                            gfn = jax.grad(lambda fv, _m=m: jnp.sum(_replay(
+                                program, params, {**feeds, _m.wrt_ref: fv},
+                                [_m.target], pruned)[0]))
+                            grads.append(gfn(feeds[m.wrt_ref]))
+                        else:
+                            gfn = jax.grad(lambda pv, _m=m: jnp.sum(_replay(
+                                program, {**params, _m.wrt_ref: pv}, feeds,
+                                [_m.target], pruned)[0]))
+                            grads.append(gfn(params[m.wrt_ref]))
+                    return outs, grads
+
+                self._cache[key] = jax.jit(infer_step)
+            fetches, grads = self._cache[key](param_vals, feed_vals)
+            fetches = list(fetches) + list(grads)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+
+class CompiledProgram:
+    """ref compiler.CompiledProgram — everything is compiled here; identity."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def __getattr__(self, item):
+        return getattr(self.program, item)
+
+
+class ParallelExecutor:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ParallelExecutor is superseded: multi-device execution comes from "
+            "paddle_tpu.parallel.ParallelEngine (GSPMD) — see SURVEY.md §3.3")
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program: Optional[Program] = None, **kwargs):
+    """ref paddle.static.save_inference_model — serializes the replay function
+    as StableHLO (jax.export) + param values; loadable standalone."""
+    import os
+    import pickle
+
+    from jax import export as jexport
+
+    program = program or (feed_vars[0].program if isinstance(feed_vars[0], Variable)
+                          else default_main_program())
+    scope = global_scope()
+    feed_names = [v.var_name for v in feed_vars]
+    fetch_names = [v.var_name for v in fetch_vars]
+    param_vals = {k: scope.store.get(k, p.value) for k, p in program.params.items()}
+
+    pruned = _prune_ops(program, fetch_names)
+
+    def fn(params, *feeds):
+        return _replay(program, params, dict(zip(feed_names, feeds)), fetch_names,
+                       pruned)
+
+    # dynamic (-1/None) feed dims export as jax.export symbolic dimensions —
+    # batch-polymorphic StableHLO, same policy as jit.save
+    scope_sym = jexport.SymbolicScope()
+    in_avals = []
+    n_sym = 0
+    for v in feed_vars:
+        dims = list(getattr(v, "sym_shape", v._value.shape))
+        if any(d == -1 for d in dims):
+            spec = []
+            for d in dims:
+                if d == -1:
+                    spec.append(f"b{n_sym}")
+                    n_sym += 1
+                else:
+                    spec.append(str(d))
+            shape = jexport.symbolic_shape(", ".join(spec), scope=scope_sym)
+        else:
+            shape = tuple(dims)
+        in_avals.append(jax.ShapeDtypeStruct(shape, v.dtype))
+    exported = jexport.export(jax.jit(fn))(
+        jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+                               param_vals), *in_avals)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"feeds": feed_names, "fetches": fetch_names,
+                     "stablehlo": exported.serialize()}, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(np.asarray, param_vals), f)
+
+
+class _LoadedInferenceModel:
+    def __init__(self, meta, params):
+        from jax import export as jexport
+
+        self.feed_names = meta["feeds"]
+        self.fetch_names = meta["fetches"]
+        self._exported = jexport.deserialize(meta["stablehlo"])
+        self._params = params
+
+    def run(self, feeds: Dict[str, Any]):
+        raw = [jnp.asarray(feeds[n]) for n in self.feed_names]
+        return [np.asarray(o) for o in self._exported.call(self._params, *raw)]
+
+    def run_feed(self, feed, fetch_list, return_numpy: bool = True):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds {missing} (expects {self.feed_names})")
+        outs = self.run({k: (v.value if isinstance(v, Tensor) else v)
+                         for k, v in feed.items()})
+        if fetch_list:
+            by_name = dict(zip(self.fetch_names, outs))
+            sel = []
+            for f in fetch_list:
+                name = f if isinstance(f, str) else getattr(f, "var_name", None)
+                if name not in by_name:
+                    raise KeyError(
+                        f"fetch {name!r} not among exported fetches {self.fetch_names}")
+                sel.append(by_name[name])
+            outs = sel
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    import pickle
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    m = _LoadedInferenceModel(meta, params)
+    # reference returns (program, feed_target_names, fetch_targets)
+    return m, m.feed_names, m.fetch_names

@@ -1,8 +1,87 @@
-"""paddle.static.nn shim — static-graph layer builders have no TPU analogue;
-the dynamic `paddle_tpu.nn` layers cover the capability."""
+"""paddle.static.nn — static-graph layer builders (ref python/paddle/static/nn/).
+
+Each builder instantiates the corresponding dynamic ``paddle_tpu.nn`` layer
+(parameters eagerly initialized, the analogue of LayerHelper.create_parameter
++ startup-program init ops) and calls it on the symbolic Variable, which
+records its ops into the current Program via the central dispatch hook."""
+from __future__ import annotations
+
+from typing import Optional
 
 
-def __getattr__(name):
-    raise NotImplementedError(
-        f"paddle.static.nn.{name} is a ProgramDesc builder; use the paddle_tpu.nn layer "
-        "equivalent under jit.to_static instead.")
+def _activation(x, act: Optional[str]):
+    if act is None:
+        return x
+    import paddle_tpu.nn.functional as F
+
+    return getattr(F, act)(x)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    in_shape = x.sym_shape if hasattr(x, "sym_shape") else list(x.shape)
+    flat_dim = int(np.prod([abs(d) for d in in_shape[num_flatten_dims:]]))
+    if len(in_shape) > num_flatten_dims + 1:
+        x = paddle.reshape(x, [-1] * num_flatten_dims + [flat_dim]
+                           if num_flatten_dims == 1 else
+                           list(in_shape[:num_flatten_dims]) + [flat_dim])
+    layer = nn.Linear(flat_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    return _activation(layer(x), activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    from paddle_tpu import nn
+
+    in_ch = input.sym_shape[1] if data_format == "NCHW" else input.sym_shape[-1]
+    layer = nn.Conv2D(abs(in_ch), num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_format)
+    return _activation(layer(input), act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    from paddle_tpu import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=param_attr)
+    return layer(input)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None,
+               **kwargs):
+    """Static BN. ``is_test=False`` (training) normalizes by batch statistics,
+    matching the reference's training-graph behavior; ``is_test=True`` uses the
+    layer's running stats.  Limitation vs the reference: running statistics are
+    not updated by the recorded graph (exported inference programs should be
+    built with ``is_test=True`` after loading trained stats)."""
+    from paddle_tpu import nn
+
+    ch = input.sym_shape[1] if data_layout == "NCHW" else input.sym_shape[-1]
+    layer = nn.BatchNorm2D(abs(ch), momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return _activation(layer(input), act)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..jit import cond as _cond
+
+    return _cond(pred, true_fn, false_fn)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    from ..jit import while_loop as _wl
+
+    return _wl(cond_fn, body, loop_vars)

@@ -187,3 +187,25 @@ class TestAmp:
             assert s.dtype == jnp.float32
         out2 = paddle.matmul(a, a)
         assert out2.dtype == jnp.float32
+
+
+def test_per_param_regularizer_applied():
+    # ref fluid/regularizer.py append_regularization_ops: ParamAttr.regularizer
+    # applies even when the optimizer has no weight_decay
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.regularizer import L2Decay
+
+    lin = nn.Linear(4, 4, weight_attr=paddle.ParamAttr(regularizer=L2Decay(0.5)),
+                    bias_attr=False)
+    w0 = np.asarray(lin.weight.value).copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.zeros((2, 4), dtype="float32"))
+    loss = lin(x).sum()
+    loss.backward()
+    opt.step()
+    # grad wrt zero input is 0, so the only update comes from the L2 term
+    np.testing.assert_allclose(np.asarray(lin.weight.value),
+                               w0 - 0.1 * 0.5 * w0, rtol=1e-5)
