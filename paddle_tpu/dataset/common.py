@@ -17,8 +17,8 @@ DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
 
 
 def must_mkdirs(path):
-    # called from download()/cached_path(), NOT at import time: importing
-    # paddle_tpu must not write to the filesystem (read-only $HOME safe)
+    # called from download(), NOT at import time: importing paddle_tpu must
+    # not write to the filesystem (read-only $HOME safe)
     os.makedirs(path, exist_ok=True)
 
 
@@ -37,6 +37,10 @@ def download(url, module_name, md5sum, save_name=None):
     dir is checked; a missing file raises with guidance to place it manually.
     """
     dirname = os.path.join(DATA_HOME, module_name)
+    try:
+        must_mkdirs(dirname)
+    except OSError:
+        pass  # read-only $HOME: the existence check below still works
     filename = os.path.join(
         dirname, url.split("/")[-1] if save_name is None else save_name)
     if os.path.exists(filename) and (

@@ -73,8 +73,9 @@ def _synth_meta():
             i, 'M' if rng.rand() < 0.5 else 'F',
             age_table[rng.randint(len(age_table))], rng.randint(0, 21))
     for _ in range(2000):
+        # same [1,5] -> [-3,5] rescale as the real-data path (_parse_zip)
         RATINGS.append((rng.randint(1, 101), rng.randint(1, 201),
-                        float(rng.randint(1, 6))))
+                        float(rng.randint(1, 6)) * 2 - 5.0))
 
 
 def _parse_zip(fn):

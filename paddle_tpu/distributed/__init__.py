@@ -20,5 +20,11 @@ from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_op, shard_tensor  # noqa: F401
 from .launch_util import spawn  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import models  # noqa: F401
+from . import passes  # noqa: F401
+from . import rpc  # noqa: F401
+from . import utils  # noqa: F401
 
 __all__ = [n for n in dir() if not n.startswith("_")]

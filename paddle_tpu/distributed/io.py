@@ -1,0 +1,90 @@
+"""paddle.distributed.io — persistable save/load for distributed programs
+(ref python/paddle/distributed/io.py:190 is_persistable, :221
+save_persistables, :293 load_inference_model_distributed).
+
+TPU-native: the reference splits PS-hosted remote params from local ones and
+writes LoDTensor files; here params live in the static Scope as jax arrays —
+save writes one pickle per program (or a single combined file), load restores
+into the scope.  Sharded-across-mesh params are fetched with their GSPMD
+layout intact (fully replicated on save, same policy as
+distributed/checkpoint.py's orbax path for the dygraph side).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from ..static.graph import (Program, default_main_program, global_scope,
+                            load_inference_model)
+
+__all__ = ["is_persistable", "save_persistables", "load_persistables",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    """ref io.py:190 — feeds/fetches are not persistable; Parameters and
+    vars flagged persistable are."""
+    from ..framework.core import Parameter
+
+    if isinstance(var, Parameter):
+        return True
+    return bool(getattr(var, "persistable", False)) and not getattr(
+        var, "is_feed", False)
+
+
+def save_persistables(executor=None, dirname: str = "",
+                      main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    """Save every persistable param of the program (ref io.py:221)."""
+    program = main_program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for name, p in program.params.items():
+        val = scope.store.get(name)
+        state[name] = np.asarray(val if val is not None else p.value)
+    os.makedirs(dirname or ".", exist_ok=True)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f)
+    else:
+        for name, arr in state.items():
+            with open(os.path.join(dirname, name), "wb") as f:
+                pickle.dump({name: arr}, f)
+
+
+def load_persistables(executor=None, dirname: str = "",
+                      main_program: Optional[Program] = None,
+                      filename: Optional[str] = None):
+    """Inverse of save_persistables; loads into the global scope and the
+    program's Parameter objects."""
+    import jax.numpy as jnp
+
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            state = pickle.load(f)
+    else:
+        state = {}
+        for name in program.params:
+            path = os.path.join(dirname, name)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    state.update(pickle.load(f))
+    for name, arr in state.items():
+        if name in program.params:
+            scope.store[name] = jnp.asarray(arr)
+            program.params[name].set_value(arr)
+
+
+def load_inference_model_distributed(dirname: str, executor=None,
+                                     model_filename: Optional[str] = None,
+                                     params_filename: Optional[str] = None):
+    """ref io.py:293 — distributed variant of load_inference_model; with the
+    single-backend TPU runtime it is the same StableHLO load."""
+    prefix = os.path.join(dirname, (model_filename or "model").replace(
+        ".pdmodel", ""))
+    return load_inference_model(prefix, executor)

@@ -1,0 +1,10 @@
+"""paddle.distributed.metric (ref python/paddle/distributed/metric/)."""
+from .metrics import (  # noqa: F401
+    get_metric,
+    init_metric,
+    print_auc,
+    print_metric,
+    update_metric,
+)
+
+__all__ = []

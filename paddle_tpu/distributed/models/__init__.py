@@ -1,0 +1,4 @@
+"""paddle.distributed.models (ref python/paddle/distributed/models/)."""
+from . import moe  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,16 @@
+"""paddle.distributed.models.moe — re-export of the expert-parallel MoE stack
+(ref python/paddle/distributed/models/moe/ wraps the incubate implementation;
+ours lives at paddle_tpu/incubate/distributed/models/moe)."""
+from ...incubate.distributed.models.moe import (  # noqa: F401
+    ExpertMLP,
+    MoELayer,
+)
+from ...incubate.distributed.models.moe.gate import (  # noqa: F401
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)
+from ..utils.moe_utils import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["MoELayer", "ExpertMLP", "NaiveGate", "GShardGate", "SwitchGate",
+           "global_scatter", "global_gather"]

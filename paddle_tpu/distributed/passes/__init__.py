@@ -1,0 +1,31 @@
+"""paddle.distributed.passes — program-level passes over the static facade
+(ref python/paddle/distributed/passes/__init__.py).
+
+Working passes (they rewrite the recorded-op Program that Executor jits):
+  auto_parallel_amp / auto_parallel_fp16 / auto_parallel_bf16 — cast
+    matmul-class op inputs to low precision (compute hits the MXU in
+    bf16/fp16, results stay fp32), the list-based O1 policy of
+    ref passes/auto_parallel_amp.py / auto_parallel_bf16.py.
+  auto_parallel_recompute — wrap selected ops' fns in jax.checkpoint so
+    their outputs are rematerialized in backward (ref
+    auto_parallel_recompute.py, which re-inserts fwd sub-blocks).
+  auto_parallel_gradient_merge — wrap the program optimizer with a pure
+    k-step gradient accumulator (ref auto_parallel_gradient_merge.py).
+  auto_parallel_sharding — record ZeRO stage + param shard axis on the
+    program for the parallel engine (ref auto_parallel_sharding.py; the
+    actual sharding is GSPMD NamedSharding at jit time).
+Registered no-ops with rationale (XLA subsumes them): fuse_all_reduce,
+fuse_optimizer, fused_attention, fuse_gemm_epilogue.
+"""
+from .pass_base import (  # noqa: F401
+    PassBase,
+    PassContext,
+    PassManager,
+    PassType,
+    new_pass,
+    register_pass,
+)
+from . import passes as _passes  # noqa: F401  (registers concrete passes)
+
+__all__ = ["PassBase", "PassContext", "PassManager", "PassType", "new_pass",
+           "register_pass"]

@@ -1,0 +1,133 @@
+"""Program-pass framework (ref python/paddle/distributed/passes/pass_base.py:
+PassContext :20, PassBase :50, register_pass :123, new_pass :132,
+PassManager :312).
+
+TPU-native meaning of a "pass": the reference rewrites ProgramDesc protobuf
+IR; here a pass rewrites our recorded-op Program (paddle_tpu/static/graph.py)
+before Executor.run jits the replay.  Anything a pass leaves in place is
+still optimized by XLA — so comm/fusion passes that exist in the reference
+purely to do what XLA already does (fuse_all_reduce, fuse_optimizer) are
+registered as explicit no-ops with a recorded rationale in the PassContext.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List
+
+__all__ = ["PassContext", "PassType", "PassBase", "register_pass", "new_pass",
+           "PassManager"]
+
+
+class PassContext:
+    def __init__(self):
+        self._applied_passes: List["PassBase"] = []
+        self._attrs: Dict[str, Any] = {}
+        self.notes: List[str] = []
+
+    @property
+    def passes(self):
+        return tuple(self._applied_passes)
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassType:
+    UNKNOWN = 0
+    COMP_OPT = 1
+    COMM_OPT = 2
+    PARALLEL_OPT = 3
+    FUSION_OPT = 4
+    CALC_OPT = 5
+
+
+_PASS_REGISTRY: Dict[str, type] = {}
+
+
+class PassBase(ABC):
+    """One program transform; subclasses set attrs then implement
+    _check_self/_apply_single_impl (same contract as the reference)."""
+
+    name: str = ""
+    _type = PassType.UNKNOWN
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self) -> bool:
+        return True
+
+    def _check_conflict(self, other_pass: "PassBase") -> bool:
+        return True
+
+    def apply(self, main_programs, startup_programs, context: PassContext = None):
+        context = context or PassContext()
+        if not isinstance(main_programs, (list, tuple)):
+            main_programs = [main_programs]
+        if not isinstance(startup_programs, (list, tuple)):
+            startup_programs = [startup_programs] * len(main_programs)
+        if not self._check_self():
+            raise ValueError(f"pass {self.name!r} attrs invalid: {self._attrs}")
+        if not all(self._check_conflict(p) for p in context.passes):
+            raise ValueError(f"pass {self.name!r} conflicts with already-applied "
+                             f"passes {[p.name for p in context.passes]}")
+        for main, startup in zip(main_programs, startup_programs):
+            self._apply_single_impl(main, startup, context)
+        context._applied_passes.append(self)
+        return context
+
+    @abstractmethod
+    def _apply_single_impl(self, main_program, startup_program, context):
+        ...
+
+
+def register_pass(name):
+    def impl(cls):
+        if name in _PASS_REGISTRY:
+            raise ValueError(f"pass {name!r} already registered")
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return impl
+
+
+def new_pass(name, pass_attrs=None) -> PassBase:
+    if name not in _PASS_REGISTRY:
+        raise ValueError(f"unknown pass {name!r}; registered: "
+                         f"{sorted(_PASS_REGISTRY)}")
+    p = _PASS_REGISTRY[name]()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """Ordered application of passes over (main, startup) program pairs
+    (ref pass_base.py:312)."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = list(passes)
+        self._context = PassContext()
+
+    def apply(self, main_programs, startup_programs):
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, self._context)
+        return self._context
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

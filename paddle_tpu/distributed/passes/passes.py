@@ -1,0 +1,209 @@
+"""Concrete program passes. See package docstring for the mapping to the
+reference pass files (python/paddle/distributed/passes/auto_parallel_*.py)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pass_base import PassBase, PassType, register_pass
+
+# ops whose compute should run in low precision under O1 AMP — mirrors the
+# white list in ref python/paddle/fluid/dygraph/amp/auto_cast.py (matmul/conv
+# class ops; everything reduction/norm-like stays fp32)
+_AMP_COMPUTE_OPS = {
+    "matmul", "mm", "bmm", "conv2d", "conv3d", "conv1d", "conv2d_transpose",
+    "linear", "einsum", "addmm", "matmul_v2", "mul", "fc",
+}
+
+
+def _cast_op_fn(fn, compute_dtype):
+    """Wrap an op fn: float32 array inputs -> compute_dtype, float outputs
+    back to float32 (bf16 MXU compute, fp32 residuals)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        cast_args = [a.astype(compute_dtype)
+                     if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                     for a in args]
+        out = fn(*cast_args, **kwargs)
+
+        def up(o):
+            return (o.astype(jnp.float32)
+                    if hasattr(o, "dtype") and o.dtype == compute_dtype else o)
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(up(o) for o in out)
+        return up(out)
+
+    return wrapped
+
+
+class _AmpPassBase(PassBase):
+    _type = PassType.CALC_OPT
+    _dtype = jnp.bfloat16
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        custom_white = set(self.get_attr("custom_white_list") or ())
+        white = _AMP_COMPUTE_OPS | custom_white
+        n = 0
+        for op in main_program.ops:
+            if op.op_name in white:
+                op.fn = _cast_op_fn(op.fn, self._dtype)
+                n += 1
+        main_program._version += 1
+        context.notes.append(
+            f"{self.name}: cast {n} compute ops to {jnp.dtype(self._dtype).name}")
+
+
+@register_pass("auto_parallel_bf16")
+class AutoParallelBF16Pass(_AmpPassBase):
+    _dtype = jnp.bfloat16
+
+
+@register_pass("auto_parallel_fp16")
+class AutoParallelFP16Pass(_AmpPassBase):
+    _dtype = jnp.float16
+
+
+@register_pass("auto_parallel_amp")
+class AutoParallelAMPPass(_AmpPassBase):
+    """O1 AMP; attr 'dtype' selects float16 (default, ref) or bfloat16."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        self._dtype = (jnp.bfloat16 if self.get_attr("dtype") == "bfloat16"
+                       else jnp.float16)
+        super()._apply_single_impl(main_program, startup_program, context)
+
+
+@register_pass("auto_parallel_recompute")
+class AutoParallelRecomputePass(PassBase):
+    """Remat: wrap op fns in jax.checkpoint so their activations are
+    recomputed in backward instead of saved (ref auto_parallel_recompute.py
+    rebuilds forward sub-blocks in the backward region)."""
+
+    _type = PassType.COMP_OPT
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        selected = self.get_attr("checkpoints")  # op names; None -> all
+        n = 0
+        for op in main_program.ops:
+            if selected is None or op.op_name in selected:
+                op.fn = jax.checkpoint(op.fn, static_argnums=())
+                n += 1
+        main_program._version += 1
+        context.notes.append(f"{self.name}: remat-wrapped {n} ops")
+
+
+class _GradientMergeOptimizer:
+    """Pure k-step gradient accumulation around an optimizer (the state
+    threads through Executor.run's opt_state untouched)."""
+
+    def __init__(self, inner, k_steps: int, avg: bool = True):
+        self.inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def init_state(self, params):
+        return {
+            "inner": self.inner.init_state(params),
+            "acc": {k: jnp.zeros_like(v, dtype=jnp.float32)
+                    for k, v in params.items()},
+            "cnt": jnp.zeros((), dtype=jnp.int32),
+        }
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def pure_update(self, params, grads, state, lr, step, pnames=None,
+                    regularizers=None):
+        acc = {k: state["acc"][k] + grads[k].astype(jnp.float32)
+               for k in grads}
+        cnt = state["cnt"] + 1
+        do_step = (cnt % self.k_steps) == 0
+
+        def apply_fn(operand):
+            params_, acc_, inner_state = operand
+            eff = ({k: v / self.k_steps for k, v in acc_.items()}
+                   if self.avg else acc_)
+            new_params, new_inner = self.inner.pure_update(
+                params_, eff, inner_state, lr, step,
+                regularizers=regularizers)
+            zeroed = {k: jnp.zeros_like(v) for k, v in acc_.items()}
+            return new_params, new_inner, zeroed
+
+        def skip_fn(operand):
+            params_, acc_, inner_state = operand
+            return params_, inner_state, acc_
+
+        new_params, new_inner, new_acc = jax.lax.cond(
+            do_step, apply_fn, skip_fn, (params, acc, state["inner"]))
+        return new_params, {"inner": new_inner, "acc": new_acc, "cnt": cnt}
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+@register_pass("auto_parallel_gradient_merge")
+class AutoParallelGradientMergePass(PassBase):
+    _type = PassType.COMP_OPT
+
+    def _check_self(self):
+        return int(self.get_attr("k_steps", 1)) >= 1
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        k = int(self.get_attr("k_steps", 1))
+        if k <= 1 or main_program.optimizer is None:
+            context.notes.append(f"{self.name}: skipped (k_steps={k})")
+            return
+        main_program.optimizer = _GradientMergeOptimizer(
+            main_program.optimizer, k, avg=bool(self.get_attr("avg", True)))
+        context.notes.append(f"{self.name}: k_steps={k}")
+
+
+@register_pass("auto_parallel_sharding")
+class AutoParallelShardingPass(PassBase):
+    """Record the ZeRO stage / shard axis on the program; the parallel engine
+    turns this into NamedSharding on params+opt state at jit time (GSPMD
+    inserts the reduce-scatter/allgather the reference pass writes by hand)."""
+
+    _type = PassType.PARALLEL_OPT
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        stage = int(self.get_attr("stage", 1))
+        axis = self.get_attr("sharding_axis", "sharding")
+        main_program.sharding_config = {"stage": stage, "axis": axis}
+        context.notes.append(f"{self.name}: stage={stage} axis={axis!r}")
+
+
+class _XLANoOpPass(PassBase):
+    """Passes the reference needs but XLA already performs inside the compiled
+    program; applying them records the rationale."""
+
+    _type = PassType.FUSION_OPT
+    rationale = "subsumed by XLA fusion/scheduling"
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        context.notes.append(f"{self.name}: no-op ({self.rationale})")
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(_XLANoOpPass):
+    rationale = ("gradient all-reduces are emitted and bucketed by GSPMD "
+                 "inside the jitted train step")
+
+
+@register_pass("fuse_optimizer")
+class FuseOptimizerPass(_XLANoOpPass):
+    rationale = "optimizer update is one fused XLA program already"
+
+
+@register_pass("fused_attention")
+class FusedAttentionPass(_XLANoOpPass):
+    rationale = "attention uses the Pallas flash kernel (paddle_tpu/ops)"
+
+
+@register_pass("fuse_gemm_epilogue")
+class FuseGemmEpiloguePass(_XLANoOpPass):
+    rationale = "matmul+bias+activation epilogues are fused by XLA"

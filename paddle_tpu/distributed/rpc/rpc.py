@@ -1,0 +1,208 @@
+"""paddle.distributed.rpc parity (ref python/paddle/distributed/rpc/rpc.py:73
+init_rpc, :141 rpc_sync, :179 rpc_async, :270 shutdown, :299 get_worker_info).
+
+TPU-native design: the reference runs RPC over brpc with a C++ agent
+(paddle/fluid/distributed/rpc/).  On TPU pods the accelerator network (ICI)
+is owned by XLA collectives, so RPC is a *host-side* control-plane facility —
+a threaded TCP server per worker speaking length-prefixed pickle, with worker
+discovery through the same KV store that the launch rendezvous uses
+(launch/rendezvous.py, the TCPStore role).  Semantics match the reference:
+named workers, sync/async calls of picklable Python functions, barriered
+init/shutdown.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor, Future
+from typing import Dict, List, Optional
+
+from ..launch.rendezvous import KVClient, KVServer
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 120.0
+
+
+class _RpcState:
+    def __init__(self):
+        self.server: Optional["_RpcServer"] = None
+        self.kv_server: Optional[KVServer] = None
+        self.kv: Optional[KVClient] = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.current: Optional[WorkerInfo] = None
+        self.world_size: int = 0
+        self.pool: Optional[ThreadPoolExecutor] = None
+
+
+_STATE = _RpcState()
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = _recv_msg(self.request)
+            fn, args, kwargs = req["fn"], req["args"], req["kwargs"]
+            try:
+                value = fn(*args, **kwargs)
+                resp = {"ok": True, "value": value}
+            except Exception as e:  # serialized back to the caller
+                resp = {"ok": False, "exc": e}
+            _send_msg(self.request, resp)
+        except Exception:
+            pass
+
+
+class _RpcServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _gen_endpoint() -> str:
+    ip = os.environ.get("POD_IP", "127.0.0.1")
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    return f"{ip}:{port}"
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC agent and rendezvous with the others
+    (ref rpc.py:73). rank 0 hosts the discovery KV store."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8090")
+
+    server_endpoint = os.environ.get("PADDLE_WORKER_ENDPOINT") or _gen_endpoint()
+    ip, port = server_endpoint.rsplit(":", 1)
+
+    srv = _RpcServer(("0.0.0.0", int(port)), _RpcHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    _STATE.server = srv
+
+    if rank == 0:
+        try:
+            _STATE.kv_server = KVServer(int(master_endpoint.rsplit(":", 1)[1]))
+        except OSError:
+            _STATE.kv_server = None  # already hosted (single-process re-init)
+    _STATE.kv = KVClient(master_endpoint)
+    _STATE.kv.set(f"rpc/worker/{rank}", f"{name},{rank},{ip},{port}")
+
+    while True:
+        entries = _STATE.kv.list("rpc/worker/")
+        if len(entries) >= world_size:
+            break
+        time.sleep(0.1)
+    for v in entries.values():
+        wname, wrank, wip, wport = v.split(",")
+        _STATE.workers[wname] = WorkerInfo(wname, int(wrank), wip, int(wport))
+    _STATE.current = _STATE.workers[name]
+    _STATE.world_size = world_size
+    _STATE.pool = ThreadPoolExecutor(max_workers=16)
+    _barrier(rank, world_size)
+
+
+def _barrier(rank: int, world_size: int, tag: str = "init") -> None:
+    n = _STATE.kv.add(f"rpc/barrier/{tag}", 1)
+    target = world_size * (n // world_size + (1 if n % world_size else 0))
+    while int(_STATE.kv.get(f"rpc/barrier/{tag}") or 0) < target:
+        time.sleep(0.05)
+
+
+def _invoke(to: str, fn, args, kwargs, timeout: float):
+    if to not in _STATE.workers:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_STATE.workers)}")
+    info = _STATE.workers[to]
+    with socket.create_connection((info.ip, info.port), timeout=timeout) as s:
+        if timeout and timeout > 0:
+            s.settimeout(timeout)
+        _send_msg(s, {"fn": fn, "args": tuple(args or ()),
+                      "kwargs": dict(kwargs or {})})
+        resp = _recv_msg(s)
+    if not resp["ok"]:
+        raise resp["exc"]
+    return resp["value"]
+
+
+class FutureWrapper:
+    """Matches the reference's future: .wait() returns the result."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def wait(self):
+        return self._fut.result()
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: float = _DEFAULT_RPC_TIMEOUT):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the result
+    (ref rpc.py:141)."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = _DEFAULT_RPC_TIMEOUT) -> FutureWrapper:
+    """Async variant (ref rpc.py:179); returns a future with .wait()."""
+    return FutureWrapper(_STATE.pool.submit(_invoke, to, fn, args, kwargs,
+                                            timeout))
+
+
+def shutdown() -> None:
+    """Barrier then stop the agent (ref rpc.py:270)."""
+    if _STATE.current is None:
+        return
+    _barrier(_STATE.current.rank, _STATE.world_size, tag="shutdown")
+    if _STATE.pool:
+        _STATE.pool.shutdown(wait=True)
+    if _STATE.server:
+        _STATE.server.shutdown()
+        _STATE.server.server_close()
+    if _STATE.kv_server:
+        _STATE.kv_server.stop()
+    _STATE.__init__()
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _STATE.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_STATE.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _STATE.current

@@ -1,0 +1,14 @@
+"""paddle.distributed.utils (ref python/paddle/distributed/utils/)."""
+from . import launch_utils, log_utils, moe_utils  # noqa: F401
+from .log_utils import get_logger  # noqa: F401
+from .launch_utils import (  # noqa: F401
+    Cluster,
+    Pod,
+    Trainer,
+    find_free_ports,
+    get_cluster,
+    get_host_name_ip,
+    terminate_local_procs,
+)
+
+__all__ = []

@@ -1,0 +1,263 @@
+"""Cluster/Pod/Trainer topology description + local-proc management (ref
+python/paddle/distributed/utils/launch_utils.py:132 Cluster, :243 Pod,
+:306 get_cluster, :387 find_free_ports, :468 start_local_trainers).
+
+TPU note: "selected_gpus" becomes per-process TPU chip ordinals; on real TPU
+pods one process drives all local chips, so multi-proc launch is for
+multi-host jobs and CPU-mesh tests.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from contextlib import closing
+from typing import List, Optional
+
+from .log_utils import get_logger
+
+logger = get_logger("INFO", "launch_utils")
+
+
+class Trainer:
+    def __init__(self):
+        self.accelerators: List[int] = []
+        self.endpoint: Optional[str] = None
+        self.rank: Optional[int] = None
+
+    def __str__(self):
+        return f"accelerators:{self.accelerators} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, t):
+        return (self.accelerators == t.accelerators
+                and self.endpoint == t.endpoint and self.rank == t.rank)
+
+    def __ne__(self, t):
+        return not self == t
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class Pod:
+    def __init__(self):
+        self.rank: Optional[int] = None
+        self.id: Optional[str] = None
+        self.addr: Optional[str] = None
+        self.port: Optional[int] = None
+        self.trainers: List[Trainer] = []
+        self.accelerators: List[int] = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} port:{self.port} "
+                f"trainers_num:{len(self.trainers)}")
+
+    def __eq__(self, pod):
+        return (self.rank == pod.rank and self.id == pod.id
+                and self.addr == pod.addr and self.port == pod.port
+                and self.trainers == pod.trainers)
+
+    def __ne__(self, pod):
+        return not self == pod
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_accelerators(self):
+        return ",".join(str(a) for a in self.accelerators)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods: List[Pod] = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return f"job_server:{self.job_server} pods:{[str(p) for p in self.pods]}"
+
+    def __eq__(self, cluster):
+        return (len(self.pods) == len(cluster.pods)
+                and all(a == b for a, b in zip(self.pods, cluster.pods)))
+
+    def __ne__(self, cluster):
+        return not self == cluster
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self) -> int:
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self) -> int:
+        return len(self.pods)
+
+    def trainers_endpoints(self) -> List[str]:
+        return [t.endpoint for pod in self.pods for t in pod.trainers]
+
+    def pods_endpoints(self) -> List[str]:
+        return [f"{pod.addr}:{pod.port}" for pod in self.pods]
+
+    def get_pod_by_id(self, pod_id):
+        for pod in self.pods:
+            if str(pod_id) == str(pod.id):
+                return pod
+        return None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, selected_accelerators) -> tuple:
+    """Build (Cluster, current Pod) from node/endpoint lists (ref :306)."""
+    assert isinstance(trainer_endpoints, list), "trainer_endpoints must be a list"
+    cluster = Cluster()
+    trainer_rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        cur_node_endpoints = trainer_endpoints[node_rank]
+        for i in range(len(cur_node_endpoints)):
+            trainer = Trainer()
+            trainer.accelerators.append(selected_accelerators[i])
+            trainer.endpoint = cur_node_endpoints[i]
+            trainer.rank = trainer_rank
+            trainer_rank += 1
+            pod.trainers.append(trainer)
+        cluster.pods.append(pod)
+    pod_rank = node_ips.index(node_ip)
+    return cluster, cluster.pods[pod_rank]
+
+
+def get_host_name_ip():
+    try:
+        host_name = socket.gethostname()
+        host_ip = socket.gethostbyname(host_name)
+        return host_name, host_ip
+    except Exception:
+        return None
+
+
+def find_free_ports(num: int):
+    """ref :387 — probe the OS for num free TCP ports."""
+    port_set = set()
+    step = 0
+    while True:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            port_set.add(s.getsockname()[1])
+        if len(port_set) >= num:
+            return port_set
+        step += 1
+        if step > 400:
+            logger.warning("can't find available port; exhausted %d probes", step)
+            return None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + " Default: %(default)s.", **kwargs)
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def _prepare_trainer_env(cluster: Cluster, trainer: Trainer) -> dict:
+    return {
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(cluster.trainers_endpoints()),
+        "PADDLE_LOCAL_DEVICE_IDS": ",".join(str(a) for a in trainer.accelerators),
+    }
+
+
+def start_local_trainers(cluster: Cluster, pod: Pod, training_script: str,
+                         training_script_args, log_dir=None):
+    """Spawn one subprocess per trainer in this pod (ref :468)."""
+    current_env = {k: v for k, v in os.environ.items()
+                   if k not in ("http_proxy", "https_proxy")}
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        proc_env = _prepare_trainer_env(cluster, t)
+        current_env.update(proc_env)
+        cmd = [sys.executable, "-u", training_script] + list(training_script_args)
+        fn = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(f"{log_dir}/workerlog.{idx}", "a")
+            proc = subprocess.Popen(cmd, env=current_env, stdout=fn, stderr=fn)
+        else:
+            proc = subprocess.Popen(cmd, env=current_env)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.log_fn = fn
+        tp.log_offset = fn.tell() if fn else None
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp: TrainerProc):
+    if tp.log_fn:
+        with open(tp.log_fn.name, "r") as fin:
+            fin.seek(tp.log_offset, 0)
+            for line in fin:
+                try:
+                    sys.stdout.write(line)
+                except UnicodeEncodeError:
+                    pass
+            tp.log_offset = fin.tell()
+
+
+def watch_local_trainers(procs: List[TrainerProc], nranks: int):
+    """Poll trainer procs; raise if any died abnormally (ref :527)."""
+    alive = False
+    error = False
+    error_rank = []
+    for p in procs:
+        if p.log_fn and p.local_rank == 0:
+            pull_worker_log(p)
+        ret = p.proc.poll()
+        if ret is None:
+            alive = True
+        elif ret != 0:
+            error = True
+            error_rank.append(p.rank)
+    if error:
+        terminate_local_procs(procs)
+        raise RuntimeError(f"trainers {error_rank} exited abnormally")
+    return alive
+
+
+def terminate_local_procs(procs: List[TrainerProc]):
+    """ref :333 — SIGTERM, grace period, then kill."""
+    for p in procs:
+        if p.proc and p.proc.poll() is None:
+            p.proc.terminate()
+            if p.log_fn:
+                p.log_fn.close()
+    for _ in range(20):
+        if all(p.proc is None or p.proc.poll() is not None for p in procs):
+            return
+        time.sleep(0.1)
+    for p in procs:
+        if p.proc and p.proc.poll() is None:
+            try:
+                os.kill(p.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass

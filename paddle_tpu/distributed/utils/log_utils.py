@@ -1,0 +1,14 @@
+"""ref python/paddle/distributed/utils/log_utils.py:18 get_logger."""
+import logging
+
+
+def get_logger(log_level, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        log_handler = logging.StreamHandler()
+        log_format = logging.Formatter(
+            "%(levelname)s %(asctime)s %(filename)s:%(lineno)d] %(message)s")
+        log_handler.setFormatter(log_format)
+        logger.addHandler(log_handler)
+    return logger

@@ -1,0 +1,58 @@
+"""MoE token exchange (ref python/paddle/distributed/utils/moe_utils.py:20
+global_scatter, :146 global_gather — NCCL alltoall of variable token counts).
+
+TPU-native: XLA requires static shapes inside compiled programs, so the
+exchange is expressed on capacity-padded expert buckets (the GShard
+formulation our MoELayer uses): tensors are laid out
+``[world_size * num_local_experts, capacity, d_model]`` and one
+`lax.all_to_all` over the expert mesh axis moves bucket i*k..(i+1)*k to rank
+i.  `local_count`/`global_count` are accepted for API parity and validated
+against capacity; dynamic-count NCCL semantics have no static-shape
+equivalent — callers route via capacity + dispatch masks instead (see
+incubate/distributed/models/moe/moe_layer.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, to_array
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _exchange(x, group, take_from_axis: bool):
+    axis = group.axis if group is not None else "expert"
+    arr = to_array(x)
+    try:
+        n = jax.lax.axis_size(axis)
+        in_mesh = True
+    except NameError:
+        in_mesh = False
+    if not in_mesh:
+        # outside shard_map / pjit: single participant — exchange is identity
+        return arr
+    if arr.shape[0] % n != 0:
+        raise ValueError(
+            f"leading dim {arr.shape[0]} must be divisible by the "
+            f"{axis!r}-axis size {n} (world_size*num_local_experts buckets)")
+    return jax.lax.all_to_all(
+        arr.reshape((n, arr.shape[0] // n) + arr.shape[1:]),
+        axis, split_axis=0, concat_axis=0, tiled=False,
+    ).reshape(arr.shape)
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   use_calc_stream=True):
+    """Send expert buckets to their owning ranks (ref moe_utils.py:20)."""
+    out = _exchange(x, group, take_from_axis=False)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: bring this rank's tokens home
+    (ref moe_utils.py:146). With capacity-padded buckets the exchange is an
+    involution, so the wire pattern is the same all_to_all."""
+    out = _exchange(x, group, take_from_axis=True)
+    return Tensor(out) if isinstance(x, Tensor) else out

@@ -122,8 +122,7 @@ class Layer:
         value = init(shape, dtype)
         p = Parameter(value, trainable=trainable, name=name or "")
         p.optimize_attr["learning_rate"] = learning_rate
-        if attr is not None and attr is not False and not isinstance(attr, (str,)) \
-                and not callable(attr) and getattr(attr, "regularizer", None) is not None:
+        if getattr(attr, "regularizer", None) is not None:
             p.regularizer = attr.regularizer
         return p
 

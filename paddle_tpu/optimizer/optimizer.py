@@ -167,9 +167,13 @@ class Optimizer:
 
         return {name: self._create_slots(_P(v)) for name, v in params.items()}
 
-    def pure_update(self, params, grads, state, lr, step, pnames=None):
+    def pure_update(self, params, grads, state, lr, step, pnames=None,
+                    regularizers=None):
         """One optimizer step as a pure function — used inside pjit train steps
-        (the ZeRO/master-weight sharding comes from the state's shardings)."""
+        (the ZeRO/master-weight sharding comes from the state's shardings).
+        ``regularizers``: name → per-param regularizer callable (the ParamAttr
+        override the eager step() reads from p.regularizer)."""
+        regularizers = regularizers or {}
         new_params, new_state = {}, {}
         for name, p in params.items():
             g = grads.get(name)
@@ -178,8 +182,10 @@ class Optimizer:
                 new_state[name] = state.get(name, {})
                 continue
             g = g.astype(jnp.float32)
-            if self._l2_coeff and self._use_l2_decay():
-                g = g + self._reg_grad(p.astype(jnp.float32))
+            reg = regularizers.get(name)
+            if self._use_l2_decay() and (self._l2_coeff or reg is not None):
+                g = g + (reg(p.astype(jnp.float32)) if reg is not None
+                         else self._reg_grad(p.astype(jnp.float32)))
             np_, ns = self._apply_one(p, g, lr, step, state.get(name, {}))
             new_params[name] = np_
             new_state[name] = ns
@@ -303,18 +309,28 @@ class AdamW(Adam):
             if g is None:
                 continue
             slots = self._slots_for(p)
+            g_val = g.value.astype(jnp.float32)
+            if getattr(p, "regularizer", None) is not None:
+                # per-param ParamAttr regularizer adds its gradient even
+                # though AdamW's own decay is decoupled (ref
+                # append_regularization_ops is optimizer-independent)
+                g_val = g_val + p.regularizer(p.value.astype(jnp.float32))
             decay = self._wd_coeff
             if self._apply_decay_param_fun is not None and \
                     not self._apply_decay_param_fun(p.name):
                 decay = 0.0
             lr_r = self._lr_ratio(p) if self._lr_ratio is not None else 1.0
             new_val, new_slots = self._apply_adamw(
-                p.value, g.value.astype(jnp.float32), lr * lr_r, self._global_step, decay,
+                p.value, g_val, lr * lr_r, self._global_step, decay,
                 {k: v for k, v in slots.items() if not k.startswith("__")})
             p._value = new_val
             slots.update(new_slots)
 
-    def pure_update(self, params, grads, state, lr, step, pnames=None):
+    def pure_update(self, params, grads, state, lr, step, pnames=None,
+                    regularizers=None):
+        # AdamW decay is decoupled; a per-param ParamAttr regularizer still
+        # adds its gradient (same as the eager step() path)
+        regularizers = regularizers or {}
         new_params, new_state = {}, {}
         for name, p in params.items():
             g = grads.get(name)
@@ -322,6 +338,9 @@ class AdamW(Adam):
                 new_params[name] = p
                 new_state[name] = state.get(name, {})
                 continue
+            reg = regularizers.get(name)
+            if reg is not None:
+                g = g.astype(jnp.float32) + reg(p.astype(jnp.float32))
             decay = self._wd_coeff
             if self._apply_decay_param_fun is not None and \
                     not self._apply_decay_param_fun(name):

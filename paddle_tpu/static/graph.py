@@ -64,7 +64,10 @@ def disable_static_mode():
 
 
 def in_static_mode() -> bool:
-    return _STATE.static_mode
+    # a live program_guard is static mode too — op recording and mode checks
+    # must agree, or layer code branches on in_dynamic_mode() while dispatch
+    # records ops
+    return _STATE.static_mode or bool(_STATE.guard_stack)
 
 
 def current_programs() -> Tuple["Program", Optional["Program"]]:
@@ -137,11 +140,18 @@ class Block:
         return list(self.program.params.values())
 
 
+_PROGRAM_UID = [0]
+
+
 class Program:
     """Recorded op graph (ref fluid/framework.py Program; no protobuf IR —
     jaxpr/XLA takes that role at Executor.run time)."""
 
     def __init__(self):
+        # unique forever (id() can be reused after gc, which would leak one
+        # program's optimizer state into another)
+        _PROGRAM_UID[0] += 1
+        self._uid = _PROGRAM_UID[0]
         self.ops: List[Operator] = []
         self.vars: Dict[str, Variable] = {}
         self.params: Dict[str, Parameter] = {}
@@ -443,7 +453,10 @@ class Executor:
             program = program.program
 
         # startup program: (re)materialize initial parameter values into scope
-        if not program.ops and not program.loss_name:
+        # (a main program that merely fetches feed vars has feeds/fetches and
+        # must NOT take this branch)
+        if not program.ops and not program.loss_name and not program.feeds \
+                and not fetch_list:
             main = default_main_program()
             reinit = {}
             for name, p in list(main.params.items()) + list(program.params.items()):
@@ -487,7 +500,7 @@ class Executor:
                     "applies its own backward")
             train_vals = {k: v for k, v in param_vals.items() if k in trainable}
             frozen_vals = {k: v for k, v in param_vals.items() if k not in trainable}
-            pid = id(program)
+            pid = program._uid
             if pid not in scope.opt_state:
                 scope.opt_state[pid] = {
                     "state": opt.init_state(train_vals), "step": 0,
@@ -498,6 +511,9 @@ class Executor:
             if key not in self._cache:
                 loss_name = program.loss_name
                 pruned = _prune_ops(program, [loss_name] + list(fetch_names))
+                regs = {k: p.regularizer for k, p in program.params.items()
+                        if k in trainable
+                        and getattr(p, "regularizer", None) is not None}
 
                 def train_step(params, frozen, feeds, state, lr, step):
                     def loss_fn(ps):
@@ -508,7 +524,7 @@ class Executor:
                     (loss, fetches), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(params)
                     new_params, new_state = opt.pure_update(
-                        params, grads, state, lr, step)
+                        params, grads, state, lr, step, regularizers=regs)
                     return fetches, new_params, new_state
 
                 self._cache[key] = jax.jit(train_step)
@@ -522,7 +538,7 @@ class Executor:
         else:
             marker_keys = tuple((m.target, m.wrt_kind, m.wrt_ref)
                                 for m in grad_markers)
-            key = (id(program), program._version, "infer", tuple(fetch_names),
+            key = (program._uid, program._version, "infer", tuple(fetch_names),
                    marker_keys,
                    tuple((k, v.shape, str(v.dtype)) for k, v in sorted(feed_vals.items())))
             if key not in self._cache:

@@ -25,7 +25,13 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     from paddle_tpu import nn
 
     in_shape = x.sym_shape if hasattr(x, "sym_shape") else list(x.shape)
-    flat_dim = int(np.prod([abs(d) for d in in_shape[num_flatten_dims:]]))
+    tail = list(in_shape[num_flatten_dims:])
+    if any(d in (-1, None) for d in tail):
+        raise ValueError(
+            f"static.nn.fc: dims after num_flatten_dims={num_flatten_dims} "
+            f"must be static, got {in_shape} (ref fluid layers.fc requires "
+            "a known flattened input width)")
+    flat_dim = int(np.prod(tail))
     if len(in_shape) > num_flatten_dims + 1:
         x = paddle.reshape(x, [-1] * num_flatten_dims + [flat_dim]
                            if num_flatten_dims == 1 else

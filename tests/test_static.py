@@ -252,3 +252,54 @@ def test_static_batch_norm_trains_with_batch_stats():
     # batch-stat normalization -> per-channel mean ~0, std ~1
     assert abs(o.mean()) < 1e-2
     assert abs(o.std() - 1.0) < 5e-2
+
+
+def test_static_per_param_regularizer_applied():
+    """Per-param ParamAttr regularizer must decay weights in the static path
+    too (ref append_regularization_ops is execution-mode independent)."""
+    import paddle_tpu.nn as nn
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        layer = nn.Linear(
+            4, 2,
+            weight_attr=paddle.ParamAttr(
+                regularizer=paddle.regularizer.L2Decay(0.5)),
+            bias_attr=False)
+        out = layer(x)
+        loss = paddle.mean(out)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    pname = next(iter(main.params))
+    w0 = np.asarray(scope.store.get(pname, main.params[pname].value))
+    # zero input -> data grad 0; only the regularizer moves the weights
+    exe.run(main, feed={"x": np.zeros((2, 4), np.float32)}, fetch_list=[loss])
+    w1 = np.asarray(scope.store[pname])
+    np.testing.assert_allclose(w1, w0 * (1 - 0.1 * 0.5), rtol=1e-5)
+
+
+def test_fetch_feed_var_does_not_reset_params():
+    """A program with no ops that fetches a feed var must not be mistaken for
+    a startup program (which would re-init all params in scope)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    scope.store["sentinel"] = 123
+    xs = np.random.RandomState(0).randn(2, 4).astype("float32")
+    (out,) = exe.run(main, feed={"x": xs}, fetch_list=[x])
+    np.testing.assert_allclose(out, xs)
+    assert scope.store["sentinel"] == 123
+
+
+def test_fc_dynamic_tail_dim_raises():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, None, 8], "float32")
+        with pytest.raises(ValueError):
+            static.nn.fc(x, 16)
