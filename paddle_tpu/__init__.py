@@ -67,20 +67,8 @@ from .batch import batch  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 
-def sysconfig_get_include():
-    import os as _o
-
-    return _o.path.join(_o.path.dirname(__file__), "include")
-
-
-class sysconfig:
-    get_include = staticmethod(sysconfig_get_include)
-
-    @staticmethod
-    def get_lib():
-        import os as _o
-
-        return _o.path.join(_o.path.dirname(__file__), "..", "csrc")
+from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
 
 from .framework.io_state import save, load  # paddle.save/paddle.load
 

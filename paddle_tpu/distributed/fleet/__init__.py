@@ -4,7 +4,10 @@ model.py:30 distributed_model, fleet.py:1044 distributed_optimizer).
 from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
                    fleet_instance)
 from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import data_generator  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
 _fleet = fleet_instance
 

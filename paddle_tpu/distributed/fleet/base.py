@@ -149,9 +149,19 @@ class Fleet:
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """Ref fleet.py:1044."""
+        """Ref fleet.py:1044. Static mode returns the meta-optimizer stack
+        (amp/recompute/sharding/gradient-merge program passes); dygraph wraps
+        with HybridParallelOptimizer."""
         if not self._is_initialized:
             self.init()
+        if strategy is not None:
+            self.strategy = strategy
+        from ...static.graph import in_static_mode
+
+        if in_static_mode():
+            from .meta_optimizers import StaticFleetOptimizer
+
+            return StaticFleetOptimizer(optimizer, self.strategy)
         from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
 
         return HybridParallelOptimizer(optimizer, self.hcg, self.strategy)

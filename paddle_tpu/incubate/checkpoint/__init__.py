@@ -1,0 +1,6 @@
+"""paddle.incubate.checkpoint (ref python/paddle/incubate/checkpoint/
+re-exporting fluid/incubate/checkpoint/auto_checkpoint.py)."""
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import train_epoch_range  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,144 @@
+"""Auto-checkpoint: exactly-once epoch-range resume (ref
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+AutoCheckpointChecker :72 env-driven config, train_epoch_range generator with
+epoch bookkeeping, ExeTrainStatus :210 serialized status).
+
+TPU-native: the reference snapshots executor state to HDFS inside the epoch
+loop.  Here the loop generator persists an epoch-progress record plus (opt-in)
+a state_dict snapshot to a local/NFS dir (checkpoint storage on TPU jobs is
+typically GCS-fuse or NFS mounts — same file API), and on restart skips the
+epochs already completed: the recovery story for elastic restarts
+(SURVEY §5.3/§5.4).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Iterator, Optional
+
+__all__ = []
+
+_EPOCH_STATUS_FILE = "acp_epoch_status.json"
+
+
+class AutoCheckpointChecker:
+    """Env-driven enable/config (ref auto_checkpoint.py:72; env vars renamed
+    from HDFS to a generic checkpoint dir)."""
+
+    def __init__(self):
+        self.run_env = os.environ.get("PADDLE_RUNNING_ENV", "")
+        self.platform = os.environ.get("PADDLE_RUNNING_PLATFORM", "")
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "default_job")
+        self.ckpt_home = os.environ.get(
+            "PADDLE_CHECKPOINT_DIR",
+            os.environ.get("PADDLE_EDL_HDFS_CHECKPOINT_PATH", ""))
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.save_checkpoint_inter = int(
+            os.environ.get("PADDLE_EDL_SAVE_CHECKPOINT_INTER", 900))
+
+    def valid(self) -> bool:
+        return bool(self.ckpt_home)
+
+    def get_job_path(self) -> str:
+        return os.path.join(self.ckpt_home, self.job_id)
+
+    def get_range_checkpoint_path(self, name: str) -> str:
+        return os.path.join(self.get_job_path(), "range", name)
+
+    def __str__(self):
+        return (f"AutoCheckpointChecker(job={self.job_id!r}, "
+                f"home={self.ckpt_home!r}, trainer={self.trainer_id})")
+
+
+g_checker: Optional[AutoCheckpointChecker] = None
+
+
+def _get_checker() -> AutoCheckpointChecker:
+    global g_checker
+    if g_checker is None:
+        g_checker = AutoCheckpointChecker()
+    return g_checker
+
+
+class TrainEpochRange:
+    """Epoch bookkeeping for one named range (ref ExeTrainStatus/TrainEpochRange)."""
+
+    def __init__(self, max_epoch_num: int, name: str,
+                 checkpoint_inter: Optional[int] = None, save_fn=None,
+                 restore_fn=None):
+        self.name = name
+        self.max_epoch_num = max_epoch_num
+        self.checker = _get_checker()
+        self.restored_from = None
+        self.last_checkpoint_time = time.time()
+        self.checkpoint_inter = (checkpoint_inter
+                                 if checkpoint_inter is not None
+                                 else self.checker.save_checkpoint_inter)
+        self._save_fn = save_fn
+        self._restore_fn = restore_fn
+        self._completed = -1
+        if self.checker.valid():
+            self._path = self.checker.get_range_checkpoint_path(name)
+            os.makedirs(self._path, exist_ok=True)
+            status = os.path.join(self._path, _EPOCH_STATUS_FILE)
+            if os.path.exists(status):
+                with open(status) as f:
+                    rec = json.load(f)
+                self._completed = int(rec.get("epoch_no", -1))
+                self.restored_from = status
+                if self._restore_fn is not None and rec.get("has_state"):
+                    self._restore_fn(os.path.join(self._path, "state"))
+        else:
+            self._path = None
+
+    def _persist(self, epoch_no: int, force: bool = False):
+        if self._path is None:
+            return
+        has_state = False
+        now = time.time()
+        if self._save_fn is not None and (
+                force or now - self.last_checkpoint_time >= self.checkpoint_inter):
+            self._save_fn(os.path.join(self._path, "state"))
+            self.last_checkpoint_time = now
+            has_state = True
+        tmp = os.path.join(self._path, _EPOCH_STATUS_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump({"name": self.name, "epoch_no": epoch_no,
+                       "has_state": has_state or self._save_fn is not None,
+                       "timestamp": now}, f)
+        os.replace(tmp, os.path.join(self._path, _EPOCH_STATUS_FILE))
+
+    def next(self) -> Iterator[int]:
+        start = self._completed + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            self._completed = epoch
+            self._persist(epoch, force=(epoch == self.max_epoch_num - 1))
+
+
+g_train_epoch_range: Optional[TrainEpochRange] = None
+
+
+def train_epoch_range(max_epoch_num: int, name: Optional[str] = None,
+                      save_checkpoint_inter: Optional[int] = None,
+                      save_fn=None, restore_fn=None) -> Iterator[int]:
+    """Resumable epoch loop (ref auto_checkpoint.py train_epoch_range):
+
+        for epoch in train_epoch_range(10, name="job0",
+                                       save_fn=..., restore_fn=...):
+            train_one_epoch()
+
+    On restart with the same PADDLE_CHECKPOINT_DIR/PADDLE_JOB_ID, completed
+    epochs are skipped exactly-once; save_fn(path)/restore_fn(path) snapshot
+    and restore model+optimizer state (e.g. via paddle.save/state_dict).
+    """
+    global g_train_epoch_range
+    g_train_epoch_range = TrainEpochRange(
+        max_epoch_num, name or "default_range",
+        checkpoint_inter=save_checkpoint_inter,
+        save_fn=save_fn, restore_fn=restore_fn)
+    try:
+        yield from g_train_epoch_range.next()
+    finally:
+        g_train_epoch_range = None

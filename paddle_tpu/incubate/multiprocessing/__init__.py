@@ -1,0 +1,15 @@
+"""paddle.incubate.multiprocessing (ref python/paddle/incubate/
+multiprocessing/ — CUDA-IPC / shared-memory tensor passing between processes).
+
+TPU-native: device memory is owned by the XLA runtime and is not IPC-shareable
+the way CUDA allocations are; cross-process tensor transport goes through host
+shared memory.  We register pickle reductions that move Tensor data via
+``multiprocessing.shared_memory`` blocks (the analogue of the reference's
+file_descriptor/file_system LoDTensor strategies in reductions.py), so
+``mp.Queue``/``Pipe`` of Tensors avoids a serialize copy of the payload.
+"""
+from .reductions import init_reductions  # noqa: F401
+
+init_reductions()
+
+__all__ = []

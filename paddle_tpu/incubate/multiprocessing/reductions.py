@@ -1,0 +1,56 @@
+"""Shared-memory pickle reductions for Tensor (ref python/paddle/incubate/
+multiprocessing/reductions.py:94 _reduce_tensor / :182 init_reductions).
+
+The reference shares CUDA memory via cudaIpcGetMemHandle and CPU LoDTensors
+via /dev/shm files.  Here a Tensor crossing a process boundary is staged to a
+``multiprocessing.shared_memory`` block; the receiver maps it zero-copy and
+wraps it back into a Tensor (device placement happens lazily on first use,
+as with any host array entering jax).
+"""
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ...framework.core import Tensor, to_array
+
+__all__ = ["init_reductions"]
+
+# keep SharedMemory blocks alive on the producer side until gc
+_PRODUCED = []
+
+
+def _rebuild_tensor_from_shm(shm_name, shape, dtype_str, stop_gradient):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_str), buffer=shm.buf)
+        t = Tensor(np.array(arr), stop_gradient=stop_gradient)  # own the data
+    finally:
+        shm.close()
+        try:
+            shm.unlink()  # receiver owns the lifetime: one-shot handoff
+        except FileNotFoundError:
+            pass
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    arr = np.asarray(to_array(t))
+    if arr.nbytes == 0:
+        return (Tensor, (arr,))
+    shm = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+    dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+    dst[...] = arr
+    _PRODUCED.append(shm)  # hold mapping until interpreter exit
+    return (_rebuild_tensor_from_shm,
+            (shm.name, arr.shape, arr.dtype.str, bool(t.stop_gradient)))
+
+
+def init_reductions() -> None:
+    """Register with ForkingPickler ONLY (ref reductions.py:182): the shm
+    path must apply to multiprocessing transport, not to ordinary pickling
+    (paddle.save must keep writing self-contained files)."""
+    from multiprocessing.reduction import ForkingPickler
+
+    ForkingPickler.register(Tensor, _reduce_tensor)

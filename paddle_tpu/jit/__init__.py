@@ -227,6 +227,49 @@ def ignore_module(modules):
     pass
 
 
+def _trace_to_exported(layer, input_spec):
+    """Trace layer.forward over input_spec into a jax.export Exported
+    (StableHLO) + its param values. Shared by jit.save and onnx.export."""
+    from jax import export as jexport
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        params = state_values(layer)
+
+        def fn(params, *args):
+            out = functional_call(layer, params, *[Tensor(a) for a in args])
+            return jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        # None/-1 dims (the canonical dynamic-batch InputSpec) export as
+        # jax.export symbolic dimensions — batch-polymorphic StableHLO
+        scope = jexport.SymbolicScope()
+        in_avals = []
+        n_sym = 0
+        for s in input_spec:
+            if any(d is None or d == -1 for d in s.shape):
+                dims = []
+                for d in s.shape:
+                    if d is None or d == -1:
+                        dims.append(f"b{n_sym}")
+                        n_sym += 1
+                    else:
+                        dims.append(str(d))
+                shape = jexport.symbolic_shape(", ".join(dims), scope=scope)
+            else:
+                shape = tuple(s.shape)
+            in_avals.append(jax.ShapeDtypeStruct(shape, s.dtype))
+        exported = jexport.export(jax.jit(fn))(
+            jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+                                   params), *in_avals)
+        return exported, params
+    finally:
+        if was_training:
+            layer.train()
+
+
 def save(layer, path, input_spec=None, **configs):
     """paddle.jit.save parity (ref jit/api.py jit.save → TranslatedLayer).
 
@@ -253,47 +296,12 @@ def save(layer, path, input_spec=None, **configs):
         pickle.dump(meta, f)
     if input_spec:
         import numpy as np
-        from jax import export as jexport
 
-        was_training = layer.training
-        layer.eval()
-        try:
-            params = state_values(layer)
-
-            def fn(params, *args):
-                out = functional_call(layer, params, *[Tensor(a) for a in args])
-                return jax.tree_util.tree_map(
-                    lambda t: t.value if isinstance(t, Tensor) else t, out,
-                    is_leaf=lambda t: isinstance(t, Tensor))
-
-            # None/-1 dims (the canonical dynamic-batch InputSpec) export as
-            # jax.export symbolic dimensions — batch-polymorphic StableHLO
-            scope = jexport.SymbolicScope()
-            in_avals = []
-            n_sym = 0
-            for s in input_spec:
-                if any(d is None or d == -1 for d in s.shape):
-                    dims = []
-                    for d in s.shape:
-                        if d is None or d == -1:
-                            dims.append(f"b{n_sym}")
-                            n_sym += 1
-                        else:
-                            dims.append(str(d))
-                    shape = jexport.symbolic_shape(", ".join(dims), scope=scope)
-                else:
-                    shape = tuple(s.shape)
-                in_avals.append(jax.ShapeDtypeStruct(shape, s.dtype))
-            exported = jexport.export(jax.jit(fn))(
-                jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
-                                       params), *in_avals)
-            with open(path + ".stablehlo", "wb") as f:
-                f.write(exported.serialize())
-            with open(path + ".pdexport", "wb") as f:
-                pickle.dump(jax.tree_util.tree_map(np.asarray, params), f)
-        finally:
-            if was_training:
-                layer.train()
+        exported, params = _trace_to_exported(layer, input_spec)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".pdexport", "wb") as f:
+            pickle.dump(jax.tree_util.tree_map(np.asarray, params), f)
 
 
 class TranslatedLayer:

@@ -1,0 +1,4 @@
+"""paddle.onnx parity (ref python/paddle/onnx/export.py)."""
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

@@ -1,0 +1,52 @@
+"""ONNX export (ref python/paddle/onnx/export.py export(), which delegates to
+the external paddle2onnx package).
+
+TPU-native: the portable interchange format for XLA programs is StableHLO —
+`paddle.jit.save` / `paddle.inference` already export it, and it is what TPU
+serving consumes.  ONNX export is provided for CPU/GPU interop when the
+`onnx` package is installed: the traced jaxpr is converted via jax's
+tf-less exporters if available, else we raise with guidance (the reference
+likewise raises unless paddle2onnx is installed).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = []
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs):
+    """Export a Layer to ``<path>.onnx`` (ref export.py export()).
+
+    Requires the ``onnx`` package (not bundled, mirroring the reference's
+    external paddle2onnx dependency).  For the TPU-native interchange path use
+    ``paddle.jit.save`` (StableHLO), which needs no extra packages.
+    """
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export requires the 'onnx' package, which is not "
+            "installed in this environment (the reference has the same "
+            "external dependency on paddle2onnx). For TPU-native model "
+            "interchange use paddle.jit.save(layer, path) — it exports "
+            "batch-polymorphic StableHLO loadable by paddle.inference."
+        ) from e
+
+    from ..jit import _trace_to_exported  # jaxpr -> jax.export Exported
+
+    exported, _params = _trace_to_exported(layer, input_spec or [])
+    # With onnx available, go through jax's StableHLO -> ONNX conversion if
+    # present in the environment; otherwise surface the gap explicitly.
+    try:
+        from jax.experimental import export_onnx  # not in all jax versions
+    except ImportError as e:
+        raise NotImplementedError(
+            "this jax build has no StableHLO->ONNX converter; use "
+            "paddle.jit.save for StableHLO export instead") from e
+    model = export_onnx.convert(exported, opset_version=opset_version)
+    out = path if path.endswith(".onnx") else path + ".onnx"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    onnx.save(model, out)
+    return out

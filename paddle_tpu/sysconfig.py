@@ -1,0 +1,21 @@
+"""paddle.sysconfig parity (ref python/paddle/sysconfig.py:20 get_include,
+:39 get_lib) — paths for compiling C extensions against the framework.
+
+TPU-native: the native surface is the C-ABI custom-op SDK
+(utils/cpp_extension.py) and csrc/ shared objects; there are no CUDA headers.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the framework's C headers (csrc/)."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing the framework's shared libraries."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")
