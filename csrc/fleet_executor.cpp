@@ -1,0 +1,239 @@
+// paddle_tpu native actor runtime.
+//
+// TPU-native equivalent of the reference FleetExecutor
+// (ref paddle/fluid/distributed/fleet_executor/: Carrier carrier.h:49,
+// Interceptor message loop interceptor.h:46, ComputeInterceptor /
+// AmplifierInterceptor, TaskNode DAG, brpc MessageBus). On TPU the
+// accelerator data plane is XLA collectives inside compiled programs, so the
+// actor runtime's job is HOST-side orchestration: driving per-stage callbacks
+// (microbatch pipeline schedules, async IO stages, checkpoint writers)
+// concurrently with device compute. Cross-rank brpc messaging is therefore
+// out of scope (single-host mailboxes; multi-host control uses the Python KV
+// store) — the scheduling semantics (credit-based upstream/downstream flow
+// control, per-step message loop) match the reference's ComputeInterceptor:
+// a node runs step s when every upstream has finished s AND every downstream
+// has consumed s - buffer_size (ready/credit counters, interceptor.cc
+// Compute/Amplifier RunOps loop).
+//
+// Build: g++ -O3 -shared -fPIC -o libfleet_executor.so fleet_executor.cpp -lpthread
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// message kinds (ref interceptor_message.proto: DATA_IS_READY, DATA_IS_USELESS,
+// STOP)
+enum MsgType : int32_t {
+  kDataIsReady = 0,   // upstream finished a step
+  kDataIsUseless = 1, // downstream consumed a step (credit returned)
+  kStop = 2,
+};
+
+struct Message {
+  int32_t type;
+  int64_t src;
+  int64_t step;
+};
+
+// task callback: status = fn(task_id, step); nonzero aborts the run
+using TaskFn = int64_t (*)(int64_t, int64_t);
+
+struct TaskNode {
+  int64_t id = 0;
+  int64_t role = 0; // opaque to the runtime (ref task_node.h role for sched)
+  int64_t max_run_times = 1;     // microbatch count
+  int64_t buffer_size = 1;       // downstream credit (ref buff size / 1F1B depth)
+  std::vector<int64_t> upstream;
+  std::vector<int64_t> downstream;
+  TaskFn fn = nullptr;
+};
+
+class Interceptor {
+ public:
+  Interceptor(const TaskNode& node, class Carrier* carrier)
+      : node_(node), carrier_(carrier) {}
+
+  void Start() { thread_ = std::thread([this] { Loop(); }); }
+  void Join() {
+    if (thread_.joinable()) thread_.join();
+  }
+
+  void Enqueue(const Message& m) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      box_.push_back(m);
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop();
+  bool Ready() const {
+    // all upstreams delivered step `step_`, and we hold downstream credit
+    // (ref compute_interceptor.cc IsInputReady/CanWriteOutput)
+    if (step_ >= node_.max_run_times) return false;
+    for (auto& kv : up_seen_)
+      if (kv.second <= step_) return false;
+    return consumed_ + node_.buffer_size > step_;
+  }
+
+  TaskNode node_;
+  Carrier* carrier_;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Message> box_;
+  std::map<int64_t, int64_t> up_seen_; // upstream id -> #steps delivered
+  int64_t step_ = 0;                   // next step to run
+  int64_t consumed_ = 0;               // min steps consumed downstream
+  std::map<int64_t, int64_t> down_consumed_;
+};
+
+class Carrier {
+ public:
+  int64_t AddNode(const TaskNode& n) {
+    nodes_[n.id] = n;
+    return n.id;
+  }
+
+  bool Run();
+
+  void Route(int64_t dst, const Message& m) {
+    auto it = actors_.find(dst);
+    if (it != actors_.end()) it->second->Enqueue(m);
+  }
+
+  void Abort(int64_t code) {
+    int64_t expected = 0;
+    error_.compare_exchange_strong(expected, code);
+    // wake everyone with STOP so threads exit
+    for (auto& kv : actors_) kv.second->Enqueue({kStop, -1, 0});
+  }
+
+  int64_t error() const { return error_.load(); }
+  const std::map<int64_t, TaskNode>& nodes() const { return nodes_; }
+
+ private:
+  std::map<int64_t, TaskNode> nodes_;
+  std::map<int64_t, std::unique_ptr<Interceptor>> actors_;
+  std::atomic<int64_t> error_{0};
+};
+
+void Interceptor::Loop() {
+  for (auto u : node_.upstream) up_seen_[u] = 0;
+  for (auto d : node_.downstream) down_consumed_[d] = 0;
+  bool stopped = false;
+  while (!stopped) {
+    // run every step that is ready under current credits
+    while (Ready() && carrier_->error() == 0) {
+      int64_t rc = node_.fn ? node_.fn(node_.id, step_) : 0;
+      if (rc != 0) {
+        carrier_->Abort(rc);
+        break;
+      }
+      // notify downstream: data ready; return credit upstream: consumed
+      for (auto d : node_.downstream)
+        carrier_->Route(d, {kDataIsReady, node_.id, step_});
+      for (auto u : node_.upstream)
+        carrier_->Route(u, {kDataIsUseless, node_.id, step_});
+      ++step_;
+      if (node_.downstream.empty()) consumed_ = step_; // sink self-credits
+    }
+    if (step_ >= node_.max_run_times || carrier_->error() != 0) break;
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [this] { return !box_.empty(); });
+    while (!box_.empty()) {
+      Message m = box_.front();
+      box_.pop_front();
+      switch (m.type) {
+        case kDataIsReady:
+          up_seen_[m.src] = m.step + 1;
+          break;
+        case kDataIsUseless: {
+          down_consumed_[m.src] = m.step + 1;
+          int64_t mn = step_ + 1;
+          for (auto& kv : down_consumed_) mn = std::min(mn, kv.second);
+          consumed_ = mn;
+          break;
+        }
+        case kStop:
+          stopped = true;
+          break;
+      }
+    }
+  }
+}
+
+bool Carrier::Run() {
+  error_.store(0);
+  actors_.clear();
+  for (auto& kv : nodes_)
+    actors_[kv.first] = std::unique_ptr<Interceptor>(new Interceptor(kv.second, this));
+  for (auto& kv : actors_) kv.second->Start();
+  for (auto& kv : actors_) kv.second->Join();
+  return error_.load() == 0;
+}
+
+std::mutex g_mu;
+std::map<int64_t, std::unique_ptr<Carrier>> g_carriers;
+int64_t g_next = 1;
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_carrier_create() {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_carriers[h] = std::unique_ptr<Carrier>(new Carrier());
+  return h;
+}
+
+void pt_carrier_destroy(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_carriers.erase(h);
+}
+
+// upstream/downstream: arrays of task ids
+int64_t pt_carrier_add_task(int64_t h, int64_t id, int64_t role,
+                            int64_t max_run_times, int64_t buffer_size,
+                            const int64_t* upstream, int64_t n_up,
+                            const int64_t* downstream, int64_t n_down,
+                            TaskFn fn) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_carriers.find(h);
+  if (it == g_carriers.end()) return -1;
+  TaskNode n;
+  n.id = id;
+  n.role = role;
+  n.max_run_times = max_run_times;
+  n.buffer_size = buffer_size < 1 ? 1 : buffer_size;
+  n.upstream.assign(upstream, upstream + n_up);
+  n.downstream.assign(downstream, downstream + n_down);
+  n.fn = fn;
+  return it->second->AddNode(n);
+}
+
+// returns 0 on success, else the first nonzero task status
+int64_t pt_carrier_run(int64_t h) {
+  Carrier* c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_carriers.find(h);
+    if (it == g_carriers.end()) return -1;
+    c = it->second.get();
+  }
+  c->Run();
+  return c->error();
+}
+
+}  // extern "C"

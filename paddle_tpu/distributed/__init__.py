@@ -26,5 +26,6 @@ from . import models  # noqa: F401
 from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from . import utils  # noqa: F401
+from . import fleet_executor  # noqa: F401
 
 __all__ = [n for n in dir() if not n.startswith("_")]

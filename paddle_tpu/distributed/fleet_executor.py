@@ -1,0 +1,135 @@
+"""FleetExecutor — native actor pipeline runtime (ref
+paddle/fluid/distributed/fleet_executor/: Carrier carrier.h:49, Interceptor
+interceptor.h:46, TaskNode DAG, fleet_executor.cc; Python bindings
+pybind/bind_fleet_executor.cc).
+
+TPU-native role: host-side orchestration of per-stage callbacks — microbatch
+pipeline schedules, async IO, checkpoint writers — running concurrently with
+device compute (the accelerator data plane itself is XLA collectives inside
+jitted programs, so the brpc cross-rank MessageBus is replaced by single-host
+C++ mailbox threads; multi-host control traffic uses the launch KV store).
+Backed by csrc/fleet_executor.cpp via ctypes; scheduling semantics follow the
+reference ComputeInterceptor: a task runs step s when every upstream finished
+s and downstream credit (buffer_size) is available — with buffer_size=1 a
+linear chain executes in the classic pipelined (1F1B-shaped) order.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["TaskNode", "FleetExecutor"]
+
+_TASK_FN = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64, ctypes.c_int64)
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _lib():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            root = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), "csrc")
+            so = os.path.join(root, "libfleet_executor.so")
+            if not os.path.exists(so):
+                subprocess.check_call(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", so,
+                     os.path.join(root, "fleet_executor.cpp"), "-lpthread"])
+            lib = ctypes.CDLL(so)
+            lib.pt_carrier_create.restype = ctypes.c_int64
+            lib.pt_carrier_add_task.restype = ctypes.c_int64
+            lib.pt_carrier_add_task.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, _TASK_FN]
+            lib.pt_carrier_run.restype = ctypes.c_int64
+            lib.pt_carrier_run.argtypes = [ctypes.c_int64]
+            lib.pt_carrier_destroy.argtypes = [ctypes.c_int64]
+            _LIB = lib
+    return _LIB
+
+
+class TaskNode:
+    """One node of the pipeline DAG (ref task_node.h; role kinds ref
+    task_node.cc — here role is an opaque label)."""
+
+    def __init__(self, task_id: int, fn: Callable[[int, int], None],
+                 max_run_times: int = 1, role: int = 0, buffer_size: int = 1):
+        self.task_id = int(task_id)
+        self.fn = fn
+        self.max_run_times = int(max_run_times)
+        self.role = int(role)
+        self.buffer_size = int(buffer_size)
+        self.upstream: List[int] = []
+        self.downstream: List[int] = []
+
+    def add_upstream_task(self, task_id: int, buff_size: int = 1):
+        self.upstream.append(int(task_id))
+
+    def add_downstream_task(self, task_id: int, buff_size: int = 1):
+        self.downstream.append(int(task_id))
+
+
+class FleetExecutor:
+    """Carrier facade (ref fleet_executor.cc Init/Run). Tasks' Python
+    callbacks run on C++ interceptor threads (ctypes re-acquires the GIL per
+    call); exceptions abort the whole run and re-raise on the caller."""
+
+    def __init__(self):
+        self._nodes: Dict[int, TaskNode] = {}
+
+    def add_task_node(self, node: TaskNode) -> TaskNode:
+        self._nodes[node.task_id] = node
+        return node
+
+    def task_chain(self, fns: Sequence[Callable[[int, int], None]],
+                   max_run_times: int, buffer_size: int = 1) -> List[TaskNode]:
+        """Convenience: wire fns[0] -> fns[1] -> ... as a pipeline."""
+        nodes = [self.add_task_node(TaskNode(i, fn, max_run_times,
+                                             buffer_size=buffer_size))
+                 for i, fn in enumerate(fns)]
+        for a, b in zip(nodes, nodes[1:]):
+            a.add_downstream_task(b.task_id)
+            b.add_upstream_task(a.task_id)
+        return nodes
+
+    def run(self) -> None:
+        lib = _lib()
+        h = lib.pt_carrier_create()
+        errors: Dict[int, BaseException] = {}
+        keepalive = []  # CFUNCTYPE objects must outlive the run
+        try:
+            for node in self._nodes.values():
+                def make_cb(n: TaskNode):
+                    def cb(task_id, step):
+                        try:
+                            n.fn(int(task_id), int(step))
+                            return 0
+                        except BaseException as e:  # surface to caller
+                            errors[int(task_id)] = e
+                            return 1
+                    return _TASK_FN(cb)
+
+                cfn = make_cb(node)
+                keepalive.append(cfn)
+                up = (ctypes.c_int64 * max(len(node.upstream), 1))(
+                    *node.upstream)
+                down = (ctypes.c_int64 * max(len(node.downstream), 1))(
+                    *node.downstream)
+                lib.pt_carrier_add_task(
+                    h, node.task_id, node.role, node.max_run_times,
+                    node.buffer_size, up, len(node.upstream), down,
+                    len(node.downstream), cfn)
+            rc = lib.pt_carrier_run(h)
+            if rc != 0:
+                if errors:
+                    raise next(iter(errors.values()))
+                raise RuntimeError(f"FleetExecutor run failed with status {rc}")
+        finally:
+            lib.pt_carrier_destroy(h)

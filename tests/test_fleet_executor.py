@@ -1,0 +1,86 @@
+"""FleetExecutor actor-runtime tests (ref fleet_executor C++ tests message-pass
+single-process via in-proc Carrier, SURVEY §4 fixtures)."""
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet_executor import FleetExecutor, TaskNode
+
+
+def _recorder(log, lock, delay=0.0):
+    def fn(task_id, step):
+        with lock:
+            log.append((task_id, step))
+        if delay:
+            time.sleep(delay)
+    return fn
+
+
+def test_chain_runs_all_steps_in_pipeline_order():
+    log, lock = [], threading.Lock()
+    ex = FleetExecutor()
+    ex.task_chain([_recorder(log, lock, 0.001)] * 3, max_run_times=4)
+    ex.run()
+    assert sorted(log) == [(t, s) for t in range(3) for s in range(4)]
+    pos = {e: i for i, e in enumerate(log)}
+    for t in range(1, 3):
+        for s in range(4):
+            assert pos[(t, s)] > pos[(t - 1, s)]  # dataflow order per step
+
+
+def test_buffer_size_flow_control():
+    """With buffer_size=1, the source may run at most 1 step ahead of an
+    unconsumed downstream (credit-based backpressure, ref compute_interceptor
+    CanWriteOutput)."""
+    log, lock = [], threading.Lock()
+
+    def slow_sink(task_id, step):
+        time.sleep(0.01)
+        with lock:
+            log.append(("sink", step))
+
+    def source(task_id, step):
+        with lock:
+            log.append(("src", step))
+
+    ex = FleetExecutor()
+    src = ex.add_task_node(TaskNode(0, source, max_run_times=4, buffer_size=1))
+    snk = ex.add_task_node(TaskNode(1, slow_sink, max_run_times=4, buffer_size=1))
+    src.add_downstream_task(1)
+    snk.add_upstream_task(0)
+    ex.run()
+    pos = {e: i for i, e in enumerate(log)}
+    # src step s+1 must wait for sink consuming step s (credit return)
+    for s in range(3):
+        assert pos[("src", s + 1)] > pos[("sink", s)]
+
+
+def test_diamond_dag_joins_both_upstreams():
+    log, lock = [], threading.Lock()
+    ex = FleetExecutor()
+    rec = _recorder(log, lock)
+    a = ex.add_task_node(TaskNode(0, rec, max_run_times=3))
+    b = ex.add_task_node(TaskNode(1, rec, max_run_times=3))
+    c = ex.add_task_node(TaskNode(2, rec, max_run_times=3))
+    d = ex.add_task_node(TaskNode(3, rec, max_run_times=3))
+    for mid in (1, 2):
+        a.add_downstream_task(mid)
+        ex._nodes[mid].add_upstream_task(0)
+        ex._nodes[mid].add_downstream_task(3)
+        d.add_upstream_task(mid)
+    ex.run()
+    pos = {e: i for i, e in enumerate(log)}
+    for s in range(3):
+        assert pos[(3, s)] > pos[(1, s)] and pos[(3, s)] > pos[(2, s)]
+
+
+def test_exception_aborts_and_reraises():
+    def boom(task_id, step):
+        if step == 2:
+            raise RuntimeError("stage failed")
+
+    ex = FleetExecutor()
+    ex.task_chain([_recorder([], threading.Lock()), boom], max_run_times=5)
+    with pytest.raises(RuntimeError, match="stage failed"):
+        ex.run()
