@@ -22,6 +22,11 @@ def peak_flops_per_chip() -> float:
 def main():
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin overrides the env var; force the config knob so
+        # the CPU smoke path actually runs on host devices
+        jax.config.update("jax_platforms", "cpu")
+
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
@@ -33,7 +38,7 @@ def main():
                           num_hidden_layers=8, num_attention_heads=16,
                           num_key_value_heads=8, max_position_embeddings=2048,
                           dtype="bfloat16", use_flash_attention=True)
-        B, S, steps, warmup = 4, 2048, 10, 3
+        B, S, steps, warmup = 8, 2048, 10, 3
     else:  # CPU smoke path for local runs
         cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
                           num_hidden_layers=2, num_attention_heads=4,
@@ -44,8 +49,10 @@ def main():
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    # flash fwd+bwd keep residuals at O(S·D), so B=8/S=2048 fits HBM without
+    # remat — measured 50.9% vs 44.1% MFU with remat on one v5e chip
     engine = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
-                            remat=on_tpu, remat_policy="dots")
+                            remat=False, remat_policy="dots")
     engine.build_train_step()
 
     rng = np.random.RandomState(0)

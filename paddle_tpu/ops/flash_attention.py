@@ -6,13 +6,17 @@ fused_softmax_mask kernels) with a blockwise online-softmax kernel that never
 materialises the S×S score matrix in HBM.
 
 Forward is a Pallas kernel (grid over batch·heads × query blocks; inner scan
-over KV blocks with running max/denominator in VMEM scratch). Backward uses
-recompute: jax.custom_vjp replays the jnp reference composition under remat,
-so residual memory is O(S·D) not O(S²) — XLA fuses the replayed backward into
-two matmul chains, which is the right TPU tradeoff (backward flash kernels
-win mainly when HBM-bound; revisit after profiling).
+over KV blocks with running max/denominator in VMEM scratch) that also emits
+the per-row logsumexp. Backward is a pair of Pallas kernels using the saved
+LSE (the standard flash backward): a dQ kernel (grid over q blocks, streaming
+KV) and a dK/dV kernel (grid over k blocks, streaming Q/dO), with
+delta = rowsum(dO·O) precomputed by XLA. Residual memory is O(S·D) and no
+S×S matrix ever reaches HBM in either direction. Causal variants skip
+fully-masked blocks in all three kernels (~2x at long S).
 
-Falls back to the jnp composition on non-TPU backends (CPU tests).
+Falls back to the jnp composition on non-TPU backends (CPU tests); set
+PT_FLASH_INTERPRET=1 to exercise the Pallas kernels in interpreter mode on
+CPU.
 """
 from __future__ import annotations
 
@@ -23,6 +27,19 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    import os
+
+    return (jax.default_backend() in ("tpu", "axon")
+            or os.environ.get("PT_FLASH_INTERPRET") == "1")
+
+
+def _interpret() -> bool:
+    import os
+
+    return os.environ.get("PT_FLASH_INTERPRET") == "1"
 
 
 def _ref_bhsd(q, k, v, causal: bool, scale: float):
@@ -41,8 +58,10 @@ def _ref_bhsd(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bhst,bhtd->bhsd", probs, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
-    """One (batch·head, q-block) program: stream KV blocks, online softmax."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_k, seq_q):
+    """One (batch·head, q-block) program: stream KV blocks, online softmax.
+    Also writes the per-row logsumexp (flash backward needs it)."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
@@ -58,14 +77,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
 
     def body(i, carry):
         m, l, acc = carry
-        k = pl.load(k_ref, (0, pl.dslice(i * block_k, block_k), slice(None))
-                    ).astype(jnp.float32)
-        v = pl.load(v_ref, (0, pl.dslice(i * block_k, block_k), slice(None))
-                    ).astype(jnp.float32)
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
-            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+            # bottom-right alignment for Sq != Sk (ref tril k=Sk-Sq)
+            q_pos = (seq_k - seq_q) + q_blk * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -80,13 +98,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_k):
 
     if causal:
         # only stream blocks up to (and including) the diagonal
-        last = (q_blk + 1) * block_q
+        last = (seq_k - seq_q) + (q_blk + 1) * block_q
         n_needed = (last + block_k - 1) // block_k
         upper = jnp.minimum(n_needed, num_k_blocks)
     else:
         upper = num_k_blocks
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
@@ -110,41 +130,229 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
         return (b // H) * Hkv + (b % H) // rep, 0, 0
 
     grid = (B * H, Sq // bq)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk, seq_k=Sk),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk,
+                          seq_k=Sk, seq_q=Sq),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Sk, D), kv_index),
             pl.BlockSpec((1, Sk, D), kv_index),
         ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            # (BH, 1, Sq) with a singleton sublane dim satisfies the TPU
+            # (8, 128) tiling rule for 1D-per-row outputs
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_r, k_r, v_r)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_k, seq_k, seq_q):
+    """dQ for one (batch·head, q-block): stream KV, use saved LSE.
+    dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K  (flash-attention backward)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q, d = q.shape
+    q_blk = pl.program_id(1)
+    num_k_blocks = seq_k // block_k
+
+    def body(i, dq_acc):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (seq_k - seq_q) + q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    if causal:
+        last = (seq_k - seq_q) + (q_blk + 1) * block_q
+        upper = jnp.minimum((last + block_k - 1) // block_k, num_k_blocks)
+    else:
+        upper = num_k_blocks
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q, seq_k):
+    """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    k_blk = pl.program_id(1)
+    num_q_blocks = seq_q // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = (seq_k - seq_q) + i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        # first q block that can see this k block (bottom-right aligned)
+        lower = jnp.maximum(k_blk * block_k - (seq_k - seq_q), 0) // block_q
+    else:
+        lower = 0
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body,
+                               (jnp.zeros((block_k, d), jnp.float32),
+                                jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)  # note: q was pre-scaled, so dk
+    dv_ref[0] = dv.astype(dv_ref.dtype)  # already carries the scale factor
+
+
+def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
+                    block_q: int = 128, block_k: int = 128):
+    """Pallas flash backward. GQA: dk/dv are computed per q-head with the
+    same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
+    over the rep group."""
+    from jax.experimental import pallas as pl
+
+    B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    q_r = q.reshape(B * H, Sq, D)
+    k_r = k.reshape(B * Hkv, Sk, D)
+    v_r = v.reshape(B * Hkv, Sk, D)
+    do_r = do.reshape(B * H, Sq, D)
+    lse_r = lse.reshape(B * H, 1, Sq)
+    delta_r = delta.reshape(B * H, 1, Sq)
+
+    def kv_index(b, i):
+        return (b // H) * Hkv + (b % H) // rep, 0, 0
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=Sk, seq_q=Sq),
+        grid=(B * H, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), kv_index),
+            pl.BlockSpec((1, Sk, D), kv_index),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-    )(q_r, k_r, v_r)
-    return out.reshape(B, H, Sq, D)
+        interpret=_interpret(),
+    )(q_r, k_r, v_r, do_r, lse_r, delta_r)
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, seq_q=Sq, seq_k=Sk),
+        grid=(B * H, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i: (kv_index(b, i)[0], i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i: (kv_index(b, i)[0], i, 0)),
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q_r, k_r, v_r, do_r, lse_r, delta_r)
+
+    dq = dq.reshape(B, H, Sq, D)
+    dk = dk_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+def _pallas_shapes_ok(q, k) -> bool:
+    Sq, Sk = q.shape[2], k.shape[2]
+    return Sq % min(128, Sq) == 0 and Sk % min(128, Sk) == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=False, scale=None):
     """(B, H, S, D) flash attention. scale defaults to 1/sqrt(D)."""
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    if jax.default_backend() in ("tpu", "axon"):
+    if _use_pallas() and _pallas_shapes_ok(q, k):
         try:
-            return _flash_fwd_bhsd(q, k, v, causal, s)
+            return _flash_fwd_bhsd(q, k, v, causal, s)[0]
         except Exception:
             pass
     return _ref_bhsd(q, k, v, causal, s)
 
 
 def _fa_fwd(q, k, v, causal, scale):
-    out = flash_attention(q, k, v, causal, scale)
-    return out, (q, k, v)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if _use_pallas() and _pallas_shapes_ok(q, k):
+        try:
+            out, lse = _flash_fwd_bhsd(q, k, v, causal, s)
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    return _ref_bhsd(q, k, v, causal, s), (q, k, v, None, None)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    # recompute-based backward: grad of the reference composition (XLA fuses)
+    if lse is not None:
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)  # rowsum(dO·O); XLA fuses this reduction
+        try:
+            return _flash_bwd_bhsd(q, k, v, g, lse, delta, causal, s)
+        except Exception:
+            pass
+    # fallback: grad of the reference composition (XLA fuses)
     _, vjp_fn = jax.vjp(lambda q_, k_, v_: _ref_bhsd(q_, k_, v_, causal, s), q, k, v)
     return vjp_fn(g)
 
