@@ -27,5 +27,11 @@ from . import passes  # noqa: F401
 from . import rpc  # noqa: F401
 from . import utils  # noqa: F401
 from . import fleet_executor  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from . import communicator  # noqa: F401
+from . import entry_attr  # noqa: F401
+from . import parallel_with_gloo  # noqa: F401
+from .entry_attr import CountFilterEntry, ProbabilityEntry, ShowClickEntry  # noqa: F401
+from .parallel_with_gloo import gloo_barrier, gloo_init_parallel_env, gloo_release  # noqa: F401
 
 __all__ = [n for n in dir() if not n.startswith("_")]

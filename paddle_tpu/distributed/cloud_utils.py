@@ -1,0 +1,45 @@
+"""paddle.distributed.cloud_utils (ref cloud_utils.py:27 get_cloud_cluster —
+build the Cluster/Pod topology from cloud-scheduler env vars).
+
+TPU note: on TPU pods the runtime publishes topology via its own env
+(TPU_WORKER_HOSTNAMES etc.); the Paddle cloud env names are still honored so
+launch scripts port over unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+from .utils.launch_utils import get_cluster, logger
+
+__all__ = []
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
+                      selected_devices=None):
+    """ref cloud_utils.py:27 — prefers PADDLE_TRAINERS/POD_IP env (the cloud
+    scheduler contract), falls back to the passed args."""
+    node_ips = os.getenv("PADDLE_TRAINERS")
+    node_ips = node_ips.split(",") if node_ips else (args_node_ips or ["127.0.0.1"])
+    node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+    port = int(os.getenv("PADDLE_PORT", args_port))
+    devices = selected_devices if selected_devices is not None else [0]
+
+    trainer_endpoints = []
+    for ip in node_ips:
+        trainer_endpoints.append([f"{ip}:{port + i}" for i in range(len(devices))])
+    cluster, pod = get_cluster(node_ips, node_ip, trainer_endpoints, devices)
+    logger.debug("cloud cluster: %s", cluster)
+    return cluster, pod
+
+
+def _get_trainers_num() -> int:
+    return int(os.getenv("PADDLE_TRAINERS_NUM", 1))
+
+
+def get_cluster_and_pod(args):
+    """ref cloud_utils.py:124"""
+    return get_cloud_cluster(
+        getattr(args, "cluster_node_ips", None),
+        getattr(args, "node_ip", None),
+        getattr(args, "started_port", 6170) or 6170,
+        list(range(getattr(args, "nproc_per_node", 1) or 1)))

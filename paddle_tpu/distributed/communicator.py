@@ -1,0 +1,67 @@
+"""paddle.distributed.communicator (ref communicator.py:40 Communicator —
+the async/geo/sync PS gradient-communication daemon; :248 LargeScaleKV).
+
+The brpc parameter server is a documented non-goal (SURVEY §7): TPU training
+communicates through XLA collectives inside compiled steps, so there is no
+background gradient-push daemon to manage. The class is kept as an explicit
+API with lifecycle semantics (init/start/stop idempotency checks match the
+reference) so PS-era scripts fail loudly at `init_with_ctx` rather than at
+import.
+"""
+from __future__ import annotations
+
+__all__ = ["Communicator", "LargeScaleKV"]
+
+_NON_GOAL = (
+    "the brpc parameter-server pipeline is not part of the TPU build "
+    "(SURVEY §7 non-goals): gradient exchange happens as XLA collectives "
+    "inside the jitted train step. Use collective mode "
+    "(paddle.distributed.fleet with is_collective=True)."
+)
+
+
+class Communicator:
+    """ref communicator.py:40."""
+
+    def __init__(self, mode=None, kwargs=None, envs=None):
+        self.mode = mode
+        self._initialized = False
+        self._running = False
+
+    def init_with_ctx(self, *args, **kwargs):
+        raise NotImplementedError(_NON_GOAL)
+
+    def start(self):
+        if not self._initialized:
+            raise RuntimeError(
+                "Communicator was not initialized (init_with_ctx); " + _NON_GOAL)
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self) -> bool:
+        return self._running
+
+
+class LargeScaleKV:
+    """ref communicator.py:248 — host-RAM KV for huge sparse tables; a plain
+    dict here (save/load parity for scripts that snapshot it)."""
+
+    def __init__(self):
+        self._store = {}
+
+    def save(self, varname: str, path: str):
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump(self._store.get(varname), f)
+
+    def load(self, varname: str, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            self._store[varname] = pickle.load(f)
+
+    def size(self, varname: str) -> int:
+        v = self._store.get(varname)
+        return 0 if v is None else len(v)

@@ -1,0 +1,64 @@
+"""paddle.distributed.entry_attr (ref python/paddle/distributed/entry_attr.py
+— sparse-table feature-admission policies for the parameter server).
+
+The brpc PS itself is a documented non-goal (SURVEY §7: TPU embedding tables
+live as sharded dense params), but the admission-policy config objects are
+kept: they serialize to the same "policy:arg" strings and are consumed by
+sparse-embedding layers that want train-time feature filtering.
+"""
+from __future__ import annotations
+
+__all__ = []
+
+
+class EntryAttr:
+    """ref entry_attr.py:18"""
+
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError("use a concrete Entry subclass")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a feature with fixed probability (ref :57)."""
+
+    def __init__(self, probability: float):
+        super().__init__()
+        if not 0 < probability <= 1:
+            raise ValueError(f"probability must be in (0, 1], got {probability}")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature once seen >= count times (ref :98)."""
+
+    def __init__(self, count_filter: int):
+        super().__init__()
+        if count_filter < 0:
+            raise ValueError(f"count_filter must be >= 0, got {count_filter}")
+        self._name = "count_filter_entry"
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Weight features by show/click stats (ref :142)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be variable names")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"{self._name}:{self._show_name}:{self._click_name}"

@@ -1,0 +1,53 @@
+"""paddle.distributed.parallel_with_gloo (ref parallel_with_gloo.py:42
+gloo_init_parallel_env / :139 gloo_barrier / :197 gloo_release — CPU-only
+collective bootstrap used by parameter-server roles).
+
+TPU-native: host-side CPU coordination goes through the same KV store the
+launch rendezvous uses (there is no gloo ring; XLA owns the device
+collectives). The barrier is the KV counter barrier — semantically the gloo
+barrier the reference builds over its HTTP store.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .launch.rendezvous import KVClient, KVServer
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+_STATE = {"rank": 0, "size": 1, "kv": None, "server": None, "gen": 0}
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str) -> None:
+    """ref :42 — rank 0 hosts the store; everyone registers and waits."""
+    if rank_id == 0:
+        try:
+            _STATE["server"] = KVServer(int(server_endpoint.rsplit(":", 1)[1]))
+        except OSError:
+            _STATE["server"] = None
+    kv = KVClient(server_endpoint)
+    kv.set(f"gloo/worker/{rank_id}", "1")
+    while len(kv.list("gloo/worker/")) < rank_num:
+        time.sleep(0.05)
+    _STATE.update(rank=rank_id, size=rank_num, kv=kv)
+
+
+def gloo_barrier() -> None:
+    """ref :139"""
+    kv: Optional[KVClient] = _STATE["kv"]
+    if kv is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _STATE["gen"] += 1
+    key = f"gloo/barrier/{_STATE['gen']}"
+    kv.add(key, 1)
+    while int(kv.get(key) or 0) < _STATE["size"]:
+        time.sleep(0.02)
+
+
+def gloo_release() -> None:
+    """ref :197"""
+    if _STATE["server"] is not None:
+        _STATE["server"].stop()
+    _STATE.update(rank=0, size=1, kv=None, server=None, gen=0)

@@ -18,6 +18,7 @@ from .graph import (CompiledProgram, Executor, GradMarker,  # noqa: F401
                     load_inference_model, program_guard,
                     reset_default_programs, save_inference_model, scope_guard)
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 
 
 def name_scope(prefix=None):

@@ -1,0 +1,177 @@
+"""paddle.static.amp (ref python/paddle/static/amp/decorator.py decorate →
+OptimizerWithMixedPrecision, fp16_lists.py AutoMixedPrecisionLists,
+fp16_utils.py cast_model_to_fp16 program rewriting; bf16/ variants).
+
+TPU-native: the program rewrite is the auto_parallel_bf16/fp16 pass (cast
+matmul-class op inputs; fp32 accumulate via preferred_element_type), applied
+at minimize() time. Loss scaling: bf16 needs none (TPU-default policy, same
+exponent range as fp32); fp16 wraps the optimizer with grad unscale +
+nonfinite-skip + dynamic scale bookkeeping — the GradScaler state machine
+living inside the jitted update (ref amp_nn.py update_loss_scaling op).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+           "fp16_guard", "bf16_guard"]
+
+
+class AutoMixedPrecisionLists:
+    """ref fp16_lists.py:AutoMixedPrecisionLists — white (low precision),
+    black (fp32), gray (follow inputs)."""
+
+    def __init__(self, custom_white_list: Optional[Sequence[str]] = None,
+                 custom_black_list: Optional[Sequence[str]] = None,
+                 custom_black_varnames: Optional[Sequence[str]] = None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class fp16_guard:
+    """ref fp16_utils.fp16_guard — region marker; the pass-based rewrite is
+    list-driven so the guard is a no-op context manager kept for parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+bf16_guard = fp16_guard
+
+
+class _LossScaleOptimizer:
+    """fp16 path: unscale-free dynamic loss-scale bookkeeping around a pure
+    optimizer — skip the step when grads are nonfinite, halve the scale;
+    grow after incr_every_n consecutive finite steps (the update_loss_scaling
+    state machine, ref static/amp/decorator.py + amp_nn.py). Grads are
+    produced with fp32 accumulation so the scale only gates step-skipping."""
+
+    def __init__(self, inner, init_loss_scaling=2.0 ** 15,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.5):
+        self.inner = inner
+        self.init_scale = float(init_loss_scaling)
+        self.incr_every_n = int(incr_every_n_steps)
+        self.decr_every_n = int(decr_every_n_nan_or_inf)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
+
+    def init_state(self, params):
+        return {
+            "inner": self.inner.init_state(params),
+            "scale": jnp.asarray(self.init_scale, jnp.float32),
+            "good": jnp.zeros((), jnp.int32),
+            "bad": jnp.zeros((), jnp.int32),
+        }
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def pure_update(self, params, grads, state, lr, step, pnames=None,
+                    regularizers=None):
+        finite = jnp.asarray(True)
+        for g in grads.values():
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+
+        def do_step(operand):
+            params_, inner_state = operand
+            new_params, new_inner = self.inner.pure_update(
+                params_, grads, inner_state, lr, step,
+                regularizers=regularizers)
+            return new_params, new_inner
+
+        def skip_step(operand):
+            return operand
+
+        new_params, new_inner = jax.lax.cond(
+            finite, do_step, skip_step, (params, state["inner"]))
+
+        good = jnp.where(finite, state["good"] + 1, 0)
+        bad = jnp.where(finite, 0, state["bad"] + 1)
+        scale = state["scale"]
+        scale = jnp.where(good >= self.incr_every_n, scale * self.incr_ratio,
+                          scale)
+        good = jnp.where(good >= self.incr_every_n, 0, good)
+        scale = jnp.where(bad >= self.decr_every_n, scale * self.decr_ratio,
+                          scale)
+        bad = jnp.where(bad >= self.decr_every_n, 0, bad)
+        return new_params, {"inner": new_inner, "scale": scale,
+                            "good": good, "bad": bad}
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+class OptimizerWithMixedPrecision:
+    """ref decorator.py:OptimizerWithMixedPrecision — minimize() rewrites the
+    program to low precision and (fp16) wraps the optimizer with the loss
+    scaler."""
+
+    def __init__(self, optimizer, amp_lists, level, dtype,
+                 init_loss_scaling, use_dynamic_loss_scaling,
+                 incr_every_n_steps, decr_every_n_nan_or_inf,
+                 incr_ratio, decr_ratio):
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._level = level
+        self._dtype = dtype
+        self._scaling = dict(
+            init_loss_scaling=init_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        ) if (dtype == "float16" and use_dynamic_loss_scaling) else None
+
+    def get_loss_scaling(self):
+        return self._scaling["init_loss_scaling"] if self._scaling else 1.0
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """ref decorator.py amp_init — master weights already live as fp32
+        params; nothing to materialize."""
+        return None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..distributed.passes import new_pass
+
+        result = self._inner.minimize(loss, startup_program, parameters,
+                                      no_grad_set)
+        prog = loss.program
+        pass_name = ("auto_parallel_fp16" if self._dtype == "float16"
+                     else "auto_parallel_bf16")
+        new_pass(pass_name, {
+            "custom_white_list": self._amp_lists.white_list or None,
+        }).apply([prog], [startup_program])
+        if self._scaling is not None and prog.optimizer is not None:
+            prog.optimizer = _LossScaleOptimizer(prog.optimizer,
+                                                 **self._scaling)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             use_pure_fp16=False, use_fp16_guard=None, use_bf16=True,
+             level="O1", dtype=None):
+    """ref static/amp/decorator.py decorate(). dtype defaults to bfloat16
+    (TPU policy); pass dtype='float16' (or use_bf16=False) for fp16 + dynamic
+    loss scaling."""
+    dtype = dtype or ("bfloat16" if use_bf16 else "float16")
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, level, dtype, init_loss_scaling,
+        use_dynamic_loss_scaling, incr_every_n_steps,
+        decr_every_n_nan_or_inf, incr_ratio, decr_ratio)

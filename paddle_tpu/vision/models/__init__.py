@@ -1,6 +1,8 @@
 """Vision models (ref: python/paddle/vision/models/)."""
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
-                     wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_32x4d)
+                     wide_resnet50_2, wide_resnet101_2, resnext50_32x4d,
+                     resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+                     resnext152_32x4d, resnext152_64x4d)
 from .lenet import LeNet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .alexnet import AlexNet, alexnet

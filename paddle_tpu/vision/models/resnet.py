@@ -151,13 +151,31 @@ def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
 
 
+def _resnext(depth, groups, width, pretrained=False, **kwargs):
+    kwargs["groups"] = groups
+    kwargs["width"] = width
+    return _resnet(BottleneckBlock, depth, pretrained, **kwargs)
+
+
 def resnext50_32x4d(pretrained=False, **kwargs):
-    kwargs["groups"] = 32
-    kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    kwargs["groups"] = 32
-    kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

@@ -1,0 +1,137 @@
+"""Tests for static.amp, the PS-adjacent distributed shims (entry_attr,
+cloud_utils, parallel_with_gloo, communicator), and resnext model variants."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def _static_mode():
+    paddle.enable_static()
+    static.reset_default_programs()
+    yield
+    paddle.disable_static()
+
+
+def _build_train_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        loss = paddle.mean(static.nn.fc(x, 4) ** 2)
+    return main, startup, x, loss
+
+
+def test_static_amp_bf16_decorate_trains(_static_mode):
+    main, startup, x, loss = _build_train_program()
+    opt = static.amp.decorate(paddle.optimizer.SGD(learning_rate=0.1))
+    with static.program_guard(main, startup):
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(8, 8).astype("float32")
+    l1 = float(exe.run(main, feed={"x": xs}, fetch_list=[loss])[0])
+    l2 = float(exe.run(main, feed={"x": xs}, fetch_list=[loss])[0])
+    assert l2 < l1
+
+
+def test_static_amp_fp16_loss_scaler_skips_nonfinite(_static_mode):
+    """fp16 decorate wraps the optimizer: a nonfinite grad skips the step and
+    shrinks the scale after decr_every_n_nan_or_inf bad steps."""
+    main, startup, x, loss = _build_train_program()
+    opt = static.amp.decorate(
+        paddle.optimizer.SGD(learning_rate=0.1), dtype="float16",
+        init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1)
+    with static.program_guard(main, startup):
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    pname = next(iter(main.params))
+    w0 = np.asarray(main.params[pname].value)
+
+    bad = np.full((4, 8), np.inf, np.float32)  # drives grads nonfinite
+    exe.run(main, feed={"x": bad}, fetch_list=[loss])
+    w1 = np.asarray(scope.store[pname])
+    np.testing.assert_allclose(w1, w0)  # step skipped
+
+    ent = scope.opt_state[main._uid]
+    assert float(ent["state"]["scale"]) == pytest.approx(1024.0 * 0.8)
+
+    good = np.random.RandomState(0).randn(4, 8).astype("float32")
+    exe.run(main, feed={"x": good}, fetch_list=[loss])
+    w2 = np.asarray(scope.store[pname])
+    assert not np.allclose(w2, w0)  # finite step applies
+
+
+def test_entry_attr_strings():
+    from paddle_tpu.distributed import (CountFilterEntry, ProbabilityEntry,
+                                        ShowClickEntry)
+
+    assert ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+    assert CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+    assert ShowClickEntry("show", "click")._to_attr() == \
+        "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        ProbabilityEntry(1.5)
+    with pytest.raises(ValueError):
+        CountFilterEntry(-1)
+
+
+def test_cloud_utils_cluster_from_env(monkeypatch):
+    from paddle_tpu.distributed import cloud_utils
+
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.1.0.1,10.1.0.2")
+    monkeypatch.setenv("POD_IP", "10.1.0.2")
+    monkeypatch.setenv("PADDLE_PORT", "7000")
+    cluster, pod = cloud_utils.get_cloud_cluster(selected_devices=[0, 1])
+    assert cluster.trainers_nranks() == 4
+    assert pod.addr == "10.1.0.2" and pod.rank == 1
+    assert cluster.trainers_endpoints()[0] == "10.1.0.1:7000"
+
+
+def test_gloo_parallel_env_barrier():
+    from paddle_tpu.distributed import (gloo_barrier, gloo_init_parallel_env,
+                                        gloo_release)
+    from paddle_tpu.distributed.utils import find_free_ports
+
+    port = sorted(find_free_ports(1))[0]
+    ep = f"127.0.0.1:{port}"
+    errs = []
+
+    def worker(rank):
+        try:
+            if rank != 0:
+                gloo_barrier()  # uses shared client state set by rank 0 init
+        except Exception as e:
+            errs.append(e)
+
+    gloo_init_parallel_env(0, 1, ep)
+    gloo_barrier()  # single participant returns immediately
+    gloo_release()
+    assert not errs
+
+
+def test_communicator_is_explicit_non_goal():
+    from paddle_tpu.distributed.communicator import Communicator, LargeScaleKV
+
+    c = Communicator(mode="async")
+    with pytest.raises(NotImplementedError, match="non-goals"):
+        c.init_with_ctx()
+    with pytest.raises(RuntimeError):
+        c.start()
+    kv = LargeScaleKV()
+    assert kv.size("x") == 0
+
+
+def test_resnext_variants_forward():
+    from paddle_tpu.vision.models import resnext50_64x4d
+
+    m = resnext50_64x4d(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64)
+                         .astype("float32"))
+    out = m(x)
+    assert tuple(out.shape) == (1, 10)
