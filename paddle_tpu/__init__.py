@@ -8,7 +8,9 @@ into a single XLA program under `paddle_tpu.jit.to_static`.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+
+__version__ = version.full_version
 
 # On CPU (tests / local dev) match the reference's numerics: true-f32 matmuls
 # and 64-bit int/float dtypes. On TPU keep JAX performance defaults (bf16

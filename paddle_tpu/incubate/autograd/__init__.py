@@ -108,3 +108,52 @@ def hessian(func, xs, create_graph=False, allow_unused=False):
     vals = _vals(xs)
     h = jax.hessian(_pure(func))(*vals)
     return Tensor(h)
+
+
+# --------------------------------------------------------------------------- #
+# prim system (ref python/paddle/incubate/autograd/primapi.py)
+# --------------------------------------------------------------------------- #
+
+_PRIM_ENABLED = False
+
+
+def enable_prim():
+    """ref primapi enable_prim — turns on composite-primitive lowering of the
+    static graph. TPU-native: jaxpr IS the primitive IR (every op we record is
+    already a composition of jax primitives; XLA decomposes further), so this
+    is a semantic no-op kept as a queryable switch."""
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False
+
+
+def prim_enabled() -> bool:
+    return _PRIM_ENABLED
+
+
+def prim2orig(*args, **kwargs):
+    """ref primapi prim2orig — lower primitive ops back to original ops; the
+    jaxpr never leaves primitive form, so there is nothing to lower."""
+    return None
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode AD (ref primapi.py:24 forward_grad): JVP of outputs w.r.t.
+    inputs, seeded with grad_inputs (defaults to ones)."""
+    outs, tangents = jvp(
+        outputs if callable(outputs) else (lambda *xs: outputs),
+        inputs, v=grad_inputs)
+    return tangents
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """ref primapi grad / autograd.grad for pure functions: VJP of outputs
+    w.r.t. inputs seeded with grad_outputs."""
+    _, grads = vjp(
+        outputs if callable(outputs) else (lambda *xs: outputs),
+        inputs, v=grad_outputs)
+    return grads if isinstance(grads, (list, tuple)) else [grads]

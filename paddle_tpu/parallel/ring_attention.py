@@ -22,10 +22,14 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+@functools.partial(jax.checkpoint, static_argnums=())
 def _block_attn(q, k, v, scale, mask):
     """One KV block's contribution: returns (m, l, acc) pieces.
 
     q: (B,H,Sq,D) k/v: (B,H,Sk,D) mask: (Sq,Sk) bool or None.
+    Remat-wrapped: the (Sq, Sk) score block is recomputed in backward instead
+    of being saved per ring hop, keeping residuals O(S·D) like the flash
+    kernel (n hops would otherwise stash n score blocks each).
     """
     s = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
     if mask is not None:

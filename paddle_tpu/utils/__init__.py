@@ -4,6 +4,10 @@ from __future__ import annotations
 import importlib
 import sys
 
+from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+
 
 def try_import(module_name, err_msg=None):
     try:

@@ -1,0 +1,25 @@
+"""paddle.utils.dlpack (ref python/paddle/utils/dlpack.py to_dlpack/
+from_dlpack over paddle/fluid/framework/dlpack_tensor.cc).
+
+TPU-native: jax arrays speak dlpack natively (zero-copy on CPU; device
+buffers export via the producer stream) — torch/numpy interop without a copy.
+"""
+from __future__ import annotations
+
+from ..framework.core import Tensor, to_array
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor → DLPack capsule (ref dlpack.py to_dlpack)."""
+    arr = to_array(x) if isinstance(x, Tensor) else x
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule_or_ext) -> Tensor:
+    """DLPack capsule or __dlpack__-capable external tensor → Tensor
+    (ref dlpack.py from_dlpack)."""
+    import jax.numpy as jnp
+
+    return Tensor(jnp.from_dlpack(capsule_or_ext))

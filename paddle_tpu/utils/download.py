@@ -1,0 +1,39 @@
+"""paddle.utils.download (ref python/paddle/utils/download.py
+get_weights_path_from_url — fetch + cache pretrained weights).
+
+Zero-egress environment: resolves against the local cache only and raises
+with placement guidance when absent (same policy as dataset/common.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True) -> str:
+    fname = os.path.join(root_dir, url.split("/")[-1])
+    if os.path.exists(fname) and _md5check(fname, md5sum):
+        return fname
+    raise RuntimeError(
+        f"weights file {fname} not cached and network egress is disabled; "
+        f"place the file from {url} at that path")
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """ref download.py get_weights_path_from_url"""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,39 @@
+"""paddle.version parity (ref python/paddle/version.py is build-generated).
+
+Versioning note: `major.minor` tracks the reference API surface this build
+targets (Paddle ~2.5 era, SURVEY.md header); the local build has no CUDA —
+cuda()/cudnn() return the reference's "not compiled" sentinel 'False'.
+"""
+
+full_version = "2.5.0+tpu"
+major = "2"
+minor = "5"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "unknown"
+with_mkl = "OFF"
+
+
+def show() -> None:
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+
+
+def cuda() -> str:
+    return "False"
+
+
+def cudnn() -> str:
+    return "False"
+
+
+def xpu() -> str:
+    return "False"
+
+
+def xpu_xccl() -> str:
+    return "False"

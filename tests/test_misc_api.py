@@ -1,0 +1,65 @@
+"""Tests for version / utils.dlpack / utils.download / incubate.autograd prim
+API (SURVEY §2.2 misc API inventory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_version_module():
+    import paddle_tpu.version as v
+
+    assert paddle.__version__ == v.full_version
+    assert v.cuda() == "False" and v.cudnn() == "False"
+    v.show()
+
+
+def test_dlpack_roundtrip_numpy():
+    from paddle_tpu.utils import dlpack
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = dlpack.from_dlpack(a)
+    np.testing.assert_allclose(t.numpy(), a)
+    capsule = dlpack.to_dlpack(t)
+    back = np.from_dlpack(type("X", (), {"__dlpack__": lambda self, **kw: capsule,
+                                         "__dlpack_device__": lambda self: (1, 0)})())
+    np.testing.assert_allclose(back, a)
+
+
+def test_dlpack_torch_interop():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils import dlpack
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    th = torch.from_dlpack(dlpack.to_dlpack(t))
+    np.testing.assert_allclose(th.numpy(), t.numpy())
+    back = dlpack.from_dlpack(torch.arange(4, dtype=torch.float32))
+    np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+
+
+def test_download_cache_only(tmp_path):
+    from paddle_tpu.utils import download
+
+    p = tmp_path / "w.pdparams"
+    p.write_bytes(b"weights")
+    got = download.get_path_from_url("http://x/w.pdparams", str(tmp_path))
+    assert got == str(p)
+    with pytest.raises(RuntimeError, match="egress"):
+        download.get_path_from_url("http://x/missing.bin", str(tmp_path))
+
+
+def test_prim_api_switch_and_grads():
+    import paddle_tpu.incubate.autograd as ia
+
+    ia.enable_prim()
+    assert ia.prim_enabled()
+    ia.disable_prim()
+    assert not ia.prim_enabled()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    f = lambda t: t * t
+    (g,) = ia.grad(f, x)
+    np.testing.assert_allclose(np.asarray(g.value), [2.0, 4.0])
+    tangents = ia.forward_grad(f, x)
+    t0 = tangents[0] if isinstance(tangents, (list, tuple)) else tangents
+    np.testing.assert_allclose(np.asarray(t0.value), [2.0, 4.0])
