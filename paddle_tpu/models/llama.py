@@ -165,9 +165,16 @@ class LlamaAttention(Layer):
             from ..ops.flash_attention import flash_attention_bshd
 
             if self.cfg.context_parallel:
+                from ..ops.flash_attention import _use_pallas
                 from ..parallel.ring_attention import ring_attention_bshd
+                from ..parallel.ring_flash_attention import \
+                    ring_flash_attention_bshd
 
                 try:
+                    if _use_pallas():
+                        # Pallas blockwise kernels per ring hop, GQA-native
+                        return ring_flash_attention_bshd(qr, kr, vv, "context",
+                                                         causal=causal)
                     kx = jnp.repeat(kr, rep, axis=2) if rep > 1 else kr
                     vx = jnp.repeat(vv, rep, axis=2) if rep > 1 else vv
                     return ring_attention_bshd(qr, kx, vx, "context", causal=causal)

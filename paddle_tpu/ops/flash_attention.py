@@ -42,6 +42,24 @@ def _interpret() -> bool:
     return os.environ.get("PT_FLASH_INTERPRET") == "1"
 
 
+
+def _vma_of(*arrays):
+    """Union of varying-mesh-axes of traced inputs (shard_map check_vma):
+    pallas out_shapes must declare how outputs vary across mesh axes."""
+    vma = frozenset()
+    for a in arrays:
+        try:
+            vma = vma | jax.typeof(a).vma
+        except Exception:
+            pass
+    return vma
+
+
+def _sds(shape, dtype, vma):
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma) if vma else \
+        jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _ref_bhsd(q, k, v, causal: bool, scale: float):
     """Reference composition, (B, H, S, D) layout, fp32 softmax. GQA: k/v may
     have Hkv | H heads."""
@@ -146,8 +164,8 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, Sq), jnp.float32),
+            _sds((B * H, Sq, D), q.dtype, _vma_of(q, k, v)),
+            _sds((B * H, 1, Sq), jnp.float32, _vma_of(q, k, v)),
         ],
         interpret=_interpret(),
     )(q_r, k_r, v_r)
@@ -281,7 +299,8 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_shape=_sds((B * H, Sq, D), q.dtype,
+                       _vma_of(q, k, v, do, lse, delta)),
         interpret=_interpret(),
     )(q_r, k_r, v_r, do_r, lse_r, delta_r)
 
@@ -302,8 +321,8 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
             pl.BlockSpec((1, bk, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype),
+            _sds((B * H, Sk, D), k.dtype, _vma_of(q, k, v, do, lse, delta)),
+            _sds((B * H, Sk, D), v.dtype, _vma_of(q, k, v, do, lse, delta)),
         ],
         interpret=_interpret(),
     )(q_r, k_r, v_r, do_r, lse_r, delta_r)

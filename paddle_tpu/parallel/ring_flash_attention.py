@@ -1,0 +1,170 @@
+"""Ring flash attention — Pallas blockwise kernels around the context ring.
+
+NEW DESIGN (reference has no context parallelism, SURVEY §5.7). The plain
+`ring_attention` (ring_attention.py) materializes an (S/n)² score block per
+hop in jnp; this variant runs the Pallas flash kernels per resident block, so
+per-hop memory is O(S·D) and a single chip's shard can itself be long.
+
+Forward: per hop, run the flash forward on (q_local, k_block, v_block) to get
+(out_b, lse_b); combine blocks with the logsumexp merge
+    m' = max(m, lse_b);  l' = l·e^{m-m'} + e^{lse_b-m'};
+    acc' = acc·e^{m-m'} + out_b·e^{lse_b-m'}
+and rotate K/V with lax.ppermute. Block causality classes (full / diagonal /
+masked-out) are picked by lax.switch; the masked class contributes
+lse_b = -inf, i.e. zero weight, so the merge is uniform.
+
+Backward is a second ring pass (custom_vjp — no scan transposition): with the
+global LSE and delta = rowsum(dO·O), each hop calls the flash backward
+kernels per block; dQ accumulates locally while the (dK, dV) partials rotate
+WITH their K/V block, so after n hops every block's gradient arrives back at
+its home rank. This is the standard ring-attention gradient schedule on ICI.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import (NEG_INF, _flash_bwd_bhsd, _flash_fwd_bhsd,
+                                   _ref_bhsd)
+
+__all__ = ["ring_flash_attention", "ring_flash_attention_bshd"]
+
+
+def _block_fwd(q, kb, vb, scale, block_kind):
+    """(out_b, lse_b) for one resident block. block_kind: 0 full, 1 diagonal
+    (causal), 2 masked-out."""
+
+    def full(_):
+        return _flash_fwd_bhsd(q, kb, vb, False, scale)
+
+    def diag(_):
+        return _flash_fwd_bhsd(q, kb, vb, True, scale)
+
+    def skip(_):
+        # derive from q so outputs carry the same varying-mesh-axes type
+        return (q * 0, (q[..., 0] * 0).astype(jnp.float32) + NEG_INF)
+
+    return jax.lax.switch(block_kind, (full, diag, skip), None)
+
+
+def _block_bwd(q, kb, vb, do, lse, delta, scale, block_kind):
+    """(dq_b, dk_b, dv_b) for one resident block given the GLOBAL lse/delta.
+    The flash backward formulas hold per block when lse is global: p_ij =
+    exp(s_ij - LSE_i) is each key's true softmax weight."""
+
+    def full(_):
+        return _flash_bwd_bhsd(q, kb, vb, do, lse, delta, False, scale)
+
+    def diag(_):
+        return _flash_bwd_bhsd(q, kb, vb, do, lse, delta, True, scale)
+
+    def skip(_):
+        return (q * 0, kb * 0, vb * 0)  # keeps the inputs' vma type
+
+    return jax.lax.switch(block_kind, (full, diag, skip), None)
+
+
+def _block_kind(src, my_idx, causal):
+    if not causal:
+        return jnp.zeros((), jnp.int32)
+    return jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2)
+                     ).astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q, k, v, axis_name: str = "context",
+                         causal: bool = True,
+                         scale: Optional[float] = None):
+    """Per-shard ring attention body (call inside shard_map); Pallas flash
+    per block. q,k,v local shards (B, H, S_local, D) with the sequence dim
+    sharded over `axis_name`."""
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m0 = jnp.full((B, H, S), NEG_INF, jnp.float32) + (q[..., 0] * 0.0)
+    l0 = jnp.zeros((B, H, S), jnp.float32) + (q[..., 0] * 0.0)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32) + (q * 0.0)
+
+    def step(carry, t):
+        m, l, acc, kb, vb = carry
+        src = (my_idx - t) % n
+        out_b, lse_b = _block_fwd(q, kb, vb, sc, _block_kind(src, my_idx, causal))
+        m_new = jnp.maximum(m, lse_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_b - m_new)
+        l_new = l * alpha + beta
+        acc_new = acc * alpha[..., None] + out_b.astype(jnp.float32) * beta[..., None]
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        return (m_new, l_new, acc_new, kb_next, vb_next), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(step, (m0, l0, acc0, k, v),
+                                        jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    lse_global = m + jnp.log(l_safe)
+    return out, lse_global
+
+
+def _ring_fa_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_fa_bwd(axis_name, causal, scale, res, g):
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    D = q.shape[-1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    def step(carry, t):
+        dq_acc, kb, vb, dkb, dvb = carry
+        src = (my_idx - t) % n
+        dq_b, dk_b, dv_b = _block_bwd(
+            q, kb, vb, do, lse, delta, sc, _block_kind(src, my_idx, causal))
+        dq_acc = dq_acc + dq_b.astype(jnp.float32)
+        dkb = dkb + dk_b.astype(jnp.float32)
+        dvb = dvb + dv_b.astype(jnp.float32)
+        # the (k, v, dk, dv) bundle travels the ring together; after the last
+        # hop's rotation every block is home with its full gradient
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        dkb = jax.lax.ppermute(dkb, axis_name, perm)
+        dvb = jax.lax.ppermute(dvb, axis_name, perm)
+        return (dq_acc, kb, vb, dkb, dvb), None
+
+    dq0 = jnp.zeros_like(q, dtype=jnp.float32)
+    dk0 = jnp.zeros_like(k, dtype=jnp.float32)
+    dv0 = jnp.zeros_like(v, dtype=jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_ring_fa_fwd, _ring_fa_bwd)
+
+
+def ring_flash_attention_bshd(q, k, v, axis_name: str = "context",
+                              causal: bool = True,
+                              scale: Optional[float] = None):
+    """(B, S, H, D) layout wrapper."""
+    out = ring_flash_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                               jnp.swapaxes(v, 1, 2), axis_name, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
