@@ -1,0 +1,47 @@
+"""Compare two op_benchmark.py JSON outputs and fail on regressions (ref
+tools/check_op_benchmark_result.py — the CI gate comparing op perf vs the
+develop branch)."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("base")
+    ap.add_argument("head")
+    ap.add_argument("--tol", type=float, default=1.15,
+                    help="fail if head latency > tol * base latency")
+    args = ap.parse_args()
+
+    with open(args.base) as f:
+        base = json.load(f)
+    with open(args.head) as f:
+        head = json.load(f)
+
+    failed = []
+    for op, rec in sorted(head.items()):
+        if op not in base:
+            print(f"NEW      {op:28s} {rec['ms']:9.3f} ms")
+            continue
+        b, h = base[op]["ms"], rec["ms"]
+        ratio = h / b if b else float("inf")
+        status = "OK" if ratio <= args.tol else "REGRESSED"
+        print(f"{status:8s} {op:28s} base {b:9.3f} ms  head {h:9.3f} ms  "
+              f"x{ratio:.2f}")
+        if ratio > args.tol:
+            failed.append(op)
+    for op in sorted(set(base) - set(head)):
+        print(f"MISSING  {op:28s} (present in base, absent in head)")
+        failed.append(op)
+
+    if failed:
+        print(f"\nFAILED: {len(failed)} op(s) regressed or missing: {failed}")
+        sys.exit(1)
+    print("\nall ops within tolerance")
+
+
+if __name__ == "__main__":
+    main()

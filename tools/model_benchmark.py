@@ -1,0 +1,112 @@
+"""Model-level benchmark harness (ref tools/ci_model_benchmark.sh — relative
+model-perf gate). Runs a quick train-step benchmark for each flagship model
+family and writes JSON {model: {"ms_per_step": ..., "tokens_or_imgs_per_s"}}.
+
+Usage: python tools/model_benchmark.py [-o out.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_llama():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048, dtype="bfloat16",
+                          use_flash_attention=True)
+        B, S, iters = 8, 2048, 6
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=384, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256, dtype="float32",
+                          use_flash_attention=False)
+        B, S, iters = 2, 128, 3
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    engine = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                            remat=False)
+    engine.build_train_step()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
+    loss = None
+    for _ in range(2):
+        loss = engine.train_batch(ids, labels)
+    jax.block_until_ready(loss.value)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = engine.train_batch(ids, labels)
+    jax.block_until_ready(loss.value)
+    dt = (time.perf_counter() - t0) / iters
+    return {"ms_per_step": round(dt * 1e3, 2),
+            "tokens_per_s": round(B * S / dt, 1)}
+
+
+def bench_resnet50():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    B = 32 if on_tpu else 4
+    model = resnet50(num_classes=10)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+    from paddle_tpu.parallel import ParallelEngine
+
+    def loss_fn(logits, labels):
+        return paddle.nn.functional.cross_entropy(logits, labels)
+
+    engine = ParallelEngine(model, optimizer=opt, loss_fn=loss_fn, remat=False)
+    engine.build_train_step()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(B, 3, 224, 224).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (B,)).astype("int64"))
+    loss = None
+    for _ in range(2):
+        loss = engine.train_batch(x, y)
+    jax.block_until_ready(loss.value)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = engine.train_batch(x, y)
+    jax.block_until_ready(loss.value)
+    dt = (time.perf_counter() - t0) / iters
+    return {"ms_per_step": round(dt * 1e3, 2),
+            "imgs_per_s": round(B / dt, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default=None)
+    ap.add_argument("--models", default="llama,resnet50")
+    args = ap.parse_args()
+    table = {"llama": bench_llama, "resnet50": bench_resnet50}
+    results = {}
+    for name in args.models.split(","):
+        results[name] = table[name.strip()]()
+        print(name, results[name])
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()
