@@ -1,18 +1,20 @@
-"""Flash attention (Pallas TPU kernel).
+"""Flash attention (Pallas TPU kernels).
 
 Replaces the reference's CUDA FMHA stack (ref
 paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h,
-fused_softmax_mask kernels) with a blockwise online-softmax kernel that never
-materialises the S×S score matrix in HBM.
+fused_softmax_mask kernels) with blockwise online-softmax kernels that never
+materialise the S×S score matrix in HBM.
 
-Forward is a Pallas kernel (grid over batch·heads × query blocks; inner scan
-over KV blocks with running max/denominator in VMEM scratch) that also emits
-the per-row logsumexp. Backward is a pair of Pallas kernels using the saved
-LSE (the standard flash backward): a dQ kernel (grid over q blocks, streaming
-KV) and a dK/dV kernel (grid over k blocks, streaming Q/dO), with
-delta = rowsum(dO·O) precomputed by XLA. Residual memory is O(S·D) and no
-S×S matrix ever reaches HBM in either direction. Causal variants skip
-fully-masked blocks in all three kernels (~2x at long S).
+Kernel structure: 3-axis grids with the KV (resp. Q) block dimension as the
+innermost "arbitrary" axis and fp32 VMEM scratch accumulators — KV streams
+through VMEM block-by-block (Mosaic double-buffers the grid axis), so
+sequence length is bounded by HBM, not by a resident full-K block. Forward
+also emits per-row logsumexp; backward is the standard flash pair (dQ kernel
+streaming KV; dK/dV kernel streaming Q/dO) using the saved LSE and
+delta = rowsum(dO·O) precomputed by XLA. Causal variants skip fully-masked
+blocks via pl.when (~2x at long S) and handle Sq != Sk with bottom-right
+alignment. GQA: q heads route to shared kv heads through the BlockSpec index
+map — no HBM repeat of K/V.
 
 Falls back to the jnp composition on non-TPU backends (CPU tests); set
 PT_FLASH_INTERPRET=1 to exercise the Pallas kernels in interpreter mode on
@@ -40,7 +42,6 @@ def _interpret() -> bool:
     import os
 
     return os.environ.get("PT_FLASH_INTERPRET") == "1"
-
 
 
 def _vma_of(*arrays):
@@ -76,7 +77,309 @@ def _ref_bhsd(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bhst,bhtd->bhsd", probs, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+def _causal_mask(s, q_blk, kk, block_q, block_k, offs):
+    q_pos = offs + q_blk * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = kk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _lanes(x):
+    """Broadcast a (rows,) vector across the 128-lane scratch dim."""
+    return jnp.broadcast_to(x[:, None], (x.shape[0], 128))
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
+                scale, causal, block_q, block_k, nk, seq_q, seq_k):
+    """One (batch·head, q-block, k-block) program; k innermost with VMEM
+    scratch (m, l, acc) carrying the online softmax across k steps."""
+    from jax.experimental import pallas as pl
+
+    q_blk = pl.program_id(1)
+    kk = pl.program_id(2)
+    offs = seq_k - seq_q
+
+    @pl.when(kk == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        needed = offs + (q_blk + 1) * block_q - 1 >= kk * block_k
+    else:
+        needed = True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_blk, kk, block_q, block_k, offs)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = _lanes(l_prev * alpha + jnp.sum(p, axis=-1))
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = _lanes(m_new)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        m = m_ref[:, 0]
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd_bhsd_stream(q, k, v, causal: bool, scale: float,
+                           block_q: int = 128, block_k: int = 128):
+    """GQA-native: k/v may have fewer heads (Hkv | Hq); the kv BlockSpec
+    index map routes each q head to its shared kv head — zero HBM copies
+    (the reference materializes repeated KV; ref fmha_ref.h)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    nk = Sk // bk
+    q_r = q.reshape(B * H, Sq, D)
+    k_r = k.reshape(B * Hkv, Sk, D)
+    v_r = v.reshape(B * Hkv, Sk, D)
+
+    def kv_head(b):
+        return (b // H) * Hkv + (b % H) // rep
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=bq,
+                          block_k=bk, nk=nk, seq_q=Sq, seq_k=Sk),
+        grid=(B * H, Sq // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, kk: (kv_head(b), kk, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, kk: (kv_head(b), kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, kk: (b, i, 0)),
+            # (BH, 1, Sq) with a singleton sublane dim satisfies the TPU
+            # (8, 128) tiling rule for 1D-per-row outputs
+            pl.BlockSpec((1, 1, bq), lambda b, i, kk: (b, 0, i)),
+        ],
+        out_shape=[
+            _sds((B * H, Sq, D), q.dtype, _vma_of(q, k, v)),
+            _sds((B * H, 1, Sq), jnp.float32, _vma_of(q, k, v)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # m (lane-replicated)
+            pltpu.VMEM((bq, 128), jnp.float32),  # l (lane-replicated)
+            pltpu.VMEM((bq, D), jnp.float32),    # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_r, k_r, v_r)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc_ref, *, scale, causal, block_q, block_k, nk,
+                   seq_q, seq_k):
+    """dQ for one (batch·head, q-block): k blocks stream on the innermost
+    grid axis. dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K."""
+    from jax.experimental import pallas as pl
+
+    q_blk = pl.program_id(1)
+    kk = pl.program_id(2)
+    offs = seq_k - seq_q
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    if causal:
+        needed = offs + (q_blk + 1) * block_q - 1 >= kk * block_k
+    else:
+        needed = True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_blk, kk, block_q, block_k, offs)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
+                    block_q, block_k, nq, seq_q, seq_k):
+    """dK/dV for one (batch·head, k-block): q/dO blocks stream innermost.
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q (q pre-scaled, so dk carries the scale)."""
+    from jax.experimental import pallas as pl
+
+    k_blk = pl.program_id(1)
+    qi = pl.program_id(2)
+    offs = seq_k - seq_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    if causal:
+        needed = offs + (qi + 1) * block_q - 1 >= k_blk * block_k
+    else:
+        needed = True
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi, k_blk, block_q, block_k, offs)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal: bool, scale: float,
+                           block_q: int = 128, block_k: int = 128):
+    """Pallas flash backward. GQA: dk/dv are computed per q-head with the
+    same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
+    over the rep group."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Sq, D = q.shape
+    Hkv = k.shape[1]
+    rep = H // Hkv
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    nq = Sq // bq
+    nk = Sk // bk
+    q_r = q.reshape(B * H, Sq, D)
+    k_r = k.reshape(B * Hkv, Sk, D)
+    v_r = v.reshape(B * Hkv, Sk, D)
+    do_r = do.reshape(B * H, Sq, D)
+    lse_r = lse.reshape(B * H, 1, Sq)
+    delta_r = delta.reshape(B * H, 1, Sq)
+    vma = _vma_of(q, k, v, do, lse, delta)
+
+    def kv_head(b):
+        return (b // H) * Hkv + (b % H) // rep
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk, seq_q=Sq, seq_k=Sk),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, kk: (kv_head(b), kk, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, kk: (kv_head(b), kk, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, kk: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, kk: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, kk: (b, i, 0)),
+        out_shape=_sds((B * H, Sq, D), q.dtype, vma),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_r, k_r, v_r, do_r, lse_r, delta_r)
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq, seq_q=Sq, seq_k=Sk),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, kb, qi: (b, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (kv_head(b), kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (kv_head(b), kb, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, kb, qi: (b, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, kb, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda b, kb, qi: (b, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (b, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (b, kb, 0)),
+        ],
+        out_shape=[
+            _sds((B * H, Sk, D), k.dtype, vma),
+            _sds((B * H, Sk, D), v.dtype, vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q_r, k_r, v_r, do_r, lse_r, delta_r)
+
+    dq = dq.reshape(B, H, Sq, D)
+    dk = dk_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(k.dtype)
+    dv = dv_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# full-K fori-loop variants — faster when K/V fit VMEM (better block reuse
+# than the streaming grid); dispatcher picks by Sk (see _flash_dispatch)
+# --------------------------------------------------------------------------- #
+
+def _fwd_kernel_loop(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_k, seq_k, seq_q):
     """One (batch·head, q-block) program: stream KV blocks, online softmax.
     Also writes the per-row logsumexp (flash backward needs it)."""
@@ -127,7 +430,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
-def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
+def _flash_fwd_bhsd_loop(q, k, v, causal: bool, scale: float, block_q: int = 128,
                     block_k: int = 128):
     """GQA-native: k/v may have fewer heads (Hkv | Hq); the kv BlockSpec
     index map routes each q head to its shared kv head — zero HBM copies
@@ -149,7 +452,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
 
     grid = (B * H, Sq // bq)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk,
+        functools.partial(_fwd_kernel_loop, scale=scale, causal=causal, block_k=bk,
                           seq_k=Sk, seq_q=Sq),
         grid=grid,
         in_specs=[
@@ -172,7 +475,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int = 128,
     return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _bwd_dq_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    scale, causal, block_k, seq_k, seq_q):
     """dQ for one (batch·head, q-block): stream KV, use saved LSE.
     dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K  (flash-attention backward)."""
@@ -214,7 +517,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, seq_q, seq_k):
     """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
     dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
@@ -263,7 +566,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)  # already carries the scale factor
 
 
-def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
+def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
                     block_q: int = 128, block_k: int = 128):
     """Pallas flash backward. GQA: dk/dv are computed per q-head with the
     same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
@@ -287,7 +590,7 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
         return (b // H) * Hkv + (b % H) // rep, 0, 0
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dq_kernel_loop, scale=scale, causal=causal,
                           block_k=bk, seq_k=Sk, seq_q=Sq),
         grid=(B * H, Sq // bq),
         in_specs=[
@@ -305,7 +608,7 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
     )(q_r, k_r, v_r, do_r, lse_r, delta_r)
 
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_bwd_dkv_kernel_loop, scale=scale, causal=causal,
                           block_q=bq, seq_q=Sq, seq_k=Sk),
         grid=(B * H, Sk // bk),
         in_specs=[
@@ -331,6 +634,35 @@ def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal: bool, scale: float,
     dk = dk_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(k.dtype)
     dv = dv_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
+
+
+
+
+
+
+# K/V longer than this stream block-by-block through the 3-axis grid; below
+# it the full-K loop kernels win (K/V stay resident in VMEM across q blocks)
+_FULL_K_MAX = 8192
+
+
+def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128):
+    if k.shape[2] <= _FULL_K_MAX:
+        return _flash_fwd_bhsd_loop(q, k, v, causal, scale, block_q, block_k)
+    return _flash_fwd_bhsd_stream(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal, scale,
+                    block_q=128, block_k=128):
+    if k.shape[2] <= _FULL_K_MAX:
+        return _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal, scale,
+                                    block_q, block_k)
+    return _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal, scale,
+                                  block_q, block_k)
+
+
+# --------------------------------------------------------------------------- #
+# public custom-vjp entry points
+# --------------------------------------------------------------------------- #
 
 
 def _pallas_shapes_ok(q, k) -> bool:

@@ -100,3 +100,27 @@ def test_non_divisible_seq_falls_back():
     ref = fa._ref_bhsd(q, k, v, True, 1.0 / np.sqrt(64))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streaming_grid_variant_matches_reference(causal):
+    """The 3-axis streaming kernels (used when Sk > _FULL_K_MAX) — forced
+    directly so CI covers them even though small shapes dispatch to the
+    full-K loop variant."""
+    q, k, v = _rand((1, 2, 256, 64), 20), _rand((1, 1, 256, 64), 21), _rand(
+        (1, 1, 256, 64), 22)
+    s = 1.0 / np.sqrt(64)
+    out, lse = fa._flash_fwd_bhsd_stream(q, k, v, causal, s)
+    ref = fa._ref_bhsd(q, k, v, causal, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    do = jnp.cos(out)
+    delta = jnp.sum(do * out, axis=-1)
+    dq, dk, dv = fa._flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal, s)
+    _, vjp_fn = jax.vjp(lambda a, b, c: fa._ref_bhsd(a, b, c, causal, s),
+                        q, k, v)
+    rq, rk, rv = vjp_fn(do)
+    for a, b, name in zip((dq, dk, dv), (rq, rk, rv), "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"stream d{name} causal={causal}")
