@@ -1,0 +1,41 @@
+"""Compat shim mirroring the reference's generated-op namespace.
+
+Ref: python/paddle/_C_ops.py:19 re-exports `core.eager.ops.*` C functions.
+Here there is no generated C layer — every op is a Python function over
+jax — so this module resolves op names against the public functional
+namespaces (tensor ops first, then nn.functional), letting code written
+against `paddle._C_ops.<op>` run unchanged.
+"""
+from __future__ import annotations
+
+import importlib
+
+_NAMESPACES = ("paddle_tpu.tensor", "paddle_tpu.nn.functional", "paddle_tpu")
+
+# reference op name -> (module, attr) overrides where names diverge
+_ALIASES = {
+    "elementwise_add": ("paddle_tpu.tensor", "add"),
+    "elementwise_sub": ("paddle_tpu.tensor", "subtract"),
+    "elementwise_mul": ("paddle_tpu.tensor", "multiply"),
+    "elementwise_div": ("paddle_tpu.tensor", "divide"),
+    "reduce_sum": ("paddle_tpu.tensor", "sum"),
+    "reduce_mean": ("paddle_tpu.tensor", "mean"),
+    "softmax_with_cross_entropy": ("paddle_tpu.nn.functional", "cross_entropy"),
+    "fill_constant": ("paddle_tpu.tensor", "full"),
+}
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _ALIASES:
+        mod, attr = _ALIASES[name]
+        return getattr(importlib.import_module(mod), attr)
+    base = name[:-1] if name.endswith("_") else name  # inplace variants
+    for ns in _NAMESPACES:
+        mod = importlib.import_module(ns)
+        if hasattr(mod, name):
+            return getattr(mod, name)
+        if hasattr(mod, base):
+            return getattr(mod, base)
+    raise AttributeError(f"_C_ops has no op {name!r}")

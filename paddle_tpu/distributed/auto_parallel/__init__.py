@@ -172,3 +172,6 @@ def get_mesh():
     from ...distributed.collective import get_global_mesh
 
     return get_global_mesh()
+
+
+from .tuner import ClusterDesc, ModelDesc, RuleBasedTuner, TunedStrategy, tune  # noqa: E402

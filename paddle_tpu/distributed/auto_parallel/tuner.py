@@ -1,0 +1,132 @@
+"""Rule-based parallel-strategy tuner.
+
+Ref: python/paddle/distributed/auto_parallel/tuner/rule_based_tuner.py (+
+cost_model.py): the reference searches dist-attr assignments over the op
+graph with a cost model. On TPU the search space is the mesh shape itself —
+(dp, sharding, tensor, pipe, context, expert) degrees — because GSPMD takes
+care of per-op propagation once the mesh and the weight PartitionSpecs are
+fixed. The rules encode the scaling-book recipe: shard params until they
+fit (ZeRO axis), add TP when a single layer's working set or the per-chip
+batch gets too small, add PP only past the TP sweet spot, keep DP for the
+rest; context axis only for long sequences.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ModelDesc:
+    n_params: int                    # total parameter count
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_attention_heads: int = 32
+    seq_len: int = 4096
+    vocab_size: int = 32000
+    dtype_bytes: int = 2             # bf16 params
+
+
+@dataclasses.dataclass
+class ClusterDesc:
+    n_devices: int
+    hbm_bytes: int = 16 << 30        # v5e default
+    devices_per_host: int = 4        # ICI island size for TP preference
+
+
+@dataclasses.dataclass
+class TunedStrategy:
+    dp: int = 1
+    sharding: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    context: int = 1
+
+    def degrees(self) -> Dict[str, int]:
+        return {"dp": self.dp, "sharding": self.sharding, "tensor": self.tensor,
+                "pipe": self.pipe, "context": self.context}
+
+    def total(self) -> int:
+        return self.dp * self.sharding * self.tensor * self.pipe * self.context
+
+
+def tune(model: ModelDesc, cluster: ClusterDesc,
+         max_seq_per_chip: int = 8192) -> TunedStrategy:
+    """Pick mesh degrees for a transformer of ``model``'s size on ``cluster``.
+
+    Memory model (per chip): params+grads+AdamW state ≈ 16 bytes/param when
+    unsharded (bf16 param + bf16 grad + fp32 master + 2×fp32 moments),
+    divided by (sharding × tensor × pipe).
+    """
+    n = cluster.n_devices
+    s = TunedStrategy()
+    bytes_per_param = 16.0
+    budget = 0.6 * cluster.hbm_bytes  # leave room for activations
+
+    # 1) TP: needed when one layer is too fat for a chip, preferred ≤ ICI island
+    layer_bytes = bytes_per_param * model.n_params / max(model.num_layers, 1)
+    tp = 1
+    while (layer_bytes / tp > 0.25 * budget and tp < cluster.devices_per_host
+           and tp * 2 <= n and model.num_attention_heads % (tp * 2) == 0):
+        tp *= 2
+    s.tensor = tp
+
+    # 2) context axis for long sequences (ring attention)
+    ctx = 1
+    while model.seq_len // ctx > max_seq_per_chip and s.tensor * ctx * 2 <= n:
+        ctx *= 2
+    s.context = ctx
+
+    # 3) ZeRO sharding until the full state fits
+    remaining = n // (s.tensor * s.context)
+    shard = 1
+    while (bytes_per_param * model.n_params / (s.tensor * shard) > budget
+           and shard * 2 <= remaining):
+        shard *= 2
+    s.sharding = shard
+
+    # 4) PP only when sharding+TP still don't fit (very large models)
+    remaining = n // (s.tensor * s.context * s.sharding)
+    pp = 1
+    while (bytes_per_param * model.n_params / (s.tensor * s.sharding * pp) > budget
+           and pp * 2 <= remaining and model.num_layers % (pp * 2) == 0):
+        pp *= 2
+    s.pipe = pp
+
+    # 5) everything left is DP
+    s.dp = max(1, n // (s.tensor * s.context * s.sharding * s.pipe))
+    return s
+
+
+class RuleBasedTuner:
+    """Object facade over :func:`tune` (ref rule_based_tuner.py class shape)."""
+
+    def __init__(self, model: ModelDesc, cluster: Optional[ClusterDesc] = None):
+        import jax
+
+        self.model = model
+        self.cluster = cluster or ClusterDesc(n_devices=len(jax.devices()))
+
+    def tune(self) -> TunedStrategy:
+        return tune(self.model, self.cluster)
+
+    def build_mesh(self):
+        """Materialize the tuned strategy as a jax Mesh."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        avail = len(jax.devices())
+        if self.cluster.n_devices > avail:
+            # tuned for a bigger pod than is attached — re-tune to what exists
+            s = tune(self.model, dataclasses.replace(self.cluster, n_devices=avail))
+        else:
+            s = self.tune()
+        degs = s.degrees()
+        names = [k for k, v in degs.items() if v > 1] or ["dp"]
+        shape = [degs[k] for k in names]
+        devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+        axis_rename = {"dp": "data", "pipe": "pipe", "tensor": "tensor",
+                       "sharding": "sharding", "context": "context"}
+        return Mesh(devs, tuple(axis_rename[k] for k in names))

@@ -1,6 +1,7 @@
 """Framework core: Tensor, autograd tape, dtypes, flags, RNG."""
 from .core import (EagerParamBase, Parameter, Tensor, backward, enable_grad, grad,
                    is_grad_enabled, no_grad, to_array)
+from .containers import SelectedRows, TensorArray
 from .dispatch import apply_op, defop
 from .dtype import (bfloat16, bool_, complex64, complex128, convert_dtype, float16, float32,
                     float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,

@@ -1,0 +1,92 @@
+"""Auxiliary tensor container types.
+
+Ref: paddle/phi/core/selected_rows.h (SelectedRows — sparse row-slice
+gradients for embeddings) and the fluid TensorArray / LoDTensorArray
+(paddle/phi/core/tensor_array.h) used by static control flow
+(array_write/array_read around While ops).
+
+TPU-native: TensorArray is a host-side list in eager mode; inside jit, the
+idiomatic equivalent is lax.scan's stacked outputs, so ``stack()`` is the
+bridge. SelectedRows keeps (rows, values) and densifies via a scatter-add,
+which XLA turns into an efficient one-hot matmul/scatter on the MXU.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .core import Tensor, to_array
+
+
+class TensorArray:
+    """Dynamic array of same-rank tensors (write/read/stack)."""
+
+    def __init__(self, values: Optional[Sequence] = None):
+        self._items: List[Optional[Tensor]] = list(values) if values else []
+
+    def append(self, x) -> "TensorArray":
+        self._items.append(x if isinstance(x, Tensor) else Tensor(to_array(x)))
+        return self
+
+    def write(self, index: int, x):
+        index = int(index)
+        if index >= len(self._items):
+            self._items.extend([None] * (index + 1 - len(self._items)))
+        self._items[index] = x if isinstance(x, Tensor) else Tensor(to_array(x))
+
+    def read(self, index: int) -> Tensor:
+        item = self._items[int(index)]
+        if item is None:
+            raise IndexError(f"TensorArray slot {index} was never written")
+        return item
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from ..tensor.manipulation import stack
+
+        return stack([t for t in self._items if t is not None], axis=axis)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i):
+        return self.read(i)
+
+
+class SelectedRows:
+    """Row-sparse tensor: ``value[i]`` is the slice for row id ``rows[i]``.
+
+    The reference uses this as the gradient type of large embedding tables
+    (phi/core/selected_rows.h); optimizers apply sparse updates. Here the
+    dense bridge is a segment-sum scatter, which is what a TPU optimizer
+    update wants anyway.
+    """
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(to_array(rows)).astype(jnp.int32)
+        self.value = to_array(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                          self.value.dtype)
+        return Tensor(dense.at[self.rows].add(self.value))
+
+    def merge(self) -> "SelectedRows":
+        """Merge duplicate row ids by summing their slices."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0], fill_value=self.height)
+        merged = jnp.zeros((uniq.shape[0],) + tuple(self.value.shape[1:]),
+                           self.value.dtype).at[inv].add(self.value)
+        keep = uniq < self.height
+        return SelectedRows(jnp.where(keep, uniq, 0), merged * keep[(...,) + (None,) * (self.value.ndim - 1)], self.height)
+
+    def __repr__(self):
+        return f"SelectedRows(height={self.height}, nnz_rows={self.rows.shape[0]})"

@@ -55,6 +55,10 @@ class LlamaConfig:
     context_parallel: bool = False  # ring attention over 'context' axis
     sequence_parallel: bool = False  # shard activations over 'sep'
     use_flash_attention: bool = True
+    # fuse lm_head matmul + CE when forward() is given labels: chunked
+    # logsumexp, never materializes [B,S,V] logits (ops/fused_ce.py)
+    fused_lm_head_ce: bool = True
+    ce_chunk_size: int = 4096  # tokens per fused-CE chunk (dW carry vs logits tradeoff)
     recompute: bool = False
 
 
@@ -301,12 +305,26 @@ class LlamaForCausalLM(Layer):
 
                 self.lm_head._convert_dtype(convert_dtype(cfg.dtype))
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
+        if labels is not None and self.cfg.fused_lm_head_ce:
+            from ..ops.fused_ce import fused_linear_cross_entropy
+
+            tied = self.cfg.tie_word_embeddings
+            w = self.model.embed_tokens.weight if tied else self.lm_head.weight
+            return apply_op(
+                lambda hv, wv, lv: fused_linear_cross_entropy(
+                    hv, wv, lv, chunk_size=self.cfg.ce_chunk_size,
+                    transpose_weight=tied),
+                h, w, labels, op_name="fused_linear_cross_entropy")
         if self.cfg.tie_word_embeddings:
-            return apply_op(lambda v, w: jnp.matmul(v, w.T), h,
-                            self.model.embed_tokens.weight)
-        return self.lm_head(h)
+            logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                              self.model.embed_tokens.weight)
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        return self.loss_fn(logits, labels)
 
     def loss_fn(self, logits, labels):
         """Next-token CE with fp32 softmax (ParallelCrossEntropy math)."""
@@ -314,5 +332,4 @@ class LlamaForCausalLM(Layer):
 
 
 def llama_pretrain_loss(model: LlamaForCausalLM, input_ids, labels):
-    logits = model(input_ids)
-    return model.loss_fn(logits, labels)
+    return model(input_ids, labels)

@@ -89,6 +89,22 @@ def _lanes(x):
     return jnp.broadcast_to(x[:, None], (x.shape[0], 128))
 
 
+def _pick_block(n: int, want: int) -> int:
+    """Largest of (want, 256, 128, n) that divides n — big blocks keep the
+    MXU busy (512x512 measured ~2.3x over 128x128 at S=2048 on v5e), but the
+    grid needs exact tiling."""
+    for b in (want, 256, 128):
+        if n % b == 0:
+            return min(b, n)
+    return n
+
+
+def _mxu(x):
+    """MXU operand dtype: keep bf16/f32 native; fold f64 (x64 test mode) to
+    f32 so fp32 accumulators and carries type-match."""
+    return x.astype(jnp.float32) if x.dtype == jnp.float64 else x
+
+
 # --------------------------------------------------------------------------- #
 # forward
 # --------------------------------------------------------------------------- #
@@ -99,6 +115,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     """One (batch·head, q-block, k-block) program; k innermost with VMEM
     scratch (m, l, acc) carrying the online softmax across k steps."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
     q_blk = pl.program_id(1)
     kk = pl.program_id(2)
@@ -117,11 +134,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # keep matmul operands in the input dtype (bf16 hits the MXU at full
+        # rate; an fp32 cast here runs ~7x slower) — fp32 only for softmax
+        q = _mxu(q_ref[0])
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, q_blk, kk, block_q, block_k, offs)
         m_prev = m_ref[:, 0]
@@ -131,7 +150,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         l_ref[...] = _lanes(l_prev * alpha + jnp.sum(p, axis=-1))
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_ref[...] = _lanes(m_new)
 
     @pl.when(kk == nk - 1)
@@ -143,7 +163,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
 
 def _flash_fwd_bhsd_stream(q, k, v, causal: bool, scale: float,
-                           block_q: int = 128, block_k: int = 128):
+                           block_q: int = 512, block_k: int = 512):
     """GQA-native: k/v may have fewer heads (Hkv | Hq); the kv BlockSpec
     index map routes each q head to its shared kv head — zero HBM copies
     (the reference materializes repeated KV; ref fmha_ref.h)."""
@@ -154,8 +174,8 @@ def _flash_fwd_bhsd_stream(q, k, v, causal: bool, scale: float,
     Hkv = k.shape[1]
     rep = H // Hkv
     Sk = k.shape[2]
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
     nk = Sk // bk
     q_r = q.reshape(B * H, Sq, D)
     k_r = k.reshape(B * Hkv, Sk, D)
@@ -206,6 +226,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     """dQ for one (batch·head, q-block): k blocks stream on the innermost
     grid axis. dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
     q_blk = pl.program_id(1)
     kk = pl.program_id(2)
@@ -222,20 +243,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        do = do_ref[0].astype(jnp.float32)
+        q = _mxu(q_ref[0])
+        do = _mxu(do_ref[0])
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, q_blk, kk, block_q, block_k, offs)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
         dq_acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -250,6 +271,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dK/dV for one (batch·head, k-block): q/dO blocks stream innermost.
     dV = Pᵀ·dO; dK = scale · dSᵀ·Q (q pre-scaled, so dk carries the scale)."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
     k_blk = pl.program_id(1)
     qi = pl.program_id(2)
@@ -267,24 +289,26 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32) * scale
-        do = do_ref[0].astype(jnp.float32)
+        k = _mxu(k_ref[0])
+        v = _mxu(v_ref[0])
+        q = _mxu(q_ref[0])
+        do = _mxu(do_ref[0])
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, k_blk, block_q, block_k, offs)
         p = jnp.exp(s - lse[:, None])
         dv_acc_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_acc_ref[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -293,7 +317,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal: bool, scale: float,
-                           block_q: int = 128, block_k: int = 128):
+                           block_q: int = 512, block_k: int = 512):
     """Pallas flash backward. GQA: dk/dv are computed per q-head with the
     same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
     over the rep group."""
@@ -304,8 +328,8 @@ def _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal: bool, scale: float,
     Hkv = k.shape[1]
     rep = H // Hkv
     Sk = k.shape[2]
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
     nq = Sq // bq
     nk = Sk // bk
     q_r = q.reshape(B * H, Sq, D)
@@ -384,9 +408,10 @@ def _fwd_kernel_loop(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     """One (batch·head, q-block) program: stream KV blocks, online softmax.
     Also writes the per-row logsumexp (flash backward needs it)."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-    block_q = q.shape[0]
+    q = _mxu(q_ref[0])  # (block_q, d) — native dtype: bf16 operands hit the MXU at
+    block_q = q.shape[0]  # full rate (fp32-cast dots run ~7x slower)
     d = q.shape[-1]
     q_blk = pl.program_id(1)
 
@@ -398,23 +423,20 @@ def _fwd_kernel_loop(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        k = _mxu(k_ref[0, pl.dslice(i * block_k, block_k), :])
+        v = _mxu(v_ref[0, pl.dslice(i * block_k, block_k), :])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             # bottom-right alignment for Sq != Sk (ref tril k=Sk-Sq)
-            q_pos = (seq_k - seq_q) + q_blk * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_blk, i, block_q, block_k, seq_k - seq_q)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     if causal:
@@ -430,8 +452,8 @@ def _fwd_kernel_loop(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
-def _flash_fwd_bhsd_loop(q, k, v, causal: bool, scale: float, block_q: int = 128,
-                    block_k: int = 128):
+def _flash_fwd_bhsd_loop(q, k, v, causal: bool, scale: float, block_q: int = 512,
+                    block_k: int = 512):
     """GQA-native: k/v may have fewer heads (Hkv | Hq); the kv BlockSpec
     index map routes each q head to its shared kv head — zero HBM copies
     (the reference materializes repeated KV; ref fmha_ref.h)."""
@@ -441,8 +463,8 @@ def _flash_fwd_bhsd_loop(q, k, v, causal: bool, scale: float, block_q: int = 128
     Hkv = k.shape[1]
     rep = H // Hkv
     Sk = k.shape[2]
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
     q_r = q.reshape(B * H, Sq, D)
     k_r = k.reshape(B * Hkv, Sk, D)
     v_r = v.reshape(B * Hkv, Sk, D)
@@ -480,9 +502,10 @@ def _bwd_dq_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     """dQ for one (batch·head, q-block): stream KV, use saved LSE.
     dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K  (flash-attention backward)."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = _mxu(q_ref[0])
+    do = _mxu(do_ref[0])
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     block_q, d = q.shape
@@ -490,20 +513,16 @@ def _bwd_dq_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     num_k_blocks = seq_k // block_k
 
     def body(i, dq_acc):
-        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        k = _mxu(k_ref[0, pl.dslice(i * block_k, block_k), :])
+        v = _mxu(v_ref[0, pl.dslice(i * block_k, block_k), :])
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = (seq_k - seq_q) + q_blk * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_blk, i, block_q, block_k, seq_k - seq_q)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
         return dq_acc + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -522,36 +541,34 @@ def _bwd_dkv_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
     dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
     from jax.experimental import pallas as pl
+    scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = _mxu(k_ref[0])
+    v = _mxu(v_ref[0])
     block_k, d = k.shape
     k_blk = pl.program_id(1)
     num_q_blocks = seq_q // block_q
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        q = _mxu(q_ref[0, pl.dslice(i * block_q, block_q), :])
+        do = _mxu(do_ref[0, pl.dslice(i * block_q, block_q), :])
         lse = lse_ref[0, 0, pl.dslice(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.dslice(i * block_q, block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = (seq_k - seq_q) + i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_blk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, i, k_blk, block_q, block_k, seq_k - seq_q)
         p = jnp.exp(s - lse[:, None])
         dv_new = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
         dk_new = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
         return dk_new, dv_new
 
     if causal:
@@ -562,12 +579,12 @@ def _bwd_dkv_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body,
                                (jnp.zeros((block_k, d), jnp.float32),
                                 jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)  # note: q was pre-scaled, so dk
-    dv_ref[0] = dv.astype(dv_ref.dtype)  # already carries the scale factor
+    dk_ref[0] = dk.astype(dk_ref.dtype)  # scale applied per-block in body
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """Pallas flash backward. GQA: dk/dv are computed per q-head with the
     same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
     over the rep group."""
@@ -577,8 +594,8 @@ def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
     Hkv = k.shape[1]
     rep = H // Hkv
     Sk = k.shape[2]
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+    bq = _pick_block(Sq, block_q)
+    bk = _pick_block(Sk, block_k)
     q_r = q.reshape(B * H, Sq, D)
     k_r = k.reshape(B * Hkv, Sk, D)
     v_r = v.reshape(B * Hkv, Sk, D)
@@ -637,22 +654,19 @@ def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
 
 
 
-
-
-
 # K/V longer than this stream block-by-block through the 3-axis grid; below
 # it the full-K loop kernels win (K/V stay resident in VMEM across q blocks)
 _FULL_K_MAX = 8192
 
 
-def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=128, block_k=128):
+def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=512, block_k=512):
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_fwd_bhsd_loop(q, k, v, causal, scale, block_q, block_k)
     return _flash_fwd_bhsd_stream(q, k, v, causal, scale, block_q, block_k)
 
 
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal, scale,
-                    block_q=128, block_k=128):
+                    block_q=512, block_k=512):
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal, scale,
                                     block_q, block_k)

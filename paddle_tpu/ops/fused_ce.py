@@ -1,0 +1,111 @@
+"""Fused lm-head + cross-entropy, chunked over tokens.
+
+The standard pretrain loss materializes fp32 logits of shape [B, S, V]
+(bench config: 8*2048*32000*4 B = 2 GB) and saves them for backward — the
+single largest HBM tensor in the train step. This op computes the loss in
+token chunks (logits for one chunk at a time, discarded after the logsumexp
+and label gather) and recomputes the chunk logits in the hand-written
+backward, so the residuals are O(N) instead of O(N*V).
+
+Reference analogue: paddle/phi/kernels/fusion (fused softmax+CE kernels) and
+mp_ops.py:_c_softmax_with_cross_entropy:375 — there fused for TP numerics,
+here fused for HBM traffic. The vocab ("tensor"-sharded) dimension stays a
+plain dot so GSPMD inserts the TP collectives exactly as it does for the
+unfused path.
+
+Backward per chunk: p = softmax(logits); dlogits = (p - onehot(label)) * g / n_valid;
+dh = dlogits @ W^T; dW += h^T @ dlogits. Extra cost is one logits recompute
+(+2NHV FLOPs, ~1/3 of the lm-head's 6NHV) in exchange for never storing NV.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk(h2, labels, chunk_size, ignore_index):
+    """Pad [N,H]/[N] to a multiple of chunk_size and reshape to chunks."""
+    n = h2.shape[0]
+    c = min(chunk_size, n)
+    nchunk = -(-n // c)
+    pad = nchunk * c - n
+    if pad:
+        h2 = jnp.pad(h2, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
+    return h2.reshape(nchunk, c, h2.shape[-1]), labels.reshape(nchunk, c), pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flce(h2, w, labels, ignore_index, chunk_size):
+    (loss_sum, cnt), _ = _flce_scan(h2, w, labels, ignore_index, chunk_size)
+    return loss_sum / jnp.maximum(cnt.astype(jnp.float32), 1.0)
+
+
+def _flce_scan(h2, w, labels, ignore_index, chunk_size):
+    hc, lc, _ = _chunk(h2, labels, chunk_size, ignore_index)
+
+    def body(carry, xs):
+        s_loss, s_cnt = carry
+        hk, lk = xs
+        logits = jnp.dot(hk, w, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        li = jnp.clip(lk, 0, logits.shape[-1] - 1).astype(jnp.int32)
+        gold = jnp.take_along_axis(logits, li[:, None], axis=-1)[:, 0]
+        valid = lk != ignore_index
+        loss = jnp.where(valid, lse - gold, 0.0)
+        return (s_loss + loss.sum().astype(jnp.float32),
+                s_cnt + valid.sum().astype(jnp.int32)), lse
+
+    return lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), (hc, lc))
+
+
+def _flce_fwd(h2, w, labels, ignore_index, chunk_size):
+    (loss_sum, cnt), lses = _flce_scan(h2, w, labels, ignore_index, chunk_size)
+    mean = loss_sum / jnp.maximum(cnt.astype(jnp.float32), 1.0)
+    return mean, (h2, w, labels, lses, cnt)
+
+
+def _flce_bwd(ignore_index, chunk_size, res, g):
+    h2, w, labels, lses, cnt = res
+    hc, lc, _ = _chunk(h2, labels, chunk_size, ignore_index)
+    scale = g / jnp.maximum(cnt.astype(jnp.float32), 1.0)
+    v = w.shape[-1]
+
+    def body(dw, xs):
+        hk, lk, lsek = xs
+        logits = jnp.dot(hk, w, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lsek[:, None])
+        li = jnp.clip(lk, 0, v - 1).astype(jnp.int32)
+        valid = (lk != ignore_index)[:, None]
+        onehot = jax.nn.one_hot(li, v, dtype=jnp.float32)
+        dlog = jnp.where(valid, (p - onehot) * scale, 0.0)
+        dh_k = jnp.dot(dlog.astype(w.dtype), w.T).astype(hk.dtype)
+        dw = dw + jnp.dot(hk.astype(jnp.float32).T, dlog)
+        return dw, dh_k
+
+    dw, dhc = lax.scan(body, jnp.zeros(w.shape, jnp.float32), (hc, lc, lses))
+    dh2 = dhc.reshape(-1, h2.shape[-1])[: h2.shape[0]]
+    return dh2, dw.astype(w.dtype), None
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
+                               chunk_size: int = 1024,
+                               transpose_weight: bool = False):
+    """Mean next-token CE of ``softmax(hidden @ weight)`` vs integer ``labels``
+    without materializing the full logits tensor.
+
+    hidden: [..., H]; weight: [H, V] ([V, H] with transpose_weight, for tied
+    embeddings); labels: integer [...] matching hidden's leading dims.
+    """
+    if transpose_weight:
+        weight = weight.T
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    l1 = labels.reshape(-1)
+    return _flce(h2, weight, l1, ignore_index, chunk_size)

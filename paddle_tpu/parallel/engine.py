@@ -120,6 +120,12 @@ class ParallelEngine:
         else:
             *inputs, label = batch
             labels = (label,)
+        if loss_fn is None:
+            # model computes its own loss (e.g. fused lm-head+CE path where
+            # logits must never materialize): forward(*inputs, *labels) -> loss
+            out = call(params, *inputs, *labels)
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            return out.value if isinstance(out, Tensor) else out
         out = call(params, *inputs)
         outs = out if isinstance(out, (list, tuple)) else (out,)
         with mesh_context(self.mesh):

@@ -110,13 +110,17 @@ def test_streaming_grid_variant_matches_reference(causal):
     q, k, v = _rand((1, 2, 256, 64), 20), _rand((1, 1, 256, 64), 21), _rand(
         (1, 1, 256, 64), 22)
     s = 1.0 / np.sqrt(64)
-    out, lse = fa._flash_fwd_bhsd_stream(q, k, v, causal, s)
+    # 128-blocks so S=256 yields a multi-block grid — exercises the online
+    # softmax carry across k steps (512 defaults would collapse to one block)
+    out, lse = fa._flash_fwd_bhsd_stream(q, k, v, causal, s,
+                                         block_q=128, block_k=128)
     ref = fa._ref_bhsd(q, k, v, causal, s)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
     do = jnp.cos(out)
     delta = jnp.sum(do * out, axis=-1)
-    dq, dk, dv = fa._flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal, s)
+    dq, dk, dv = fa._flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal, s,
+                                           block_q=128, block_k=128)
     _, vjp_fn = jax.vjp(lambda a, b, c: fa._ref_bhsd(a, b, c, causal, s),
                         q, k, v)
     rq, rk, rv = vjp_fn(do)
