@@ -25,6 +25,26 @@ _ALIASES = {
 }
 
 
+def _inplace(fn):
+    """Reference `op_` mutates its first arg; emulate by writing the result
+    back into the input Tensor so callers that drop the return value still
+    see the update."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(x, *args, **kwargs):
+        from .framework.core import Tensor
+
+        out = fn(x, *args, **kwargs)
+        if isinstance(x, Tensor) and isinstance(out, Tensor) \
+                and out.value.shape == x.value.shape:
+            x._value = out.value
+            return x
+        return out
+
+    return wrapped
+
+
 def __getattr__(name):
     if name.startswith("__"):
         raise AttributeError(name)
@@ -35,7 +55,9 @@ def __getattr__(name):
     for ns in _NAMESPACES:
         mod = importlib.import_module(ns)
         if hasattr(mod, name):
-            return getattr(mod, name)
+            fn = getattr(mod, name)
+            # a same-named attr ending in _ may itself be the true inplace op
+            return fn
         if hasattr(mod, base):
-            return getattr(mod, base)
+            return _inplace(getattr(mod, base))
     raise AttributeError(f"_C_ops has no op {name!r}")

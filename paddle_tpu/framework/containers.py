@@ -44,7 +44,12 @@ class TensorArray:
     def stack(self, axis: int = 0) -> Tensor:
         from ..tensor.manipulation import stack
 
-        return stack([t for t in self._items if t is not None], axis=axis)
+        holes = [i for i, t in enumerate(self._items) if t is None]
+        if holes:
+            raise IndexError(
+                f"TensorArray.stack: slots {holes} were never written "
+                "(write() every index, or append() densely)")
+        return stack(list(self._items), axis=axis)
 
     def __len__(self):
         return len(self._items)

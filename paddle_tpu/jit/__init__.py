@@ -41,7 +41,12 @@ class InputSpec:
 
 
 @contextlib.contextmanager
-def _swapped_params(layer, named_values: Dict[str, Any]):
+def _swapped_params(layer, named_values: Dict[str, Any],
+                    mutated_out: Optional[Dict[str, Any]] = None):
+    """Swap in values, run, restore. If ``mutated_out`` is given, any entry
+    whose ``_value`` the call reassigned (BN running stats and similar eager
+    side effects, ref nn/functional/norm.py batch_norm) is captured into it
+    before restore — the functionalized form of that state update."""
     saved = {}
     params = dict(layer.named_parameters())
     buffers = dict(layer.named_buffers())
@@ -54,6 +59,11 @@ def _swapped_params(layer, named_values: Dict[str, Any]):
             saved[name] = t._value
             t._value = val
         yield
+        if mutated_out is not None:
+            for name, val in named_values.items():
+                t = store.get(name)
+                if t is not None and t._value is not val:
+                    mutated_out[name] = t._value
     finally:
         for name, val in saved.items():
             store[name]._value = val
@@ -73,12 +83,16 @@ def param_values(layer) -> Dict[str, jax.Array]:
     return {name: p.value for name, p in layer.named_parameters() if p.trainable}
 
 
-def functional_call(layer, named_values: Dict[str, Any], *args, call_fn=None, **kwargs):
+def functional_call(layer, named_values: Dict[str, Any], *args, call_fn=None,
+                    mutated_state: Optional[Dict[str, Any]] = None, **kwargs):
     """Run ``layer(*args)`` with parameters/buffers temporarily replaced by
     ``named_values`` (possibly tracers). The tape is disabled: gradients on
     this path come from jax.grad over this function. ``call_fn`` overrides the
-    callable (used by to_static to avoid re-entering a patched forward)."""
-    with _swapped_params(layer, named_values), no_grad_ctx():
+    callable (used by to_static to avoid re-entering a patched forward).
+    ``mutated_state``: dict filled with buffer values the call reassigned
+    (e.g. BN running stats) so jitted callers can thread them as outputs."""
+    with _swapped_params(layer, named_values, mutated_out=mutated_state), \
+            no_grad_ctx():
         out = (call_fn or layer)(*args, **kwargs)
     return out
 
@@ -100,12 +114,16 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, layer=None, input_spec=None, build_strategy=None,
                  backend=None):
-        self._fn = fn
+        # AST-rewrite Python if/while over Tensors into lax.cond/while_loop
+        # (ref dy2static *_transformer.py); no-op when nothing applies
+        from .dy2static import convert_to_static
+
+        self._fn = convert_to_static(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._jitted = None
         self._donate = False
-        functools.update_wrapper(self, fn)
+        functools.update_wrapper(self, self._fn)
 
     @property
     def forward_fn(self):

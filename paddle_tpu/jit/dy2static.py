@@ -1,0 +1,426 @@
+"""dy2static: AST rewriting of Python control flow into traceable ops.
+
+Ref: python/paddle/jit/dy2static/ — the reference rewrites a function's AST
+(~20 *_transformer.py; IfElseTransformer, LoopTransformer) so `if`/`while`
+over Tensors become conditional_block/while ops in the ProgramDesc.
+
+TPU-native version: the same AST rewrite, but the target ops are
+`lax.cond` / `lax.while_loop`, and dispatch happens at RUNTIME —
+`convert_ifelse` first tries `bool(pred)`; concrete (eager) predicates keep
+exact Python semantics, and only tracer predicates (inside `to_static`'s
+jax.jit trace) take the lax path. Locals are threaded through the branches
+as a dict pytree (name analysis picks up loads/stores).
+
+Supported subset (documented, mirrors the reference's own restrictions):
+- `if`/`elif`/`else` and `while` whose bodies don't `return`/`break`/
+  `continue`; such statements are left untouched (they still work whenever
+  the predicate is concrete).
+- names assigned under a traced branch/loop must already exist before it
+  (lax.cond/while_loop need both paths to produce the same structure).
+- functions whose source is available and which have no free closure
+  variables; otherwise the original function is used unchanged.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+
+class _Undef:
+    """Sentinel for names not bound at the capture point."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undef>"
+
+
+UNDEF = _Undef()
+
+
+def pack(local_map: Dict[str, Any], names: Sequence[str]) -> Dict[str, Any]:
+    """Capture the subset of ``locals()`` a rewritten block threads through."""
+    return {n: local_map[n] for n in names if n in local_map}
+
+
+def _is_traced(x) -> bool:
+    import jax.core
+
+    from ..framework.core import Tensor
+
+    if isinstance(x, Tensor):
+        x = x.value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _raw_bool(x):
+    from ..framework.core import Tensor
+
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _partition(vars_dict: Dict[str, Any], promote: Sequence[str]):
+    """Split locals into lax-traceable operands and static closure values.
+
+    Returns (dyn, static, wrappers): ``dyn`` maps name → raw jax value;
+    ``wrappers`` remembers which names held framework Tensors so branch
+    bodies see the type they were written against. Plain Python numbers are
+    promoted to arrays only for names in ``promote`` (the block's stores) —
+    untouched statics keep exact Python semantics."""
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ..framework.core import Tensor
+
+    dyn, static, wrappers = {}, {}, {}
+    for k, v in vars_dict.items():
+        raw = v.value if isinstance(v, Tensor) else v
+        if _is_traced(raw) or hasattr(raw, "dtype") and hasattr(raw, "shape"):
+            dyn[k] = raw
+            wrappers[k] = isinstance(v, Tensor)
+        elif k in promote and isinstance(v, (bool, int, float, _np.number)):
+            dyn[k] = jnp.asarray(v)
+            wrappers[k] = False
+        else:
+            static[k] = v
+    return dyn, static, wrappers
+
+
+def _env(dyn, static, wrappers):
+    from ..framework.core import Tensor
+
+    out = dict(static)
+    for k, v in dyn.items():
+        out[k] = Tensor(v) if wrappers.get(k) else v
+    return out
+
+
+def _dyn_outs(result: Dict[str, Any], keys):
+    """Extract the lax-carried names from a branch's pack() result as raw
+    arrays, coercing numbers so both branches agree."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    out = {}
+    for k in keys:
+        v = result.get(k, UNDEF)
+        if isinstance(v, _Undef):
+            raise TypeError(
+                f"dy2static: variable {k!r} must be bound on every path of a "
+                "Tensor-predicate block (ref dy2static IfElseTransformer)")
+        v = v.value if isinstance(v, Tensor) else v
+        out[k] = jnp.asarray(v)
+    return out
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   vars_dict: Dict[str, Any],
+                   store_names: Sequence[str] = ()) -> Dict[str, Any]:
+    """Runtime dispatch for a rewritten ``if`` (ref convert_operators.py
+    convert_ifelse): concrete pred → plain Python call; traced pred →
+    lax.cond carrying the array-typed locals, statics via closure."""
+    if not _is_traced(pred):
+        return true_fn(dict(vars_dict)) if bool(_raw_bool(pred)) else \
+            false_fn(dict(vars_dict))
+    import jax
+
+    dyn, static, wrappers = _partition(vars_dict, store_names)
+    carried = list(store_names)
+    default_wrap = any(wrappers.values())  # new names follow the block's style
+
+    def t_out(d):
+        return _dyn_outs(true_fn(_env(d, static, wrappers)), carried)
+
+    def f_out(d):
+        return _dyn_outs(false_fn(_env(d, static, wrappers)), carried)
+
+    res = jax.lax.cond(_raw_bool(pred) != 0, t_out, f_out, dyn)
+    from ..framework.core import Tensor
+
+    out = dict(vars_dict)
+    for k in carried:
+        out[k] = Tensor(res[k]) if wrappers.get(k, default_wrap) else res[k]
+    return out
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable,
+                       vars_dict: Dict[str, Any],
+                       store_names: Sequence[str] = ()) -> Dict[str, Any]:
+    """Runtime dispatch for a rewritten ``while``: concrete condition →
+    Python loop; traced condition → lax.while_loop with the array-typed
+    locals as carry (numeric stores promoted to arrays)."""
+    first = cond_fn(dict(vars_dict))
+    if not _is_traced(first):
+        vars_dict = dict(vars_dict)
+        while bool(_raw_bool(cond_fn(dict(vars_dict)))):
+            vars_dict = body_fn(dict(vars_dict))
+        return vars_dict
+    import jax
+
+    dyn, static, wrappers = _partition(vars_dict, store_names)
+    missing = [k for k in store_names if k not in dyn]
+    if missing:
+        raise TypeError(
+            f"dy2static: variables {missing!r} assigned in a Tensor-condition "
+            "`while` must be bound to array/number values before the loop "
+            "(lax.while_loop fixed-structure restriction)")
+    carry_keys = sorted(dyn)
+
+    def c(d):
+        return _raw_bool(cond_fn(_env(d, static, wrappers))) != 0
+
+    def b(d):
+        res = _dyn_outs(body_fn(_env(d, static, wrappers)), carry_keys)
+        # unchanged carries keep their dtype; changed ones must match
+        return {k: res[k].astype(d[k].dtype) if hasattr(d[k], "dtype") and
+                res[k].dtype != d[k].dtype else res[k] for k in carry_keys}
+
+    res = jax.lax.while_loop(c, b, dyn)
+    from ..framework.core import Tensor
+
+    out = dict(vars_dict)
+    for k in carry_keys:
+        out[k] = Tensor(res[k]) if wrappers.get(k, False) else res[k]
+    return out
+
+
+def convert_logical_and(lhs: Callable, rhs: Callable):
+    l = lhs()
+    if not _is_traced(l):
+        return rhs() if bool(_raw_bool(l)) else l
+    import jax.numpy as jnp
+
+    return jnp.logical_and(_raw_bool(l) != 0, _raw_bool(rhs()) != 0)
+
+
+def convert_logical_or(lhs: Callable, rhs: Callable):
+    l = lhs()
+    if not _is_traced(l):
+        return l if bool(_raw_bool(l)) else rhs()
+    import jax.numpy as jnp
+
+    return jnp.logical_or(_raw_bool(l) != 0, _raw_bool(rhs()) != 0)
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not bool(_raw_bool(x))
+    import jax.numpy as jnp
+
+    return jnp.logical_not(_raw_bool(x) != 0)
+
+
+# --------------------------------------------------------------------------- #
+# AST transformer
+# --------------------------------------------------------------------------- #
+
+_JST = "_pt_jst"          # module alias injected into the compiled namespace
+_PREFIX = "__pt_"
+
+
+def _walk_scoped(node):
+    """ast.walk that does not descend into nested function/class scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                stack.append(child)
+
+
+def _has_escape(nodes) -> bool:
+    """True if the block contains return/break/continue/yield at this level
+    (not inside a nested function) — those keep Python semantics."""
+    for n in nodes:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue  # a def at this level opens its own scope
+        for sub in _walk_scoped(n):
+            if isinstance(sub, (ast.Return, ast.Break, ast.Continue,
+                                ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+_BUILTINS = set(dir(__import__("builtins")))
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _walk_no_comp(node):
+    """Walk without descending into comprehension scopes (their targets are
+    scope-local in Py3, not block stores)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _COMP_NODES):
+                stack.append(child)
+
+
+def _name_sets(nodes) -> Tuple[set, set]:
+    loads, stores = set(), set()
+    for n in nodes:
+        for sub in ast.walk(n):  # loads: anywhere, incl. comprehensions
+            if isinstance(sub, ast.Name) and not sub.id.startswith(_PREFIX) \
+                    and sub.id != _JST and not isinstance(sub.ctx, ast.Store):
+                loads.add(sub.id)
+        for sub in _walk_no_comp(n):  # stores: statement level only
+            if isinstance(sub, ast.Name) and not sub.id.startswith(_PREFIX) \
+                    and sub.id != _JST and isinstance(sub.ctx, ast.Store):
+                stores.add(sub.id)
+    # builtins are resolved from the enclosing scope, not threaded — unless
+    # the user actually assigns to the name
+    loads -= _BUILTINS - stores
+    return loads, stores
+
+
+def _stmt(src: str) -> list:
+    return ast.parse(textwrap.dedent(src)).body
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+
+    # don't descend into nested function/class definitions
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: node  # noqa: E731
+
+    def _make_branch_fn(self, name, body, tracked):
+        # unpack with explicit global fallback: any assignment makes the name
+        # function-local (so a bare conditional unpack would shadow imports /
+        # module helpers with an unbound local); absent-everywhere names get
+        # UNDEF and only fail if the body actually reads them before binding
+        unpack = [f'{v} = {_PREFIX}vars["{v}"] if "{v}" in {_PREFIX}vars '
+                  f'else globals().get("{v}", {_JST}.UNDEF)'
+                  for v in sorted(tracked)]
+        src = f"def {name}({_PREFIX}vars):\n" + "".join(
+            f"    {u}\n" for u in unpack) + "    pass\n"
+        fn = _stmt(src)[0]
+        fn.body = fn.body[:-1] + body + _stmt(
+            f"return {_JST}.pack(locals(), {sorted(tracked)!r})")
+        return fn
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        loads, stores = _name_sets(node.body + node.orelse)
+        cond_loads, _ = _name_sets([node.test])
+        tracked = sorted((loads | stores | cond_loads) - {"_", _JST})
+        if not stores:
+            return node
+        i = self.n
+        self.n += 1
+        true_fn = self._make_branch_fn(f"{_PREFIX}true_{i}", node.body or
+                                       _stmt("pass"), tracked)
+        false_fn = self._make_branch_fn(f"{_PREFIX}false_{i}", node.orelse or
+                                        _stmt("pass"), tracked)
+        call = _stmt(
+            f"{_PREFIX}out_{i} = {_JST}.convert_ifelse(PREDPLACEHOLDER, "
+            f"{_PREFIX}true_{i}, {_PREFIX}false_{i}, "
+            f"{_JST}.pack(locals(), {tracked!r}), {sorted(stores)!r})")[0]
+        call.value.args[0] = node.test
+        unpacks = []
+        for v in sorted(stores):
+            unpacks += _stmt(
+                f'if "{v}" in {_PREFIX}out_{i} and not isinstance('
+                f'{_PREFIX}out_{i}["{v}"], {_JST}._Undef):\n'
+                f'    {v} = {_PREFIX}out_{i}["{v}"]')
+        return [true_fn, false_fn, call] + unpacks
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        loads, stores = _name_sets(node.body)
+        cond_loads, _ = _name_sets([node.test])
+        tracked = sorted((loads | stores | cond_loads) - {"_", _JST})
+        if not stores:
+            return node
+        i = self.n
+        self.n += 1
+        cond_src = f"def {_PREFIX}cond_{i}({_PREFIX}vars):\n" + "".join(
+            f'    {v} = {_PREFIX}vars["{v}"] if "{v}" in {_PREFIX}vars '
+            f'else globals().get("{v}", {_JST}.UNDEF)\n'
+            for v in tracked) + "    return COND\n"
+        cond_fn = _stmt(cond_src)[0]
+        cond_fn.body[-1] = ast.Return(value=node.test)
+        body_fn = self._make_branch_fn(f"{_PREFIX}body_{i}", node.body, tracked)
+        call = _stmt(
+            f"{_PREFIX}out_{i} = {_JST}.convert_while_loop({_PREFIX}cond_{i}, "
+            f"{_PREFIX}body_{i}, {_JST}.pack(locals(), {tracked!r}), "
+            f"{sorted(stores)!r})")[0]
+        unpacks = []
+        for v in sorted(stores):
+            unpacks += _stmt(
+                f'if "{v}" in {_PREFIX}out_{i} and not isinstance('
+                f'{_PREFIX}out_{i}["{v}"], {_JST}._Undef):\n'
+                f'    {v} = {_PREFIX}out_{i}["{v}"]')
+        return [cond_fn, body_fn, call] + unpacks
+
+
+@functools.lru_cache(maxsize=256)
+def _convert_cached(fn):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    if fn.__closure__:
+        return fn  # free variables wouldn't resolve in the recompiled scope
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    before = ast.dump(fdef)
+    # visit the body statements (visit_FunctionDef guards NESTED defs; the
+    # top-level def itself must be descended into)
+    t = _CtrlFlowTransformer()
+    new_body = []
+    for stmt in fdef.body:
+        r = t.visit(stmt)
+        new_body.extend(r if isinstance(r, list) else [r])
+    fdef.body = new_body
+    ast.fix_missing_locations(tree)
+    if ast.dump(fdef) == before:
+        return fn  # nothing rewritten
+    import paddle_tpu.jit.dy2static as _self
+
+    ns = dict(fn.__globals__)
+    ns[_JST] = _self
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, ns)  # noqa: S102 — recompiling the user's own source
+        out = ns[fdef.name]
+        out.__wrapped_dy2static__ = fn
+        return out
+    except Exception:
+        return fn
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Rewrite ``fn``'s Python `if`/`while` into runtime-dispatched
+    convert_ifelse/convert_while_loop calls (ref ProgramTranslator.get_func).
+    Bound methods are rewritten on the underlying function and re-bound.
+    Falls back to the original on any unsupported construct."""
+    if inspect.ismethod(fn):
+        conv = _convert_cached(fn.__func__)
+        return conv.__get__(fn.__self__) if conv is not fn.__func__ else fn
+    if not inspect.isfunction(fn):
+        return fn
+    return _convert_cached(fn)

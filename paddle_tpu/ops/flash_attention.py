@@ -269,7 +269,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
                     block_q, block_k, nq, seq_q, seq_k):
     """dK/dV for one (batch·head, k-block): q/dO blocks stream innermost.
-    dV = Pᵀ·dO; dK = scale · dSᵀ·Q (q pre-scaled, so dk carries the scale)."""
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q (scale applied per-block on the dk dot)."""
     from jax.experimental import pallas as pl
     scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 

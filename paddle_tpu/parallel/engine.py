@@ -82,8 +82,21 @@ class ParallelEngine:
     # ------------------------------------------------------------------ state
     def _build_state(self):
         mesh = self.mesh
+        # single-device mesh: keep plain (unsharded) arrays — NamedSharding
+        # inputs route jit through the SPMD partitioner, which compiles a
+        # measurably worse program around Pallas custom calls (6x step time
+        # at S=16k on one v5e); GSPMD buys nothing with one device anyway
+        self._spmd = mesh.size > 1
         self.specs = param_specs(self.model, mesh, fsdp=self.fsdp)
         vals = state_values(self.model)
+        if not self._spmd:
+            self.params = dict(vals)
+            self._trainable = {name for name, p in self.model.named_parameters()
+                               if p.trainable}
+            self.opt_state = (self.optimizer.init_state(
+                {n: v for n, v in self.params.items() if n in self._trainable})
+                if self.optimizer is not None else {})
+            return
         self.params = {
             name: jax.device_put(v, _sharding_of(mesh, self.specs.get(name, P())))
             for name, v in vals.items()
@@ -104,12 +117,15 @@ class ParallelEngine:
             self.opt_state = {}
 
     # ------------------------------------------------------------- train step
-    def _loss_from_batch(self, params, batch):
+    def _loss_from_batch(self, params, batch, state_out=None):
+        """state_out: dict capturing buffer values the forward reassigned
+        (BN running stats etc.) so the jitted step can carry them."""
         model, loss_fn = self.model, self.loss_fn
 
         def call(p, *args):
             with mesh_context(self.mesh):
-                out = functional_call(model, p, *[Tensor(a) for a in args])
+                out = functional_call(model, p, *[Tensor(a) for a in args],
+                                      mutated_state=state_out)
             return out
 
         if isinstance(batch, dict):
@@ -132,6 +148,24 @@ class ParallelEngine:
             loss = loss_fn(*outs, *[Tensor(l) for l in labels])
         return loss.value if isinstance(loss, Tensor) else loss
 
+    @staticmethod
+    def _raw(v):
+        return v.value if isinstance(v, Tensor) else v
+
+    def _batch_sharding(self, arr, spec):
+        """NamedSharding for one batch array: drop mesh axes the array's dims
+        can't be evenly split over (tiny eval batches on a big global mesh)."""
+        spec = _filter_spec(spec, self.mesh)
+        dims = []
+        for i, ax in enumerate(spec):
+            if ax is None:
+                dims.append(None)
+                continue
+            axes = (ax,) if isinstance(ax, str) else tuple(ax)
+            size = int(np.prod([self.mesh.shape[a] for a in axes]))
+            dims.append(ax if i < arr.ndim and arr.shape[i] % size == 0 else None)
+        return _sharding_of(self.mesh, P(*dims))
+
     def build_train_step(self):
         mesh = self.mesh
         opt = self.optimizer
@@ -141,7 +175,15 @@ class ParallelEngine:
             frozen = {n: v for n, v in params.items() if n not in self._trainable}
 
             def loss_of(tr):
-                return self._loss_from_batch({**tr, **frozen}, batch)
+                # aux = buffers the forward reassigned (BN running stats):
+                # captured from the eager side effect and carried as a jit
+                # output so the compiled path matches eager BN semantics
+                mutated = {}
+                loss = self._loss_from_batch({**tr, **frozen}, batch,
+                                             state_out=mutated)
+                new_bufs = {n: self._raw(v) for n, v in mutated.items()
+                            if n not in self._trainable}
+                return loss, new_bufs
 
             if self.remat:
                 # keep MXU outputs, recompute elementwise (the reference's
@@ -155,15 +197,18 @@ class ParallelEngine:
                 loss_of_ = jax.checkpoint(loss_of, policy=policy)
             else:
                 loss_of_ = loss_of
-            loss, grads = jax.value_and_grad(loss_of_)(train)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of_, has_aux=True)(train)
             new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
                                                    step_count + 1)
-            # keep shardings stable across steps
-            new_train = {
-                n: jax.lax.with_sharding_constraint(
-                    v, _sharding_of(mesh, self.specs.get(n, P())))
-                for n, v in new_train.items()
-            }
+            if self._spmd:
+                # keep shardings stable across steps
+                new_train = {
+                    n: jax.lax.with_sharding_constraint(
+                        v, _sharding_of(mesh, self.specs.get(n, P())))
+                    for n, v in new_train.items()
+                }
+            frozen = {**frozen, **new_bufs}
             return {**new_train, **frozen}, new_state, step_count + 1, loss
 
         self._step_count = jnp.zeros((), jnp.int32)
@@ -178,11 +223,12 @@ class ParallelEngine:
         lr = self.optimizer.get_lr()
         batch_vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                            for b in batch)
-        batch_vals = tuple(
-            jax.device_put(b, _sharding_of(self.mesh, _filter_spec(
-                self.batch_spec if not isinstance(self.batch_spec, (list, tuple))
-                else self.batch_spec[i], self.mesh)))
-            for i, b in enumerate(batch_vals))
+        if self._spmd:
+            batch_vals = tuple(
+                jax.device_put(b, self._batch_sharding(
+                    b, self.batch_spec if not isinstance(self.batch_spec, (list, tuple))
+                    else self.batch_spec[i]))
+                for i, b in enumerate(batch_vals))
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
         if isinstance(self.optimizer._learning_rate, object) and hasattr(

@@ -18,5 +18,6 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_3
                            shufflenet_v2_x2_0, shufflenet_v2_swish)
 from .googlenet import GoogLeNet, googlenet
 from .inceptionv3 import InceptionV3, inception_v3
+from .ocr import CRNN, DBNet, crnn, crnn_ctc_loss, db_loss, dbnet
 
 __all__ = [n for n in dir() if not n.startswith("_")]

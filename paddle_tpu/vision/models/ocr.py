@@ -1,0 +1,216 @@
+"""OCR model family — the conv-heavy path of BASELINE.json config 5
+(PP-OCRv4 det+rec).
+
+The reference repo itself carries only the kernel substrate for OCR
+(conv/pool/interpolate PHI kernels, warpctc op — ref
+paddle/phi/kernels/gpu/conv_kernel.cu, paddle/fluid/operators/ctc_align_op*);
+the models live in PaddleOCR on top of paddle.vision backbones. Here the
+same pair is provided natively:
+
+- ``DBNet``: Differentiable-Binarization text detector — light 4-stage conv
+  backbone, FPN neck (top-down adds + upsampled concat), DB head emitting
+  probability/threshold maps and the differentiable binarization
+  ``1/(1+exp(-k(P-T)))``.
+- ``CRNN``: text recognizer — VGG-style conv tower pooling height to 1,
+  2-layer bidirectional LSTM encoder over width, CTC projection. Pairs with
+  ``F.ctc_loss``.
+
+Both are MXU-friendly: plain NCHW convs XLA lowers onto the MXU, no dynamic
+shapes, upsampling via nearest interpolate.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.dispatch import apply_op
+from ...nn import (BatchNorm2D, Conv2D, Layer, LayerList, Linear, MaxPool2D,
+                   ReLU, Sequential)
+from ...nn import functional as F
+from ...nn.layer.rnn import LSTM
+
+__all__ = ["DBNet", "CRNN", "db_loss", "crnn_ctc_loss", "dbnet", "crnn"]
+
+
+def _conv_bn(cin, cout, k=3, stride=1, padding=1):
+    return Sequential(
+        Conv2D(cin, cout, k, stride=stride, padding=padding, bias_attr=False),
+        BatchNorm2D(cout), ReLU())
+
+
+class _Backbone(Layer):
+    """4-stage strided conv backbone; returns features at 1/4..1/32."""
+
+    def __init__(self, in_channels=3, base=16):
+        super().__init__()
+        c = base
+        self.stem = Sequential(_conv_bn(in_channels, c, stride=2),
+                               _conv_bn(c, c))
+        self.stages = LayerList([
+            Sequential(_conv_bn(c, 2 * c, stride=2), _conv_bn(2 * c, 2 * c)),
+            Sequential(_conv_bn(2 * c, 4 * c, stride=2), _conv_bn(4 * c, 4 * c)),
+            Sequential(_conv_bn(4 * c, 8 * c, stride=2), _conv_bn(8 * c, 8 * c)),
+            Sequential(_conv_bn(8 * c, 16 * c, stride=2), _conv_bn(16 * c, 16 * c)),
+        ])
+        self.out_channels = [2 * c, 4 * c, 8 * c, 16 * c]
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats  # strides 4, 8, 16, 32
+
+
+class _FPN(Layer):
+    """DB-style neck: lateral 1x1 + top-down nearest-upsample adds, then each
+    level reduced and upsampled to 1/4 scale and concatenated."""
+
+    def __init__(self, in_channels, out_channels=96):
+        super().__init__()
+        self.laterals = LayerList([
+            Conv2D(c, out_channels, 1, bias_attr=False) for c in in_channels])
+        quarter = out_channels // 4
+        self.smooth = LayerList([
+            Conv2D(out_channels, quarter, 3, padding=1, bias_attr=False)
+            for _ in in_channels])
+        self.out_channels = quarter * 4
+
+    def forward(self, feats):
+        lat = [l(f) for l, f in zip(self.laterals, feats)]
+        for i in range(len(lat) - 2, -1, -1):
+            up = F.interpolate(lat[i + 1], size=lat[i].shape[2:], mode="nearest")
+            lat[i] = lat[i] + up
+        outs = []
+        tgt = lat[0].shape[2:]
+        for s, f in zip(self.smooth, lat):
+            f = s(f)
+            if tuple(f.shape[2:]) != tuple(tgt):
+                f = F.interpolate(f, size=tgt, mode="nearest")
+            outs.append(f)
+        from ...tensor.manipulation import concat
+
+        return concat(outs, axis=1)
+
+
+class _DBHead(Layer):
+    """Conv → upsample ×4 → 1-channel sigmoid map."""
+
+    def __init__(self, in_channels):
+        super().__init__()
+        mid = in_channels // 4
+        self.conv1 = _conv_bn(in_channels, mid)
+        self.conv2 = Conv2D(mid, 1, 1)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = F.interpolate(x, scale_factor=4, mode="nearest")
+        return F.sigmoid(self.conv2(x))
+
+
+class DBNet(Layer):
+    """Differentiable Binarization detector (det side of config 5).
+
+    forward → dict with 'maps': (B, 3, H, W) = prob, thresh, binary maps in
+    train mode; (B, 1, H, W) prob map in eval.
+    """
+
+    def __init__(self, in_channels=3, base_channels=16, k=50.0):
+        super().__init__()
+        self.backbone = _Backbone(in_channels, base_channels)
+        self.neck = _FPN(self.backbone.out_channels)
+        self.prob_head = _DBHead(self.neck.out_channels)
+        self.thresh_head = _DBHead(self.neck.out_channels)
+        self.k = k
+
+    def forward(self, x):
+        feat = self.neck(self.backbone(x))
+        prob = self.prob_head(feat)
+        if not self.training:
+            return {"maps": prob}
+        thresh = self.thresh_head(feat)
+        binary = apply_op(
+            lambda p, t: 1.0 / (1.0 + jnp.exp(-self.k * (p - t))), prob, thresh,
+            op_name="db_binarize")
+        from ...tensor.manipulation import concat
+
+        return {"maps": concat([prob, thresh, binary], axis=1)}
+
+
+def db_loss(maps, shrink_map, shrink_mask, thresh_map=None, thresh_mask=None,
+            alpha=5.0, beta=10.0, eps=1e-6):
+    """DB loss: BCE on the probability map + dice on the binary map + L1 on
+    the threshold map (when supervision is provided)."""
+    from ...framework.core import Tensor
+
+    def f(m, sm, smask, *tm):
+        prob, thresh, binary = m[:, 0], m[:, 1], m[:, 2]
+        smf = sm.astype(jnp.float32)
+        w = smask.astype(jnp.float32)
+        p = jnp.clip(prob, eps, 1 - eps)
+        bce = -(smf * jnp.log(p) + (1 - smf) * jnp.log(1 - p))
+        bce = (bce * w).sum() / jnp.maximum(w.sum(), 1.0)
+        inter = (binary * smf * w).sum()
+        union = (binary * w).sum() + (smf * w).sum() + eps
+        dice = 1.0 - 2.0 * inter / union
+        loss = alpha * bce + dice
+        if tm:
+            t, tmask = tm
+            tw = tmask.astype(jnp.float32)
+            l1 = (jnp.abs(thresh - t) * tw).sum() / jnp.maximum(tw.sum(), 1.0)
+            loss = loss + beta * l1
+        return loss
+
+    args = [maps, shrink_map, shrink_mask]
+    if thresh_map is not None:
+        args += [thresh_map, thresh_mask]
+    return apply_op(f, *args, op_name="db_loss")
+
+
+class CRNN(Layer):
+    """CRNN recognizer (rec side of config 5): conv tower → BiLSTM → CTC
+    logits (B, T, num_classes+1); blank index 0."""
+
+    def __init__(self, num_classes, in_channels=3, hidden_size=96):
+        super().__init__()
+        self.features = Sequential(
+            _conv_bn(in_channels, 32), MaxPool2D(2, 2),          # H/2, W/2
+            _conv_bn(32, 64), MaxPool2D(2, 2),                   # H/4, W/4
+            _conv_bn(64, 128), _conv_bn(128, 128),
+            MaxPool2D((2, 1), (2, 1)),                           # H/8, W/4
+            _conv_bn(128, 256),
+            MaxPool2D((2, 1), (2, 1)),                           # H/16, W/4
+            _conv_bn(256, 256, k=2, padding=0),                  # H/16-1 → 1
+        )
+        self.encoder = LSTM(256, hidden_size, num_layers=2,
+                            direction="bidirect")
+        self.head = Linear(2 * hidden_size, num_classes + 1)
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        f = self.features(x)  # (B, C, 1, W')
+        B, C = f.shape[0], f.shape[1]
+        from ...tensor.manipulation import reshape, transpose
+
+        seq = transpose(reshape(f, [B, C, -1]), [0, 2, 1])  # (B, W', C)
+        enc, _ = self.encoder(seq)
+        return self.head(enc)  # (B, T, num_classes+1)
+
+
+def crnn_ctc_loss(logits, labels, label_lengths, blank=0):
+    """CTC loss over CRNN logits: all timesteps are valid input frames."""
+    from ...tensor.creation import full
+    from ...tensor.manipulation import transpose
+
+    t = logits.shape[1]
+    tl = full([logits.shape[0]], t, dtype="int32")
+    return F.ctc_loss(transpose(logits, [1, 0, 2]), labels, tl, label_lengths,
+                      blank=blank)
+
+
+def dbnet(**kwargs) -> DBNet:
+    return DBNet(**kwargs)
+
+
+def crnn(num_classes=36, **kwargs) -> CRNN:
+    return CRNN(num_classes, **kwargs)

@@ -1,0 +1,177 @@
+"""dy2static AST control-flow rewriting (jit/dy2static.py): Python if/while
+over Tensors become lax.cond/while_loop under to_static; concrete predicates
+keep exact Python semantics. Ref: dy2static *_transformer.py tests
+(unittests/dygraph_to_static/) — per-construct dygraph vs static parity."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def _relu_branch(x, flag):
+    if flag:
+        y = x * 2
+    else:
+        y = x - 1
+    i = 0
+    while i < 3:
+        y = y + 1
+        i += 1
+    return y
+
+
+def test_python_predicates_unchanged():
+    g = convert_to_static(_relu_branch)
+    assert g is not _relu_branch
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    np.testing.assert_allclose(np.asarray(g(x, True).value), [5.0, 7.0])
+    np.testing.assert_allclose(np.asarray(g(x, False).value), [3.0, 4.0])
+    # matches the untransformed function
+    np.testing.assert_allclose(np.asarray(g(x, True).value),
+                               np.asarray(_relu_branch(x, True).value))
+
+
+def _tensor_if(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = -x
+    return y
+
+
+def test_tensor_predicate_if_under_jit():
+    f = convert_to_static(_tensor_if)
+    jf = jax.jit(f)
+    np.testing.assert_allclose(jf(jnp.array([1.0, 2.0])), [2.0, 4.0])
+    np.testing.assert_allclose(jf(jnp.array([-3.0, 1.0])), [3.0, -1.0])
+
+
+def _tensor_while(x):
+    s = x * 0.0
+    n = x.sum() * 0
+    while n < 4:
+        s = s + x
+        n = n + 1
+    return s
+
+
+def test_tensor_while_under_jit():
+    f = convert_to_static(_tensor_while)
+    np.testing.assert_allclose(jax.jit(f)(jnp.array([1.0, 0.5])), [4.0, 2.0])
+
+
+def _nested(x):
+    if x.sum() > 0:
+        if x.sum() > 10:
+            y = x * 100
+        else:
+            y = x * 2
+    else:
+        y = -x
+    return y
+
+
+def test_nested_tensor_if():
+    jf = jax.jit(convert_to_static(_nested))
+    np.testing.assert_allclose(jf(jnp.array([20.0])), [2000.0])
+    np.testing.assert_allclose(jf(jnp.array([1.0])), [2.0])
+    np.testing.assert_allclose(jf(jnp.array([-3.0])), [3.0])
+
+
+def _with_return_inside(x):
+    if x.sum() > 0:
+        return x * 2
+    return -x
+
+
+def test_return_in_branch_left_as_python():
+    # return inside the branch → untransformed (Python semantics retained for
+    # concrete preds; documented subset restriction)
+    f = convert_to_static(_with_return_inside)
+    x = paddle.to_tensor(np.array([1.0], "float32"))
+    np.testing.assert_allclose(np.asarray(f(x).value), [2.0])
+
+
+def _layer_forward_cond():
+    from paddle_tpu import nn
+
+    class Gated(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                out = h * 2
+            else:
+                out = h * 0.5
+            return out
+
+    return Gated()
+
+
+def test_to_static_layer_with_tensor_if():
+    from paddle_tpu.jit import to_static
+
+    paddle.seed(0)
+    m = _layer_forward_cond()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype("float32"))
+    eager = m(x)  # eager: concrete pred, Python path
+    ms = to_static(m)
+    static = ms(x)  # jitted: traced pred, lax.cond path
+    np.testing.assert_allclose(np.asarray(static.value), np.asarray(eager.value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _global_in_branch(x):
+    if x.sum() > 0:
+        y = jnp.abs(x)  # module-level global referenced inside the branch
+    else:
+        y = x
+    return y
+
+
+def test_branch_referencing_module_global():
+    f = convert_to_static(_global_in_branch)
+    np.testing.assert_allclose(jax.jit(f)(jnp.array([1.0, -2.0])), [1.0, -2.0])
+    np.testing.assert_allclose(jax.jit(f)(jnp.array([1.0, 2.0])), [1.0, 2.0])
+
+
+def _comp_in_branch(x, parts):
+    y = x * 0
+    if x.sum() > 0:
+        y = sum([p.sum() for p in parts]) + y  # comp target is scope-local
+    return y
+
+
+def test_comprehension_target_not_treated_as_store():
+    f = convert_to_static(_comp_in_branch)
+    parts = (jnp.array([1.0]), jnp.array([2.0]))
+    np.testing.assert_allclose(jax.jit(lambda x: f(x, parts))(jnp.array([3.0])),
+                               [3.0])
+
+
+def test_c_ops_inplace_writeback():
+    from paddle_tpu import _C_ops
+
+    t = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
+    out = _C_ops.relu_(t)
+    np.testing.assert_allclose(np.asarray(t.value), [0.0, 2.0])
+    assert out is t
+
+
+def test_tensor_array_stack_hole_raises():
+    from paddle_tpu.framework import TensorArray
+
+    t = paddle.to_tensor(np.ones((2,), "float32"))
+    ta = TensorArray()
+    ta.write(0, t)
+    ta.write(2, t)
+    try:
+        ta.stack()
+        raise AssertionError("expected IndexError for unwritten slot")
+    except IndexError:
+        pass
