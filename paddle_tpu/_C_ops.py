@@ -36,8 +36,9 @@ def _inplace(fn):
         from .framework.core import Tensor
 
         out = fn(x, *args, **kwargs)
-        if isinstance(x, Tensor) and isinstance(out, Tensor) \
-                and out.value.shape == x.value.shape:
+        if isinstance(x, Tensor) and isinstance(out, Tensor):
+            # shape-changing inplace ops (reshape_, squeeze_, ...) mutate the
+            # same tensor in the reference, so write back unconditionally
             x._value = out.value
             return x
         return out

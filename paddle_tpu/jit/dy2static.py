@@ -296,6 +296,36 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
     visit_ClassDef = visit_FunctionDef
     visit_Lambda = lambda self, node: node  # noqa: E731
 
+    def visit_BoolOp(self, node):
+        """`a and b` / `a or b` → convert_logical_and/or(lambda: a, lambda: b)
+        — lazy lambdas preserve short-circuiting for concrete values; traced
+        values route to jnp.logical_and/or instead of bool() (which raises)."""
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                                    kwonlyargs=[], kw_defaults=[],
+                                                    defaults=[]), body=out),
+                      ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                                    kwonlyargs=[], kw_defaults=[],
+                                                    defaults=[]), body=rhs)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                               attr="convert_logical_not", ctx=ast.Load()),
+            args=[node.operand], keywords=[])
+
     def _make_branch_fn(self, name, body, tracked):
         # unpack with explicit global fallback: any assignment makes the name
         # function-local (so a bare conditional unpack would shadow imports /

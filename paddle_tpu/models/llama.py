@@ -209,6 +209,40 @@ class LlamaAttention(Layer):
             out = shard_constraint(out, P("data", "sep", None))
         return out
 
+    def decode(self, x, cos, sin, ck, cv, pos):
+        """Single-token decode with a fixed-size KV cache: write the new
+        K/V at ``pos`` via dynamic_update_slice (static shapes, so the whole
+        generate loop compiles once) and attend over positions ≤ pos.
+        ck/cv: Tensors (B, L, KV, D); pos: traced int32 scalar."""
+        B = x.shape[0]
+        H, KV, D = self.num_heads, self.num_kv_heads, self.head_dim
+        q = reshape(self.q_proj(x), [B, 1, H, D])
+        k = reshape(self.k_proj(x), [B, 1, KV, D])
+        v = reshape(self.v_proj(x), [B, 1, KV, D])
+
+        def step(qv, kv, vv, ckv, cvv, cosv, sinv):
+            qr = _apply_rope(qv, cosv, sinv, pos)
+            kr = _apply_rope(kv, cosv, sinv, pos)
+            ckv = jax.lax.dynamic_update_slice(ckv, kr.astype(ckv.dtype),
+                                               (0, pos, 0, 0))
+            cvv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                               (0, pos, 0, 0))
+            rep = H // KV
+            kx = jnp.repeat(ckv, rep, axis=2) if rep > 1 else ckv
+            vx = jnp.repeat(cvv, rep, axis=2) if rep > 1 else cvv
+            L = ckv.shape[1]
+            scores = jnp.einsum("bshd,bthd->bhst", qr, kx).astype(jnp.float32) \
+                / math.sqrt(D)
+            mask = (jnp.arange(L) <= pos)[None, None, None, :]
+            scores = jnp.where(mask, scores, -1e30)
+            p = jax.nn.softmax(scores, -1).astype(qr.dtype)
+            return jnp.einsum("bhst,bthd->bshd", p, vx), ckv, cvv
+
+        out, ck, cv = apply_op(step, q, k, v, ck, cv, Tensor(cos), Tensor(sin),
+                               op_name="decode_attention")
+        out = reshape(out, [B, 1, H * D])
+        return self.o_proj(out), ck, cv
+
 
 class LlamaMLP(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -250,6 +284,13 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out
 
+    def decode(self, x, cos, sin, ck, cv, pos):
+        a, ck, cv = self.self_attn.decode(self.input_layernorm(x), cos, sin,
+                                          ck, cv, pos)
+        h = x + a
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, ck, cv
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -283,6 +324,16 @@ class LlamaModel(Layer):
             else:
                 x = layer(x, self._cos, self._sin, cache, pos_offset)
         return self.norm(x)
+
+    def decode_step(self, token, caches, pos):
+        """token: Tensor (B, 1) int; caches: list of (ck, cv) Tensors per
+        layer; pos: traced int32 scalar. Returns (normed hidden, new caches)."""
+        x = self.embed_tokens(token)
+        new = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.decode(x, self._cos, self._sin, ck, cv, pos)
+            new.append((ck, cv))
+        return self.norm(x), new
 
     def _should_recompute(self):
         from ..framework.core import is_grad_enabled
@@ -329,6 +380,109 @@ class LlamaForCausalLM(Layer):
     def loss_fn(self, logits, labels):
         """Next-token CE with fp32 softmax (ParallelCrossEntropy math)."""
         return F.cross_entropy(logits, labels, reduction="mean")
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id: Optional[int] = None):
+        """Autoregressive generation with a compiled single-token decode loop
+        (PaddleNLP `model.generate` surface; greedy when temperature == 0).
+
+        TPU-native design: fixed-size KV caches (B, P+N, KV, D) updated via
+        dynamic_update_slice, one lax.scan over P+N-1 steps covering prefill
+        and decode uniformly — the whole loop is ONE compiled program, no
+        per-step dispatch and no dynamic shapes. Returns (B, P+N) int32 of
+        prompt + generated tokens.
+        """
+        import numpy as _np
+
+        from ..framework.core import to_array
+        from ..jit import functional_call, state_values
+
+        ids = _np.asarray(to_array(input_ids))
+        B, P = ids.shape
+        L = P + max_new_tokens
+        cfg = self.cfg
+        if L > cfg.max_position_embeddings:
+            raise ValueError(f"prompt+new tokens {L} exceeds "
+                             f"max_position_embeddings {cfg.max_position_embeddings}")
+        kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        from ..framework.dtype import convert_dtype
+
+        cdtype = convert_dtype(cfg.dtype)
+        params = state_values(self)
+        model = self
+
+        def run_one(p, tok, flat_caches, pos):
+            caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
+                      for i in range(cfg.num_hidden_layers)]
+
+            def call():
+                h, new = model.model.decode_step(Tensor(tok), caches, pos)
+                if cfg.tie_word_embeddings:
+                    logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                                      model.model.embed_tokens.weight)
+                else:
+                    logits = model.lm_head(h)
+                return logits, new
+
+            logits, new = functional_call(model, p, call_fn=lambda: call())
+            flat = []
+            for ck, cv in new:
+                flat += [ck.value, cv.value]
+            return logits.value[:, 0], flat
+
+        def gen_fn(p, prompt, rng):
+            caches = []
+            for _ in range(cfg.num_hidden_layers):
+                caches += [jnp.zeros((B, L, kv, d), cdtype),
+                           jnp.zeros((B, L, kv, d), cdtype)]
+            toks = jnp.concatenate(
+                [prompt, jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+            done = jnp.zeros((B,), bool)
+
+            def body(carry, t):
+                toks, caches, done, rng = carry
+                tok = jax.lax.dynamic_slice_in_dim(toks, t, 1, 1)
+                logits, caches = run_one(p, tok, caches, t)
+                if temperature and temperature > 0:
+                    rng, sub = jax.random.split(rng)
+                    lg = logits.astype(jnp.float32) / temperature
+                    if top_k and top_k > 0:
+                        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                        lg = jnp.where(lg < kth, -1e30, lg)
+                    nxt = jax.random.categorical(sub, lg, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                # within the prompt, the "next" token is the given one
+                given = t + 1 < P
+                cur = jax.lax.dynamic_slice_in_dim(toks, jnp.minimum(t + 1, L - 1),
+                                                   1, 1)[:, 0]
+                nxt = jnp.where(given, cur, nxt)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = done | ((nxt == eos_token_id) & ~given)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, nxt[:, None], (0, jnp.minimum(t + 1, L - 1)))
+                return (toks, caches, done, rng), None
+
+            (toks, _, _, _), _ = jax.lax.scan(
+                body, (toks, caches, done, rng), jnp.arange(L - 1))
+            return toks
+
+        # jit caches by function identity — cache the compiled loop per
+        # static generation config so repeat calls don't recompile
+        key = (B, P, max_new_tokens, float(temperature or 0.0), int(top_k or 0),
+               eos_token_id)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key not in cache:
+            cache[key] = jax.jit(gen_fn)
+        rng = jax.random.PRNGKey(seed)
+        out = cache[key](params, jnp.asarray(ids, jnp.int32), rng)
+        return Tensor(out)
 
 
 def llama_pretrain_loss(model: LlamaForCausalLM, input_ids, labels):

@@ -60,7 +60,10 @@ class ParallelEngine:
     def __init__(self, model, optimizer=None, loss_fn: Optional[Callable] = None,
                  mesh: Optional[Mesh] = None, fsdp: bool = False, remat: bool = False,
                  remat_policy: Optional[str] = "dots", batch_spec: Any = P("data"),
-                 donate: bool = True):
+                 donate: bool = True, abstract: bool = False):
+        """abstract=True keeps params/opt-state as ShapeDtypeStructs — the
+        step can be .lower()ed (AOT partitioning validation at any scale)
+        but not executed."""
         from ..distributed.collective import get_global_mesh
 
         self.model = model
@@ -75,6 +78,7 @@ class ParallelEngine:
         self.remat_policy = remat_policy
         self.batch_spec = batch_spec
         self._donate = donate
+        self._abstract = abstract
         self._build_state()
         self._train_step = None
         self._eval_step = None
@@ -89,10 +93,35 @@ class ParallelEngine:
         self._spmd = mesh.size > 1
         self.specs = param_specs(self.model, mesh, fsdp=self.fsdp)
         vals = state_values(self.model)
+        self._trainable = {name for name, p in self.model.named_parameters()
+                           if p.trainable}
+        if self._abstract:
+            self.params = {
+                name: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=_sharding_of(mesh, self.specs.get(name, P())))
+                for name, v in vals.items()
+            }
+            if self.optimizer is not None:
+                train = {n: v for n, v in self.params.items()
+                         if n in self._trainable}
+                st = jax.eval_shape(self.optimizer.init_state, train)
+                # re-attach shardings per owning param
+                self.opt_state = {
+                    n: {k: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype,
+                        sharding=_sharding_of(mesh, self.specs.get(n, P())))
+                        for k, s in slots.items()}
+                    for n, slots in st.items()
+                }
+            else:
+                self.opt_state = {}
+            return
         if not self._spmd:
-            self.params = dict(vals)
-            self._trainable = {name for name, p in self.model.named_parameters()
-                               if p.trainable}
+            # copy: self.params gets donated every step; aliasing the model's
+            # live Parameter buffers would invalidate eager use of the model
+            # (model(x), p.value) until sync_to_model
+            self.params = {name: jnp.copy(v) for name, v in vals.items()}
             self.opt_state = (self.optimizer.init_state(
                 {n: v for n, v in self.params.items() if n in self._trainable})
                 if self.optimizer is not None else {})
@@ -101,8 +130,6 @@ class ParallelEngine:
             name: jax.device_put(v, _sharding_of(mesh, self.specs.get(name, P())))
             for name, v in vals.items()
         }
-        self._trainable = {name for name, p in self.model.named_parameters()
-                           if p.trainable}
         if self.optimizer is not None:
             train_params = {n: v for n, v in self.params.items() if n in self._trainable}
             state = self.optimizer.init_state(train_params)

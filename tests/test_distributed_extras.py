@@ -231,3 +231,43 @@ def test_save_load_persistables_roundtrip(_static_mode, tmp_path):
     for k, v in saved.items():
         np.testing.assert_allclose(np.asarray(scope.store[k]), v)
         assert dist_io.is_persistable(main.params[k])
+
+
+def test_abstract_engine_lowering():
+    """ParallelEngine(abstract=True): params/opt-state stay ShapeDtypeStructs
+    and the sharded train step lowers + GSPMD-compiles without allocating
+    (the tools/validate_70b_4d.py mechanism, scaled down)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=16,
+                      dtype="float32", use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "sharding", "tensor"))
+    opt = AdamW(learning_rate=1e-4, parameters=m.parameters())
+    eng = ParallelEngine(m, optimizer=opt, loss_fn=None, mesh=mesh,
+                         fsdp=True, abstract=True)
+    assert isinstance(next(iter(eng.params.values())), jax.ShapeDtypeStruct)
+    step = eng.build_train_step()
+    ids = jax.ShapeDtypeStruct((4, 8), jnp.int32,
+                               sharding=NamedSharding(mesh, P("data", None)))
+    lbl = jax.ShapeDtypeStruct((4, 8), jnp.int64,
+                               sharding=NamedSharding(mesh, P("data", None)))
+    sc = jax.ShapeDtypeStruct((), jnp.int32)
+    lowered = step.lower(eng.params, eng.opt_state, sc, 1e-4, (ids, lbl))
+    txt = lowered.as_text()
+    assert txt.count("sdy.sharding") + txt.count("mhlo.sharding") > 0
+    compiled = lowered.compile()
+    assert compiled is not None

@@ -175,3 +175,32 @@ def test_tensor_array_stack_hole_raises():
         raise AssertionError("expected IndexError for unwritten slot")
     except IndexError:
         pass
+
+
+def _boolop(x, y):
+    if x.sum() > 0 and y.sum() > 0:
+        z = x * 10
+    else:
+        z = x * 100
+    return z
+
+
+def test_boolop_over_tensor_predicates():
+    f = jax.jit(convert_to_static(_boolop))
+    np.testing.assert_allclose(f(jnp.array([1.0]), jnp.array([2.0])), [10.0])
+    np.testing.assert_allclose(f(jnp.array([1.0]), jnp.array([-2.0])), [100.0])
+    np.testing.assert_allclose(f(jnp.array([-1.0]), jnp.array([2.0])), [-100.0])
+
+
+def _notop(x):
+    if not (x.sum() > 0):
+        z = x * 10
+    else:
+        z = x * 100
+    return z
+
+
+def test_not_over_tensor_predicate():
+    f = jax.jit(convert_to_static(_notop))
+    np.testing.assert_allclose(f(jnp.array([-1.0])), [-10.0])
+    np.testing.assert_allclose(f(jnp.array([1.0])), [100.0])

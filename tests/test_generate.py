@@ -1,0 +1,64 @@
+"""Autoregressive generation (LlamaForCausalLM.generate): compiled scan
+decode with fixed-size KV caches. The key invariant: greedy decode's first
+generated token equals argmax of the training forward's last-position
+logits — which exercises RoPE positions, cache writes, and masking against
+the independently-implemented training path."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny(vocab=61):
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=32,
+                      dtype="float32", use_flash_attention=False)
+    return LlamaForCausalLM(cfg)
+
+
+def test_greedy_matches_forward_argmax():
+    m = _tiny()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 61, (2, 6)).astype("int32"))
+    out = np.asarray(m.generate(ids, max_new_tokens=4).value)
+    assert out.shape == (2, 10)
+    np.testing.assert_array_equal(out[:, :6], np.asarray(ids.value))
+    expect = np.asarray(m(ids).value)[:, -1].argmax(-1)
+    np.testing.assert_array_equal(out[:, 6], expect)
+
+
+def test_greedy_multi_step_matches_incremental_forward():
+    """Every generated token must equal re-running the full forward on the
+    sequence so far (cache correctness across steps)."""
+    m = _tiny()
+    rng = np.random.RandomState(1)
+    ids = np.asarray(rng.randint(0, 61, (1, 5)).astype("int32"))
+    out = np.asarray(m.generate(paddle.to_tensor(ids), max_new_tokens=3).value)
+    seq = ids.copy()
+    for t in range(3):
+        logits = np.asarray(m(paddle.to_tensor(seq)).value)
+        nxt = logits[:, -1].argmax(-1).astype("int32")
+        assert out[0, 5 + t] == nxt[0], f"step {t} diverged"
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+
+
+def test_sampling_and_eos():
+    m = _tiny()
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(rng.randint(0, 61, (2, 4)).astype("int32"))
+    s1 = np.asarray(m.generate(ids, max_new_tokens=5, temperature=0.9,
+                               top_k=7, seed=3).value)
+    s2 = np.asarray(m.generate(ids, max_new_tokens=5, temperature=0.9,
+                               top_k=7, seed=3).value)
+    np.testing.assert_array_equal(s1, s2)  # same seed → deterministic
+    assert (s1[:, 4:] < 61).all() and (s1[:, 4:] >= 0).all()
+    # eos: once emitted, the rest of the row is eos
+    first = np.asarray(m(ids).value)[:, -1].argmax(-1)
+    out = np.asarray(m.generate(ids, max_new_tokens=6,
+                                eos_token_id=int(first[0])).value)
+    row = out[0, 4:]
+    hit = np.where(row == int(first[0]))[0]
+    if len(hit):
+        assert (row[hit[0]:] == int(first[0])).all()

@@ -1,0 +1,76 @@
+"""Decode (generation) throughput benchmark for the flagship Llama model.
+
+Measures single-chip autoregressive tokens/s through
+LlamaForCausalLM.generate's compiled scan loop — the serving-side
+counterpart of bench.py's training MFU. Decode is HBM-bandwidth-bound
+(params re-read per token), so the roofline is
+bandwidth / params_bytes tokens/s; the report includes that ceiling.
+
+Usage: python tools/decode_benchmark.py [--new 128] [--batch 8]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=args.prompt + args.new,
+                          dtype="bfloat16", use_flash_attention=True)
+        hbm_bw = 819e9  # v5e
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2,
+                          max_position_embeddings=args.prompt + args.new,
+                          dtype="float32", use_flash_attention=False)
+        hbm_bw = 0
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (args.batch, args.prompt)).astype("int32"))
+
+    out = model.generate(ids, max_new_tokens=args.new)  # compile + run
+    jax.block_until_ready(out.value)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=args.new, seed=1)
+    jax.block_until_ready(out.value)
+    dt = time.perf_counter() - t0
+
+    steps = args.prompt + args.new - 1
+    tps = args.batch * steps / dt
+    line = {"metric": "llama_decode_tokens_per_sec_1chip",
+            "value": round(tps, 1),
+            "unit": f"tok/s (B={args.batch}, {steps} steps, "
+                    f"params={n_params/1e6:.0f}M)"}
+    if hbm_bw:
+        ceiling = hbm_bw / (2.0 * n_params) * args.batch  # bf16 params
+        line["roofline_tok_s"] = round(ceiling, 1)
+    import json
+
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()
