@@ -1,0 +1,115 @@
+"""Generic autoregressive generation for causal LMs (PaddleNLP
+`model.generate` surface).
+
+Model-agnostic strategy: keep a fixed (B, L) token buffer and, per step,
+re-run the FULL causal forward on the buffer, reading logits at the current
+position — causal masking guarantees positions ≤ t ignore the padding
+beyond t, so no KV-cache plumbing is needed. The loop is one lax.scan, so
+the whole generation compiles once; cost is O(L) forwards of length L
+(fine for short-to-medium generations; models with a cached decode path,
+e.g. Llama, override generate with the O(L) cached version).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def next_token(logits, rng, temperature: float, top_k: int):
+    """Sample/argmax one token per row from (B, V) logits. Shared by every
+    generate implementation so sampling semantics can't drift."""
+    if temperature and temperature > 0:
+        rng, sub = jax.random.split(rng)
+        lg = logits.astype(jnp.float32) / temperature
+        if top_k and top_k > 0:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -1e30, lg)
+        return jax.random.categorical(sub, lg, axis=-1).astype(jnp.int32), rng
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+
+
+def advance_tokens(toks, done, nxt, t, prompt_len: int, total_len: int,
+                   eos_token_id: Optional[int]):
+    """Write the step-t output token into the buffer: within the prompt the
+    'next' token is the given one (teacher forcing); after eos, keep
+    emitting eos."""
+    given = t + 1 < prompt_len
+    at = jnp.minimum(t + 1, total_len - 1)
+    cur = jax.lax.dynamic_slice_in_dim(toks, at, 1, 1)[:, 0]
+    nxt = jnp.where(given, cur, nxt)
+    if eos_token_id is not None:
+        nxt = jnp.where(done, eos_token_id, nxt)
+        done = done | ((nxt == eos_token_id) & ~given)
+    toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, at))
+    return toks, done
+
+
+class GenerationMixin:
+    """Mixin for Layer models whose forward(input_ids) returns logits
+    (B, S, V) with causal semantics."""
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id: Optional[int] = None):
+        import numpy as _np
+
+        from ..framework.core import Tensor, to_array
+        from ..jit import functional_call, state_values
+
+        ids = _np.asarray(to_array(input_ids))
+        B, P = ids.shape
+        L = P + max_new_tokens
+        max_pos = getattr(getattr(self, "cfg", None), "max_position_embeddings",
+                          None)
+        if max_pos is not None and L > max_pos:
+            raise ValueError(f"prompt+new tokens {L} exceeds "
+                             f"max_position_embeddings {max_pos}")
+        params = state_values(self)
+        model = self
+
+        def logits_at(p, toks, t):
+            out = functional_call(model, p, Tensor(toks))
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            row = jax.lax.dynamic_slice_in_dim(out.value, t, 1, 1)
+            return row[:, 0]
+
+        def gen_fn(p, prompt, rng):
+            toks = jnp.concatenate(
+                [prompt, jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+            done = jnp.zeros((B,), bool)
+
+            def body(carry, t):
+                toks, done, rng = carry
+                logits = logits_at(p, toks, t)
+                nxt, rng = next_token(logits, rng, temperature, top_k)
+                toks, done = advance_tokens(toks, done, nxt, t, P, L,
+                                            eos_token_id)
+                return (toks, done, rng), None
+
+            # no KV cache here, every step re-reads the full buffer — so the
+            # prompt needs no warm-up iterations; start at the last prompt
+            # position instead of 0
+            (toks, _, _), _ = jax.lax.scan(body, (toks, done, rng),
+                                           jnp.arange(P - 1, L - 1))
+            return toks
+
+        key = (B, P, max_new_tokens, float(temperature or 0.0),
+               int(top_k or 0), eos_token_id)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key not in cache:
+            cache[key] = jax.jit(gen_fn)
+        was_training = getattr(self, "training", False)
+        self.eval()  # dropout etc. must be off — a traced dropout key would
+        try:         # leak into the global RNG state
+            out = cache[key](params, jnp.asarray(ids, jnp.int32),
+                             jax.random.PRNGKey(seed))
+        finally:
+            if was_training:
+                self.train()
+        from ..framework.core import Tensor as T
+
+        return T(out)

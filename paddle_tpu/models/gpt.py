@@ -13,6 +13,7 @@ from ..framework.dispatch import apply_op
 from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..nn.layer_base import Layer
+from .generation import GenerationMixin
 from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import LayerNorm
@@ -97,7 +98,7 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg

@@ -228,15 +228,16 @@ class LlamaAttention(Layer):
             cvv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
                                                (0, pos, 0, 0))
             rep = H // KV
-            kx = jnp.repeat(ckv, rep, axis=2) if rep > 1 else ckv
-            vx = jnp.repeat(cvv, rep, axis=2) if rep > 1 else cvv
             L = ckv.shape[1]
-            scores = jnp.einsum("bshd,bthd->bhst", qr, kx).astype(jnp.float32) \
-                / math.sqrt(D)
-            mask = (jnp.arange(L) <= pos)[None, None, None, :]
+            # GQA-native: group q heads by kv head — no L-sized cache copies
+            qg = qr.reshape(B, 1, KV, rep, D)
+            scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ckv).astype(
+                jnp.float32) / math.sqrt(D)
+            mask = (jnp.arange(L) <= pos)[None, None, None, None, :]
             scores = jnp.where(mask, scores, -1e30)
             p = jax.nn.softmax(scores, -1).astype(qr.dtype)
-            return jnp.einsum("bhst,bthd->bshd", p, vx), ckv, cvv
+            out = jnp.einsum("bgrst,btgd->bsgrd", p, cvv)
+            return out.reshape(B, 1, H, D), ckv, cvv
 
         out, ck, cv = apply_op(step, q, k, v, ck, cv, Tensor(cos), Tensor(sin),
                                op_name="decode_attention")
@@ -442,29 +443,14 @@ class LlamaForCausalLM(Layer):
             done = jnp.zeros((B,), bool)
 
             def body(carry, t):
+                from .generation import advance_tokens, next_token
+
                 toks, caches, done, rng = carry
                 tok = jax.lax.dynamic_slice_in_dim(toks, t, 1, 1)
                 logits, caches = run_one(p, tok, caches, t)
-                if temperature and temperature > 0:
-                    rng, sub = jax.random.split(rng)
-                    lg = logits.astype(jnp.float32) / temperature
-                    if top_k and top_k > 0:
-                        kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                        lg = jnp.where(lg < kth, -1e30, lg)
-                    nxt = jax.random.categorical(sub, lg, axis=-1)
-                else:
-                    nxt = jnp.argmax(logits, axis=-1)
-                nxt = nxt.astype(jnp.int32)
-                # within the prompt, the "next" token is the given one
-                given = t + 1 < P
-                cur = jax.lax.dynamic_slice_in_dim(toks, jnp.minimum(t + 1, L - 1),
-                                                   1, 1)[:, 0]
-                nxt = jnp.where(given, cur, nxt)
-                if eos_token_id is not None:
-                    nxt = jnp.where(done, eos_token_id, nxt)
-                    done = done | ((nxt == eos_token_id) & ~given)
-                toks = jax.lax.dynamic_update_slice(
-                    toks, nxt[:, None], (0, jnp.minimum(t + 1, L - 1)))
+                nxt, rng = next_token(logits, rng, temperature, top_k)
+                toks, done = advance_tokens(toks, done, nxt, t, P, L,
+                                            eos_token_id)
                 return (toks, caches, done, rng), None
 
             (toks, _, _, _), _ = jax.lax.scan(
@@ -480,8 +466,14 @@ class LlamaForCausalLM(Layer):
             cache = self._gen_cache = {}
         if key not in cache:
             cache[key] = jax.jit(gen_fn)
-        rng = jax.random.PRNGKey(seed)
-        out = cache[key](params, jnp.asarray(ids, jnp.int32), rng)
+        was_training = self.training
+        self.eval()  # keep stochastic layers off under the trace
+        try:
+            out = cache[key](params, jnp.asarray(ids, jnp.int32),
+                             jax.random.PRNGKey(seed))
+        finally:
+            if was_training:
+                self.train()
         return Tensor(out)
 
 

@@ -62,3 +62,23 @@ def test_sampling_and_eos():
     hit = np.where(row == int(first[0]))[0]
     if len(hit):
         assert (row[hit[0]:] == int(first[0])).all()
+
+
+def test_gpt_generate_via_mixin():
+    """GPT uses the generic padded-reforward GenerationMixin (no KV cache
+    plumbing); greedy first token must match the forward argmax."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=32)
+    m = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 61, (2, 6)).astype("int32"))
+    out = np.asarray(m.generate(ids, max_new_tokens=4).value)
+    assert out.shape == (2, 10)
+    np.testing.assert_array_equal(out[:, :6], np.asarray(ids.value))
+    m.eval()
+    expect = np.asarray(m(ids).value)[:, -1].argmax(-1)
+    np.testing.assert_array_equal(out[:, 6], expect)
