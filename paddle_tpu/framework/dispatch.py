@@ -12,15 +12,100 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Sequence
 
+import functools
+import weakref
+
 import jax
 import jax.numpy as jnp
-import weakref
 
 from .core import Tensor, TapeNode, is_grad_enabled, to_array
 from .dtype import is_floating_point
 from .flags import GLOBAL_FLAGS
 
 _static_graph = None  # lazily bound paddle_tpu.static.graph module
+
+# --------------------------------------------------------------------------- #
+# cached eager autograd: jax.vjp re-traces the op's Python body on EVERY
+# eager call (the dominant cost of eager training loops). For closure-free
+# op functions the traced (out, vjp) pair is compiled once per
+# (fn, arg-structure, kwargs) — jax.vjp's VJP closure is a pytree, so a
+# jitted wrapper can return it, and a shared jitted applier replays the
+# backward without retracing. Functions with closures are excluded: they may
+# capture per-call state (dropout keys, loop indices), which a cached trace
+# would freeze.
+# --------------------------------------------------------------------------- #
+
+_FWD_JIT_CACHE: dict = {}
+_FWD_JIT_CACHE_MAX = 1024
+_BWD_JIT = None
+
+
+def _hashable(x) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+# module-global entry points whose values change per call (PRNG draws, flag
+# reads): a cached trace would freeze their first value forever. Functions
+# whose code references any of these names are never cached.
+_IMPURE_NAMES = frozenset({"next_key", "default_generator", "get_rng_state",
+                           "GLOBAL_FLAGS", "get_flags"})
+
+
+def _cached_fwd(fn, n_args, diff_idx, arr_pos, statics, kwargs):
+    # key on the CODE object: closure-free functions defined per call (the
+    # common `def f(...)` inside a layer's forward) share code but not
+    # identity — keying on the object would compile a fresh executable every
+    # call. Same code + same defaults + no closure ⇒ same behavior, PROVIDED
+    # the body doesn't read per-call mutable globals (checked via co_names).
+    code = fn.__code__
+    if _IMPURE_NAMES & set(code.co_names):
+        return None
+    defaults = getattr(fn, "__defaults__", None)
+    if defaults is not None and not all(_hashable(d) for d in defaults):
+        return None
+    kwdefaults = getattr(fn, "__kwdefaults__", None)
+    kwdefaults = tuple(sorted(kwdefaults.items())) if kwdefaults else None
+    if kwdefaults is not None and not _hashable(kwdefaults):
+        return None
+    key = (code, defaults, kwdefaults, n_args, diff_idx, arr_pos, statics,
+           tuple(sorted(kwargs.items())))
+    entry = _FWD_JIT_CACHE.get(key)
+    if entry is None:
+        if len(_FWD_JIT_CACHE) >= _FWD_JIT_CACHE_MAX:
+            # evict one (FIFO) — clearing everything would trigger a full
+            # recompilation storm for every hot op
+            _FWD_JIT_CACHE.pop(next(iter(_FWD_JIT_CACHE)))
+
+        def wrapper(*arrs):
+            full = [None] * n_args
+            for p, a in zip(arr_pos, arrs):
+                full[p] = a
+            for p, v in statics:
+                full[p] = v
+
+            def f_diff(*dvals):
+                ff = list(full)
+                for i, v in zip(diff_idx, dvals):
+                    ff[i] = v
+                return fn(*ff, **dict(kwargs))
+
+            return jax.vjp(f_diff, *(full[i] for i in diff_idx))
+
+        entry = jax.jit(wrapper)
+        _FWD_JIT_CACHE[key] = entry
+    return entry
+
+
+def _bwd_apply(vjp_fn, cts):
+    """Replay a cached VJP under a shared jit so backward doesn't retrace."""
+    global _BWD_JIT
+    if _BWD_JIT is None:
+        _BWD_JIT = jax.jit(lambda v, c: v(c))
+    return _BWD_JIT(vjp_fn, cts)
 
 
 def _check_nan_inf(name, arrays):
@@ -84,13 +169,31 @@ def apply_op(fn: Callable, *args, n_outputs: Optional[int] = None, op_name: str 
     record = is_grad_enabled() and len(diff_idx) > 0
 
     if record:
-        def f(*dvals):
-            full = list(raw)
-            for i, v in zip(diff_idx, dvals):
-                full[i] = v
-            return fn(*full, **kwargs)
+        cached = None
+        if getattr(fn, "__closure__", True) is None:
+            arr_pos, statics = [], []
+            for i, a in enumerate(raw):
+                if hasattr(a, "shape") and hasattr(a, "dtype"):
+                    arr_pos.append(i)
+                elif _hashable(a):
+                    statics.append((i, a))
+                else:
+                    arr_pos = None
+                    break
+            if arr_pos is not None and all(_hashable(v) for v in kwargs.values()):
+                cached = _cached_fwd(fn, len(raw), tuple(diff_idx),
+                                     tuple(arr_pos), tuple(statics), kwargs)
+        if cached is not None:
+            out, raw_vjp = cached(*(raw[i] for i in arr_pos))
+            vjp_fn = functools.partial(_bwd_apply, raw_vjp)
+        else:
+            def f(*dvals):
+                full = list(raw)
+                for i, v in zip(diff_idx, dvals):
+                    full[i] = v
+                return fn(*full, **kwargs)
 
-        out, vjp_fn = jax.vjp(f, *(raw[i] for i in diff_idx))
+            out, vjp_fn = jax.vjp(f, *(raw[i] for i in diff_idx))
     else:
         out = fn(*raw, **kwargs)
 

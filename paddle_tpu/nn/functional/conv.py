@@ -42,41 +42,49 @@ def _dimnums(n, channel_last):
     return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
 
 
+def _conv_fn(v, w, *b, n, channel_last, stride, pad, dilation, groups):
+    """Closure-free conv kernel fn: config arrives as hashable kwargs so the
+    cached-vjp dispatch (framework/dispatch.py) can compile it once per
+    (shape, config) instead of retracing every eager call."""
+    # weight always [out, in/groups, *k] (paddle layout); convert per spec
+    if n == 1:
+        wj = w.transpose(2, 1, 0) if channel_last else w
+    elif n == 2:
+        wj = w.transpose(2, 3, 1, 0) if channel_last else w
+    else:
+        wj = w.transpose(2, 3, 4, 1, 0) if channel_last else w
+    lhs_spec, rhs_spec, out_spec = _dimnums(n, channel_last)
+    out = jax.lax.conv_general_dilated(
+        v, wj,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(v.dtype)
+    if b:
+        bias_shape = [1] * out.ndim
+        bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+        out = out + b[0].reshape(bias_shape)
+    return out
+
+
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
     channel_last = data_format in ("NLC", "NHWC", "NDHWC")
-    stride = _tup(stride, n)
-    dilation = _tup(dilation, n)
-    pad = _pad_spec(padding, n)
-    lhs_spec, _, out_spec = _dimnums(n, channel_last)
-
-    def f(v, w, *b):
-        # weight always [out, in/groups, *k] (paddle layout); convert per spec
-        if n == 1:
-            wj = w.transpose(2, 1, 0) if channel_last else w
-        elif n == 2:
-            wj = w.transpose(2, 3, 1, 0) if channel_last else w
-        else:
-            wj = w.transpose(2, 3, 4, 1, 0) if channel_last else w
-        rhs_spec = _dimnums(n, channel_last)[1]
-        out = jax.lax.conv_general_dilated(
-            v, wj,
-            window_strides=stride,
-            padding=pad,
-            rhs_dilation=dilation,
-            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
-        )
-        out = out.astype(v.dtype)
-        if b:
-            bias_shape = [1] * out.ndim
-            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
-            out = out + b[0].reshape(bias_shape)
-        return out
-
+    kw = dict(n=n, channel_last=channel_last, stride=_tup(stride, n),
+              pad=_hashable_pad(_pad_spec(padding, n)),
+              dilation=_tup(dilation, n), groups=groups)
     if bias is None:
-        return apply_op(f, x, weight, op_name=f"conv{n}d")
-    return apply_op(f, x, weight, bias, op_name=f"conv{n}d")
+        return apply_op(_conv_fn, x, weight, op_name=f"conv{n}d", **kw)
+    return apply_op(_conv_fn, x, weight, bias, op_name=f"conv{n}d", **kw)
+
+
+def _hashable_pad(pad):
+    if isinstance(pad, list):
+        return tuple(tuple(p) if isinstance(p, (list, tuple)) else p for p in pad)
+    return pad
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

@@ -1,0 +1,76 @@
+"""Cached eager autograd (framework/dispatch.py): closure-free op functions
+compile their vjp once per (code, structure, kwargs); impure ops (PRNG
+readers) and closures are excluded."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.framework.dispatch as D
+from paddle_tpu.framework.dispatch import apply_op
+
+
+def _op_static_scale(v, w, *, scale=2.0):
+    return (v * w) * scale
+
+
+def test_cache_hits_for_per_call_defs():
+    """Functions with identical code defined per call share one cache entry."""
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    x.stop_gradient = False
+    before = len(D._FWD_JIT_CACHE)
+
+    outs = []
+    for _ in range(4):
+        def f(v, w):  # same code object every iteration
+            return v * w + 1.0
+
+        outs.append(apply_op(f, x, x, op_name="t"))
+    assert len(D._FWD_JIT_CACHE) == before + 1
+    outs[-1].sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.value), 2 * np.ones((2, 3)))
+
+
+def test_kwdefaults_distinguish_entries():
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    x.stop_gradient = False
+    o1 = apply_op(_op_static_scale, x, x, op_name="s")
+    o2 = apply_op(_op_static_scale, x, x, op_name="s", scale=5.0)
+    np.testing.assert_allclose(np.asarray(o1.value), [2.0, 2.0])
+    np.testing.assert_allclose(np.asarray(o2.value), [5.0, 5.0])
+
+
+def test_impure_rng_ops_not_frozen():
+    paddle.seed(0)
+    p = paddle.to_tensor(np.full((8,), 0.5, "float32"))
+    p.stop_gradient = False  # grad-enabled → record path
+    draws = {tuple(np.asarray(paddle.bernoulli(p).value)) for _ in range(4)}
+    assert len(draws) > 1, "bernoulli draws frozen by the vjp cache"
+
+
+def test_closure_fns_excluded():
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    x.stop_gradient = False
+    before = len(D._FWD_JIT_CACHE)
+    for k in (1.0, 2.0, 3.0):
+        def f(v, _k=None):  # closure over k
+            return v * k
+
+        out = apply_op(f, x, op_name="c")
+        np.testing.assert_allclose(np.asarray(out.value), [k, k])
+    assert len(D._FWD_JIT_CACHE) == before  # none cached
+
+
+def test_backward_through_cached_conv():
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m = nn.Conv2D(3, 4, 3, padding=1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 8, 8)
+                         .astype("float32"))
+    y = m(x)
+    (y * y).sum().backward()
+    g1 = np.asarray(m.weight.grad.value).copy()
+    m.clear_gradients()
+    y = m(x)  # second call: cache hit
+    (y * y).sum().backward()
+    np.testing.assert_allclose(np.asarray(m.weight.grad.value), g1,
+                               rtol=1e-6, atol=1e-7)
