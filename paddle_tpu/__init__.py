@@ -83,7 +83,8 @@ from .hapi import callbacks  # noqa: F401
 from .hapi import summary  # noqa: F401
 from . import hub  # noqa: F401
 from .cost_model import flops  # noqa: F401
-from .compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace, LazyGuard, NPUPlace, TPUPlace,
+from .compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace,
+                     LazyGuard, MLUPlace, NPUPlace, TPUPlace, XPUPlace,
                      add_n, batch, cast, check_shape, create_parameter, diagonal,
                      disable_signal_handler, dsplit, dtype, finfo, frexp,
                      get_cuda_rng_state, hsplit, iinfo, index_add_, is_complex,

@@ -1,0 +1,9 @@
+"""paddle._legacy_C_ops — alias of _C_ops (ref python/paddle/_legacy_C_ops.py
+re-exports core.ops legacy generated functions; our dispatch has a single
+generation, so the two namespaces are identical)."""
+from ._C_ops import *  # noqa: F401,F403
+from . import _C_ops as _c
+
+
+def __getattr__(name):
+    return getattr(_c, name)

@@ -1,0 +1,5 @@
+"""paddle.callbacks — re-export of hapi callbacks
+(ref python/paddle/callbacks.py → python/paddle/hapi/callbacks.py)."""
+from .hapi.callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                             ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau,
+                             VisualDL)

@@ -208,6 +208,26 @@ class TPUPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class XPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("xpu", device_id)
+
+
+class IPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("ipu", device_id)
+
+
+class MLUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__("mlu", device_id)
+
+
+class CustomPlace(Place):
+    def __init__(self, dev_type="custom", device_id=0):
+        super().__init__(dev_type, device_id)
+
+
 # ---- misc ------------------------------------------------------------------
 
 

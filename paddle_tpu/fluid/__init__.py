@@ -1,0 +1,65 @@
+"""paddle.fluid — legacy compat namespace.
+
+The reference keeps its pre-2.0 API alive under ``python/paddle/fluid``
+(~269k LoC: framework.py Program/Block/Variable, executor.py, layers/,
+dygraph/, io.py, reader.py — SURVEY §2.2 "fluid (legacy)").  Migrating
+users import it everywhere (``import paddle.fluid as fluid``), so this
+package preserves that surface as thin aliases onto the TPU-native
+implementations: the recorded-Program static facade (paddle_tpu/static),
+the tape-autograd eager core (paddle_tpu/framework), and the jax-backed
+nn/optimizer/io stacks.  No legacy execution machinery is re-implemented —
+a fluid Program IS a paddle_tpu.static Program.
+"""
+from __future__ import annotations
+
+from ..compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace,  # noqa: F401
+                      IPUPlace, MLUPlace, NPUPlace, TPUPlace, XPUPlace)
+from ..framework.flags import get_flags, set_flags  # noqa: F401
+from ..static.graph import (CompiledProgram, Executor, ParallelExecutor,  # noqa: F401
+                            Program, Scope, Variable, default_main_program,
+                            default_startup_program, global_scope,
+                            program_guard, scope_guard)
+from ..static import name_scope, create_global_var  # noqa: F401
+from ..framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+from . import backward  # noqa: F401
+from . import clip  # noqa: F401
+from . import core  # noqa: F401
+from . import data_feeder  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import executor  # noqa: F401
+from . import framework  # noqa: F401
+from . import initializer  # noqa: F401
+from . import io  # noqa: F401
+from . import layers  # noqa: F401
+from . import nets  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import param_attr  # noqa: F401
+from . import reader  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import unique_name  # noqa: F401
+
+from .data_feeder import DataFeeder  # noqa: F401
+from .dygraph import disable_dygraph, enable_dygraph, in_dygraph_mode  # noqa: F401
+from .framework import cuda_places, cpu_places, device_guard, is_compiled_with_cuda  # noqa: F401
+from .io import DataLoader, load_inference_model, save_inference_model  # noqa: F401
+from .layers import data, embedding, one_hot  # noqa: F401
+
+
+def install_check():
+    """ref python/paddle/fluid/install_check.py — run a tiny training step to
+    verify the install works on the current backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as optim
+
+    lin = nn.Linear(2, 1)
+    opt = optim.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.rand(4, 2).astype("float32"))
+    loss = nn.functional.mse_loss(lin(x), paddle.zeros([4, 1]))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    print("Your paddle_tpu works well on SINGLE device.")
+    print("install_check PASSED")

@@ -1,0 +1,4 @@
+"""fluid.backward — static-graph autodiff (ref python/paddle/fluid/backward.py
+append_backward/gradients). Our Program replay differentiates with jax.grad at
+Executor.run time, so these just mark targets on the recorded Program."""
+from paddle_tpu.static.graph import append_backward, gradients  # noqa: F401

@@ -1,0 +1,8 @@
+"""fluid.clip — gradient clipping (ref python/paddle/fluid/clip.py
+ClipGradByGlobalNorm etc., the home of global-norm clipping pre-2.0)."""
+from paddle_tpu.nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                                ClipGradByValue)
+
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+GradientClipByNorm = ClipGradByNorm
+GradientClipByValue = ClipGradByValue

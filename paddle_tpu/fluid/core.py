@@ -1,0 +1,86 @@
+"""fluid.core — shim for the reference's pybind extension module
+(ref paddle/fluid/pybind/pybind.cc:625 `libpaddle`).  There is no native
+binding layer to expose — XLA owns the runtime — so this provides the
+handful of names user code touches: places, dtype enums (VarDesc.VarType),
+the eager Tensor type, and flag accessors."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..compat import (CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace,  # noqa: F401
+                      IPUPlace, MLUPlace, NPUPlace, TPUPlace, XPUPlace)
+from ..framework.core import Tensor
+from ..framework.flags import get_flags as _get_flags, set_flags as _set_flags
+
+
+class VarDesc:
+    """Dtype enum used pervasively by legacy user code
+    (``core.VarDesc.VarType.FP32``).  Values map to jnp dtypes."""
+
+    class VarType:
+        BOOL = jnp.bool_
+        INT8 = jnp.int8
+        UINT8 = jnp.uint8
+        INT16 = jnp.int16
+        INT32 = jnp.int32
+        INT64 = jnp.int64
+        FP16 = jnp.float16
+        BF16 = jnp.bfloat16
+        FP32 = jnp.float32
+        FP64 = jnp.float64
+        COMPLEX64 = jnp.complex64
+        COMPLEX128 = jnp.complex128
+        # non-dtype var kinds, kept as distinct sentinels
+        LOD_TENSOR = "lod_tensor"
+        SELECTED_ROWS = "selected_rows"
+        LOD_TENSOR_ARRAY = "lod_tensor_array"
+        RAW = "raw"
+
+
+VarBase = Tensor  # legacy dygraph tensor name
+LoDTensor = Tensor  # LoD (ragged) metadata is not modeled; dense alias
+
+
+class _OpsProxy:
+    """core.eager.ops.* — the reference exposes generated per-op C functions
+    here; ours resolve lazily through paddle_tpu._C_ops' dispatch."""
+
+    def __getattr__(self, name):
+        from .. import _C_ops
+
+        return getattr(_C_ops, name)
+
+
+class eager:
+    Tensor = Tensor
+    ops = _OpsProxy()
+
+
+def is_compiled_with_cuda() -> bool:
+    from ..device import is_compiled_with_cuda as f
+
+    return f()
+
+
+def globals_set(name, value):
+    _set_flags({name: value})
+
+
+def globals_get(name):
+    return _get_flags([name])[name]
+
+
+def get_cuda_device_count() -> int:
+    import jax
+
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+class Scope:
+    def __init__(self):
+        from ..static.graph import Scope as _S
+
+        self._impl = _S()
+
+    def find_var(self, name):
+        return self._impl.find_var(name)

@@ -1,0 +1,37 @@
+"""fluid.data_feeder — ref python/paddle/fluid/data_feeder.py DataFeeder:
+converts numpy/list minibatches into the feed dict an Executor expects."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self._names = [v if isinstance(v, str) else v.name for v in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable)) if iterable and not isinstance(
+            iterable, dict) else iterable
+        if isinstance(cols, dict):
+            return {k: np.asarray(v) for k, v in cols.items()}
+        return {n: np.asarray(c) for n, c in zip(self._names, cols)}
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name,
+                             extra_message=""):
+    return True
+
+
+def check_type(input, input_name, expected_type, op_name, extra_message=""):
+    return True
+
+
+def check_dtype(input_dtype, input_name, expected_dtype, op_name,
+                extra_message=""):
+    return True
+
+
+def convert_dtype(dtype):
+    from paddle_tpu.framework.dtype import dtype_name
+
+    return dtype_name(dtype)

@@ -1,0 +1,116 @@
+"""fluid.dygraph — legacy eager-mode namespace (ref python/paddle/fluid/dygraph/:
+base.py guard/to_variable, layers.py Layer, parallel.py DataParallel:399,
+nn.py legacy layer classes)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+from paddle_tpu.framework.core import Tensor, no_grad  # noqa: F401
+from paddle_tpu.nn import (BatchNorm1D, BatchNorm2D, Embedding as _Embedding,  # noqa: F401
+                           LayerNorm as _LayerNorm, Linear as _Linear)
+from paddle_tpu.nn.layer_base import Layer  # noqa: F401
+from paddle_tpu.static.graph import disable_static_mode, enable_static_mode, \
+    in_static_mode
+
+
+def enable_dygraph(place=None):
+    disable_static_mode()
+
+
+def disable_dygraph():
+    enable_static_mode()
+
+
+def enabled() -> bool:
+    return not in_static_mode()
+
+
+def in_dygraph_mode() -> bool:
+    return not in_static_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """ref fluid/dygraph/base.py guard — dygraph context; eager is our default."""
+    was_static = in_static_mode()
+    disable_static_mode()
+    try:
+        yield
+    finally:
+        if was_static:
+            enable_static_mode()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    import paddle_tpu as p
+
+    t = p.to_tensor(np.asarray(value) if not isinstance(value, Tensor) else value)
+    return t.astype(dtype) if dtype else t
+
+
+class Linear(_Linear):
+    """Legacy fluid.dygraph.Linear(input_dim, output_dim, act=None)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from paddle_tpu.nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Embedding(_Embedding):
+    """Legacy fluid.dygraph.Embedding(size=[vocab, dim])."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(size[0], size[1], padding_idx=padding_idx,
+                         sparse=is_sparse, weight_attr=param_attr)
+
+
+class BatchNorm(BatchNorm2D):
+    """Legacy fluid.dygraph.BatchNorm(num_channels, act=None)."""
+
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", **kw):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from paddle_tpu.nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        from paddle_tpu.nn import LayerList as LL
+
+        # delegate entirely; kept for `fluid.dygraph.LayerList` imports
+        self.__class__ = LL  # type: ignore[assignment]
+        LL.__init__(self, sublayers)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    import paddle_tpu as p
+
+    return p.grad(outputs, inputs, grad_outputs=grad_outputs,
+                  retain_graph=retain_graph, create_graph=create_graph,
+                  allow_unused=allow_unused)

@@ -1,0 +1,4 @@
+"""fluid.executor — ref python/paddle/fluid/executor.py:921 Executor.
+The recorded-Program replay executor lives in paddle_tpu/static/graph.py."""
+from paddle_tpu.static.graph import (Executor, Scope, global_scope,  # noqa: F401
+                                     scope_guard)

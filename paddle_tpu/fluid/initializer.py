@@ -1,0 +1,15 @@
+"""fluid.initializer — legacy initializer class names (ref
+python/paddle/fluid/initializer.py: ConstantInitializer etc.)."""
+from paddle_tpu.nn.initializer import (Assign, Constant, KaimingNormal,  # noqa: F401
+                                       KaimingUniform, Normal, TruncatedNormal,
+                                       Uniform, XavierNormal, XavierUniform)
+
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingUniform
+NumpyArrayInitializer = Assign
+Xavier = XavierUniform
+MSRA = KaimingUniform

@@ -1,0 +1,61 @@
+"""fluid.io — legacy IO (ref python/paddle/fluid/io.py save/load_inference_model,
+reader.py:311 DataLoader). Inference programs serialize as StableHLO via
+paddle_tpu.static; the DataLoader is the modern one."""
+from __future__ import annotations
+
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,  # noqa: F401
+                           DistributedBatchSampler, IterableDataset)
+from paddle_tpu.static.graph import load_inference_model as _load, \
+    save_inference_model as _save
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Legacy signature: feed names + fetch vars + dirname (not path_prefix)."""
+    import os
+
+    from paddle_tpu.static.graph import current_programs
+
+    prog = main_program
+    if prog is None:
+        prog, _ = current_programs()
+    feed_vars = [prog.global_block().var(n) for n in feeded_var_names]
+    return _save(os.path.join(dirname, "model"), feed_vars, target_vars,
+                 executor=executor, program=prog)
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import os
+
+    return _load(os.path.join(dirname, "model"), executor=executor)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    import os
+
+    import paddle_tpu as p
+    from paddle_tpu.static.graph import current_programs
+
+    prog = main_program or current_programs()[0]
+    state = {v.name: v for v in prog.all_parameters()}
+    p.save(state, os.path.join(dirname, filename or "params.pdparams"))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    import os
+
+    import paddle_tpu as p
+    from paddle_tpu.static.graph import current_programs
+
+    prog = main_program or current_programs()[0]
+    state = p.load(os.path.join(dirname, filename or "params.pdparams"))
+    for v in prog.all_parameters():
+        if v.name in state:
+            v.set_value(state[v.name])
+
+
+save_persistables = save_params
+load_persistables = load_params

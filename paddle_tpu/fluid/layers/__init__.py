@@ -1,0 +1,221 @@
+"""fluid.layers — the legacy flat op namespace (ref python/paddle/fluid/layers/:
+nn.py ~15k LoC of ``fluid.layers.*`` functions).  Legacy spellings
+(``reduce_mean(dim=...)``, ``fill_constant``, probability-input
+``cross_entropy``) delegate to the modern paddle_tpu surface; under
+``paddle.enable_static`` every call is recorded into the current Program by
+the central dispatch, exactly like the 2.x API."""
+from __future__ import annotations
+
+import paddle_tpu as _p
+from paddle_tpu import nn as _nn
+from paddle_tpu.nn import functional as _F
+from paddle_tpu.static.graph import data as _static_data
+from paddle_tpu.static.nn import (batch_norm, cond, conv2d, embedding,  # noqa: F401
+                                  while_loop)
+from paddle_tpu.static.nn import fc as _fc
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Legacy fc spelling: param_attr/act instead of weight_attr/activation."""
+    return _fc(input, size, num_flatten_dims=num_flatten_dims,
+               weight_attr=param_attr, bias_attr=bias_attr, activation=act,
+               name=name)
+
+# direct re-exports where 2.x name == legacy name
+from paddle_tpu import (abs, assign, cast, clip, concat, cumsum, exp,  # noqa: F401
+                        expand, flatten, gather, increment, log, matmul,
+                        ones, pow, reshape, scale, shape, sigmoid, slice,
+                        split, sqrt, square, squeeze, stack, tanh, tile,
+                        topk, transpose, tril, triu, unsqueeze, where, zeros)
+from paddle_tpu.nn.functional import (dropout, log_softmax, relu, softmax,  # noqa: F401
+                                      softmax_with_cross_entropy)
+from paddle_tpu.metric import accuracy  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """Legacy fluid.layers.data prepends a -1 batch dim unless told not to."""
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + list(shape)
+    return _static_data(name, shape, dtype, lod_level)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    return _p.full(shape, value, dtype=dtype)
+
+
+def mean(x, name=None):
+    return _p.mean(x)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _p.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _p.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _p.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _p.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _p.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _act(_p.add(x, y), act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _act(_p.subtract(x, y), act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _act(_p.multiply(x, y), act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _act(_p.divide(x, y), act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _act(_p.pow(x, y), act)
+
+
+def _act(x, act):
+    return getattr(_F, act)(x) if act else x
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """Legacy mul op == matmul after flattening to 2-D."""
+    xs = x.reshape([-1 if x_num_col_dims else 1,
+                    int(_np_prod(x.shape[x_num_col_dims:]))]) \
+        if len(x.shape) > 2 else x
+    return _p.matmul(xs, y)
+
+
+def _np_prod(t):
+    out = 1
+    for v in t:
+        out *= int(v)
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100, name=None):
+    """Legacy cross_entropy takes PROBABILITIES (post-softmax), not logits
+    (ref fluid/layers/loss.py cross_entropy)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.dispatch import apply_op
+
+    if soft_label:
+        return apply_op(
+            lambda p, l: -(l * jnp.log(jnp.clip(p, 1e-12))).sum(-1, keepdims=True),
+            input, label)
+
+    def f(p, l):
+        l = l.reshape(p.shape[:-1]).astype(jnp.int32)
+        picked = jnp.take_along_axis(p, l[..., None], axis=-1)
+        out = -jnp.log(jnp.clip(picked, 1e-12))
+        if ignore_index >= 0:
+            out = jnp.where(l[..., None] == ignore_index, 0.0, out)
+        return out
+
+    return apply_op(f, input, label)
+
+
+def softmax_with_cross_entropy_legacy(logits, label, **kw):
+    return softmax_with_cross_entropy(logits, label, **kw)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _F.one_hot(input, depth)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True,
+           data_format="NCHW", name=None):
+    if global_pooling:
+        return (_F.adaptive_max_pool2d(input, 1) if pool_type == "max"
+                else _F.adaptive_avg_pool2d(input, 1))
+    if pool_type == "max":
+        return _F.max_pool2d(input, pool_size, stride=pool_stride,
+                             padding=pool_padding, ceil_mode=ceil_mode)
+    return _F.avg_pool2d(input, pool_size, stride=pool_stride,
+                         padding=pool_padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from paddle_tpu import create_parameter as cp
+
+    return cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+              default_initializer=default_initializer)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return _p.zeros([1], dtype=dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return _p.uniform(shape, dtype=dtype, min=min, max=max)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    return _p.normal(mean=mean, std=std, shape=shape).astype(dtype)
+
+
+def argmax(x, axis=0, name=None):
+    return _p.argmax(x, axis=axis)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _F.hardswish(x)
+
+
+def relu6(x, name=None):
+    return _F.relu6(x)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _F.leaky_relu(x, negative_slope=alpha)
+
+
+def batch_norm_legacy(*a, **k):
+    return batch_norm(*a, **k)
+
+
+def sums(input, out=None):
+    out_t = input[0]
+    for t in input[1:]:
+        out_t = _p.add(out_t, t)
+    return out_t
+
+
+def unsqueeze_legacy(input, axes, name=None):
+    out = input
+    for ax in (axes if isinstance(axes, (list, tuple)) else [axes]):
+        out = _p.unsqueeze(out, ax)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Legacy debug print op → jax.debug.print under jit, plain print eager."""
+    import jax
+
+    from paddle_tpu.framework.dispatch import apply_op
+
+    def f(x):
+        jax.debug.print((message or "") + "{x}", x=x)
+        return x
+
+    return apply_op(f, input)

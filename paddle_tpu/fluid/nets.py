@@ -1,0 +1,44 @@
+"""fluid.nets — convenience composite networks (ref python/paddle/fluid/nets.py)."""
+from __future__ import annotations
+
+from .layers import conv2d, fc, pool2d
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    conv_out = conv2d(input, num_filters, filter_size, stride=conv_stride,
+                      padding=conv_padding, dilation=conv_dilation,
+                      groups=conv_groups, param_attr=param_attr,
+                      bias_attr=bias_attr, act=act)
+    return pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                  pool_stride=pool_stride, pool_padding=pool_padding,
+                  global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    from .layers import batch_norm, dropout
+
+    tmp = input
+    if not isinstance(conv_num_filter, (list, tuple)):
+        conv_num_filter = [conv_num_filter]
+    with_bn = conv_with_batchnorm if isinstance(conv_with_batchnorm, list) \
+        else [conv_with_batchnorm] * len(conv_num_filter)
+    drop = conv_batchnorm_drop_rate if isinstance(
+        conv_batchnorm_drop_rate, list) else \
+        [conv_batchnorm_drop_rate] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = conv2d(tmp, nf, conv_filter_size, padding=conv_padding,
+                     param_attr=param_attr,
+                     act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = batch_norm(tmp, act=conv_act)
+            if drop[i] > 0:
+                tmp = dropout(tmp, p=drop[i])
+    return pool2d(tmp, pool_size=pool_size, pool_stride=pool_stride,
+                  pool_type=pool_type)

@@ -1,0 +1,75 @@
+"""fluid.optimizer — legacy optimizer classes with *Optimizer names and
+`parameter_list` / `.minimize(loss)` conventions (ref
+python/paddle/fluid/optimizer.py)."""
+from __future__ import annotations
+
+from paddle_tpu import optimizer as _opt
+
+
+class SGDOptimizer(_opt.SGD):
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate=learning_rate, parameters=parameter_list,
+                         grad_clip=grad_clip)
+
+
+class MomentumOptimizer(_opt.Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameter_list=None,
+                 use_nesterov=False, regularization=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         parameters=parameter_list, use_nesterov=use_nesterov,
+                         grad_clip=grad_clip)
+
+
+class AdamOptimizer(_opt.Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None, lazy_mode=False, **kw):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, parameters=parameter_list,
+                         grad_clip=grad_clip)
+
+
+class AdamaxOptimizer(_opt.Adamax):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, parameters=parameter_list,
+                         grad_clip=grad_clip)
+
+
+class AdagradOptimizer(_opt.Adagrad):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate=learning_rate, epsilon=epsilon,
+                         parameters=parameter_list, grad_clip=grad_clip,
+                         initial_accumulator_value=initial_accumulator_value)
+
+
+class RMSPropOptimizer(_opt.RMSProp):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate=learning_rate, rho=rho, epsilon=epsilon,
+                         momentum=momentum, centered=centered,
+                         parameters=parameter_list, grad_clip=grad_clip)
+
+
+class LambOptimizer(_opt.Lamb):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameter_list=None,
+                 regularization=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon,
+                         parameters=parameter_list, grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)
+
+
+Adam = AdamOptimizer
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer

@@ -1,0 +1,2 @@
+"""fluid.param_attr — ref python/paddle/fluid/param_attr.py."""
+from paddle_tpu.framework.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,30 @@
+"""fluid.reader — ref python/paddle/fluid/reader.py (DataLoader:311)."""
+from paddle_tpu.io import DataLoader  # noqa: F401
+
+
+class PyReader:
+    """Legacy PyReader — iterable feeding wrapper over a sample generator."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._reader = None
+        self._batch = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._reader = reader
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._reader = reader
+
+    def __iter__(self):
+        import numpy as np
+
+        import paddle_tpu as p
+
+        for batch in self._reader():
+            if isinstance(batch, (list, tuple)) and batch and isinstance(
+                    batch[0], (list, tuple)):
+                cols = list(zip(*batch))
+                yield [p.to_tensor(np.asarray(c)) for c in cols]
+            else:
+                yield [p.to_tensor(np.asarray(b)) for b in batch]

@@ -1,0 +1,43 @@
+"""fluid.unique_name — name uniquifier (ref python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _Gen(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+    def make(self, key):
+        n = self.counters.get(key, 0)
+        self.counters[key] = n + 1
+        return f"{key}_{n}"
+
+
+_gen = _Gen()
+
+
+def generate(key: str) -> str:
+    return _gen.make(key)
+
+
+def generate_with_ignorable_key(key: str) -> str:
+    return _gen.make(key)
+
+
+def switch(new_generator=None):
+    global _gen
+    old = _gen
+    _gen = new_generator or _Gen()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _gen
+        _gen = old

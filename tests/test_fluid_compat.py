@@ -1,0 +1,153 @@
+"""paddle.fluid legacy-compat namespace tests (SURVEY §2.2 'fluid (legacy)'):
+the pre-2.0 spellings must run against the TPU-native core."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+@pytest.fixture(autouse=True)
+def _eager_mode():
+    paddle.disable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestFluidDygraph:
+    def test_guard_to_variable_linear(self):
+        with fluid.dygraph.guard():
+            x = fluid.dygraph.to_variable(np.ones((4, 3), dtype="float32"))
+            lin = fluid.dygraph.Linear(3, 2)
+            out = lin(x)
+            assert tuple(out.shape) == (4, 2)
+
+    def test_legacy_optimizer_minimize(self):
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(3, 2)
+            opt = fluid.optimizer.AdamOptimizer(
+                0.01, parameter_list=lin.parameters())
+            x = fluid.dygraph.to_variable(
+                np.random.rand(4, 3).astype("float32"))
+            loss = fluid.layers.reduce_mean(fluid.layers.square(lin(x)))
+            before = np.array(lin.weight.numpy())
+            opt.minimize(loss)
+            assert not np.allclose(before, lin.weight.numpy())
+
+    def test_legacy_embedding_batchnorm(self):
+        with fluid.dygraph.guard():
+            emb = fluid.dygraph.Embedding(size=[10, 4])
+            ids = fluid.dygraph.to_variable(np.array([[1, 2], [3, 4]]))
+            assert tuple(emb(ids).shape) == (2, 2, 4)
+            bn = fluid.dygraph.BatchNorm(3)
+            img = fluid.dygraph.to_variable(
+                np.random.rand(2, 3, 5, 5).astype("float32"))
+            assert tuple(bn(img).shape) == (2, 3, 5, 5)
+
+    def test_dygraph_grad(self):
+        with fluid.dygraph.guard():
+            x = paddle.to_tensor([2.0], stop_gradient=False)
+            y = x * x
+            (g,) = fluid.dygraph.grad([y], [x])
+            np.testing.assert_allclose(np.asarray(g), [4.0], rtol=1e-6)
+
+
+class TestFluidStatic:
+    def test_program_executor_training(self):
+        paddle.enable_static()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [3])
+            y = fluid.layers.data("y", [1], dtype="int64")
+            h = fluid.layers.fc(x, 8, act="relu")
+            prob = fluid.layers.softmax(fluid.layers.fc(h, 4))
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, y))
+            fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(8, 3).astype("float32"),
+                "y": rng.randint(0, 4, (8, 1))}
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]).mean())
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+        paddle.disable_static()
+
+    def test_legacy_layer_spellings(self):
+        with fluid.dygraph.guard():
+            x = fluid.dygraph.to_variable(
+                np.arange(12, dtype="float32").reshape(3, 4))
+            np.testing.assert_allclose(
+                np.asarray(fluid.layers.reduce_sum(x, dim=1)),
+                np.arange(12, dtype="float32").reshape(3, 4).sum(1), rtol=1e-6)
+            fc_out = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+            np.testing.assert_allclose(np.asarray(fc_out), np.full((2, 2), 3.0))
+            probs = fluid.dygraph.to_variable(
+                np.array([[0.9, 0.1], [0.2, 0.8]], dtype="float32"))
+            labels = fluid.dygraph.to_variable(np.array([[0], [1]]))
+            ce = np.asarray(fluid.layers.cross_entropy(probs, labels))
+            np.testing.assert_allclose(
+                ce.ravel(), -np.log([0.9, 0.8]), rtol=1e-5)
+
+    def test_nets_simple_img_conv_pool(self):
+        paddle.enable_static()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", [1, 8, 8])
+            out = fluid.nets.simple_img_conv_pool(
+                img, num_filters=2, filter_size=3, pool_size=2, pool_stride=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res, = exe.run(main,
+                       feed={"img": np.random.rand(2, 1, 8, 8).astype("float32")},
+                       fetch_list=[out])
+        assert np.asarray(res).shape == (2, 2, 3, 3)
+        paddle.disable_static()
+
+
+class TestFluidMisc:
+    def test_core_shim(self):
+        assert fluid.core.VarDesc.VarType.FP32 is not None
+        assert hasattr(fluid.core.eager.ops, "matmul")
+        assert isinstance(fluid.core.get_cuda_device_count(), int)
+
+    def test_unique_name(self):
+        a = fluid.unique_name.generate("fc")
+        b = fluid.unique_name.generate("fc")
+        assert a != b
+        with fluid.unique_name.guard():
+            c = fluid.unique_name.generate("fc")
+        assert c.startswith("fc_")
+
+    def test_clip_regularizer_initializer_aliases(self):
+        assert fluid.clip.GradientClipByGlobalNorm is not None
+        assert fluid.regularizer.L2DecayRegularizer is not None
+        assert fluid.initializer.MSRAInitializer is not None
+        assert fluid.initializer.ConstantInitializer is not None
+
+    def test_data_feeder(self):
+        feeder = fluid.DataFeeder(feed_list=["a", "b"])
+        out = feeder.feed([(np.zeros(2), 1), (np.ones(2), 0)])
+        assert set(out) == {"a", "b"}
+        assert out["a"].shape == (2, 2)
+
+    def test_top_level_callbacks_and_legacy_ops(self):
+        import paddle_tpu._legacy_C_ops as legacy_ops
+        import paddle_tpu.callbacks as callbacks
+
+        assert hasattr(legacy_ops, "matmul")
+        assert callbacks.EarlyStopping is not None
+        assert callbacks.ReduceLROnPlateau is not None
+
+    def test_save_load_params(self, tmp_path):
+        paddle.enable_static()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [3])
+            fluid.layers.fc(x, 2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_params(exe, str(tmp_path), main_program=main)
+        fluid.io.load_params(exe, str(tmp_path), main_program=main)
+        paddle.disable_static()
