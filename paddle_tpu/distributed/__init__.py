@@ -5,7 +5,7 @@ mesh axes replace process groups; jax.distributed.initialize replaces
 TCPStore+NCCL bootstrap; pjit/GSPMD sharding replaces per-rank program
 slicing.
 """
-from .collective import (Group, ReduceOp, all_gather, all_gather_object, all_reduce,
+from .collective import (Group, ProcessGroup, ReduceOp, all_gather, all_gather_object, all_reduce,
                          all_to_all, alltoall, barrier, broadcast, broadcast_object_list,
                          destroy_process_group, get_backend, get_global_mesh, get_group,
                          irecv, isend, new_group, recv, reduce, reduce_scatter, scatter,

@@ -66,6 +66,9 @@ class Group:
         return self
 
 
+ProcessGroup = Group  # reference name (ref process_group.h:53)
+
+
 class _Task:
     """Async completion handle (ref process_group.h Task :55-88). XLA calls
     are async by default; wait() blocks on the result buffer."""

