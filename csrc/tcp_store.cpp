@@ -1,0 +1,369 @@
+// paddle_tpu native TCPStore.
+//
+// TPU-native equivalent of the reference's rendezvous KV store
+// (ref paddle/phi/core/distributed/store/tcp_store.cc + tcp_utils.cc): the
+// bootstrap service every multi-host job uses to exchange coordinator
+// addresses, ranks and barrier counters before jax.distributed comes up.
+// One poll-loop thread serves all clients (the reference uses the same
+// single-threaded masterdaemon design); clients speak a tiny length-prefixed
+// binary protocol. Exposed through a C ABI for ctypes
+// (paddle_tpu/distributed/store.py) — no pybind in this build.
+//
+// Protocol: [u8 cmd][u32 klen][key][u32 vlen][value]
+//   cmd: 1=SET 2=GET 3=ADD(value=i64 delta) 4=WAIT 5=NUM_KEYS 6=DELETE
+// Reply: [i32 status][u32 vlen][value]   status 0=ok, -1=missing/timeout
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -o libtcpstore.so tcp_store.cpp -lpthread
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kNumKeys = 5,
+                     kDelete = 6 };
+
+struct Server {
+  int listen_fd = -1;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::map<std::string, std::string> kv;
+
+  // per-connection read buffer
+  struct Conn {
+    std::string buf;
+    // WAIT parked until the key appears
+    bool waiting = false;
+    std::string wait_key;
+    std::chrono::steady_clock::time_point wait_deadline;
+  };
+  std::map<int, Conn> conns;
+};
+
+bool send_all(int fd, const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  while (n) {
+    ssize_t w = ::send(fd, c, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    c += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+void reply(int fd, int32_t status, const std::string& val) {
+  std::string out;
+  out.resize(8 + val.size());
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  std::memcpy(&out[0], &status, 4);
+  std::memcpy(&out[4], &vlen, 4);
+  std::memcpy(&out[8], val.data(), val.size());
+  send_all(fd, out.data(), out.size());
+}
+
+// try to parse one complete request from conn.buf; returns false if more
+// bytes are needed. On success fills cmd/key/val and strips the request.
+bool parse_req(std::string& buf, uint8_t* cmd, std::string* key,
+               std::string* val) {
+  if (buf.size() < 9) return false;
+  uint32_t klen, vlen;
+  std::memcpy(&klen, buf.data() + 1, 4);
+  if (buf.size() < 9 + klen) return false;
+  std::memcpy(&vlen, buf.data() + 5 + klen, 4);
+  if (buf.size() < 9 + klen + vlen) return false;
+  *cmd = static_cast<uint8_t>(buf[0]);
+  key->assign(buf, 5, klen);
+  val->assign(buf, 9 + klen, vlen);
+  buf.erase(0, 9 + klen + vlen);
+  return true;
+}
+
+void serve(Server* s) {
+  std::vector<pollfd> fds;
+  while (!s->stop.load()) {
+    fds.clear();
+    fds.push_back({s->listen_fd, POLLIN, 0});
+    {
+      std::lock_guard<std::mutex> l(s->mu);
+      for (auto& [fd, c] : s->conns)
+        fds.push_back({fd, static_cast<short>(c.waiting ? 0 : POLLIN), 0});
+    }
+    ::poll(fds.data(), fds.size(), 50 /*ms; also ticks WAIT timeouts*/);
+    if (fds[0].revents & POLLIN) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd >= 0) {
+        int one = 1;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        std::lock_guard<std::mutex> l(s->mu);
+        s->conns[cfd];
+      }
+    }
+    std::vector<int> closed;
+    for (size_t i = 1; i < fds.size(); ++i) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      char tmp[4096];
+      ssize_t r = ::recv(fds[i].fd, tmp, sizeof(tmp), 0);
+      if (r <= 0) {
+        closed.push_back(fds[i].fd);
+        continue;
+      }
+      std::lock_guard<std::mutex> l(s->mu);
+      auto& conn = s->conns[fds[i].fd];
+      conn.buf.append(tmp, static_cast<size_t>(r));
+      uint8_t cmd;
+      std::string key, val;
+      while (parse_req(conn.buf, &cmd, &key, &val)) {
+        switch (cmd) {
+          case kSet:
+            s->kv[key] = val;
+            reply(fds[i].fd, 0, "");
+            break;
+          case kGet: {
+            auto it = s->kv.find(key);
+            if (it == s->kv.end()) reply(fds[i].fd, -1, "");
+            else reply(fds[i].fd, 0, it->second);
+            break;
+          }
+          case kAdd: {
+            int64_t delta = 0;
+            if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+            int64_t cur = 0;
+            auto it = s->kv.find(key);
+            if (it != s->kv.end() && it->second.size() == 8)
+              std::memcpy(&cur, it->second.data(), 8);
+            cur += delta;
+            std::string enc(8, '\0');
+            std::memcpy(&enc[0], &cur, 8);
+            s->kv[key] = enc;
+            reply(fds[i].fd, 0, enc);
+            break;
+          }
+          case kWait: {
+            auto it = s->kv.find(key);
+            if (it != s->kv.end()) {
+              reply(fds[i].fd, 0, it->second);
+            } else {
+              int64_t timeout_ms = 0;
+              if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+              conn.waiting = true;
+              conn.wait_key = key;
+              conn.wait_deadline = std::chrono::steady_clock::now() +
+                                   std::chrono::milliseconds(timeout_ms);
+            }
+            break;
+          }
+          case kNumKeys: {
+            int64_t n = static_cast<int64_t>(s->kv.size());
+            std::string enc(8, '\0');
+            std::memcpy(&enc[0], &n, 8);
+            reply(fds[i].fd, 0, enc);
+            break;
+          }
+          case kDelete:
+            reply(fds[i].fd, s->kv.erase(key) ? 0 : -1, "");
+            break;
+          default:
+            closed.push_back(fds[i].fd);
+        }
+      }
+    }
+    // resolve parked WAITs (key arrived or deadline passed)
+    {
+      std::lock_guard<std::mutex> l(s->mu);
+      auto now = std::chrono::steady_clock::now();
+      for (auto& [fd, c] : s->conns) {
+        if (!c.waiting) continue;
+        auto it = s->kv.find(c.wait_key);
+        if (it != s->kv.end()) {
+          reply(fd, 0, it->second);
+          c.waiting = false;
+        } else if (now >= c.wait_deadline) {
+          reply(fd, -1, "");
+          c.waiting = false;
+        }
+      }
+      for (int fd : closed) {
+        ::close(fd);
+        s->conns.erase(fd);
+      }
+    }
+  }
+  std::lock_guard<std::mutex> l(s->mu);
+  for (auto& [fd, c] : s->conns) ::close(fd);
+  s->conns.clear();
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+};
+
+bool recv_all(int fd, void* p, size_t n) {
+  char* c = static_cast<char*>(p);
+  while (n) {
+    ssize_t r = ::recv(fd, c, n, 0);
+    if (r <= 0) return false;
+    c += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// one round trip; returns status, fills out
+int32_t request(Client* c, uint8_t cmd, const std::string& key,
+                const std::string& val, std::string* out) {
+  std::lock_guard<std::mutex> l(c->mu);
+  std::string req;
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  req.resize(9 + klen + vlen);
+  req[0] = static_cast<char>(cmd);
+  std::memcpy(&req[1], &klen, 4);
+  std::memcpy(&req[5], key.data(), klen);
+  std::memcpy(&req[5 + klen], &vlen, 4);
+  std::memcpy(&req[9 + klen], val.data(), vlen);
+  if (!send_all(c->fd, req.data(), req.size())) return -2;
+  int32_t status;
+  uint32_t rlen;
+  if (!recv_all(c->fd, &status, 4) || !recv_all(c->fd, &rlen, 4)) return -2;
+  out->resize(rlen);
+  if (rlen && !recv_all(c->fd, &(*out)[0], rlen)) return -2;
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) { delete s; return nullptr; }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ||
+      ::listen(s->listen_fd, 128)) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->loop = std::thread(serve, s);
+  return s;
+}
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  s->loop.join();
+  ::close(s->listen_fd);
+  delete s;
+}
+
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, std::to_string(port).c_str(), &hints, &res) || !res)
+    return nullptr;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int fd = -1;
+  // retry until the server side comes up (launch-order independence)
+  while (std::chrono::steady_clock::now() < deadline) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void pts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+int pts_set(void* h, const char* key, const char* val, int vlen) {
+  std::string out;
+  return request(static_cast<Client*>(h), kSet, key,
+                 std::string(val, static_cast<size_t>(vlen)), &out);
+}
+
+// returns value length, or -1 missing / -2 io error; caller buffer
+int pts_get(void* h, const char* key, char* buf, int buflen) {
+  std::string out;
+  int32_t st = request(static_cast<Client*>(h), kGet, key, "", &out);
+  if (st != 0) return st;
+  int n = static_cast<int>(out.size());
+  if (n > buflen) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return n;
+}
+
+int64_t pts_add(void* h, const char* key, int64_t delta) {
+  std::string val(8, '\0');
+  std::memcpy(&val[0], &delta, 8);
+  std::string out;
+  int32_t st = request(static_cast<Client*>(h), kAdd, key, val, &out);
+  if (st != 0 || out.size() != 8) return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int pts_wait(void* h, const char* key, int64_t timeout_ms, char* buf,
+             int buflen) {
+  std::string val(8, '\0');
+  std::memcpy(&val[0], &timeout_ms, 8);
+  std::string out;
+  int32_t st = request(static_cast<Client*>(h), kWait, key, val, &out);
+  if (st != 0) return st;
+  int n = static_cast<int>(out.size());
+  if (n > buflen) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return n;
+}
+
+int64_t pts_num_keys(void* h) {
+  std::string out;
+  int32_t st = request(static_cast<Client*>(h), kNumKeys, "", "", &out);
+  if (st != 0 || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int pts_delete(void* h, const char* key) {
+  std::string out;
+  return request(static_cast<Client*>(h), kDelete, key, "", &out);
+}
+
+}  // extern "C"

@@ -11,6 +11,7 @@ from .collective import (Group, ProcessGroup, ReduceOp, all_gather, all_gather_o
                          irecv, isend, new_group, recv, reduce, reduce_scatter, scatter,
                          send, set_global_mesh, wait)
 from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized)
+from .store import TCPStore
 from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh
 from .parallel import DataParallel
 from . import auto_parallel  # noqa: F401

@@ -1,0 +1,230 @@
+"""TCPStore — native rendezvous KV store.
+
+API parity with the reference's store (ref paddle/phi/core/distributed/store/
+tcp_store.h TCPStore: set/get/add/wait + world-size barrier), used to
+bootstrap multi-host jobs before jax.distributed is up.  The data path is the
+C++ poll-loop server in ``csrc/tcp_store.cpp`` loaded via ctypes; when the
+shared object is missing (fresh checkout, no toolchain) a pure-Python
+``socketserver`` fallback with the same wire protocol semantics is used from
+``launch/rendezvous.py``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from typing import Optional
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libtcpstore.so"))
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _compile_to(src: str, out_path: str) -> bool:
+    """Compile to a temp file in the destination dir, then atomically rename —
+    concurrent ranks racing on first use must never CDLL a half-written .so."""
+    import subprocess
+    import tempfile
+
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so",
+                                   dir=os.path.dirname(out_path))
+        os.close(fd)
+        subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-o", tmp, src, "-lpthread"],
+                       check=True, capture_output=True)
+        os.replace(tmp, out_path)  # atomic on POSIX
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_CSRC, "tcp_store.cpp")
+        path = _LIB_PATH
+        if not os.path.exists(path):
+            if not os.path.exists(src):
+                return None
+            if not _compile_to(src, path):
+                # package dir may be read-only: build into a cache dir
+                cache = os.path.join(os.path.expanduser("~"), ".cache",
+                                     "paddle_tpu")
+                os.makedirs(cache, exist_ok=True)
+                path = os.path.join(cache, "libtcpstore.so")
+                if not os.path.exists(path) and not _compile_to(src, path):
+                    return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.pts_server_start.restype = ctypes.c_void_p
+        lib.pts_server_start.argtypes = [ctypes.c_int]
+        lib.pts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pts_client_connect.restype = ctypes.c_void_p
+        lib.pts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                           ctypes.c_int]
+        lib.pts_client_close.argtypes = [ctypes.c_void_p]
+        lib.pts_set.restype = ctypes.c_int
+        lib.pts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_int]
+        lib.pts_get.restype = ctypes.c_int
+        lib.pts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_int]
+        lib.pts_add.restype = ctypes.c_int64
+        lib.pts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+        lib.pts_wait.restype = ctypes.c_int
+        lib.pts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+        lib.pts_num_keys.restype = ctypes.c_int64
+        lib.pts_num_keys.argtypes = [ctypes.c_void_p]
+        lib.pts_delete.restype = ctypes.c_int
+        lib.pts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+_MAX_VAL = 1 << 20
+
+
+class TCPStore:
+    """ref TCPStore(host, port, is_master, world_size, timeout).
+
+    The master rank also runs the server; every rank (master included) is a
+    client. ``native`` is False when running on the Python fallback."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 120.0):
+        self.host, self.port = host, port
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        self._client = None
+        self._py = None
+        self._barrier_rounds: dict = {}
+        lib = _load()
+        if lib is not None:
+            if is_master:
+                self._server = lib.pts_server_start(port)
+                if not self._server:
+                    raise OSError(f"TCPStore: cannot bind port {port}")
+            self._client = lib.pts_client_connect(
+                host.encode(), port, int(timeout * 1000))
+            if not self._client:
+                if self._server:
+                    lib.pts_server_stop(self._server)
+                raise TimeoutError(
+                    f"TCPStore: cannot reach {host}:{port} within {timeout}s")
+        else:  # pure-Python fallback (JSON wire protocol, str values)
+            from .launch.rendezvous import KVServer, KVClient
+
+            if is_master:
+                self._py_server = KVServer(port)
+            self._py = KVClient(f"{host}:{port}")
+
+    @property
+    def native(self) -> bool:
+        return self._client is not None
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            self._py.set(key, data.decode("latin-1"))
+            return
+        if _lib.pts_set(self._client, key.encode(), data, len(data)) != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str) -> bytes:
+        """Blocking get (reference get waits for the key)."""
+        return self.wait(key, self.timeout)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        if self._py is not None:
+            v = self._py.get(key)
+            return None if v is None else v.encode("latin-1")
+        buf = ctypes.create_string_buffer(_MAX_VAL)
+        n = _lib.pts_get(self._client, key.encode(), buf, _MAX_VAL)
+        if n == -3:
+            raise ValueError(
+                f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
+        return None if n < 0 else buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._py is not None:
+            return self._py.add(key, delta)
+        v = _lib.pts_add(self._client, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        if self._py is not None:
+            deadline = time.time() + t
+            while time.time() < deadline:
+                v = self.try_get(key)
+                if v is not None:
+                    return v
+                time.sleep(0.05)
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {t}s")
+        buf = ctypes.create_string_buffer(_MAX_VAL)
+        n = _lib.pts_wait(self._client, key.encode(), int(t * 1000), buf,
+                          _MAX_VAL)
+        if n == -3:
+            raise ValueError(
+                f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
+        if n < 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {t}s")
+        return buf.raw[:n]
+
+    def delete_key(self, key: str) -> bool:
+        if self._py is not None:
+            return bool(self._py.set(key, "").get("ok"))  # no delete op; clear
+        return _lib.pts_delete(self._client, key.encode()) == 0
+
+    def num_keys(self) -> int:
+        if self._py is not None:
+            return len(self._py.list(""))
+        return int(_lib.pts_num_keys(self._client))
+
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None):
+        """All world_size ranks arrive before any leaves (ref barrier via
+        add + wait-for-count). Reusable: each call uses a fresh round-numbered
+        key, assuming every rank calls barrier() the same number of times
+        (the standard collective contract)."""
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        key = f"/{name}/{rnd}"
+        n = self.add(f"{key}/count", 1)
+        if n == self.world_size:
+            self.set(f"{key}/done", b"1")
+        self.wait(f"{key}/done", timeout)
+
+    def close(self):
+        lib = _lib
+        if self._client is not None and lib is not None:
+            lib.pts_client_close(self._client)
+            self._client = None
+        if self._server is not None and lib is not None:
+            lib.pts_server_stop(self._server)
+            self._server = None
+        if getattr(self, "_py_server", None) is not None:
+            self._py_server.stop()
+            self._py_server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -84,3 +84,11 @@ class Scope:
 
     def find_var(self, name):
         return self._impl.find_var(name)
+
+
+def TCPStore(*args, **kwargs):
+    """ref pybind binding core.TCPStore used by init_parallel_env
+    (parallel.py:279) — resolves to the native store."""
+    from ..distributed.store import TCPStore as _S
+
+    return _S(*args, **kwargs)

@@ -267,17 +267,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal,
-                    block_q, block_k, nq, seq_q, seq_k):
-    """dK/dV for one (batch·head, k-block): q/dO blocks stream innermost.
-    dV = Pᵀ·dO; dK = scale · dSᵀ·Q (scale applied per-block on the dk dot)."""
+                    block_q, block_k, nq, seq_q, seq_k, rep):
+    """dK/dV for one (batch·kv-head, k-block): the grid's two inner axes walk
+    the ``rep`` q heads sharing this kv head, then stream q/dO blocks.
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q (scale applied per-block on the dk dot).
+    GQA gradients accumulate in VMEM scratch across the whole (rep, qi)
+    plane — no redundant per-q-head kernel runs, no HBM rep-reduction."""
     from jax.experimental import pallas as pl
     scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
     k_blk = pl.program_id(1)
-    qi = pl.program_id(2)
+    r = pl.program_id(2)
+    qi = pl.program_id(3)
     offs = seq_k - seq_q
 
-    @pl.when(qi == 0)
+    @pl.when(jnp.logical_and(r == 0, qi == 0))
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
@@ -291,10 +295,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _compute():
         k = _mxu(k_ref[0])
         v = _mxu(v_ref[0])
-        q = _mxu(q_ref[0])
-        do = _mxu(do_ref[0])
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        q = _mxu(q_ref[0, 0])
+        do = _mxu(do_ref[0, 0])
+        lse = lse_ref[0, 0, 0]
+        delta = delta_ref[0, 0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -310,7 +314,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(qi == nq - 1)
+    @pl.when(jnp.logical_and(r == rep - 1, qi == nq - 1))
     def _finish():
         dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -318,9 +322,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal: bool, scale: float,
                            block_q: int = 512, block_k: int = 512):
-    """Pallas flash backward. GQA: dk/dv are computed per q-head with the
-    same kv BlockSpec routing as forward (no HBM repeat of K/V), then summed
-    over the rep group."""
+    """Pallas flash backward. GQA-native: dq routes kv blocks per q head (no
+    HBM repeat of K/V); dk/dv accumulate over the rep q heads inside the
+    kernel grid (see _bwd_dkv_kernel) — no [B,H,Sk,D] intermediate."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -363,38 +367,44 @@ def _flash_bwd_bhsd_stream(q, k, v, do, lse, delta, causal: bool, scale: float,
         interpret=_interpret(),
     )(q_r, k_r, v_r, do_r, lse_r, delta_r)
 
-    dk_h, dv_h = pl.pallas_call(
+    q_g = q.reshape(B * Hkv, rep, Sq, D)
+    do_g = do.reshape(B * Hkv, rep, Sq, D)
+    lse_g = lse.reshape(B * Hkv, rep, 1, Sq)
+    delta_g = delta.reshape(B * Hkv, rep, 1, Sq)
+    dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, nq=nq, seq_q=Sq, seq_k=Sk),
-        grid=(B * H, nk, nq),
+                          block_q=bq, block_k=bk, nq=nq, seq_q=Sq, seq_k=Sk,
+                          rep=rep),
+        grid=(B * Hkv, nk, rep, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, kb, qi: (b, qi, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (kv_head(b), kb, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (kv_head(b), kb, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, kb, qi: (b, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, kb, qi: (b, 0, qi)),
-            pl.BlockSpec((1, 1, bq), lambda b, kb, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, kb, r, qi: (b, r, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, r, qi: (b, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, r, qi: (b, kb, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, kb, r, qi: (b, r, qi, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, kb, r, qi: (b, r, 0, qi)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, kb, r, qi: (b, r, 0, qi)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (b, kb, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, kb, qi: (b, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, r, qi: (b, kb, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, kb, r, qi: (b, kb, 0)),
         ],
         out_shape=[
-            _sds((B * H, Sk, D), k.dtype, vma),
-            _sds((B * H, Sk, D), v.dtype, vma),
+            _sds((B * Hkv, Sk, D), k.dtype, vma),
+            _sds((B * Hkv, Sk, D), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
         interpret=_interpret(),
-    )(q_r, k_r, v_r, do_r, lse_r, delta_r)
+    )(q_g, k_r, v_r, do_g, lse_g, delta_g)
 
     dq = dq.reshape(B, H, Sq, D)
-    dk = dk_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(k.dtype)
-    dv = dv_h.reshape(B, Hkv, rep, Sk, D).sum(axis=2).astype(v.dtype)
+    dk = dk.reshape(B, Hkv, Sk, D)
+    dv = dv.reshape(B, Hkv, Sk, D)
     return dq, dk, dv
 
 
@@ -539,7 +549,14 @@ def _bwd_dq_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel_loop(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, seq_q, seq_k):
     """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
-    dV = Pᵀ·dO; dK = scale · dSᵀ·Q."""
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q.
+
+    GQA note: this full-K form runs per *query* head and reduces dk/dv over
+    the rep group afterwards. Folding the rep axis into the grid (as the
+    stream form does) was measured 2026-07: rep-innermost refetches the full
+    Sq·D q/dO slab Sk/bk times — a net HBM regression; rep-outermost breaks
+    the consecutive-revisit rule for the output accumulator. The redundant
+    [B,H,Sk,D] intermediate is ~16 MB at the S≤8192 sizes this form serves."""
     from jax.experimental import pallas as pl
     scale = jnp.float32(scale)  # np.float64 scale must not promote f32 math
 
@@ -659,14 +676,27 @@ def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
 _FULL_K_MAX = 8192
 
 
-def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=512, block_k=512):
+def _block_defaults():
+    """Tuning knobs (benchmarked via bench.py A/B; microbenchmarks are
+    unreliable through the remote-TPU tunnel)."""
+    import os
+
+    return (int(os.environ.get("PT_FLASH_BLOCK_Q", 512)),
+            int(os.environ.get("PT_FLASH_BLOCK_K", 512)))
+
+
+def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
+    dq, dk = _block_defaults()
+    block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_fwd_bhsd_loop(q, k, v, causal, scale, block_q, block_k)
     return _flash_fwd_bhsd_stream(q, k, v, causal, scale, block_q, block_k)
 
 
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal, scale,
-                    block_q=512, block_k=512):
+                    block_q=None, block_k=None):
+    dq, dk = _block_defaults()
+    block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal, scale,
                                     block_q, block_k)

@@ -1,0 +1,152 @@
+"""Native C++ TCPStore (csrc/tcp_store.cpp via ctypes; ref
+paddle/phi/core/distributed/store/tcp_store.cc)."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.distributed import TCPStore
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def store():
+    s = TCPStore("127.0.0.1", _free_port(), is_master=True, world_size=1,
+                 timeout=10)
+    yield s
+    s.close()
+
+
+class TestTCPStoreNative:
+    def test_uses_native_backend(self, store):
+        assert store.native  # libtcpstore.so built and loaded
+
+    def test_set_get(self, store):
+        store.set("alpha", b"hello")
+        assert store.try_get("alpha") == b"hello"
+        assert store.get("alpha") == b"hello"
+        assert store.try_get("missing") is None
+
+    def test_add_counter(self, store):
+        assert store.add("cnt", 5) == 5
+        assert store.add("cnt", 3) == 8
+        assert store.add("cnt", -1) == 7
+
+    def test_wait_blocks_until_set(self, store):
+        def setter():
+            import time
+
+            time.sleep(0.3)
+            store2 = TCPStore("127.0.0.1", store.port, is_master=False,
+                             world_size=1, timeout=5)
+            store2.set("late", b"arrived")
+            store2.close()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        assert store.wait("late", timeout=5) == b"arrived"
+        t.join()
+
+    def test_wait_timeout(self, store):
+        with pytest.raises(TimeoutError):
+            store.wait("never", timeout=0.3)
+
+    def test_num_keys_delete(self, store):
+        store.set("a", b"1")
+        store.set("b", b"2")
+        assert store.num_keys() == 2
+        assert store.delete_key("a")
+        assert store.num_keys() == 1
+        assert not store.delete_key("a")
+
+    def test_multi_client_barrier(self):
+        """3 'ranks' (threads with their own client connections) all arrive."""
+        port = _free_port()
+        master = TCPStore("127.0.0.1", port, is_master=True, world_size=3,
+                          timeout=10)
+        results = []
+
+        def worker():
+            c = TCPStore("127.0.0.1", port, is_master=False, world_size=3,
+                         timeout=10)
+            c.barrier("b0", timeout=10)
+            results.append(1)
+            c.close()
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        master.barrier("b0", timeout=10)
+        for t in ts:
+            t.join()
+        assert len(results) == 2
+        master.close()
+
+    def test_barrier_is_reusable(self):
+        """Successive barriers must each synchronize (round-numbered keys)."""
+        port = _free_port()
+        master = TCPStore("127.0.0.1", port, is_master=True, world_size=2,
+                          timeout=10)
+        worker = TCPStore("127.0.0.1", port, is_master=False, world_size=2,
+                          timeout=10)
+        order = []
+
+        def w():
+            worker.barrier("r")
+            order.append("w1")
+            worker.barrier("r")
+            order.append("w2")
+
+        t = threading.Thread(target=w)
+        t.start()
+        master.barrier("r")
+        master.barrier("r")
+        t.join()
+        assert order == ["w1", "w2"]
+        # a third round must still block until both arrive (fresh keys)
+        t2 = threading.Thread(target=lambda: worker.barrier("r"))
+        t2.start()
+        master.barrier("r")
+        t2.join(timeout=5)
+        assert not t2.is_alive()
+        worker.close()
+        master.close()
+
+    def test_oversized_value_raises(self, store):
+        store.set("big", b"x" * (2 << 20))
+        with pytest.raises(ValueError, match="exceeds"):
+            store.try_get("big")
+
+    def test_cross_process_client(self):
+        """A real subprocess connects to the in-process server (the actual
+        launch topology: master rank hosts, peers connect over TCP)."""
+        port = _free_port()
+        master = TCPStore("127.0.0.1", port, is_master=True, world_size=2,
+                          timeout=15)
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from paddle_tpu.distributed import TCPStore\n"
+            "s = TCPStore('127.0.0.1', %d, is_master=False, world_size=2, timeout=10)\n"
+            "s.set('from_child', b'pid-ok')\n"
+            "print(s.wait('from_parent', 10).decode())\n"
+            "s.close()\n" % (os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), port)
+        )
+        env = {k: v for k, v in os.environ.items()}
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE, env=env)
+        assert master.wait("from_child", 15) == b"pid-ok"
+        master.set("from_parent", b"parent-ok")
+        out, _ = proc.communicate(timeout=30)
+        assert proc.returncode == 0
+        assert b"parent-ok" in out
+        master.close()
