@@ -77,21 +77,29 @@ void reply(int fd, int32_t status, const std::string& val) {
   send_all(fd, out.data(), out.size());
 }
 
-// try to parse one complete request from conn.buf; returns false if more
-// bytes are needed. On success fills cmd/key/val and strips the request.
-bool parse_req(std::string& buf, uint8_t* cmd, std::string* key,
-               std::string* val) {
-  if (buf.size() < 9) return false;
+// sanity cap on wire lengths: anything larger is not our protocol (a stray
+// HTTP client would otherwise make us buffer its bytes forever)
+constexpr uint32_t kMaxKeyLen = 1 << 16;
+constexpr uint32_t kMaxValLen = 4 << 20;
+
+// parse one complete request from conn.buf. Returns 1 on success (cmd/key/val
+// filled, request stripped), 0 if more bytes are needed, -1 on protocol
+// violation (caller must close the connection).
+int parse_req(std::string& buf, uint8_t* cmd, std::string* key,
+              std::string* val) {
+  if (buf.size() < 9) return 0;
   uint32_t klen, vlen;
   std::memcpy(&klen, buf.data() + 1, 4);
-  if (buf.size() < 9 + klen) return false;
+  if (buf[0] < kSet || buf[0] > kDelete || klen > kMaxKeyLen) return -1;
+  if (buf.size() < 9 + klen) return 0;
   std::memcpy(&vlen, buf.data() + 5 + klen, 4);
-  if (buf.size() < 9 + klen + vlen) return false;
+  if (vlen > kMaxValLen) return -1;
+  if (buf.size() < 9 + klen + vlen) return 0;
   *cmd = static_cast<uint8_t>(buf[0]);
   key->assign(buf, 5, klen);
   val->assign(buf, 9 + klen, vlen);
   buf.erase(0, 9 + klen + vlen);
-  return true;
+  return 1;
 }
 
 void serve(Server* s) {
@@ -110,6 +118,9 @@ void serve(Server* s) {
       if (cfd >= 0) {
         int one = 1;
         setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // bound reply() sends: a stalled client must not wedge the poll loop
+        timeval tv{10, 0};
+        setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
         std::lock_guard<std::mutex> l(s->mu);
         s->conns[cfd];
       }
@@ -128,7 +139,12 @@ void serve(Server* s) {
       conn.buf.append(tmp, static_cast<size_t>(r));
       uint8_t cmd;
       std::string key, val;
-      while (parse_req(conn.buf, &cmd, &key, &val)) {
+      int st;
+      while ((st = parse_req(conn.buf, &cmd, &key, &val)) != 0) {
+        if (st < 0) {  // not our protocol: drop the connection
+          closed.push_back(fds[i].fd);
+          break;
+        }
         switch (cmd) {
           case kSet:
             s->kv[key] = val;

@@ -153,6 +153,27 @@ class Engine:
         losses = [float(np.asarray(eng.eval_batch(*batch).value)) for batch in loader]
         return {"loss": float(np.mean(losses))}
 
+    def predict(self, test_data, batch_size=1):
+        """Ref engine.py predict — forward-only over a dataset."""
+        from ...io import DataLoader
+
+        # trained weights live in the engine's donated buffers; flow them
+        # back into the Layer before predicting with it
+        self._ensure().sync_to_model()
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size)
+        outs = []
+        for batch in loader:
+            # same convention as the train step: last element is the label
+            if isinstance(batch, (list, tuple)) and len(batch) > 1:
+                xs = batch[:-1]
+            elif isinstance(batch, (list, tuple)):
+                xs = batch
+            else:
+                xs = [batch]
+            outs.append(self.model(*xs))
+        return outs
+
     def save(self, path, training=True):
         from ...framework.io_state import save
 
@@ -174,4 +195,6 @@ def get_mesh():
     return get_global_mesh()
 
 
+from .partition import (Cluster, CompletedProgram, Completer, Converter,  # noqa: E402
+                        Partitioner, Resharder)
 from .tuner import ClusterDesc, ModelDesc, RuleBasedTuner, TunedStrategy, tune  # noqa: E402

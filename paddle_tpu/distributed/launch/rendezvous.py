@@ -37,6 +37,8 @@ class _KVHandler(socketserver.StreamRequestHandler):
                     prefix = req["key"]
                     resp = {"ok": True, "value": {k: v for k, v in store.items()
                                                   if k.startswith(prefix)}}
+                elif req["op"] == "del":
+                    resp = {"ok": store.pop(req["key"], None) is not None}
                 else:
                     resp = {"ok": False}
             self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -88,6 +90,9 @@ class KVClient:
 
     def list(self, prefix):
         return self._req(op="list", key=prefix)["value"]
+
+    def delete(self, key) -> bool:
+        return bool(self._req(op="del", key=key).get("ok"))
 
 
 class HTTPMaster:

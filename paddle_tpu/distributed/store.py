@@ -28,6 +28,7 @@ def _compile_to(src: str, out_path: str) -> bool:
     import subprocess
     import tempfile
 
+    tmp = None
     try:
         fd, tmp = tempfile.mkstemp(suffix=".so",
                                    dir=os.path.dirname(out_path))
@@ -38,10 +39,11 @@ def _compile_to(src: str, out_path: str) -> bool:
         os.replace(tmp, out_path)  # atomic on POSIX
         return True
     except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
@@ -52,6 +54,10 @@ def _load():
             return _lib
         src = os.path.join(_CSRC, "tcp_store.cpp")
         path = _LIB_PATH
+        stale = (os.path.exists(path) and os.path.exists(src) and
+                 os.path.getmtime(src) > os.path.getmtime(path))
+        if stale:
+            _compile_to(src, path)  # refresh; on failure keep the old binary
         if not os.path.exists(path):
             if not os.path.exists(src):
                 return None
@@ -155,6 +161,9 @@ class TCPStore:
             return None if v is None else v.encode("latin-1")
         buf = ctypes.create_string_buffer(_MAX_VAL)
         n = _lib.pts_get(self._client, key.encode(), buf, _MAX_VAL)
+        if n == -2:
+            raise ConnectionError(
+                f"TCPStore: connection to {self.host}:{self.port} lost")
         if n == -3:
             raise ValueError(
                 f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
@@ -181,6 +190,9 @@ class TCPStore:
         buf = ctypes.create_string_buffer(_MAX_VAL)
         n = _lib.pts_wait(self._client, key.encode(), int(t * 1000), buf,
                           _MAX_VAL)
+        if n == -2:
+            raise ConnectionError(
+                f"TCPStore: connection to {self.host}:{self.port} lost")
         if n == -3:
             raise ValueError(
                 f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
@@ -190,7 +202,7 @@ class TCPStore:
 
     def delete_key(self, key: str) -> bool:
         if self._py is not None:
-            return bool(self._py.set(key, "").get("ok"))  # no delete op; clear
+            return self._py.delete(key)
         return _lib.pts_delete(self._client, key.encode()) == 0
 
     def num_keys(self) -> int:

@@ -1,0 +1,120 @@
+"""auto_parallel Completer/Partitioner/Resharder/Converter (ref
+auto_parallel completion.py/partitioner.py/reshard.py/converter.py): assert
+on sharding artifacts without N real devices — the reference's
+program-text-test pattern (SURVEY §4) on jaxpr/HLO instead."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import (Cluster, Completer,
+                                                  Converter, Partitioner,
+                                                  Resharder)
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "mp"))
+
+
+class TestCompleter:
+    def test_hlo_carries_shardings(self):
+        mesh = _mesh()
+
+        def fn(x, w):
+            return x @ w
+
+        x = jnp.ones((16, 32))
+        w = jnp.ones((32, 64))
+        prog = Completer(mesh).complete(fn, x, w,
+                                        in_specs=[P("dp", None), P(None, "mp")])
+        assert "sharding" in prog.hlo_text  # GSPMD annotations present
+        assert len(prog.input_shardings()) == 2
+
+    def test_output_shardings_propagated(self):
+        mesh = _mesh()
+        prog = Completer(mesh).complete(lambda x: x * 2, jnp.ones((8, 8)),
+                                        in_specs=[P("dp", None)])
+        (out,) = prog.output_shardings()
+        # elementwise op: the dp row sharding must propagate to the output
+        assert out.spec == P("dp") or out.spec == P("dp", None)
+
+
+class TestPartitioner:
+    def test_local_shapes(self):
+        mesh = _mesh()
+        part = Partitioner(mesh)
+        assert part.local_shape((16, 64), P("dp", "mp")) == (4, 32)
+        assert part.local_shape((16, 64), P(None, "mp")) == (16, 32)
+        assert part.local_shape((16, 64), None) == (16, 64)
+
+    def test_partition_state(self):
+        mesh = _mesh()
+        state = {"w": np.zeros((8, 8)), "b": np.zeros((8,))}
+        shapes = Partitioner(mesh).partition_state(
+            state, {"w": P(None, "mp"), "b": None})
+        assert shapes == {"w": (8, 4), "b": (8,)}
+
+
+class TestReshardConvert:
+    def test_reshard_changes_layout(self):
+        mesh = _mesh()
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        y = Resharder(mesh).reshard(x, P("dp", None))
+        assert y.sharding.spec == P("dp", None)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_converter_checkpoint_reshard(self):
+        """Params saved replicated load back mp-sharded with identical
+        values — the strategy-change resume flow (ref converter.py)."""
+        mesh = _mesh()
+        sd = {"w": np.arange(32, dtype=np.float32).reshape(4, 8)}
+        out = Converter(sd).convert(mesh, {"w": P(None, "mp")})
+        assert out["w"].sharding.spec == P(None, "mp")
+        np.testing.assert_array_equal(np.asarray(out["w"]), sd["w"])
+
+
+class TestEnginePredict:
+    def test_predict_uses_trained_weights(self):
+        """fit() trains inside the ParallelEngine's donated buffers; predict
+        must see those weights, not the Layer's initial ones."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.auto_parallel import Engine
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.x = rng.rand(32, 4).astype("float32")
+                self.y = self.x.sum(1, keepdims=True).astype("float32")
+
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+        model = nn.Linear(4, 1)
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=model.parameters())
+        eng = Engine(model=model, loss=nn.functional.mse_loss, optimizer=opt)
+        before = np.array(model.weight.numpy())
+        eng.fit(DS(), epochs=3, batch_size=8, verbose=0)
+        preds = eng.predict(DS(), batch_size=8)
+        # weights must have left their initial values in the Layer itself
+        assert not np.allclose(before, model.weight.numpy())
+        ds = DS()
+        mse = float(np.mean((np.concatenate(
+            [np.asarray(p) for p in preds]) - ds.y) ** 2))
+        init_mse = float(np.mean((ds.x @ before + 0 - ds.y) ** 2))
+        assert mse < init_mse  # predictions reflect training
+
+
+class TestCluster:
+    def test_cluster_describes_devices(self):
+        c = Cluster()
+        assert c.device_count >= 8
+        assert c.machine_count() >= 1
+        assert len(c.devices) == c.device_count
+        assert c.device_kinds()

@@ -120,6 +120,17 @@ class TestTCPStoreNative:
         worker.close()
         master.close()
 
+    def test_garbage_protocol_connection_dropped(self, store):
+        """A non-protocol client (port scanner, stray HTTP) must be dropped,
+        not buffered forever, and must not wedge real clients."""
+        with socket.create_connection(("127.0.0.1", store.port),
+                                      timeout=5) as s:
+            s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+            s.settimeout(5)
+            assert s.recv(64) == b""  # server closed on us
+        store.set("still-alive", b"yes")
+        assert store.try_get("still-alive") == b"yes"
+
     def test_oversized_value_raises(self, store):
         store.set("big", b"x" * (2 << 20))
         with pytest.raises(ValueError, match="exceeds"):
