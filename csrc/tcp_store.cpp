@@ -36,7 +36,7 @@
 namespace {
 
 enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kNumKeys = 5,
-                     kDelete = 6 };
+                     kDelete = 6, kSetNx = 7 };
 
 struct Server {
   int listen_fd = -1;
@@ -67,14 +67,16 @@ bool send_all(int fd, const void* p, size_t n) {
   return true;
 }
 
-void reply(int fd, int32_t status, const std::string& val) {
+// returns false on send failure (timeout/dead peer): the caller must close
+// the connection — a partially-written frame would desync every later reply
+bool reply(int fd, int32_t status, const std::string& val) {
   std::string out;
   out.resize(8 + val.size());
   uint32_t vlen = static_cast<uint32_t>(val.size());
   std::memcpy(&out[0], &status, 4);
   std::memcpy(&out[4], &vlen, 4);
   std::memcpy(&out[8], val.data(), val.size());
-  send_all(fd, out.data(), out.size());
+  return send_all(fd, out.data(), out.size());
 }
 
 // sanity cap on wire lengths: anything larger is not our protocol (a stray
@@ -90,7 +92,7 @@ int parse_req(std::string& buf, uint8_t* cmd, std::string* key,
   if (buf.size() < 9) return 0;
   uint32_t klen, vlen;
   std::memcpy(&klen, buf.data() + 1, 4);
-  if (buf[0] < kSet || buf[0] > kDelete || klen > kMaxKeyLen) return -1;
+  if (buf[0] < kSet || buf[0] > kSetNx || klen > kMaxKeyLen) return -1;
   if (buf.size() < 9 + klen) return 0;
   std::memcpy(&vlen, buf.data() + 5 + klen, 4);
   if (vlen > kMaxValLen) return -1;
@@ -140,20 +142,35 @@ void serve(Server* s) {
       uint8_t cmd;
       std::string key, val;
       int st;
-      while ((st = parse_req(conn.buf, &cmd, &key, &val)) != 0) {
+      bool drop = false;
+      auto rep = [&](int32_t status, const std::string& v) {
+        if (!reply(fds[i].fd, status, v)) drop = true;
+      };
+      while (!drop && (st = parse_req(conn.buf, &cmd, &key, &val)) != 0) {
         if (st < 0) {  // not our protocol: drop the connection
-          closed.push_back(fds[i].fd);
+          drop = true;
           break;
         }
         switch (cmd) {
           case kSet:
             s->kv[key] = val;
-            reply(fds[i].fd, 0, "");
+            rep(0, "");
             break;
+          case kSetNx: {
+            // claim-if-absent: the crash-safe slot primitive sync_peers uses
+            auto it = s->kv.find(key);
+            if (it == s->kv.end()) {
+              s->kv[key] = val;
+              rep(0, val);
+            } else {
+              rep(-1, it->second);
+            }
+            break;
+          }
           case kGet: {
             auto it = s->kv.find(key);
-            if (it == s->kv.end()) reply(fds[i].fd, -1, "");
-            else reply(fds[i].fd, 0, it->second);
+            if (it == s->kv.end()) rep(-1, "");
+            else rep(0, it->second);
             break;
           }
           case kAdd: {
@@ -167,13 +184,13 @@ void serve(Server* s) {
             std::string enc(8, '\0');
             std::memcpy(&enc[0], &cur, 8);
             s->kv[key] = enc;
-            reply(fds[i].fd, 0, enc);
+            rep(0, enc);
             break;
           }
           case kWait: {
             auto it = s->kv.find(key);
             if (it != s->kv.end()) {
-              reply(fds[i].fd, 0, it->second);
+              rep(0, it->second);
             } else {
               int64_t timeout_ms = 0;
               if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
@@ -188,16 +205,17 @@ void serve(Server* s) {
             int64_t n = static_cast<int64_t>(s->kv.size());
             std::string enc(8, '\0');
             std::memcpy(&enc[0], &n, 8);
-            reply(fds[i].fd, 0, enc);
+            rep(0, enc);
             break;
           }
           case kDelete:
-            reply(fds[i].fd, s->kv.erase(key) ? 0 : -1, "");
+            rep(s->kv.erase(key) ? 0 : -1, "");
             break;
           default:
-            closed.push_back(fds[i].fd);
+            drop = true;
         }
       }
+      if (drop) closed.push_back(fds[i].fd);
     }
     // resolve parked WAITs (key arrived or deadline passed)
     {
@@ -207,10 +225,10 @@ void serve(Server* s) {
         if (!c.waiting) continue;
         auto it = s->kv.find(c.wait_key);
         if (it != s->kv.end()) {
-          reply(fd, 0, it->second);
+          if (!reply(fd, 0, it->second)) closed.push_back(fd);
           c.waiting = false;
         } else if (now >= c.wait_deadline) {
-          reply(fd, -1, "");
+          if (!reply(fd, -1, "")) closed.push_back(fd);
           c.waiting = false;
         }
       }
@@ -380,6 +398,21 @@ int64_t pts_num_keys(void* h) {
 int pts_delete(void* h, const char* key) {
   std::string out;
   return request(static_cast<Client*>(h), kDelete, key, "", &out);
+}
+
+// set-if-absent. Returns 0 when this caller claimed the key; -1 when it
+// already existed (current value copied into buf); -2 on I/O error.
+// buf receives the key's value either way (claimed value or existing one).
+int pts_setnx(void* h, const char* key, const char* val, int vlen, char* buf,
+              int buflen) {
+  std::string out;
+  int32_t st = request(static_cast<Client*>(h), kSetNx, key,
+                       std::string(val, static_cast<size_t>(vlen)), &out);
+  if (st == -2) return -2;
+  int n = static_cast<int>(out.size());
+  if (n > buflen) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return st == 0 ? 0 : -1;
 }
 
 }  // extern "C"

@@ -125,13 +125,17 @@ class Engine:
                                           donate=False)
         return self._engine
 
-    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
-            log_freq=10, verbose=1):
+    @staticmethod
+    def _loader(data, batch_size):
         from ...io import DataLoader
 
+        return data if isinstance(data, DataLoader) else DataLoader(
+            data, batch_size=batch_size)
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1):
         eng = self._ensure()
-        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
-            train_data, batch_size=batch_size)
+        loader = self._loader(train_data, batch_size)
         history = []
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
@@ -145,32 +149,29 @@ class Engine:
         return history
 
     def evaluate(self, eval_data, batch_size=1):
-        from ...io import DataLoader
-
         eng = self._ensure()
-        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
-            eval_data, batch_size=batch_size)
+        loader = self._loader(eval_data, batch_size)
         losses = [float(np.asarray(eng.eval_batch(*batch).value)) for batch in loader]
         return {"loss": float(np.mean(losses))}
 
-    def predict(self, test_data, batch_size=1):
-        """Ref engine.py predict — forward-only over a dataset."""
-        from ...io import DataLoader
+    def predict(self, test_data, batch_size=1, has_labels=True):
+        """Ref engine.py predict — forward-only over a dataset.
 
+        ``has_labels``: whether each batch's LAST element is a label to drop
+        (the train-step convention). Pass False for unlabeled test data so
+        multi-input models receive every element."""
         # trained weights live in the engine's donated buffers; flow them
         # back into the Layer before predicting with it
         self._ensure().sync_to_model()
-        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
-            test_data, batch_size=batch_size)
+        loader = self._loader(test_data, batch_size)
         outs = []
         for batch in loader:
-            # same convention as the train step: last element is the label
-            if isinstance(batch, (list, tuple)) and len(batch) > 1:
-                xs = batch[:-1]
-            elif isinstance(batch, (list, tuple)):
-                xs = batch
-            else:
+            if not isinstance(batch, (list, tuple)):
                 xs = [batch]
+            elif has_labels and len(batch) > 1:
+                xs = batch[:-1]
+            else:
+                xs = batch
             outs.append(self.model(*xs))
         return outs
 

@@ -39,6 +39,14 @@ class _KVHandler(socketserver.StreamRequestHandler):
                                                   if k.startswith(prefix)}}
                 elif req["op"] == "del":
                     resp = {"ok": store.pop(req["key"], None) is not None}
+                elif req["op"] == "setnx":
+                    if req["key"] in store:
+                        resp = {"ok": True, "claimed": False,
+                                "value": store[req["key"]]}
+                    else:
+                        store[req["key"]] = req["value"]
+                        resp = {"ok": True, "claimed": True,
+                                "value": req["value"]}
                 else:
                     resp = {"ok": False}
             self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -94,28 +102,50 @@ class KVClient:
     def delete(self, key) -> bool:
         return bool(self._req(op="del", key=key).get("ok"))
 
+    def setnx(self, key, value):
+        return self._req(op="setnx", key=key, value=value)
+
 
 class HTTPMaster:
     """sync_peers barrier (ref master.py:54,65): every node publishes its
-    endpoint, waits until all N are present, gets a deterministic rank."""
+    endpoint, waits until all N are present, gets a deterministic rank.
 
-    def __init__(self, master_endpoint: str, is_master: bool, nnodes: int):
+    Backed by the native C++ TCPStore (csrc/tcp_store.cpp) when available —
+    join-order rank assignment via the store's atomic add() counter — with
+    the same algorithm over the pure-Python KV fallback otherwise."""
+
+    def __init__(self, master_endpoint: str, is_master: bool, nnodes: int,
+                 timeout: float = 300.0):
+        from ..store import TCPStore
+
         self.endpoint = master_endpoint
         self.nnodes = nnodes
-        self.server: Optional[KVServer] = None
-        if is_master:
-            self.server = KVServer(int(master_endpoint.rsplit(":", 1)[1]))
-        self.client = KVClient(master_endpoint)
+        self.timeout = timeout
+        host, port = master_endpoint.rsplit(":", 1)
+        self.store = TCPStore(host, int(port), is_master=is_master,
+                              world_size=nnodes, timeout=timeout)
 
     def sync_peers(self, my_endpoint: str, job_id: str = "default") -> List[str]:
-        key = f"peers/{job_id}/{my_endpoint}"
-        self.client.set(key, my_endpoint)
-        while True:
-            peers = self.client.list(f"peers/{job_id}/")
-            if len(peers) >= self.nnodes:
-                return sorted(peers.values())
-            time.sleep(0.3)
+        # claim slot 0..n-1 via atomic set-if-absent; idempotent under
+        # restart (a relaunched node with the same endpoint re-finds its
+        # slot) and crash-safe (a node that dies claims either nothing or a
+        # slot its replacement reuses — no orphaned counter values)
+        my = my_endpoint.encode()
+        claimed = None
+        for i in range(self.nnodes):
+            ok, cur = self.store.set_nx(f"peers/{job_id}/{i}", my)
+            if ok or cur == my:
+                claimed = i
+                break
+        if claimed is None:
+            raise RuntimeError(
+                f"rendezvous: all {self.nnodes} peer slots taken and "
+                f"{my_endpoint} is not among them (stale job_id {job_id!r}?)")
+        # every node reads the same numbered slots, so the list (and the
+        # endpoints.index-derived rank) is identical everywhere
+        return [self.store.wait(f"peers/{job_id}/{i}",
+                                self.timeout).decode()
+                for i in range(self.nnodes)]
 
     def stop(self):
-        if self.server:
-            self.server.stop()
+        self.store.close()

@@ -14,7 +14,7 @@ import ctypes
 import os
 import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 _LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libtcpstore.so"))
@@ -96,6 +96,10 @@ def _load():
         lib.pts_num_keys.argtypes = [ctypes.c_void_p]
         lib.pts_delete.restype = ctypes.c_int
         lib.pts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pts_setnx.restype = ctypes.c_int
+        lib.pts_setnx.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_char_p, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -199,6 +203,26 @@ class TCPStore:
         if n < 0:
             raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {t}s")
         return buf.raw[:n]
+
+    def set_nx(self, key: str, value) -> Tuple[bool, bytes]:
+        """Set-if-absent (atomic claim). Returns (claimed, current_value) —
+        the winning writer's value either way. The crash-safe primitive the
+        launch rendezvous builds rank slots on."""
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            r = self._py.setnx(key, data.decode("latin-1"))
+            return r["claimed"], r["value"].encode("latin-1")
+        buf = ctypes.create_string_buffer(_MAX_VAL)
+        n = _lib.pts_setnx(self._client, key.encode(), data, len(data), buf,
+                           _MAX_VAL)
+        if n == -2:
+            raise ConnectionError(
+                f"TCPStore: connection to {self.host}:{self.port} lost")
+        if n == -3:
+            raise ValueError(
+                f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
+        cur = self.try_get(key)
+        return n == 0, cur if cur is not None else data
 
     def delete_key(self, key: str) -> bool:
         if self._py is not None:

@@ -261,11 +261,26 @@ class LlamaMLP(Layer):
         self._sp = cfg.sequence_parallel
 
     def forward(self, x):
-        def mlp(v, wg, wu, wd):
-            return jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
+        from ..nn.quant import Int8Linear
 
-        out = apply_op(mlp, x, self.gate_proj.weight, self.up_proj.weight,
-                       self.down_proj.weight, op_name="linear")
+        if isinstance(self.gate_proj, Int8Linear):  # weight-only decode mode
+            from ..ops.int8 import w8_matmul
+
+            def mlp8(v, wgq, sg, wuq, su, wdq, sd):
+                h = jax.nn.silu(w8_matmul(v, wgq, sg)) * w8_matmul(v, wuq, su)
+                return w8_matmul(h, wdq, sd)
+
+            out = apply_op(mlp8, x,
+                           self.gate_proj.weight_q, self.gate_proj.weight_scale,
+                           self.up_proj.weight_q, self.up_proj.weight_scale,
+                           self.down_proj.weight_q, self.down_proj.weight_scale,
+                           op_name="w8_mlp")
+        else:
+            def mlp(v, wg, wu, wd):
+                return jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
+
+            out = apply_op(mlp, x, self.gate_proj.weight, self.up_proj.weight,
+                           self.down_proj.weight, op_name="linear")
         if self._sp:
             out = shard_constraint(out, P("data", "sep", None))
         return out
@@ -381,6 +396,26 @@ class LlamaForCausalLM(Layer):
     def loss_fn(self, logits, labels):
         """Next-token CE with fp32 softmax (ParallelCrossEntropy math)."""
         return F.cross_entropy(logits, labels, reduction="mean")
+
+    def quantize_int8(self):
+        """Convert every projection (q/k/v/o, gate/up/down, lm_head) to
+        weight-only int8 for decode (ref fused_multi_transformer_int8 /
+        weight-only PTQ; TPU rationale in ops/int8.py: decode tokens/s is
+        HBM-bound on parameter bytes, int8 halves them). Embedding stays in
+        the model dtype (it is gathered, not matmul'd). In-place; returns
+        self. Use for inference only — int8 weights do not train."""
+        from ..nn.quant import Int8Linear
+
+        for layer in self.model.layers:
+            att, mlp = layer.self_attn, layer.mlp
+            for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                setattr(att, name, Int8Linear.from_linear(getattr(att, name)))
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                setattr(mlp, name, Int8Linear.from_linear(getattr(mlp, name)))
+        if not self.cfg.tie_word_embeddings:
+            self.lm_head = Int8Linear.from_linear(self.lm_head)
+        self._gen_cache = {}  # old compiled loops close over bf16 params
+        return self
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,

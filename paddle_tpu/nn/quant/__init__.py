@@ -6,6 +6,7 @@ from .functional_layers import (  # noqa: F401
 )
 from .lsq import FakeQuantActLSQPlus, FakeQuantWeightLSQPlus  # noqa: F401
 from .quant_layers import (  # noqa: F401
+    Int8Linear,
     FakeQuantAbsMax, FakeQuantChannelWiseAbsMax, FakeQuantMAOutputScaleLayer,
     FakeQuantMovingAverageAbsMax, MAOutputScaleLayer, MovingAverageAbsMaxScale,
     QuantizedColumnParallelLinear, QuantizedConv2D, QuantizedConv2DTranspose,

@@ -201,3 +201,43 @@ class FakeQuantMAOutputScaleLayer(Layer):
         if isinstance(out, (list, tuple)) and len(out) > 1:
             return out
         return self._fake_quant_output(out)
+
+
+class Int8Linear(Layer):
+    """Weight-only int8 linear for HBM-bound decode (ref
+    fused_multi_transformer_int8_op.cu weight-only path; see ops/int8.py).
+
+    Holds w_q (int8, [K,N]) and per-channel scale as BUFFERS so
+    jit.state_values / functional_call carry them through compiled
+    generation. Built from a trained Linear via ``from_linear``."""
+
+    def __init__(self, w_q, scale, bias=None, name=None):
+        super().__init__()
+        from ...framework.core import Tensor as _T
+
+        self.register_buffer("weight_q", _T(w_q))
+        self.register_buffer("weight_scale", _T(scale))
+        self._has_bias = bias is not None
+        if self._has_bias:
+            self.register_buffer("bias", bias)
+        self.in_features = int(w_q.shape[0])
+        self.out_features = int(w_q.shape[1])
+
+    @classmethod
+    def from_linear(cls, linear):
+        from ...ops.int8 import quantize_per_channel
+
+        w_q, scale = quantize_per_channel(linear.weight.value)
+        return cls(w_q, scale, bias=getattr(linear, "bias", None))
+
+    def forward(self, x):
+        from ...framework.dispatch import apply_op
+        from ...ops.int8 import w8_matmul
+
+        if self._has_bias:
+            return apply_op(lambda v, wq, s, b: w8_matmul(v, wq, s) + b,
+                            x, self.weight_q, self.weight_scale, self.bias,
+                            op_name="w8_linear")
+        return apply_op(lambda v, wq, s: w8_matmul(v, wq, s),
+                        x, self.weight_q, self.weight_scale,
+                        op_name="w8_linear")

@@ -1,0 +1,91 @@
+"""Weight-only int8 decode (ops/int8.py, nn.quant.Int8Linear,
+LlamaForCausalLM.quantize_int8; ref fused_multi_transformer_int8 weight-only
+path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+class TestW8Matmul:
+    def test_quantize_roundtrip_error(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.int8 import quantize_per_channel
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(64, 32).astype("float32")
+        w_q, scale = quantize_per_channel(w)
+        assert w_q.dtype == jnp.int8 and scale.shape == (32,)
+        deq = np.asarray(w_q, np.float32) * np.asarray(scale)[None, :]
+        # absmax symmetric per channel: max error bounded by scale/2
+        err = np.abs(deq - w)
+        assert (err <= np.asarray(scale)[None, :] * 0.5 + 1e-6).all()
+
+    def test_w8_matmul_matches_dequant_reference(self):
+        from paddle_tpu.ops.int8 import quantize_per_channel, w8_matmul
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 7, 64).astype("float32")
+        w = rng.randn(64, 128).astype("float32")
+        w_q, scale = quantize_per_channel(w)
+        out = np.asarray(w8_matmul(x, w_q, scale))
+        ref = x @ (np.asarray(w_q, np.float32) * np.asarray(scale)[None, :])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestInt8Llama:
+    def _model(self):
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=96,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=64,
+                          dtype="float32", use_flash_attention=False,
+                          tie_word_embeddings=False)
+        paddle.seed(0)
+        return LlamaForCausalLM(cfg)
+
+    def test_quantized_logits_close_to_full(self):
+        m = self._model()
+        ids = paddle.to_tensor(np.arange(12, dtype="int32").reshape(1, 12) % 128)
+        full = np.asarray(m(ids).value)
+        m.quantize_int8()
+        quant = np.asarray(m(ids).value)
+        # int8 weight-only: logits track the full model closely
+        denom = np.maximum(np.abs(full).max(), 1e-6)
+        assert np.abs(quant - full).max() / denom < 0.05
+
+    def test_quantized_generate_runs_greedy(self):
+        m = self._model()
+        ids = paddle.to_tensor(np.array([[5, 7, 11]], dtype="int32"))
+        ref = np.asarray(m.generate(ids, max_new_tokens=6).value)
+        m.quantize_int8()
+        out = np.asarray(m.generate(ids, max_new_tokens=6).value)
+        assert out.shape == (1, 9)
+        np.testing.assert_array_equal(out[:, :3], ref[:, :3])  # prompt kept
+        assert (out >= 0).all() and (out < 128).all()
+
+    def test_int8_state_is_int8(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit import state_values
+
+        m = self._model().quantize_int8()
+        sv = state_values(m)
+        q_keys = [k for k in sv if k.endswith("weight_q")]
+        assert len(q_keys) == 2 * 7 + 1  # 7 projections per layer + lm_head
+        assert all(sv[k].dtype == jnp.int8 for k in q_keys)
+        # bf16/f32 projection weights are gone from the state
+        assert not any(k.endswith("q_proj.weight") for k in sv)
+
+    def test_params_bytes_halved(self):
+        m = self._model()
+        def nbytes(model):
+            from paddle_tpu.jit import state_values
+
+            return sum(np.asarray(v).nbytes for k, v in state_values(model).items()
+                       if "embed" not in k)
+        before = nbytes(m)
+        m.quantize_int8()
+        after = nbytes(m)
+        assert after < before * 0.5 * 1.2  # int8 + f32 scales ≈ quarter of f32

@@ -136,6 +136,73 @@ class TestTCPStoreNative:
         with pytest.raises(ValueError, match="exceeds"):
             store.try_get("big")
 
+    def test_set_nx_atomic_claim(self, store):
+        ok1, v1 = store.set_nx("slot", b"alice")
+        ok2, v2 = store.set_nx("slot", b"bob")
+        assert ok1 and v1 == b"alice"
+        assert not ok2 and v2 == b"alice"  # loser sees the winner's value
+
+    def test_sync_peers_rejoin_after_restart(self):
+        """A relaunched node with the same endpoint must re-find its slot
+        (crash-safe rendezvous), not wedge the barrier."""
+        from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
+
+        port = _free_port()
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        r = {}
+        t = threading.Thread(
+            target=lambda: r.setdefault("w", w.sync_peers("10.0.0.2:7002")))
+        t.start()
+        eps = m.sync_peers("10.0.0.1:7001")
+        t.join()
+        assert eps == r["w"]
+        # "restart" of node 2: same endpoint syncs again and gets same list
+        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        assert w2.sync_peers("10.0.0.2:7002") == eps
+        w2.stop()
+        w.stop()
+        m.stop()
+
+    def test_http_master_sync_peers_native(self):
+        """Launch rendezvous over the native store: 3 nodes join, all see the
+        identical rank-ordered endpoint list (ref master.py sync_peers)."""
+        from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
+
+        port = _free_port()
+        results = {}
+
+        def node(i, is_master):
+            m = HTTPMaster(f"127.0.0.1:{port}", is_master, nnodes=3,
+                           timeout=15)
+            eps = m.sync_peers(f"10.0.0.{i}:700{i}", job_id="j1")
+            results[i] = eps
+            if not is_master:
+                m.stop()
+            return m
+
+        masters = {}
+
+        def run(i, is_master):
+            masters[i] = node(i, is_master)
+
+        ts = [threading.Thread(target=run, args=(i, i == 0))
+              for i in range(3)]
+        ts[0].start()
+        import time
+
+        time.sleep(0.3)  # let the master bind first
+        for t in ts[1:]:
+            t.start()
+        for t in ts:
+            t.join()
+        master = masters[0]
+        assert len(results) == 3
+        assert results[0] == results[1] == results[2]
+        assert sorted(results[0]) == ["10.0.0.0:7000", "10.0.0.1:7001",
+                                      "10.0.0.2:7002"]
+        master.stop()
+
     def test_cross_process_client(self):
         """A real subprocess connects to the in-process server (the actual
         launch topology: master rank hosts, peers connect over TCP)."""

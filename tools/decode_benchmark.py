@@ -21,6 +21,8 @@ def main():
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--new", type=int, default=128)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 decode (model.quantize_int8())")
     args = ap.parse_args()
 
     import jax
@@ -47,6 +49,8 @@ def main():
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    if args.int8:
+        model.quantize_int8()
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (args.batch, args.prompt)).astype("int32"))
@@ -65,8 +69,10 @@ def main():
             "unit": f"tok/s (B={args.batch}, {steps} steps, "
                     f"params={n_params/1e6:.0f}M)"}
     if hbm_bw:
-        ceiling = hbm_bw / (2.0 * n_params) * args.batch  # bf16 params
+        bytes_per_param = 1.0 if args.int8 else 2.0  # int8 vs bf16
+        ceiling = hbm_bw / (bytes_per_param * n_params) * args.batch
         line["roofline_tok_s"] = round(ceiling, 1)
+        line["weights"] = "int8" if args.int8 else "bf16" 
     import json
 
     print(json.dumps(line))
