@@ -400,11 +400,13 @@ int pts_delete(void* h, const char* key) {
   return request(static_cast<Client*>(h), kDelete, key, "", &out);
 }
 
-// set-if-absent. Returns 0 when this caller claimed the key; -1 when it
-// already existed (current value copied into buf); -2 on I/O error.
-// buf receives the key's value either way (claimed value or existing one).
+// set-if-absent. Returns the CURRENT value's length (the atomic winner's —
+// this caller's value if it claimed the key, the existing one otherwise),
+// copied into buf; *claimed is 1 when this caller won. -2 I/O error, -3
+// buffer too small. One round trip — no separate get needed (or wanted:
+// a second fetch would not be atomic with the claim).
 int pts_setnx(void* h, const char* key, const char* val, int vlen, char* buf,
-              int buflen) {
+              int buflen, int* claimed) {
   std::string out;
   int32_t st = request(static_cast<Client*>(h), kSetNx, key,
                        std::string(val, static_cast<size_t>(vlen)), &out);
@@ -412,7 +414,8 @@ int pts_setnx(void* h, const char* key, const char* val, int vlen, char* buf,
   int n = static_cast<int>(out.size());
   if (n > buflen) return -3;
   std::memcpy(buf, out.data(), out.size());
-  return st == 0 ? 0 : -1;
+  if (claimed) *claimed = (st == 0) ? 1 : 0;
+  return n;
 }
 
 }  // extern "C"

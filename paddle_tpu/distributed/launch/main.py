@@ -144,7 +144,11 @@ def launch(argv=None) -> int:
                                               master_ep.startswith(_local_ip()))
         master = HTTPMaster(master_ep, is_master, nnodes)
         my_ep = f"{_local_ip()}:{_free_port()}"
-        endpoints = master.sync_peers(my_ep, args.job_id)
+        # stable identity so a relaunch (fresh port) re-finds its rank slot:
+        # explicit env id > explicit rank > host ip (one node per host)
+        node_id = os.environ.get("PADDLE_NODE_ID") or (
+            f"rank{args.rank}" if args.rank >= 0 else _local_ip())
+        endpoints = master.sync_peers(my_ep, args.job_id, node_id=node_id)
         node_rank = endpoints.index(my_ep) if args.rank < 0 else args.rank
 
     restarts = 0

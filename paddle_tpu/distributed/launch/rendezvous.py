@@ -125,25 +125,34 @@ class HTTPMaster:
         self.store = TCPStore(host, int(port), is_master=is_master,
                               world_size=nnodes, timeout=timeout)
 
-    def sync_peers(self, my_endpoint: str, job_id: str = "default") -> List[str]:
-        # claim slot 0..n-1 via atomic set-if-absent; idempotent under
-        # restart (a relaunched node with the same endpoint re-finds its
-        # slot) and crash-safe (a node that dies claims either nothing or a
-        # slot its replacement reuses — no orphaned counter values)
-        my = my_endpoint.encode()
+    def sync_peers(self, my_endpoint: str, job_id: str = "default",
+                   node_id: str = None) -> List[str]:
+        """Claim rank slots 0..n-1 via atomic set-if-absent.
+
+        Slots are keyed by a STABLE node identity (``node_id``; defaults to
+        the endpoint), and the slot's endpoint is stored separately and
+        overwritable — so a node relaunched with a fresh port re-finds its
+        slot by identity and republishes its new endpoint instead of
+        wedging the barrier. Launch passes ``PADDLE_NODE_ID``/host identity
+        (launch/main.py); crash-safe: a node that dies mid-claim leaves
+        either nothing or a slot its replacement (same identity) reuses."""
+        me = (node_id or my_endpoint).encode()
         claimed = None
         for i in range(self.nnodes):
-            ok, cur = self.store.set_nx(f"peers/{job_id}/{i}", my)
-            if ok or cur == my:
+            ok, cur = self.store.set_nx(f"peers/{job_id}/owner/{i}", me)
+            if ok or cur == me:
                 claimed = i
                 break
         if claimed is None:
             raise RuntimeError(
-                f"rendezvous: all {self.nnodes} peer slots taken and "
-                f"{my_endpoint} is not among them (stale job_id {job_id!r}?)")
+                f"rendezvous: all {self.nnodes} peer slots taken and node id "
+                f"{me.decode()!r} owns none of them (stale job_id "
+                f"{job_id!r}?)")
+        # endpoint may change across restarts: plain set, not set_nx
+        self.store.set(f"peers/{job_id}/ep/{claimed}", my_endpoint)
         # every node reads the same numbered slots, so the list (and the
         # endpoints.index-derived rank) is identical everywhere
-        return [self.store.wait(f"peers/{job_id}/{i}",
+        return [self.store.wait(f"peers/{job_id}/ep/{i}",
                                 self.timeout).decode()
                 for i in range(self.nnodes)]
 

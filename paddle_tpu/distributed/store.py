@@ -99,7 +99,8 @@ def _load():
         lib.pts_setnx.restype = ctypes.c_int
         lib.pts_setnx.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_char_p, ctypes.c_int,
-                                  ctypes.c_char_p, ctypes.c_int]
+                                  ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int)]
         _lib = lib
         return _lib
 
@@ -206,23 +207,24 @@ class TCPStore:
 
     def set_nx(self, key: str, value) -> Tuple[bool, bytes]:
         """Set-if-absent (atomic claim). Returns (claimed, current_value) —
-        the winning writer's value either way. The crash-safe primitive the
-        launch rendezvous builds rank slots on."""
+        the winning writer's value, delivered atomically with the claim in
+        one round trip. The crash-safe primitive the launch rendezvous
+        builds rank slots on."""
         data = value if isinstance(value, bytes) else str(value).encode()
         if self._py is not None:
             r = self._py.setnx(key, data.decode("latin-1"))
             return r["claimed"], r["value"].encode("latin-1")
         buf = ctypes.create_string_buffer(_MAX_VAL)
+        claimed = ctypes.c_int(0)
         n = _lib.pts_setnx(self._client, key.encode(), data, len(data), buf,
-                           _MAX_VAL)
+                           _MAX_VAL, ctypes.byref(claimed))
         if n == -2:
             raise ConnectionError(
                 f"TCPStore: connection to {self.host}:{self.port} lost")
         if n == -3:
             raise ValueError(
                 f"TCPStore value for {key!r} exceeds the {_MAX_VAL} byte limit")
-        cur = self.try_get(key)
-        return n == 0, cur if cur is not None else data
+        return bool(claimed.value), buf.raw[:n]
 
     def delete_key(self, key: str) -> bool:
         if self._py is not None:

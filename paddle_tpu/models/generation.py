@@ -113,3 +113,66 @@ class GenerationMixin:
         from ..framework.core import Tensor as T
 
         return T(out)
+
+
+def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
+                             top_k, seed, eos_token_id, make_caches, run_one,
+                             max_positions=None, extra_key=()):
+    """Shared prefill+decode loop for models WITH a cached decode_step
+    (Llama, GPT): fixed-size KV caches, one lax.scan over P+N-1 steps, the
+    whole generation compiled once per static config.
+
+    make_caches(B, L) -> flat list of cache arrays.
+    run_one(params, tok[B,1], flat_caches, pos) -> ((B,V) logits, flat).
+    Mirrors the reference's fused decode loop (fused_multi_transformer) as a
+    single compiled scan instead of a per-step CUDA op."""
+    import numpy as _np
+
+    from ..framework.core import Tensor, to_array
+    from ..jit import state_values
+
+    ids = _np.asarray(to_array(input_ids))
+    B, P = ids.shape
+    L = P + max_new_tokens
+    if max_positions is not None and L > max_positions:
+        raise ValueError(f"prompt+new tokens {L} exceeds "
+                         f"max_position_embeddings {max_positions}")
+    params = state_values(model)
+
+    def gen_fn(p, prompt, rng):
+        caches = make_caches(B, L)
+        toks = jnp.concatenate(
+            [prompt, jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+        done = jnp.zeros((B,), bool)
+
+        def body(carry, t):
+            toks, caches, done, rng = carry
+            tok = jax.lax.dynamic_slice_in_dim(toks, t, 1, 1)
+            logits, caches = run_one(p, tok, caches, t)
+            nxt, rng = next_token(logits, rng, temperature, top_k)
+            toks, done = advance_tokens(toks, done, nxt, t, P, L,
+                                        eos_token_id)
+            return (toks, caches, done, rng), None
+
+        (toks, _, _, _), _ = jax.lax.scan(
+            body, (toks, caches, done, rng), jnp.arange(L - 1))
+        return toks
+
+    key = (B, P, max_new_tokens, float(temperature or 0.0), int(top_k or 0),
+           eos_token_id, tuple(extra_key))
+    cache = getattr(model, "_gen_cache", None)
+    if cache is None:
+        cache = model._gen_cache = {}
+    if key not in cache:
+        cache[key] = jax.jit(gen_fn)
+    was_training = getattr(model, "training", False)
+    model.eval()  # stochastic layers must be off under the trace
+    try:
+        out = cache[key](params, jnp.asarray(ids, jnp.int32),
+                         jax.random.PRNGKey(seed))
+    finally:
+        if was_training:
+            model.train()
+    from ..framework.core import Tensor as _T
+
+    return _T(out)

@@ -76,6 +76,41 @@ class GPTBlock(Layer):
         x = x + self.drop(self.c_out(F.gelu(self.c_fc(self.ln_2(x)), approximate=True)))
         return x
 
+    def decode(self, x, ck, cv, pos):
+        """Single-token decode with fixed-size KV caches (B, L, nh, hd) —
+        same design as LlamaAttention.decode: write at ``pos`` via
+        dynamic_update_slice, attend over positions ≤ pos, static shapes so
+        the whole generate loop compiles once."""
+        import jax
+        import jax.numpy as jnp
+        import math
+
+        B, H = x.shape[0], x.shape[2]
+        nh = self.n_head
+        hd = H // nh
+        qkv = self.c_attn(self.ln_1(x))
+
+        def attn_step(qkvv, ckv, cvv):
+            q, k, v = jnp.split(qkvv.reshape(B, 1, 3 * nh, hd), 3, axis=2)
+            ckv = jax.lax.dynamic_update_slice(ckv, k.astype(ckv.dtype),
+                                               (0, pos, 0, 0))
+            cvv = jax.lax.dynamic_update_slice(cvv, v.astype(cvv.dtype),
+                                               (0, pos, 0, 0))
+            L = ckv.shape[1]
+            scores = jnp.einsum("bshd,bthd->bhst", q, ckv).astype(
+                jnp.float32) / math.sqrt(hd)
+            mask = (jnp.arange(L) <= pos)[None, None, None, :]
+            scores = jnp.where(mask, scores, -1e30)
+            p = jax.nn.softmax(scores, -1).astype(q.dtype)
+            out = jnp.einsum("bhst,bthd->bshd", p, cvv)
+            return out.reshape(B, 1, H), ckv, cvv
+
+        out, ck, cv = apply_op(attn_step, qkv, ck, cv,
+                               op_name="gpt_decode_attention")
+        x = x + self.c_proj(out)
+        x = x + self.c_out(F.gelu(self.c_fc(self.ln_2(x)), approximate=True))
+        return x, ck, cv
+
 
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -97,6 +132,20 @@ class GPTModel(Layer):
             x = block(x)
         return self.ln_f(x)
 
+    def decode_step(self, token, caches, pos):
+        """token (B,1) at absolute position ``pos``; returns hidden (B,1,H)
+        + updated caches (list of (ck, cv) per block)."""
+        from ..framework.dispatch import apply_op as _apply
+
+        x = self.wte(token) + _apply(
+            lambda w: jax.lax.dynamic_slice_in_dim(w, pos, 1, 0)[None],
+            self.wpe.weight, op_name="wpe_at")
+        new = []
+        for block, (ck, cv) in zip(self.h, caches):
+            x, ck, cv = block.decode(x, ck, cv, pos)
+            new.append((ck, cv))
+        return self.ln_f(x), new
+
 
 class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
@@ -110,3 +159,51 @@ class GPTForCausalLM(Layer, GenerationMixin):
 
     def loss_fn(self, logits, labels):
         return F.cross_entropy(logits, labels, reduction="mean")
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id=None):
+        """Cached O(L) decode (overrides the cache-less GenerationMixin
+        fallback): fixed KV caches per block + one compiled scan — the same
+        design as Llama's generate."""
+        from ..framework.core import Tensor
+        from ..framework.dtype import convert_dtype
+        from ..jit import functional_call
+        from .generation import compiled_cached_generate
+
+        cfg = self.cfg
+        nh = cfg.num_attention_heads
+        hd = cfg.hidden_size // nh
+        n_layers = cfg.num_hidden_layers
+        cdtype = convert_dtype(getattr(cfg, "dtype", "float32"))
+        model = self
+
+        def make_caches(B, L):
+            flat = []
+            for _ in range(n_layers):
+                flat += [jnp.zeros((B, L, nh, hd), cdtype),
+                         jnp.zeros((B, L, nh, hd), cdtype)]
+            return flat
+
+        def run_one(p, tok, flat, pos):
+            caches = [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
+                      for i in range(n_layers)]
+
+            def call():
+                h, new = model.transformer.decode_step(Tensor(tok), caches,
+                                                       pos)
+                logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                                  model.transformer.wte.weight)
+                return logits, new
+
+            logits, new = functional_call(model, p, call_fn=call)
+            out = []
+            for ck, cv in new:
+                out += [ck.value, cv.value]
+            return logits.value[:, 0], out
+
+        return compiled_cached_generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, seed=seed,
+            eos_token_id=eos_token_id, make_caches=make_caches,
+            run_one=run_one, max_positions=cfg.max_position_embeddings)

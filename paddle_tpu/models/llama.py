@@ -429,25 +429,22 @@ class LlamaForCausalLM(Layer):
         per-step dispatch and no dynamic shapes. Returns (B, P+N) int32 of
         prompt + generated tokens.
         """
-        import numpy as _np
+        from ..framework.dtype import convert_dtype
+        from ..jit import functional_call
+        from .generation import compiled_cached_generate
 
-        from ..framework.core import to_array
-        from ..jit import functional_call, state_values
-
-        ids = _np.asarray(to_array(input_ids))
-        B, P = ids.shape
-        L = P + max_new_tokens
         cfg = self.cfg
-        if L > cfg.max_position_embeddings:
-            raise ValueError(f"prompt+new tokens {L} exceeds "
-                             f"max_position_embeddings {cfg.max_position_embeddings}")
         kv = cfg.num_key_value_heads
         d = cfg.hidden_size // cfg.num_attention_heads
-        from ..framework.dtype import convert_dtype
-
         cdtype = convert_dtype(cfg.dtype)
-        params = state_values(self)
         model = self
+
+        def make_caches(B, L):
+            flat = []
+            for _ in range(cfg.num_hidden_layers):
+                flat += [jnp.zeros((B, L, kv, d), cdtype),
+                         jnp.zeros((B, L, kv, d), cdtype)]
+            return flat
 
         def run_one(p, tok, flat_caches, pos):
             caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
@@ -468,48 +465,11 @@ class LlamaForCausalLM(Layer):
                 flat += [ck.value, cv.value]
             return logits.value[:, 0], flat
 
-        def gen_fn(p, prompt, rng):
-            caches = []
-            for _ in range(cfg.num_hidden_layers):
-                caches += [jnp.zeros((B, L, kv, d), cdtype),
-                           jnp.zeros((B, L, kv, d), cdtype)]
-            toks = jnp.concatenate(
-                [prompt, jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
-            done = jnp.zeros((B,), bool)
-
-            def body(carry, t):
-                from .generation import advance_tokens, next_token
-
-                toks, caches, done, rng = carry
-                tok = jax.lax.dynamic_slice_in_dim(toks, t, 1, 1)
-                logits, caches = run_one(p, tok, caches, t)
-                nxt, rng = next_token(logits, rng, temperature, top_k)
-                toks, done = advance_tokens(toks, done, nxt, t, P, L,
-                                            eos_token_id)
-                return (toks, caches, done, rng), None
-
-            (toks, _, _, _), _ = jax.lax.scan(
-                body, (toks, caches, done, rng), jnp.arange(L - 1))
-            return toks
-
-        # jit caches by function identity — cache the compiled loop per
-        # static generation config so repeat calls don't recompile
-        key = (B, P, max_new_tokens, float(temperature or 0.0), int(top_k or 0),
-               eos_token_id)
-        cache = getattr(self, "_gen_cache", None)
-        if cache is None:
-            cache = self._gen_cache = {}
-        if key not in cache:
-            cache[key] = jax.jit(gen_fn)
-        was_training = self.training
-        self.eval()  # keep stochastic layers off under the trace
-        try:
-            out = cache[key](params, jnp.asarray(ids, jnp.int32),
-                             jax.random.PRNGKey(seed))
-        finally:
-            if was_training:
-                self.train()
-        return Tensor(out)
+        return compiled_cached_generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, seed=seed,
+            eos_token_id=eos_token_id, make_caches=make_caches,
+            run_one=run_one, max_positions=cfg.max_position_embeddings)
 
 
 def llama_pretrain_loss(model: LlamaForCausalLM, input_ids, labels):

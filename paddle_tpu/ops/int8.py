@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 _LANE = 128
+_WARNED_FALLBACK = False
 
 
 def quantize_per_channel(w) -> Tuple[jax.Array, jax.Array]:
@@ -49,11 +50,21 @@ def _w8_kernel(x_ref, w_ref, s_ref, o_ref, *, out_dtype):
     o_ref[...] = (acc * s).astype(o_ref.dtype)
 
 
-def _w8_matmul_pallas(x2, w_q, scale, out_dtype, block_n: int = 512):
+def _w8_matmul_pallas(x2, w_q, scale, out_dtype, block_n: int = 0):
+    import os
+
     from jax.experimental import pallas as pl
 
     M, K = x2.shape
     N = w_q.shape[1]
+    if not block_n:
+        try:
+            block_n = int(os.environ.get("PT_W8_BLOCK_N", 512))
+        except ValueError:
+            block_n = 512
+        # round down to a power of two in [_LANE, ...]; bad values would
+        # either ZeroDivide (0) or shred the grid into tiny blocks
+        block_n = max(_LANE, 1 << max(block_n, _LANE).bit_length() - 1)
     bn = min(block_n, N)
     while N % bn:
         bn //= 2
@@ -86,7 +97,15 @@ def w8_matmul(x, w_q, scale):
         try:
             out = _w8_matmul_pallas(x2, w_q, scale, out_dtype)
             return out.reshape(*lead, N)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — Mosaic raises many types
+            global _WARNED_FALLBACK
+            if not _WARNED_FALLBACK:
+                import warnings
+
+                warnings.warn(
+                    f"w8_matmul: Pallas kernel failed ({type(e).__name__}: "
+                    f"{e}); falling back to full dequantization — the int8 "
+                    "bandwidth advantage is LOST", RuntimeWarning)
+                _WARNED_FALLBACK = True
     deq = (w_q.astype(jnp.float32) * scale[None, :]).astype(out_dtype)
     return jnp.matmul(x2, deq).reshape(*lead, N)

@@ -82,3 +82,38 @@ def test_gpt_generate_via_mixin():
     m.eval()
     expect = np.asarray(m(ids).value)[:, -1].argmax(-1)
     np.testing.assert_array_equal(out[:, 6], expect)
+
+
+class TestGPTCachedGenerate:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+
+        paddle.seed(0)
+        return GPTForCausalLM(gpt_tiny_config())
+
+    def test_cached_matches_cacheless(self):
+        """GPT's new KV-cached generate must produce exactly the greedy
+        tokens of the cache-less full-forward fallback."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.generation import GenerationMixin
+
+        m = self._model()
+        ids = paddle.to_tensor(np.array([[3, 1, 4, 1, 5]], dtype="int32"))
+        cached = np.asarray(m.generate(ids, max_new_tokens=8).value)
+        cacheless = np.asarray(GenerationMixin.generate(
+            m, ids, max_new_tokens=8).value)
+        np.testing.assert_array_equal(cached, cacheless)
+
+    def test_eos_and_sampling_shapes(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        m = self._model()
+        ids = paddle.to_tensor(np.array([[2, 7], [9, 4]], dtype="int32"))
+        out = m.generate(ids, max_new_tokens=5, temperature=0.8, top_k=4,
+                         seed=3, eos_token_id=0)
+        assert tuple(out.shape) == (2, 7)

@@ -164,6 +164,30 @@ class TestTCPStoreNative:
         w.stop()
         m.stop()
 
+    def test_sync_peers_rejoin_with_new_port(self):
+        """The realistic restart: a relaunched node has a FRESH port but a
+        stable node_id — it must re-find its rank slot and republish its new
+        endpoint (launch/main.py passes PADDLE_NODE_ID/host identity)."""
+        from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
+
+        port = _free_port()
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        r = {}
+        t = threading.Thread(target=lambda: r.setdefault(
+            "w", w.sync_peers("10.0.0.2:7002", node_id="node-b")))
+        t.start()
+        eps = m.sync_peers("10.0.0.1:7001", node_id="node-a")
+        t.join()
+        assert eps == ["10.0.0.1:7001", "10.0.0.2:7002"]
+        # node-b relaunches on a different port: same slot, new endpoint
+        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        eps2 = w2.sync_peers("10.0.0.2:9999", node_id="node-b")
+        assert eps2 == ["10.0.0.1:7001", "10.0.0.2:9999"]
+        w2.stop()
+        w.stop()
+        m.stop()
+
     def test_http_master_sync_peers_native(self):
         """Launch rendezvous over the native store: 3 nodes join, all see the
         identical rank-ordered endpoint list (ref master.py sync_peers)."""
