@@ -144,11 +144,14 @@ def launch(argv=None) -> int:
                                               master_ep.startswith(_local_ip()))
         master = HTTPMaster(master_ep, is_master, nnodes)
         my_ep = f"{_local_ip()}:{_free_port()}"
-        # stable identity so a relaunch (fresh port) re-finds its rank slot:
-        # explicit env id > explicit rank > host ip (one node per host)
+        # identity for slot claims: explicit env id (stable across elastic
+        # restarts) > explicit rank (pins slot rank directly) > the unique
+        # endpoint (same-host launchers can't collide; no restart rejoin)
         node_id = os.environ.get("PADDLE_NODE_ID") or (
-            f"rank{args.rank}" if args.rank >= 0 else _local_ip())
-        endpoints = master.sync_peers(my_ep, args.job_id, node_id=node_id)
+            f"rank{args.rank}" if args.rank >= 0 else my_ep)
+        endpoints = master.sync_peers(
+            my_ep, args.job_id, node_id=node_id,
+            preferred_slot=args.rank if args.rank >= 0 else None)
         node_rank = endpoints.index(my_ep) if args.rank < 0 else args.rank
 
     restarts = 0

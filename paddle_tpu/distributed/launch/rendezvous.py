@@ -126,26 +126,31 @@ class HTTPMaster:
                               world_size=nnodes, timeout=timeout)
 
     def sync_peers(self, my_endpoint: str, job_id: str = "default",
-                   node_id: str = None) -> List[str]:
+                   node_id: str = None, preferred_slot: int = None) -> List[str]:
         """Claim rank slots 0..n-1 via atomic set-if-absent.
 
-        Slots are keyed by a STABLE node identity (``node_id``; defaults to
-        the endpoint), and the slot's endpoint is stored separately and
+        Slots are keyed by a node identity (``node_id``; defaults to the
+        unique endpoint), and the slot's endpoint is stored separately and
         overwritable — so a node relaunched with a fresh port re-finds its
-        slot by identity and republishes its new endpoint instead of
-        wedging the barrier. Launch passes ``PADDLE_NODE_ID``/host identity
-        (launch/main.py); crash-safe: a node that dies mid-claim leaves
-        either nothing or a slot its replacement (same identity) reuses."""
+        slot when it has a STABLE identity (set ``PADDLE_NODE_ID`` for
+        elastic restarts; the default endpoint identity is unique per
+        process, which keeps same-host multi-launcher setups collision-free
+        but cannot survive a port change). ``preferred_slot`` pins the claim
+        to one slot (used with explicit --rank so slot order == rank order).
+        Crash-safe: a node that dies mid-claim leaves either nothing or a
+        slot its replacement (same identity) reuses."""
         me = (node_id or my_endpoint).encode()
         claimed = None
-        for i in range(self.nnodes):
+        slots = [preferred_slot] if preferred_slot is not None else \
+            range(self.nnodes)
+        for i in slots:
             ok, cur = self.store.set_nx(f"peers/{job_id}/owner/{i}", me)
             if ok or cur == me:
                 claimed = i
                 break
         if claimed is None:
             raise RuntimeError(
-                f"rendezvous: all {self.nnodes} peer slots taken and node id "
+                f"rendezvous: peer slot(s) {list(slots)} taken and node id "
                 f"{me.decode()!r} owns none of them (stale job_id "
                 f"{job_id!r}?)")
         # endpoint may change across restarts: plain set, not set_nx

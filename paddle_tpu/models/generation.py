@@ -132,7 +132,7 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
     from ..jit import state_values
 
     ids = _np.asarray(to_array(input_ids))
-    B, P = ids.shape
+    B, P = ids.shape  # noqa: N806
     L = P + max_new_tokens
     if max_positions is not None and L > max_positions:
         raise ValueError(f"prompt+new tokens {L} exceeds "
@@ -173,6 +173,4 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
     finally:
         if was_training:
             model.train()
-    from ..framework.core import Tensor as _T
-
-    return _T(out)
+    return Tensor(out)

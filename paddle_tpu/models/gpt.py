@@ -81,10 +81,6 @@ class GPTBlock(Layer):
         same design as LlamaAttention.decode: write at ``pos`` via
         dynamic_update_slice, attend over positions ≤ pos, static shapes so
         the whole generate loop compiles once."""
-        import jax
-        import jax.numpy as jnp
-        import math
-
         B, H = x.shape[0], x.shape[2]
         nh = self.n_head
         hd = H // nh
@@ -135,9 +131,7 @@ class GPTModel(Layer):
     def decode_step(self, token, caches, pos):
         """token (B,1) at absolute position ``pos``; returns hidden (B,1,H)
         + updated caches (list of (ck, cv) per block)."""
-        from ..framework.dispatch import apply_op as _apply
-
-        x = self.wte(token) + _apply(
+        x = self.wte(token) + apply_op(
             lambda w: jax.lax.dynamic_slice_in_dim(w, pos, 1, 0)[None],
             self.wpe.weight, op_name="wpe_at")
         new = []

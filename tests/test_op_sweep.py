@@ -1,0 +1,196 @@
+"""Declarative op sweep — the OpTest pattern at scale (ref
+python/paddle/fluid/tests/unittests/op_test.py:327: numpy reference forward
+per op + numeric-gradient checks, fixed seeds). One table row per op; every
+row is checked against its numpy reference, and differentiable unary/binary
+rows get a finite-difference gradient check through the eager tape."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(7)
+POS = np.abs(RNG.randn(3, 4)).astype("float32") + 0.5   # strictly positive
+ANY = RNG.randn(3, 4).astype("float32")
+ANY2 = RNG.randn(3, 4).astype("float32")
+UNIT = np.clip(RNG.rand(3, 4).astype("float32"), 0.05, 0.95)  # (0, 1)
+GT1 = np.abs(RNG.randn(3, 4)).astype("float32") + 1.5   # > 1
+INTS = RNG.randint(-5, 6, (3, 4)).astype("int32")
+
+# (paddle name, args builder, numpy reference, grad-checkable)
+UNARY = [
+    ("abs", ANY, np.abs, False),  # non-smooth at 0
+    ("exp", ANY, np.exp, True),
+    ("expm1", ANY, np.expm1, True),
+    ("log", POS, np.log, True),
+    ("log2", POS, np.log2, True),
+    ("log10", POS, np.log10, True),
+    ("log1p", POS, np.log1p, True),
+    ("sqrt", POS, np.sqrt, True),
+    ("rsqrt", POS, lambda x: 1.0 / np.sqrt(x), True),
+    ("square", ANY, np.square, True),
+    ("reciprocal", POS, np.reciprocal, True),
+    ("sin", ANY, np.sin, True),
+    ("cos", ANY, np.cos, True),
+    ("tan", UNIT, np.tan, True),
+    ("asin", UNIT, np.arcsin, True),
+    ("acos", UNIT, np.arccos, True),
+    ("atan", ANY, np.arctan, True),
+    ("sinh", ANY, np.sinh, True),
+    ("cosh", ANY, np.cosh, True),
+    ("tanh", ANY, np.tanh, True),
+    ("asinh", ANY, np.arcsinh, True),
+    ("acosh", GT1, np.arccosh, True),
+    ("atanh", UNIT * 0.9, np.arctanh, True),
+    ("ceil", ANY, np.ceil, False),
+    ("floor", ANY, np.floor, False),
+    ("round", ANY, np.round, False),
+    ("trunc", ANY, np.trunc, False),
+    ("sign", ANY, np.sign, False),
+    ("sigmoid", ANY, lambda x: 1 / (1 + np.exp(-x)), True),
+    ("erf", ANY, None, True),  # scipy-free: checked via grad only
+    ("neg", ANY, np.negative, True),
+    ("logit", UNIT, lambda x: np.log(x / (1 - x)), True),
+    ("digamma", POS + 1.0, None, True),
+    ("lgamma", POS + 1.0, None, True),
+]
+
+BINARY = [
+    ("add", (ANY, ANY2), np.add),
+    ("subtract", (ANY, ANY2), np.subtract),
+    ("multiply", (ANY, ANY2), np.multiply),
+    ("divide", (ANY, POS), np.divide),
+    ("maximum", (ANY, ANY2), np.maximum),
+    ("minimum", (ANY, ANY2), np.minimum),
+    ("pow", (POS, np.float32(2.5)), np.power),
+    ("fmax", (ANY, ANY2), np.fmax),
+    ("fmin", (ANY, ANY2), np.fmin),
+    ("remainder", (ANY, POS), np.remainder),
+    ("floor_divide", (POS * 4, POS), lambda a, b: np.floor_divide(a, b)),
+    ("atan2", (ANY, POS), np.arctan2),
+    ("hypot", (ANY, ANY2), np.hypot),
+    ("logaddexp", (ANY, ANY2), np.logaddexp),
+    ("heaviside", (ANY, UNIT), np.heaviside),
+]
+
+COMPARE = [
+    ("equal", np.equal),
+    ("not_equal", np.not_equal),
+    ("less_than", np.less),
+    ("less_equal", np.less_equal),
+    ("greater_than", np.greater),
+    ("greater_equal", np.greater_equal),
+]
+
+REDUCE = [
+    ("sum", np.sum),
+    ("mean", np.mean),
+    ("max", np.max),
+    ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+def numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x, dtype=np.float64)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.astype(np.float64).copy()
+        xm = xp.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp.astype(np.float32)) - f(xm.astype(np.float32))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("name,x,ref,_", UNARY,
+                         ids=[r[0] for r in UNARY])
+def test_unary_forward(name, x, ref, _):
+    fn = getattr(paddle, name)
+    out = np.asarray(fn(paddle.to_tensor(x)).value)
+    if ref is None:
+        assert out.shape == x.shape and np.isfinite(out).all()
+        return
+    np.testing.assert_allclose(out, ref(x), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("name,x,ref,gradable",
+                         [r for r in UNARY if r[3]],
+                         ids=[r[0] for r in UNARY if r[3]])
+def test_unary_grad(name, x, ref, gradable):
+    """Tape gradient vs central finite differences (OpTest check_grad)."""
+    fn = getattr(paddle, name)
+    xs = x[:2, :2]  # keep the finite-difference loop small
+
+    t = paddle.to_tensor(xs, stop_gradient=False)
+    loss = paddle.sum(fn(t))
+    loss.backward()
+    got = np.asarray(t.grad.value)
+
+    want = numeric_grad(
+        lambda v: float(np.asarray(paddle.sum(fn(paddle.to_tensor(v))).value)),
+        xs)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("name,args,ref", BINARY, ids=[r[0] for r in BINARY])
+def test_binary_forward(name, args, ref):
+    fn = getattr(paddle, name)
+    a, b = args
+    out = np.asarray(fn(paddle.to_tensor(a), paddle.to_tensor(b)).value)
+    np.testing.assert_allclose(out, ref(a, b), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("name,ref", COMPARE, ids=[r[0] for r in COMPARE])
+def test_compare_ops(name, ref):
+    fn = getattr(paddle, name)
+    a = paddle.to_tensor(INTS)
+    b = paddle.to_tensor(INTS.T.copy().reshape(3, 4))
+    np.testing.assert_array_equal(
+        np.asarray(fn(a, b).value), ref(INTS, INTS.T.copy().reshape(3, 4)))
+
+
+@pytest.mark.parametrize("name,ref", REDUCE, ids=[r[0] for r in REDUCE])
+def test_reduce_ops(name, ref):
+    fn = getattr(paddle, name)
+    x = paddle.to_tensor(ANY)
+    np.testing.assert_allclose(np.asarray(fn(x).value), ref(ANY), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fn(x, axis=1).value),
+                               ref(ANY, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fn(x, axis=0, keepdim=True).value),
+                               ref(ANY, axis=0, keepdims=True), rtol=1e-5)
+
+
+def test_broadcasting_matrix():
+    """Elementwise broadcast semantics across rank combinations (the
+    elementwise-op broadcast tests in the reference suite)."""
+    shapes = [((3, 4), (4,)), ((3, 4), (1, 4)), ((3, 4), (3, 1)),
+              ((2, 3, 4), (3, 4)), ((2, 3, 4), (1, 1, 4)), ((3, 4), ())]
+    for sa, sb in shapes:
+        a = RNG.randn(*sa).astype("float32") if sa else np.float32(RNG.randn())
+        b = RNG.randn(*sb).astype("float32") if sb else np.float32(RNG.randn())
+        out = np.asarray(paddle.add(paddle.to_tensor(a),
+                                    paddle.to_tensor(b)).value)
+        np.testing.assert_allclose(out, a + b, rtol=1e-6)
+
+
+def test_logical_ops():
+    a = INTS > 0
+    b = INTS < 2
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_array_equal(np.asarray(paddle.logical_and(ta, tb).value),
+                                  a & b)
+    np.testing.assert_array_equal(np.asarray(paddle.logical_or(ta, tb).value),
+                                  a | b)
+    np.testing.assert_array_equal(np.asarray(paddle.logical_xor(ta, tb).value),
+                                  a ^ b)
+    np.testing.assert_array_equal(np.asarray(paddle.logical_not(ta).value), ~a)
+
+
+def test_int_dtype_preserved():
+    """Arithmetic on integer tensors stays integral (OpTest dtype checks)."""
+    t = paddle.to_tensor(INTS)
+    assert "int" in str((t + t).dtype)
+    assert "int" in str((t * 2).dtype)
+    assert "float" in str(paddle.mean(t.astype("float32")).dtype)

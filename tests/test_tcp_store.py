@@ -227,6 +227,29 @@ class TestTCPStoreNative:
                                       "10.0.0.2:7002"]
         master.stop()
 
+    def test_sync_peers_explicit_rank_pins_slot(self):
+        """With --rank, each node claims exactly its own slot so the
+        endpoint list order == rank order regardless of arrival order."""
+        from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
+
+        port = _free_port()
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        # rank-1 node arrives FIRST but must land in slot 1
+        r = {}
+        t = threading.Thread(target=lambda: r.setdefault(
+            "w", w.sync_peers("10.0.0.2:7002", node_id="rank1",
+                              preferred_slot=1)))
+        t.start()
+        import time
+
+        time.sleep(0.2)
+        eps = m.sync_peers("10.0.0.1:7001", node_id="rank0", preferred_slot=0)
+        t.join()
+        assert eps == r["w"] == ["10.0.0.1:7001", "10.0.0.2:7002"]
+        w.stop()
+        m.stop()
+
     def test_cross_process_client(self):
         """A real subprocess connects to the in-process server (the actual
         launch topology: master rank hosts, peers connect over TCP)."""
