@@ -140,8 +140,17 @@ def launch(argv=None) -> int:
         master = None
     else:
         master_ep = args.master or f"{_local_ip()}:{_free_port()}"
+        master_host = master_ep.rsplit(":", 1)[0]
+        # the master host may be named by loopback, hostname, or LAN ip —
+        # resolve all spellings of "this machine" before deciding to host
+        local_names = {_local_ip(), "127.0.0.1", "localhost", "0.0.0.0",
+                       socket.gethostname()}
+        try:
+            local_names.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
         is_master = args.rank in (0, -1) and (args.master is None or
-                                              master_ep.startswith(_local_ip()))
+                                              master_host in local_names)
         master = HTTPMaster(master_ep, is_master, nnodes)
         my_ep = f"{_local_ip()}:{_free_port()}"
         # identity for slot claims: explicit env id (stable across elastic

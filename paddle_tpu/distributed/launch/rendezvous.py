@@ -122,7 +122,16 @@ class HTTPMaster:
         self.nnodes = nnodes
         self.timeout = timeout
         host, port = master_endpoint.rsplit(":", 1)
-        self.store = TCPStore(host, int(port), is_master=is_master,
+        if is_master:
+            try:
+                self.store = TCPStore(host, int(port), is_master=True,
+                                      world_size=nnodes, timeout=timeout)
+                return
+            except OSError:
+                # another same-host launcher already hosts the store (both
+                # legitimately matched "this machine" with rank -1): join it
+                pass
+        self.store = TCPStore(host, int(port), is_master=False,
                               world_size=nnodes, timeout=timeout)
 
     def sync_peers(self, my_endpoint: str, job_id: str = "default",

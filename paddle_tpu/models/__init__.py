@@ -4,7 +4,8 @@ paddle_tpu.vision models for the conv path)."""
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, llama3_8b_config,
                     llama3_70b_config, llama_tiny_config)
 from .gpt import GPTConfig, GPTForCausalLM, gpt2_small_config, gpt_tiny_config
-from .ernie import ErnieConfig, ErnieForSequenceClassification, ErnieModel, \
+from .ernie import ErnieConfig, ErnieForMaskedLM, ErnieForQuestionAnswering, \
+    ErnieForSequenceClassification, ErnieForTokenClassification, ErnieModel, \
     ernie_tiny_config
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -92,3 +92,88 @@ class ErnieForSequenceClassification(Layer):
 
     def loss_fn(self, logits, labels):
         return F.cross_entropy(logits, labels, reduction="mean")
+
+
+class ErnieForTokenClassification(Layer):
+    """Per-token head (NER etc.; ref PaddleNLP ErnieForTokenClassification)."""
+
+    def __init__(self, cfg: ErnieConfig, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = Dropout(dropout if dropout is not None
+                               else cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids)
+        return self.classifier(self.dropout(seq))
+
+    def loss_fn(self, logits, labels):
+        import paddle_tpu as paddle
+
+        return F.cross_entropy(paddle.reshape(logits, [-1, logits.shape[-1]]),
+                               paddle.reshape(labels, [-1]), reduction="mean")
+
+
+class ErnieForQuestionAnswering(Layer):
+    """Span head: start/end logits (ref ErnieForQuestionAnswering)."""
+
+    def __init__(self, cfg: ErnieConfig, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = Dropout(dropout if dropout is not None
+                               else cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(seq))
+        import paddle_tpu as paddle
+
+        start, end = paddle.split(logits, 2, axis=-1)
+        return paddle.squeeze(start, -1), paddle.squeeze(end, -1)
+
+    def loss_fn(self, start_logits, end_logits, start_pos, end_pos):
+        l1 = F.cross_entropy(start_logits, start_pos, reduction="mean")
+        l2 = F.cross_entropy(end_logits, end_pos, reduction="mean")
+        return (l1 + l2) / 2
+
+
+class ErnieLMHead(Layer):
+    """Masked-LM transform + decoder tied to the word embedding."""
+
+    def __init__(self, cfg: ErnieConfig, embedding_weight):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self._act = getattr(F, cfg.hidden_act)
+        self._embed = embedding_weight  # tied (not a new parameter)
+        self.bias = self.create_parameter([cfg.vocab_size], is_bias=True)
+
+    def forward(self, x):
+        from ..framework.dispatch import apply_op
+
+        h = self.norm(self._act(self.transform(x)))
+        return apply_op(lambda v, w, b: jnp.matmul(v, w.T) + b,
+                        h, self._embed, self.bias, op_name="ernie_lm_logits")
+
+
+class ErnieForMaskedLM(Layer):
+    """ref ErnieForMaskedLM / ErnieForPretraining's MLM half."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.lm_head = ErnieLMHead(
+            cfg, self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids)
+        return self.lm_head(seq)
+
+    def loss_fn(self, logits, labels, ignore_index=-100):
+        import paddle_tpu as paddle
+
+        return F.cross_entropy(paddle.reshape(logits, [-1, logits.shape[-1]]),
+                               paddle.reshape(labels, [-1]),
+                               ignore_index=ignore_index, reduction="mean")

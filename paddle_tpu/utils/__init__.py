@@ -70,3 +70,30 @@ def deprecated(update_to="", since="", reason=""):
         return fn
 
     return decorator
+
+
+def require_version(min_version: str, max_version=None):
+    """ref python/paddle/utils/install_check require_version — assert the
+    installed framework version falls in [min_version, max_version]."""
+    from ..version import full_version
+
+    def parse(v):
+        """Leading numeric part of each of the first 3 segments, zero-padded
+        ('2.5.0+tpu' -> (2,5,0); '2.5' -> (2,5,0)) so local suffixes and
+        length mismatches don't skew the comparison."""
+        import re
+
+        out = []
+        for seg in str(v).split(".")[:3]:
+            m = re.match(r"\d+", seg)
+            out.append(int(m.group()) if m else 0)
+        return tuple(out + [0] * (3 - len(out)))
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise ValueError(
+            f"paddle_tpu version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise ValueError(
+            f"paddle_tpu version {full_version} > allowed {max_version}")
+    return True

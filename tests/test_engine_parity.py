@@ -1,0 +1,96 @@
+"""Full-engine numerical parity: the SAME tiny Llama trained (a) eagerly on
+one device and (b) through ParallelEngine on a dp×tensor×sharding mesh must
+produce identical weights — the strongest correctness statement about the
+GSPMD sharding layout (dryrun_multichip only checks compile+run+finite).
+
+Pattern per SURVEY §4: the reference compares per-rank losses of distributed
+subprocess runs against a single-process run (test_dist_base.py:899); the
+8-device CPU mesh replaces the subprocess fleet."""
+import copy
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.parallel import ParallelEngine
+
+
+def _cfg():
+    return LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=48,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=32,
+                       dtype="float32", use_flash_attention=False,
+                       tie_word_embeddings=False, fused_lm_head_ce=False)
+
+
+def _batches(cfg, n=3, B=4, S=16):
+    rng = np.random.RandomState(0)
+    return [(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"),
+             rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
+            for _ in range(n)]
+
+
+def _train(model, mesh, batches, **engine_kw):
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, **engine_kw)
+    losses = [float(np.asarray(eng.train_batch(
+        paddle.to_tensor(x), paddle.to_tensor(y)).value))
+        for x, y in batches]
+    eng.sync_to_model()
+    return losses, {k: np.asarray(v.value)
+                    for k, v in model.state_dict().items()}
+
+
+@pytest.mark.parametrize("axes,shape,fsdp", [
+    ({"data": 2, "tensor": 2, "sharding": 2}, (2, 2, 2), True),
+    ({"data": 2, "tensor": 4}, (2, 4), False),
+], ids=["dp2_tp2_zero2", "dp2_tp4"])
+def test_hybrid_engine_matches_single_device(axes, shape, fsdp):
+    cfg = _cfg()
+    paddle.seed(42)
+    ref_model = LlamaForCausalLM(cfg)
+    init_state = {k: np.array(np.asarray(v.value))
+                  for k, v in ref_model.state_dict().items()}
+    batches = _batches(cfg)
+
+    # single-device reference
+    single_mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+    ref_losses, ref_weights = _train(ref_model, single_mesh, batches)
+
+    # sharded run from the identical init
+    paddle.seed(42)
+    sharded_model = LlamaForCausalLM(cfg)
+    sharded_model.set_state_dict({k: paddle.to_tensor(v)
+                                  for k, v in init_state.items()})
+    devs = np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    mesh = Mesh(devs, tuple(axes))
+    sh_losses, sh_weights = _train(sharded_model, mesh, batches, fsdp=fsdp)
+
+    np.testing.assert_allclose(sh_losses, ref_losses, rtol=1e-4, atol=1e-5)
+    for k in ref_weights:
+        np.testing.assert_allclose(sh_weights[k], ref_weights[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_sharded_params_actually_sharded():
+    """The parity above must not come from silent replication: check that
+    weight shards really live distributed over the mesh."""
+    cfg = _cfg()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "tensor", "sharding"))
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, fsdp=True)
+    sharded = [n for n, v in eng.params.items()
+               if hasattr(v, "sharding") and
+               any(s is not None for s in getattr(v.sharding, "spec", []))]
+    assert len(sharded) > 0, "no parameter carries a non-trivial PartitionSpec"
+    qs = [n for n in sharded if "q_proj" in n]
+    assert qs, "attention projections should be tensor-sharded"

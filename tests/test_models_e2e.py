@@ -312,3 +312,81 @@ class TestDataLoader:
         dl = DataLoader(DS(), batch_size=2, num_workers=2)
         out = [npt(b)[0] if isinstance(b, list) else npt(b) for b in dl]
         assert len(out) == 3
+
+
+class TestErnieHeads:
+    """ERNIE task heads (ref ErnieForTokenClassification/QuestionAnswering/
+    MaskedLM): forward shapes + one training step decreasing the loss."""
+
+    def _cfg(self):
+        from paddle_tpu.models import ernie_tiny_config
+
+        return ernie_tiny_config()
+
+    def test_token_classification_trains(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ErnieForTokenClassification
+        from paddle_tpu.optimizer import Adam
+
+        paddle.seed(0)
+        m = ErnieForTokenClassification(self._cfg(), num_classes=5)
+        opt = Adam(learning_rate=1e-3, parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 64, (2, 12)).astype("int32"))
+        labels = paddle.to_tensor(rng.randint(0, 5, (2, 12)).astype("int64"))
+        losses = []
+        for _ in range(4):
+            logits = m(ids)
+            assert tuple(logits.shape) == (2, 12, 5)
+            loss = m.loss_fn(logits, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_question_answering_shapes_and_loss(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ErnieForQuestionAnswering
+
+        paddle.seed(0)
+        m = ErnieForQuestionAnswering(self._cfg())
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 10)).astype("int32"))
+        start, end = m(ids)
+        assert tuple(start.shape) == (2, 10) and tuple(end.shape) == (2, 10)
+        sp = paddle.to_tensor(np.array([1, 2], dtype="int64"))
+        ep = paddle.to_tensor(np.array([3, 4], dtype="int64"))
+        loss = m.loss_fn(start, end, sp, ep)
+        assert float(loss) > 0
+
+    def test_masked_lm_tied_embedding(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models import ErnieForMaskedLM
+
+        paddle.seed(0)
+        m = ErnieForMaskedLM(self._cfg())
+        cfg = self._cfg()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, (2, 8)).astype("int32"))
+        logits = m(ids)
+        assert logits.shape[-1] == cfg.vocab_size
+        # decoder weight is the embedding itself (tied): no [V, H]-shaped
+        # duplicate parameter under the lm_head
+        dup = [n for n, p in m.named_parameters()
+               if n.startswith("lm_head") and
+               cfg.vocab_size in tuple(p.shape) and len(p.shape) == 2]
+        assert not dup, dup
+        labels = paddle.to_tensor(
+            np.where(np.random.RandomState(1).rand(2, 8) < 0.3,
+                     np.asarray(ids.value), -100).astype("int64"))
+        loss = m.loss_fn(logits, labels)
+        loss.backward()
+        emb = m.ernie.embeddings.word_embeddings.weight
+        assert emb.grad is not None  # grads flow through the tied decoder

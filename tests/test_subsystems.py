@@ -236,3 +236,43 @@ class TestSparseFFT:
         y = fft.ifft(fft.fft(x))
         np.testing.assert_allclose(npt(y.real()) if hasattr(y, "real") else
                                    np.real(npt(y)), npt(x), rtol=1e-4, atol=1e-6)
+
+
+class TestMultiNodeLaunch:
+    def test_two_launchers_rendezvous(self, tmp_path):
+        """Two launcher processes on one host form a 2-node job through the
+        native TCPStore master (the reference's TestDistBase subprocess
+        pattern, test_dist_base.py:899): both must agree on the endpoint
+        list and assign distinct global ranks."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            master_port = s.getsockname()[1]
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "print('RANK', os.environ['PADDLE_TRAINER_ID'],\n"
+            "      'N', os.environ['PADDLE_TRAINERS_NUM'],\n"
+            "      'EPS', os.environ['PADDLE_TRAINER_ENDPOINTS'])\n")
+
+        def run(rank):
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--rank", str(rank),
+                 "--master", f"127.0.0.1:{master_port}",
+                 "--log_dir", str(tmp_path / f"log{rank}"), str(script)],
+                cwd="/root/repo", stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE)
+
+        p0 = run(0)
+        p1 = run(1)
+        assert p0.wait(timeout=180) == 0, p0.stderr.read().decode()[-800:]
+        assert p1.wait(timeout=180) == 0, p1.stderr.read().decode()[-800:]
+        log0 = (tmp_path / "log0" / "workerlog.0").read_text()
+        log1 = (tmp_path / "log1" / "workerlog.1").read_text()
+        assert "RANK 0 N 2" in log0
+        assert "RANK 1 N 2" in log1
+        eps0 = log0.split("EPS ")[1].strip()
+        eps1 = log1.split("EPS ")[1].strip()
+        assert eps0 == eps1 and len(eps0.split(",")) == 2
