@@ -142,8 +142,10 @@ def launch(argv=None) -> int:
         master_ep = args.master or f"{_local_ip()}:{_free_port()}"
         master_host = master_ep.rsplit(":", 1)[0]
         # the master host may be named by loopback, hostname, or LAN ip —
-        # resolve all spellings of "this machine" before deciding to host
-        local_names = {_local_ip(), "127.0.0.1", "localhost", "0.0.0.0",
+        # resolve spellings of "this machine" before deciding to host.
+        # (0.0.0.0 is deliberately NOT local: with the wildcard every node
+        # would claim mastership and split-brain its own private store)
+        local_names = {_local_ip(), "127.0.0.1", "localhost",
                        socket.gethostname()}
         try:
             local_names.add(socket.gethostbyname(socket.gethostname()))

@@ -116,7 +116,7 @@ class HTTPMaster:
 
     def __init__(self, master_endpoint: str, is_master: bool, nnodes: int,
                  timeout: float = 300.0):
-        from ..store import TCPStore
+        from ..store import PortInUseError, TCPStore
 
         self.endpoint = master_endpoint
         self.nnodes = nnodes
@@ -127,9 +127,11 @@ class HTTPMaster:
                 self.store = TCPStore(host, int(port), is_master=True,
                                       world_size=nnodes, timeout=timeout)
                 return
-            except OSError:
+            except PortInUseError:
                 # another same-host launcher already hosts the store (both
-                # legitimately matched "this machine" with rank -1): join it
+                # legitimately matched "this machine" with rank -1): join it.
+                # Only the bind failure falls through — connect timeouts etc.
+                # must propagate, not silently demote the master to a client
                 pass
         self.store = TCPStore(host, int(port), is_master=False,
                               world_size=nnodes, timeout=timeout)

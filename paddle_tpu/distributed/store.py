@@ -108,6 +108,11 @@ def _load():
 _MAX_VAL = 1 << 20
 
 
+class PortInUseError(OSError):
+    """Server socket could not bind — distinct from connect timeouts so the
+    launch rendezvous can fall back to client mode ONLY for this case."""
+
+
 class TCPStore:
     """ref TCPStore(host, port, is_master, world_size, timeout).
 
@@ -129,7 +134,7 @@ class TCPStore:
             if is_master:
                 self._server = lib.pts_server_start(port)
                 if not self._server:
-                    raise OSError(f"TCPStore: cannot bind port {port}")
+                    raise PortInUseError(f"TCPStore: cannot bind port {port}")
             self._client = lib.pts_client_connect(
                 host.encode(), port, int(timeout * 1000))
             if not self._client:
@@ -141,7 +146,10 @@ class TCPStore:
             from .launch.rendezvous import KVServer, KVClient
 
             if is_master:
-                self._py_server = KVServer(port)
+                try:
+                    self._py_server = KVServer(port)
+                except OSError as e:
+                    raise PortInUseError(str(e)) from e
             self._py = KVClient(f"{host}:{port}")
 
     @property

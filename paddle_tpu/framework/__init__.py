@@ -7,6 +7,7 @@ from .dtype import (bfloat16, bool_, complex64, complex128, convert_dtype, float
                     float64, get_default_dtype, int8, int16, int32, int64, set_default_dtype,
                     uint8)
 from .flags import GLOBAL_FLAGS, get_flags, set_flags
+from .monitor import monitor_add, monitor_get, stat_registry
 from .random import Generator, default_generator, get_rng_state, seed, set_rng_state
 
 __all__ = [

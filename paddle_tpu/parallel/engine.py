@@ -258,6 +258,9 @@ class ParallelEngine:
                 for i, b in enumerate(batch_vals))
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
+        from ..framework.monitor import monitor_add
+
+        monitor_add("engine_train_steps")
         if isinstance(self.optimizer._learning_rate, object) and hasattr(
                 self.optimizer._learning_rate, "step"):
             try:

@@ -311,3 +311,36 @@ class TestText:
         scores, path = viterbi_decode(emissions, trans)
         np.testing.assert_array_equal(npt(path)[0], [0, 1, 0])
         assert float(scores.item()) == pytest.approx(30.0)
+
+
+class TestMonitor:
+    def test_stat_registry_counters(self):
+        """ref platform/monitor.cc StatRegistry: named counters the runtime
+        bumps (engine train steps are wired through monitor_add)."""
+        from paddle_tpu.framework.monitor import (monitor_add, monitor_get,
+                                                  stat_registry)
+
+        stat_registry().reset("t_counter")
+        assert monitor_get("t_counter") == 0
+        assert monitor_add("t_counter", 2) == 2
+        assert monitor_add("t_counter") == 3
+        assert stat_registry().stats()["t_counter"] == 3
+
+    def test_engine_bumps_train_step_counter(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.framework.monitor import monitor_get, stat_registry
+        from paddle_tpu.parallel import ParallelEngine
+
+        stat_registry().reset("engine_train_steps")
+        m = nn.Linear(4, 2)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        eng = ParallelEngine(m, optimizer=opt,
+                             loss_fn=lambda out, y: nn.functional.mse_loss(out, y))
+        x = paddle.to_tensor(np.ones((4, 4), dtype="float32"))
+        y = paddle.to_tensor(np.zeros((4, 2), dtype="float32"))
+        eng.train_batch(x, y)
+        eng.train_batch(x, y)
+        assert monitor_get("engine_train_steps") == 2

@@ -75,7 +75,7 @@ class TestInt8Llama:
         q_keys = [k for k in sv if k.endswith("weight_q")]
         assert len(q_keys) == 2 * 7 + 1  # 7 projections per layer + lm_head
         assert all(sv[k].dtype == jnp.int8 for k in q_keys)
-        # bf16/f32 projection weights are gone from the state
+        # float projection weights are gone from the state
         assert not any(k.endswith("q_proj.weight") for k in sv)
 
     def test_params_bytes_halved(self):
