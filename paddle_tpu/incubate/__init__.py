@@ -12,4 +12,4 @@ from . import distributed  # noqa: F401
 from . import multiprocessing  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
-from .optimizer import LBFGS, LookAhead, ModelAverage  # noqa: F401
+from .optimizer import DistributedFusedLamb, LBFGS, LookAhead, ModelAverage  # noqa: F401

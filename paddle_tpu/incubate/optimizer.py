@@ -173,6 +173,28 @@ class LBFGS(Optimizer):
 
 
 class DistributedFusedLamb(Lamb):
-    """Ref incubate/optimizer/distributed_fused_lamb.py — on TPU the fusion +
-    cross-replica sharding comes from the compiled pure_update (one fused XLA
-    program over all params), so this is Lamb with the engine path."""
+    """ref python/paddle/incubate/optimizer/distributed_fused_lamb.py — LAMB
+    with optimizer state distributed across ranks. TPU-native: state sharding
+    is a LAYOUT property (ParallelEngine(fsdp=True) places moments with the
+    param shards via GSPMD), so the optimizer math is exactly Lamb and the
+    reference's fused multi-tensor CUDA kernel is XLA fusion. Layout-only
+    knobs (clip_after_allreduce, nproc_per_node, master-param flags) are
+    accepted no-ops; gradient accumulation changes training math and is the
+    engine's job (gradient-merge pass), so != 1 raises."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, clip_after_allreduce=True,
+                 is_grad_scaled_by_nranks=True, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 nproc_per_node=None, name=None):
+        if gradient_accumulation_steps != 1:
+            raise NotImplementedError(
+                "gradient_accumulation_steps != 1: use the engine's "
+                "gradient-merge pass (distributed/passes) instead — a "
+                "silently ignored value would change the update schedule")
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, parameters=parameters,
+                         grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)

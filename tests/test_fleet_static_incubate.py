@@ -197,3 +197,27 @@ def test_onnx_export_gated_without_onnx_pkg():
     layer = paddle.nn.Linear(4, 2)
     with pytest.raises(ImportError, match="jit.save"):
         paddle.onnx.export(layer, "/tmp/should_not_exist")
+
+
+def test_distributed_fused_lamb_steps():
+    """ref incubate/optimizer/distributed_fused_lamb.py — LAMB math with
+    state sharding delegated to the engine's GSPMD layout."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import DistributedFusedLamb
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    opt = DistributedFusedLamb(learning_rate=0.05,
+                               parameters=m.parameters())
+    before = np.array(m.weight.numpy())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 4).astype("float32"))
+    for _ in range(3):
+        loss = paddle.mean(paddle.square(m(x)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert not np.allclose(before, m.weight.numpy())
+    assert float(loss) < 1.0
