@@ -117,13 +117,17 @@ class GenerationMixin:
 
 def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, make_caches, run_one,
-                             max_positions=None, extra_key=()):
+                             prefill=None, max_positions=None, extra_key=()):
     """Shared prefill+decode loop for models WITH a cached decode_step
-    (Llama, GPT): fixed-size KV caches, one lax.scan over P+N-1 steps, the
-    whole generation compiled once per static config.
+    (Llama, GPT): fixed-size KV caches, one lax.scan over the decode steps,
+    the whole generation compiled once per static config.
 
     make_caches(B, L) -> flat list of cache arrays.
     run_one(params, tok[B,1], flat_caches, pos) -> ((B,V) logits, flat).
+    prefill(params, prompt[B,P], flat_caches) -> ((B,V) logits at P-1, flat):
+    optional whole-prompt pass (flash attention) that fills cache positions
+    [0, P) in ONE forward; without it the prompt is teacher-forced through
+    P-1 single-token decode steps.
     Mirrors the reference's fused decode loop (fused_multi_transformer) as a
     single compiled scan instead of a per-step CUDA op."""
     import numpy as _np
@@ -144,6 +148,15 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
         toks = jnp.concatenate(
             [prompt, jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
         done = jnp.zeros((B,), bool)
+        start = 0
+        # prefill needs a real prompt AND at least one token to emit — with
+        # max_new_tokens == 0 the sampled token would overwrite toks[:, P-1]
+        if prefill is not None and P > 1 and max_new_tokens > 0:
+            logits, caches = prefill(p, prompt, caches)
+            nxt, rng = next_token(logits, rng, temperature, top_k)
+            toks, done = advance_tokens(toks, done, nxt, P - 1, P, L,
+                                        eos_token_id)
+            start = P  # positions [0, P) are in the caches already
 
         def body(carry, t):
             toks, caches, done, rng = carry
@@ -155,11 +168,11 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
             return (toks, caches, done, rng), None
 
         (toks, _, _, _), _ = jax.lax.scan(
-            body, (toks, caches, done, rng), jnp.arange(L - 1))
+            body, (toks, caches, done, rng), jnp.arange(start, L - 1))
         return toks
 
     key = (B, P, max_new_tokens, float(temperature or 0.0), int(top_k or 0),
-           eos_token_id, tuple(extra_key))
+           eos_token_id, prefill is not None, tuple(extra_key))
     cache = getattr(model, "_gen_cache", None)
     if cache is None:
         cache = model._gen_cache = {}

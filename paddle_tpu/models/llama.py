@@ -217,6 +217,43 @@ class LlamaAttention(Layer):
             out = shard_constraint(out, P("data", "sep", None))
         return out
 
+    def prefill(self, x, cos, sin, ck, cv):
+        """Prompt pass that fills the fixed decode caches at positions
+        [0, S): ONE causal attention over the whole prompt (flash kernel
+        when enabled) instead of S single-token decode steps — prompt
+        processing at training-forward speed."""
+        B, S = x.shape[0], x.shape[1]
+        q, k, v = self._qkv(x, B, S)
+
+        def step(qv, kv, vv, ckv, cvv, cosv, sinv):
+            qr = _apply_rope(qv, cosv, sinv, 0)
+            kr = _apply_rope(kv, cosv, sinv, 0)
+            ckv = jax.lax.dynamic_update_slice(ckv, kr.astype(ckv.dtype),
+                                               (0, 0, 0, 0))
+            cvv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                               (0, 0, 0, 0))
+            rep = self.num_heads // self.num_kv_heads
+            if self.cfg.use_flash_attention:
+                from ..ops.flash_attention import flash_attention_bshd
+
+                out = flash_attention_bshd(qr, kr, vv, causal=True)
+            else:
+                kx = jnp.repeat(kr, rep, axis=2) if rep > 1 else kr
+                vx = jnp.repeat(vv, rep, axis=2) if rep > 1 else vv
+                d = qr.shape[-1]
+                logits = jnp.einsum("bshd,bthd->bhst", qr, kx).astype(
+                    jnp.float32) / math.sqrt(d)
+                mask = jnp.tril(jnp.ones((S, S), bool))
+                logits = jnp.where(mask, logits, -1e30)
+                p = jax.nn.softmax(logits, -1).astype(qr.dtype)
+                out = jnp.einsum("bhst,bthd->bshd", p, vx)
+            return out, ckv, cvv
+
+        out, ck, cv = apply_op(step, q, k, v, ck, cv, Tensor(cos), Tensor(sin),
+                               op_name="prefill_attention")
+        out = reshape(out, [B, S, self.num_heads * self.head_dim])
+        return self.o_proj(out), ck, cv
+
     def decode(self, x, cos, sin, ck, cv, pos):
         """Single-token decode with a fixed-size KV cache: write the new
         K/V at ``pos`` via dynamic_update_slice (static shapes, so the whole
@@ -313,6 +350,13 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, ck, cv
 
+    def prefill(self, x, cos, sin, ck, cv):
+        a, ck, cv = self.self_attn.prefill(self.input_layernorm(x), cos, sin,
+                                           ck, cv)
+        h = x + a
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, ck, cv
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -354,6 +398,16 @@ class LlamaModel(Layer):
         new = []
         for layer, (ck, cv) in zip(self.layers, caches):
             x, ck, cv = layer.decode(x, self._cos, self._sin, ck, cv, pos)
+            new.append((ck, cv))
+        return self.norm(x), new
+
+    def prefill(self, input_ids, caches):
+        """Fill the decode caches from the whole prompt in one forward;
+        returns (normed hidden for ALL prompt positions, new caches)."""
+        x = self.embed_tokens(input_ids)
+        new = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.prefill(x, self._cos, self._sin, ck, cv)
             new.append((ck, cv))
         return self.norm(x), new
 
@@ -452,20 +506,35 @@ class LlamaForCausalLM(Layer):
                          jnp.zeros((B, L, kv, d), cdtype)]
             return flat
 
+        def head(h):
+            if cfg.tie_word_embeddings:
+                return apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                                model.model.embed_tokens.weight)
+            return model.lm_head(h)
+
         def run_one(p, tok, flat_caches, pos):
             caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
                       for i in range(cfg.num_hidden_layers)]
 
             def call():
                 h, new = model.model.decode_step(Tensor(tok), caches, pos)
-                if cfg.tie_word_embeddings:
-                    logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
-                                      model.model.embed_tokens.weight)
-                else:
-                    logits = model.lm_head(h)
-                return logits, new
+                return head(h), new
 
             logits, new = functional_call(model, p, call_fn=lambda: call())
+            flat = []
+            for ck, cv in new:
+                flat += [ck.value, cv.value]
+            return logits.value[:, 0], flat
+
+        def prefill_fn(p, prompt, flat_caches):
+            caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
+                      for i in range(cfg.num_hidden_layers)]
+
+            def call():
+                h, new = model.model.prefill(Tensor(prompt), caches)
+                return head(h[:, -1:]), new  # logits only for the last token
+
+            logits, new = functional_call(model, p, call_fn=call)
             flat = []
             for ck, cv in new:
                 flat += [ck.value, cv.value]
@@ -475,7 +544,8 @@ class LlamaForCausalLM(Layer):
             self, input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, seed=seed,
             eos_token_id=eos_token_id, make_caches=make_caches,
-            run_one=run_one, max_positions=cfg.max_position_embeddings)
+            run_one=run_one, prefill=prefill_fn,
+            max_positions=cfg.max_position_embeddings)
 
 
 def llama_pretrain_loss(model: LlamaForCausalLM, input_ids, labels):

@@ -91,8 +91,12 @@ def w8_matmul(x, w_q, scale):
     x2 = x.reshape(-1, K)
     M = x2.shape[0]
     out_dtype = x.dtype
+    # the streaming int8 kernel only wins when the matmul is weight-read
+    # bound (single-token decode, tiny M). Prefill/training shapes (M large)
+    # re-use each weight block M times — there the dequantize-once XLA path
+    # is the right program, and huge x blocks would blow VMEM anyway.
     usable = (_use_pallas() and K % _LANE == 0 and N % _LANE == 0 and
-              M <= 1024)
+              M <= 256)
     if usable:
         try:
             out = _w8_matmul_pallas(x2, w_q, scale, out_dtype)

@@ -117,3 +117,71 @@ class TestGPTCachedGenerate:
         out = m.generate(ids, max_new_tokens=5, temperature=0.8, top_k=4,
                          seed=3, eos_token_id=0)
         assert tuple(out.shape) == (2, 7)
+
+
+class TestLlamaPrefill:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=48,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=64,
+                          dtype="float32", use_flash_attention=False,
+                          tie_word_embeddings=False)
+        return LlamaForCausalLM(cfg)
+
+    def test_prefill_generate_matches_cacheless(self):
+        """Prefill + cached decode must reproduce the full-forward greedy
+        tokens exactly (prompt handled in ONE forward, not P decode steps)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.models.generation import GenerationMixin
+
+        m = self._model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(3).randint(0, 96, (2, 9)).astype("int32"))
+        cached = np.asarray(m.generate(ids, max_new_tokens=7).value)
+        cacheless = np.asarray(GenerationMixin.generate(
+            m, ids, max_new_tokens=7).value)
+        np.testing.assert_array_equal(cached, cacheless)
+
+    def test_prefill_fills_cache_like_decode(self):
+        """model.prefill's caches must bit-match P single-token decode
+        writes (same RoPE positions, same layout)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.core import Tensor
+
+        m = self._model()
+        cfg = m.cfg
+        B, Pn, KV, D = 2, 6, 2, 8
+        ids = np.random.RandomState(1).randint(0, 96, (B, Pn)).astype("int32")
+        mk = lambda: [(paddle.zeros([B, 16, KV, D]), paddle.zeros([B, 16, KV, D]))
+                      for _ in range(cfg.num_hidden_layers)]
+        _, pre = m.model.prefill(paddle.to_tensor(ids), mk())
+        dec = mk()
+        for t in range(Pn):
+            _, dec = m.model.decode_step(
+                paddle.to_tensor(ids[:, t:t + 1]), dec, t)
+        for (pk, pv), (dk, dv) in zip(pre, dec):
+            np.testing.assert_allclose(np.asarray(pk.value)[:, :Pn],
+                                       np.asarray(dk.value)[:, :Pn],
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(pv.value)[:, :Pn],
+                                       np.asarray(dv.value)[:, :Pn],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_zero_new_tokens_returns_prompt_unchanged(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        m = self._model()
+        ids = np.random.RandomState(5).randint(0, 96, (2, 6)).astype("int32")
+        out = np.asarray(m.generate(paddle.to_tensor(ids),
+                                    max_new_tokens=0).value)
+        np.testing.assert_array_equal(out, ids)
