@@ -76,6 +76,32 @@ class GPTBlock(Layer):
         x = x + self.drop(self.c_out(F.gelu(self.c_fc(self.ln_2(x)), approximate=True)))
         return x
 
+    def prefill(self, x, ck, cv):
+        """Whole-prompt pass filling cache positions [0, S) in one causal
+        attention (the Llama prefill design). Attention goes through the
+        SAME scaled_dot_product_attention path as forward() — flash kernel
+        on TPU, jnp fallback elsewhere — only the cache writes are new."""
+        B, S, H = x.shape[0], x.shape[1], x.shape[2]
+        nh = self.n_head
+        hd = H // nh
+        qkv = reshape(self.c_attn(self.ln_1(x)), [B, S, 3, nh, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def fill(ckv, cvv, kv_, vv_):
+            ckv = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
+                                               (0, 0, 0, 0))
+            cvv = jax.lax.dynamic_update_slice(cvv, vv_.astype(cvv.dtype),
+                                               (0, 0, 0, 0))
+            return ckv, cvv
+
+        ck, cv = apply_op(fill, ck, cv, k, v, op_name="gpt_cache_fill")
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=False)
+        out = reshape(out, [B, S, H])
+        x = x + self.c_proj(out)
+        x = x + self.c_out(F.gelu(self.c_fc(self.ln_2(x)), approximate=True))
+        return x, ck, cv
+
     def decode(self, x, ck, cv, pos):
         """Single-token decode with fixed-size KV caches (B, L, nh, hd) —
         same design as LlamaAttention.decode: write at ``pos`` via
@@ -127,6 +153,20 @@ class GPTModel(Layer):
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
+
+    def prefill(self, input_ids, caches):
+        """Whole-prompt pass filling the decode caches; returns (normed
+        hidden for all positions, new caches)."""
+        import paddle_tpu as paddle
+
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        new = []
+        for block, (ck, cv) in zip(self.h, caches):
+            x, ck, cv = block.prefill(x, ck, cv)
+            new.append((ck, cv))
+        return self.ln_f(x), new
 
     def decode_step(self, token, caches, pos):
         """token (B,1) at absolute position ``pos``; returns hidden (B,1,H)
@@ -196,8 +236,25 @@ class GPTForCausalLM(Layer, GenerationMixin):
                 out += [ck.value, cv.value]
             return logits.value[:, 0], out
 
+        def prefill_fn(p, prompt, flat):
+            caches = [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
+                      for i in range(n_layers)]
+
+            def call():
+                h, new = model.transformer.prefill(Tensor(prompt), caches)
+                logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h[:, -1:],
+                                  model.transformer.wte.weight)
+                return logits, new
+
+            logits, new = functional_call(model, p, call_fn=call)
+            out = []
+            for ck, cv in new:
+                out += [ck.value, cv.value]
+            return logits.value[:, 0], out
+
         return compiled_cached_generate(
             self, input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, seed=seed,
             eos_token_id=eos_token_id, make_caches=make_caches,
-            run_one=run_one, max_positions=cfg.max_position_embeddings)
+            run_one=run_one, prefill=prefill_fn,
+            max_positions=cfg.max_position_embeddings)
