@@ -221,3 +221,84 @@ class TestDeformConv:
         out.sum().backward()
         assert layer.weight.grad is not None
         assert float(np.abs(layer.weight.grad.numpy()).sum()) > 0
+
+
+class TestAugmentationTransforms:
+    """The augmentation set (ref python/paddle/vision/transforms/transforms.py:
+    ColorJitter, RandomResizedCrop, RandomRotation, RandomErasing, ...)."""
+
+    def _img(self, h=32, w=24):
+        rng = np.random.RandomState(0)
+        return rng.randint(0, 256, (h, w, 3)).astype("uint8")
+
+    def test_pad_and_grayscale(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = self._img()
+        assert T.Pad(4)(img).shape == (40, 32, 3)
+        assert T.Pad((1, 2))(img).shape == (36, 26, 3)
+        g1 = T.Grayscale()(img)
+        assert g1.shape == (32, 24, 1)
+        assert T.Grayscale(3)(img).shape == (32, 24, 3)
+        # luma weights: pure red -> ~76
+        red = np.zeros((4, 4, 3), np.uint8); red[..., 0] = 255
+        assert abs(int(T.Grayscale()(red)[0, 0, 0]) - 76) <= 1
+
+    def test_color_jitter_family(self):
+        import random
+
+        from paddle_tpu.vision import transforms as T
+
+        random.seed(0)
+        img = self._img()
+        for t in (T.BrightnessTransform(0.5), T.ContrastTransform(0.5),
+                  T.SaturationTransform(0.5), T.HueTransform(0.4),
+                  T.ColorJitter(0.4, 0.4, 0.4, 0.2)):
+            out = t(img)
+            assert out.shape == img.shape and out.dtype == img.dtype
+        # value=0 transforms are identity
+        np.testing.assert_array_equal(T.BrightnessTransform(0)(img), img)
+        np.testing.assert_array_equal(T.HueTransform(0)(img), img)
+
+    def test_random_resized_crop_and_rotation(self):
+        import random
+
+        from paddle_tpu.vision import transforms as T
+
+        random.seed(1)
+        img = self._img(64, 48)
+        out = T.RandomResizedCrop(20)(img)
+        assert out.shape == (20, 20, 3)
+        rot = T.RandomRotation(30)(img)
+        assert rot.shape == img.shape
+        # rotation by 0 degrees is identity
+        np.testing.assert_array_equal(T.RandomRotation((0, 0))(img), img)
+
+    def test_random_erasing(self):
+        import random
+
+        from paddle_tpu.vision import transforms as T
+
+        random.seed(2)
+        img = self._img()
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        assert out.shape == img.shape
+        assert (out == 0).sum() > (img == 0).sum()  # some pixels erased
+        same = T.RandomErasing(prob=0.0)(img)
+        np.testing.assert_array_equal(same, img)
+
+    def test_affine_and_perspective(self):
+        import random
+
+        from paddle_tpu.vision import transforms as T
+
+        random.seed(3)
+        img = self._img()
+        aff = T.RandomAffine(degrees=15, translate=(0.1, 0.1),
+                             scale=(0.9, 1.1))(img)
+        assert aff.shape == img.shape
+        # identity affine reproduces the image
+        ident = T.RandomAffine(degrees=(0, 0))(img)
+        np.testing.assert_array_equal(ident, img)
+        persp = T.RandomPerspective(prob=1.0, distortion_scale=0.3)(img)
+        assert persp.shape == img.shape
