@@ -82,3 +82,8 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, i):
         return self.x[i], self.y[i]
+
+
+from . import datasets  # noqa: E402,F401
+from .datasets import (Conll05st, Movielens, ViterbiDecoder, WMT14,  # noqa: E402,F401
+                       WMT16)

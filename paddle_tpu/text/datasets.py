@@ -1,0 +1,97 @@
+"""paddle.text.datasets — map-style Dataset classes over the legacy reader
+modules (ref python/paddle/text/datasets/: Conll05st, Imdb, Imikolov,
+Movielens, UCIHousing, WMT14, WMT16 — same names, backed by
+paddle_tpu.dataset's reader functions, synthetic corpora in this offline
+image)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+from . import Imdb, Imikolov, UCIHousing  # noqa: F401  (already map-style)
+
+
+class _ReaderDataset(Dataset):
+    """Materialize a legacy reader() generator into a map-style dataset."""
+
+    def __init__(self, reader):
+        self._rows = [tuple(np.asarray(c) for c in row) if isinstance(
+            row, (list, tuple)) else (np.asarray(row),) for row in reader()]
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __getitem__(self, idx):
+        return self._rows[idx]
+
+
+class Conll05st(_ReaderDataset):
+    """ref text/datasets/conll05.py Conll05st (SRL): numeric 9-field rows
+    (word_ids, ctx_n2..ctx_p2, pred_ids, mark, label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, download=True):
+        from ..dataset import conll05
+
+        self.word_dict, self.verb_dict, self.label_dict = conll05.get_dict()
+        super().__init__(conll05.reader_creator(
+            conll05.corpus_reader(), self.word_dict, self.verb_dict,
+            self.label_dict))
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+    def get_embedding(self):
+        from ..dataset import conll05
+
+        return conll05.get_embedding()
+
+
+class Movielens(_ReaderDataset):
+    """ref text/datasets/movielens.py Movielens rating rows."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        from ..dataset import movielens
+
+        super().__init__(movielens.__reader_creator__(
+            rand_seed=rand_seed, test_ratio=test_ratio,
+            is_test=(mode != "train")))
+
+
+class WMT14(_ReaderDataset):
+    """ref text/datasets/wmt14.py — (src_ids, trg_ids, trg_ids_next) rows."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 download=True):
+        from ..dataset import wmt14
+
+        reader = wmt14.train(dict_size) if mode == "train" else \
+            wmt14.test(dict_size)
+        super().__init__(reader)
+
+
+class WMT16(_ReaderDataset):
+    """ref text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", download=True):
+        from ..dataset import wmt16
+
+        reader = wmt16.train(src_dict_size, trg_dict_size, src_lang=lang) \
+            if mode == "train" else \
+            wmt16.test(src_dict_size, trg_dict_size, src_lang=lang)
+        super().__init__(reader)
+
+
+class ViterbiDecoder:
+    """ref paddle.text.ViterbiDecoder — callable layer-style wrapper over
+    viterbi_decode."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        from . import viterbi_decode
+
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -222,11 +222,33 @@ class Grayscale(BaseTransform):
         if arr.ndim == 2:
             g = arr
         else:
-            g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+            g = _rgb_to_gray(arr)
         g = g[..., None]
         if self.num_output_channels == 3:
             g = np.repeat(g, 3, axis=-1)
         return g.astype(raw.dtype)
+
+
+def _rgb_to_gray(arr):
+    """ITU-R 601-2 luma; arr float HWC-3."""
+    return arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+
+
+def _clip_to_dtype(out, dtype):
+    return np.clip(out, 0, 255 if dtype == np.uint8 else np.inf).astype(dtype)
+
+
+def _inverse_warp(arr, sy, sx, fill, out_shape=None):
+    """Nearest-neighbor gather at source coords (sy, sx); out-of-bounds
+    pixels get ``fill``. Shared by rotation/affine/perspective."""
+    h, w = arr.shape[0], arr.shape[1]
+    syi = np.round(sy).astype(np.int64)
+    sxi = np.round(sx).astype(np.int64)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    shape = (out_shape or sy.shape) + arr.shape[2:]
+    out = np.full(shape, fill, dtype=arr.dtype)
+    out[valid] = arr[np.clip(syi, 0, h - 1), np.clip(sxi, 0, w - 1)][valid]
+    return out
 
 
 def _jitter_range(value, center=1.0):
@@ -252,8 +274,7 @@ class BrightnessTransform(BaseTransform):
             return img
         arr = _to_np(img)
         f = random.uniform(*self.range)
-        return np.clip(arr.astype(np.float32) * f, 0,
-                       255 if arr.dtype == np.uint8 else np.inf).astype(arr.dtype)
+        return _clip_to_dtype(arr.astype(np.float32) * f, arr.dtype)
 
 
 class ContrastTransform(BaseTransform):
@@ -271,13 +292,11 @@ class ContrastTransform(BaseTransform):
         arr = raw.astype(np.float32)
         f = random.uniform(*self.range)
         if arr.ndim == 3 and arr.shape[-1] == 3:
-            pivot = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 +
-                     arr[..., 2] * 0.114).mean()
+            pivot = _rgb_to_gray(arr).mean()
         else:
             pivot = arr.mean()
         out = pivot + f * (arr - pivot)
-        return np.clip(out, 0,
-                       255 if raw.dtype == np.uint8 else np.inf).astype(raw.dtype)
+        return _clip_to_dtype(out, raw.dtype)
 
 
 class SaturationTransform(BaseTransform):
@@ -291,13 +310,13 @@ class SaturationTransform(BaseTransform):
         if self.range == (1.0, 1.0):
             return img
         raw = _to_np(img)
+        if raw.ndim != 3 or raw.shape[-1] != 3:
+            return img  # saturation undefined off 3-channel RGB
         arr = raw.astype(np.float32)
         f = random.uniform(*self.range)
-        gray = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 +
-                arr[..., 2] * 0.114)[..., None]
+        gray = _rgb_to_gray(arr)[..., None]
         out = gray + f * (arr - gray)
-        return np.clip(out, 0,
-                       255 if raw.dtype == np.uint8 else np.inf).astype(raw.dtype)
+        return _clip_to_dtype(out, raw.dtype)
 
 
 class HueTransform(BaseTransform):
@@ -316,6 +335,8 @@ class HueTransform(BaseTransform):
         if self.range == (0.0, 0.0):
             return img
         arr = _to_np(img)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            return img  # hue rotation is only defined on 3-channel RGB
         f = random.uniform(*self.range)
         x = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
         # RGB->HSV hue rotation (vectorized)
@@ -434,12 +455,7 @@ class RandomRotation(BaseTransform):
         # inverse map: source = R(-angle) · (dst - oc) + c
         sy = ca * (yy - ocy) - sa * (xx - ocx) + cy
         sx = sa * (yy - ocy) + ca * (xx - ocx) + cx
-        syi = np.round(sy).astype(np.int64)
-        sxi = np.round(sx).astype(np.int64)
-        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
-        out = np.full((oh, ow) + arr.shape[2:], self.fill, dtype=arr.dtype)
-        out[valid] = arr[np.clip(syi, 0, h - 1), np.clip(sxi, 0, w - 1)][valid]
-        return out
+        return _inverse_warp(arr, sy, sx, self.fill, out_shape=(oh, ow))
 
 
 class RandomErasing(BaseTransform):
@@ -472,7 +488,7 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 i = random.randint(0, h - eh)
                 j = random.randint(0, w - ew)
-                if self.value == "random":
+                if isinstance(self.value, str) and self.value == "random":
                     # seed from the random module so random.seed() makes the
                     # whole pipeline reproducible
                     rng = np.random.RandomState(random.getrandbits(32))
@@ -482,7 +498,9 @@ class RandomErasing(BaseTransform):
                         255 if arr.dtype == np.uint8 else 1)
                     patch = patch.astype(arr.dtype)
                 else:
-                    patch = self.value
+                    patch = np.asarray(self.value, dtype=arr.dtype)
+                    if patch.ndim == 1:  # per-channel fill
+                        patch = patch.reshape((-1, 1, 1) if chw else (1, 1, -1))
                 if chw:
                     arr[..., i:i + eh, j:j + ew] = patch
                 else:
@@ -505,6 +523,7 @@ class RandomAffine(BaseTransform):
         self.scale_rng = scale
         self.shear = shear
         self.fill = fill
+        self.center = center
 
     def _apply_image(self, img):
         arr = _to_np(img)
@@ -521,7 +540,10 @@ class RandomAffine(BaseTransform):
             sh = np.deg2rad(random.uniform(self.shear[0], self.shear[1]))
         else:
             sh = 0.0
-        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        if self.center is not None:
+            cx, cy = self.center
+        else:
+            cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
         ca, sa = np.cos(angle), np.sin(angle)
         # forward affine A = R·Shear·Scale; inverse-map each dst pixel
         a11, a12 = ca * sc, (-sa + ca * np.tan(sh)) * sc
@@ -532,11 +554,7 @@ class RandomAffine(BaseTransform):
         dy, dx = yy - cy - ty, xx - cx - tx
         sy = i11 * dy + i12 * dx + cy
         sx = i21 * dy + i22 * dx + cx
-        syi, sxi = np.round(sy).astype(np.int64), np.round(sx).astype(np.int64)
-        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
-        out = np.full_like(arr, self.fill)
-        out[valid] = arr[np.clip(syi, 0, h - 1), np.clip(sxi, 0, w - 1)][valid]
-        return out
+        return _inverse_warp(arr, sy, sx, self.fill)
 
 
 AffineTransform = RandomAffine  # legacy alias used by some reference code
@@ -583,8 +601,4 @@ class RandomPerspective(BaseTransform):
         den = m[2, 0] * yy + m[2, 1] * xx + 1.0
         sy = (m[0, 0] * yy + m[0, 1] * xx + m[0, 2]) / den
         sx = (m[1, 0] * yy + m[1, 1] * xx + m[1, 2]) / den
-        syi, sxi = np.round(sy).astype(np.int64), np.round(sx).astype(np.int64)
-        valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
-        out = np.full_like(arr, self.fill)
-        out[valid] = arr[np.clip(syi, 0, h - 1), np.clip(sxi, 0, w - 1)][valid]
-        return out
+        return _inverse_warp(arr, sy, sx, self.fill)

@@ -192,3 +192,43 @@ class TestNNQuant:
         layer.train()
         layer(paddle.randn([4, 4]))
         assert layer._ma_output_scale.scale > 0.0
+
+
+class TestTextDatasets:
+    """paddle.text.datasets map-style classes (ref python/paddle/text/datasets/)."""
+
+    def test_all_classes_load_and_index(self):
+        import numpy as np
+
+        import paddle_tpu.text as text
+
+        for cls in (text.Conll05st, text.Movielens, text.WMT14, text.WMT16):
+            d = cls()
+            assert len(d) > 0
+            row = d[0]
+            assert isinstance(row, tuple) and len(row) >= 2
+            assert all(isinstance(c, np.ndarray) for c in row)
+
+    def test_conll_dicts_and_embedding(self):
+        import paddle_tpu.text as text
+
+        d = text.Conll05st()
+        wd, _, ld = d.get_dict()
+        assert len(wd) > 0 and len(ld) > 0
+        emb = d.get_embedding()
+        assert emb.shape[0] >= len(wd)
+
+    def test_wmt_modes_differ(self):
+        import paddle_tpu.text as text
+
+        tr = text.WMT14(mode="train")
+        te = text.WMT14(mode="test")
+        assert len(tr) > 0 and len(te) > 0
+
+    def test_dataloader_over_text_dataset(self):
+        from paddle_tpu.io import DataLoader
+        import paddle_tpu.text as text
+
+        d = text.Movielens()
+        batch = next(iter(DataLoader(d, batch_size=4)))
+        assert len(batch) >= 2
