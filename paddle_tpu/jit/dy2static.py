@@ -119,15 +119,28 @@ def _dyn_outs(result: Dict[str, Any], keys):
 
 def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
                    vars_dict: Dict[str, Any],
-                   store_names: Sequence[str] = ()) -> Dict[str, Any]:
+                   store_names: Sequence[str] = (),
+                   stores_true: Sequence[str] = None,
+                   stores_false: Sequence[str] = None) -> Dict[str, Any]:
     """Runtime dispatch for a rewritten ``if`` (ref convert_operators.py
     convert_ifelse): concrete pred → plain Python call; traced pred →
-    lax.cond carrying the array-typed locals, statics via closure."""
+    lax.cond carrying the array-typed locals, statics via closure.
+
+    When per-branch store sets are given, only names bound on BOTH paths —
+    either by both branches, or by one branch with a pre-existing binding —
+    are carried through lax.cond; a name bound on a single path with no
+    prior value is dead after the block (loading it would be undefined
+    anyway) and is dropped instead of raising."""
     if not _is_traced(pred):
         return true_fn(dict(vars_dict)) if bool(_raw_bool(pred)) else \
             false_fn(dict(vars_dict))
     import jax
 
+    if stores_true is not None and stores_false is not None:
+        both = set(stores_true) & set(stores_false)
+        store_names = [n for n in store_names
+                       if n in both or not isinstance(
+                           vars_dict.get(n, UNDEF), _Undef)]
     dyn, static, wrappers = _partition(vars_dict, store_names)
     carried = list(store_names)
     default_wrap = any(wrappers.values())  # new names follow the block's style
@@ -350,6 +363,8 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         tracked = sorted((loads | stores | cond_loads) - {"_", _JST})
         if not stores:
             return node
+        _, stores_t = _name_sets(node.body)
+        _, stores_f = _name_sets(node.orelse)
         i = self.n
         self.n += 1
         true_fn = self._make_branch_fn(f"{_PREFIX}true_{i}", node.body or
@@ -359,7 +374,9 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         call = _stmt(
             f"{_PREFIX}out_{i} = {_JST}.convert_ifelse(PREDPLACEHOLDER, "
             f"{_PREFIX}true_{i}, {_PREFIX}false_{i}, "
-            f"{_JST}.pack(locals(), {tracked!r}), {sorted(stores)!r})")[0]
+            f"{_JST}.pack(locals(), {tracked!r}), {sorted(stores)!r}, "
+            f"stores_true={sorted(stores_t)!r}, "
+            f"stores_false={sorted(stores_f)!r})")[0]
         call.value.args[0] = node.test
         unpacks = []
         for v in sorted(stores):
@@ -400,6 +417,81 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return [cond_fn, body_fn, call] + unpacks
 
 
+def _contains_return(node) -> bool:
+    return any(isinstance(n, ast.Return) for n in _walk_scoped(node))
+
+
+def _loop_holds_return(node) -> bool:
+    for n in _walk_scoped(node):
+        if isinstance(n, (ast.While, ast.For)) and _contains_return(n):
+            return True
+    return False
+
+
+def _fold_tail_returns(stmts, counter):
+    """Rewrite early returns inside ``if`` statements into a single trailing
+    return (ref dy2static return_transformer.py SingleReturnTransformer,
+    simplified):
+
+        if c:              if c:
+            <t>; return A      <t>; __pt_ret = A
+        <rest>; return B   else:
+                               <rest'>; __pt_ret = B
+                           return __pt_ret
+
+    The statements after the if ARE its implicit else-continuation. After
+    folding, no Return remains inside any If, so the control-flow
+    transformer can convert the if to lax.cond. Returns None when the shape
+    is unsupported (returns inside loops, bare yields, ...) — callers keep
+    the original body and Python semantics."""
+    import copy
+
+    out = []
+    for idx, st in enumerate(stmts):
+        if isinstance(st, ast.Return):
+            # statements after a top-level return are dead — truncating here
+            # also discards the continuation copies appended below
+            out.append(st)
+            return out
+        if isinstance(st, ast.If) and _contains_return(st):
+            if _loop_holds_return(st) or _has_escape([st]) and any(
+                    isinstance(n, (ast.Break, ast.Continue, ast.Yield,
+                                   ast.YieldFrom))
+                    for n in _walk_scoped(st)):
+                return None
+            # the statements after the if are the continuation of EVERY path
+            # that falls through — append them to BOTH branches (dead copies
+            # after a return are truncated by the recursion)
+            rest = stmts[idx + 1:]
+            body = _fold_tail_returns(
+                list(st.body) + copy.deepcopy(rest), counter)
+            orelse = _fold_tail_returns(
+                list(st.orelse or []) + copy.deepcopy(rest), counter)
+            if body is None or orelse is None:
+                return None
+            # a branch that falls off the end implicitly returns None
+            if not (body and isinstance(body[-1], ast.Return)):
+                body = body + [ast.Return(value=ast.Constant(value=None))]
+            if not (orelse and isinstance(orelse[-1], ast.Return)):
+                orelse = orelse + [ast.Return(value=ast.Constant(value=None))]
+            rv = f"__fold_ret_{counter[0]}"  # NOT _PREFIX: must be a store
+            counter[0] += 1
+
+            def land(branch):
+                val = branch[-1].value
+                assign = ast.Assign(
+                    targets=[ast.Name(id=rv, ctx=ast.Store())],
+                    value=val if val is not None else ast.Constant(value=None))
+                return branch[:-1] + [assign]
+
+            out.append(ast.If(test=st.test, body=land(body),
+                              orelse=land(orelse)))
+            out.append(ast.Return(value=ast.Name(id=rv, ctx=ast.Load())))
+            return out
+        out.append(st)
+    return out
+
+
 @functools.lru_cache(maxsize=256)
 def _convert_cached(fn):
     try:
@@ -416,6 +508,9 @@ def _convert_cached(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []
+    folded = _fold_tail_returns(fdef.body, [0])
+    if folded is not None:
+        fdef.body = folded
     before = ast.dump(fdef)
     # visit the body statements (visit_FunctionDef guards NESTED defs; the
     # top-level def itself must be descended into)

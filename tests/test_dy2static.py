@@ -204,3 +204,85 @@ def test_not_over_tensor_predicate():
     f = jax.jit(convert_to_static(_notop))
     np.testing.assert_allclose(f(jnp.array([-1.0])), [-10.0])
     np.testing.assert_allclose(f(jnp.array([1.0])), [100.0])
+
+
+class TestEarlyReturns:
+    """Tail-return folding (ref dy2static return_transformer.py): tensor-
+    condition ifs with early returns must convert to lax.cond."""
+
+    def test_if_return_tail(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        x = paddle.to_tensor(np.arange(1, 5, dtype="float32"))
+        np.testing.assert_allclose(np.asarray(f(x).value),
+                                   np.arange(1, 5) * 2.0)
+        np.testing.assert_allclose(np.asarray(f(-x).value),
+                                   -np.arange(1, 5) - 1.0)
+
+    def test_cascaded_early_returns(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            if x[0] > 10:
+                return x + 100
+            if x[1] > 0:
+                y = x * 3
+                return y
+            return x
+
+        x = paddle.to_tensor(np.arange(8, dtype="float32"))
+        np.testing.assert_allclose(np.asarray(f(x).value),
+                                   np.arange(8) * 3.0)
+        np.testing.assert_allclose(np.asarray(f(x + 20).value),
+                                   np.arange(8) + 120.0)
+        neg = paddle.to_tensor(-np.ones(8, dtype="float32"))
+        np.testing.assert_allclose(np.asarray(f(neg).value), -np.ones(8))
+
+    def test_if_else_both_return(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            if x.mean() > 0:
+                return x.sum()
+            else:
+                return -x.sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        assert float(f(x)) == 3.0
+        assert float(f(-x)) == 3.0
+
+    def test_statements_after_early_return_if(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import jit
+
+        @jit.to_static
+        def f(x):
+            if x.max() > 5:
+                return x / 2
+            y = x + 1
+            z = y * y
+            return z
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float32"))
+        np.testing.assert_allclose(np.asarray(f(x).value), [4.0, 9.0])
+        big = paddle.to_tensor(np.array([10.0, 2.0], dtype="float32"))
+        np.testing.assert_allclose(np.asarray(f(big).value), [5.0, 1.0])
