@@ -1,13 +1,22 @@
 """Benchmark: Llama pretraining step on one TPU chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Metric = MFU of a bf16 Llama train step (fwd+bwd+AdamW) — comparable against
-the north-star target of 40% MFU (BASELINE.md); vs_baseline = MFU / 0.40.
+Metric = MFU of a bf16 Llama train step (fwd+bwd+AdamW) on a 509M-param
+proxy model (the largest no-remat config that fits one 16GB v5e) — the unit
+string labels the proxy honestly.  A second, larger config (~1.3B with
+remat) is measured and reported in the same JSON under "extra".
+
+Robustness: TPU backend init can fail transiently (tunneled plugin).  The
+__main__ block runs the workload in a child process and retries with
+backoff; if the TPU never comes up it falls back to the CPU smoke config
+and emits the JSON line with an explicit "error" field instead of dying
+with a raw traceback.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -19,45 +28,24 @@ def peak_flops_per_chip() -> float:
     return {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}.get(gen, 197e12)
 
 
-def main():
+def _measure(cfg, B, S, steps, warmup, remat=False):
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # the axon TPU plugin overrides the env var; force the config knob so
-        # the CPU smoke path actually runs on host devices
-        jax.config.update("jax_platforms", "cpu")
-
     import paddle_tpu as paddle
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
     from paddle_tpu.parallel import ParallelEngine
 
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
-    if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-                          num_hidden_layers=8, num_attention_heads=16,
-                          num_key_value_heads=8, max_position_embeddings=2048,
-                          dtype="bfloat16", use_flash_attention=True)
-        B, S, steps, warmup = 8, 2048, 10, 3
-    else:  # CPU smoke path for local runs
-        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
-                          num_hidden_layers=2, num_attention_heads=4,
-                          num_key_value_heads=2, max_position_embeddings=256,
-                          dtype="float32", use_flash_attention=False)
-        B, S, steps, warmup = 2, 128, 3, 1
-
-    B = int(os.environ.get("BENCH_B", B))
-    S = int(os.environ.get("BENCH_S", S))
     cfg.max_position_embeddings = max(cfg.max_position_embeddings, S)
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
     # flash fwd+bwd keep attention residuals at O(S·D) and the fused chunked
-    # lm-head CE (ops/fused_ce.py) never materializes [B,S,V] logits, so
-    # B=16/S=2048 trains without remat; loss_fn=None routes labels into
-    # forward() so the model returns the fused loss directly
+    # lm-head CE (ops/fused_ce.py) never materializes [B,S,V] logits;
+    # loss_fn=None routes labels into forward() so the model returns the
+    # fused loss directly
     engine = ParallelEngine(model, optimizer=opt, loss_fn=None,
-                            remat=False, remat_policy="dots")
+                            remat=remat, remat_policy="dots")
     engine.build_train_step()
 
     rng = np.random.RandomState(0)
@@ -76,17 +64,116 @@ def main():
 
     tokens_per_sec = B * S * steps / dt
     flops_per_token = 6.0 * n_params  # fwd+bwd matmul FLOPs approximation
-    achieved = tokens_per_sec * flops_per_token
-    mfu = achieved / peak_flops_per_chip()
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    return mfu, tokens_per_sec, n_params, float(np.asarray(loss.value))
 
-    print(json.dumps({
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin overrides the env var; force the config knob so
+        # the CPU smoke path actually runs on host devices
+        jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.models import LlamaConfig
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                          num_hidden_layers=8, num_attention_heads=16,
+                          num_key_value_heads=8, max_position_embeddings=2048,
+                          dtype="bfloat16", use_flash_attention=True)
+        B, S, steps, warmup = 8, 2048, 10, 3
+    else:  # CPU smoke path for local runs / TPU-unavailable fallback
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=384,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, max_position_embeddings=256,
+                          dtype="float32", use_flash_attention=False)
+        B, S, steps, warmup = 2, 128, 3, 1
+
+    B = int(os.environ.get("BENCH_B", B))
+    S = int(os.environ.get("BENCH_S", S))
+    mfu, tokens_per_sec, n_params, loss = _measure(cfg, B, S, steps, warmup)
+
+    extra = {}
+    if on_tpu and os.environ.get("BENCH_SKIP_LARGE") != "1":
+        # second metric: largest-fitting config (~1.3B, remat on) — closer to
+        # the 8B north star's arithmetic intensity than the 509M proxy
+        try:
+            big = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                              intermediate_size=5632, num_hidden_layers=24,
+                              num_attention_heads=16, num_key_value_heads=8,
+                              max_position_embeddings=2048, dtype="bfloat16",
+                              use_flash_attention=True)
+            bmfu, btps, bn, _ = _measure(big, 4, 2048, 5, 2, remat=True)
+            extra = {"mfu_1p3b_remat": round(bmfu, 4),
+                     "tokens_per_sec_1p3b": round(btps),
+                     "params_1p3b": bn}
+        except Exception as e:  # OOM etc. — headline metric still reports
+            extra = {"mfu_1p3b_remat_error": str(e)[:200]}
+
+    out = {
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu, 4),
-        "unit": f"MFU (tokens/s={tokens_per_sec:.0f}, params={n_params/1e6:.0f}M, "
-                f"B={B}, S={S}, loss={float(np.asarray(loss.value)):.3f})",
+        "unit": f"MFU, 509M-proxy model (tokens/s={tokens_per_sec:.0f}, "
+                f"params={n_params/1e6:.0f}M, B={B}, S={S}, loss={loss:.3f})",
         "vs_baseline": round(mfu / 0.40, 4),
-    }))
+    }
+    if not on_tpu:
+        out["unit"] = (f"MFU, CPU smoke config — NOT a TPU number "
+                       f"(tokens/s={tokens_per_sec:.0f}, params={n_params/1e6:.1f}M)")
+        err = os.environ.get("_PADDLE_TPU_BENCH_TPU_ERROR")
+        if err:
+            out["error"] = f"TPU backend unavailable after retries: {err[:400]}"
+    if extra:
+        out["extra"] = extra
+    print(json.dumps(out))
+
+
+def _run_with_retries() -> int:
+    """Run the workload in child processes; retry TPU backend init with
+    backoff, then fall back to CPU with an explicit error field."""
+    env = dict(os.environ)
+    env["_PADDLE_TPU_BENCH_CHILD"] = "1"
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+    last_tail = ""
+    for i in range(attempts):
+        try:
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, capture_output=True, text=True,
+                                  timeout=int(os.environ.get("BENCH_TIMEOUT", "900")))
+        except subprocess.TimeoutExpired:
+            last_tail = f"bench child timed out (attempt {i + 1})"
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        if proc.returncode == 0 and '"metric"' in proc.stdout:
+            sys.stdout.write(proc.stdout[proc.stdout.index('{"metric"'):])
+            return 0
+        last_tail = (proc.stderr or proc.stdout)[-800:]
+        time.sleep(10 * (i + 1))
+    # unrecoverable on the requested platform: CPU fallback, error recorded
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_PADDLE_TPU_BENCH_TPU_ERROR"] = " ".join(last_tail.split())[-400:]
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, text=True, timeout=900)
+        sys.stderr.write(proc.stderr[-4000:])
+        if proc.returncode == 0 and '"metric"' in proc.stdout:
+            sys.stdout.write(proc.stdout[proc.stdout.index('{"metric"'):])
+            return 0
+        last_tail = (proc.stderr or proc.stdout)[-800:]
+    except subprocess.TimeoutExpired:
+        last_tail = "CPU fallback bench child timed out"
+    print(json.dumps({"metric": "llama_train_mfu_1chip", "value": 0.0,
+                      "unit": "ERROR: bench failed on TPU and CPU fallback",
+                      "vs_baseline": 0.0,
+                      "error": " ".join(last_tail.split())[-400:]}))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_PADDLE_TPU_BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.exit(_run_with_retries())

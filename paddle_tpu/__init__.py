@@ -20,6 +20,13 @@ import os as _os
 if _os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
     import jax as _jax
 
+    # Some TPU plugins (axon) ignore the JAX_PLATFORMS env var and hang
+    # initializing the TPU backend in subprocesses.  Honor the env var's
+    # intent by forcing the config knob in-process — this is the only
+    # reliable way to pin the platform, and it makes every child process
+    # that imports paddle_tpu (launch trainers, store clients, test
+    # scripts) safe on hosts with a broken TPU plugin installed.
+    _jax.config.update("jax_platforms", "cpu")
     _jax.config.update("jax_enable_x64", True)
     _jax.config.update("jax_default_matmul_precision", "highest")
 
