@@ -4,18 +4,20 @@ p2p_communication.py SendRecvMeta/partial p2p).
 
 TPU-native design: two execution paths.
 
-1. **Host 1F1B (eager)** — the classic microbatch schedule driven from the
-   host. Because a TPU slice is single-controller SPMD, every "stage" is
-   resident in the same program; cross-stage "p2p" is just tensor handoff
-   (device-to-device copy handled by XLA placement). This path keeps exact
-   schedule parity (startup/steady/cooldown accounting identical to
-   pipeline_parallel.py:117) and is what tests verify numerically.
+1. **Eager microbatch loop** — ``PipelineParallel.forward_backward_pipeline``
+   below runs fwd+bwd per microbatch with all stages co-resident. This is
+   gradient accumulation: it matches 1F1B's *numerics* exactly but has NONE
+   of its scheduling/memory semantics (no stage-sharded params, no bubble).
+   It exists for API parity and single-host debugging only.
 
-2. **Compiled stage-scan (spmd_pipeline_step)** — for real pods: the stage
-   loop is a lax.scan over microbatches with lax.ppermute moving activations
-   along the 'pipe' mesh axis (GPipe-style fill/drain; 1F1B's memory profile
-   is recovered by remat on the stage body). This is what
-   `__graft_entry__.dryrun_multichip` exercises.
+2. **Compiled pipeline TRAINING** — ``parallel.pipeline_engine.PipelineEngine``
+   is the real PP path: stage-sharded params P("pipe"), the GPipe fill/drain
+   scan (``spmd_pipeline_fn``) under a pipe-manual shard_map, differentiated
+   end-to-end so activation grads ppermute backward stage→stage-1, remat on
+   the stage body for the 1F1B-like memory bound, and the optimizer stepping
+   stage-local shards. Verified weight-parity vs single-device in
+   tests/test_engine_parity.py and exercised by
+   ``__graft_entry__.dryrun_multichip``.
 """
 from __future__ import annotations
 
@@ -74,10 +76,11 @@ class PipelineParallel:
         return [(mb,) for mb in tensor_split(data, n, axis=0)]
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """1F1B schedule (ref :117). All stages are local on TPU, so the
-        startup/steady/cooldown phases reduce to interleaving fwd/bwd over
-        microbatches with the same op order (and therefore the same peak
-        memory shape when stages are device-split via sharding)."""
+        """Microbatch loop with 1F1B-equivalent NUMERICS (ref :117) — this
+        eager path is gradient accumulation with all stages co-resident; it
+        does not reproduce 1F1B's scheduling or memory behavior. Use
+        ``parallel.PipelineEngine`` for true stage-sharded pipeline
+        training."""
         inputs, labels = data
         micro_inputs = self._split_micro(inputs)
         micro_labels = self._split_micro(labels)
@@ -165,7 +168,7 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
         # mark replicated inputs as varying over the pipe axis so scan/cond
         # type-check against the ppermute-produced (varying) activations
         micro_batches = jax.tree_util.tree_map(
-            lambda x: jax.lax.pvary(x, (axis_name,)), micro_batches)
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), micro_batches)
         stage = jax.lax.axis_index(axis_name)
         T = num_micro + num_stages - 1  # fill + drain ticks
 
@@ -196,8 +199,8 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
         # run one stage fwd to get output shape
         out_shape = jax.eval_shape(lambda a: stage_fn(0, params_shard, a), act0)
         outputs0 = jax.tree_util.tree_map(
-            lambda s: jax.lax.pvary(jnp.zeros((num_micro,) + tuple(s.shape), s.dtype),
-                                    (axis_name,)), out_shape)
+            lambda s: jax.lax.pcast(jnp.zeros((num_micro,) + tuple(s.shape), s.dtype),
+                                    (axis_name,), to="varying"), out_shape)
         (act, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
         # only the last stage wrote real values; psum replicates them ring-wide
         return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
@@ -226,7 +229,7 @@ def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro:
 
     def per_shard(params_shard, micro_batches):
         micro_batches = jax.tree_util.tree_map(
-            lambda x: jax.lax.pvary(x, (axis_name,)), micro_batches)
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), micro_batches)
         dev = jax.lax.axis_index(axis_name)
         S = num_stages * num_chunks
         T = num_micro + S - 1
@@ -287,9 +290,9 @@ def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro:
             lambda a: stage_fn(0, chunk_params(0), a),
             jax.tree_util.tree_map(lambda x: x[0], micro_batches))
         outputs0 = jax.tree_util.tree_map(
-            lambda s: jax.lax.pvary(
-                jnp.zeros((num_micro,) + tuple(s.shape), s.dtype), (axis_name,)),
-            out_shape)
+            lambda s: jax.lax.pcast(
+                jnp.zeros((num_micro,) + tuple(s.shape), s.dtype), (axis_name,),
+                to="varying"), out_shape)
         (acts, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
         return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
 

@@ -7,7 +7,8 @@ reshard.py) — propagation/partition/reshard all happen inside XLA.
 from .api import (current_mesh, mesh_context, shard_constraint, shard_tensor, psum,
                   all_gather_axis, axis_index, axis_size)
 from .engine import ParallelEngine, parallelize, make_train_step
+from .pipeline_engine import PipelineEngine, llama_pipeline_engine
 
 __all__ = ["current_mesh", "mesh_context", "shard_constraint", "shard_tensor", "psum",
            "all_gather_axis", "axis_index", "axis_size", "ParallelEngine", "parallelize",
-           "make_train_step"]
+           "make_train_step", "PipelineEngine", "llama_pipeline_engine"]

@@ -1,0 +1,329 @@
+"""Compiled pipeline-parallel TRAINING over the ``pipe`` mesh axis.
+
+Ref: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117
+(``forward_backward_pipeline`` — the 1F1B fwd+bwd schedule) and
+pp_utils/p2p_communication.py:298 (stage-to-stage p2p).  The reference drives
+the schedule from the host with NCCL send/recv per microbatch; stage-sharded
+parameters live in separate processes.
+
+TPU-native design — one compiled SPMD program:
+
+- The homogeneous decoder-block stack is stacked into leaves of shape
+  ``[num_stages, layers_per_stage, ...]`` sharded ``P("pipe")``: each device
+  along the pipe axis holds exactly its stages' weights (stage-sharded
+  params, the PP memory model).
+- The microbatch schedule is the GPipe fill/drain loop ``spmd_pipeline_fn``
+  (lax.scan over ticks, lax.ppermute rotating activations stage→stage+1)
+  run under a *partial-manual* ``jax.shard_map``: only ``pipe`` is manual,
+  so data/tensor/sharding axes keep their GSPMD shardings inside the loop
+  (TP matmuls, DP batch splits compose transparently).
+- The backward pipeline is ``jax.grad`` through that scan: scan's VJP
+  replays ticks in reverse with the transposed ppermute — activation grads
+  ppermute **backward** stage→stage-1, exactly the reference's
+  ``send_backward_recv_forward`` dataflow — and per-stage grad accumulation
+  falls out as the scan-carry accumulation of each stage's param grads.
+  ``jax.checkpoint`` on the stage body gives the 1F1B-like memory bound
+  (store only per-tick boundary activations, recompute block internals).
+- Embedding / final-norm / lm-head live OUTSIDE the manual region,
+  replicated over ``pipe`` and sharded over ``tensor`` by GSPMD.  Tied
+  embeddings therefore need no special grad allreduce: the tied weight is a
+  single array used at both ends, so its grad is the sum of both uses —
+  the semantics of ref ``allreduce_shared_weight_gradients``
+  (pipeline_parallel.py:117 steady-state) by construction.
+
+The optimizer update runs on the stage-local shards (opt state is sharded
+``P("pipe")`` like its param), i.e. ZeRO-over-pipe for the block stack.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor
+from ..jit import functional_call, state_values
+from .api import _filter_spec, mesh_context
+from .engine import param_specs, _sharding_of
+
+
+class PipelineEngine:
+    """Train step = embed → pipelined block stack (pipe-manual shard_map) →
+    head+loss, differentiated end-to-end, AdamW on stage-local shards.
+
+    Generic over the model via three pure functions:
+      pre_fn(params, *inputs)        -> activations  [B, ...]
+      block_fn(block_params, acts)   -> acts          (ONE decoder block)
+      post_fn(params, acts, *labels) -> scalar loss
+    where ``params`` is the flat name→array dict of all NON-stacked params
+    and ``block_params`` the name→array dict of one block (template-relative
+    names).  Use :func:`llama_pipeline_engine` for the stock Llama wiring.
+    """
+
+    def __init__(self, model, layers, layers_prefix: str,
+                 pre_fn: Callable, block_fn: Callable, post_fn: Callable,
+                 optimizer=None, mesh: Optional[Mesh] = None,
+                 num_micro: int = 2, remat: bool = True,
+                 abstract: bool = False):
+        from ..distributed.collective import get_global_mesh
+
+        assert optimizer is not None, \
+            "PipelineEngine is a training engine: pass an optimizer " \
+            "(for inference use the plain model / ParallelEngine.eval_batch)"
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh or get_global_mesh()
+        assert self.mesh is not None and "pipe" in self.mesh.axis_names, \
+            "PipelineEngine needs a mesh with a 'pipe' axis"
+        self.num_stages = int(self.mesh.shape["pipe"])
+        self.num_micro = num_micro
+        self.remat = remat
+        self._abstract = abstract
+        self._layers_prefix = layers_prefix
+        self._pre_fn, self._block_fn, self._post_fn = pre_fn, block_fn, post_fn
+
+        L = len(layers)
+        assert L % self.num_stages == 0, \
+            f"{L} layers not divisible by {self.num_stages} stages"
+        self.layers_per_stage = L // self.num_stages
+
+        # ---- split params: stacked block stack vs everything else
+        all_vals = state_values(model)
+        base_specs = param_specs(model, self.mesh)
+        sub_names = [n for n, _ in layers[0].named_parameters()]
+        trainable = {n for n, p in model.named_parameters() if p.trainable}
+
+        self.stacked_specs: Dict[str, P] = {}
+        stacked = {}
+        for sub in sub_names:
+            arrs = [all_vals[f"{layers_prefix}.{i}.{sub}"] for i in range(L)]
+            shape = (self.num_stages, self.layers_per_stage) + tuple(arrs[0].shape)
+            base = tuple(base_specs.get(f"{layers_prefix}.0.{sub}", P()))
+            self.stacked_specs[sub] = _filter_spec(
+                P("pipe", None, *base), self.mesh)
+            if abstract:
+                stacked[sub] = (shape, arrs[0].dtype)  # no materialization
+            else:
+                # stack on HOST, then device_put with the final sharding —
+                # never materializes an unsharded device copy of the stack
+                stacked[sub] = np.stack([np.asarray(a) for a in arrs]).reshape(shape)
+        self.rest_specs = {
+            n: base_specs.get(n, P()) for n in all_vals
+            if not n.startswith(layers_prefix + ".")
+        }
+        rest = {n: all_vals[n] for n in self.rest_specs}
+        self._rest_trainable = {n for n in rest if n in trainable}
+        # every block param of the (uniform) stack is trainable iff layer-0's is
+        self._stacked_trainable = {
+            sub for sub in sub_names
+            if f"{layers_prefix}.0.{sub}" in trainable}
+
+        if abstract:
+            self.stacked = {
+                k: jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=_sharding_of(self.mesh,
+                                                              self.stacked_specs[k]))
+                for k, (shape, dtype) in stacked.items()}
+            self.rest = {
+                n: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                        sharding=_sharding_of(self.mesh,
+                                                              self.rest_specs[n]))
+                for n, v in rest.items()}
+        else:
+            self.stacked = {k: jax.device_put(v, _sharding_of(self.mesh,
+                                                              self.stacked_specs[k]))
+                            for k, v in stacked.items()}
+            self.rest = {n: jax.device_put(v, _sharding_of(self.mesh,
+                                                           self.rest_specs[n]))
+                        for n, v in rest.items()}
+
+        self._init_opt_state()
+        self._train_step = None
+        self._step_count = jnp.zeros((), jnp.int32)
+
+    # ------------------------------------------------------------------ state
+    def _merged_trainable(self, rest, stacked):
+        m = {f"rest.{n}": rest[n] for n in self._rest_trainable}
+        m.update({f"stacked.{k}": stacked[k] for k in self._stacked_trainable})
+        return m
+
+    def _spec_of(self, merged_name: str) -> P:
+        kind, _, name = merged_name.partition(".")
+        return (self.rest_specs if kind == "rest" else self.stacked_specs)[name]
+
+    def _init_opt_state(self):
+        if self.optimizer is None:
+            self.opt_state = {}
+            return
+        train = self._merged_trainable(self.rest, self.stacked)
+        if self._abstract:
+            st = jax.eval_shape(self.optimizer.init_state, train)
+            self.opt_state = {
+                n: {k: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype,
+                    sharding=_sharding_of(self.mesh, self._spec_of(n)))
+                    for k, s in slots.items()}
+                for n, slots in st.items()}
+            return
+        st = self.optimizer.init_state(train)
+        # opt state shards like its param: stage-local along pipe
+        self.opt_state = {
+            n: {k: jax.device_put(v, _sharding_of(self.mesh, self._spec_of(n)))
+                for k, v in slots.items()}
+            for n, slots in st.items()}
+
+    # ------------------------------------------------------------- train step
+    def _pipeline_apply(self, stacked, acts):
+        """acts [B, ...] -> [B, ...] through the pipelined stack."""
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import \
+            spmd_pipeline_fn
+
+        lps, remat = self.layers_per_stage, self.remat
+        block_fn = self._block_fn
+
+        def stage_fn(stage_id, params_shard, x):
+            def body(ps, x):
+                for j in range(lps):
+                    blk = {k: v[0, j] for k, v in ps.items()}
+                    x = block_fn(blk, x)
+                return x
+
+            if remat:
+                return jax.checkpoint(body)(params_shard, x)
+            return body(params_shard, x)
+
+        B = acts.shape[0]
+        assert B % self.num_micro == 0, (B, self.num_micro)
+        micro = acts.reshape((self.num_micro, B // self.num_micro) +
+                             acts.shape[1:])
+        fn = spmd_pipeline_fn(stage_fn, self.num_stages, self.num_micro)
+        out = jax.shard_map(
+            fn, mesh=self.mesh, in_specs=(P("pipe"), P()), out_specs=P(),
+            axis_names=frozenset({"pipe"}))(stacked, micro)
+        return out.reshape(acts.shape[:1] + out.shape[2:])
+
+    def build_train_step(self):
+        opt = self.optimizer
+        mesh = self.mesh
+        rest_frozen_names = [n for n in self.rest
+                             if n not in self._rest_trainable]
+
+        def step_fn(rest, stacked, opt_state, step_count, lr, inputs, labels):
+            frozen = {n: rest[n] for n in rest_frozen_names}
+
+            def loss_of(tr):
+                rest_full = {**frozen,
+                             **{n: tr[f"rest.{n}"] for n in self._rest_trainable}}
+                stk = {k: tr[f"stacked.{k}"] for k in self._stacked_trainable}
+                with mesh_context(mesh):
+                    acts = self._pre_fn(rest_full, *inputs)
+                    out = self._pipeline_apply(stk, acts)
+                    loss = self._post_fn(rest_full, out, *labels)
+                return loss.value if isinstance(loss, Tensor) else loss
+
+            train = self._merged_trainable(rest, stacked)
+            loss, grads = jax.value_and_grad(loss_of)(train)
+            new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
+                                                   step_count + 1)
+            new_train = {
+                n: jax.lax.with_sharding_constraint(
+                    v, _sharding_of(mesh, self._spec_of(n)))
+                for n, v in new_train.items()}
+            new_rest = {**rest,
+                        **{n: new_train[f"rest.{n}"] for n in self._rest_trainable}}
+            new_stacked = {**stacked,
+                           **{k: new_train[f"stacked.{k}"]
+                              for k in self._stacked_trainable}}
+            return new_rest, new_stacked, new_state, step_count + 1, loss
+
+        self._train_step = jax.jit(step_fn, static_argnums=())
+        return self._train_step
+
+    def lower_train_step(self, inputs, labels):
+        """AOT-lower (abstract mode) for partitioning validation at scale."""
+        if self._train_step is None:
+            self.build_train_step()
+        return self._train_step.lower(self.rest, self.stacked, self.opt_state,
+                                      self._step_count, jnp.float32(0.0),
+                                      inputs, labels)
+
+    def train_batch(self, *batch):
+        """batch = (*inputs, labels); returns host loss Tensor."""
+        if self._train_step is None:
+            self.build_train_step()
+        vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        inputs, labels = vals[:-1], vals[-1:]
+        lr = self.optimizer.get_lr()
+        (self.rest, self.stacked, self.opt_state, self._step_count,
+         loss) = self._train_step(self.rest, self.stacked, self.opt_state,
+                                  self._step_count, lr, inputs, labels)
+        return Tensor(loss)
+
+    # ------------------------------------------------------------------- sync
+    def unstacked_params(self) -> Dict[str, Any]:
+        """Flat name→array dict in the model's original layout (for
+        checkpointing / parity checks)."""
+        out = dict(self.rest)
+        for sub, v in self.stacked.items():
+            flat = np.asarray(v).reshape((-1,) + tuple(v.shape[2:]))
+            for i in range(flat.shape[0]):
+                out[f"{self._layers_prefix}.{i}.{sub}"] = jnp.asarray(flat[i])
+        return out
+
+    def sync_to_model(self):
+        store = {**dict(self.model.named_parameters()),
+                 **dict(self.model.named_buffers())}
+        for name, v in self.unstacked_params().items():
+            if name in store:
+                store[name]._value = v
+
+
+def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
+                          remat: bool = True, abstract: bool = False
+                          ) -> PipelineEngine:
+    """Wire a ``LlamaForCausalLM`` into the pipeline engine: embedding before
+    the pipe region, decoder blocks inside, final-norm + lm-head + CE after.
+    Tied embeddings (cfg.tie_word_embeddings) share one array across both
+    ends, so the tied-grad allreduce is implicit."""
+    import paddle_tpu.nn.functional as F
+
+    lm = model
+    core = lm.model            # LlamaModel
+    layers = list(core.layers)
+    template = layers[0]
+    cos, sin = core._cos, core._sin
+    tied = lm.cfg.tie_word_embeddings
+
+    def pre_fn(params, input_ids):
+        emb = params["model.embed_tokens.weight"]
+        return jnp.take(emb, input_ids, axis=0)
+
+    def block_fn(blk, x):
+        out = functional_call(template, blk, Tensor(x), cos, sin)
+        return out.value if isinstance(out, Tensor) else out
+
+    def post_fn(params, h, labels):
+        out = functional_call(core.norm, {"weight": params["model.norm.weight"]},
+                              Tensor(h))
+        h = out.value if isinstance(out, Tensor) else out
+        w = params["model.embed_tokens.weight"] if tied \
+            else params["lm_head.weight"]
+        if lm.cfg.fused_lm_head_ce:
+            # chunked fused lm-head+CE: never materializes [B,S,V] logits
+            # (same memory design as the non-pipelined engine path)
+            from ..ops.fused_ce import fused_linear_cross_entropy
+
+            return fused_linear_cross_entropy(
+                h, w, labels, chunk_size=lm.cfg.ce_chunk_size,
+                transpose_weight=tied)
+        logits = h @ (w.T if tied else w)
+        return F.cross_entropy(Tensor(logits), Tensor(labels),
+                               reduction="mean")
+
+    return PipelineEngine(lm, layers, "model.layers", pre_fn, block_fn, post_fn,
+                          optimizer=optimizer, mesh=mesh, num_micro=num_micro,
+                          remat=remat, abstract=abstract)
