@@ -67,7 +67,8 @@ class PipelineEngine:
                  pre_fn: Callable, block_fn: Callable, post_fn: Callable,
                  optimizer=None, mesh: Optional[Mesh] = None,
                  num_micro: int = 2, remat: bool = True,
-                 abstract: bool = False):
+                 abstract: bool = False, fsdp: bool = False,
+                 fsdp_axis: str = "sharding"):
         from ..distributed.collective import get_global_mesh
 
         assert optimizer is not None, \
@@ -90,9 +91,12 @@ class PipelineEngine:
             f"{L} layers not divisible by {self.num_stages} stages"
         self.layers_per_stage = L // self.num_stages
 
+        self.fsdp, self.fsdp_axis = fsdp, fsdp_axis
+
         # ---- split params: stacked block stack vs everything else
         all_vals = state_values(model)
-        base_specs = param_specs(model, self.mesh)
+        base_specs = param_specs(model, self.mesh, fsdp=fsdp,
+                                 fsdp_axis=fsdp_axis)
         sub_names = [n for n, _ in layers[0].named_parameters()]
         trainable = {n for n, p in model.named_parameters() if p.trainable}
 
@@ -102,8 +106,8 @@ class PipelineEngine:
             arrs = [all_vals[f"{layers_prefix}.{i}.{sub}"] for i in range(L)]
             shape = (self.num_stages, self.layers_per_stage) + tuple(arrs[0].shape)
             base = tuple(base_specs.get(f"{layers_prefix}.0.{sub}", P()))
-            self.stacked_specs[sub] = _filter_spec(
-                P("pipe", None, *base), self.mesh)
+            self.stacked_specs[sub] = self._with_fsdp(
+                _filter_spec(P("pipe", None, *base), self.mesh), shape)
             if abstract:
                 stacked[sub] = (shape, arrs[0].dtype)  # no materialization
             else:
@@ -145,6 +149,26 @@ class PipelineEngine:
         self._step_count = jnp.zeros((), jnp.int32)
 
     # ------------------------------------------------------------------ state
+    def _with_fsdp(self, spec, shape) -> P:
+        """ZeRO over ``fsdp_axis`` for the stacked block params: shard the
+        first still-unsharded, evenly-divisible weight dim (params AND opt
+        state share the spec — ref group_sharded_stage3.py:60 semantics,
+        expressed as a GSPMD layout)."""
+        if not self.fsdp or self.fsdp_axis not in self.mesh.axis_names:
+            return spec
+        size = int(self.mesh.shape[self.fsdp_axis])
+        if size <= 1:
+            return spec
+        entries = list(tuple(spec))
+        entries += [None] * (len(shape) - len(entries))
+        if self.fsdp_axis in entries:  # base spec already consumed the axis
+            return P(*entries)
+        for i in range(2, len(shape)):  # skip the (pipe, layer) dims
+            if entries[i] is None and shape[i] % size == 0:
+                entries[i] = self.fsdp_axis
+                break
+        return P(*entries)
+
     def _merged_trainable(self, rest, stacked):
         m = {f"rest.{n}": rest[n] for n in self._rest_trainable}
         m.update({f"stacked.{k}": stacked[k] for k in self._stacked_trainable})
@@ -283,8 +307,8 @@ class PipelineEngine:
 
 
 def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
-                          remat: bool = True, abstract: bool = False
-                          ) -> PipelineEngine:
+                          remat: bool = True, abstract: bool = False,
+                          fsdp: bool = False) -> PipelineEngine:
     """Wire a ``LlamaForCausalLM`` into the pipeline engine: embedding before
     the pipe region, decoder blocks inside, final-norm + lm-head + CE after.
     Tied embeddings (cfg.tie_word_embeddings) share one array across both
@@ -326,4 +350,4 @@ def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
 
     return PipelineEngine(lm, layers, "model.layers", pre_fn, block_fn, post_fn,
                           optimizer=optimizer, mesh=mesh, num_micro=num_micro,
-                          remat=remat, abstract=abstract)
+                          remat=remat, abstract=abstract, fsdp=fsdp)

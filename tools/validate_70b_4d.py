@@ -1,19 +1,23 @@
 """AOT-validate the Llama-3-70B 4D-hybrid training program (BASELINE config 4).
 
-Builds the full 70B config (80 layers, 8192 hidden, GQA-8) sharded over a
-virtual dp×sharding×tensor×pipe-capable mesh and LOWERS the complete train
-step (fwd + bwd + AdamW) with abstract inputs — no parameter memory is
-allocated, so this runs on any host. A successful lowering proves the GSPMD
-program (with all TP/ZeRO collectives) type-checks and partitions end to end;
-the driver's `dryrun_multichip` covers the execute path on a tiny model.
+TRUE 4D: dp × ZeRO-sharding × tensor × PIPE over a 16-virtual-device mesh
+(2×2×2×2), with the block stack pipelined through the compiled GPipe scan
+(`parallel.PipelineEngine`) — ref fleet.py:385 `_init_hybrid_parallel_env`
+(dp×pp×sharding×mp all at once). The full train step (fwd + bwd + AdamW) is
+lowered with ABSTRACT engine params/opt-state (no 70B optimizer memory), but
+the eager model build itself does materialize zero-filled fp32 host arrays:
+~5.5GB/layer — default --layers 4 needs ~22GB host RAM; --layers 80 would
+need a ~300GB host. With --compile the partitioned HLO must contain
+collective-permute (pipe ppermute) alongside the TP all-reduce and ZeRO
+all-gather sites.
 
 Usage:
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-        python tools/validate_70b_4d.py [--layers N] [--seq 4096]
+    XLA_FLAGS=--xla_force_host_platform_device_count=16 JAX_PLATFORMS=cpu \
+        python tools/validate_70b_4d.py [--layers N] [--seq 4096] [--compile]
 
 --layers trims the depth (the sharding structure is per-layer identical, so
 8 layers exercises the same program shapes ~10x faster; pass 80 for the
-full model).
+full model). Must stay divisible by the 2 pipeline stages.
 """
 import argparse
 import os
@@ -22,18 +26,22 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+N_DEV = 16  # 2 data × 2 sharding × 2 tensor × 2 pipe
+
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--seq", type=int, default=4096)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--micro", type=int, default=4)
     ap.add_argument("--compile", action="store_true",
                     help="run GSPMD partitioning too (slower) and report "
                          "collective counts in the partitioned HLO")
     args = ap.parse_args()
 
-    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault(
+        "XLA_FLAGS", f"--xla_force_host_platform_device_count={N_DEV}")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
@@ -47,14 +55,20 @@ def main():
 
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama3_70b_config
-    from paddle_tpu.parallel.engine import ParallelEngine, param_specs
 
-    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
-    mesh = Mesh(devs, ("data", "sharding", "tensor"))
+    assert jax.device_count() >= N_DEV, \
+        f"need {N_DEV} devices (run with XLA_FLAGS=" \
+        f"--xla_force_host_platform_device_count={N_DEV})"
+    devs = np.asarray(jax.devices()[:N_DEV]).reshape(2, 2, 2, 2)
+    mesh = Mesh(devs, ("data", "sharding", "tensor", "pipe"))
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
+    # float32: XLA's CPU backend crashes in AllReducePromotion cloning a
+    # bf16 all-reduce ("Invalid binary instruction opcode copy"); the
+    # partitioning/collective structure being validated is dtype-independent
     cfg = llama3_70b_config(num_hidden_layers=args.layers,
-                            max_position_embeddings=args.seq)
+                            max_position_embeddings=args.seq,
+                            dtype="float32")
     t0 = time.time()
     paddle.seed(0)
     # zero-fill initializers: at 70B scale random init dominates build time
@@ -73,25 +87,30 @@ def main():
           f"in {time.time()-t0:.0f}s")
 
     from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import llama_pipeline_engine
 
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
-    eng = ParallelEngine(model, optimizer=opt, loss_fn=None, mesh=mesh,
-                         fsdp=True, remat=True, abstract=True)
-    step = eng.build_train_step()
+    eng = llama_pipeline_engine(model, optimizer=opt, mesh=mesh,
+                                num_micro=args.micro, remat=True,
+                                abstract=True, fsdp=True)
+    # stage-sharded + ZeRO: every stacked leaf carries pipe and most carry
+    # the sharding axis too
+    piped = [s for s in eng.stacked_specs.values() if "pipe" in tuple(s)]
+    zeroed = [s for s in eng.stacked_specs.values()
+              if "sharding" in tuple(s)]
+    print(f"stacked specs: {len(piped)}/{len(eng.stacked_specs)} pipe-sharded,"
+          f" {len(zeroed)} ZeRO-sharded (e.g. "
+          f"{eng.stacked_specs['self_attn.q_proj.weight']})")
+    assert len(piped) == len(eng.stacked_specs)
+    assert len(zeroed) > 0, "ZeRO sharding axis missing from stacked specs"
 
     ids = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32,
                                sharding=NamedSharding(mesh, P("data", None)))
     lbl = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int64,
                                sharding=NamedSharding(mesh, P("data", None)))
-    p_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
-             for k, v in eng.params.items()}
-    st_abs = jax.tree.map(
-        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding),
-        eng.opt_state)
-    sc = jax.ShapeDtypeStruct((), jnp.int32)
 
     t0 = time.time()
-    lowered = step.lower(p_abs, st_abs, sc, 1e-4, (ids, lbl))
+    lowered = eng.lower_train_step((ids,), (lbl,))
     txt = lowered.as_text()
     n_shard = txt.count("sdy.sharding") + txt.count("mhlo.sharding")
     print(f"lowered in {time.time()-t0:.0f}s; {len(txt) // 1024}kB StableHLO, "
@@ -102,10 +121,16 @@ def main():
         compiled = lowered.compile()
         hlo = compiled.as_text()
         print(f"GSPMD-compiled in {time.time()-t0:.0f}s")
-        for coll in ("all-gather", "reduce-scatter", "all-reduce",
-                     "collective-permute"):
-            print(f"  {coll}: {hlo.count(coll)} sites")
-    print("70B 4D-hybrid validation OK")
+        counts = {coll: hlo.count(coll)
+                  for coll in ("all-gather", "reduce-scatter", "all-reduce",
+                               "collective-permute")}
+        for coll, n in counts.items():
+            print(f"  {coll}: {n} sites")
+        assert counts["collective-permute"] > 0, \
+            "pipeline ppermute missing from partitioned HLO"
+        assert counts["all-reduce"] > 0
+        assert counts["all-gather"] > 0, "ZeRO all-gathers missing"
+    print("70B 4D-hybrid (dp×sharding×tensor×pipe) validation OK")
 
 
 if __name__ == "__main__":
