@@ -2,11 +2,12 @@
 fluid/dataloader/ worker machinery).
 
 TPU-native: the loader produces host numpy batches; device transfer happens
-at first tensor use (XLA manages staging). Multi-worker prefetch uses a
-thread pool by default (the reference's subprocess workers + shared memory
-exist for GIL-bound CPU augmentation; for TPU input pipelines the usual
-bottleneck is host→device, which threads cover) — set num_workers>0 with
-use_process=True for process workers via multiprocessing.
+at first tensor use (XLA manages staging). ``num_workers>0`` spawns real
+SUBPROCESS workers (worker_pool.py — index queue in, shared-memory arrays
+out, collate in the parent so children never touch jax), matching the
+reference's multiprocess design for GIL-bound numpy augmentation
+(dataloader_iter.py:162,370). Set PADDLE_TPU_DATALOADER_THREAD=1 to force
+the lighter single-thread prefetch path instead.
 """
 from __future__ import annotations
 
@@ -322,6 +323,11 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None  # persistent MapWorkerPool
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -357,9 +363,61 @@ class DataLoader:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
+    def _use_threads(self):
+        import os
+
+        return os.environ.get("PADDLE_TPU_DATALOADER_THREAD") == "1"
+
+    def _mp_iter(self):
+        from .worker_pool import IterableWorkerIter, MapWorkerPool
+
+        if self._iterable_mode:
+            return IterableWorkerIter(
+                self.dataset, self.num_workers, self.batch_size,
+                self.drop_last, self.collate_fn, default_convert_fn,
+                worker_init_fn=self.worker_init_fn,
+                use_shm=self.use_shared_memory, timeout=self.timeout,
+                prefetch_factor=self.prefetch_factor)
+        if self.batch_sampler is not None:
+            batches = list(self.batch_sampler)
+        else:
+            batches = [[i] for i in range(len(self.dataset))]
+            # single-sample mode converts, not collates
+        collate = (self.collate_fn if self.batch_sampler is not None
+                   else lambda samples: default_convert_fn(samples[0]))
+        if self._pool is None:
+            self._pool = MapWorkerPool(
+                self.dataset, self.num_workers,
+                worker_init_fn=self.worker_init_fn,
+                use_shm=self.use_shared_memory, timeout=self.timeout)
+        pool = self._pool
+
+        def run():
+            try:
+                yield from pool.run_epoch(batches, collate,
+                                          self.prefetch_factor)
+            finally:
+                if not self.persistent_workers:
+                    pool.shutdown()
+                    self._pool = None
+
+        return run()
+
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
-            return _PrefetchIter(self._gen, self.num_workers, self.prefetch_factor)
+            if self._use_threads():
+                return _PrefetchIter(self._gen, self.num_workers,
+                                     self.prefetch_factor)
+            try:
+                return self._mp_iter()
+            except Exception as e:  # unpicklable dataset etc.
+                import warnings
+
+                warnings.warn(
+                    f"multiprocess DataLoader workers unavailable ({e!r}); "
+                    f"falling back to single-thread prefetch", RuntimeWarning)
+                return _PrefetchIter(self._gen, self.num_workers,
+                                     self.prefetch_factor)
         return self._gen()
 
     def __len__(self):
@@ -371,4 +429,8 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """Worker metadata inside a DataLoader worker process; None in the main
+    process (ref fluid/dataloader/worker.py get_worker_info)."""
+    from .worker_pool import get_worker_info as _impl
+
+    return _impl()
