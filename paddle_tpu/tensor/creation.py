@@ -162,11 +162,14 @@ def triu_indices(row, col=None, offset=0, dtype="int64"):
 
 
 def assign(x, output=None):
-    val = to_array(x)
     if output is not None:
-        output.set_value(val)
+        output.set_value(to_array(x))
         return output
-    return Tensor(jnp.asarray(val))
+    if isinstance(x, Tensor):
+        from ..framework.dispatch import apply_op
+
+        return apply_op(lambda v: v, x)  # identity — keeps the tape
+    return Tensor(jnp.asarray(to_array(x)))
 
 
 def clone(x, name=None):

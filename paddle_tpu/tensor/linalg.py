@@ -146,9 +146,10 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
+    # returns (U, S, VH) with x == U @ diag(S) @ VH — ref
+    # python/paddle/tensor/linalg.py:1871 ("VH is the conjugate transpose of V")
     def f(v):
-        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+        return jnp.linalg.svd(v, full_matrices=full_matrices)
 
     return apply_op(f, x)
 
@@ -158,7 +159,8 @@ def svdvals(x, name=None):
 
 
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
-    u, s, v = svd(x)
+    u, s, vh = svd(x)
+    v = apply_op(lambda m: jnp.swapaxes(m, -1, -2).conj(), vh)
     return u[..., :q], s[..., :q], v[..., :q]
 
 

@@ -224,11 +224,17 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
     def f(v, i, val):
         i = i.astype(jnp.int32)
-        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        ax = axis % v.ndim
+        # numpy put_along_axis broadcast rules: indices/values broadcast
+        # against arr on the non-axis dims
+        bshape = list(v.shape)
+        bshape[ax] = i.shape[ax]
+        i = jnp.broadcast_to(i, bshape)
+        val = jnp.broadcast_to(val, bshape).astype(v.dtype)
         dims = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(v.ndim)])
-                for k, s in enumerate(v.shape)]
-        idx = [jnp.broadcast_to(d, i.shape) for d in dims]
-        idx[axis] = i
+                for k, s in enumerate(bshape)]
+        idx = [jnp.broadcast_to(d, bshape) for d in dims]
+        idx[ax] = i
         if reduce == "add":
             return v.at[tuple(idx)].add(val)
         if reduce in ("mul", "multiply"):
@@ -308,10 +314,11 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 
 def masked_select(x, mask, name=None):
-    # Dynamic output shape: eager-only (not jittable) — same restriction XLA has.
-    v = np.asarray(to_array(x))
+    # Dynamic output shape: eager-only (not jittable) — same restriction XLA
+    # has. The mask is concretized, so the gather is differentiable in x.
     m = np.asarray(to_array(mask)).astype(bool)
-    return Tensor(jnp.asarray(v[m]))
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    return apply_op(lambda v: v[idx], x)
 
 
 def masked_fill(x, mask, value, name=None):
@@ -320,12 +327,13 @@ def masked_fill(x, mask, value, name=None):
 
 
 def masked_scatter(x, mask, value, name=None):
-    v = np.asarray(to_array(x))
+    # concrete mask; differentiable in both x and value
     m = np.asarray(to_array(mask)).astype(bool)
-    val = np.asarray(to_array(value)).reshape(-1)
-    out = v.copy()
-    out[m] = val[: int(m.sum())]
-    return Tensor(jnp.asarray(out))
+    k = int(m.sum())
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    return apply_op(
+        lambda v, val: v.at[idx].set(val.reshape(-1)[:k].astype(v.dtype)),
+        x, value)
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
@@ -347,14 +355,20 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
     else:
         ax = axis
     n = v.shape[ax]
+    import builtins
+
     if n == 0:
         outs = [Tensor(v)]
+        if return_inverse:
+            outs.append(Tensor(jnp.zeros((0,), jnp.int64)))
+        if return_counts:
+            outs.append(Tensor(jnp.zeros((0,), jnp.int64)))
     else:
         first = np.ones(n, dtype=bool)
-        sl = [slice(None)] * v.ndim
+        sl = [builtins.slice(None)] * v.ndim
         sl_prev = list(sl)
-        sl[ax] = slice(1, None)
-        sl_prev[ax] = slice(None, -1)
+        sl[ax] = builtins.slice(1, None)
+        sl_prev[ax] = builtins.slice(None, -1)
         neq = np.any(v[tuple(sl)] != v[tuple(sl_prev)],
                      axis=tuple(i for i in range(v.ndim) if i != ax)) if v.ndim > 1 else (
             v[1:] != v[:-1])
@@ -390,13 +404,15 @@ def view_as(x, other, name=None):
 
 
 def unfold(x, axis, size, step, name=None):
+    # windows along `axis` become a new trailing dim of length `size`
+    # (Tensor.unfold semantics: out[..., w, ..., e] = x[..., w*step+e, ...])
     def f(v):
-        n = (v.shape[axis] - size) // step + 1
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
         idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
-        vm = jnp.moveaxis(v, axis, 0)
-        out = vm[idx]  # (n, size, ...)
-        out = jnp.moveaxis(out, 0, axis)
-        return jnp.moveaxis(out, axis + 1 if axis >= 0 else axis, -1)
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+        return jnp.moveaxis(out, ax + 1, -1)
 
     return apply_op(f, x)
 

@@ -267,21 +267,42 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return apply_op(lambda v: jnp.cumprod(v, axis=int(dim), dtype=d), x)
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
+def _cum_extreme(x, axis, dtype, op):
+    """(values, indices) running extreme — ref paddle.cummax/cummin return
+    both; index is the position of the running extreme along the axis."""
+    from ..framework.dtype import convert_dtype
+
     def f(v):
-        ax = -1 if axis is None else int(axis)
-        vals = jax.lax.associative_scan(jnp.maximum, v, axis=ax)
-        return vals
+        flat = axis is None
+        vv = v.reshape(-1) if flat else v
+        ax = -1 if flat else int(axis)
+        n = vv.shape[ax]
+        pos_shape = [1] * vv.ndim
+        pos_shape[ax] = n
+        pos = jnp.broadcast_to(
+            jnp.arange(n).reshape(pos_shape), vv.shape)
+
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            # NaN-propagating like np.maximum/minimum.accumulate: once a NaN
+            # enters the running extreme it sticks
+            take_b = (bv > av) if op is jnp.maximum else (bv < av)
+            take_b = take_b | jnp.isnan(bv)
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        vals, idx = jax.lax.associative_scan(combine, (vv, pos), axis=ax)
+        return vals, idx.astype(convert_dtype(dtype))
 
     return apply_op(f, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_extreme(x, axis, dtype, jnp.maximum)
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    def f(v):
-        ax = -1 if axis is None else int(axis)
-        return jax.lax.associative_scan(jnp.minimum, v, axis=ax)
-
-    return apply_op(f, x)
+    return _cum_extreme(x, axis, dtype, jnp.minimum)
 
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):

@@ -163,7 +163,7 @@ class TestLinalg:
         a = np.random.randn(4, 3).astype(np.float32)
         u, s, v = paddle.linalg.svd(paddle.to_tensor(a))
         allclose(paddle.to_tensor(
-            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T), a, rtol=1e-3, atol=1e-4)
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy()), a, rtol=1e-3, atol=1e-4)
         spd = a.T @ a + np.eye(3, dtype=np.float32)
         L = paddle.linalg.cholesky(paddle.to_tensor(spd))
         allclose(paddle.to_tensor(L.numpy() @ L.numpy().T), spd, rtol=1e-3, atol=1e-4)
