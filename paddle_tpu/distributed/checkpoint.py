@@ -70,6 +70,9 @@ class AutoCheckpoint:
         self._saved = []
 
     def step(self, model=None, optimizer=None, extra: Optional[dict] = None):
+        from .fleet.elastic import pulse_heartbeat
+
+        pulse_heartbeat()
         self._step += 1
         if self._step % self.every_n_steps != 0:
             return None

@@ -76,6 +76,13 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     n = num_processes if num_processes is not None else (len(eps) or None)
     if coordinator_address is None and eps:
         coordinator_address = eps[0]
+    if os.environ.get("PADDLE_HEARTBEAT_FILE"):
+        # launched with --elastic_timeout: start beating BEFORE the
+        # coordinator rendezvous so a rank wedged in initialize is still
+        # covered by the watcher (fleet/elastic.py)
+        from .fleet.elastic import start_file_heartbeat
+
+        start_file_heartbeat()
     if coordinator_address and (n or 1) > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -115,3 +115,67 @@ def run_with_fault_tolerance(train_fn: Callable[[int], None], checkpoint,
             attempts += 1
             if attempts > max_restarts:
                 raise
+
+
+_beat_state = {"thread_stop": None, "last_pulse": 0.0}
+
+
+def start_file_heartbeat(path: Optional[str] = None,
+                         interval: Optional[float] = None):
+    """Touch the launcher-assigned heartbeat file periodically so the
+    launcher's watcher (launch/main.py Pod.join) can detect a HUNG rank —
+    not just an exited one — and restart the pod (ref manager.py:260 lease
+    heartbeat, realized as file mtimes on the shared log dir).
+
+    Two phases:
+    - STARTUP (this thread): a free-running beat covers imports, rendezvous
+      and data loading, where no training progress exists yet.
+    - TRAINING: the first :func:`pulse_heartbeat` (called per train step by
+      the engines and ``AutoCheckpoint.step``) STOPS the thread — from then
+      on the file only advances with real training progress, so a rank
+      wedged inside a collective (thread would happily keep beating) goes
+      stale and is detected.
+
+    Auto-started by ``init_parallel_env`` when ``PADDLE_HEARTBEAT_FILE`` is
+    set (i.e. the job was launched with ``--elastic_timeout``). Returns the
+    stop Event, or None when no heartbeat file is configured."""
+    path = path or os.environ.get("PADDLE_HEARTBEAT_FILE")
+    if not path:
+        return None
+    interval = float(interval or
+                     os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0"))
+    stop = threading.Event()
+    _beat_state["thread_stop"] = stop
+
+    def beat():
+        while not stop.is_set():
+            _touch(path)
+            stop.wait(interval)
+
+    threading.Thread(target=beat, daemon=True).start()
+    return stop
+
+
+def _touch(path):
+    try:
+        with open(path, "a"):
+            os.utime(path, None)
+    except OSError:
+        pass
+
+
+def pulse_heartbeat():
+    """Per-train-step heartbeat pulse. Throttled to ~5 Hz. The first pulse
+    hands ownership of the heartbeat file from the startup thread to the
+    training loop (see start_file_heartbeat)."""
+    path = os.environ.get("PADDLE_HEARTBEAT_FILE")
+    if not path:
+        return
+    stop = _beat_state.get("thread_stop")
+    if stop is not None:
+        stop.set()
+        _beat_state["thread_stop"] = None
+    now = time.time()
+    if now - _beat_state["last_pulse"] >= 0.2:
+        _beat_state["last_pulse"] = now
+        _touch(path)

@@ -49,6 +49,12 @@ def parse_args(argv=None):
     p.add_argument("--run_mode", default="collective")
     p.add_argument("--job_id", default="default")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_timeout", type=float, default=0.0,
+                   help="seconds without a trainer heartbeat before the rank "
+                        "is declared hung and the pod restarted (0=off); "
+                        "trainers beat via PADDLE_HEARTBEAT_FILE (set "
+                        "automatically) — init_parallel_env or "
+                        "fleet.elastic.start_file_heartbeat() starts the beat")
     p.add_argument("--devices", "--gpus", default=None)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -58,10 +64,12 @@ def parse_args(argv=None):
 class Container:
     """One managed process (ref launch/job/container.py)."""
 
-    def __init__(self, cmd: List[str], env: dict, log_path: str):
+    def __init__(self, cmd: List[str], env: dict, log_path: str,
+                 heartbeat_file: str | None = None):
         self.cmd = cmd
         self.env = env
         self.log_path = log_path
+        self.heartbeat_file = heartbeat_file
         self.proc: subprocess.Popen | None = None
 
     def start(self):
@@ -92,16 +100,52 @@ class Pod:
         for c in self.containers:
             c.start()
 
-    def join(self) -> int:
+    HANG_EXIT = 98  # pod killed by the heartbeat watcher
+
+    @staticmethod
+    def _norm(code: int) -> int:
+        # signal deaths poll() as negative; normalize to 128+sig so a rank
+        # killed by SIGKILL can never be masked by a sibling's exit 0
+        return 128 - code if code < 0 else code
+
+    def join(self, hang_timeout: float = 0.0) -> int:
+        last_beat: dict = {}  # container -> (mtime, local time it changed)
         while True:
             codes = [c.poll() for c in self.containers]
             if all(code is not None for code in codes):
-                return max(code or 0 for code in codes)
+                return max(self._norm(code) for code in codes)
             if any(code not in (None, 0) for code in codes):
                 for c in self.containers:
                     c.terminate()
-                return max(code or 0 for code in codes if code is not None)
-            time.sleep(1)
+                return max(self._norm(code) for code in codes
+                           if code is not None)
+            if hang_timeout > 0:
+                # failure DETECTION beyond process exit (ref elastic
+                # manager.py:260 lease heartbeats): a rank that stops
+                # touching its heartbeat file while still running is hung —
+                # kill the pod so the launcher's restart loop can recover.
+                # Staleness = the mtime has not ADVANCED for hang_timeout by
+                # the launcher's own clock (comparing successive mtimes, not
+                # mtime-vs-wallclock, so a skewed NFS server clock cannot
+                # fake staleness).
+                now = time.time()
+                for c in self.containers:
+                    hb = c.heartbeat_file
+                    if not (c.poll() is None and hb and os.path.exists(hb)):
+                        continue
+                    mtime = os.path.getmtime(hb)
+                    prev = last_beat.get(c)
+                    if prev is None or mtime != prev[0]:
+                        last_beat[c] = (mtime, now)
+                        continue
+                    if now - prev[1] > hang_timeout:
+                        print(f"[launch] rank heartbeat stale "
+                              f"({hb}, >{hang_timeout}s): declaring hung",
+                              file=sys.stderr)
+                        for cc in self.containers:
+                            cc.terminate()
+                        return self.HANG_EXIT
+            time.sleep(0.2 if hang_timeout > 0 else 1)
 
     def stop(self):
         for c in self.containers:
@@ -124,9 +168,23 @@ def build_pod(args, node_rank: int, endpoints: List[str]) -> Pod:
             "PADDLE_MASTER": endpoints[0],
             "FLAGS_selected_devices": str(local_rank),
         }
-        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
         log = os.path.join(args.log_dir, f"workerlog.{global_rank}")
-        pod.containers.append(Container(cmd, env, log))
+        hb = None
+        if args.elastic_timeout > 0:
+            hb = os.path.join(args.log_dir, f"heartbeat.{global_rank}")
+            env["PADDLE_HEARTBEAT_FILE"] = hb
+            env["PADDLE_HEARTBEAT_INTERVAL"] = str(
+                max(0.2, args.elastic_timeout / 4))
+            try:
+                os.remove(hb)  # stale beat from a previous attempt
+            except OSError:
+                pass
+        if hb is None:
+            # clear any inherited value: a nested launch must not alias an
+            # outer launcher's heartbeat file
+            env["PADDLE_HEARTBEAT_FILE"] = ""
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        pod.containers.append(Container(cmd, env, log, heartbeat_file=hb))
     return pod
 
 
@@ -170,7 +228,7 @@ def launch(argv=None) -> int:
         while True:
             pod = build_pod(args, node_rank, endpoints)
             pod.deploy()
-            code = pod.join()
+            code = pod.join(hang_timeout=args.elastic_timeout)
             if code == 0:
                 return 0
             restarts += 1

@@ -261,6 +261,9 @@ class ParallelEngine:
         from ..framework.monitor import monitor_add
 
         monitor_add("engine_train_steps")
+        from ..distributed.fleet.elastic import pulse_heartbeat
+
+        pulse_heartbeat()  # progress-based hang detection (--elastic_timeout)
         if isinstance(self.optimizer._learning_rate, object) and hasattr(
                 self.optimizer._learning_rate, "step"):
             try:

@@ -285,6 +285,9 @@ class PipelineEngine:
         (self.rest, self.stacked, self.opt_state, self._step_count,
          loss) = self._train_step(self.rest, self.stacked, self.opt_state,
                                   self._step_count, lr, inputs, labels)
+        from ..distributed.fleet.elastic import pulse_heartbeat
+
+        pulse_heartbeat()
         return Tensor(loss)
 
     # ------------------------------------------------------------------- sync

@@ -276,3 +276,81 @@ class TestMultiNodeLaunch:
         eps0 = log0.split("EPS ")[1].strip()
         eps1 = log1.split("EPS ")[1].strip()
         assert eps0 == eps1 and len(eps0.split(",")) == 2
+
+
+class TestElasticDrill:
+    """Failure-detection + auto-resume drills (ref fleet/elastic/manager.py
+    heartbeats + unittests/collective/fleet/test_auto_checkpoint*.py kill-
+    and-resume pattern)."""
+
+    def test_kill_resume_from_checkpoint(self, tmp_path):
+        """SIGKILL a training proc mid-run; the launcher restarts it and it
+        must resume from the orbax AutoCheckpoint, not from step 0."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, signal, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu.distributed.checkpoint import AutoCheckpoint\n"
+            "from paddle_tpu.optimizer import AdamW\n"
+            "import paddle_tpu.nn as nn\n"
+            "work = %r\n"
+            "paddle.seed(0)\n"
+            "m = nn.Linear(4, 4)\n"
+            "opt = AdamW(learning_rate=0.1, parameters=m.parameters())\n"
+            "ck = AutoCheckpoint(os.path.join(work, 'ckpt'), every_n_steps=1)\n"
+            "start = ck.resume(m, opt)\n"
+            "open(os.path.join(work, 'starts.log'), 'a').write(f'{start}\\n')\n"
+            "x = paddle.to_tensor(np.ones((2, 4), 'float32'))\n"
+            "for step in range(start, 8):\n"
+            "    loss = paddle.mean((m(x) - 1.0) ** 2)\n"
+            "    loss.backward(); opt.step(); opt.clear_grad()\n"
+            "    ck.step(m, opt)\n"
+            "    marker = os.path.join(work, 'killed_once')\n"
+            "    if step == 3 and not os.path.exists(marker):\n"
+            "        open(marker, 'w').close()\n"
+            "        os.kill(os.getpid(), signal.SIGKILL)\n"
+            "open(os.path.join(work, 'final.log'), 'w').write(\n"
+            "    f'{float(np.asarray(loss.value)):.6f}')\n"
+            "print('DONE')\n" % ("/root/repo", str(tmp_path)))
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--max_restart", "2", "--log_dir", str(tmp_path / "log"),
+             str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=240)
+        assert r.returncode == 0, r.stderr[-800:]
+        starts = [int(s) for s in
+                  (tmp_path / "starts.log").read_text().split()]
+        assert starts[0] == 0 and len(starts) == 2 and starts[1] == 4, starts
+        assert (tmp_path / "final.log").exists()
+        assert "restart 1/2" in r.stderr
+
+    def test_hang_detection_restarts(self, tmp_path):
+        """A rank that stops heartbeating (hung, not dead) must be detected
+        by the launcher watcher, killed, and restarted."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from paddle_tpu.distributed.fleet.elastic import "
+            "start_file_heartbeat\n"
+            "work = %r\n"
+            "stop = start_file_heartbeat()\n"
+            "assert stop is not None, 'no heartbeat file assigned'\n"
+            "marker = os.path.join(work, 'hung_once')\n"
+            "if not os.path.exists(marker):\n"
+            "    open(marker, 'w').close()\n"
+            "    time.sleep(1)\n"
+            "    stop.set()  # simulate a hang: alive but not beating\n"
+            "    time.sleep(600)\n"
+            "print('DONE')\n" % ("/root/repo", str(tmp_path)))
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--max_restart", "2", "--elastic_timeout", "3",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=180)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "heartbeat stale" in r.stderr
+        assert "restart 1/2" in r.stderr
+        assert "DONE" in (tmp_path / "log" / "workerlog.0").read_text()
