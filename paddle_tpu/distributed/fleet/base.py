@@ -156,6 +156,9 @@ class Fleet:
             self.init()
         if strategy is not None:
             self.strategy = strategy
+        from .meta_optimizers import rewrite_inner_optimizer
+
+        optimizer = rewrite_inner_optimizer(optimizer, self.strategy)
         from ...static.graph import in_static_mode
 
         if in_static_mode():

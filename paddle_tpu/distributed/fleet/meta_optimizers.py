@@ -18,7 +18,8 @@ from typing import List, Optional
 from ..passes import new_pass
 
 __all__ = ["MetaOptimizerBase", "AMPOptimizer", "RecomputeOptimizer",
-           "GradientMergeOptimizer", "ShardingOptimizer",
+           "GradientMergeOptimizer", "ShardingOptimizer", "LambOptimizer",
+           "LarsOptimizer", "LocalSGDOptimizer",
            "apply_meta_optimizers", "StaticFleetOptimizer"]
 
 
@@ -106,8 +107,140 @@ class GradientMergeOptimizer(MetaOptimizerBase):
                           "avg": cfg.get("avg", True)})]
 
 
-_META_OPTIMIZERS = [AMPOptimizer, RecomputeOptimizer, ShardingOptimizer,
-                    GradientMergeOptimizer]
+class LambOptimizer(MetaOptimizerBase):
+    """ref lamb_optimizer.py — strategy.lamb swaps the inner optimizer for
+    LAMB (layer-adaptive moments for large-batch training)."""
+
+    priority = 5
+    name = "lamb"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "lamb", False))
+
+    def rewrite_optimizer(self, inner):
+        from ...optimizer import Lamb
+
+        cfg = getattr(self.strategy, "lamb_configs", {}) or {}
+        import re
+
+        exclude = [re.compile(pat) for pat in cfg.get("exclude_from_weight_decay", [])]
+        # carry the scheduler object (not a frozen float) and the grad clip;
+        # parameters may be unbound in static mode (minimize binds them)
+        return Lamb(learning_rate=inner._learning_rate,
+                    lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                    exclude_from_weight_decay_fn=(
+                        (lambda p: any(r.search(getattr(p, "name", "") or "")
+                                       for r in exclude)) if exclude else None),
+                    grad_clip=inner._grad_clip,
+                    parameters=inner._parameter_list)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """ref lars_optimizer.py — strategy.lars swaps Momentum for LARS."""
+
+    priority = 5
+    name = "lars"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "lars", False))
+
+    def rewrite_optimizer(self, inner):
+        from ...optimizer import Lars
+
+        cfg = getattr(self.strategy, "lars_configs", {}) or {}
+        return Lars(learning_rate=inner._learning_rate,
+                    momentum=cfg.get("momentum", 0.9),
+                    lars_coeff=cfg.get("lars_coeff", 0.001),
+                    lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                    grad_clip=inner._grad_clip,
+                    parameters=inner._parameter_list)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """ref localsgd_optimizer.py — skip per-step gradient allreduce; average
+    PARAMETERS across data-parallel workers every k_steps. TPU-native form:
+    the wrapper steps the inner optimizer on purely local grads and every
+    k_steps runs an eager all_reduce(param)/world_size over the default
+    group (the eager DP path; the GSPMD engine's per-step psum is already
+    the k=1 case)."""
+
+    priority = 45
+    name = "localsgd"
+
+    def can_apply(self):
+        return bool(getattr(self.strategy, "localsgd", False))
+
+    def rewrite_optimizer(self, inner):
+        cfg = getattr(self.strategy, "localsgd_configs", {}) or {}
+        return _LocalSGDWrapper(inner, int(cfg.get("k_steps", 1)))
+
+
+class _LocalSGDWrapper:
+    _OWN = ("_inner", "_k", "_t")
+
+    def __init__(self, inner, k_steps):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_k", max(1, k_steps))
+        object.__setattr__(self, "_t", 0)
+
+    def step(self):
+        self._inner.step()
+        object.__setattr__(self, "_t", self._t + 1)
+        if self._t % self._k == 0:
+            self._average_params()
+
+    def _average_params(self):
+        # average over the axis all_reduce actually reduces (the dp group),
+        # NOT the global world size — and only when a reduction happened
+        from ...distributed.collective import _axis_size, all_reduce
+
+        n = _axis_size("data")
+        if n <= 1:
+            return
+        for p in self._inner._get_params():
+            all_reduce(p)
+            p.set_value(p.value / n)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __setattr__(self, item, value):
+        # attribute writes meant for the optimizer (e.g. HybridParallel's
+        # _grad_clip replacement) must land on the inner, not the proxy
+        if item in self._OWN:
+            object.__setattr__(self, item, value)
+        else:
+            setattr(self._inner, item, value)
+
+
+# DGC (deep gradient compression, ref dgc_optimizer.py / dgc_op.cc) is a
+# documented NON-GOAL: it sparsifies the NCCL allreduce payload with top-k
+# gradient selection, which has no profitable mapping onto XLA's dense
+# ICI collectives (a sparse allgather of (idx, val) pairs is slower than the
+# fused dense psum on TPU interconnect). The strategy flag is accepted and
+# ignored with a warning for migration compatibility.
+_META_OPTIMIZERS = [LambOptimizer, LarsOptimizer, AMPOptimizer,
+                    RecomputeOptimizer, ShardingOptimizer,
+                    GradientMergeOptimizer, LocalSGDOptimizer]
+
+
+def rewrite_inner_optimizer(inner, strategy):
+    """Apply the optimizer-swapping meta-optimizers (lamb/lars/localsgd —
+    ref meta_optimizers that replace the inner optimizer rather than rewrite
+    the program). DGC is accepted-but-ignored with a warning (non-goal: top-k
+    sparsified allreduce loses to dense XLA collectives on ICI)."""
+    if getattr(strategy, "dgc", False):
+        import warnings
+
+        warnings.warn(
+            "strategy.dgc is a documented non-goal on TPU (dense XLA "
+            "collectives over ICI outperform top-k sparsified allreduce); "
+            "training proceeds without gradient compression", UserWarning)
+    for cls in sorted(_META_OPTIMIZERS, key=lambda c: c.priority):
+        mo = cls(strategy)
+        if hasattr(mo, "rewrite_optimizer") and mo.can_apply():
+            inner = mo.rewrite_optimizer(inner)
+    return inner
 
 
 def apply_meta_optimizers(main_program, startup_program, strategy):

@@ -1,8 +1,12 @@
 """paddle.static.quantization — static-graph quantization entry points
 (ref python/paddle/static/quantization/: QuantizationTransformPass,
-quant_int8 post-training flows).  Our static Programs replay through jit,
-so quantization happens at the layer level: these re-export the dygraph
-QAT/PTQ machinery, which works identically on recorded programs."""
+post_training_quantization.py quant_post_static).  Our static Programs
+replay through jit, so quantization happens at the layer level: the dygraph
+QAT/PTQ machinery works identically on recorded programs."""
+import os
+
+import numpy as np
+
 from ...quantization import (PTQ, QAT, QATv2, QuantConfig,  # noqa: F401
                              FakeQuanterWithAbsMax,
                              FakeQuanterWithAbsMaxObserver, QuantedConv2D,
@@ -11,12 +15,109 @@ from ...quantization import (PTQ, QAT, QATv2, QuantConfig,  # noqa: F401
 
 
 def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
-                      sample_generator=None, batch_size=16, batch_nums=None,
-                      algo="abs_max", **kwargs):
-    """Minimal post-training static quantization driver: load an inference
-    model, calibrate abs-max scales over sample batches, store scales next to
-    the model (ref static/quantization/post_training_quantization.py)."""
-    raise NotImplementedError(
-        "paddle_tpu serves quantized inference through PTQ(model).quantize(); "
-        "StableHLO export of quantized programs lands with the inference "
-        "engine (see paddle_tpu/inference)")
+                      sample_generator=None, model=None, model_filename=None,
+                      params_filename=None, batch_size=16, batch_nums=8,
+                      algo="abs_max", weight_bits=8, **kwargs):
+    """Post-training quantization driver (ref
+    static/quantization/post_training_quantization.py quant_post_static).
+
+    Two entry forms, matching what each artifact allows:
+
+    - ``model=`` a live Layer (+ optional ``sample_generator``): full PTQ —
+      calibrate per-layer activation abs-max scales over ``batch_nums``
+      sample batches, per-channel abs-max quantize every >=2D weight, and
+      write the quantized program to ``quantize_model_path`` (int8 weights +
+      fp32 scales + activation ranges).
+
+    - ``model_dir=`` a ``jit.save`` artifact prefix: weight-only int8 —
+      the serialized StableHLO cannot be re-traced for activation quant, so
+      the weights are per-channel abs-max quantized and saved alongside the
+      copied program manifest (the reference's weight-only
+      ``quant_post_only_weight`` flow).
+
+    Output format at ``quantize_model_path``:
+      ``<path>.pdiparams``   — {name: int8 array} for quantized weights,
+                               original arrays for the rest
+      ``<path>.scales``      — {name: fp32 per-channel scale} +
+                               {"act/<layer>": abs-max} activation ranges
+      plus the ``.pdmodel``/``.stablehlo``/``.pdexport`` manifest files
+      copied from the source when loading from ``model_dir``.
+    Use :func:`load_quantized_state` to get a dequantized float state_dict.
+    """
+    import pickle
+
+    from ...framework.io_state import load as _load
+    from ...framework.io_state import save as _save
+
+    assert quantize_model_path, "quantize_model_path is required"
+    act_ranges = {}
+    if model is not None:
+        state = {k: np.asarray(v.value) for k, v in model.state_dict().items()}
+        if sample_generator is not None:
+            ptq = PTQ({"bits": weight_bits})
+            act_ranges = ptq.observe(model, sample_generator,
+                                     n_batches=batch_nums or 8)
+    elif model_dir is not None:
+        state = _load(model_dir + ".pdiparams")
+        state = {k: np.asarray(v.value if hasattr(v, "value") else v)
+                 for k, v in state.items()}
+    else:
+        raise ValueError("pass either model= (live Layer) or model_dir= "
+                         "(jit.save artifact prefix)")
+
+    qstate, scales = {}, {}
+    for name, arr in state.items():
+        if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+            # per-OUTPUT-channel abs-max (ref ChannelWiseAbsMax): Linear
+            # weights are [in, out] (channel = last axis); conv weights are
+            # OIHW (channel = axis 0)
+            if arr.ndim == 2:
+                axes, bshape = (0,), (1, arr.shape[1])
+            else:
+                axes = tuple(range(1, arr.ndim))
+                bshape = (arr.shape[0],) + (1,) * (arr.ndim - 1)
+            scale = np.maximum(np.abs(arr).max(axis=axes), 1e-8) / 127.0
+            qstate[name] = np.clip(np.round(arr / scale.reshape(bshape)),
+                                   -128, 127).astype(np.int8)
+            scales[name] = scale.astype(np.float32)
+        else:
+            qstate[name] = arr
+    for lname, r in (act_ranges or {}).items():
+        scales[f"act/{lname}"] = np.float32(r)
+
+    os.makedirs(os.path.dirname(quantize_model_path) or ".", exist_ok=True)
+    _save(qstate, quantize_model_path + ".pdiparams")
+    with open(quantize_model_path + ".scales", "wb") as f:
+        pickle.dump(scales, f)
+    if model_dir is not None:
+        import shutil
+
+        for ext in (".pdmodel", ".stablehlo", ".pdexport"):
+            src = model_dir + ext
+            if os.path.exists(src):
+                shutil.copy(src, quantize_model_path + ext)
+    return quantize_model_path
+
+
+def load_quantized_state(path):
+    """Load a quant_post_static artifact back to a float32 state dict
+    (int8 weight * per-channel scale); activation ranges under 'act/'."""
+    import pickle
+
+    from ...framework.io_state import load as _load
+
+    state = _load(path + ".pdiparams")
+    with open(path + ".scales", "rb") as f:
+        scales = pickle.load(f)
+    out = {}
+    for name, v in state.items():
+        arr = np.asarray(v.value if hasattr(v, "value") else v)
+        if name in scales and arr.dtype == np.int8:
+            sc = scales[name]
+            bshape = ((1, -1) if arr.ndim == 2
+                      else (-1,) + (1,) * (arr.ndim - 1))
+            out[name] = arr.astype(np.float32) * sc.reshape(bshape)
+        else:
+            out[name] = arr
+    acts = {k[4:]: float(v) for k, v in scales.items() if k.startswith("act/")}
+    return out, acts
