@@ -68,7 +68,7 @@ class PipelineEngine:
                  optimizer=None, mesh: Optional[Mesh] = None,
                  num_micro: int = 2, remat: bool = True,
                  abstract: bool = False, fsdp: bool = False,
-                 fsdp_axis: str = "sharding"):
+                 fsdp_axis: str = "sharding", num_chunks: int = 1):
         from ..distributed.collective import get_global_mesh
 
         assert optimizer is not None, \
@@ -81,15 +81,17 @@ class PipelineEngine:
             "PipelineEngine needs a mesh with a 'pipe' axis"
         self.num_stages = int(self.mesh.shape["pipe"])
         self.num_micro = num_micro
+        self.num_chunks = num_chunks  # >1: interleaved virtual stages
         self.remat = remat
         self._abstract = abstract
         self._layers_prefix = layers_prefix
         self._pre_fn, self._block_fn, self._post_fn = pre_fn, block_fn, post_fn
 
         L = len(layers)
-        assert L % self.num_stages == 0, \
-            f"{L} layers not divisible by {self.num_stages} stages"
-        self.layers_per_stage = L // self.num_stages
+        S, C = self.num_stages, self.num_chunks
+        assert L % (S * C) == 0, \
+            f"{L} layers not divisible by {S} stages x {C} chunks"
+        self.layers_per_stage = L // (S * C)  # per logical stage
 
         self.fsdp, self.fsdp_axis = fsdp, fsdp_axis
 
@@ -102,18 +104,33 @@ class PipelineEngine:
 
         self.stacked_specs: Dict[str, P] = {}
         stacked = {}
+        lps = self.layers_per_stage
         for sub in sub_names:
             arrs = [all_vals[f"{layers_prefix}.{i}.{sub}"] for i in range(L)]
-            shape = (self.num_stages, self.layers_per_stage) + tuple(arrs[0].shape)
+            w = tuple(arrs[0].shape)
+            if C > 1:
+                # interleaved: logical stage s = chunk*S + device owns layers
+                # [s*lps, (s+1)*lps) -> element [dev, chunk, j] = layer
+                # (chunk*S + dev)*lps + j
+                shape = (S, C, lps) + w
+                lead = P("pipe", None, None)
+            else:
+                shape = (S, lps) + w
+                lead = P("pipe", None)
             base = tuple(base_specs.get(f"{layers_prefix}.0.{sub}", P()))
             self.stacked_specs[sub] = self._with_fsdp(
-                _filter_spec(P("pipe", None, *base), self.mesh), shape)
+                _filter_spec(P(*lead, *base), self.mesh), shape)
             if abstract:
                 stacked[sub] = (shape, arrs[0].dtype)  # no materialization
             else:
                 # stack on HOST, then device_put with the final sharding —
                 # never materializes an unsharded device copy of the stack
-                stacked[sub] = np.stack([np.asarray(a) for a in arrs]).reshape(shape)
+                st = np.stack([np.asarray(a) for a in arrs])
+                if C > 1:
+                    st = np.swapaxes(st.reshape((C, S, lps) + w), 0, 1)
+                else:
+                    st = st.reshape(shape)
+                stacked[sub] = np.ascontiguousarray(st)
         self.rest_specs = {
             n: base_specs.get(n, P()) for n in all_vals
             if not n.startswith(layers_prefix + ".")
@@ -163,7 +180,8 @@ class PipelineEngine:
         entries += [None] * (len(shape) - len(entries))
         if self.fsdp_axis in entries:  # base spec already consumed the axis
             return P(*entries)
-        for i in range(2, len(shape)):  # skip the (pipe, layer) dims
+        lead = 3 if self.num_chunks > 1 else 2  # (pipe[, chunk], layer) dims
+        for i in range(lead, len(shape)):
             if entries[i] is None and shape[i] % size == 0:
                 entries[i] = self.fsdp_axis
                 break
@@ -202,28 +220,42 @@ class PipelineEngine:
     # ------------------------------------------------------------- train step
     def _pipeline_apply(self, stacked, acts):
         """acts [B, ...] -> [B, ...] through the pipelined stack."""
-        from ..distributed.fleet.meta_parallel.pipeline_parallel import \
-            spmd_pipeline_fn
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import (
+            spmd_interleaved_pipeline_fn, spmd_pipeline_fn)
 
         lps, remat = self.layers_per_stage, self.remat
         block_fn = self._block_fn
 
-        def stage_fn(stage_id, params_shard, x):
-            def body(ps, x):
+        def run_blocks(blocks, x):
+            # blocks: pytree with leading [lps] dim
+            def body(bs, x):
                 for j in range(lps):
-                    blk = {k: v[0, j] for k, v in ps.items()}
-                    x = block_fn(blk, x)
+                    x = block_fn({k: v[j] for k, v in bs.items()}, x)
                 return x
 
             if remat:
-                return jax.checkpoint(body)(params_shard, x)
-            return body(params_shard, x)
+                return jax.checkpoint(body)(blocks, x)
+            return body(blocks, x)
 
         B = acts.shape[0]
         assert B % self.num_micro == 0, (B, self.num_micro)
         micro = acts.reshape((self.num_micro, B // self.num_micro) +
                              acts.shape[1:])
-        fn = spmd_pipeline_fn(stage_fn, self.num_stages, self.num_micro)
+        if self.num_chunks > 1:
+            # interleaved virtual stages (ref PipelineParallelWithInterleave
+            # pipeline_parallel.py:461): bubble (S-1)/(M*C), differentiated
+            # end-to-end like the plain schedule
+            def chunk_fn(chunk_id, params_chunk, x):
+                return run_blocks(params_chunk, x)
+
+            fn = spmd_interleaved_pipeline_fn(chunk_fn, self.num_stages,
+                                              self.num_micro, self.num_chunks)
+        else:
+            def stage_fn(stage_id, params_shard, x):
+                return run_blocks(
+                    {k: v[0] for k, v in params_shard.items()}, x)
+
+            fn = spmd_pipeline_fn(stage_fn, self.num_stages, self.num_micro)
         out = jax.shard_map(
             fn, mesh=self.mesh, in_specs=(P("pipe"), P()), out_specs=P(),
             axis_names=frozenset({"pipe"}))(stacked, micro)
@@ -296,7 +328,12 @@ class PipelineEngine:
         checkpointing / parity checks)."""
         out = dict(self.rest)
         for sub, v in self.stacked.items():
-            flat = np.asarray(v).reshape((-1,) + tuple(v.shape[2:]))
+            a = np.asarray(v)
+            if self.num_chunks > 1:
+                a = np.swapaxes(a, 0, 1)  # [S,C,lps,...] -> [C,S,lps,...]
+                flat = a.reshape((-1,) + a.shape[3:])
+            else:
+                flat = a.reshape((-1,) + a.shape[2:])
             for i in range(flat.shape[0]):
                 out[f"{self._layers_prefix}.{i}.{sub}"] = jnp.asarray(flat[i])
         return out
@@ -311,7 +348,8 @@ class PipelineEngine:
 
 def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
                           remat: bool = True, abstract: bool = False,
-                          fsdp: bool = False) -> PipelineEngine:
+                          fsdp: bool = False, num_chunks: int = 1
+                          ) -> PipelineEngine:
     """Wire a ``LlamaForCausalLM`` into the pipeline engine: embedding before
     the pipe region, decoder blocks inside, final-norm + lm-head + CE after.
     Tied embeddings (cfg.tie_word_embeddings) share one array across both
@@ -353,4 +391,5 @@ def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
 
     return PipelineEngine(lm, layers, "model.layers", pre_fn, block_fn, post_fn,
                           optimizer=optimizer, mesh=mesh, num_micro=num_micro,
-                          remat=remat, abstract=abstract, fsdp=fsdp)
+                          remat=remat, abstract=abstract, fsdp=fsdp,
+                          num_chunks=num_chunks)
