@@ -140,23 +140,22 @@ def create_predictor(config_or_layer, example_inputs=None, **kw) -> Predictor:
 # --------------------------------------------------------------------------- #
 
 
-def export_model(layer, example_inputs: Sequence[Any], path: str):
-    """Serialize weights + StableHLO of the jitted forward (ref: the saved
-    inference program; jax.export replaces ProgramDesc+params files)."""
+def _unwrap_out(out):
+    return jax.tree_util.tree_map(
+        lambda t: t.value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _write_artifact(fn, params, example_inputs, path, meta_extra=None):
+    """Trace fn(params, *inputs), serialize StableHLO + params + meta —
+    the one artifact format load_predictor consumes."""
     from jax import export as jexport
 
-    layer.eval()
-    params = state_values(layer)
-
-    def fn(params, *args):
-        out = functional_call(layer, params, *[Tensor(a) for a in args])
-        return jax.tree_util.tree_map(
-            lambda t: t.value if isinstance(t, Tensor) else t, out,
-            is_leaf=lambda t: isinstance(t, Tensor))
-
-    ex = [a.value if isinstance(a, Tensor) else jnp.asarray(a) for a in example_inputs]
+    ex = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+          for a in example_inputs]
     exported = jexport.export(jax.jit(fn))(
-        jax.tree_util.tree_map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params),
+        jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params),
         *[jax.ShapeDtypeStruct(e.shape, e.dtype) for e in ex])
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "model.stablehlo"), "wb") as f:
@@ -164,8 +163,66 @@ def export_model(layer, example_inputs: Sequence[Any], path: str):
     with open(os.path.join(path, "params.pkl"), "wb") as f:
         pickle.dump(jax.tree_util.tree_map(np.asarray, params), f)
     with open(os.path.join(path, "meta.pkl"), "wb") as f:
-        pickle.dump({"n_inputs": len(ex)}, f)
+        pickle.dump({"n_inputs": len(ex), **(meta_extra or {})}, f)
     return path
+
+
+def export_model(layer, example_inputs: Sequence[Any], path: str):
+    """Serialize weights + StableHLO of the jitted forward (ref: the saved
+    inference program; jax.export replaces ProgramDesc+params files)."""
+    layer.eval()
+    params = state_values(layer)
+
+    def fn(params, *args):
+        return _unwrap_out(
+            functional_call(layer, params, *[Tensor(a) for a in args]))
+
+    return _write_artifact(fn, params, example_inputs, path)
+
+
+def export_quantized_model(layer, example_inputs: Sequence[Any], path: str):
+    """Quantized-program export (the reference's int8 quantizer pipeline,
+    ref inference/api/mkldnn_quantizer.cc, done the TPU way): serialized
+    params are per-output-channel INT8 weights, and the traced StableHLO
+    program dequantizes in-graph — int8 weights live in HBM (half the
+    artifact/transfer of bf16, quarter of fp32) and XLA fuses the dequant
+    into the consuming matmul (the weight-only int8 serving path that gives
+    1.55x decode throughput, BASELINE.md). Loads with the same
+    :func:`load_predictor`."""
+    from jax import export as jexport
+
+    from ..static.quantization import channelwise_quant_int8
+
+    layer.eval()
+    params = state_values(layer)
+    qparams: Dict[str, Any] = {}
+    scales: Dict[str, Any] = {}
+    for name, v in params.items():
+        arr = np.asarray(v)
+        # jnp.issubdtype (not np.): bfloat16 is outside numpy's floating
+        # hierarchy but is exactly the dtype this export targets
+        if arr.ndim >= 2 and jnp.issubdtype(arr.dtype, jnp.floating):
+            q, sc, bshape = channelwise_quant_int8(
+                arr.astype(np.float32) if arr.dtype != np.float32 else arr)
+            qparams[name] = q
+            scales[name] = (jnp.asarray(sc.reshape(bshape)), arr.dtype)
+        else:
+            qparams[name] = arr
+    assert scales, "no quantizable (>=2D floating) weights found"
+
+    def fn(qp, *args):
+        deq = {}
+        for name, v in qp.items():
+            if name in scales:
+                sc, dt = scales[name]  # scales are program constants
+                deq[name] = (v.astype(jnp.float32) * sc).astype(dt)
+            else:
+                deq[name] = v
+        return _unwrap_out(
+            functional_call(layer, deq, *[Tensor(a) for a in args]))
+
+    return _write_artifact(fn, qparams, example_inputs, path,
+                           meta_extra={"quantized": "int8-weight-only"})
 
 
 def load_predictor(path: str) -> Predictor:

@@ -14,6 +14,22 @@ from ...quantization import (PTQ, QAT, QATv2, QuantConfig,  # noqa: F401
                              quantize_absmax)
 
 
+def channelwise_quant_int8(arr):
+    """Per-OUTPUT-channel abs-max int8 quantization (ref ChannelWiseAbsMax):
+    Linear weights are [in, out] (channel = last axis); conv weights are
+    OIHW (channel = axis 0). Returns (int8 q, fp32 per-channel scale,
+    broadcast shape for dequant)."""
+    if arr.ndim == 2:
+        axes, bshape = (0,), (1, arr.shape[1])
+    else:
+        axes = tuple(range(1, arr.ndim))
+        bshape = (arr.shape[0],) + (1,) * (arr.ndim - 1)
+    scale = np.maximum(np.abs(arr).max(axis=axes), 1e-8) / 127.0
+    q = np.clip(np.round(arr / scale.reshape(bshape)), -128, 127
+                ).astype(np.int8)
+    return q, scale.astype(np.float32), bshape
+
+
 def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
                       sample_generator=None, model=None, model_filename=None,
                       params_filename=None, batch_size=16, batch_nums=8,
@@ -67,19 +83,13 @@ def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
 
     qstate, scales = {}, {}
     for name, arr in state.items():
-        if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
-            # per-OUTPUT-channel abs-max (ref ChannelWiseAbsMax): Linear
-            # weights are [in, out] (channel = last axis); conv weights are
-            # OIHW (channel = axis 0)
-            if arr.ndim == 2:
-                axes, bshape = (0,), (1, arr.shape[1])
-            else:
-                axes = tuple(range(1, arr.ndim))
-                bshape = (arr.shape[0],) + (1,) * (arr.ndim - 1)
-            scale = np.maximum(np.abs(arr).max(axis=axes), 1e-8) / 127.0
-            qstate[name] = np.clip(np.round(arr / scale.reshape(bshape)),
-                                   -128, 127).astype(np.int8)
-            scales[name] = scale.astype(np.float32)
+        import jax.numpy as jnp
+
+        # jnp.issubdtype: bfloat16 models quantize too (bf16 is outside
+        # numpy's floating hierarchy)
+        if arr.ndim >= 2 and jnp.issubdtype(arr.dtype, jnp.floating):
+            qstate[name], scales[name], _ = channelwise_quant_int8(
+                arr.astype(np.float32) if arr.dtype != np.float32 else arr)
         else:
             qstate[name] = arr
     for lname, r in (act_ranges or {}).items():
