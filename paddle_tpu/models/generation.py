@@ -41,7 +41,7 @@ def advance_tokens(toks, done, nxt, t, prompt_len: int, total_len: int,
     nxt = jnp.where(given, cur, nxt)
     if eos_token_id is not None:
         nxt = jnp.where(done, eos_token_id, nxt)
-        done = done | ((nxt == eos_token_id) & ~given)
+        done = done | ((nxt == eos_token_id) & jnp.logical_not(given))
     toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, at))
     return toks, done
 

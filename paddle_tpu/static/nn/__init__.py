@@ -91,3 +91,13 @@ def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
     from ..jit import while_loop as _wl
 
     return _wl(cond_fn, body, loop_vars)
+
+# sequence op family (dense + lengths representation; ref
+# fluid/layers/sequence_lod.py)
+from .sequence import (sequence_concat, sequence_conv,  # noqa: F401
+                       sequence_enumerate, sequence_expand,
+                       sequence_expand_as, sequence_first_step,
+                       sequence_last_step, sequence_mask, sequence_pad,
+                       sequence_pool, sequence_reshape, sequence_reverse,
+                       sequence_scatter, sequence_slice, sequence_softmax,
+                       sequence_unpad)

@@ -1,0 +1,309 @@
+"""Sequence op family (ref python/paddle/fluid/layers/sequence_lod.py —
+sequence_conv:49 ... sequence_reverse:1432, 16 LoD-based ops backed by
+paddle/fluid/operators/sequence_ops/).
+
+TPU-native redesign: the reference represents ragged batches as LoD tensors
+(flat values + offset table). XLA wants static shapes, so the equivalent
+representation here is DENSE-PADDED ``[B, T, ...]`` values + an int
+``lengths [B]`` vector (exactly what ``sequence_pad`` produces in the
+reference). Every op below is the dense+lengths formulation of its LoD
+ancestor; ops whose OUTPUT is ragged (``sequence_unpad``) return packed
+values eagerly (dynamic output shape — same restriction the reference's
+LoD→dense boundary has).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, to_array
+from ...framework.dispatch import apply_op
+
+__all__ = ["sequence_conv", "sequence_softmax", "sequence_pool",
+           "sequence_concat", "sequence_first_step", "sequence_last_step",
+           "sequence_slice", "sequence_expand", "sequence_expand_as",
+           "sequence_pad", "sequence_unpad", "sequence_reshape",
+           "sequence_scatter", "sequence_enumerate", "sequence_mask",
+           "sequence_reverse"]
+
+
+def _tmask(lengths, T, dtype=jnp.bool_):
+    """[B, T] validity mask from lengths."""
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Ref sequence_lod.py:1369 — lengths → mask."""
+    from ...nn.functional import sequence_mask as _sm
+
+    return _sm(x, maxlen=maxlen, dtype=dtype, name=name)
+
+
+def sequence_softmax(input, lengths, name=None):
+    """Masked softmax over the time dim (ref :189 — softmax within each
+    sequence; padded steps get probability 0)."""
+
+    def f(x, ln):
+        m = _tmask(ln, x.shape[1])
+        shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        mm = m.reshape(shape)
+        z = jnp.where(mm, x, -jnp.inf)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(mm, p, 0.0)
+
+    return apply_op(f, input, lengths)
+
+
+def sequence_pool(input, lengths, pool_type="average", pad_value=0.0,
+                  name=None):
+    """Ref :276 — pool each sequence over time: sum / average / sqrt
+    (sum/sqrt(len)) / max / min / first / last. Empty sequences yield
+    pad_value."""
+    pool_type = pool_type.lower()
+
+    def f(x, ln):
+        T = x.shape[1]
+        m = _tmask(ln, T).reshape((x.shape[0], T) + (1,) * (x.ndim - 2))
+        lnf = jnp.maximum(ln, 1).reshape((-1,) + (1,) * (x.ndim - 2))
+        xm = jnp.where(m, x, 0.0)
+        if pool_type == "sum":
+            out = xm.sum(axis=1)
+        elif pool_type == "average":
+            out = xm.sum(axis=1) / lnf
+        elif pool_type == "sqrt":
+            out = xm.sum(axis=1) / jnp.sqrt(lnf.astype(x.dtype))
+        elif pool_type == "max":
+            out = jnp.where(m, x, -jnp.inf).max(axis=1)
+        elif pool_type == "min":
+            out = jnp.where(m, x, jnp.inf).min(axis=1)
+        elif pool_type == "first":
+            out = x[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            ).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        empty = (ln == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+    return apply_op(f, input, lengths)
+
+
+def sequence_first_step(input, lengths):
+    """Ref :462."""
+    return sequence_pool(input, lengths, "first")
+
+
+def sequence_last_step(input, lengths):
+    """Ref :520."""
+    return sequence_pool(input, lengths, "last")
+
+
+def sequence_conv(input, lengths, filter_param, context_size=3,
+                  context_start=None, bias=None, name=None):
+    """Ref :49 — context-window projection: for each timestep, concatenate
+    the ``context_size`` neighboring steps (zero-padded at sequence borders
+    AND beyond each sequence's length) and project with
+    ``filter_param [context_size * D, num_filters]``."""
+    if context_start is None:
+        context_start = -(context_size // 2)
+
+    def f(x, ln, w, *b):
+        B, T, D = x.shape
+        m = _tmask(ln, T, x.dtype)[..., None]
+        xm = x * m
+        cols = []
+        for k in range(context_size):
+            off = context_start + k
+            shifted = jnp.roll(xm, -off, axis=1)
+            if off > 0:  # looking forward: zero the wrapped tail
+                valid = jnp.arange(T) < (T - off)
+            elif off < 0:
+                valid = jnp.arange(T) >= (-off)
+            else:
+                valid = jnp.ones((T,), bool)
+            cols.append(shifted * valid[None, :, None].astype(x.dtype))
+        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+        out = ctx @ w
+        if b:
+            out = out + b[0]
+        return out * m
+
+    args = (input, lengths, filter_param) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args)
+
+
+def sequence_concat(input, lengths_list, name=None):
+    """Ref :394 — concatenate sequences element-wise: output row b is
+    seq0[b] ++ seq1[b] ++ ... Returns (padded values, lengths)."""
+
+    n = len(input)
+
+    def f(*args):
+        xs, lens = args[:n], args[n:]
+        total = sum(lens[1:], lens[0])  # [B]
+        Tout = sum(x.shape[1] for x in xs)
+        B = xs[0].shape[0]
+        out = jnp.zeros((B, Tout) + xs[0].shape[2:], xs[0].dtype)
+        pos = jnp.zeros((B,), jnp.int32)
+        for x, ln in zip(xs, lens):
+            T = x.shape[1]
+            t_idx = jnp.arange(T)[None, :] + pos[:, None]  # [B, T]
+            m = _tmask(ln, T)
+            safe = jnp.where(m, t_idx, Tout)  # parked writes → dropped
+            out = out.at[jnp.arange(B)[:, None], safe].set(
+                jnp.where(m.reshape((B, T) + (1,) * (x.ndim - 2)), x, 0),
+                mode="drop")
+            pos = pos + ln.astype(jnp.int32)
+        return out, total
+
+    return apply_op(f, *input, *lengths_list)
+
+
+def sequence_slice(input, lengths, offset, length, name=None):
+    """Ref :579 — per-sequence slice [offset, offset+length); returns
+    (padded values, new lengths = clip(len-off, 0, length))."""
+
+    def f(x, ln, off, lgt):
+        B, T = x.shape[0], x.shape[1]
+        t = jnp.arange(T)[None, :]
+        src = t + off[:, None]
+        valid = ((t < lgt[:, None]) & (src >= 0) & (src < ln[:, None]) &
+                 (src < T))
+        src = jnp.clip(src, 0, T - 1)
+        out = jnp.take_along_axis(
+            x, src.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+        return jnp.where(valid.reshape((B, T) + (1,) * (x.ndim - 2)), out, 0)
+
+    new_len = apply_op(
+        lambda ln, off, lgt: jnp.clip(ln - off, 0, lgt), lengths, offset, length)
+    return apply_op(f, input, lengths, offset, length), new_len
+
+
+def sequence_expand(x, lengths, ref_lengths, maxlen=None, name=None):
+    """Ref :673 (ref_level=-1 dense analogue): repeat each row b of ``x``
+    ``ref_lengths[b]`` times along a new time axis — returns padded
+    [B, maxlen or max(ref_lengths), ...]. ``lengths`` (x's own lengths) is
+    accepted for API shape but dense rows are whole by construction."""
+    # maxlen=0 is a real (zero-width) request; only None means "derive".
+    # Deriving concretizes ref_lengths — pass maxlen explicitly under
+    # static-graph build / jit.
+    T = int(maxlen) if maxlen is not None else \
+        int(np.asarray(to_array(ref_lengths)).max())
+
+    def f(v, rln):
+        rep = jnp.repeat(v[:, None], T, axis=1)
+        m = _tmask(rln, T).reshape((v.shape[0], T) + (1,) * (v.ndim - 1))
+        return jnp.where(m, rep, 0)
+
+    return apply_op(f, x, ref_lengths)
+
+
+def sequence_expand_as(x, y, y_lengths, name=None):
+    """Ref :812 — expand x rows to y's padded time dim, masked by y's
+    lengths."""
+
+    def f(v, yv, yln):
+        T = yv.shape[1]
+        rep = jnp.repeat(v[:, None], T, axis=1)
+        m = _tmask(yln, T).reshape((v.shape[0], T) + (1,) * (v.ndim - 1))
+        return jnp.where(m, rep, 0)
+
+    return apply_op(f, x, y, y_lengths)
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """Ref :932 — packed values [sum(L), ...] + lengths → padded
+    [B, maxlen, ...]. Eager (the packed input has data-dependent shape)."""
+    v = np.asarray(to_array(x))
+    ln = np.asarray(to_array(lengths)).astype(np.int64)
+    pv = float(to_array(pad_value)) if isinstance(pad_value, Tensor) else pad_value
+    B = len(ln)
+    T = int(maxlen) if maxlen is not None else int(ln.max())
+    out = np.full((B, T) + v.shape[1:], pv, v.dtype)
+    pos = 0
+    for b in range(B):
+        n = min(int(ln[b]), T)
+        out[b, :n] = v[pos:pos + int(ln[b])][:n]
+        pos += int(ln[b])
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(ln))
+
+
+def sequence_unpad(x, length, name=None):
+    """Ref :1053 — padded [B, T, ...] + lengths → packed [sum(L), ...].
+    Eager (dynamic output shape)."""
+    v = np.asarray(to_array(x))
+    ln = np.asarray(to_array(length)).astype(np.int64)
+    return Tensor(jnp.asarray(
+        np.concatenate([v[b, :int(ln[b])] for b in range(len(ln))], axis=0)))
+
+
+def sequence_reshape(input, lengths, new_dim, name=None):
+    """Ref :1134 — refold the feature dim: [B, T, D] → [B, T*D//new_dim,
+    new_dim], lengths scale by D/new_dim (must divide evenly per row)."""
+
+    def f(x, ln):
+        B, T, D = x.shape
+        assert (T * D) % new_dim == 0
+        return x.reshape(B, T * D // new_dim, new_dim)
+
+    D = int(input.shape[-1])
+    ln_raw = to_array(lengths)
+    if not isinstance(ln_raw, jax.core.Tracer):
+        bad = np.nonzero((np.asarray(ln_raw) * D) % new_dim)[0]
+        assert bad.size == 0, \
+            f"rows {bad.tolist()}: length*{D} not divisible by {new_dim}"
+    new_len = apply_op(lambda ln: (ln * D) // new_dim, lengths)
+    return apply_op(f, input, lengths), new_len
+
+
+def sequence_scatter(input, index, updates, lengths, name=None):
+    """Ref :1203 — per-sequence scatter-add: for each batch row b,
+    input[b, index[b, j]] += updates[b, j] for j < lengths[b]."""
+
+    def f(x, idx, upd, ln):
+        B, T = idx.shape[0], idx.shape[1]
+        m = _tmask(ln, T)
+        safe = jnp.where(m, idx, x.shape[1])  # parked → dropped
+        return x.at[jnp.arange(B)[:, None], safe].add(
+            jnp.where(m.reshape(m.shape + (1,) * (upd.ndim - 2)), upd, 0),
+            mode="drop")
+
+    return apply_op(f, input, index, updates, lengths)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    """Ref :1299 — sliding windows over the time dim: [B, T] int ids →
+    [B, T, win_size] (windows starting at each step, padded with pad_value
+    past each sequence's end; ``lengths=None`` treats all rows as full)."""
+
+    def f(x, *ln):
+        T = x.shape[1]
+        t = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]
+        end = ln[0][:, None, None] if ln else T
+        valid = t[None] < end  # window elements past the row's length pad
+        win = x[:, jnp.clip(t, 0, T - 1)]
+        return jnp.where(valid, win, pad_value)
+
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    return apply_op(f, *args)
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Ref :1432 — reverse the VALID prefix of each sequence, keep padding
+    in place."""
+
+    def f(v, ln):
+        B, T = v.shape[0], v.shape[1]
+        t = jnp.arange(T)[None, :]
+        src = ln[:, None] - 1 - t
+        valid = t < ln[:, None]
+        src = jnp.where(valid, src, t)
+        return jnp.take_along_axis(
+            v, src.reshape((B, T) + (1,) * (v.ndim - 2)), axis=1)
+
+    return apply_op(f, x, lengths)

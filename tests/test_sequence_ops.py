@@ -1,0 +1,146 @@
+"""Sequence op family vs numpy references (ref fluid/layers/sequence_lod.py
++ operators/sequence_ops/ — the dense+lengths TPU formulation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import (sequence_concat, sequence_conv,
+                                  sequence_enumerate, sequence_expand,
+                                  sequence_expand_as, sequence_first_step,
+                                  sequence_last_step, sequence_mask,
+                                  sequence_pad, sequence_pool,
+                                  sequence_reshape, sequence_reverse,
+                                  sequence_scatter, sequence_slice,
+                                  sequence_softmax, sequence_unpad)
+
+RNG = np.random.RandomState(3)
+B, T, D = 3, 5, 4
+X = RNG.randn(B, T, D).astype("float32")
+LEN = np.array([5, 3, 0], dtype="int64")
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+def npv(o):
+    return np.asarray(o.value)
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("ptype", ["sum", "average", "sqrt", "max",
+                                       "first", "last"])
+    def test_pool_matches_numpy(self, ptype):
+        out = npv(sequence_pool(t(X), t(LEN), ptype, pad_value=-1.0))
+        for b in range(B):
+            n = int(LEN[b])
+            if n == 0:
+                np.testing.assert_allclose(out[b], -1.0)
+                continue
+            seg = X[b, :n]
+            want = {"sum": seg.sum(0), "average": seg.mean(0),
+                    "sqrt": seg.sum(0) / np.sqrt(n), "max": seg.max(0),
+                    "first": seg[0], "last": seg[-1]}[ptype]
+            np.testing.assert_allclose(out[b], want, rtol=1e-5, err_msg=ptype)
+
+    def test_first_last_step(self):
+        np.testing.assert_allclose(npv(sequence_first_step(t(X), t(LEN)))[0],
+                                   X[0, 0])
+        np.testing.assert_allclose(npv(sequence_last_step(t(X), t(LEN)))[1],
+                                   X[1, 2])
+
+
+class TestSequenceShape:
+    def test_pad_unpad_roundtrip(self):
+        packed = np.concatenate([X[b, :int(LEN[b])] for b in range(B)], 0)
+        padded, lens = sequence_pad(t(packed), 0.0, t(LEN), maxlen=T)
+        for b in range(B):
+            n = int(LEN[b])
+            np.testing.assert_allclose(npv(padded)[b, :n], X[b, :n])
+            assert (npv(padded)[b, n:] == 0).all()
+        back = npv(sequence_unpad(padded, lens))
+        np.testing.assert_allclose(back, packed)
+
+    def test_reverse(self):
+        out = npv(sequence_reverse(t(X), t(LEN)))
+        np.testing.assert_allclose(out[0], X[0, ::-1])
+        np.testing.assert_allclose(out[1, :3], X[1, :3][::-1])
+        np.testing.assert_allclose(out[1, 3:], X[1, 3:])  # padding kept
+
+    def test_slice(self):
+        off = np.array([1, 0, 0], "int64")
+        lgt = np.array([2, 2, 2], "int64")
+        out, nl = sequence_slice(t(X), t(LEN), t(off), t(lgt))
+        np.testing.assert_allclose(npv(out)[0, :2], X[0, 1:3])
+        np.testing.assert_array_equal(npv(nl), [2, 2, 0])
+
+    def test_reshape(self):
+        out, nl = sequence_reshape(t(X), t(LEN), new_dim=2)
+        assert npv(out).shape == (B, T * D // 2, 2)
+        np.testing.assert_array_equal(npv(nl), LEN * (D // 2))
+
+    def test_concat(self):
+        Y = RNG.randn(B, 2, D).astype("float32")
+        ylen = np.array([2, 1, 2], "int64")
+        out, total = sequence_concat([t(X), t(Y)], [t(LEN), t(ylen)])
+        np.testing.assert_array_equal(npv(total), LEN + ylen)
+        np.testing.assert_allclose(npv(out)[1, :3], X[1, :3])
+        np.testing.assert_allclose(npv(out)[1, 3:4], Y[1, :1])
+
+    def test_expand_and_expand_as(self):
+        v = RNG.randn(B, D).astype("float32")
+        rl = np.array([2, 1, 3], "int64")
+        out = npv(sequence_expand(t(v), None, t(rl)))
+        assert out.shape == (B, 3, D)
+        np.testing.assert_allclose(out[0, :2], np.repeat(v[0:1], 2, 0))
+        assert (out[1, 1:] == 0).all()
+        out2 = npv(sequence_expand_as(t(v), t(X), t(rl)))
+        assert out2.shape == (B, T, D)
+
+
+class TestSequenceCompute:
+    def test_softmax_masks_padding(self):
+        out = npv(sequence_softmax(t(X[..., 0:1]), t(LEN)))
+        np.testing.assert_allclose(out[:, :, 0].sum(1)[:2], [1.0, 1.0],
+                                   rtol=1e-5)
+        assert (out[1, 3:] == 0).all() and (out[2] == 0).all()
+
+    def test_conv_window_projection(self):
+        w = RNG.randn(3 * D, 6).astype("float32")
+        out = npv(sequence_conv(t(X), t(LEN), t(w), context_size=3))
+        assert out.shape == (B, T, 6)
+        # middle timestep of row 0: full context window
+        ctx = np.concatenate([X[0, 1], X[0, 2], X[0, 3]])
+        np.testing.assert_allclose(out[0, 2], ctx @ w, rtol=1e-4)
+        # first timestep: left context zero-padded
+        ctx0 = np.concatenate([np.zeros(D, "float32"), X[0, 0], X[0, 1]])
+        np.testing.assert_allclose(out[0, 0], ctx0 @ w, rtol=1e-4)
+        assert (out[2] == 0).all()  # empty sequence fully masked
+
+    def test_scatter(self):
+        base = np.zeros((B, T), "float32")
+        idx = np.array([[0, 2], [1, 1], [0, 0]], "int64")
+        upd = np.ones((B, 2), "float32")
+        ln = np.array([2, 2, 0], "int64")
+        out = npv(sequence_scatter(t(base), t(idx), t(upd), t(ln)))
+        np.testing.assert_allclose(out[0], [1, 0, 1, 0, 0])
+        np.testing.assert_allclose(out[1], [0, 2, 0, 0, 0])
+        np.testing.assert_allclose(out[2], np.zeros(T))
+
+    def test_enumerate(self):
+        ids = np.array([[1, 2, 3, 4, 5]], "int64")
+        out = npv(sequence_enumerate(t(ids), win_size=2, pad_value=0))
+        np.testing.assert_array_equal(out[0, 0], [1, 2])
+        np.testing.assert_array_equal(out[0, 4], [5, 0])
+
+    def test_mask_reexport(self):
+        m = npv(sequence_mask(t(np.array([2, 0], "int64")), maxlen=3))
+        np.testing.assert_array_equal(m, [[1, 1, 0], [0, 0, 0]])
+
+    def test_pool_grad_flows(self):
+        x = paddle.to_tensor(X, stop_gradient=False)
+        loss = paddle.sum(sequence_pool(x, t(LEN), "average"))
+        loss.backward()
+        g = np.asarray(x.grad.value)
+        np.testing.assert_allclose(g[0], np.full((T, D), 1 / 5), rtol=1e-6)
+        assert (g[1, 3:] == 0).all() and (g[2] == 0).all()
