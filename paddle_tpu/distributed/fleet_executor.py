@@ -19,7 +19,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["TaskNode", "FleetExecutor"]
 
@@ -98,6 +98,55 @@ class FleetExecutor:
             a.add_downstream_task(b.task_id)
             b.add_upstream_task(a.task_id)
         return nodes
+
+    @classmethod
+    def from_program(cls, program, feeds: Sequence[Dict[str, Any]],
+                     fetch_list: Sequence[str], num_segments: int = 2,
+                     buffer_size: int = 1) -> "FleetExecutor":
+        """Build the TaskNode DAG FROM a recorded static Program (ref
+        fleet_executor/task_node.cc TaskNode(program, rank, ...) +
+        dist_model.cc: the program is sliced into contiguous op segments,
+        one TaskNode per segment, chained with credit-based buffers; each
+        run step processes one microbatch flowing through the segment
+        pipeline on the C++ interceptor threads).
+
+        ``feeds``: one feed dict per microbatch. After ``run()``, fetched
+        values per microbatch are in ``.results``."""
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor
+        from ..static.graph import exec_ops, global_scope
+
+        exe = cls()
+        ops = list(program.ops)
+        num_segments = max(1, min(num_segments, len(ops) or 1))
+        bounds = [i * len(ops) // num_segments
+                  for i in range(num_segments + 1)]
+        segments = [ops[bounds[i]:bounds[i + 1]] for i in range(num_segments)]
+        # trained values live in the executor scope; fall back to init values
+        # (same pattern as save_inference_model, static/graph.py)
+        store = global_scope().store
+        params = {name: store.get(name, p.value)
+                  for name, p in program.params.items()}
+        envs = [{k: jnp.asarray(v.value if isinstance(v, Tensor) else v)
+                 for k, v in f.items()} for f in feeds]
+        results: List[Any] = [None] * len(feeds)
+
+        def make_fn(seg, last: bool):
+            def fn(task_id, step):
+                env = envs[step]
+                exec_ops(seg, env, params, program)
+                if last:
+                    results[step] = [env[n] for n in fetch_list]
+
+            return fn
+
+        fns = [make_fn(seg, i == num_segments - 1)
+               for i, seg in enumerate(segments)]
+        exe.task_chain(fns, max_run_times=len(feeds),
+                       buffer_size=buffer_size)
+        exe.results = results
+        return exe
 
     def run(self) -> None:
         lib = _lib()

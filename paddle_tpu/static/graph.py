@@ -399,12 +399,17 @@ def _prune_ops(program: Program, fetch_names: Sequence[str]) -> List[Operator]:
     return kept
 
 
-def _replay(program: Program, param_vals: Dict[str, Any],
-            feed_vals: Dict[str, Any], fetch_names: Sequence[str],
-            ops: Optional[List[Operator]] = None):
-    """Execute the recorded ops as a pure function."""
-    env: Dict[str, Any] = dict(feed_vals)
-    for op in (program.ops if ops is None else ops):
+def exec_ops(ops: List[Operator], env: Dict[str, Any],
+             param_vals: Dict[str, Any], program: "Program",
+             feed_keys: Optional[set] = None) -> None:
+    """Execute a contiguous op segment against a mutable env — the shared
+    inner loop of whole-program replay (_replay) and per-TaskNode segment
+    execution (distributed.fleet_executor.FleetExecutor.from_program).
+    ``feed_keys``: the caller's original feed names, for error messages
+    (env accumulates intermediates, which would mislead)."""
+    if feed_keys is None:
+        feed_keys = set(env)
+    for op in ops:
         ins = []
         for kind, ref in op.in_refs:
             if kind == "var":
@@ -413,7 +418,7 @@ def _replay(program: Program, param_vals: Dict[str, Any],
                     if v is not None and v.is_feed:
                         raise KeyError(
                             f"feed Variable {ref!r} was not fed (feed keys: "
-                            f"{sorted(k for k in feed_vals)}); pass it in "
+                            f"{sorted(feed_keys)}); pass it in "
                             "Executor.run(feed=...)")
                     env[ref] = v._value
                 ins.append(env[ref])
@@ -425,6 +430,15 @@ def _replay(program: Program, param_vals: Dict[str, Any],
         outs = list(out) if op.multi else [out]
         for name, o in zip(op.out_names, outs):
             env[name] = o
+
+
+def _replay(program: Program, param_vals: Dict[str, Any],
+            feed_vals: Dict[str, Any], fetch_names: Sequence[str],
+            ops: Optional[List[Operator]] = None):
+    """Execute the recorded ops as a pure function."""
+    env: Dict[str, Any] = dict(feed_vals)
+    exec_ops(program.ops if ops is None else ops, env, param_vals, program,
+             feed_keys=set(feed_vals))
     return [env[n] for n in fetch_names]
 
 

@@ -271,3 +271,45 @@ def test_abstract_engine_lowering():
     assert txt.count("sdy.sharding") + txt.count("mhlo.sharding") > 0
     compiled = lowered.compile()
     assert compiled is not None
+
+
+def test_rpc_cross_process_two_workers(tmp_path):
+    """Two real OS processes form an RPC world over the TCP transport
+    (ref unittests/test_rpc*.py subprocess pattern): each calls a function
+    ON THE OTHER and checks the result computed in the remote process."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        master_port = s.getsockname()[1]
+    import os as _os
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    code = (
+        "import sys, os\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from paddle_tpu.distributed import rpc\n"
+        "rank = int(sys.argv[1])\n"
+        "def whoami(tag):\n"
+        "    return f'{tag}-from-rank{os.getpid()}'\n"
+        "rpc.init_rpc(f'worker{rank}', rank=rank, world_size=2,\n"
+        "             master_endpoint='127.0.0.1:%d')\n"
+        "peer = f'worker{1 - rank}'\n"
+        "out = rpc.rpc_sync(peer, whoami, args=(f'hello{rank}',))\n"
+        "assert out.startswith(f'hello{rank}-from-rank'), out\n"
+        "assert not out.endswith(str(os.getpid())), 'ran locally, not remote'\n"
+        "fut = rpc.rpc_async(peer, whoami, args=('async',))\n"
+        "assert fut.wait().startswith('async-from-rank')\n"
+        "rpc.shutdown()\n"
+        "print('RPC-OK', rank)\n" % (repo, master_port))
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(r)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True)
+             for r in (0, 1)]
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-800:]
+        assert f"RPC-OK {r}" in out

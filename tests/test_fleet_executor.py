@@ -84,3 +84,38 @@ def test_exception_aborts_and_reraises():
     ex.task_chain([_recorder([], threading.Lock()), boom], max_run_times=5)
     with pytest.raises(RuntimeError, match="stage failed"):
         ex.run()
+
+
+def test_tasknode_dag_from_program():
+    """TaskNode DAG built FROM a recorded Program (ref task_node.cc
+    TaskNode(program,...) + dist_model.cc): op segments pipeline
+    microbatches through interceptor threads and must match whole-program
+    Executor.run per batch."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            h = paddle.matmul(x, paddle.ones([4, 3]))
+            h = paddle.tanh(h + 0.5)
+            out = paddle.sum(h * 2.0, axis=1)
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.randn(2, 4).astype("float32")} for _ in range(4)]
+
+        exe = static.Executor()
+        exe.run(startup)
+        want = [exe.run(main, feed=f, fetch_list=[out])[0] for f in feeds]
+
+        fexe = FleetExecutor.from_program(main, feeds, [out.var_name],
+                                          num_segments=3)
+        assert len(fexe._nodes) == 3, "program was not split into segments"
+        fexe.run()
+        for got, ref in zip(fexe.results, want):
+            np.testing.assert_allclose(np.asarray(got[0]), ref, rtol=1e-5)
+    finally:
+        paddle.disable_static()
