@@ -199,3 +199,4 @@ def get_mesh():
 from .partition import (Cluster, CompletedProgram, Completer, Converter,  # noqa: E402
                         Partitioner, Resharder)
 from .tuner import ClusterDesc, ModelDesc, RuleBasedTuner, TunedStrategy, tune  # noqa: E402
+from .cost_model import CostBreakdown, estimate_step_time, search  # noqa: E402

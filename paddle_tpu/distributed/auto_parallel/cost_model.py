@@ -1,0 +1,138 @@
+"""Analytical cost model for parallel-strategy search.
+
+Ref: python/paddle/distributed/auto_parallel/cost_model.py + cost/ (per-op
+comp/comm cost classes fed from measured latency tables). TPU-native
+redesign: there is no per-op latency table to keep — XLA fuses everything —
+so the model is the roofline the scaling-book recipe reasons with:
+
+- compute: dense transformer step FLOPs (6·N per token fwd+bwd) at an
+  efficiency-derated peak,
+- DP/ZeRO gradient reduction: ring-allreduce bytes over ICI (overlappable
+  with backward: only the non-overlapped fraction is charged),
+- TP: two allreduces of the activation block per layer (Megatron pattern),
+- PP: the fill/drain bubble (pp-1)/micro stretching the step,
+- memory: 16 bytes/param optimizer-state model (bf16 param+grad, fp32
+  master+moments) divided over the sharding axes, plus activation bytes
+  with remat assumed for what doesn't fit.
+
+Numbers are *relative* — good enough to rank candidate meshes, which is all
+the tuner needs (the reference's tables serve the same purpose).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from .tuner import BYTES_PER_PARAM, ClusterDesc, ModelDesc, TunedStrategy
+
+MFU_CEILING = 0.55       # realistic dense-transformer efficiency ceiling
+OVERLAP = 0.7            # fraction of grad reduction hidden under backward
+
+
+@dataclasses.dataclass
+class CostBreakdown:
+    compute_s: float
+    dp_comm_s: float
+    tp_comm_s: float
+    pp_bubble_frac: float    # dimensionless step stretch, NOT seconds
+    feasible: bool
+    mem_bytes: float
+
+    @property
+    def step_s(self) -> float:
+        busy = self.compute_s + self.tp_comm_s + self.dp_comm_s
+        return busy * (1.0 + self.pp_bubble_frac)
+
+
+def _ring_allreduce_bytes(nbytes: float, n: int) -> float:
+    return 2.0 * (n - 1) / max(n, 1) * nbytes
+
+
+def estimate_step_time(model: ModelDesc, cluster: ClusterDesc,
+                       s: TunedStrategy, global_batch: int = 32,
+                       num_micro: Optional[int] = None) -> CostBreakdown:
+    """Predict one training-step time for strategy ``s`` (relative units)."""
+    n = s.total()
+    assert n <= cluster.n_devices, \
+        f"strategy needs {n} devices, cluster has {cluster.n_devices}"
+    tokens = global_batch * model.seq_len
+    tokens_per_chip = tokens / max(s.dp * s.context * s.sharding, 1)
+    # model FLOPs: 6·N per token (fwd+bwd matmuls), split over tp×pp
+    flops_per_chip = 6.0 * model.n_params * tokens_per_chip / (s.tensor * s.pipe)
+    compute_s = flops_per_chip / (cluster.peak_flops * MFU_CEILING)
+
+    # DP/ZeRO grad reduction: each chip owns n_params/(tp·pp) grads in bf16,
+    # reduced over dp·sharding ranks; OVERLAP of it hides under backward
+    red_ranks = s.dp * s.sharding
+    grad_bytes = model.dtype_bytes * model.n_params / (s.tensor * s.pipe)
+    dp_comm_s = 0.0
+    if red_ranks > 1:
+        dp_comm_s = (1 - OVERLAP) * _ring_allreduce_bytes(
+            grad_bytes, red_ranks) / cluster.ici_bw
+
+    # TP: Megatron pattern — 2 allreduces of the activation block per layer
+    tp_comm_s = 0.0
+    if s.tensor > 1:
+        act_bytes = (tokens_per_chip * model.hidden_size * model.dtype_bytes)
+        per_layer = 2.0 * _ring_allreduce_bytes(act_bytes, s.tensor) / cluster.ici_bw
+        tp_comm_s = per_layer * model.num_layers / s.pipe
+
+    # PP bubble stretches the step by (pp-1)/micro (GPipe/1F1B fill+drain)
+    micro = num_micro or max(2 * s.pipe, 1)
+    pp_bubble = (s.pipe - 1) / micro if s.pipe > 1 else 0.0
+
+    # memory feasibility: state bytes over (tensor·sharding·pipe) + remat'd
+    # activation floor (tokens_per_chip already carries the dp/context/
+    # sharding batch split — do not divide again)
+    state = BYTES_PER_PARAM * model.n_params / (s.tensor * s.sharding * s.pipe)
+    act = (tokens_per_chip * model.hidden_size *
+           model.dtype_bytes * model.num_layers / s.pipe / 4)  # remat floor
+    mem = state + act
+    feasible = mem <= 0.9 * cluster.hbm_bytes
+
+    return CostBreakdown(compute_s, dp_comm_s, tp_comm_s, pp_bubble,
+                         feasible, mem)
+
+
+def _factorizations(n: int, axes: int):
+    """All ordered (d0..d_{axes-1}) divisor tuples with prod == n."""
+    if axes == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, axes - 1):
+                yield (d,) + rest
+
+
+def search(model: ModelDesc, cluster: ClusterDesc, global_batch: int = 32,
+           max_candidates: int = 4096) -> Dict:
+    """Cost-model-driven strategy search (the reference tuner's search loop
+    over dist-attr candidates, collapsed to mesh-degree candidates): rank
+    every feasible (dp, sharding, tensor, pipe) divisor factorization of
+    the cluster by predicted step time."""
+    best = None
+    tried = 0
+    for dp, shard, tp, pp in _factorizations(cluster.n_devices, 4):
+        tried += 1
+        if tried > max_candidates:
+            break
+        if tp > 1 and model.num_attention_heads % tp:
+            continue
+        if pp > 1 and model.num_layers % pp:
+            continue
+        if global_batch % max(dp * shard, 1):
+            continue
+        s = TunedStrategy(dp=dp, sharding=shard, tensor=tp, pipe=pp)
+        cost = estimate_step_time(model, cluster, s, global_batch)
+        if not cost.feasible:
+            continue
+        if best is None or cost.step_s < best["cost"].step_s:
+            best = {"strategy": s, "cost": cost}
+    if best is None:  # nothing fits — fall back to the rule-based answer
+        from .tuner import tune
+
+        s = tune(model, cluster)
+        best = {"strategy": s,
+                "cost": estimate_step_time(model, cluster, s, global_batch)}
+    return best

@@ -28,11 +28,18 @@ class ModelDesc:
     dtype_bytes: int = 2             # bf16 params
 
 
+# optimizer-state memory model: bf16 param + bf16 grad + fp32 master +
+# 2×fp32 Adam moments — shared by the rule-based tuner and the cost model
+BYTES_PER_PARAM = 16.0
+
+
 @dataclasses.dataclass
 class ClusterDesc:
     n_devices: int
     hbm_bytes: int = 16 << 30        # v5e default
     devices_per_host: int = 4        # ICI island size for TP preference
+    peak_flops: float = 197e12       # per chip (v5e)
+    ici_bw: float = 1.6e11           # bytes/s per link direction
 
 
 @dataclasses.dataclass
@@ -61,7 +68,7 @@ def tune(model: ModelDesc, cluster: ClusterDesc,
     """
     n = cluster.n_devices
     s = TunedStrategy()
-    bytes_per_param = 16.0
+    bytes_per_param = BYTES_PER_PARAM
     budget = 0.6 * cluster.hbm_bytes  # leave room for activations
 
     # 1) TP: needed when one layer is too fat for a chip, preferred ≤ ICI island

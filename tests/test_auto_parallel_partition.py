@@ -118,3 +118,60 @@ class TestCluster:
         assert c.machine_count() >= 1
         assert len(c.devices) == c.device_count
         assert c.device_kinds()
+
+
+class TestCostModelSearch:
+    """Cost-model-driven strategy search (ref auto_parallel/cost_model.py +
+    tuner search loop): rankings must reflect the roofline structure."""
+
+    def _model(self, n_params=8e9, layers=32, heads=32):
+        from paddle_tpu.distributed.auto_parallel import ModelDesc
+
+        return ModelDesc(n_params=int(n_params), hidden_size=4096,
+                         num_layers=layers, num_attention_heads=heads,
+                         seq_len=4096)
+
+    def test_small_model_prefers_pure_dp(self):
+        from paddle_tpu.distributed.auto_parallel import ClusterDesc, search
+
+        m = self._model(n_params=5e8)
+        best = search(m, ClusterDesc(n_devices=8), global_batch=32)
+        s = best["strategy"]
+        assert s.tensor == 1 and s.pipe == 1, s.degrees()
+        assert s.dp * s.sharding == 8
+
+    def test_large_model_needs_sharding_axes(self):
+        from paddle_tpu.distributed.auto_parallel import ClusterDesc, search
+
+        m = self._model(n_params=70e9, layers=80, heads=64)
+        # v5p-class HBM: 70B state (1.12TB at 16B/param) needs >=13 chips of
+        # coverage; on 16GB v5e-64 it genuinely does NOT fit (1TB total) —
+        # a correct infeasibility the model reports
+        best = search(m, ClusterDesc(n_devices=64, hbm_bytes=95 << 30),
+                      global_batch=64)
+        s = best["strategy"]
+        assert best["cost"].feasible
+        assert s.tensor * s.sharding * s.pipe >= 16, s.degrees()
+
+    def test_infeasible_strategies_are_rejected(self):
+        from paddle_tpu.distributed.auto_parallel import (ClusterDesc,
+                                                          TunedStrategy,
+                                                          estimate_step_time)
+
+        m = self._model(n_params=70e9)
+        replicated = TunedStrategy(dp=8)
+        cost = estimate_step_time(m, ClusterDesc(n_devices=8), replicated)
+        assert not cost.feasible
+
+    def test_pp_bubble_penalizes_step_time(self):
+        from paddle_tpu.distributed.auto_parallel import (ClusterDesc,
+                                                          TunedStrategy,
+                                                          estimate_step_time)
+
+        m = self._model()
+        c = ClusterDesc(n_devices=8, hbm_bytes=95 << 30)  # all configs fit
+        t_dp = estimate_step_time(m, c, TunedStrategy(dp=8), 32)
+        t_pp = estimate_step_time(m, c, TunedStrategy(pipe=8), 32,
+                                  num_micro=8)
+        assert t_pp.pp_bubble_frac > 0 and t_dp.pp_bubble_frac == 0
+        assert t_pp.step_s > t_dp.compute_s
