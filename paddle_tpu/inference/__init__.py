@@ -240,3 +240,6 @@ def load_predictor(path: str) -> Predictor:
 
     names = [f"input_{i}" for i in range(meta["n_inputs"])]
     return Predictor(fn, params, names, [])
+
+
+from .serving import GenerationServer  # noqa: E402,F401

@@ -1,0 +1,222 @@
+"""Continuous-batching generation server — the TPU serving engine.
+
+Ref capability: the reference serves models through AnalysisPredictor /
+DistModel (inference/api/, fleet_executor/dist_model.cc) with request-level
+batching. The TPU-native redesign follows modern LLM serving: a FIXED pool
+of ``max_batch`` slots, each with its own KV-cache rows and position; ONE
+compiled decode step advances every active slot per tick (static shapes —
+compiled exactly once), and finished slots are freed and refilled mid-flight
+so throughput is never quantized by batch boundaries (continuous batching).
+
+Prefill runs per request at bucketed prompt lengths (one compile per
+bucket), producing cache rows that are scattered into the slot. The decode
+step uses the model's vector-position path (`LlamaAttention.decode` with
+``pos [B]``): every slot attends at its own depth.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..jit import functional_call, state_values
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    generated: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class GenerationServer:
+    """Greedy continuous-batching decode server for a ``LlamaForCausalLM``.
+
+    Usage::
+
+        srv = GenerationServer(model, max_batch=4, max_len=256)
+        rid = srv.submit([1, 5, 9], max_new_tokens=16)
+        out = srv.run()          # drain all pending requests
+        tokens = out[rid]        # prompt + generated ids
+    """
+
+    def __init__(self, model, max_batch: int = 4, max_len: int = 256,
+                 prompt_buckets: Sequence[int] = (32, 64, 128),
+                 eos_token_id: Optional[int] = None):
+        cfg = model.cfg
+        assert max_len <= cfg.max_position_embeddings
+        self.model = model
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.buckets = sorted(b for b in prompt_buckets if b <= max_len)
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket fits max_len={max_len} "
+                f"(prompt_buckets={tuple(prompt_buckets)})")
+        self.eos = eos_token_id
+        self.params = state_values(model)
+
+        from ..framework.dtype import convert_dtype
+
+        kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        cdtype = convert_dtype(cfg.dtype)
+        self._caches = [jnp.zeros((max_batch, max_len, kv, d), cdtype)
+                        for _ in range(2 * cfg.num_hidden_layers)]
+        self.pos = jnp.zeros((max_batch,), jnp.int32)
+        self.tokens = jnp.zeros((max_batch,), jnp.int32)
+        self._slots: List[Optional[_Request]] = [None] * max_batch
+        self._queue: deque = deque()
+        self._results: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        # donate the KV pool: XLA updates the caches in place instead of
+        # copying 2·L·(max_batch, max_len, KV, D) every decoded token
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
+        self._prefills: Dict[int, object] = {}  # bucket -> jitted fn
+
+    # ------------------------------------------------------------ compiled fns
+    def _head(self, h):
+        from ..framework.dispatch import apply_op
+
+        if self.cfg.tie_word_embeddings:
+            return apply_op(lambda v, w: jnp.matmul(v, w.T), h,
+                            self.model.model.embed_tokens.weight)
+        return self.model.lm_head(h)
+
+    def _decode_fn(self, params, tokens, flat_caches, pos):
+        """One tick: advance every slot by one token (greedy)."""
+        model = self.model
+        caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
+                  for i in range(self.cfg.num_hidden_layers)]
+
+        def call():
+            h, new = model.model.decode_step(Tensor(tokens[:, None]), caches,
+                                             pos)
+            return self._head(h), new
+
+        logits, new = functional_call(model, params, call_fn=call)
+        flat = []
+        for ck, cv in new:
+            flat += [ck.value, cv.value]
+        nxt = jnp.argmax(logits.value[:, 0], axis=-1).astype(jnp.int32)
+        return nxt, flat
+
+    def _prefill(self, bucket: int):
+        if bucket not in self._prefills:
+            model = self.model
+
+            def fn(params, prompt, true_len):
+                """prompt [1, bucket] right-padded; logits at true_len-1."""
+                kvs = self.cfg.num_key_value_heads
+                d = self.cfg.hidden_size // self.cfg.num_attention_heads
+                from ..framework.dtype import convert_dtype
+
+                cdtype = convert_dtype(self.cfg.dtype)
+                caches = [(Tensor(jnp.zeros((1, self.max_len, kvs, d), cdtype)),
+                           Tensor(jnp.zeros((1, self.max_len, kvs, d), cdtype)))
+                          for _ in range(self.cfg.num_hidden_layers)]
+
+                def call():
+                    h, new = model.model.prefill(Tensor(prompt), caches)
+                    last = jax.lax.dynamic_slice_in_dim(
+                        h.value, true_len - 1, 1, 1)
+                    return self._head(Tensor(last)), new
+
+                logits, new = functional_call(model, params, call_fn=call)
+                flat = []
+                for ck, cv in new:
+                    flat += [ck.value, cv.value]
+                nxt = jnp.argmax(logits.value[:, 0], axis=-1).astype(jnp.int32)
+                return nxt, flat
+
+            self._prefills[bucket] = jax.jit(fn)
+        return self._prefills[bucket]
+
+    # --------------------------------------------------------------- requests
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> int:
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len={self.max_len}")
+        self._bucket_for(len(prompt))  # validate against buckets up front
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, list(prompt), max_new_tokens))
+        return rid
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket "
+                         f"{self.buckets[-1]}")
+
+    def _assign(self, slot: int, req: _Request) -> None:
+        n = len(req.prompt)
+        bucket = self._bucket_for(n)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :n] = req.prompt
+        first, flat = self._prefill(bucket)(self.params, jnp.asarray(prompt),
+                                            n)
+        # scatter this request's cache rows into the slot. Rows beyond the
+        # true prompt length hold right-pad garbage, but decode writes
+        # sequentially from pos=n, overwriting each such row BEFORE the
+        # attention mask (arange <= pos) can reach it — never attended.
+        for i in range(len(self._caches)):
+            self._caches[i] = self._caches[i].at[slot, :self.max_len].set(
+                flat[i][0])
+        self.pos = self.pos.at[slot].set(n)
+        self.tokens = self.tokens.at[slot].set(int(first[0]))
+        req.generated.append(int(first[0]))
+        self._slots[slot] = req
+
+    def _fill_free_slots(self) -> None:
+        for s in range(self.max_batch):
+            if self._slots[s] is None and self._queue:
+                self._assign(s, self._queue.popleft())
+
+    def step(self) -> int:
+        """One decode tick across all occupied slots; returns #active."""
+        self._fill_free_slots()
+        active = [s for s in range(self.max_batch)
+                  if self._slots[s] is not None]
+        if not active:
+            return 0
+        nxt, self._caches = self._decode(self.params, self.tokens,
+                                         self._caches, self.pos)
+        active_mask = np.zeros((self.max_batch,), np.int32)
+        active_mask[active] = 1
+        # only occupied slots advance — idle slots must not drift their
+        # write position (their garbage scatters would eventually go OOB)
+        self.pos = self.pos + jnp.asarray(active_mask)
+        self.tokens = nxt
+        nxt_host = np.asarray(nxt)
+        pos_host = np.asarray(self.pos)
+        for s in active:
+            req = self._slots[s]
+            tok = int(nxt_host[s])
+            finished_last = (self.eos is not None and
+                             req.generated[-1] == self.eos)
+            if not finished_last:
+                req.generated.append(tok)
+            if (finished_last or len(req.generated) >= req.max_new_tokens
+                    or int(pos_host[s]) >= self.max_len - 1):
+                self._results[req.rid] = req.prompt + req.generated[
+                    :req.max_new_tokens]
+                self._slots[s] = None  # freed: refilled next tick
+        return sum(sl is not None for sl in self._slots) + len(self._queue)
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain the queue; returns {rid: prompt+generated token ids}."""
+        while self.step():
+            pass
+        out, self._results = self._results, {}
+        return out

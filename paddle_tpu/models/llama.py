@@ -93,17 +93,29 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
+def _rope_rotate(x, c, s):
+    """Rotate pairs (x[..., :D/2], x[..., D/2:]) by pre-gathered c/s rows."""
+    d2 = x.shape[-1] // 2
+    xf1 = x[..., :d2].astype(jnp.float32)
+    xf2 = x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _apply_rope_rows(x, cos, sin, pos):
+    """x: (B, 1, H, D), pos: int32 [B] — per-row rope rotation (continuous
+    batching: each row sits at its own position)."""
+    c = jnp.take(cos, pos, axis=0)[:, None, None, :]
+    s = jnp.take(sin, pos, axis=0)[:, None, None, :]
+    return _rope_rotate(x, c, s)
+
+
 def _apply_rope(x, cos, sin, pos_offset=0):
     """x: (B, S, H, D); rotate pairs (x[..., :D/2], x[..., D/2:])."""
     S = x.shape[1]
     c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, S, 0)[None, :, None, :]
     s = jax.lax.dynamic_slice_in_dim(sin, pos_offset, S, 0)[None, :, None, :]
-    d2 = x.shape[-1] // 2
-    x1, x2 = x[..., :d2], x[..., d2:]
-    xf1 = x1.astype(jnp.float32)
-    xf2 = x2.astype(jnp.float32)
-    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
-    return out.astype(x.dtype)
+    return _rope_rotate(x, c, s)
 
 
 # --------------------------------------------------------------------------- #
@@ -258,25 +270,40 @@ class LlamaAttention(Layer):
         """Single-token decode with a fixed-size KV cache: write the new
         K/V at ``pos`` via dynamic_update_slice (static shapes, so the whole
         generate loop compiles once) and attend over positions ≤ pos.
-        ck/cv: Tensors (B, L, KV, D); pos: traced int32 scalar."""
+        ck/cv: Tensors (B, L, KV, D); pos: traced int32 scalar, or an int32
+        [B] VECTOR of per-row positions (continuous-batching serving: every
+        slot sits at its own depth — rope rows are gathered and cache writes
+        scattered per row)."""
         B = x.shape[0]
         H, KV, D = self.num_heads, self.num_kv_heads, self.head_dim
         q, k, v = self._qkv(x, B, 1)
 
         def step(qv, kv, vv, ckv, cvv, cosv, sinv):
-            qr = _apply_rope(qv, cosv, sinv, pos)
-            kr = _apply_rope(kv, cosv, sinv, pos)
-            ckv = jax.lax.dynamic_update_slice(ckv, kr.astype(ckv.dtype),
-                                               (0, pos, 0, 0))
-            cvv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
-                                               (0, pos, 0, 0))
+            vector_pos = jnp.ndim(pos) == 1
+            if vector_pos:
+                qr = _apply_rope_rows(qv, cosv, sinv, pos)
+                kr = _apply_rope_rows(kv, cosv, sinv, pos)
+                rows = jnp.arange(B)
+                ckv = ckv.at[rows, pos].set(kr[:, 0].astype(ckv.dtype))
+                cvv = cvv.at[rows, pos].set(vv[:, 0].astype(cvv.dtype))
+            else:
+                qr = _apply_rope(qv, cosv, sinv, pos)
+                kr = _apply_rope(kv, cosv, sinv, pos)
+                ckv = jax.lax.dynamic_update_slice(ckv, kr.astype(ckv.dtype),
+                                                   (0, pos, 0, 0))
+                cvv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                                   (0, pos, 0, 0))
             rep = H // KV
             L = ckv.shape[1]
             # GQA-native: group q heads by kv head — no L-sized cache copies
             qg = qr.reshape(B, 1, KV, rep, D)
             scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ckv).astype(
                 jnp.float32) / math.sqrt(D)
-            mask = (jnp.arange(L) <= pos)[None, None, None, None, :]
+            if vector_pos:
+                mask = (jnp.arange(L)[None, :] <=
+                        pos[:, None])[:, None, None, None, :]
+            else:
+                mask = (jnp.arange(L) <= pos)[None, None, None, None, :]
             scores = jnp.where(mask, scores, -1e30)
             p = jax.nn.softmax(scores, -1).astype(qr.dtype)
             out = jnp.einsum("bgrst,btgd->bsgrd", p, cvv)
