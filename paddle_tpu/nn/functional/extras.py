@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -84,10 +86,7 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
         target_theta = margin1 * theta + margin2
         target_logit = jnp.cos(target_theta) - margin3
         onehot = jax.nn.one_hot(lbl_i, z.shape[-1], dtype=z.dtype)
-        adj = z * (1 - onehot) + target_logit[..., None] * 0  # placeholder
-        tgt = jnp.take_along_axis(target_logit, lbl_i[:, None], 1) \
-            if False else None
-        mod = jnp.where(onehot > 0, jnp.cos(margin1 * theta + margin2) - margin3, z)
+        mod = jnp.where(onehot > 0, target_logit, z)
         logits_s = mod * scale
         logp = jax.nn.log_softmax(logits_s, -1)
         loss = -jnp.take_along_axis(logp, lbl_i[:, None], 1)[:, 0]
@@ -116,7 +115,9 @@ def gather_tree(ids, parents):
             new_beams = jnp.take_along_axis(par[t], beams, axis=-1)
             return new_beams, tok
 
-        init = jnp.broadcast_to(jnp.arange(idv.shape[2]), idv.shape[1:])
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=jnp.int32), idv.shape[1:]
+        ).astype(jnp.int32)  # match take_along_axis output under x64
         _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
         return jnp.flip(toks, 0).astype(jnp.int64)
 
@@ -219,19 +220,140 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
         x, indices)
 
 
-def sparse_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "sparse_attention: use flash attention (dense blockwise beats the "
-        "reference's CUDA block-sparse op on TPU) or ring attention for long "
-        "sequences")
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """CSR-masked attention (ref sparse_attention op: per-row allowed
+    columns in CSR offset/columns form). TPU-native note: the sparsity
+    PATTERN is honored exactly, but compute is dense-masked — on the MXU a
+    dense masked softmax beats the reference's CUDA block-sparse kernels at
+    these sizes, and true long-sequence sparsity is served by ring/flash
+    attention instead. The CSR layout is concretized (eager), matching the
+    reference's host-resident layout tensors.
+
+    q/k/v: (B, H, S, D); offset: (B, H, S+1); columns: (B, H, nnz).
+    """
+    offs = np.asarray(to_array(sparse_csr_offset)).astype(np.int64)
+    cols = np.asarray(to_array(sparse_csr_columns)).astype(np.int64)
+    B, H, S = offs.shape[0], offs.shape[1], offs.shape[2] - 1
+    allow = np.zeros((B, H, S, S), bool)
+    for b in range(B):
+        for h in range(H):
+            for i in range(S):
+                cs = cols[b, h, offs[b, h, i]:offs[b, h, i + 1]]
+                allow[b, h, i, cs] = True
+    mask = jnp.asarray(allow)
+
+    def f(q, k, v, *extra):
+        d = q.shape[-1]
+        sc = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)).astype(q.dtype)
+        sc = sc.astype(jnp.float32)
+        i = 0
+        # ADDITIVE masks (0 = keep, -inf/-1e30 = drop) — the convention the
+        # rest of this package's attention ops use
+        if key_padding_mask is not None:
+            sc = sc + extra[i][:, None, None, :].astype(jnp.float32)
+            i += 1
+        if attn_mask is not None:
+            sc = sc + extra[i].astype(jnp.float32)
+        sc = jnp.where(mask, sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1).astype(q.dtype)
+        p = jnp.where(mask, p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v)
+
+    extra = []
+    if key_padding_mask is not None:
+        extra.append(key_padding_mask)
+    if attn_mask is not None:
+        extra.append(attn_mask)
+    return apply_op(f, query, key, value, *extra)
 
 
-def rnnt_loss(*args, **kwargs):
-    raise NotImplementedError("rnnt_loss: planned (lattice scan)")
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (ref warprnnt-backed rnnt_loss op) as the lattice
+    forward DP, jit-compiled: alpha(t, u) over the (T, U+1) grid with
+    blank transitions advancing t and label transitions advancing u.
+
+    logits: (B, T, U+1, V) unnormalized; labels: (B, U) int; lengths per
+    sample select each lattice's terminal cell. FastEmit regularization is
+    not implemented — a nonzero ``fastemit_lambda`` raises rather than
+    silently training without it.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: fastemit_lambda != 0 is not supported (the FastEmit "
+            "gradient-blending term is not implemented); pass 0.0")
+
+    def f(lg, lb, tl, ul):
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        B, T, U1, _ = lp.shape
+        blank_lp = lp[..., blank]                      # (B, T, U+1)
+        neg_inf = jnp.float32(-1e30)
+        if U1 > 1:
+            lab_lp = jnp.take_along_axis(
+                lp[:, :, :U1 - 1, :], lb[:, None, :, None].astype(jnp.int32),
+                axis=-1)[..., 0]                       # (B, T, U)
+        else:  # U == 0: dummy column so traced indexing stays in bounds
+            lab_lp = jnp.full((B, T, 1), neg_inf)
+
+        def t_step(alpha_prev, t):
+            # horizontal (blank) move from alpha[t-1, u]
+            from_blank = jnp.where(
+                t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
+                jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, neg_inf))
+
+            # vertical (label) moves within column t: sequential over u
+            def u_step(carry, u):
+                prev_u = carry  # alpha[t, u-1]
+                lab = jnp.where(u > 0,
+                                lab_lp[:, t, jnp.maximum(u - 1, 0)], neg_inf)
+                cur = jnp.logaddexp(from_blank[:, u],
+                                    jnp.where(u > 0, prev_u + lab, neg_inf))
+                cur = jnp.where(u == 0, from_blank[:, 0], cur)
+                return cur, cur
+
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), neg_inf),
+                                   jnp.arange(U1))
+            alpha_t = jnp.transpose(cols)              # (B, U+1)
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(t_step, jnp.full((B, U1), neg_inf),
+                                 jnp.arange(T))        # (T, B, U+1)
+        alphas = jnp.transpose(alphas, (1, 0, 2))      # (B, T, U+1)
+        tl_i = tl.astype(jnp.int32) - 1
+        ul_i = ul.astype(jnp.int32)
+        bi = jnp.arange(B)
+        final = alphas[bi, tl_i, ul_i] + blank_lp[bi, tl_i, ul_i]
+        loss = -final
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op(f, logits, labels, logit_lengths, label_lengths)
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-style API, out of scope")
+    """Ref class_center_sample op (margin-softmax training): sample
+    ``num_samples`` class centers containing every positive class; return
+    (remapped labels into the sampled set, sampled class indices). The
+    reference unions positives across the model-parallel group; here the
+    single-process form (the TP path shards the classifier via GSPMD, which
+    needs no explicit sampling)."""
+    lbl = np.asarray(to_array(label)).astype(np.int64).reshape(-1)
+    pos = np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.permutation(rest)[:num_samples - len(pos)]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lbl])),
+            Tensor(jnp.asarray(sampled)))
 
 
 # in-place activation aliases

@@ -76,7 +76,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                name=None):
     out = _pool(x, kernel_size, stride, padding, 1, "NCL", "max", None, ceil_mode)
     if return_mask:
-        return out, None
+        return out, _max_pool_indices_nd(x, kernel_size, stride, padding, 1,
+                                         ceil_mode=ceil_mode)
     return out
 
 
@@ -84,7 +85,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", None, ceil_mode)
     if return_mask:
-        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        idx = _max_pool_indices_nd(x, kernel_size, stride, padding, 2,
+                                   ceil_mode=ceil_mode,
+                                   channel_last=data_format == "NHWC")
         return out, idx
     return out
 
@@ -93,40 +96,74 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", None, ceil_mode)
     if return_mask:
-        return out, None
+        return out, _max_pool_indices_nd(x, kernel_size, stride, padding, 3,
+                                         ceil_mode=ceil_mode,
+                                         channel_last=data_format == "NDHWC")
     return out
 
 
-def _max_pool_indices(x, ksize, stride, padding, data_format):
-    from .common import unfold as _unfold
+def _max_pool_indices_nd(x, ksize, stride, padding, nd, ceil_mode=False,
+                         channel_last=False):
+    """Flattened-spatial argmax indices of an nd max-pool (paddle
+    return_mask format, consumed by max_unpool{1,2,3}d). Positions stay
+    int32 end to end (no float roundtrip — exact for any volume size)."""
+    import itertools
+    import math as _math
 
-    # indices over flattened spatial dims, paddle-style; eager helper
-    k = _tup(ksize, 2)
-    s = _tup(stride, 2) if stride is not None else k
+    k = _tup(ksize, nd)
+    s = _tup(stride, nd) if stride is not None else k
 
     def f(v):
-        n, c, h, w = v.shape
-        cols = []
-        idxs = []
-        p = _pads(padding, 2)
-        vp = jnp.pad(v, [(0, 0), (0, 0), p[0], p[1]],
+        if channel_last:  # normalize to channel-first; positions are over
+            v = jnp.moveaxis(v, -1, 1)  # the spatial dims either way
+        lead = v.shape[:2]
+        spatial = v.shape[2:]
+        p = _pads(padding, nd)
+        if isinstance(p, str):  # 'SAME'/'VALID' → explicit amounts
+            if p == "VALID":
+                p = [(0, 0)] * nd
+            else:
+                p = []
+                for i in range(nd):
+                    out_i = _math.ceil(spatial[i] / s[i])
+                    total = max((out_i - 1) * s[i] + k[i] - spatial[i], 0)
+                    p.append((total // 2, total - total // 2))
+        vp = jnp.pad(v, [(0, 0), (0, 0)] + list(p),
                      constant_values=-jnp.inf)
-        pos = jnp.arange(h * w).reshape(1, 1, h, w).astype(jnp.float32)
-        posp = jnp.pad(pos, [(0, 0), (0, 0), p[0], p[1]], constant_values=-1)
-        oh = (vp.shape[2] - k[0]) // s[0] + 1
-        ow = (vp.shape[3] - k[1]) // s[1] + 1
+        size = 1
+        for d in spatial:
+            size *= d
+        pos = jnp.arange(size, dtype=jnp.int32).reshape((1, 1) + spatial)
+        posp = jnp.pad(pos, [(0, 0), (0, 0)] + list(p), constant_values=-1)
+        if ceil_mode:  # extend so the last partial window is a full slot
+            extra = []
+            for i in range(nd):
+                out_i = _math.ceil((vp.shape[2 + i] - k[i]) / s[i]) + 1
+                need = (out_i - 1) * s[i] + k[i]
+                extra.append((0, max(0, need - vp.shape[2 + i])))
+            vp = jnp.pad(vp, [(0, 0), (0, 0)] + extra,
+                         constant_values=-jnp.inf)
+            posp = jnp.pad(posp, [(0, 0), (0, 0)] + extra,
+                           constant_values=-1)
+        outd = [(vp.shape[2 + i] - k[i]) // s[i] + 1 for i in range(nd)]
         patches, ppos = [], []
-        for i in range(k[0]):
-            for j in range(k[1]):
-                patches.append(vp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]])
-                ppos.append(jnp.broadcast_to(
-                    posp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]], (n, c, oh, ow)))
+        for offs in itertools.product(*[range(k[i]) for i in range(nd)]):
+            sl = (slice(None), slice(None)) + tuple(
+                slice(offs[i], offs[i] + outd[i] * s[i], s[i])
+                for i in range(nd))
+            patches.append(vp[sl])
+            ppos.append(jnp.broadcast_to(posp[sl], lead + tuple(outd)))
         stacked = jnp.stack(patches, 0)
         spos = jnp.stack(ppos, 0)
         am = jnp.argmax(stacked, axis=0)
-        return jnp.take_along_axis(spos, am[None], axis=0)[0].astype(jnp.int32)
+        idx = jnp.take_along_axis(spos, am[None], axis=0)[0]
+        if channel_last:
+            idx = jnp.moveaxis(idx, 1, -1)
+        return idx.astype(jnp.int32)
 
     return apply_op(f, x)
+
+
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,

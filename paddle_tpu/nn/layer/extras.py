@@ -101,8 +101,9 @@ class HSigmoidLoss(Layer):
                 i += 1
                 code = rest[i]
             else:
-                tbl = jnp.take(self._table, lbl.astype(jnp.int32), axis=0)
-                code = jnp.take(self._codes, lbl.astype(jnp.int32), axis=0)
+                flat_lbl = lbl.astype(jnp.int32).reshape(lbl.shape[0])
+                tbl = jnp.take(self._table, flat_lbl, axis=0)
+                code = jnp.take(self._codes, flat_lbl, axis=0)
             valid = (tbl >= 0).astype(jnp.float32)
             tbl_c = jnp.clip(tbl, 0, None)
             w_path = jnp.take(w, tbl_c, axis=0)  # (B, D, feat)
@@ -123,11 +124,24 @@ class HSigmoidLoss(Layer):
 
 
 class RNNTLoss(Layer):
-    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+    """Transducer loss layer over functional.rnnt_loss (lattice forward DP)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        # fastemit default 0.0 (the reference defaults to 0.001 but our
+        # rnnt_loss rejects nonzero lambda instead of silently ignoring it)
         super().__init__()
-        raise NotImplementedError(
-            "RNNTLoss: transducer lattice loss planned (lax.scan over the "
-            "(T,U) grid); use CTCLoss for CTC-style training meanwhile")
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        from ..functional.extras import rnnt_loss
+
+        return rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
 
 
 class MaxUnPool1D(Layer):

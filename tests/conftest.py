@@ -29,6 +29,7 @@ _SLOW_FILES = {
     "test_distributed_extras.py", "test_extensions.py",
     "test_auto_parallel_partition.py", "test_fleet_executor.py",
     "test_serving.py", "test_op_sweep_extended.py", "test_sequence_ops.py",
+    "test_functional_sweep.py",
 }
 
 
