@@ -234,14 +234,7 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     """
     offs = np.asarray(to_array(sparse_csr_offset)).astype(np.int64)
     cols = np.asarray(to_array(sparse_csr_columns)).astype(np.int64)
-    B, H, S = offs.shape[0], offs.shape[1], offs.shape[2] - 1
-    allow = np.zeros((B, H, S, S), bool)
-    for b in range(B):
-        for h in range(H):
-            for i in range(S):
-                cs = cols[b, h, offs[b, h, i]:offs[b, h, i + 1]]
-                allow[b, h, i, cs] = True
-    mask = jnp.asarray(allow)
+    mask = _csr_allow_mask(offs, cols)
 
     def f(q, k, v, *extra):
         d = q.shape[-1]
@@ -267,6 +260,33 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     if attn_mask is not None:
         extra.append(attn_mask)
     return apply_op(f, query, key, value, *extra)
+
+
+_CSR_MASK_CACHE: dict = {}
+
+
+def _csr_allow_mask(offs, cols):
+    """Dense (B,H,S,S) allow-mask from a CSR layout — one vectorized
+    assignment per (b,h), cached on the layout bytes (training reuses the
+    same sparsity pattern every step)."""
+    key = (offs.tobytes(), cols.tobytes())
+    hit = _CSR_MASK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    B, H, S = offs.shape[0], offs.shape[1], offs.shape[2] - 1
+    allow = np.zeros((B * H, S, S), bool)
+    offs2 = offs.reshape(B * H, S + 1)
+    cols2 = cols.reshape(B * H, -1)
+    for i in range(B * H):
+        counts = np.diff(offs2[i])
+        rows = np.repeat(np.arange(S), counts)
+        cs = cols2[i, offs2[i, 0]:offs2[i, -1]]
+        allow[i, rows, cs] = True
+    mask = jnp.asarray(allow.reshape(B, H, S, S))
+    if len(_CSR_MASK_CACHE) > 8:  # bound the cache
+        _CSR_MASK_CACHE.clear()
+    _CSR_MASK_CACHE[key] = mask
+    return mask
 
 
 def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
@@ -296,35 +316,40 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
                 axis=-1)[..., 0]                       # (B, T, U)
         else:  # U == 0: dummy column so traced indexing stays in bounds
             lab_lp = jnp.full((B, T, 1), neg_inf)
+        lab_lp = jnp.concatenate(
+            [jnp.full((B, T, 1), neg_inf), lab_lp], axis=2)  # u-1 gather pad
 
-        def t_step(alpha_prev, t):
-            # horizontal (blank) move from alpha[t-1, u]
-            from_blank = jnp.where(
-                t > 0, alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
-                jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, neg_inf))
+        # anti-diagonal wavefront: diagonal d holds cells (t = d-u, u) —
+        # T+U sequential steps instead of T·U (each diagonal vectorized
+        # over u), the standard transducer lattice schedule
+        u_ar = jnp.arange(U1)
 
-            # vertical (label) moves within column t: sequential over u
-            def u_step(carry, u):
-                prev_u = carry  # alpha[t, u-1]
-                lab = jnp.where(u > 0,
-                                lab_lp[:, t, jnp.maximum(u - 1, 0)], neg_inf)
-                cur = jnp.logaddexp(from_blank[:, u],
-                                    jnp.where(u > 0, prev_u + lab, neg_inf))
-                cur = jnp.where(u == 0, from_blank[:, 0], cur)
-                return cur, cur
+        def diag_step(alpha_prev, d):
+            tvec = d - u_ar                             # (U1,) t per cell
+            on = (tvec >= 0) & (tvec < T)
+            tc = jnp.clip(tvec, 0, T - 1)
+            # blank move from (t-1, u): previous diagonal, same u
+            b_lp = blank_lp[:, jnp.clip(tvec - 1, 0, T - 1), u_ar]  # (B, U1)
+            from_blank = jnp.where((tvec > 0)[None, :],
+                                   alpha_prev + b_lp, neg_inf)
+            # label move from (t, u-1): previous diagonal, u-1
+            l_lp = lab_lp[:, tc, u_ar]                  # lab_lp[t, u-1] (B,U1)
+            alpha_um1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha_prev[:, :-1]], axis=1)
+            from_label = jnp.where((u_ar > 0)[None, :],
+                                   alpha_um1 + l_lp, neg_inf)
+            cur = jnp.logaddexp(from_blank, from_label)
+            cur = jnp.where((d == 0) & (u_ar == 0)[None, :], 0.0, cur)
+            cur = jnp.where(on[None, :], cur, neg_inf)
+            return cur, cur
 
-            _, cols = jax.lax.scan(u_step, jnp.full((B,), neg_inf),
-                                   jnp.arange(U1))
-            alpha_t = jnp.transpose(cols)              # (B, U+1)
-            return alpha_t, alpha_t
-
-        _, alphas = jax.lax.scan(t_step, jnp.full((B, U1), neg_inf),
-                                 jnp.arange(T))        # (T, B, U+1)
-        alphas = jnp.transpose(alphas, (1, 0, 2))      # (B, T, U+1)
+        _, diags = jax.lax.scan(diag_step, jnp.full((B, U1), neg_inf),
+                                jnp.arange(T + U1 - 1))  # (T+U1-1, B, U1)
         tl_i = tl.astype(jnp.int32) - 1
         ul_i = ul.astype(jnp.int32)
         bi = jnp.arange(B)
-        final = alphas[bi, tl_i, ul_i] + blank_lp[bi, tl_i, ul_i]
+        final_alpha = diags[tl_i + ul_i, bi, ul_i]
+        final = final_alpha + blank_lp[bi, tl_i, ul_i]
         loss = -final
         if reduction == "mean":
             return jnp.mean(loss)

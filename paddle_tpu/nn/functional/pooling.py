@@ -119,7 +119,8 @@ def _max_pool_indices_nd(x, ksize, stride, padding, nd, ceil_mode=False,
         lead = v.shape[:2]
         spatial = v.shape[2:]
         p = _pads(padding, nd)
-        if isinstance(p, str):  # 'SAME'/'VALID' → explicit amounts
+        string_pad = isinstance(p, str)
+        if string_pad:  # 'SAME'/'VALID' → explicit amounts
             if p == "VALID":
                 p = [(0, 0)] * nd
             else:
@@ -135,7 +136,10 @@ def _max_pool_indices_nd(x, ksize, stride, padding, nd, ceil_mode=False,
             size *= d
         pos = jnp.arange(size, dtype=jnp.int32).reshape((1, 1) + spatial)
         posp = jnp.pad(pos, [(0, 0), (0, 0)] + list(p), constant_values=-1)
-        if ceil_mode:  # extend so the last partial window is a full slot
+        # _pool skips its ceil extension for string padding (SAME already
+        # ceils; VALID+ceil is rejected by the reference) — mirror that so
+        # out and idx always have the SAME spatial shape
+        if ceil_mode and not string_pad:
             extra = []
             for i in range(nd):
                 out_i = _math.ceil((vp.shape[2 + i] - k[i]) / s[i]) + 1

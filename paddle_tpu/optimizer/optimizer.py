@@ -20,6 +20,37 @@ from ..framework.dtype import convert_dtype
 from .lr import LRScheduler
 
 
+def _pure_grad_clip(clip, grads):
+    """Traceable counterpart of ClipGradBy*'s eager _dygraph_clip, applied
+    inside compiled train steps (pure_update): same math, no host
+    concretization. Unknown custom clip classes are skipped with a warning
+    (their eager hook cannot run under jit)."""
+    from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                           ClipGradByValue)
+
+    if isinstance(clip, ClipGradByValue):
+        return {n: jnp.clip(g, clip.min, clip.max) for n, g in grads.items()}
+    if isinstance(clip, ClipGradByGlobalNorm):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        scale = jnp.minimum(
+            clip.clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12), 1.0)
+        return {n: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for n, g in grads.items()}
+    if isinstance(clip, ClipGradByNorm):
+        out = {}
+        for n, g in grads.items():
+            nrm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            sc = jnp.minimum(clip.clip_norm / jnp.maximum(nrm, 1e-12), 1.0)
+            out[n] = (g.astype(jnp.float32) * sc).astype(g.dtype)
+        return out
+    import warnings
+
+    warnings.warn(f"grad_clip {type(clip).__name__} has no traceable form; "
+                  f"compiled train step proceeds UNCLIPPED", UserWarning)
+    return grads
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -174,6 +205,8 @@ class Optimizer:
         ``regularizers``: name → per-param regularizer callable (the ParamAttr
         override the eager step() reads from p.regularizer)."""
         regularizers = regularizers or {}
+        if self._grad_clip is not None:
+            grads = _pure_grad_clip(self._grad_clip, grads)
         new_params, new_state = {}, {}
         for name, p in params.items():
             g = grads.get(name)
@@ -331,6 +364,8 @@ class AdamW(Adam):
         # AdamW decay is decoupled; a per-param ParamAttr regularizer still
         # adds its gradient (same as the eager step() path)
         regularizers = regularizers or {}
+        if self._grad_clip is not None:
+            grads = _pure_grad_clip(self._grad_clip, grads)
         new_params, new_state = {}, {}
         for name, p in params.items():
             g = grads.get(name)

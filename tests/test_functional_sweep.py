@@ -522,12 +522,30 @@ def test_newly_implemented_ops():
                            t(np.array([1], "int64")),
                            t(np.array([0], "int64"))))
     np.testing.assert_allclose(loss, -lp[0, 0, 0, 0], rtol=1e-4)
-    # bigger lattice: finite and permutation-sensitive
+    # bigger lattice with per-sample lengths vs a brute-force log-semiring DP
+    def _brute(lg1, lb1, T_, U_):
+        lp = lg1 - np.log(np.exp(lg1).sum(-1, keepdims=True))
+        alpha = np.full((T_, U_ + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t_ in range(T_):
+            for u_ in range(U_ + 1):
+                if t_ == 0 and u_ == 0:
+                    continue
+                c = []
+                if t_ > 0:
+                    c.append(alpha[t_ - 1, u_] + lp[t_ - 1, u_, 0])
+                if u_ > 0:
+                    c.append(alpha[t_, u_ - 1] + lp[t_, u_ - 1, lb1[u_ - 1]])
+                alpha[t_, u_] = np.logaddexp.reduce(c)
+        return -(alpha[T_ - 1, U_] + lp[T_ - 1, U_, 0])
+
     lg = RNG.randn(2, 5, 3, 4).astype("float32")
     lb = RNG.randint(1, 4, (2, 2)).astype("int32")
-    l1 = npv(F.rnnt_loss(t(lg), t(lb), t(np.array([5, 4], "int64")),
-                         t(np.array([2, 2], "int64"))))
-    assert np.isfinite(l1).all() and float(l1) > 0
+    tl = np.array([5, 4], "int64")
+    ul = np.array([2, 1], "int64")
+    got = npv(F.rnnt_loss(t(lg), t(lb), t(tl), t(ul), reduction="none"))
+    want = [_brute(lg[b], lb[b], int(tl[b]), int(ul[b])) for b in range(2)]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
 
     # class_center_sample: all positives present, remap consistent
     lab = np.array([3, 9, 3, 7], "int64")

@@ -209,3 +209,73 @@ def test_per_param_regularizer_applied():
     # grad wrt zero input is 0, so the only update comes from the L2 term
     np.testing.assert_allclose(np.asarray(lin.weight.value),
                                w0 - 0.1 * 0.5 * w0, rtol=1e-5)
+
+
+class TestCompiledGradClip:
+    """grad_clip must apply inside the COMPILED train step (pure_update) —
+    the eager step() already clips; silently dropping it under jit would
+    train the recipe unclipped (ref ClipGradByGlobalNorm semantics)."""
+
+    def test_engine_matches_eager_with_global_norm_clip(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+        from paddle_tpu.optimizer import SGD
+        from paddle_tpu.parallel import ParallelEngine
+
+        def build():
+            paddle.seed(11)
+            m = nn.Linear(4, 4)
+            opt = SGD(learning_rate=0.5, parameters=m.parameters(),
+                      grad_clip=ClipGradByGlobalNorm(0.1))
+            return m, opt
+
+        x = paddle.to_tensor(np.full((2, 4), 5.0, "float32"))
+        y = paddle.to_tensor(np.full((2, 4), -5.0, "float32"))
+
+        m1, o1 = build()  # eager: clip applied in step()
+        loss = paddle.mean((m1(x) - y) ** 2)
+        loss.backward()
+        o1.step()
+
+        m2, o2 = build()  # compiled engine path
+        eng = ParallelEngine(m2, optimizer=o2,
+                             loss_fn=lambda out, lbl: paddle.mean(
+                                 (out - lbl) ** 2),
+                             mesh=Mesh(np.array(jax.devices()[:1]).reshape(1),
+                                       ("data",)))
+        eng.train_batch(x, y)
+        eng.sync_to_model()
+        for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                      m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1.value),
+                                       np.asarray(p2.value),
+                                       rtol=1e-5, atol=1e-6, err_msg=n1)
+
+    def test_unclipped_differs(self):
+        """Sanity: with these huge grads, clipping must actually change the
+        update (guards against the clip being a no-op in both paths)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+        from paddle_tpu.optimizer import SGD
+
+        x = paddle.to_tensor(np.full((2, 4), 5.0, "float32"))
+        y = paddle.to_tensor(np.full((2, 4), -5.0, "float32"))
+        outs = []
+        for clip in (None, ClipGradByGlobalNorm(0.1)):
+            paddle.seed(11)
+            m = nn.Linear(4, 4)
+            opt = SGD(learning_rate=0.5, parameters=m.parameters(),
+                      grad_clip=clip)
+            loss = paddle.mean((m(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            outs.append(np.asarray(m.weight.value))
+        assert not np.allclose(outs[0], outs[1])
