@@ -7,8 +7,9 @@ reshard.py) — propagation/partition/reshard all happen inside XLA.
 from .api import (current_mesh, mesh_context, shard_constraint, shard_tensor, psum,
                   all_gather_axis, axis_index, axis_size)
 from .engine import ParallelEngine, parallelize, make_train_step
-from .pipeline_engine import PipelineEngine, llama_pipeline_engine
+from .pipeline_engine import (PipelineEngine, gpt_pipeline_engine,
+                              llama_pipeline_engine)
 
 __all__ = ["current_mesh", "mesh_context", "shard_constraint", "shard_tensor", "psum",
            "all_gather_axis", "axis_index", "axis_size", "ParallelEngine", "parallelize",
-           "make_train_step", "PipelineEngine", "llama_pipeline_engine"]
+           "make_train_step", "PipelineEngine", "llama_pipeline_engine", "gpt_pipeline_engine"]

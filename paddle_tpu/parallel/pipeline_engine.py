@@ -393,3 +393,47 @@ def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
                           optimizer=optimizer, mesh=mesh, num_micro=num_micro,
                           remat=remat, abstract=abstract, fsdp=fsdp,
                           num_chunks=num_chunks)
+
+
+def gpt_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
+                        remat: bool = True, abstract: bool = False,
+                        fsdp: bool = False, num_chunks: int = 1
+                        ) -> PipelineEngine:
+    """Wire a ``GPTForCausalLM`` into the pipeline engine (second model
+    family through the same generic pre/block/post decomposition): token+pos
+    embedding before the pipe region, GPT blocks inside, final LayerNorm +
+    tied-embedding head + CE after (tied wte grads sum across both uses
+    automatically)."""
+    import paddle_tpu.nn.functional as F
+
+    core = model.transformer
+    layers = list(core.h)
+    template = layers[0]
+    assert model.cfg.hidden_dropout_prob == 0.0, \
+        "gpt_pipeline_engine: embedding dropout lives outside the pipe " \
+        "region and is not replicated here — train with " \
+        "hidden_dropout_prob=0 (the usual large-model setting)"
+
+    def pre_fn(params, input_ids):
+        wte = params["transformer.wte.weight"]
+        wpe = params["transformer.wpe.weight"]
+        S = input_ids.shape[1]
+        return jnp.take(wte, input_ids, axis=0) + wpe[None, :S]
+
+    def block_fn(blk, x):
+        out = functional_call(template, blk, Tensor(x))
+        return out.value if isinstance(out, Tensor) else out
+
+    def post_fn(params, h, labels):
+        out = functional_call(
+            core.ln_f, {"weight": params["transformer.ln_f.weight"],
+                        "bias": params["transformer.ln_f.bias"]}, Tensor(h))
+        hn = out.value if isinstance(out, Tensor) else out
+        logits = hn @ params["transformer.wte.weight"].T
+        return F.cross_entropy(Tensor(logits), Tensor(labels),
+                               reduction="mean")
+
+    return PipelineEngine(model, layers, "transformer.h", pre_fn, block_fn,
+                          post_fn, optimizer=optimizer, mesh=mesh,
+                          num_micro=num_micro, remat=remat, abstract=abstract,
+                          fsdp=fsdp, num_chunks=num_chunks)

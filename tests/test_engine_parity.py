@@ -201,3 +201,42 @@ def test_interleaved_pipeline_engine_matches_single_device():
     for k in ref_weights:
         np.testing.assert_allclose(pp_weights[k], ref_weights[k], rtol=2e-3,
                                    atol=5e-5, err_msg=k)
+
+
+def test_gpt_pipeline_engine_matches_single_device():
+    """The GENERIC pipeline engine also carries the GPT family (tied
+    embeddings, LayerNorm blocks): weight parity vs the single-device run."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.parallel import gpt_pipeline_engine
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=4, num_attention_heads=4,
+                    max_position_embeddings=32, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    paddle.seed(13)
+    ref_model = GPTForCausalLM(cfg)
+    init_state = {k: np.array(np.asarray(v.value))
+                  for k, v in ref_model.state_dict().items()}
+    batches = _batches(cfg, n=2)
+
+    single_mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+    ref_losses, ref_weights = _train(ref_model, single_mesh, batches)
+
+    paddle.seed(13)
+    pp_model = GPTForCausalLM(cfg)
+    pp_model.set_state_dict({k: paddle.to_tensor(v)
+                             for k, v in init_state.items()})
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+    opt = AdamW(learning_rate=1e-2, parameters=pp_model.parameters())
+    eng = gpt_pipeline_engine(pp_model, optimizer=opt, mesh=mesh, num_micro=2)
+    pp_losses = [float(np.asarray(eng.train_batch(
+        paddle.to_tensor(x), paddle.to_tensor(y)).value))
+        for x, y in batches]
+    eng.sync_to_model()
+    pp_weights = {k: np.asarray(v.value)
+                  for k, v in pp_model.state_dict().items()}
+
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=1e-4, atol=1e-5)
+    for k in ref_weights:
+        np.testing.assert_allclose(pp_weights[k], ref_weights[k], rtol=2e-3,
+                                   atol=5e-5, err_msg=k)
