@@ -32,6 +32,7 @@ class _Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int
+    temperature: float = 0.0
     generated: List[int] = field(default_factory=list)
     done: bool = False
 
@@ -73,6 +74,9 @@ class GenerationServer:
                         for _ in range(2 * cfg.num_hidden_layers)]
         self.pos = jnp.zeros((max_batch,), jnp.int32)
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
+        self.temps = jnp.zeros((max_batch,), jnp.float32)
+        self._step_no = 0
+        self._base_key = jax.random.PRNGKey(0)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._queue: deque = deque()
         self._results: Dict[int, List[int]] = {}
@@ -91,8 +95,10 @@ class GenerationServer:
                             self.model.model.embed_tokens.weight)
         return self.model.lm_head(h)
 
-    def _decode_fn(self, params, tokens, flat_caches, pos):
-        """One tick: advance every slot by one token (greedy)."""
+    def _decode_fn(self, params, tokens, flat_caches, pos, temps, key):
+        """One tick: advance every slot by one token. Per-slot temperature:
+        temp == 0 → greedy argmax; temp > 0 → categorical sample at that
+        temperature (each slot draws from its own key)."""
         model = self.model
         caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
                   for i in range(self.cfg.num_hidden_layers)]
@@ -106,8 +112,14 @@ class GenerationServer:
         flat = []
         for ck, cv in new:
             flat += [ck.value, cv.value]
-        nxt = jnp.argmax(logits.value[:, 0], axis=-1).astype(jnp.int32)
-        return nxt, flat
+        lg = logits.value[:, 0].astype(jnp.float32)       # (B, V)
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        keys = jax.random.split(key, lg.shape[0])
+        sampled = jax.vmap(
+            lambda k, row, tmp: jax.random.categorical(
+                k, row / jnp.maximum(tmp, 1e-6)))(keys, lg, temps
+                                                  ).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy), flat
 
     def _prefill(self, bucket: int):
         if bucket not in self._prefills:
@@ -141,7 +153,8 @@ class GenerationServer:
         return self._prefills[bucket]
 
     # --------------------------------------------------------------- requests
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> int:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0) -> int:
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
@@ -149,7 +162,8 @@ class GenerationServer:
         self._bucket_for(len(prompt))  # validate against buckets up front
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, list(prompt), max_new_tokens))
+        self._queue.append(_Request(rid, list(prompt), max_new_tokens,
+                                    temperature=float(temperature)))
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -175,6 +189,7 @@ class GenerationServer:
                 flat[i][0])
         self.pos = self.pos.at[slot].set(n)
         self.tokens = self.tokens.at[slot].set(int(first[0]))
+        self.temps = self.temps.at[slot].set(req.temperature)
         req.generated.append(int(first[0]))
         self._slots[slot] = req
 
@@ -190,8 +205,11 @@ class GenerationServer:
                   if self._slots[s] is not None]
         if not active:
             return 0
+        self._step_no += 1
+        key = jax.random.fold_in(self._base_key, self._step_no)
         nxt, self._caches = self._decode(self.params, self.tokens,
-                                         self._caches, self.pos)
+                                         self._caches, self.pos, self.temps,
+                                         key)
         active_mask = np.zeros((self.max_batch,), np.int32)
         active_mask[active] = 1
         # only occupied slots advance — idle slots must not drift their
