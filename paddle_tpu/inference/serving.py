@@ -38,7 +38,9 @@ class _Request:
 
 
 class GenerationServer:
-    """Greedy continuous-batching decode server for a ``LlamaForCausalLM``.
+    """Continuous-batching decode server for a ``LlamaForCausalLM`` —
+    greedy by default, per-request temperature sampling via
+    ``submit(..., temperature=...)``.
 
     Usage::
 
@@ -50,7 +52,7 @@ class GenerationServer:
 
     def __init__(self, model, max_batch: int = 4, max_len: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128),
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, seed: int = 0):
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         self.model = model
@@ -76,7 +78,7 @@ class GenerationServer:
         self.tokens = jnp.zeros((max_batch,), jnp.int32)
         self.temps = jnp.zeros((max_batch,), jnp.float32)
         self._step_no = 0
-        self._base_key = jax.random.PRNGKey(0)
+        self._base_key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._queue: deque = deque()
         self._results: Dict[int, List[int]] = {}
@@ -114,11 +116,9 @@ class GenerationServer:
             flat += [ck.value, cv.value]
         lg = logits.value[:, 0].astype(jnp.float32)       # (B, V)
         greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        keys = jax.random.split(key, lg.shape[0])
-        sampled = jax.vmap(
-            lambda k, row, tmp: jax.random.categorical(
-                k, row / jnp.maximum(tmp, 1e-6)))(keys, lg, temps
-                                                  ).astype(jnp.int32)
+        # categorical draws independent samples per row with one key
+        sampled = jax.random.categorical(
+            key, lg / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
         return jnp.where(temps > 0, sampled, greedy), flat
 
     def _prefill(self, bucket: int):
@@ -146,8 +146,7 @@ class GenerationServer:
                 flat = []
                 for ck, cv in new:
                     flat += [ck.value, cv.value]
-                nxt = jnp.argmax(logits.value[:, 0], axis=-1).astype(jnp.int32)
-                return nxt, flat
+                return logits.value[:, 0].astype(jnp.float32), flat
 
             self._prefills[bucket] = jax.jit(fn)
         return self._prefills[bucket]
@@ -159,6 +158,8 @@ class GenerationServer:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len={self.max_len}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         self._bucket_for(len(prompt))  # validate against buckets up front
         rid = self._next_rid
         self._next_rid += 1
@@ -178,8 +179,14 @@ class GenerationServer:
         bucket = self._bucket_for(n)
         prompt = np.zeros((1, bucket), np.int32)
         prompt[0, :n] = req.prompt
-        first, flat = self._prefill(bucket)(self.params, jnp.asarray(prompt),
-                                            n)
+        lg, flat = self._prefill(bucket)(self.params, jnp.asarray(prompt), n)
+        # the FIRST generated token honors the request temperature too
+        if req.temperature > 0:
+            k = jax.random.fold_in(self._base_key, (req.rid << 20) | 1)
+            first = jax.random.categorical(
+                k, lg / max(req.temperature, 1e-6)).astype(jnp.int32)
+        else:
+            first = jnp.argmax(lg, axis=-1).astype(jnp.int32)
         # scatter this request's cache rows into the slot. Rows beyond the
         # true prompt length hold right-pad garbage, but decode writes
         # sequentially from pos=n, overwriting each such row BEFORE the
