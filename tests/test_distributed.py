@@ -373,3 +373,57 @@ class TestGroupSharded:
         plats = {list(v.devices())[0].platform for k, v in slots.items()
                  if not k.startswith("__") and hasattr(v, "devices")}
         assert plats == {"cpu"}
+
+
+class TestMoETraining:
+    """Expert parallelism TRAINS: a transformer-ish block with an MoE FFN on
+    an expert-sharded mesh, full fwd+bwd+update through the compiled engine,
+    loss decreasing and expert weights expert-sharded (upgrades the dryrun's
+    dispatch-roundtrip check to end-to-end training; ref
+    incubate/distributed/models/moe/moe_layer.py:260)."""
+
+    def test_moe_block_trains_on_expert_mesh(self):
+        from jax.sharding import Mesh
+
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        from paddle_tpu.optimizer import AdamW
+
+        class MoEBlock(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.inp = nn.Linear(8, 16)
+                # ExpertMLP sets pspec=P("expert") on its stacked expert
+                # params itself — the final assert checks that wiring
+                self.moe = MoELayer(d_model=16, num_experts=4, d_hidden=32,
+                                    top_k=2)
+                self.out = nn.Linear(16, 4)
+
+            def forward(self, x):
+                h = paddle.tanh(self.inp(x))
+                h = self.moe(h)
+                return self.out(h)
+
+        paddle.seed(0)
+        model = MoEBlock()
+        opt = AdamW(learning_rate=5e-3, parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+
+        def loss_fn(out, y):
+            aux = model.moe.gate.loss  # load-balance auxiliary
+            base = paddle.mean((out - y) ** 2)
+            return base + (0.01 * aux if aux is not None else 0.0)
+
+        eng = ParallelEngine(model, optimizer=opt, loss_fn=loss_fn,
+                             mesh=mesh, batch_spec=P())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(16, 4).astype("float32"))
+        losses = [float(np.asarray(eng.train_batch(x, y).value))
+                  for _ in range(8)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
+        # expert weights really live sharded over the expert axis
+        sharded = [n for n, v in eng.params.items()
+                   if "experts" in n and "expert" in str(
+                       getattr(v, "sharding", ""))]
+        assert sharded, "expert weights are not expert-sharded"
