@@ -7,10 +7,12 @@
 // accelerator data plane is XLA collectives inside compiled programs, so the
 // actor runtime's job is HOST-side orchestration: driving per-stage callbacks
 // (microbatch pipeline schedules, async IO stages, checkpoint writers)
-// concurrently with device compute. Cross-rank brpc messaging is therefore
-// out of scope (single-host mailboxes; multi-host control uses the Python KV
-// store) — the scheduling semantics (credit-based upstream/downstream flow
-// control, per-step message loop) match the reference's ComputeInterceptor:
+// concurrently with device compute. Cross-rank messaging (the brpc
+// MessageBus role) is provided by the host RPC transport: messages for
+// tasks with no local actor go out through the EgressFn callback and come
+// in through pt_carrier_notify — the scheduling semantics (credit-based
+// upstream/downstream flow control, per-step message loop) match the
+// reference's ComputeInterceptor:
 // a node runs step s when every upstream has finished s AND every downstream
 // has consumed s - buffer_size (ready/credit counters, interceptor.cc
 // Compute/Amplifier RunOps loop).
@@ -46,6 +48,11 @@ struct Message {
 
 // task callback: status = fn(task_id, step); nonzero aborts the run
 using TaskFn = int64_t (*)(int64_t, int64_t);
+// egress callback: message for a task with no local actor (it lives on
+// another host) — the Python side forwards it over the RPC bus (the brpc
+// MessageBus role, ref fleet_executor/message_bus.cc)
+using EgressFn = int64_t (*)(int64_t /*dst*/, int32_t /*type*/,
+                             int64_t /*src*/, int64_t /*step*/);
 
 struct TaskNode {
   int64_t id = 0;
@@ -107,9 +114,29 @@ class Carrier {
 
   bool Run();
 
+  void SetEgress(EgressFn fn) { egress_ = fn; }
+
   void Route(int64_t dst, const Message& m) {
-    auto it = actors_.find(dst);
-    if (it != actors_.end()) it->second->Enqueue(m);
+    bool to_egress = false;
+    {
+      std::lock_guard<std::mutex> g(route_mu_);
+      if (!running_) {
+        // external notify arriving before Run() builds the actors (or after
+        // completion): buffer pre-run, drop post-run (only stale credits)
+        if (!finished_) pending_.push_back({dst, m});
+        return;
+      }
+      auto it = actors_.find(dst);
+      if (it != actors_.end()) {
+        it->second->Enqueue(m);
+        return;
+      }
+      to_egress = egress_ != nullptr;
+    }
+    if (to_egress) {
+      // a lost cross-host message would deadlock the DAG — abort loudly
+      if (egress_(dst, m.type, m.src, m.step) != 0) Abort(3);
+    }
   }
 
   void Abort(int64_t code) {
@@ -126,6 +153,11 @@ class Carrier {
   std::map<int64_t, TaskNode> nodes_;
   std::map<int64_t, std::unique_ptr<Interceptor>> actors_;
   std::atomic<int64_t> error_{0};
+  EgressFn egress_ = nullptr;
+  std::mutex route_mu_;
+  bool running_ = false;
+  bool finished_ = false;
+  std::deque<std::pair<int64_t, Message>> pending_;
 };
 
 void Interceptor::Loop() {
@@ -156,10 +188,13 @@ void Interceptor::Loop() {
       box_.pop_front();
       switch (m.type) {
         case kDataIsReady:
-          up_seen_[m.src] = m.step + 1;
+          // cross-host delivery is unordered (RPC thread pool): never let a
+          // late message regress the counter
+          up_seen_[m.src] = std::max(up_seen_[m.src], m.step + 1);
           break;
         case kDataIsUseless: {
-          down_consumed_[m.src] = m.step + 1;
+          down_consumed_[m.src] =
+              std::max(down_consumed_[m.src], m.step + 1);
           int64_t mn = step_ + 1;
           for (auto& kv : down_consumed_) mn = std::min(mn, kv.second);
           consumed_ = mn;
@@ -175,11 +210,28 @@ void Interceptor::Loop() {
 
 bool Carrier::Run() {
   error_.store(0);
-  actors_.clear();
-  for (auto& kv : nodes_)
-    actors_[kv.first] = std::unique_ptr<Interceptor>(new Interceptor(kv.second, this));
-  for (auto& kv : actors_) kv.second->Start();
+  std::deque<std::pair<int64_t, Message>> buffered;
+  {
+    std::lock_guard<std::mutex> g(route_mu_);
+    actors_.clear();
+    for (auto& kv : nodes_)
+      actors_[kv.first] =
+          std::unique_ptr<Interceptor>(new Interceptor(kv.second, this));
+    running_ = true;
+    finished_ = false;
+    buffered.swap(pending_);
+  }
+  {
+    std::lock_guard<std::mutex> g(route_mu_);
+    for (auto& kv : actors_) kv.second->Start();
+  }
+  for (auto& p : buffered) Route(p.first, p.second);  // early external msgs
   for (auto& kv : actors_) kv.second->Join();
+  {
+    std::lock_guard<std::mutex> g(route_mu_);
+    running_ = false;
+    finished_ = true;
+  }
   return error_.load() == 0;
 }
 
@@ -221,6 +273,33 @@ int64_t pt_carrier_add_task(int64_t h, int64_t id, int64_t role,
   n.downstream.assign(downstream, downstream + n_down);
   n.fn = fn;
   return it->second->AddNode(n);
+}
+
+void pt_carrier_set_egress(int64_t h, EgressFn fn) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_carriers.find(h);
+  if (it != g_carriers.end()) it->second->SetEgress(fn);
+}
+
+// inject a message from outside (the RPC bus delivering a remote edge).
+// Routed UNDER g_mu so a concurrent pt_carrier_destroy (the worker's run()
+// teardown) cannot free the carrier out from under us.
+int64_t pt_carrier_notify(int64_t h, int64_t dst, int32_t type, int64_t src,
+                          int64_t step) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_carriers.find(h);
+  if (it == g_carriers.end()) return -1;
+  it->second->Route(dst, {type, src, step});
+  return 0;
+}
+
+// abort a run from outside (cross-host failure propagation)
+int64_t pt_carrier_abort(int64_t h, int64_t code) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_carriers.find(h);
+  if (it == g_carriers.end()) return -1;
+  it->second->Abort(code ? code : 1);
+  return 0;
 }
 
 // returns 0 on success, else the first nonzero task status

@@ -6,8 +6,9 @@ pybind/bind_fleet_executor.cc).
 TPU-native role: host-side orchestration of per-stage callbacks — microbatch
 pipeline schedules, async IO, checkpoint writers — running concurrently with
 device compute (the accelerator data plane itself is XLA collectives inside
-jitted programs, so the brpc cross-rank MessageBus is replaced by single-host
-C++ mailbox threads; multi-host control traffic uses the launch KV store).
+jitted programs). Single-host DAGs run on C++ mailbox threads; DAGs spanning
+hosts use ``DistributedFleetExecutor``, whose cross-rank edges ride the
+``paddle.distributed.rpc`` transport (the brpc MessageBus role).
 Backed by csrc/fleet_executor.cpp via ctypes; scheduling semantics follow the
 reference ComputeInterceptor: a task runs step s when every upstream finished
 s and downstream credit (buffer_size) is available — with buffer_size=1 a
@@ -21,9 +22,11 @@ import subprocess
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-__all__ = ["TaskNode", "FleetExecutor"]
+__all__ = ["TaskNode", "FleetExecutor", "DistributedFleetExecutor"]
 
 _TASK_FN = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64, ctypes.c_int64)
+_EGRESS_FN = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                              ctypes.c_int64, ctypes.c_int64)
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
@@ -51,6 +54,13 @@ def _lib():
             lib.pt_carrier_run.restype = ctypes.c_int64
             lib.pt_carrier_run.argtypes = [ctypes.c_int64]
             lib.pt_carrier_destroy.argtypes = [ctypes.c_int64]
+            lib.pt_carrier_set_egress.argtypes = [ctypes.c_int64, _EGRESS_FN]
+            lib.pt_carrier_notify.restype = ctypes.c_int64
+            lib.pt_carrier_notify.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int64]
+            lib.pt_carrier_abort.restype = ctypes.c_int64
+            lib.pt_carrier_abort.argtypes = [ctypes.c_int64, ctypes.c_int64]
             _LIB = lib
     return _LIB
 
@@ -148,37 +158,169 @@ class FleetExecutor:
         exe.results = results
         return exe
 
+    def _register_tasks(self, lib, h, errors, keepalive, predicate=None,
+                        on_error=None):
+        """Wrap + register every node passing ``predicate``; shared by the
+        single-host and distributed run paths."""
+        for node in self._nodes.values():
+            if predicate is not None and not predicate(node):
+                continue
+
+            def make_cb(n: TaskNode):
+                def cb(task_id, step):
+                    try:
+                        n.fn(int(task_id), int(step))
+                        return 0
+                    except BaseException as e:  # surface to caller
+                        errors[int(task_id)] = e
+                        if on_error is not None:
+                            on_error()
+                        return 1
+                return _TASK_FN(cb)
+
+            cfn = make_cb(node)
+            keepalive.append(cfn)
+            up = (ctypes.c_int64 * max(len(node.upstream), 1))(
+                *node.upstream)
+            down = (ctypes.c_int64 * max(len(node.downstream), 1))(
+                *node.downstream)
+            lib.pt_carrier_add_task(
+                h, node.task_id, node.role, node.max_run_times,
+                node.buffer_size, up, len(node.upstream), down,
+                len(node.downstream), cfn)
+
     def run(self) -> None:
         lib = _lib()
         h = lib.pt_carrier_create()
         errors: Dict[int, BaseException] = {}
         keepalive = []  # CFUNCTYPE objects must outlive the run
         try:
-            for node in self._nodes.values():
-                def make_cb(n: TaskNode):
-                    def cb(task_id, step):
-                        try:
-                            n.fn(int(task_id), int(step))
-                            return 0
-                        except BaseException as e:  # surface to caller
-                            errors[int(task_id)] = e
-                            return 1
-                    return _TASK_FN(cb)
-
-                cfn = make_cb(node)
-                keepalive.append(cfn)
-                up = (ctypes.c_int64 * max(len(node.upstream), 1))(
-                    *node.upstream)
-                down = (ctypes.c_int64 * max(len(node.downstream), 1))(
-                    *node.downstream)
-                lib.pt_carrier_add_task(
-                    h, node.task_id, node.role, node.max_run_times,
-                    node.buffer_size, up, len(node.upstream), down,
-                    len(node.downstream), cfn)
+            self._register_tasks(lib, h, errors, keepalive)
             rc = lib.pt_carrier_run(h)
             if rc != 0:
                 if errors:
                     raise next(iter(errors.values()))
                 raise RuntimeError(f"FleetExecutor run failed with status {rc}")
         finally:
+            lib.pt_carrier_destroy(h)
+
+
+# --------------------------------------------------------------------------
+# Cross-host message bus (the brpc MessageBus role, ref
+# fleet_executor/message_bus.cc): edges between TaskNodes placed on different
+# RPC workers ride paddle.distributed.rpc; the C++ carrier forwards messages
+# for non-local tasks through its egress callback and accepts remote
+# deliveries via pt_carrier_notify.
+# --------------------------------------------------------------------------
+
+_DIST_EXECUTORS: Dict[str, "DistributedFleetExecutor"] = {}
+
+
+def _bus_abort(job: str, code: int) -> int:
+    """RPC endpoint: a peer's task failed — abort the local carrier."""
+    exe = _DIST_EXECUTORS.get(job)
+    if exe is None or exe._handle is None:
+        return -1
+    return int(_lib().pt_carrier_abort(exe._handle, code))
+
+
+def _bus_deliver(job: str, dst: int, mtype: int, src: int, step: int) -> int:
+    """RPC endpoint: runs on the destination worker, injects the message
+    into its live carrier. Waits briefly for the carrier if the sender's
+    run() raced ahead of ours (messages must not be lost)."""
+    import time as _t
+
+    for _ in range(600):  # up to 60s
+        exe = _DIST_EXECUTORS.get(job)
+        if exe is not None and exe._handle is not None:
+            return int(_lib().pt_carrier_notify(exe._handle, dst, mtype,
+                                                src, step))
+        if exe is not None and exe._completed:
+            return 0  # stale message after completion: drop cleanly
+        _t.sleep(0.1)
+    return -1
+
+
+class DistributedFleetExecutor(FleetExecutor):
+    """TaskNode DAG spanning RPC workers: each worker runs the local carrier
+    for ITS tasks; cross-worker edges are forwarded over the RPC transport.
+    ``placement``: task_id → rpc worker name (every worker passes the same
+    full map and full DAG topology; only locally-placed nodes get callbacks).
+    Call inside an initialized ``paddle.distributed.rpc`` world."""
+
+    def __init__(self, job_id: str, placement: Dict[int, str]):
+        super().__init__()
+        from .rpc import rpc as _rpc
+
+        self._rpc = _rpc
+        self.job_id = job_id
+        self.placement = dict(placement)
+        self.my_name = _rpc.get_current_worker_info().name
+        self._handle = None
+        self._completed = False
+        _DIST_EXECUTORS[job_id] = self
+
+    def is_local(self, task_id: int) -> bool:
+        return self.placement.get(task_id) == self.my_name
+
+    def _remote_workers(self):
+        return sorted({w for w in self.placement.values()
+                       if w != self.my_name})
+
+    def _propagate_abort(self):
+        """A local task failed: abort every peer's carrier too (the
+        reference MessageBus broadcasts STOP on failure)."""
+        for w in self._remote_workers():
+            try:
+                self._rpc.rpc_async(w, _bus_abort, args=(self.job_id, 1))
+            except BaseException:
+                pass
+
+    def run(self) -> None:
+        lib = _lib()
+        h = lib.pt_carrier_create()
+        _DIST_EXECUTORS[self.job_id] = self  # re-register on every run
+        self._completed = False
+        self._handle = h
+        errors: Dict[int, BaseException] = {}
+        keepalive = []
+        job = self.job_id
+
+        def egress(dst, mtype, src, step):
+            owner = self.placement.get(int(dst))
+            if owner is None or owner == self.my_name:
+                return -1
+            try:
+                # async: the interceptor thread must not block the network;
+                # a failed send aborts this carrier (a silently dropped
+                # message would deadlock the whole DAG)
+                fut = self._rpc.rpc_async(owner, _bus_deliver,
+                                          args=(job, int(dst), int(mtype),
+                                                int(src), int(step)))
+                if int(mtype) == 0:  # kDataIsReady: loss would deadlock
+                    fut._fut.add_done_callback(
+                        lambda f: (f.exception() is not None or
+                                   f.result() != 0) and
+                        lib.pt_carrier_abort(h, 3))
+                # credits (kDataIsUseless) may race peer shutdown: a lost
+                # credit cannot stall a finished consumer — best effort
+                return 0
+            except BaseException:
+                return -1
+
+        c_egress = _EGRESS_FN(egress)
+        keepalive.append(c_egress)
+        lib.pt_carrier_set_egress(h, c_egress)
+        try:
+            self._register_tasks(lib, h, errors, keepalive,
+                                 predicate=lambda n: self.is_local(n.task_id),
+                                 on_error=self._propagate_abort)
+            rc = lib.pt_carrier_run(h)
+            if rc != 0:
+                if errors:
+                    raise next(iter(errors.values()))
+                raise RuntimeError(f"DistributedFleetExecutor rc={rc}")
+        finally:
+            self._handle = None
+            self._completed = True
             lib.pt_carrier_destroy(h)
