@@ -69,6 +69,7 @@ def _measure(cfg, B, S, steps, warmup, remat=False):
 
 
 def main():
+    t_start = time.perf_counter()
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -97,7 +98,11 @@ def main():
     mfu, tokens_per_sec, n_params, loss = _measure(cfg, B, S, steps, warmup)
 
     extra = {}
-    if on_tpu and os.environ.get("BENCH_SKIP_LARGE") != "1":
+    # only attempt the larger config if the headline left ample budget —
+    # losing the 509M number to a child timeout would be worse than missing
+    # the extra metric
+    if (on_tpu and os.environ.get("BENCH_SKIP_LARGE") != "1"
+            and time.perf_counter() - t_start < 240):
         # second metric: largest-fitting config (~1.3B, remat on) — closer to
         # the 8B north star's arithmetic intensity than the 509M proxy
         try:
@@ -136,15 +141,17 @@ def _run_with_retries() -> int:
     backoff, then fall back to CPU with an explicit error field."""
     env = dict(os.environ)
     env["_PADDLE_TPU_BENCH_CHILD"] = "1"
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+    # per-attempt budgets: a hung TPU tunnel must not eat the whole round
+    budgets = [int(b) for b in os.environ.get(
+        "BENCH_TIMEOUTS", "600,240").split(",")]
     last_tail = ""
-    for i in range(attempts):
+    for i, budget in enumerate(budgets):
         try:
             proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                   env=env, capture_output=True, text=True,
-                                  timeout=int(os.environ.get("BENCH_TIMEOUT", "900")))
+                                  timeout=budget)
         except subprocess.TimeoutExpired:
-            last_tail = f"bench child timed out (attempt {i + 1})"
+            last_tail = f"bench child timed out (attempt {i + 1}, {budget}s)"
             continue
         sys.stderr.write(proc.stderr[-4000:])
         if proc.returncode == 0 and '"metric"' in proc.stdout:
@@ -157,7 +164,7 @@ def _run_with_retries() -> int:
     env["_PADDLE_TPU_BENCH_TPU_ERROR"] = " ".join(last_tail.split())[-400:]
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, capture_output=True, text=True, timeout=900)
+                              env=env, capture_output=True, text=True, timeout=600)
         sys.stderr.write(proc.stderr[-4000:])
         if proc.returncode == 0 and '"metric"' in proc.stdout:
             sys.stdout.write(proc.stdout[proc.stdout.index('{"metric"'):])
