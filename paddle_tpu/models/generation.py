@@ -187,3 +187,133 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
         if was_training:
             model.train()
     return Tensor(out)
+
+
+def compiled_beam_search(model, input_ids, *, num_beams, max_new_tokens,
+                         eos_token_id, length_penalty, make_caches, run_one,
+                         prefill=None, max_positions=None):
+    """Compiled beam search over the cached decode step (PaddleNLP
+    ``generate(decode_strategy="beam_search")`` parity, built the TPU way:
+    the whole search is ONE lax.scan — per step the (B·K) decode batch
+    produces logprobs, joint top-k over K·V picks the next beams, KV caches
+    are gathered along the beam dim, and the token/parent trail is
+    backtraced at the end with the gather_tree primitive).
+
+    Finished beams (emitted EOS) are frozen: they re-emit EOS with no score
+    change and keep competing in the top-k, the standard is-done handling.
+    ``length_penalty`` alpha: final score = cum_logprob / (len ** alpha).
+    """
+    import numpy as _np
+
+    from ..framework.core import Tensor, to_array
+    from ..jit import state_values
+
+    ids = _np.asarray(to_array(input_ids))
+    B, P = ids.shape  # noqa: N806
+    K = int(num_beams)
+    T = max_new_tokens
+    L = P + T
+    if max_positions is not None and L > max_positions:
+        raise ValueError(f"prompt+new tokens {L} exceeds "
+                         f"max_position_embeddings {max_positions}")
+    if T < 1 or K < 1:
+        raise ValueError(
+            f"beam search needs max_new_tokens >= 1 and num_beams >= 1 "
+            f"(got {T}, {K})")
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    params = state_values(model)
+
+    def expand(x):  # (B, ...) -> (B*K, ...) beam-major per batch row
+        return jnp.repeat(x, K, axis=0)
+
+    def gen_fn(p, prompt):
+        neg = jnp.float32(-1e30)
+        # run the prompt at batch B (all beams share it), then replicate the
+        # caches/logits K-fold — prefilling (B*K) identical rows would do K
+        # times redundant compute
+        caches = make_caches(B, L)
+        if prefill is not None and P > 1:
+            logits, caches = prefill(p, prompt, caches)
+        else:
+            def tf_body(caches, t):
+                tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1, 1)
+                logits, caches = run_one(p, tok, caches, t)
+                return caches, logits
+
+            caches, all_lg = jax.lax.scan(tf_body, caches, jnp.arange(P))
+            logits = all_lg[-1]
+        caches = [jnp.repeat(c, K, axis=0) for c in caches]
+        start = P
+
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)  # (B, V)
+        V = logp.shape[-1]
+        # first expansion: every beam starts from the single prompt state,
+        # so one top-k over V per row seeds the K beams (no duplicates)
+        cum, tok0 = jax.lax.top_k(logp, K)             # (B, K)
+        flat_idx = tok0  # tokens directly (single source beam)
+        tok0 = flat_idx.astype(jnp.int32)
+        done = (tok0 == eos) if eos >= 0 else jnp.zeros((B, K), bool)
+        gen_len = jnp.ones((B, K), jnp.int32)
+        # parents for step 0 all come from beam 0; caches identical per row
+        step_tokens0 = tok0                             # (B, K)
+        step_parents0 = jnp.zeros((B, K), jnp.int32)
+        cur = tok0.reshape(B * K)
+
+        def body(carry, t):
+            cur, cum, done, gen_len, caches = carry
+            logits, caches = run_one(p, cur[:, None], caches, t)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logp = logp.reshape(B, K, V)
+            # frozen finished beams: only EOS continues, score unchanged
+            if eos >= 0:
+                frozen = jnp.full((V,), neg).at[eos].set(0.0)
+                logp = jnp.where(done[..., None], frozen[None, None, :], logp)
+            total = cum[..., None] + logp               # (B, K, V)
+            cum2, flat = jax.lax.top_k(total.reshape(B, K * V), K)
+            parent = (flat // V).astype(jnp.int32)      # (B, K)
+            tok = (flat % V).astype(jnp.int32)
+            bi = jnp.arange(B)[:, None]
+            done2 = done[bi, parent]
+            gen2 = jnp.where(done2, gen_len[bi, parent],
+                             gen_len[bi, parent] + 1)
+            if eos >= 0:
+                done2 = done2 | (tok == eos)
+            # reindex KV caches along the beam dim
+            src = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+            caches = [c[src] for c in caches]
+            return ((tok.reshape(B * K), cum2, done2, gen2, caches),
+                    (tok, parent))
+
+        (cur, cum, done, gen_len, caches), (tks, prs) = jax.lax.scan(
+            body, (cur, cum, done, gen_len, caches),
+            jnp.arange(start, start + T - 1))
+        # trail: (T, B, K) including the first expansion
+        all_toks = jnp.concatenate([step_tokens0[None], tks], axis=0)
+        all_parents = jnp.concatenate([step_parents0[None], prs], axis=0)
+        from ..nn.functional.extras import gather_tree
+
+        traced = gather_tree(Tensor(all_toks), Tensor(all_parents)).value
+        # pick the best beam per row by length-normalized score
+        # (PaddleNLP/HF convention: normalize by the FULL hypothesis length,
+        # prompt included)
+        full_len = (gen_len + P).astype(jnp.float32)
+        norm = cum / jnp.power(full_len, jnp.float32(length_penalty))
+        best = jnp.argmax(norm, axis=-1)                # (B,)
+        seq = traced[:, jnp.arange(B), best].T          # (B, T)
+        return jnp.concatenate([prompt, seq.astype(jnp.int32)], axis=1)
+
+    key = ("beam", B, P, T, K, eos, float(length_penalty),
+           prefill is not None)
+    cache = getattr(model, "_gen_cache", None)
+    if cache is None:
+        cache = model._gen_cache = {}
+    if key not in cache:
+        cache[key] = jax.jit(gen_fn)
+    was_training = getattr(model, "training", False)
+    model.eval()
+    try:
+        out = cache[key](params, jnp.asarray(ids, jnp.int32))
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out)

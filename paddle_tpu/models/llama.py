@@ -506,7 +506,8 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, num_beams: int = 1,
+                 length_penalty: float = 0.0):
         """Autoregressive generation with a compiled single-token decode loop
         (PaddleNLP `model.generate` surface; greedy when temperature == 0).
 
@@ -567,6 +568,21 @@ class LlamaForCausalLM(Layer):
                 flat += [ck.value, cv.value]
             return logits.value[:, 0], flat
 
+        if num_beams > 1:
+            if temperature or top_k:
+                import warnings
+
+                warnings.warn(
+                    "num_beams > 1 uses deterministic beam search; "
+                    "temperature/top_k/seed are ignored", UserWarning)
+            from .generation import compiled_beam_search
+
+            return compiled_beam_search(
+                self, input_ids, num_beams=num_beams,
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                length_penalty=length_penalty, make_caches=make_caches,
+                run_one=run_one, prefill=prefill_fn,
+                max_positions=cfg.max_position_embeddings)
         return compiled_cached_generate(
             self, input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, seed=seed,

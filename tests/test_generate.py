@@ -185,3 +185,75 @@ class TestLlamaPrefill:
         out = np.asarray(m.generate(paddle.to_tensor(ids),
                                     max_new_tokens=0).value)
         np.testing.assert_array_equal(out, ids)
+
+
+class TestBeamSearch:
+    """Compiled beam search (one lax.scan: joint top-k over K*V, KV-cache
+    beam gather, gather_tree backtrace) vs an exhaustive oracle."""
+
+    def _model(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        cfg = llama_tiny_config(
+            use_flash_attention=False, vocab_size=64, hidden_size=32,
+            intermediate_size=48, num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64)
+        paddle.seed(0)
+        return LlamaForCausalLM(cfg), cfg
+
+    def _oracle(self, m, prompt, T, K, eos=None):
+        def logp_of(seq):
+            out = np.asarray(m(paddle.to_tensor(
+                np.asarray([seq], np.int32))).value)[0, -1]
+            return out - np.log(np.exp(out).sum())
+
+        beams = [(list(prompt), 0.0, False)]
+        for _ in range(T):
+            cand = []
+            for seq, sc, done in beams:
+                if done:
+                    cand.append((seq + [eos], sc, True))
+                    continue
+                lp = logp_of(seq)
+                for v in range(64):
+                    cand.append((seq + [v], sc + lp[v],
+                                 eos is not None and v == eos))
+            cand.sort(key=lambda x: -x[1])
+            beams = cand[:K]
+        return [int(x) for x in beams[0][0]]
+
+    def test_matches_exhaustive_beam_search(self):
+        m, cfg = self._model()
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, 64, (2, 5)).astype(np.int32)
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=4, num_beams=3).value)
+        for b in range(2):
+            want = self._oracle(m, prompt[b], 4, 3)
+            assert out[b].tolist() == want, b
+
+    def test_eos_freezes_finished_beams(self):
+        m, cfg = self._model()
+        rng = np.random.RandomState(1)
+        prompt = rng.randint(1, 64, (1, 4)).astype(np.int32)
+        # pick the first step's argmax as the eos token: the top beam
+        # finishes immediately and must stay frozen yet win
+        first = np.asarray(m(paddle.to_tensor(prompt)).value)[0, -1]
+        eos = int(np.argmax(first))
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=5, num_beams=3,
+                                    eos_token_id=eos).value)
+        want = self._oracle(m, prompt[0], 5, 3, eos=eos)
+        assert out[0].tolist() == want
+        gen = out[0].tolist()[4:]
+        assert gen[0] == eos and all(t == eos for t in gen)
+
+    def test_beam_one_equals_greedy(self):
+        m, cfg = self._model()
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(1, 64, (2, 6)).astype(np.int32)
+        greedy = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=5).value)
+        beam1 = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=5, num_beams=1).value)
+        np.testing.assert_array_equal(greedy, beam1)
