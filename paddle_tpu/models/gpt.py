@@ -196,10 +196,12 @@ class GPTForCausalLM(Layer, GenerationMixin):
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 eos_token_id=None):
+                 eos_token_id=None, num_beams: int = 1,
+                 length_penalty: float = 0.0):
         """Cached O(L) decode (overrides the cache-less GenerationMixin
         fallback): fixed KV caches per block + one compiled scan — the same
-        design as Llama's generate."""
+        design as Llama's generate. ``num_beams > 1`` switches to the
+        compiled beam search."""
         from ..framework.core import Tensor
         from ..framework.dtype import convert_dtype
         from ..jit import functional_call
@@ -252,6 +254,21 @@ class GPTForCausalLM(Layer, GenerationMixin):
                 out += [ck.value, cv.value]
             return logits.value[:, 0], out
 
+        if num_beams > 1:
+            if temperature or top_k:
+                import warnings
+
+                warnings.warn(
+                    "num_beams > 1 uses deterministic beam search; "
+                    "temperature/top_k/seed are ignored", UserWarning)
+            from .generation import compiled_beam_search
+
+            return compiled_beam_search(
+                self, input_ids, num_beams=num_beams,
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                length_penalty=length_penalty, make_caches=make_caches,
+                run_one=run_one, prefill=prefill_fn,
+                max_positions=cfg.max_position_embeddings)
         return compiled_cached_generate(
             self, input_ids, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, seed=seed,

@@ -257,3 +257,37 @@ class TestBeamSearch:
         beam1 = np.asarray(m.generate(paddle.to_tensor(prompt),
                                       max_new_tokens=5, num_beams=1).value)
         np.testing.assert_array_equal(greedy, beam1)
+
+
+    def test_gpt_beam_search_matches_oracle(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+
+        cfg = gpt_tiny_config(vocab_size=64, hidden_size=32,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              intermediate_size=48,
+                              max_position_embeddings=64)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(1, 64, (1, 5)).astype(np.int32)
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=3, num_beams=3).value)
+        want = self._gpt_oracle(m, prompt[0], 3, 3)
+        assert out[0].tolist() == want
+
+    def _gpt_oracle(self, m, prompt, T, K):
+        def logp_of(seq):
+            out = np.asarray(m(paddle.to_tensor(
+                np.asarray([seq], np.int32))).value)[0, -1]
+            return out - np.log(np.exp(out).sum())
+
+        beams = [(list(prompt), 0.0)]
+        for _ in range(T):
+            cand = []
+            for seq, sc in beams:
+                lp = logp_of(seq)
+                for v in range(64):
+                    cand.append((seq + [v], sc + lp[v]))
+            cand.sort(key=lambda x: -x[1])
+            beams = cand[:K]
+        return [int(x) for x in beams[0][0]]
