@@ -17,15 +17,28 @@ import jax
 import jax.numpy as jnp
 
 
-def next_token(logits, rng, temperature: float, top_k: int):
+def next_token(logits, rng, temperature: float, top_k: int,
+               top_p: float = 0.0):
     """Sample/argmax one token per row from (B, V) logits. Shared by every
-    generate implementation so sampling semantics can't drift."""
+    generate implementation so sampling semantics can't drift. ``top_p``
+    applies nucleus filtering (keep the smallest prefix of the sorted
+    distribution whose mass reaches p) after top_k."""
     if temperature and temperature > 0:
         rng, sub = jax.random.split(rng)
         lg = logits.astype(jnp.float32) / temperature
         if top_k and top_k > 0:
             kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
             lg = jnp.where(lg < kth, -1e30, lg)
+        if top_p and 0 < top_p < 1:
+            srt = jnp.sort(lg, axis=-1)[:, ::-1]  # descending
+            probs = jax.nn.softmax(srt, axis=-1)
+            cdf = jnp.cumsum(probs, axis=-1)
+            # keep tokens while the mass BEFORE them is < p (always >= 1)
+            keep = jnp.concatenate(
+                [jnp.ones((lg.shape[0], 1), bool), cdf[:, :-1] < top_p],
+                axis=-1)
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[:, None]
+            lg = jnp.where(lg < cutoff, -1e30, lg)
         return jax.random.categorical(sub, lg, axis=-1).astype(jnp.int32), rng
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
 
@@ -51,7 +64,8 @@ class GenerationMixin:
     (B, S, V) with causal semantics."""
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0,
                  eos_token_id: Optional[int] = None):
         import numpy as _np
 
@@ -83,7 +97,7 @@ class GenerationMixin:
             def body(carry, t):
                 toks, done, rng = carry
                 logits = logits_at(p, toks, t)
-                nxt, rng = next_token(logits, rng, temperature, top_k)
+                nxt, rng = next_token(logits, rng, temperature, top_k, top_p)
                 toks, done = advance_tokens(toks, done, nxt, t, P, L,
                                             eos_token_id)
                 return (toks, done, rng), None
@@ -117,7 +131,8 @@ class GenerationMixin:
 
 def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
                              top_k, seed, eos_token_id, make_caches, run_one,
-                             prefill=None, max_positions=None, extra_key=()):
+                             prefill=None, max_positions=None, extra_key=(),
+                             top_p: float = 0.0):
     """Shared prefill+decode loop for models WITH a cached decode_step
     (Llama, GPT): fixed-size KV caches, one lax.scan over the decode steps,
     the whole generation compiled once per static config.
@@ -153,7 +168,7 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
         # max_new_tokens == 0 the sampled token would overwrite toks[:, P-1]
         if prefill is not None and P > 1 and max_new_tokens > 0:
             logits, caches = prefill(p, prompt, caches)
-            nxt, rng = next_token(logits, rng, temperature, top_k)
+            nxt, rng = next_token(logits, rng, temperature, top_k, top_p)
             toks, done = advance_tokens(toks, done, nxt, P - 1, P, L,
                                         eos_token_id)
             start = P  # positions [0, P) are in the caches already
@@ -162,7 +177,7 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
             toks, caches, done, rng = carry
             tok = jax.lax.dynamic_slice_in_dim(toks, t, 1, 1)
             logits, caches = run_one(p, tok, caches, t)
-            nxt, rng = next_token(logits, rng, temperature, top_k)
+            nxt, rng = next_token(logits, rng, temperature, top_k, top_p)
             toks, done = advance_tokens(toks, done, nxt, t, P, L,
                                         eos_token_id)
             return (toks, caches, done, rng), None
@@ -172,7 +187,8 @@ def compiled_cached_generate(model, input_ids, *, max_new_tokens, temperature,
         return toks
 
     key = (B, P, max_new_tokens, float(temperature or 0.0), int(top_k or 0),
-           eos_token_id, prefill is not None, tuple(extra_key))
+           float(top_p or 0.0), eos_token_id, prefill is not None,
+           tuple(extra_key))
     cache = getattr(model, "_gen_cache", None)
     if cache is None:
         cache = model._gen_cache = {}

@@ -195,7 +195,8 @@ class GPTForCausalLM(Layer, GenerationMixin):
         return F.cross_entropy(logits, labels, reduction="mean")
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0,
                  eos_token_id=None, num_beams: int = 1,
                  length_penalty: float = 0.0):
         """Cached O(L) decode (overrides the cache-less GenerationMixin
@@ -271,7 +272,7 @@ class GPTForCausalLM(Layer, GenerationMixin):
                 max_positions=cfg.max_position_embeddings)
         return compiled_cached_generate(
             self, input_ids, max_new_tokens=max_new_tokens,
-            temperature=temperature, top_k=top_k, seed=seed,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             eos_token_id=eos_token_id, make_caches=make_caches,
             run_one=run_one, prefill=prefill_fn,
             max_positions=cfg.max_position_embeddings)

@@ -291,3 +291,43 @@ class TestBeamSearch:
             cand.sort(key=lambda x: -x[1])
             beams = cand[:K]
         return [int(x) for x in beams[0][0]]
+
+
+class TestTopPSampling:
+    """Nucleus filtering in the shared next_token: samples only come from
+    the smallest prefix of the sorted distribution reaching mass p."""
+
+    def test_support_restricted_to_nucleus(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import next_token
+
+        logits = jnp.asarray(np.log(np.array(
+            [[0.5, 0.3, 0.15, 0.05],
+             [0.97, 0.01, 0.01, 0.01]], "float32")))
+        rng = jax.random.PRNGKey(0)
+        seen = [set(), set()]
+        for i in range(200):
+            tok, rng = next_token(logits, rng, temperature=1.0, top_k=0,
+                                  top_p=0.7)
+            for b in range(2):
+                seen[b].add(int(tok[b]))
+        # row 0: nucleus at p=0.7 = {0 (.5), 1 (.3)}; row 1: {0}
+        assert seen[0] <= {0, 1} and len(seen[0]) == 2
+        assert seen[1] == {0}
+
+    def test_generate_accepts_top_p(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+        cfg = llama_tiny_config(use_flash_attention=False,
+                                max_position_embeddings=64)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(1, cfg.vocab_size, (1, 5)).astype(np.int32)
+        out = np.asarray(m.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=6, temperature=1.0,
+                                    top_p=0.9).value)
+        assert out.shape == (1, 11)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
