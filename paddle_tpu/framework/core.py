@@ -104,6 +104,7 @@ class TapeNode:
     __slots__ = (
         "id",
         "vjp_fn",
+        "fwd_fn",
         "inputs",
         "n_out",
         "out_ct",
@@ -113,10 +114,14 @@ class TapeNode:
         "__weakref__",
     )
 
-    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, name="", fwd_fn=None):
         _grad_state.tape_counter += 1
         self.id = _grad_state.tape_counter
         self.vjp_fn = vjp_fn
+        # forward closure over the differentiable inputs: re-linearized by
+        # backward(create_graph=True) so second-order grads see the primal
+        # dependency (the vjp residuals alone are constants)
+        self.fwd_fn = fwd_fn
         self.inputs: Tuple["Tensor", ...] = tuple(inputs)
         self.n_out = len(out_avals)
         self.out_avals = out_avals  # list of (shape, dtype)
@@ -429,12 +434,17 @@ def _requires_grad(t: Any) -> bool:
     return isinstance(t, Tensor) and not t.stop_gradient
 
 
-def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = False):
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False):
     """paddle.autograd.backward parity (ref eager/backward.cc:383).
 
     Tape order is topological, so we sweep nodes by descending id.
+    ``create_graph=True`` records the backward computation itself on the
+    tape (cotangents flow as taped Tensors and every vjp is re-linearized
+    through dispatch), enabling double backward / ``paddle.grad`` chains.
     """
     tensors = list(tensors)
+    retain_graph = retain_graph or create_graph  # grad graph re-enters nodes
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
     roots: List[TapeNode] = []
@@ -447,6 +457,8 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = 
             g_val = jnp.ones_like(t._value)
         else:
             g_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            g_val = g if isinstance(g, Tensor) else Tensor(g_val)
         if t._node is not None:
             t._node.add_ct(t._idx, g_val)
             roots.append(t._node)
@@ -474,6 +486,8 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = 
             if ct is None:
                 shape, dtype = node.out_avals[i]
                 ct = jnp.zeros(shape, dtype)
+                if create_graph:
+                    ct = Tensor(ct)
             else:
                 pending = True
             # apply hooks registered on the output tensor
@@ -481,15 +495,25 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = 
             out_t = ref() if ref is not None else None
             if out_t is not None:
                 for hook in out_t._backward_hooks:
-                    res = hook(Tensor(ct))
+                    res = hook(ct if isinstance(ct, Tensor) else Tensor(ct))
                     if res is not None:
-                        ct = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+                        ct = _hook_result(res, create_graph)
                 if out_t._retain_grads and node.out_ct[i] is not None:
                     _accum_grad(out_t, ct)
             cts.append(ct)
         if not pending:
             continue
-        in_cts = node.vjp_fn(tuple(cts) if node.n_out > 1 else cts[0])
+        if create_graph:
+            if node.fwd_fn is None:
+                raise RuntimeError(
+                    f"create_graph=True through op '{node.name}': recorded "
+                    "without a re-linearizable forward (PyLayer/custom "
+                    "autograd) — double backward is not supported across it")
+            in_cts = _relinearized_vjp(node, cts)
+        else:
+            raw_cts = [c._value if isinstance(c, Tensor) else c for c in cts]
+            in_cts = node.vjp_fn(tuple(raw_cts) if node.n_out > 1
+                                 else raw_cts[0])
         for inp, ict in zip(node.inputs, in_cts):
             if ict is None:
                 continue
@@ -497,13 +521,42 @@ def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph: bool = 
                 inp._node.add_ct(inp._idx, ict)
             if inp._node is None or inp._retain_grads:
                 for hook in inp._backward_hooks:
-                    res = hook(Tensor(ict))
+                    res = hook(ict if isinstance(ict, Tensor) else Tensor(ict))
                     if res is not None:
-                        ict = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+                        ict = _hook_result(res, create_graph)
                 _accum_grad(inp, ict)
         node.out_ct = [None] * node.n_out
         if not retain_graph:
             node.vjp_fn = _used_vjp
+            node.fwd_fn = None  # release the captured forward inputs too
+
+
+def _hook_result(res, create_graph: bool):
+    if create_graph and isinstance(res, Tensor):
+        return res
+    return res._value if isinstance(res, Tensor) else jnp.asarray(res)
+
+
+def _relinearized_vjp(node: "TapeNode", cts):
+    """create_graph path: apply the node's vjp as a DISPATCHED op over
+    (cotangents, primal inputs) — jax.vjp is recomputed from the forward
+    closure so the primal dependency is differentiable (second order)."""
+    from .dispatch import apply_op
+
+    n_out = node.n_out
+    fwd = node.fwd_fn
+
+    def vjp_op(*a):
+        c = a[:n_out]
+        dvals = a[n_out:]
+        _, vjp = jax.vjp(fwd, *dvals)
+        res = vjp(tuple(c) if n_out > 1 else c[0])
+        return tuple(res) if len(res) > 1 else res[0]
+
+    ct_ts = [c if isinstance(c, Tensor) else Tensor(c) for c in cts]
+    out = apply_op(vjp_op, *ct_ts, *node.inputs,
+                   op_name=f"grad_{node.name}")
+    return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
 def _used_vjp(*_):
@@ -514,6 +567,10 @@ def _used_vjp(*_):
 
 def _accum_grad(t: Tensor, g) -> None:
     if t.stop_gradient and not t._retain_grads:
+        return
+    if isinstance(g, Tensor):
+        # create_graph path: keep the accumulated grad on the tape
+        t._grad = g if t._grad is None else t._grad + g
         return
     if t._grad is None:
         t._grad = Tensor(g)
@@ -542,7 +599,9 @@ def grad(
         t._grad = None
         t._retain_grads = True
     try:
-        backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph) or create_graph)
+        backward(list(outputs), grad_outputs,
+                 retain_graph=bool(retain_graph) or create_graph,
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             if t._grad is None and not allow_unused:
@@ -551,11 +610,12 @@ def grad(
                     "set allow_unused=True to return None for it.")
             results.append(t._grad)
     finally:
+        # restore .grad slots to pre-call values on BOTH paths — an
+        # exception must not clobber the caller's accumulated grads
+        # (results hold their own references, unaffected by the restore)
         for t, g, r in saved:
             t._retain_grads = r
-        # restore .grad of inputs to pre-call values only if caller had them
-    for (t, g, r), _res in zip(saved, results):
-        t._grad = g
+            t._grad = g
     return results
 
 

@@ -183,16 +183,22 @@ def apply_op(fn: Callable, *args, n_outputs: Optional[int] = None, op_name: str 
             if arr_pos is not None and all(_hashable(v) for v in kwargs.values()):
                 cached = _cached_fwd(fn, len(raw), tuple(diff_idx),
                                      tuple(arr_pos), tuple(statics), kwargs)
+        # diff positions are overwritten by dvals at call time — capture
+        # None there so the closure doesn't pin those arrays
+        def _fwd(*dvals,
+                 _raw=tuple(None if i in diff_idx else v
+                            for i, v in enumerate(raw)),
+                 _di=tuple(diff_idx), _fn=fn, _kw=kwargs):
+            full = list(_raw)
+            for i, v in zip(_di, dvals):
+                full[i] = v
+            return _fn(*full, **_kw)
+
         if cached is not None:
             out, raw_vjp = cached(*(raw[i] for i in arr_pos))
             vjp_fn = functools.partial(_bwd_apply, raw_vjp)
         else:
-            def f(*dvals):
-                full = list(raw)
-                for i, v in zip(diff_idx, dvals):
-                    full[i] = v
-                return fn(*full, **kwargs)
-
+            f = _fwd
             out, vjp_fn = jax.vjp(f, *(raw[i] for i in diff_idx))
     else:
         out = fn(*raw, **kwargs)
@@ -210,6 +216,7 @@ def apply_op(fn: Callable, *args, n_outputs: Optional[int] = None, op_name: str 
             inputs=[args[i] for i in diff_idx],
             out_avals=[(o.shape, o.dtype) for o in outs],
             name=op_name or getattr(fn, "__name__", "op"),
+            fwd_fn=_fwd,
         )
         for k, t in enumerate(out_tensors):
             t._node = node
