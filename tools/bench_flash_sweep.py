@@ -1,0 +1,105 @@
+"""Flash-attention kernel block-size sweep — run on a REAL TPU chip.
+
+Round-1 measurements (BASELINE.md) left the forward kernel ~15% behind the
+stock jax reference at B=8 S=2048 GQA and fwd+bwd at 41.6% of peak at S=16k;
+this tool is the measurement harness for closing that gap: it times every
+(block_q, block_k) combination for each shape in its own SUBPROCESS (the
+block size is baked into the compiled kernel, so same-process env flips
+would silently reuse the first compilation) and prints a ranked table plus
+the current-default comparison.
+
+Usage (TPU):
+    python tools/bench_flash_sweep.py [--shapes small|long|all] [--bwd]
+"""
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+SHAPES = {
+    "small": [(8, 2048, 16, 8, 128)],          # the B=8 S=2048 GQA headline
+    "long": [(1, 16384, 16, 8, 128)],          # S=16k streaming target
+    "all": [(8, 2048, 16, 8, 128), (2, 8192, 16, 8, 128),
+            (1, 16384, 16, 8, 128), (8, 2048, 16, 16, 128)],
+}
+BLOCKS = [(256, 256), (256, 512), (512, 256), (512, 512),
+          (512, 1024), (1024, 512), (1024, 1024)]
+
+_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, %(repo)r)
+import jax, jax.numpy as jnp
+from paddle_tpu.ops.flash_attention import flash_attention
+
+B, S, H, KV, D = %(shape)s
+do_bwd = %(bwd)s
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D).astype("float32")).astype(jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, KV, S, D).astype("float32")).astype(jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, KV, S, D).astype("float32")).astype(jnp.bfloat16)
+
+fwd = jax.jit(lambda a, b, c: flash_attention(a, b, c, True))
+loss = jax.jit(jax.grad(lambda a, b, c: jnp.sum(
+    flash_attention(a, b, c, True).astype(jnp.float32)), argnums=(0, 1, 2)))
+
+fn = loss if do_bwd else fwd
+out = fn(q, k, v); jax.block_until_ready(out)   # compile
+reps = 20 if S <= 4096 else 8
+t0 = time.perf_counter()
+for _ in range(reps):
+    out = fn(q, k, v)
+jax.block_until_ready(out)
+ms = (time.perf_counter() - t0) / reps * 1e3
+# causal attention flops: ~0.5 * 4 * B*H*S^2*D fwd (x2.5 for fwd+bwd)
+flops = 0.5 * 4.0 * B * H * S * S * D * (2.5 if do_bwd else 1.0)
+print(json.dumps({"ms": ms, "tflops": flops / ms / 1e9}))
+"""
+
+
+def run_config(shape, bq, bk, bwd):
+    env = dict(os.environ)
+    env["PT_FLASH_BLOCK_Q"] = str(bq)
+    env["PT_FLASH_BLOCK_K"] = str(bk)
+    code = _CHILD % {"repo": os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "shape": tuple(shape), "bwd": bwd}
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            return None
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError):
+        return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="small", choices=list(SHAPES))
+    ap.add_argument("--bwd", action="store_true",
+                    help="time grad (fwd+bwd) instead of forward only")
+    args = ap.parse_args()
+
+    for shape in SHAPES[args.shapes]:
+        print(f"\n== shape B,S,H,KV,D = {shape} "
+              f"({'fwd+bwd' if args.bwd else 'fwd'}) ==")
+        rows = []
+        for bq, bk in BLOCKS:
+            r = run_config(shape, bq, bk, args.bwd)
+            tag = f"bq={bq:4d} bk={bk:4d}"
+            if r is None:
+                print(f"  {tag}: FAILED/OOM")
+                continue
+            rows.append((r["ms"], tag, r["tflops"]))
+            print(f"  {tag}: {r['ms']:7.3f} ms  {r['tflops']:6.1f} TFLOP/s")
+        if rows:
+            rows.sort()
+            best = rows[0]
+            print(f"  BEST: {best[1]} at {best[0]:.3f} ms "
+                  f"({best[2]:.1f} TFLOP/s)")
+
+
+if __name__ == "__main__":
+    main()
