@@ -6,11 +6,18 @@ proxy model (the largest no-remat config that fits one 16GB v5e) — the unit
 string labels the proxy honestly.  A second, larger config (~1.3B with
 remat) is measured and reported in the same JSON under "extra".
 
-Robustness: TPU backend init can fail transiently (tunneled plugin).  The
-__main__ block runs the workload in a child process and retries with
-backoff; if the TPU never comes up it falls back to the CPU smoke config
-and emits the JSON line with an explicit "error" field instead of dying
-with a raw traceback.
+Robustness: TPU backend init can fail transiently (tunneled plugin) or
+hang outright (>400s observed when the tunnel is down).  The __main__
+block is PROBE-FIRST: a cheap short-timeout child asks `jax.devices()`
+before any workload attempt is committed, so a dead tunnel costs ~90s per
+probe instead of a full workload budget.  Only after a probe succeeds is
+the (expensive, generously-budgeted) workload child launched; if the TPU
+never comes up within the probe window the bench falls back to the CPU
+smoke config and emits the JSON line with an explicit "error" field
+instead of dying with a raw traceback.  Platform pinning note: the axon
+TPU plugin ignores the `JAX_PLATFORMS` env var, so CPU children rely on
+paddle_tpu/__init__.py translating the env var into
+`jax.config.update("jax_platforms", ...)` (also mirrored below).
 """
 from __future__ import annotations
 
@@ -80,6 +87,13 @@ def main():
     from paddle_tpu.models import LlamaConfig
 
     on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if os.environ.get("_PADDLE_TPU_BENCH_REQUIRE_TPU") == "1" and not on_tpu:
+        # a TPU-committed attempt that came up on CPU must fail loudly so the
+        # parent retries/falls back explicitly instead of recording a CPU
+        # number as if it were the TPU measurement
+        sys.stderr.write("bench child required TPU but backend is %s\n"
+                         % jax.devices()[0].platform)
+        sys.exit(7)
     if on_tpu:
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                           num_hidden_layers=8, num_attention_heads=16,
@@ -97,12 +111,33 @@ def main():
     S = int(os.environ.get("BENCH_S", S))
     mfu, tokens_per_sec, n_params, loss = _measure(cfg, B, S, steps, warmup)
 
+    out = {
+        "metric": "llama_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": f"MFU, 509M-proxy model (tokens/s={tokens_per_sec:.0f}, "
+                f"params={n_params/1e6:.0f}M, B={B}, S={S}, loss={loss:.3f})",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+    if not on_tpu:
+        out["unit"] = (f"MFU, CPU smoke config — NOT a TPU number "
+                       f"(tokens/s={tokens_per_sec:.0f}, params={n_params/1e6:.1f}M)")
+        err = os.environ.get("_PADDLE_TPU_BENCH_TPU_ERROR")
+        if err:
+            out["error"] = f"TPU backend unavailable after retries: {err[:400]}"
+    # checkpoint the headline result so the parent can salvage it if the
+    # optional large-config run below blows the child's wall-clock budget
+    partial_path = os.environ.get("_PADDLE_TPU_BENCH_PARTIAL")
+    if partial_path:
+        with open(partial_path, "w") as f:
+            f.write(json.dumps(out))
+
     extra = {}
     # only attempt the larger config if the headline left ample budget —
     # losing the 509M number to a child timeout would be worse than missing
     # the extra metric
+    child_budget = float(os.environ.get("_PADDLE_TPU_BENCH_CHILD_BUDGET", "600"))
     if (on_tpu and os.environ.get("BENCH_SKIP_LARGE") != "1"
-            and time.perf_counter() - t_start < 240):
+            and time.perf_counter() - t_start < child_budget - 300):
         # second metric: largest-fitting config (~1.3B, remat on) — closer to
         # the 8B north star's arithmetic intensity than the 509M proxy
         try:
@@ -118,60 +153,171 @@ def main():
         except Exception as e:  # OOM etc. — headline metric still reports
             extra = {"mfu_1p3b_remat_error": str(e)[:200]}
 
-    out = {
-        "metric": "llama_train_mfu_1chip",
-        "value": round(mfu, 4),
-        "unit": f"MFU, 509M-proxy model (tokens/s={tokens_per_sec:.0f}, "
-                f"params={n_params/1e6:.0f}M, B={B}, S={S}, loss={loss:.3f})",
-        "vs_baseline": round(mfu / 0.40, 4),
-    }
-    if not on_tpu:
-        out["unit"] = (f"MFU, CPU smoke config — NOT a TPU number "
-                       f"(tokens/s={tokens_per_sec:.0f}, params={n_params/1e6:.1f}M)")
-        err = os.environ.get("_PADDLE_TPU_BENCH_TPU_ERROR")
-        if err:
-            out["error"] = f"TPU backend unavailable after retries: {err[:400]}"
     if extra:
         out["extra"] = extra
     print(json.dumps(out))
 
 
-def _run_with_retries() -> int:
-    """Run the workload in child processes; retry TPU backend init with
-    backoff, then fall back to CPU with an explicit error field."""
-    env = dict(os.environ)
-    env["_PADDLE_TPU_BENCH_CHILD"] = "1"
-    # per-attempt budgets: a hung TPU tunnel must not eat the whole round
-    budgets = [int(b) for b in os.environ.get(
-        "BENCH_TIMEOUTS", "600,240").split(",")]
-    last_tail = ""
-    for i, budget in enumerate(budgets):
-        try:
-            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                  env=env, capture_output=True, text=True,
-                                  timeout=budget)
-        except subprocess.TimeoutExpired:
-            last_tail = f"bench child timed out (attempt {i + 1}, {budget}s)"
-            continue
-        sys.stderr.write(proc.stderr[-4000:])
-        if proc.returncode == 0 and '"metric"' in proc.stdout:
-            sys.stdout.write(proc.stdout[proc.stdout.index('{"metric"'):])
-            return 0
-        last_tail = (proc.stderr or proc.stdout)[-800:]
-        time.sleep(10 * (i + 1))
-    # unrecoverable on the requested platform: CPU fallback, error recorded
-    env["JAX_PLATFORMS"] = "cpu"
-    env["_PADDLE_TPU_BENCH_TPU_ERROR"] = " ".join(last_tail.split())[-400:]
+def _probe_tpu(timeout_s: float):
+    """Cheap child: does the TPU backend come up within timeout_s?
+
+    A dead axon tunnel makes `jax.devices()` hang for minutes; probing in a
+    short-timeout subprocess bounds the cost of finding that out to ~90s
+    instead of a full workload budget.  Returns None on success, else a
+    short human-readable failure description (timeout vs no-TPU-devices are
+    distinguished so the final JSON error field points at the real cause)."""
+    code = ("import jax, sys; "
+            "sys.exit(0 if any(d.platform in ('tpu', 'axon') "
+            "for d in jax.devices()) else 3)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f"TPU probe timed out ({timeout_s:.0f}s; tunnel likely down)"
+    if proc.returncode == 0:
+        return None
+    tail = " ".join((proc.stderr or "").split())[-200:]
+    return (f"TPU probe: backend initialized without TPU devices (rc={proc.returncode})"
+            + (f": {tail}" if tail else ""))
+
+
+_JSON_NEEDLE = '{"metric"'
+
+
+def _run_child(env, timeout_s):
+    """Run one bench child; forward its stderr tail.
+
+    Returns (ok, tail): ok=True means the child's JSON line was found and
+    already written to stdout; tail carries the failure description
+    otherwise ('timeout' sentinel for TimeoutExpired)."""
     try:
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, capture_output=True, text=True, timeout=600)
-        sys.stderr.write(proc.stderr[-4000:])
-        if proc.returncode == 0 and '"metric"' in proc.stdout:
-            sys.stdout.write(proc.stdout[proc.stdout.index('{"metric"'):])
-            return 0
-        last_tail = (proc.stderr or proc.stdout)[-800:]
+                              env=env, capture_output=True, text=True,
+                              timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        last_tail = "CPU fallback bench child timed out"
+        return False, "timeout"
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode == 0 and _JSON_NEEDLE in proc.stdout:
+        sys.stdout.write(proc.stdout[proc.stdout.index(_JSON_NEEDLE):])
+        return True, ""
+    return False, (proc.stderr or proc.stdout)[-800:]
+
+
+def _run_with_retries() -> int:
+    """Probe-first bench driver.
+
+    1. Probe the TPU in short-timeout children; keep re-probing (with
+       backoff) inside BENCH_PROBE_WINDOW seconds.
+    2. Once a probe succeeds, commit a workload child with a generous
+       budget (the headline 509M config needs well under it; compile over
+       the tunnel can be slow).  Up to 3 workload attempts, re-probing
+       between failures.
+    3. If no probe ever succeeds, or all attempts fail, fall back to CPU
+       with an explicit "error" field in the JSON.
+    """
+    env = dict(os.environ)
+    env["_PADDLE_TPU_BENCH_CHILD"] = "1"
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # caller explicitly requested the CPU smoke path — don't waste the
+        # budget probing a TPU we've been told not to use, and don't stamp
+        # the result with a misleading "TPU unavailable" error field
+        ok, tail = _run_child(env, float(os.environ.get(
+            "BENCH_TOTAL_BUDGET", "2100")))
+        if not ok:
+            print(json.dumps({"metric": "llama_train_mfu_1chip", "value": 0.0,
+                              "unit": "ERROR: CPU-pinned bench child failed",
+                              "vs_baseline": 0.0,
+                              "error": " ".join(tail.split())[-400:]}))
+        return 0
+    env["_PADDLE_TPU_BENCH_REQUIRE_TPU"] = "1"
+    partial_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_partial.json")
+    env["_PADDLE_TPU_BENCH_PARTIAL"] = partial_path
+    t0 = time.monotonic()
+    total = float(os.environ.get("BENCH_TOTAL_BUDGET", "2100"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    probe_window = float(os.environ.get("BENCH_PROBE_WINDOW", "480"))
+    attempt_budget = float(os.environ.get("BENCH_ATTEMPT_BUDGET", "900"))
+    fallback_reserve = 240.0  # wall-clock kept back for the CPU fallback child
+
+    def _salvage_partial() -> bool:
+        """Emit the headline JSON the child checkpointed before it was
+        killed (e.g. the optional 1.3B run overran the attempt budget)."""
+        try:
+            with open(partial_path) as f:
+                data = json.loads(f.read())
+        except (OSError, ValueError):
+            return False
+        if data.get("metric"):
+            data.setdefault("extra", {})["note"] = \
+                "child died during optional large-config run; headline salvaged"
+            print(json.dumps(data))
+            return True
+        return False
+
+    # a partial left by a PREVIOUS bench run must never be emitted as this
+    # run's result
+    try:
+        os.unlink(partial_path)
+    except OSError:
+        pass
+
+    last_tail = ""
+    attempts = 0
+    probed_ok = False
+    while attempts < 3:
+        remaining = total - (time.monotonic() - t0) - fallback_reserve
+        if remaining < 180:
+            break
+        probe_err = _probe_tpu(min(probe_timeout, remaining))
+        if probe_err is not None:
+            last_tail = probe_err  # most recent probe result is the truest
+            # keep pre-success probing inside the probe window so a dead
+            # tunnel still leaves time for the CPU fallback child
+            if not probed_ok and time.monotonic() - t0 > probe_window:
+                break
+            time.sleep(15)
+            continue
+        probed_ok = True
+        attempts += 1
+        budget = min(attempt_budget, total - (time.monotonic() - t0) - fallback_reserve)
+        if budget < 180:
+            break
+        env["_PADDLE_TPU_BENCH_CHILD_BUDGET"] = str(budget)
+        try:
+            os.unlink(partial_path)
+        except OSError:
+            pass
+        ok, tail = _run_child(env, budget)
+        if ok:
+            return 0
+        # a child killed mid-flight (attempt timeout, or a hard libtpu
+        # SIGKILL/SIGABRT during the optional 1.3B run) after the headline
+        # was checkpointed still counts: the partial is only ever written by
+        # a TPU child that passed the REQUIRE_TPU guard this run
+        if _salvage_partial():
+            return 0
+        last_tail = (f"bench child timed out (attempt {attempts}, {budget:.0f}s)"
+                     if tail == "timeout" else tail)
+        if attempts < 3:
+            time.sleep(10 * attempts)
+    if _salvage_partial():
+        return 0
+    # unrecoverable on the requested platform: CPU fallback, error recorded
+    env.pop("_PADDLE_TPU_BENCH_REQUIRE_TPU", None)
+    env.pop("_PADDLE_TPU_BENCH_CHILD_BUDGET", None)
+    env.pop("_PADDLE_TPU_BENCH_PARTIAL", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_PADDLE_TPU_BENCH_TPU_ERROR"] = (
+        " ".join(last_tail.split())[-400:]
+        or "no TPU attempt fit inside BENCH_TOTAL_BUDGET")
+    fb_budget = max(120.0, min(600.0, total - (time.monotonic() - t0)))
+    ok, tail = _run_child(env, fb_budget)
+    if ok:
+        return 0
+    last_tail = ("CPU fallback bench child timed out" if tail == "timeout"
+                 else tail)
     print(json.dumps({"metric": "llama_train_mfu_1chip", "value": 0.0,
                       "unit": "ERROR: bench failed on TPU and CPU fallback",
                       "vs_baseline": 0.0,
