@@ -11,12 +11,21 @@ TPU-native design: two execution paths.
    It exists for API parity and single-host debugging only.
 
 2. **Compiled pipeline TRAINING** — ``parallel.pipeline_engine.PipelineEngine``
-   is the real PP path: stage-sharded params P("pipe"), the GPipe fill/drain
-   scan (``spmd_pipeline_fn``) under a pipe-manual shard_map, differentiated
-   end-to-end so activation grads ppermute backward stage→stage-1, remat on
-   the stage body for the 1F1B-like memory bound, and the optimizer stepping
-   stage-local shards. Verified weight-parity vs single-device in
-   tests/test_engine_parity.py and exercised by
+   is the real PP path: stage-sharded params P("pipe") under a pipe-manual
+   shard_map, with two schedules:
+   - GPipe (``spmd_pipeline_fn``): fill/drain scan differentiated
+     end-to-end, so activation grads ppermute backward stage→stage-1;
+     remat on the stage body.  Residuals: one boundary activation per tick
+     (O(num_micro) microbatch-sized buffers per stage).
+   - true 1F1B (``spmd_1f1b_train_fn``): loss computed AT the last stage
+     inside the pipe region, backward hand-driven by per-stage ``jax.vjp``
+     in the same scan — each microbatch's backward starts one tick after
+     its forward finishes, and live residuals are bounded by the ring
+     capacity min(2S-1, M) independent of the microbatch count (the
+     reference 1F1B memory property, asserted via compiled
+     memory_analysis in tests/test_engine_parity.py).
+   Both verified weight-parity vs single-device in
+   tests/test_engine_parity.py; exercised by
    ``__graft_entry__.dryrun_multichip``.
 """
 from __future__ import annotations
@@ -204,6 +213,161 @@ def spmd_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
         (act, outputs), _ = jax.lax.scan(tick, (act0, outputs0), jnp.arange(T))
         # only the last stage wrote real values; psum replicates them ring-wide
         return jax.tree_util.tree_map(lambda o: jax.lax.psum(o, axis_name), outputs)
+
+    return per_shard
+
+
+def spmd_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
+                       num_stages: int, num_micro: int,
+                       axis_name: str = "pipe"):
+    """Compiled 1F1B pipeline TRAINING schedule (ref
+    pipeline_parallel.py:117 ``forward_backward_pipeline`` — warmup /
+    steady 1F1B / cooldown).
+
+    Unlike ``spmd_pipeline_fn`` (GPipe order, differentiated end-to-end by
+    ``jax.grad`` through the scan, which stores one boundary activation per
+    tick = O(num_micro) residuals per stage), this schedule computes the
+    loss AT the last stage inside the pipe region and hand-drives the
+    backward with per-stage ``jax.vjp`` inside the same scan, so each
+    microbatch's backward starts one tick after its forward reaches the
+    last stage.  Live residuals per stage are bounded by the ring capacity
+    ``min(2*num_stages - 1, num_micro)`` — independent of ``num_micro``,
+    which is 1F1B's defining memory property.
+
+    Tick chart (S = num_stages, M = num_micro, T = M + 2S - 1 ticks):
+      stage s runs fwd(m)  at tick  t = m + s
+      stage s runs bwd(m)  at tick  t = m + 2S - 1 - s
+    so the last stage (s = S-1) runs bwd(m) exactly one tick after fwd(m),
+    and in steady state every stage does one fwd and one bwd per tick —
+    the lockstep-SPMD rendering of the reference's alternating 1F1B order.
+    Activations ppermute s→s+1, cotangents ppermute s→s-1, each once per
+    tick.
+
+    stage_fn(stage_id, params_shard, x) -> y             (one stage fwd)
+    post_loss_fn(post_params, y, labels_mb) -> scalar    (head + loss,
+        MEAN over the microbatch; the 1/M total-loss scaling is applied
+        here so accumulated grads are grads of the full-batch mean loss)
+
+    per_shard(params_shard, post_params, micro, micro_labels) returns
+      (loss, d_params_shard, d_post_params, d_micro):
+      - loss: full-batch mean loss, replicated
+      - d_params_shard: this stage's param grads (out_specs P(axis) →
+        reassembles the stacked [S, ...] grads)
+      - d_post_params: grads of the post/head params (replicated)
+      - d_micro: grads w.r.t. the microbatched input activations [M, ...]
+        (replicated; caller backpropagates them through the embedding)
+    """
+
+    def per_shard(params_shard, post_params, micro, micro_labels):
+        to_varying = lambda tree: jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
+        micro = to_varying(micro)
+        micro_labels = to_varying(micro_labels)
+        post_params = to_varying(post_params)
+        dev = jax.lax.axis_index(axis_name)
+        S, M = num_stages, num_micro
+        K = min(2 * S - 1, M)  # residual ring capacity — the 1F1B bound
+        T = M + 2 * S - 1
+
+        def fwd_of(p, x):
+            return stage_fn(dev, p, x)
+
+        def scaled_post(pp, y, lb):
+            loss = post_loss_fn(pp, y, lb)
+            return loss / M
+
+        zeros_like_t = lambda tree: jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+        def select(pred, a, b):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(pred, x, y), a, b)
+
+        def tick(carry, t):
+            (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post,
+             d_micro, loss_acc) = carry
+
+            # ---- backward half: consumes last tick's pending cotangent /
+            # the cotangent ppermuted from stage s+1
+            mb_b = t - (2 * S - 1 - dev)
+            valid_b = (mb_b >= 0) & (mb_b < M)
+            slot_b = jnp.clip(mb_b, 0, M - 1) % K
+            x_in = jax.tree_util.tree_map(lambda r: r[slot_b], resid)
+            ct_in = select(dev == S - 1, pending_ct, bwd_grad)
+            _, vjp_fn = jax.vjp(fwd_of, params_shard, x_in)
+            dp, dx = vjp_fn(ct_in)
+            g_stk = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(valid_b, d, 0), g_stk, dp)
+            write0 = valid_b & (dev == 0)
+            mb_c = jnp.clip(mb_b, 0, M - 1)
+            d_micro = jax.tree_util.tree_map(
+                lambda buf, d: buf.at[mb_c].set(
+                    jnp.where(write0, d, buf[mb_c])), d_micro, dx)
+            dx_send = select(valid_b, dx, zeros_like_t(dx))
+
+            # ---- forward half
+            mb_f = t - dev
+            valid_f = (mb_f >= 0) & (mb_f < M)
+            mb_cf = jnp.clip(mb_f, 0, M - 1)
+            mb = jax.tree_util.tree_map(lambda x: x[mb_cf], micro)
+            lb = jax.tree_util.tree_map(lambda x: x[mb_cf], micro_labels)
+            x = select(dev == 0, mb, fwd_act)
+            y = fwd_of(params_shard, x)
+            slot_f = mb_cf % K
+            resid = jax.tree_util.tree_map(
+                lambda r, v: r.at[slot_f].set(
+                    jnp.where(valid_f, v, r[slot_f])), resid, x)
+            # head + loss at the last stage; its value_and_grad seeds the
+            # backward pipeline one tick later via pending_ct
+            take = (dev == S - 1) & valid_f
+            loss_m, (gp, gy) = jax.value_and_grad(
+                scaled_post, argnums=(0, 1))(post_params, y, lb)
+            loss_acc = loss_acc + jnp.where(take, loss_m, 0.0)
+            g_post = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(take, d, 0), g_post, gp)
+            pending_ct = select(take, gy, pending_ct)
+            y_send = select(valid_f, y, zeros_like_t(y))
+
+            # ---- one rotation each way
+            fwd_act = jax.lax.ppermute(
+                y_send, axis_name,
+                [(i, (i + 1) % S) for i in range(S)])
+            bwd_grad = jax.lax.ppermute(
+                dx_send, axis_name,
+                [(i, (i - 1) % S) for i in range(S)])
+            return (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post,
+                    d_micro, loss_acc), None
+
+        act_proto = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]),
+                                           micro)
+        y_shape = jax.eval_shape(lambda a: stage_fn(0, params_shard, a),
+                                 act_proto)
+        zvary = lambda s: jax.lax.pcast(
+            jnp.zeros(tuple(s.shape), s.dtype), (axis_name,), to="varying")
+        y0 = jax.tree_util.tree_map(zvary, y_shape)
+        carry0 = (
+            act_proto,                                   # fwd_act
+            zeros_like_t(act_proto),                     # bwd_grad (dx ~ x)
+            y0,                                          # pending_ct (~ y)
+            jax.tree_util.tree_map(                      # residual ring [K]
+                lambda x: jax.lax.pcast(
+                    jnp.zeros((K,) + tuple(x.shape), x.dtype),
+                    (axis_name,), to="varying"), act_proto),
+            zeros_like_t(params_shard),                  # g_stk
+            zeros_like_t(post_params),                   # g_post
+            jax.tree_util.tree_map(                      # d_micro [M, ...]
+                lambda x: jnp.zeros_like(x), micro),
+            jax.lax.pcast(jnp.float32(0.0), (axis_name,), to="varying"),
+        )
+        (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post, d_micro,
+         loss_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        # loss / post grads / input grads live on one stage only; psum
+        # replicates the sums ring-wide.  Stage param grads stay per-shard.
+        loss = jax.lax.psum(loss_acc, axis_name)
+        g_post = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis_name), g_post)
+        d_micro = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis_name), d_micro)
+        return loss, g_stk, g_post, d_micro
 
     return per_shard
 

@@ -31,6 +31,14 @@ TPU-native design — one compiled SPMD program:
   the semantics of ref ``allreduce_shared_weight_gradients``
   (pipeline_parallel.py:117 steady-state) by construction.
 
+- ``schedule="1f1b"`` replaces the grad-through-scan backward with the true
+  1F1B tick order (``spmd_1f1b_train_fn``): head+loss move INSIDE the pipe
+  region (run at the last stage), the backward is hand-driven with
+  per-stage ``jax.vjp`` in the same scan, and live residuals are bounded by
+  a ring of ``min(2S-1, num_micro)`` boundary activations — the reference
+  1F1B memory property (pipeline_parallel.py:117), which the GPipe order
+  cannot provide (its autodiff stores one residual per tick, O(num_micro)).
+
 The optimizer update runs on the stage-local shards (opt state is sharded
 ``P("pipe")`` like its param), i.e. ZeRO-over-pipe for the block stack.
 """
@@ -68,7 +76,8 @@ class PipelineEngine:
                  optimizer=None, mesh: Optional[Mesh] = None,
                  num_micro: int = 2, remat: bool = True,
                  abstract: bool = False, fsdp: bool = False,
-                 fsdp_axis: str = "sharding", num_chunks: int = 1):
+                 fsdp_axis: str = "sharding", num_chunks: int = 1,
+                 schedule: str = "gpipe"):
         from ..distributed.collective import get_global_mesh
 
         assert optimizer is not None, \
@@ -82,6 +91,10 @@ class PipelineEngine:
         self.num_stages = int(self.mesh.shape["pipe"])
         self.num_micro = num_micro
         self.num_chunks = num_chunks  # >1: interleaved virtual stages
+        assert schedule in ("gpipe", "1f1b"), schedule
+        assert schedule == "gpipe" or num_chunks == 1, \
+            "1f1b schedule does not support interleaved virtual stages yet"
+        self.schedule = schedule
         self.remat = remat
         self._abstract = abstract
         self._layers_prefix = layers_prefix
@@ -218,25 +231,49 @@ class PipelineEngine:
             for n, slots in st.items()}
 
     # ------------------------------------------------------------- train step
+    def _run_blocks(self, blocks, x):
+        """One logical stage: apply ``layers_per_stage`` blocks (pytree with
+        leading [lps] dim), rematerializing internals when remat is on."""
+        lps, block_fn = self.layers_per_stage, self._block_fn
+
+        def body(bs, x):
+            for j in range(lps):
+                x = block_fn({k: v[j] for k, v in bs.items()}, x)
+            return x
+
+        if self.remat:
+            return jax.checkpoint(body)(blocks, x)
+        return body(blocks, x)
+
+    def _stage_fn(self, stage_id, params_shard, x):
+        """shard_map per-shard stage: strip the size-1 pipe-shard dim and run
+        this device's blocks (shared by the GPipe and 1F1B schedules)."""
+        return self._run_blocks({k: v[0] for k, v in params_shard.items()}, x)
+
+    def _apply_update(self, rest, stacked, train, grads, opt_state, lr,
+                      step_count):
+        """Optimizer step + sharding-constraint + reassembly tail, shared by
+        every schedule's step_fn."""
+        new_train, new_state = self.optimizer.pure_update(
+            train, grads, opt_state, lr, step_count + 1)
+        new_train = {
+            n: jax.lax.with_sharding_constraint(
+                v, _sharding_of(self.mesh, self._spec_of(n)))
+            for n, v in new_train.items()}
+        new_rest = {**rest,
+                    **{n: new_train[f"rest.{n}"]
+                       for n in self._rest_trainable}}
+        new_stacked = {**stacked,
+                       **{k: new_train[f"stacked.{k}"]
+                          for k in self._stacked_trainable}}
+        return new_rest, new_stacked, new_state
+
     def _pipeline_apply(self, stacked, acts):
         """acts [B, ...] -> [B, ...] through the pipelined stack."""
         from ..distributed.fleet.meta_parallel.pipeline_parallel import (
             spmd_interleaved_pipeline_fn, spmd_pipeline_fn)
 
-        lps, remat = self.layers_per_stage, self.remat
-        block_fn = self._block_fn
-
-        def run_blocks(blocks, x):
-            # blocks: pytree with leading [lps] dim
-            def body(bs, x):
-                for j in range(lps):
-                    x = block_fn({k: v[j] for k, v in bs.items()}, x)
-                return x
-
-            if remat:
-                return jax.checkpoint(body)(blocks, x)
-            return body(blocks, x)
-
+        run_blocks = self._run_blocks
         B = acts.shape[0]
         assert B % self.num_micro == 0, (B, self.num_micro)
         micro = acts.reshape((self.num_micro, B // self.num_micro) +
@@ -251,18 +288,119 @@ class PipelineEngine:
             fn = spmd_interleaved_pipeline_fn(chunk_fn, self.num_stages,
                                               self.num_micro, self.num_chunks)
         else:
-            def stage_fn(stage_id, params_shard, x):
-                return run_blocks(
-                    {k: v[0] for k, v in params_shard.items()}, x)
-
-            fn = spmd_pipeline_fn(stage_fn, self.num_stages, self.num_micro)
+            fn = spmd_pipeline_fn(self._stage_fn, self.num_stages,
+                                  self.num_micro)
         out = jax.shard_map(
             fn, mesh=self.mesh, in_specs=(P("pipe"), P()), out_specs=P(),
             axis_names=frozenset({"pipe"}))(stacked, micro)
         return out.reshape(acts.shape[:1] + out.shape[2:])
 
+    def _ensure_post_names(self, input_vals, label_vals):
+        """Which rest params does post_fn actually read?  Traced once with
+        abstract values; the resulting name list bounds the per-tick grad
+        accumulators the 1F1B schedule carries for the head/norm params."""
+        if getattr(self, "_post_names", None) is not None:
+            return
+        label_protos = tuple(jax.ShapeDtypeStruct(l.shape, l.dtype)
+                             for l in label_vals)
+        rest_proto = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for n, v in self.rest.items()}
+        acts = jax.eval_shape(
+            lambda rf, *i: self._pre_fn(rf, *i), rest_proto,
+            *(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in input_vals))
+        M = self.num_micro
+        mb_acts = jax.ShapeDtypeStruct(
+            (acts.shape[0] // M,) + acts.shape[1:], acts.dtype)
+        mb_labels = tuple(
+            jax.ShapeDtypeStruct((l.shape[0] // M,) + l.shape[1:], l.dtype)
+            for l in label_protos)
+
+        def f(rf, y, lb):
+            loss = self._post_fn(rf, y, *lb)
+            return loss.value if isinstance(loss, Tensor) else loss
+
+        jaxpr = jax.make_jaxpr(f)(rest_proto, mb_acts, mb_labels).jaxpr
+        used = set()
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                try:
+                    used.add(v)
+                except TypeError:  # unhashable Literal
+                    pass
+        for v in jaxpr.outvars:
+            try:
+                used.add(v)
+            except TypeError:
+                pass
+        # dict flatten order == sorted keys == jaxpr invars prefix order
+        names = sorted(rest_proto)
+        self._post_names = [n for n, var in zip(names, jaxpr.invars)
+                            if var in used]
+
+    def _build_train_step_1f1b(self):
+        """1F1B schedule: loss at the last stage inside the pipe region,
+        hand-driven backward (per-stage vjp in the same scan), O(num_stages)
+        live activations — see ``spmd_1f1b_train_fn``.  Ref:
+        python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117."""
+        from ..distributed.fleet.meta_parallel.pipeline_parallel import (
+            spmd_1f1b_train_fn)
+
+        mesh = self.mesh
+        rest_frozen_names = [n for n in self.rest
+                             if n not in self._rest_trainable]
+        S, M = self.num_stages, self.num_micro
+
+        def post_loss(pp, y, lb):
+            loss = self._post_fn(pp, y, *lb)
+            v = loss.value if isinstance(loss, Tensor) else loss
+            return v.astype(jnp.float32)
+
+        fn = spmd_1f1b_train_fn(self._stage_fn, post_loss, S, M)
+        post_names = self._post_names
+
+        def step_fn(rest, stacked, opt_state, step_count, lr, inputs, labels):
+            frozen = {n: rest[n] for n in rest_frozen_names}
+            train = self._merged_trainable(rest, stacked)
+            rest_full = {**frozen,
+                         **{n: train[f"rest.{n}"] for n in self._rest_trainable}}
+            stk = {k: train[f"stacked.{k}"] for k in self._stacked_trainable}
+            with mesh_context(mesh):
+                acts, pre_vjp = jax.vjp(
+                    lambda rf: self._pre_fn(rf, *inputs), rest_full)
+                B = acts.shape[0]
+                assert B % M == 0, (B, M)
+                micro = acts.reshape((M, B // M) + acts.shape[1:])
+                micro_labels = jax.tree_util.tree_map(
+                    lambda l: l.reshape((M, B // M) + l.shape[1:]), labels)
+                post_params = {n: rest_full[n] for n in post_names}
+                loss, g_stk, g_post, d_micro = jax.shard_map(
+                    fn, mesh=mesh,
+                    in_specs=(P("pipe"), P(), P(), P()),
+                    out_specs=(P(), P("pipe"), P(), P()),
+                    axis_names=frozenset({"pipe"}))(
+                        stk, post_params, micro, micro_labels)
+                (d_rest_pre,) = pre_vjp(d_micro.reshape(acts.shape))
+            grads = {}
+            for n in self._rest_trainable:
+                g = d_rest_pre[n]
+                if n in g_post:
+                    g = g + g_post[n]
+                grads[f"rest.{n}"] = g
+            for k in self._stacked_trainable:
+                grads[f"stacked.{k}"] = g_stk[k]
+            new_rest, new_stacked, new_state = self._apply_update(
+                rest, stacked, train, grads, opt_state, lr, step_count)
+            return new_rest, new_stacked, new_state, step_count + 1, loss
+
+        self._train_step = jax.jit(step_fn)
+        return self._train_step
+
     def build_train_step(self):
-        opt = self.optimizer
+        if self.schedule == "1f1b":
+            assert getattr(self, "_post_names", None) is not None, \
+                "1f1b build needs input shapes: call train_batch/" \
+                "lower_train_step (they trace post_fn's param usage first)"
+            return self._build_train_step_1f1b()
         mesh = self.mesh
         rest_frozen_names = [n for n in self.rest
                              if n not in self._rest_trainable]
@@ -282,17 +420,8 @@ class PipelineEngine:
 
             train = self._merged_trainable(rest, stacked)
             loss, grads = jax.value_and_grad(loss_of)(train)
-            new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
-                                                   step_count + 1)
-            new_train = {
-                n: jax.lax.with_sharding_constraint(
-                    v, _sharding_of(mesh, self._spec_of(n)))
-                for n, v in new_train.items()}
-            new_rest = {**rest,
-                        **{n: new_train[f"rest.{n}"] for n in self._rest_trainable}}
-            new_stacked = {**stacked,
-                           **{k: new_train[f"stacked.{k}"]
-                              for k in self._stacked_trainable}}
+            new_rest, new_stacked, new_state = self._apply_update(
+                rest, stacked, train, grads, opt_state, lr, step_count)
             return new_rest, new_stacked, new_state, step_count + 1, loss
 
         self._train_step = jax.jit(step_fn, static_argnums=())
@@ -301,6 +430,8 @@ class PipelineEngine:
     def lower_train_step(self, inputs, labels):
         """AOT-lower (abstract mode) for partitioning validation at scale."""
         if self._train_step is None:
+            if self.schedule == "1f1b":
+                self._ensure_post_names(inputs, labels)
             self.build_train_step()
         return self._train_step.lower(self.rest, self.stacked, self.opt_state,
                                       self._step_count, jnp.float32(0.0),
@@ -308,11 +439,13 @@ class PipelineEngine:
 
     def train_batch(self, *batch):
         """batch = (*inputs, labels); returns host loss Tensor."""
-        if self._train_step is None:
-            self.build_train_step()
         vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
                      for b in batch)
         inputs, labels = vals[:-1], vals[-1:]
+        if self._train_step is None:
+            if self.schedule == "1f1b":
+                self._ensure_post_names(inputs, labels)
+            self.build_train_step()
         lr = self.optimizer.get_lr()
         (self.rest, self.stacked, self.opt_state, self._step_count,
          loss) = self._train_step(self.rest, self.stacked, self.opt_state,
@@ -348,8 +481,8 @@ class PipelineEngine:
 
 def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
                           remat: bool = True, abstract: bool = False,
-                          fsdp: bool = False, num_chunks: int = 1
-                          ) -> PipelineEngine:
+                          fsdp: bool = False, num_chunks: int = 1,
+                          schedule: str = "gpipe") -> PipelineEngine:
     """Wire a ``LlamaForCausalLM`` into the pipeline engine: embedding before
     the pipe region, decoder blocks inside, final-norm + lm-head + CE after.
     Tied embeddings (cfg.tie_word_embeddings) share one array across both
@@ -392,13 +525,13 @@ def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
     return PipelineEngine(lm, layers, "model.layers", pre_fn, block_fn, post_fn,
                           optimizer=optimizer, mesh=mesh, num_micro=num_micro,
                           remat=remat, abstract=abstract, fsdp=fsdp,
-                          num_chunks=num_chunks)
+                          num_chunks=num_chunks, schedule=schedule)
 
 
 def gpt_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
                         remat: bool = True, abstract: bool = False,
-                        fsdp: bool = False, num_chunks: int = 1
-                        ) -> PipelineEngine:
+                        fsdp: bool = False, num_chunks: int = 1,
+                        schedule: str = "gpipe") -> PipelineEngine:
     """Wire a ``GPTForCausalLM`` into the pipeline engine (second model
     family through the same generic pre/block/post decomposition): token+pos
     embedding before the pipe region, GPT blocks inside, final LayerNorm +
@@ -436,4 +569,4 @@ def gpt_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
     return PipelineEngine(model, layers, "transformer.h", pre_fn, block_fn,
                           post_fn, optimizer=optimizer, mesh=mesh,
                           num_micro=num_micro, remat=remat, abstract=abstract,
-                          fsdp=fsdp, num_chunks=num_chunks)
+                          fsdp=fsdp, num_chunks=num_chunks, schedule=schedule)
