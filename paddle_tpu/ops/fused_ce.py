@@ -27,6 +27,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _vma_zeros(shape, dtype, operands):
+    """Zero scan-carry whose varying-manual-axis type is the UNION of the
+    operands' (shard_map check_vma): a fresh jnp constant would be unvarying
+    and fail scan's carry type check when any operand is varying (e.g. the
+    pipe-manual 1F1B region).  Value-independent — never mixes operand
+    values into the zero, so non-finite garbage at masked positions cannot
+    poison the carry."""
+    from .flash_attention import _vma_of
+
+    z = jnp.zeros(shape, dtype)
+    vma = _vma_of(*operands)
+    return jax.lax.pcast(z, tuple(vma), to="varying") if vma else z
+
+
 def _chunk(h2, labels, chunk_size, ignore_index):
     """Pad [N,H]/[N] to a multiple of chunk_size and reshape to chunks."""
     n = h2.shape[0]
@@ -60,7 +74,9 @@ def _flce_scan(h2, w, labels, ignore_index, chunk_size):
         return (s_loss + loss.sum().astype(jnp.float32),
                 s_cnt + valid.sum().astype(jnp.int32)), lse
 
-    return lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), (hc, lc))
+    z_loss = _vma_zeros((), jnp.float32, (h2, w, labels))
+    z_cnt = _vma_zeros((), jnp.int32, (h2, w, labels))
+    return lax.scan(body, (z_loss, z_cnt), (hc, lc))
 
 
 def _flce_fwd(h2, w, labels, ignore_index, chunk_size):
@@ -84,10 +100,15 @@ def _flce_bwd(ignore_index, chunk_size, res, g):
         onehot = jax.nn.one_hot(li, v, dtype=jnp.float32)
         dlog = jnp.where(valid, (p - onehot) * scale, 0.0)
         dh_k = jnp.dot(dlog.astype(w.dtype), w.T).astype(hk.dtype)
-        dw = dw + jnp.dot(hk.astype(jnp.float32).T, dlog)
+        # mask ignored rows' activations before the token-contraction: the
+        # dot sums hk[t]*dlog[t] over t, and inf*0 at a masked row would
+        # NaN-poison every dw entry
+        hk_safe = jnp.where(valid, hk.astype(jnp.float32), 0.0)
+        dw = dw + jnp.dot(hk_safe.T, dlog)
         return dw, dh_k
 
-    dw, dhc = lax.scan(body, jnp.zeros(w.shape, jnp.float32), (hc, lc, lses))
+    dw0 = _vma_zeros(w.shape, jnp.float32, (h2, w, labels, lses, g))
+    dw, dhc = lax.scan(body, dw0, (hc, lc, lses))
     dh2 = dhc.reshape(-1, h2.shape[-1])[: h2.shape[0]]
     return dh2, dw.astype(w.dtype), None
 

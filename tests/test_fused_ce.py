@@ -99,3 +99,31 @@ def test_engine_model_computes_loss():
     l0 = float(eng.train_batch(ids, lbl))
     l1 = float(eng.train_batch(ids, lbl))
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_masked_rows_tolerate_nonfinite_activations():
+    """ignore_index rows must stay masked even when their activations are
+    garbage (inf/nan at padded positions): the scan-carry zeros are
+    value-independent (_vma_zeros), so non-finite inputs at masked tokens
+    cannot poison the loss or grads."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    rng = np.random.RandomState(0)
+    T, H, V = 8, 16, 32
+    h = rng.randn(T, H).astype(np.float32)
+    h[0] = np.inf  # garbage at a masked position
+    w = rng.randn(H, V).astype(np.float32) * 0.1
+    labels = rng.randint(0, V, (T,)).astype(np.int64)
+    labels[0] = -100
+
+    loss, grads = jax.value_and_grad(
+        lambda hh, ww: fused_linear_cross_entropy(
+            jnp.asarray(hh), ww, jnp.asarray(labels), chunk_size=4),
+        argnums=(0, 1))(h, jnp.asarray(w))
+    assert np.isfinite(float(loss))
+    assert bool(jnp.all(jnp.isfinite(grads[1]))), "dw poisoned"
+    assert bool(jnp.all(jnp.isfinite(np.asarray(grads[0])[1:]))), \
+        "valid-row dh poisoned"
