@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -36,13 +35,12 @@ def _lib():
     global _LIB
     with _LIB_LOCK:
         if _LIB is None:
-            root = os.path.join(os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))), "csrc")
-            so = os.path.join(root, "libfleet_executor.so")
-            if not os.path.exists(so):
-                subprocess.check_call(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", so,
-                     os.path.join(root, "fleet_executor.cpp"), "-lpthread"])
+            from ..utils.native_build import ensure_lib
+
+            so = ensure_lib("fleet_executor")
+            if so is None:
+                raise RuntimeError(
+                    "could not build csrc/fleet_executor.cpp (g++ missing?)")
             lib = ctypes.CDLL(so)
             lib.pt_carrier_create.restype = ctypes.c_int64
             lib.pt_carrier_add_task.restype = ctypes.c_int64
@@ -227,18 +225,33 @@ def _bus_abort(job: str, code: int) -> int:
 def _bus_deliver(job: str, dst: int, mtype: int, src: int, step: int) -> int:
     """RPC endpoint: runs on the destination worker, injects the message
     into its live carrier. Waits briefly for the carrier if the sender's
-    run() raced ahead of ours (messages must not be lost)."""
+    run() raced ahead of ours (messages must not be lost).
+
+    Wait budgets (seconds, env-tunable): an executor that EXISTS but hasn't
+    entered run() gets ``PADDLE_TPU_BUS_WAIT`` (default 60); a job id with
+    no executor registered at all gets only ``PADDLE_TPU_BUS_GRACE``
+    (default 20 — covers first-use .so compile + import skew) for the
+    construction race, then fails fast with -2 so a
+    misconfigured placement doesn't pin an RPC worker thread for a minute
+    per message."""
     import time as _t
 
-    for _ in range(600):  # up to 60s
+    wait = float(os.environ.get("PADDLE_TPU_BUS_WAIT", "60"))
+    grace = float(os.environ.get("PADDLE_TPU_BUS_GRACE", "20"))
+    t0 = _t.monotonic()
+    while True:
         exe = _DIST_EXECUTORS.get(job)
         if exe is not None and exe._handle is not None:
             return int(_lib().pt_carrier_notify(exe._handle, dst, mtype,
                                                 src, step))
         if exe is not None and exe._completed:
             return 0  # stale message after completion: drop cleanly
+        elapsed = _t.monotonic() - t0
+        if exe is None and elapsed > grace:
+            return -2  # unknown job here: placement mismatch, fail fast
+        if elapsed > wait:
+            return -1
         _t.sleep(0.1)
-    return -1
 
 
 class DistributedFleetExecutor(FleetExecutor):

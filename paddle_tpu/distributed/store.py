@@ -11,64 +11,24 @@ shared object is missing (fresh checkout, no toolchain) a pure-Python
 from __future__ import annotations
 
 import ctypes
-import os
 import threading
 import time
 from typing import Optional, Tuple
 
-_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libtcpstore.so"))
 _lib = None
 _lib_lock = threading.Lock()
 
 
-def _compile_to(src: str, out_path: str) -> bool:
-    """Compile to a temp file in the destination dir, then atomically rename —
-    concurrent ranks racing on first use must never CDLL a half-written .so."""
-    import subprocess
-    import tempfile
-
-    tmp = None
-    try:
-        fd, tmp = tempfile.mkstemp(suffix=".so",
-                                   dir=os.path.dirname(out_path))
-        os.close(fd)
-        subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                        "-o", tmp, src, "-lpthread"],
-                       check=True, capture_output=True)
-        os.replace(tmp, out_path)  # atomic on POSIX
-        return True
-    except Exception:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        return False
-
-
 def _load():
+    from ..utils.native_build import ensure_lib
+
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_CSRC, "tcp_store.cpp")
-        path = _LIB_PATH
-        stale = (os.path.exists(path) and os.path.exists(src) and
-                 os.path.getmtime(src) > os.path.getmtime(path))
-        if stale:
-            _compile_to(src, path)  # refresh; on failure keep the old binary
-        if not os.path.exists(path):
-            if not os.path.exists(src):
-                return None
-            if not _compile_to(src, path):
-                # package dir may be read-only: build into a cache dir
-                cache = os.path.join(os.path.expanduser("~"), ".cache",
-                                     "paddle_tpu")
-                os.makedirs(cache, exist_ok=True)
-                path = os.path.join(cache, "libtcpstore.so")
-                if not os.path.exists(path) and not _compile_to(src, path):
-                    return None
+        path = ensure_lib("tcp_store")
+        if path is None:
+            return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:

@@ -180,7 +180,8 @@ def export_model(layer, example_inputs: Sequence[Any], path: str):
     return _write_artifact(fn, params, example_inputs, path)
 
 
-def export_quantized_model(layer, example_inputs: Sequence[Any], path: str):
+def export_quantized_model(layer, example_inputs: Sequence[Any], path: str,
+                           quantizable=None, skip_patterns=None):
     """Quantized-program export (the reference's int8 quantizer pipeline,
     ref inference/api/mkldnn_quantizer.cc, done the TPU way): serialized
     params are per-output-channel INT8 weights, and the traced StableHLO
@@ -193,22 +194,33 @@ def export_quantized_model(layer, example_inputs: Sequence[Any], path: str):
 
     from ..static.quantization import channelwise_quant_int8
 
+    from ..static.quantization import (channelwise_quant_int8,
+                                       select_quantizable)
+
     layer.eval()
     params = state_values(layer)
+    np_params = {n: np.asarray(v) for n, v in params.items()}
+    # scope: >=2D floating parameters (not buffers), embedding-family names
+    # excluded by default — mirror of quant_post_static's quantizable_op_type
+    # contract; override with quantizable=/skip_patterns=
+    to_quant = select_quantizable(
+        np_params, quantizable=quantizable, skip_patterns=skip_patterns,
+        param_names={n for n, _ in layer.named_parameters()})
     qparams: Dict[str, Any] = {}
     scales: Dict[str, Any] = {}
-    for name, v in params.items():
-        arr = np.asarray(v)
-        # jnp.issubdtype (not np.): bfloat16 is outside numpy's floating
-        # hierarchy but is exactly the dtype this export targets
-        if arr.ndim >= 2 and jnp.issubdtype(arr.dtype, jnp.floating):
+    for name, arr in np_params.items():
+        if name in to_quant:
             q, sc, bshape = channelwise_quant_int8(
                 arr.astype(np.float32) if arr.dtype != np.float32 else arr)
             qparams[name] = q
             scales[name] = (jnp.asarray(sc.reshape(bshape)), arr.dtype)
         else:
             qparams[name] = arr
-    assert scales, "no quantizable (>=2D floating) weights found"
+    assert scales, (
+        "no quantizable weights: every >=2D floating parameter was excluded "
+        "by the default scope (embedding-family names and buffers are "
+        "skipped) — pass quantizable=[names]/predicate or skip_patterns=() "
+        "to widen it")
 
     def fn(qp, *args):
         deq = {}

@@ -8,8 +8,6 @@ Ref: paddle/fluid/framework/data_feed.cc + fluid/dataloader worker stack.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
@@ -17,22 +15,12 @@ import numpy as np
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
-_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 
 
 def _build_lib() -> Optional[str]:
-    src = os.path.abspath(os.path.join(_CSRC, "ptio.cpp"))
-    out = os.path.abspath(os.path.join(_CSRC, "libptio.so"))
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-        return out
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out, src,
-             "-lpthread"],
-            check=True, capture_output=True, timeout=180)
-        return out
-    except (OSError, subprocess.SubprocessError):
-        return None
+    from ..utils.native_build import ensure_lib
+
+    return ensure_lib("ptio")
 
 
 def get_lib():

@@ -235,9 +235,31 @@ def _iterable_worker_loop(dataset, result_q, worker_id, num_workers, seed,
 
 
 def _mp_context():
+    """Start-method policy: ``fork`` is the fast path but is unsafe once the
+    parent is multi-threaded (JAX/XLA runtime threads, the elastic heartbeat
+    — CPython itself deprecates fork-after-threads and children can deadlock
+    on locks held by threads that don't survive the fork), so default to
+    ``forkserver`` in that case.  ``PADDLE_TPU_MP_START`` overrides either
+    way."""
     import multiprocessing as mp
+    import threading
 
-    return mp.get_context(os.environ.get("PADDLE_TPU_MP_START", "fork"))
+    def _xla_backend_up() -> bool:
+        # XLA's runtime threads are C++ threads invisible to
+        # threading.active_count(); an initialized backend is the signal.
+        # Merely importing jax starts nothing, so light scripts keep fork.
+        try:
+            from jax._src import xla_bridge
+
+            return bool(xla_bridge._backends)
+        except Exception:
+            return False
+
+    method = os.environ.get("PADDLE_TPU_MP_START")
+    if method is None:
+        threaded = threading.active_count() > 1 or _xla_backend_up()
+        method = "forkserver" if threaded else "fork"
+    return mp.get_context(method)
 
 
 class MapWorkerPool:
@@ -246,6 +268,7 @@ class MapWorkerPool:
 
     def __init__(self, dataset, num_workers, worker_init_fn=None, seed=None,
                  use_shm=True, timeout=0):
+        self._alive = False  # before anything that can raise: __del__ safety
         ctx = _mp_context()
         self.num_workers = num_workers
         self.timeout = timeout

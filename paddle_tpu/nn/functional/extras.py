@@ -360,20 +360,40 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     return apply_op(f, logits, labels, logit_lengths, label_lengths)
 
 
-def class_center_sample(label, num_classes, num_samples, group=None):
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        seed=None):
     """Ref class_center_sample op (margin-softmax training): sample
     ``num_samples`` class centers containing every positive class; return
     (remapped labels into the sampled set, sampled class indices). The
     reference unions positives across the model-parallel group; here the
     single-process form (the TP path shards the classifier via GSPMD, which
-    needs no explicit sampling)."""
+    needs no explicit sampling).
+
+    ``seed`` (the reference op accepts one too) makes the negative-center
+    draw deterministic per call; when unset, fresh entropy is drawn from the
+    framework generator each call (fresh negatives every step, yet the whole
+    sequence is reproducible after ``paddle.seed``) and is immune to other
+    global-RNG consumers."""
     lbl = np.asarray(to_array(label)).astype(np.int64).reshape(-1)
     pos = np.unique(lbl)
     if len(pos) >= num_samples:
         sampled = pos
     else:
+        if seed is None:
+            # advance the framework generator: fresh draw per call, still
+            # reproducible as a sequence after paddle.seed
+            import jax as _jax
+
+            from ...framework.random import default_generator
+
+            entropy = np.asarray(_jax.random.key_data(
+                default_generator().next_key())).ravel().tolist()
+        else:
+            entropy = [int(seed)]
+        # local generator: never perturbed by (or perturbing) np.random
+        gen = np.random.default_rng(entropy + [len(pos), num_classes])
         rest = np.setdiff1d(np.arange(num_classes), pos)
-        extra = np.random.permutation(rest)[:num_samples - len(pos)]
+        extra = gen.permutation(rest)[:num_samples - len(pos)]
         sampled = np.sort(np.concatenate([pos, extra]))
     remap = -np.ones(num_classes, np.int64)
     remap[sampled] = np.arange(len(sampled))

@@ -30,10 +30,51 @@ def channelwise_quant_int8(arr):
     return q, scale.astype(np.float32), bshape
 
 
+# names that are almost never int8-safe: embedding/lookup tables degrade
+# accuracy well beyond the reference contract (its quant_post_static
+# restricts quantization to a quantizable_op_type list — conv/mul/matmul
+# weights; ref static/quantization/post_training_quantization.py)
+DEFAULT_SKIP_PATTERNS = ("embed", "wte", "wpe", "pos_emb", "position",
+                         "lookup_table", "rotary")
+
+
+def select_quantizable(state, quantizable=None, skip_patterns=None,
+                       param_names=None):
+    """Which entries of ``state`` (name -> array) get int8-quantized.
+
+    - ``quantizable``: explicit override — iterable of names or a
+      ``name -> bool`` predicate (mirrors the reference's
+      quantizable_op_type allow-list).
+    - default: >=2D floating PARAMETERS (``param_names`` excludes
+      registered buffers when the caller has a live Layer) whose name does
+      not match ``skip_patterns`` (default: embedding-family names).
+    """
+    import jax.numpy as jnp
+
+    if quantizable is not None:
+        if callable(quantizable):
+            return {n for n in state if quantizable(n)}
+        return set(quantizable) & set(state)
+    pats = tuple(p.lower() for p in
+                 (DEFAULT_SKIP_PATTERNS if skip_patterns is None
+                  else skip_patterns))
+    out = set()
+    for name, arr in state.items():
+        if arr.ndim < 2 or not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if param_names is not None and name not in param_names:
+            continue  # registered buffer, not a weight
+        if any(p in name.lower() for p in pats):
+            continue
+        out.add(name)
+    return out
+
+
 def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
                       sample_generator=None, model=None, model_filename=None,
                       params_filename=None, batch_size=16, batch_nums=8,
-                      algo="abs_max", weight_bits=8, **kwargs):
+                      algo="abs_max", weight_bits=8, quantizable=None,
+                      skip_patterns=None, **kwargs):
     """Post-training quantization driver (ref
     static/quantization/post_training_quantization.py quant_post_static).
 
@@ -41,7 +82,8 @@ def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
 
     - ``model=`` a live Layer (+ optional ``sample_generator``): full PTQ —
       calibrate per-layer activation abs-max scales over ``batch_nums``
-      sample batches, per-channel abs-max quantize every >=2D weight, and
+      sample batches, per-channel abs-max quantize the quantizable >=2D
+      weights (see scope below), and
       write the quantized program to ``quantize_model_path`` (int8 weights +
       fp32 scales + activation ranges).
 
@@ -59,6 +101,13 @@ def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
       plus the ``.pdmodel``/``.stablehlo``/``.pdexport`` manifest files
       copied from the source when loading from ``model_dir``.
     Use :func:`load_quantized_state` to get a dequantized float state_dict.
+
+    Quantization scope: by default only >=2D floating *parameters* (never
+    registered buffers) whose names don't look like embeddings
+    (``DEFAULT_SKIP_PATTERNS``) are quantized — the reference restricts to a
+    quantizable_op_type list (conv/mul/matmul weights) for the same reason.
+    Pass ``quantizable=`` (name list or predicate) to override, or
+    ``skip_patterns=`` to adjust the name filter.
     """
     import pickle
 
@@ -81,13 +130,14 @@ def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
         raise ValueError("pass either model= (live Layer) or model_dir= "
                          "(jit.save artifact prefix)")
 
+    param_names = ({n for n, _ in model.named_parameters()}
+                   if model is not None else None)
+    to_quant = select_quantizable(state, quantizable=quantizable,
+                                  skip_patterns=skip_patterns,
+                                  param_names=param_names)
     qstate, scales = {}, {}
     for name, arr in state.items():
-        import jax.numpy as jnp
-
-        # jnp.issubdtype: bfloat16 models quantize too (bf16 is outside
-        # numpy's floating hierarchy)
-        if arr.ndim >= 2 and jnp.issubdtype(arr.dtype, jnp.floating):
+        if name in to_quant:
             qstate[name], scales[name], _ = channelwise_quant_int8(
                 arr.astype(np.float32) if arr.dtype != np.float32 else arr)
         else:

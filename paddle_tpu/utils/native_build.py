@@ -1,0 +1,67 @@
+"""On-demand builds of the csrc/ shared objects.
+
+The .so binaries are NOT committed to version control (no way to verify a
+blob matches its source); every ctypes loader calls :func:`ensure_lib`,
+which (re)compiles ``csrc/<name>.cpp`` with g++ whenever the built library
+is missing or older than its source, caching the result next to the source
+(or under ``~/.cache/paddle_tpu`` when the tree is read-only).
+
+Atomicity: concurrent ranks racing on first use compile into a temp file in
+the destination directory and ``os.replace`` it — a loader can never CDLL a
+half-written library.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+_CSRC = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                     "csrc"))
+
+
+def _compile_to(src: str, out_path: str, extra: Sequence[str]) -> bool:
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out_path))
+        os.close(fd)
+        subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                        "-o", tmp, src, *extra, "-lpthread"],
+                       check=True, capture_output=True, timeout=300)
+        os.replace(tmp, out_path)  # atomic on POSIX
+        return True
+    except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def ensure_lib(stem: str, extra_flags: Sequence[str] = ()) -> Optional[str]:
+    """Return the path of an up-to-date ``lib<stem>.so`` built from
+    ``csrc/<stem>.cpp``, compiling if missing/stale; None if unbuildable."""
+    src = os.path.join(_CSRC, f"{stem}.cpp")
+    if not os.path.exists(src):
+        return None
+    out = os.path.join(_CSRC, f"lib{stem}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    if _compile_to(src, out, extra_flags):
+        return out
+    if os.path.exists(out):
+        return out  # refresh failed (no g++?): a stale lib beats none
+    # tree may be read-only: build into (or reuse from) a user cache
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+    try:
+        os.makedirs(cache, exist_ok=True)
+    except OSError:
+        return None
+    out = os.path.join(cache, f"lib{stem}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    if _compile_to(src, out, extra_flags):
+        return out
+    return out if os.path.exists(out) else None  # stale cache fallback

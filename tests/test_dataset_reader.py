@@ -259,6 +259,20 @@ class _CountingIterable(paddle.io.IterableDataset):
             yield np.asarray([i], dtype=np.int64)
 
 
+class _EnvProbe(paddle.io.Dataset):
+    """Module-level (picklable): forkserver/spawn workers re-import the test
+    module, so datasets crossing the process boundary cannot be closure-local
+    — same contract as the reference's spawn-mode DataLoader."""
+
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        import os
+
+        return np.asarray([int(os.environ.get("_PT_TEST_WORKER", -1))])
+
+
 def _winit(worker_id):
     import os
 
@@ -295,19 +309,8 @@ class TestMultiprocessDataLoader:
         pool.shutdown()
 
     def test_worker_init_fn_runs_in_child(self):
-        calls = []
-
-        class _Probe(paddle.io.Dataset):
-            def __len__(self):
-                return 4
-
-            def __getitem__(self, i):
-                import os
-
-                return np.asarray([int(os.environ.get("_PT_TEST_WORKER", -1))])
-
         out = [int(np.asarray(x.value)[0][0]) for x in
-               paddle.io.DataLoader(_Probe(), batch_size=1, num_workers=2,
+               paddle.io.DataLoader(_EnvProbe(), batch_size=1, num_workers=2,
                                     worker_init_fn=_winit)]
         assert set(out) <= {0, 1} and -1 not in out
 
