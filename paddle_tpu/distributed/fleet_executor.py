@@ -39,8 +39,11 @@ def _lib():
 
             so = ensure_lib("fleet_executor")
             if so is None:
+                from ..utils import native_build
+
                 raise RuntimeError(
-                    "could not build csrc/fleet_executor.cpp (g++ missing?)")
+                    "could not build csrc/fleet_executor.cpp: "
+                    f"{native_build.LAST_BUILD_ERROR or 'g++ not found'}")
             lib = ctypes.CDLL(so)
             lib.pt_carrier_create.restype = ctypes.c_int64
             lib.pt_carrier_add_task.restype = ctypes.c_int64

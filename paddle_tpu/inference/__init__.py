@@ -192,8 +192,6 @@ def export_quantized_model(layer, example_inputs: Sequence[Any], path: str,
     :func:`load_predictor`."""
     from jax import export as jexport
 
-    from ..static.quantization import channelwise_quant_int8
-
     from ..static.quantization import (channelwise_quant_int8,
                                        select_quantizable)
 

@@ -411,8 +411,25 @@ class DataLoader:
             try:
                 return self._mp_iter()
             except Exception as e:  # unpicklable dataset etc.
+                import os
                 import warnings
 
+                if (os.environ.get("PADDLE_TPU_MP_START") is None
+                        and isinstance(e, (AttributeError, TypeError))):
+                    # implicit forkserver rejects closure-local datasets /
+                    # lambdas that the old fork default accepted: retry with
+                    # fork once (the user's risk trade-off, warned)
+                    warnings.warn(
+                        f"dataset not picklable for the forkserver workers "
+                        f"({e!r}); retrying with fork — set "
+                        f"PADDLE_TPU_MP_START to silence", RuntimeWarning)
+                    os.environ["PADDLE_TPU_MP_START"] = "fork"
+                    try:
+                        return self._mp_iter()
+                    except Exception as e2:
+                        e = e2
+                    finally:
+                        del os.environ["PADDLE_TPU_MP_START"]
                 warnings.warn(
                     f"multiprocess DataLoader workers unavailable ({e!r}); "
                     f"falling back to single-thread prefetch", RuntimeWarning)

@@ -34,8 +34,8 @@ def channelwise_quant_int8(arr):
 # accuracy well beyond the reference contract (its quant_post_static
 # restricts quantization to a quantizable_op_type list — conv/mul/matmul
 # weights; ref static/quantization/post_training_quantization.py)
-DEFAULT_SKIP_PATTERNS = ("embed", "wte", "wpe", "pos_emb", "position",
-                         "lookup_table", "rotary")
+DEFAULT_SKIP_PATTERNS = ("embed", "wte", "wpe", "pos_emb", "lookup_table",
+                         "rotary")
 
 
 def select_quantizable(state, quantizable=None, skip_patterns=None,

@@ -5,8 +5,9 @@ analogue of the reference's math-op monkey patches
 (ref: python/paddle/fluid/dygraph/math_op_patch.py)."""
 from __future__ import annotations
 
-from . import attribute, creation, einsum as _einsum_mod, linalg, logic, manipulation, math, \
-    random, search, stat
+from . import array, attribute, creation, einsum as _einsum_mod, linalg, logic, manipulation, \
+    math, random, search, stat
+from .array import array_length, array_read, array_write, create_array, create_tensor
 from .attribute import imag, rank, real, shape
 from .creation import (arange, assign, clone, complex, diag, diagflat, empty, empty_like, eye,
                        full, full_like, linspace, logspace, meshgrid, ones, ones_like, polar,
@@ -22,7 +23,7 @@ from .logic import (allclose, bitwise_and, bitwise_left_shift, bitwise_not, bitw
                     greater_than, is_empty, is_tensor, isclose, less_equal, less_than,
                     logical_and, logical_not, logical_or, logical_xor, not_equal)
 from .manipulation import (as_complex, as_real, broadcast_tensors, broadcast_to, chunk, concat,
-                           crop, expand, expand_as, flatten, flip, gather, gather_nd,
+                           crop, expand, expand_as, flatten, flatten_, flip, gather, gather_nd,
                            index_add, index_put, index_sample, index_select, masked_fill,
                            masked_scatter, masked_select, moveaxis, pad, put_along_axis,
                            repeat_interleave, reshape, reshape_, roll, rot90, scatter, scatter_,
@@ -41,6 +42,9 @@ from .math import (abs, acos, acosh, add, addmm, all, amax, amin, angle, any, as
                    nansum, neg, nextafter, outer, pow, prod, rad2deg, real, reciprocal,
                    remainder, renorm, round, rsqrt, scale, sigmoid, sign, sin, sinh, sqrt,
                    square, stanh, subtract, sum, take, tan, tanh, trace, trapezoid, trunc)
+from .manipulation import put_along_axis_
+from .math import (add_, ceil_, clip_, erfinv_, exp_, floor_, lerp_, reciprocal_, remainder_,
+                   round_, rsqrt_, scale_, sqrt_, subtract_)
 from .random import (bernoulli, bernoulli_, binomial, exponential_, gaussian, multinomial,
                      normal, normal_, poisson, rand, randint, randint_like, randn, randperm,
                      standard_gamma, standard_normal, uniform, uniform_)
@@ -128,6 +132,9 @@ def _patch_tensor_methods():
         "unbind" if hasattr(this, "unbind") else "unstack", "unfold", "unique",
         "unique_consecutive", "unsqueeze", "unstack", "var", "view", "view_as", "where",
         "bernoulli_", "exponential_", "normal_", "uniform_", "tan", "acos",
+        "add_", "subtract_", "ceil_", "clip_", "erfinv_", "exp_", "floor_",
+        "lerp_", "reciprocal_", "remainder_", "round_", "rsqrt_", "scale_",
+        "sqrt_", "flatten_", "put_along_axis_",
     ]
     for nm in method_names:
         fn = getattr(this, nm, None)

@@ -1,0 +1,68 @@
+"""TensorArray ops (ref: python/paddle/tensor/array.py — array_length:24,
+array_read:73, array_write:141, create_array:222; creation.py create_tensor).
+
+The reference's LoDTensorArray is a graph-variable holding a list of
+tensors, indexed by scalar tensors inside control flow.  Eagerly (and under
+``paddle_tpu.jit`` tracing, where Python lists are unrolled at trace time) a
+plain Python list of Tensors carries the same semantics, so that is the
+array representation here — writes grow the list, reads index it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["array_length", "array_read", "array_write", "create_array",
+           "create_tensor"]
+
+
+def _idx(i) -> int:
+    import numpy as np
+
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.value))
+    return int(i)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """New TensorArray; optionally seeded from ``initialized_list``
+    (ref array.py:222).  ``dtype`` is advisory — elements keep their own."""
+    out = []
+    if initialized_list is not None:
+        for v in initialized_list:
+            out.append(v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+    return out
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i``, growing the array as needed
+    (ref array.py:141); returns the array."""
+    if array is None:
+        array = []
+    i = _idx(i)
+    if i < len(array):
+        array[i] = x
+    else:
+        while len(array) < i:
+            array.append(None)
+        array.append(x)
+    return array
+
+
+def array_read(array, i):
+    """Read position ``i`` (ref array.py:73)."""
+    return array[_idx(i)]
+
+
+def array_length(array):
+    """Length as an int64 scalar Tensor (ref array.py:24)."""
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """An (empty) tensor variable of ``dtype`` to be filled later, e.g. by
+    ``paddle.assign`` (ref creation.py create_tensor)."""
+    from ..framework.dtype import convert_dtype
+
+    return Tensor(jnp.zeros((), convert_dtype(dtype)))

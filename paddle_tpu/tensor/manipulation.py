@@ -454,3 +454,12 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_shard, v - lo, ignore_value)
 
     return apply_op(f, input)
+
+
+def _inplace_pair():
+    from .math import _make_inplace
+
+    return _make_inplace(flatten), _make_inplace(put_along_axis)
+
+
+flatten_, put_along_axis_ = _inplace_pair()

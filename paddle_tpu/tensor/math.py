@@ -434,3 +434,44 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 def log_normalize(x, axis=-1):
     return apply_op(lambda v: v - jax.scipy.special.logsumexp(v, axis=axis, keepdims=True), x)
+
+
+# ---------------------------------------------------------------------------
+# in-place variants (ref: python/paddle/tensor/math.py *_ APIs /
+# fluid/dygraph/math_op_patch.py): compute out-of-place (XLA arrays are
+# immutable — "in-place" on TPU is a rebind, which XLA turns into buffer
+# reuse via donation), then rebind the Tensor's value and return it.
+# ---------------------------------------------------------------------------
+
+
+def _make_inplace(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def method(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out.value if hasattr(out, "value") else out
+        return x
+
+    method.__name__ = fn.__name__ + "_"
+    method.__qualname__ = fn.__qualname__ + "_"
+    method.__doc__ = (f"In-place variant of :func:`{fn.__name__}` "
+                      f"(rebinds ``x``'s value; ref tensor/math.py "
+                      f"{fn.__name__}_).")
+    return method
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+ceil_ = _make_inplace(ceil)
+clip_ = _make_inplace(clip)
+erfinv_ = _make_inplace(erfinv)
+exp_ = _make_inplace(exp)
+floor_ = _make_inplace(floor)
+lerp_ = _make_inplace(lerp)
+reciprocal_ = _make_inplace(reciprocal)
+remainder_ = _make_inplace(remainder)
+round_ = _make_inplace(round)
+rsqrt_ = _make_inplace(rsqrt)
+scale_ = _make_inplace(scale)
+sqrt_ = _make_inplace(sqrt)

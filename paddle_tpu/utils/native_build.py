@@ -20,24 +20,31 @@ from typing import Optional, Sequence
 _CSRC = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
                                      "csrc"))
 
+#: last g++ failure (stderr tail / exception), for loader error messages
+LAST_BUILD_ERROR: Optional[str] = None
+
 
 def _compile_to(src: str, out_path: str, extra: Sequence[str]) -> bool:
+    global LAST_BUILD_ERROR
     tmp = None
     try:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out_path))
         os.close(fd)
         subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                         "-o", tmp, src, *extra, "-lpthread"],
-                       check=True, capture_output=True, timeout=300)
+                       check=True, capture_output=True, text=True, timeout=300)
         os.replace(tmp, out_path)  # atomic on POSIX
         return True
-    except Exception:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        return False
+    except subprocess.CalledProcessError as e:
+        LAST_BUILD_ERROR = (e.stderr or e.stdout or str(e))[-2000:]
+    except Exception as e:
+        LAST_BUILD_ERROR = repr(e)
+    if tmp is not None:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return False
 
 
 def ensure_lib(stem: str, extra_flags: Sequence[str] = ()) -> Optional[str]:

@@ -568,6 +568,85 @@ def test_bf16_tier(name):
 
 
 # ---------------------------------------------------------------------------
+# in-place variants: value matches the out-of-place op, identity is preserved
+# ---------------------------------------------------------------------------
+
+INPLACE = [
+    # (name, run(p, x) -> same-object Tensor, expected(np_a) -> np array)
+    ("add_", lambda p, x: p.add_(x, t(np.ones(1, "float32"))),
+     lambda a: a + 1.0),
+    ("subtract_", lambda p, x: p.subtract_(x, t(np.ones(1, "float32"))),
+     lambda a: a - 1.0),
+    ("ceil_", lambda p, x: p.ceil_(x), np.ceil),
+    ("clip_", lambda p, x: p.clip_(x, -0.5, 0.5),
+     lambda a: np.clip(a, -0.5, 0.5)),
+    ("erfinv_", lambda p, x: p.erfinv_(x), None),  # domain-prepped below
+    ("exp_", lambda p, x: p.exp_(x), np.exp),
+    ("floor_", lambda p, x: p.floor_(x), np.floor),
+    ("lerp_", lambda p, x: p.lerp_(x, p.zeros_like(x), 0.25),
+     lambda a: a * 0.75),
+    ("reciprocal_", lambda p, x: p.reciprocal_(x), lambda a: 1.0 / a),
+    ("remainder_",
+     lambda p, x: p.remainder_(x, t(np.full(1, 0.7, "float32"))),
+     lambda a: np.mod(a, 0.7)),
+    ("round_", lambda p, x: p.round_(x), None),  # banker's vs half-away
+    ("rsqrt_", lambda p, x: p.rsqrt_(x), lambda a: 1.0 / np.sqrt(a)),
+    ("scale_", lambda p, x: p.scale_(x, 2.0, 1.0), lambda a: a * 2.0 + 1.0),
+    ("sqrt_", lambda p, x: p.sqrt_(x), np.sqrt),
+    ("flatten_", lambda p, x: p.flatten_(x), lambda a: a.reshape(-1)),
+    ("put_along_axis_",
+     lambda p, x: p.put_along_axis_(x, t(np.zeros((1, 1), "int64")),
+                                    t(np.full((1, 1), 9.0, "float32")), 0),
+     None),
+]
+
+# ops whose math domain needs positive / bounded inputs
+_INPLACE_PREP = {
+    "sqrt_": lambda a: np.abs(a) + 0.5,
+    "rsqrt_": lambda a: np.abs(a) + 0.5,
+    "reciprocal_": lambda a: np.abs(a) + 0.5,
+    "erfinv_": lambda a: np.clip(a, -0.9, 0.9),
+}
+
+
+@pytest.mark.parametrize("name,run,expect",
+                         [(r[0], r[1], r[2]) for r in INPLACE],
+                         ids=[r[0] for r in INPLACE])
+def test_inplace_variant(name, run, expect):
+    import paddle_tpu as p
+
+    a = _INPLACE_PREP.get(name, lambda v: v)(A.astype("float32").copy())
+    x = t(a)
+    ident = x
+    out = run(p, x)
+    assert out is ident, f"{name} must return the same Tensor object"
+    if expect is not None:
+        np.testing.assert_allclose(np.asarray(out.value), expect(a),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+    else:
+        assert np.all(np.isfinite(np.asarray(out.value))), name
+
+
+def test_tensor_array_ops():
+    """create_array/array_write/array_read/array_length/create_tensor
+    (ref tensor/array.py) — eager TensorArray semantics."""
+    import paddle_tpu as p
+
+    arr = p.create_array("float32")
+    assert arr == []
+    p.array_write(t(np.zeros(2, "float32")), 0, arr)
+    p.array_write(t(np.ones(2, "float32")), t(np.asarray(2, "int64")), arr)
+    assert int(np.asarray(p.array_length(arr).value)) == 3
+    assert arr[1] is None
+    got = p.array_read(arr, 2)
+    np.testing.assert_allclose(np.asarray(got.value), 1.0)
+    seeded = p.create_array("float32", [np.arange(3, dtype="float32")])
+    assert int(np.asarray(p.array_length(seeded).value)) == 1
+    ct = p.create_tensor("int32")
+    assert str(np.asarray(ct.value).dtype) == "int32"
+
+
+# ---------------------------------------------------------------------------
 # surface completeness gate
 # ---------------------------------------------------------------------------
 
@@ -623,6 +702,9 @@ def test_surface_is_covered():
     covered |= {r[0] for r in sweep1.COMPARE}
     covered |= {r[0] for r in sweep1.REDUCE}
     covered |= {"logical_and", "logical_or", "logical_xor", "logical_not"}
+    covered |= {r[0] for r in INPLACE}
+    covered |= {"create_array", "array_write", "array_read", "array_length",
+                "create_tensor"}
     missing = surface - covered - set(EXEMPT)
     assert not missing, f"ops registered but never swept: {sorted(missing)}"
     stale = set(EXEMPT) & covered
