@@ -1,4 +1,8 @@
-"""paddle.audio parity (ref: python/paddle/audio/ — features + functional)."""
-from . import features, functional
+"""paddle.audio parity (ref: python/paddle/audio/ — features, functional,
+backends; init_backend binds load/save/info onto paddle.audio)."""
+from . import backends, features, functional
+from .backends import (get_current_backend, info, list_available_backends,
+                       load, save, set_backend)
 
-__all__ = ["features", "functional"]
+__all__ = ["features", "functional", "backends", "load", "save", "info",
+           "list_available_backends", "get_current_backend", "set_backend"]

@@ -1,0 +1,40 @@
+"""Audio IO backends (ref: python/paddle/audio/backends/ — backend.py
+AudioInfo:21, wave_backend.py info:37/load:89/save:168, init_backend.py
+list_available_backends:37/get_current_backend:93/set_backend:135).
+
+``load``/``save``/``info`` dispatch through the registry: the stdlib
+``wave`` backend (16-bit PCM WAV) is always available; ``soundfile`` is
+used for other formats when the optional package is installed — mirroring
+the reference's wave_backend / paddleaudio split."""
+from __future__ import annotations
+
+from . import soundfile_backend, wave_backend
+from .init_backend import (get_current_backend, list_available_backends,
+                           set_backend)
+from .wave_backend import AudioInfo
+
+_MODULES = {"wave": wave_backend, "soundfile": soundfile_backend}
+
+
+def _backend():
+    return _MODULES[get_current_backend()]
+
+
+def info(filepath):
+    return _backend().info(filepath)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    return _backend().load(filepath, frame_offset, num_frames, normalize,
+                           channels_first)
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_S",
+         bits_per_sample=16):
+    return _backend().save(filepath, src, sample_rate, channels_first,
+                           encoding, bits_per_sample)
+
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]

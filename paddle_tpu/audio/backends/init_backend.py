@@ -1,0 +1,35 @@
+"""Backend registry (ref: python/paddle/audio/backends/init_backend.py —
+list_available_backends:37, get_current_backend:93, set_backend:135).
+
+"wave" (stdlib, always available) plus "soundfile" when the optional
+package is installed — mirroring the reference's wave_backend /
+paddleaudio split."""
+from __future__ import annotations
+
+from typing import List
+
+_CURRENT = "wave"
+
+
+def list_available_backends() -> List[str]:
+    out = ["wave"]
+    try:
+        import soundfile  # noqa: F401
+
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend() -> str:
+    return _CURRENT
+
+
+def set_backend(backend_name: str):
+    global _CURRENT
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} not available "
+            f"(have {list_available_backends()})")
+    _CURRENT = backend_name

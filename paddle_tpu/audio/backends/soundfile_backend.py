@@ -1,0 +1,48 @@
+"""Optional ``soundfile`` backend (ref: the paddleaudio backend role in
+init_backend.py) — full-format load/save/info when the package exists."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+from .wave_backend import AudioInfo
+
+
+def _sf():
+    import soundfile
+
+    return soundfile
+
+
+def info(filepath) -> AudioInfo:
+    i = _sf().info(filepath)
+    bits = {"PCM_16": 16, "PCM_24": 24, "PCM_32": 32, "PCM_S8": 8,
+            "PCM_U8": 8}.get(i.subtype, 16)
+    return AudioInfo(int(i.samplerate), int(i.frames), int(i.channels),
+                     bits, i.subtype or "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    sf = _sf()
+    stop = None if num_frames < 0 else frame_offset + num_frames
+    data, rate = sf.read(filepath, start=frame_offset, stop=stop,
+                         dtype="float32" if normalize else "int16",
+                         always_2d=True)
+    if channels_first:
+        data = data.T
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.ascontiguousarray(data))), int(rate)
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_S",
+         bits_per_sample=16):
+    a = np.asarray(src.value if isinstance(src, Tensor) else src)
+    if a.ndim == 1:
+        a = a[None, :] if channels_first else a[:, None]
+    if channels_first:
+        a = a.T
+    subtype = {16: "PCM_16", 24: "PCM_24", 32: "PCM_32"}.get(
+        bits_per_sample, "PCM_16")
+    _sf().write(filepath, a, int(sample_rate), subtype=subtype)

@@ -1,0 +1,121 @@
+"""WAV backend on the stdlib ``wave`` module (ref:
+python/paddle/audio/backends/wave_backend.py — info:37, load:89, save:168;
+AudioInfo ref backend.py:21).
+
+16-bit PCM in/out like the reference's wave_backend: ``load`` returns
+float32 in [-1, 1] when ``normalize`` (else raw int16), shaped
+``(channels, frames)`` when ``channels_first``."""
+from __future__ import annotations
+
+import wave
+from typing import Tuple, Union
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+
+class AudioInfo:
+    """Ref backends/backend.py:21."""
+
+    def __init__(self, sample_rate: int, num_frames: int, num_channels: int,
+                 bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def _open(filepath):
+    if hasattr(filepath, "read"):
+        return filepath, False
+    return open(filepath, "rb"), True
+
+
+def info(filepath) -> AudioInfo:
+    """Signal info of a WAV file (ref wave_backend.py:37)."""
+    file_obj, owned = _open(filepath)
+    try:
+        f = wave.open(file_obj)
+    except wave.Error as e:
+        if owned:
+            file_obj.close()
+        raise NotImplementedError(
+            f"only 16-bit PCM WAV is supported by the wave backend ({e}); "
+            f"install soundfile for other formats") from e
+    try:
+        width = f.getsampwidth()
+        # WAV spec: 1-byte samples are unsigned; wider are signed PCM
+        out = AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                        width * 8, "PCM_U" if width == 1 else "PCM_S")
+    finally:
+        if owned:
+            file_obj.close()
+    return out
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Load WAV audio (ref wave_backend.py:89): float32 in [-1,1] when
+    ``normalize`` else int16; (channels, time) when ``channels_first``."""
+    file_obj, owned = _open(filepath)
+    try:
+        try:
+            f = wave.open(file_obj)
+        except wave.Error as e:
+            raise NotImplementedError(
+                f"only 16-bit PCM WAV is supported by the wave backend "
+                f"({e}); install soundfile for other formats") from e
+        channels = f.getnchannels()
+        rate = f.getframerate()
+        width = f.getsampwidth()
+        if width != 2:
+            raise NotImplementedError(
+                f"wave backend reads 16-bit PCM only, got {width * 8}-bit")
+        if frame_offset:
+            f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    finally:
+        if owned:
+            file_obj.close()
+    data = np.frombuffer(raw, dtype="<i2").reshape(-1, channels)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    if channels_first:
+        data = data.T
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.ascontiguousarray(data))), rate
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_S", bits_per_sample: int = 16) -> None:
+    """Save to 16-bit PCM WAV (ref wave_backend.py:168). ``src``:
+    (channels, time) when ``channels_first`` else (time, channels); float
+    input is clipped to [-1, 1] and scaled."""
+    if bits_per_sample != 16 or encoding != "PCM_S":
+        raise NotImplementedError(
+            "wave backend writes 16-bit PCM_S only; install soundfile for "
+            "other encodings")
+    a = np.asarray(src.value if isinstance(src, Tensor) else src)
+    if a.ndim == 1:
+        a = a[None, :] if channels_first else a[:, None]
+    if channels_first:
+        a = a.T  # -> (frames, channels)
+    if np.issubdtype(a.dtype, np.floating):
+        a = (np.clip(a, -1.0, 1.0) * 32767.0).astype("<i2")
+    elif a.dtype == np.int16:
+        a = a.astype("<i2")
+    else:
+        # wider ints would wrap mod 2^16 and write garbage noise
+        raise ValueError(
+            f"wave backend writes int16 or float input, got {a.dtype}; "
+            f"rescale to [-1, 1] float or int16 first")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(a).tobytes())

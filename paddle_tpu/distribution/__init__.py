@@ -12,6 +12,8 @@ from ..framework.core import Tensor, to_array
 from ..framework.dispatch import apply_op
 from ..framework.random import next_key
 
+from . import constraint  # noqa: F401  (ref distribution/constraint.py)
+
 
 def _v(x):
     if isinstance(x, Tensor):
@@ -651,3 +653,66 @@ def _kl_uniform(p, q):
 def _kl_exponential(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(1.0 / r) + r - 1.0)
+
+
+# --------------------------------------------------------------------------- #
+# Independent (ref: python/paddle/distribution/independent.py:18)
+# --------------------------------------------------------------------------- #
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of
+    ``base`` as event dims: log_prob/entropy sum over them (ref
+    independent.py:18)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError(
+                f"Expected type of 'base' is Distribution, got {type(base)}")
+        if not (0 < reinterpreted_batch_rank <= len(base.batch_shape)):
+            raise ValueError(
+                f"Expected 0 < reinterpreted_batch_rank <= "
+                f"{len(base.batch_shape)}, got {reinterpreted_batch_rank}")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cut = len(base.batch_shape) - self._reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:cut], event_shape=shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self._base.log_prob(value),
+                                   self._reinterpreted_batch_rank)
+
+    def entropy(self):
+        return self._sum_rightmost(self._base.entropy(),
+                                   self._reinterpreted_batch_rank)
+
+    def _sum_rightmost(self, value, n):
+        # through apply_op so the tape records the reduction: ELBO-style
+        # training differentiates through Independent.log_prob
+        if n <= 0:
+            return value if isinstance(value, Tensor) else Tensor(value)
+        return apply_op(lambda v: v.sum(tuple(range(-n, 0))), value)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p._reinterpreted_batch_rank != q._reinterpreted_batch_rank:
+        raise NotImplementedError(
+            "KL between Independents of different reinterpreted ranks")
+    inner = kl_divergence(p._base, q._base)
+    return p._sum_rightmost(inner, p._reinterpreted_batch_rank)

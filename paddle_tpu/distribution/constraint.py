@@ -1,0 +1,48 @@
+"""Constraint system (ref: python/paddle/distribution/constraint.py —
+Constraint:17, Real:24, Range:29, Positive:39, Simplex:44).
+
+A constraint is a predicate over parameter/sample space; ``__call__``
+returns a boolean array marking in-support entries.  Distributions use
+these for argument validation (`variable.py` in the reference wires them
+into transformed variables)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Constraint:
+    """Base: callable value -> bool array (ref constraint.py:17)."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return value == value  # finite-dtype NaN check, ref semantics
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower = lower
+        self._upper = upper
+        super().__init__()
+
+    def __call__(self, value):
+        return (self._lower <= value) & (value <= self._upper)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return value >= 0.0
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return jnp.all(value >= 0, -1) & (
+            jnp.abs(value.sum(-1) - 1.0) < 1e-6)
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()

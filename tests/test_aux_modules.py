@@ -384,3 +384,45 @@ class TestMonitor:
         eng.train_batch(x, y)
         eng.train_batch(x, y)
         assert monitor_get("engine_train_steps") == 2
+
+
+class TestAudioBackend:
+    """ref python/paddle/audio/backends/wave_backend.py — save/load/info
+    round-trip on 16-bit PCM WAV."""
+
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        import paddle_tpu as paddle
+
+        sr = 16000
+        tdur = 0.05
+        n = int(sr * tdur)
+        wav = (np.sin(2 * np.pi * 440 * np.arange(n) / sr) * 0.5
+               ).astype("float32")
+        stereo = np.stack([wav, -wav])  # (channels, time)
+        path = str(tmp_path / "t.wav")
+        paddle.audio.save(path, paddle.to_tensor(stereo), sr)
+
+        meta = paddle.audio.info(path)
+        assert (meta.sample_rate, meta.num_channels, meta.num_frames,
+                meta.bits_per_sample) == (sr, 2, n, 16)
+        assert meta.encoding == "PCM_S"
+
+        out, rate = paddle.audio.load(path)
+        assert rate == sr
+        arr = np.asarray(out.value)
+        assert arr.shape == (2, n) and arr.dtype == np.float32
+        np.testing.assert_allclose(arr, stereo, atol=2 / 32768)
+
+        # raw int16, channels_last, offset+count window
+        raw, _ = paddle.audio.load(path, frame_offset=10, num_frames=20,
+                                   normalize=False, channels_first=False)
+        rarr = np.asarray(raw.value)
+        assert rarr.shape == (20, 2) and rarr.dtype == np.int16
+
+    def test_backend_registry(self):
+        import paddle_tpu as paddle
+
+        assert "wave" in paddle.audio.list_available_backends()
+        assert paddle.audio.get_current_backend() == "wave"
+        with pytest.raises(NotImplementedError):
+            paddle.audio.set_backend("nonexistent")

@@ -96,6 +96,51 @@ class TestDistributions:
         assert float(td.log_prob(x).item()) == pytest.approx(
             float(ln.log_prob(x).item()), rel=1e-4)
 
+    def test_independent_reinterprets_batch_as_event(self):
+        """ref distribution/independent.py:18 — log_prob sums the
+        reinterpreted batch dims; KL follows."""
+        from paddle_tpu.distribution import (Independent, Normal,
+                                             kl_divergence)
+
+        base = Normal(paddle.to_tensor([0.0, 1.0]), paddle.to_tensor([1.0, 2.0]))
+        ind = Independent(base, 1)
+        assert ind.batch_shape == () and ind.event_shape == (2,)
+        x = paddle.to_tensor([0.3, -0.2])
+        got = float(ind.log_prob(x).item())
+        want = float(np.asarray(base.log_prob(x).value).sum())
+        assert got == pytest.approx(want, rel=1e-6)
+        ent = float(np.asarray(ind.entropy().value))
+        assert ent == pytest.approx(float(np.asarray(base.entropy().value).sum()),
+                                    rel=1e-6)
+        q = Independent(Normal(paddle.to_tensor([1.0, 0.0]),
+                               paddle.to_tensor([1.0, 1.0])), 1)
+        kl = float(np.asarray(kl_divergence(ind, q).value))
+        kl_base = np.asarray(kl_divergence(
+            base, Normal(paddle.to_tensor([1.0, 0.0]),
+                         paddle.to_tensor([1.0, 1.0]))).value)
+        assert kl == pytest.approx(float(kl_base.sum()), rel=1e-6)
+        with pytest.raises(ValueError):
+            Independent(ind, 1)  # no batch dims left
+        # ELBO-style training: gradients must flow through the reduction
+        xg = paddle.to_tensor([0.3, -0.2], stop_gradient=False)
+        ind.log_prob(xg).backward()
+        assert xg.grad is not None
+        assert np.all(np.isfinite(np.asarray(xg.grad.value)))
+
+    def test_constraints(self):
+        """ref distribution/constraint.py — Real/Range/Positive/Simplex."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.distribution import constraint
+
+        assert bool(constraint.real(jnp.asarray(1.0)))
+        assert not bool(constraint.real(jnp.asarray(float("nan"))))
+        r = constraint.Range(0.0, 1.0)
+        assert bool(r(jnp.asarray(0.5))) and not bool(r(jnp.asarray(1.5)))
+        assert bool(constraint.positive(jnp.asarray(0.0)))
+        assert bool(constraint.simplex(jnp.asarray([0.2, 0.8])))
+        assert not bool(constraint.simplex(jnp.asarray([0.5, 0.9])))
+
     def test_beta_gamma_dirichlet(self):
         from paddle_tpu.distribution import Beta, Dirichlet, Gamma
 
