@@ -247,7 +247,7 @@ def ignore_module(modules):
 
 def _trace_to_exported(layer, input_spec):
     """Trace layer.forward over input_spec into a jax.export Exported
-    (StableHLO) + its param values. Shared by jit.save and onnx.export."""
+    (StableHLO) + its param values; the jit.save export path."""
     from jax import export as jexport
 
     was_training = layer.training

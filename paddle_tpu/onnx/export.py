@@ -1,52 +1,34 @@
-"""ONNX export (ref python/paddle/onnx/export.py export(), which delegates to
-the external paddle2onnx package).
+"""ONNX export — a documented NON-GOAL of this framework (ref
+python/paddle/onnx/export.py export(), which itself only delegates to the
+external paddle2onnx package and raises without it).
 
-TPU-native: the portable interchange format for XLA programs is StableHLO —
-`paddle.jit.save` / `paddle.inference` already export it, and it is what TPU
-serving consumes.  ONNX export is provided for CPU/GPU interop when the
-`onnx` package is installed: the traced jaxpr is converted via jax's
-tf-less exporters if available, else we raise with guidance (the reference
-likewise raises unless paddle2onnx is installed).
+TPU-native rationale: the portable interchange format for XLA programs is
+StableHLO — ``paddle.jit.save`` / ``paddle.inference`` export and consume
+it, and it is what TPU serving runs.  No StableHLO→ONNX converter exists in
+jax, and bundling one is out of scope (README "Non-goals"); this module
+keeps the reference's API surface and failure mode: calling ``export``
+raises with guidance, exactly as the reference does without paddle2onnx.
 """
 from __future__ import annotations
-
-import os
 
 __all__ = []
 
 
 def export(layer, path: str, input_spec=None, opset_version: int = 13,
            **configs):
-    """Export a Layer to ``<path>.onnx`` (ref export.py export()).
+    """API-parity stub (ref export.py export()): always raises.
 
-    Requires the ``onnx`` package (not bundled, mirroring the reference's
-    external paddle2onnx dependency).  For the TPU-native interchange path use
-    ``paddle.jit.save`` (StableHLO), which needs no extra packages.
+    The reference delegates to the external ``paddle2onnx`` package and
+    raises when it is missing; this framework's interchange format is
+    StableHLO (``paddle.jit.save(layer, path)``, batch-polymorphic,
+    loadable by ``paddle.inference``), and ONNX conversion is a documented
+    non-goal (README).
     """
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "paddle.onnx.export requires the 'onnx' package, which is not "
-            "installed in this environment (the reference has the same "
-            "external dependency on paddle2onnx). For TPU-native model "
-            "interchange use paddle.jit.save(layer, path) — it exports "
-            "batch-polymorphic StableHLO loadable by paddle.inference."
-        ) from e
-
-    from ..jit import _trace_to_exported  # jaxpr -> jax.export Exported
-
-    exported, _params = _trace_to_exported(layer, input_spec or [])
-    # With onnx available, go through jax's StableHLO -> ONNX conversion if
-    # present in the environment; otherwise surface the gap explicitly.
-    try:
-        from jax.experimental import export_onnx  # not in all jax versions
-    except ImportError as e:
-        raise NotImplementedError(
-            "this jax build has no StableHLO->ONNX converter; use "
-            "paddle.jit.save for StableHLO export instead") from e
-    model = export_onnx.convert(exported, opset_version=opset_version)
-    out = path if path.endswith(".onnx") else path + ".onnx"
-    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
-    onnx.save(model, out)
-    return out
+    raise NotImplementedError(
+        "ONNX export is a documented non-goal of paddle_tpu (see README "
+        "Non-goals): the XLA-native interchange format is StableHLO. "
+        "Use paddle.jit.save(layer, path) to export batch-polymorphic "
+        "StableHLO loadable by paddle.inference; convert externally if ONNX "
+        "is required (the reference likewise needs the external paddle2onnx "
+        "package)."
+    )
