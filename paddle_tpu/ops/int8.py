@@ -11,8 +11,10 @@ Scheme: symmetric per-output-channel absmax. w ≈ w_q(int8) * scale(f32)[N],
 and since scale is per *column*, dot(x, w_q·scale) == dot(x, w_q) · scale —
 the kernel dots in bf16 (int8 values up to 127 are exact in bf16) and applies
 the scale to the fp32 accumulator. The Pallas kernel streams int8 weight
-blocks through VMEM (half the bytes of the bf16 path); CPU/interpret mode
-falls back to plain jnp.
+blocks through VMEM (half the bytes of the bf16 path); on CPU the plain jnp
+dequant path runs, except under PT_FLASH_INTERPRET=1 where the Pallas
+kernel itself executes interpreted (same gate as flash_attention — CI
+coverage of the kernel logic without a chip).
 """
 from __future__ import annotations
 
@@ -37,6 +39,12 @@ def quantize_per_channel(w) -> Tuple[jax.Array, jax.Array]:
 
 def _use_pallas() -> bool:
     from .flash_attention import _use_pallas as f
+
+    return f()
+
+
+def _interpret() -> bool:
+    from .flash_attention import _interpret as f
 
     return f()
 
@@ -79,6 +87,10 @@ def _w8_matmul_pallas(x2, w_q, scale, out_dtype, block_n: int = 0):
         ],
         out_specs=pl.BlockSpec((M, bn), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        # interpret-mode knob mirrors flash_attention: CPU CI runs the same
+        # kernel logic interpreted (compiled Mosaic lowering is TPU-only and
+        # its error escapes the caller's try/except at jit-compile time)
+        interpret=_interpret(),
     )(x2, w_q, scale.reshape(1, N))
 
 

@@ -89,3 +89,27 @@ class TestInt8Llama:
         m.quantize_int8()
         after = nbytes(m)
         assert after < before * 0.5 * 1.2  # int8 + f32 scales ≈ quarter of f32
+
+
+def test_w8_pallas_kernel_interpreted_matches_jnp():
+    """The Pallas w8 kernel logic itself (BlockSpec maps, scale layout) via
+    interpret mode — the path the TPU tier compiles with Mosaic."""
+    import os
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.int8 import _w8_matmul_pallas, quantize_per_channel
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 256).astype("float32")).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(256, 512).astype("float32") * 0.05)
+    wq, scale = quantize_per_channel(w)
+    os.environ["PT_FLASH_INTERPRET"] = "1"
+    try:
+        got = _w8_matmul_pallas(x, wq, scale, jnp.float32)
+    finally:
+        os.environ.pop("PT_FLASH_INTERPRET", None)
+    want = (x.astype(jnp.float32) @
+            (wq.astype(jnp.float32) * scale[None, :]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-2, atol=1e-2)

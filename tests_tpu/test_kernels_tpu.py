@@ -1,0 +1,166 @@
+"""Compiled-Mosaic kernel correctness on a real chip (VERDICT r2 item 3).
+
+Everything here runs the ACTUAL Pallas kernels (no PT_FLASH_INTERPRET), so
+BlockSpec index maps, VMEM scratch carries, and the GQA head-group mapping
+are exercised as compiled code.  References are plain jnp math in float32.
+
+Tolerances are bf16-realistic: flash outputs compare at ~2e-2 after the
+f32 reference is cast through bf16 inputs.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+B, H, KV, D = 2, 8, 4, 128
+S = 1024
+
+
+def _qkv(seed, s=S, kv=KV, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, s, D).astype("float32")).astype(dtype)
+    k = jnp.asarray(rng.randn(B, kv, s, D).astype("float32")).astype(dtype)
+    v = jnp.asarray(rng.randn(B, kv, s, D).astype("float32")).astype(dtype)
+    return q, k, v
+
+
+def _ref(q, k, v, causal):
+    """f32 dense reference with GQA K/V head repeat."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    if kf.shape[1] != qf.shape[1]:
+        rep = qf.shape[1] // kf.shape[1]
+        kf = jnp.repeat(kf, rep, axis=1)
+        vf = jnp.repeat(vf, rep, axis=1)
+    logits = jnp.einsum("bhsd,bhtd->bhst", qf, kf) / np.sqrt(D)
+    if causal:
+        s = logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    return jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(logits, -1), vf)
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
+def test_flash_fwd_matches_dense_gqa(causal):
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _qkv(0)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal))(q, k, v)
+    want = _ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_flash_bwd_matches_dense_grads():
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _qkv(1)
+
+    def loss_flash(a, b, c):
+        return jnp.sum(flash_attention(a, b, c, True).astype(jnp.float32)
+                       * 0.01)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(_ref(a, b, c, True) * 0.01)
+
+    g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for got, want, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=5e-2, atol=5e-2, err_msg=name)
+
+
+def test_flash_long_sequence_streaming_grid():
+    """S=8192 exercises the streaming grid (VMEM scratch carries across the
+    KV loop) — values vs the dense f32 reference on a slice."""
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = _qkv(2, s=8192, kv=KV)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, True))(q, k, v)
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+    want = _ref(q[:, :, :1024], k[:, :, :1024], v[:, :, :1024], True)
+    np.testing.assert_allclose(np.asarray(out[:, :, :1024], np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_fused_ce_matches_logits_ce():
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    rng = np.random.RandomState(3)
+    T, Hd, V = 512, 256, 4096
+    h = jnp.asarray(rng.randn(T, Hd).astype("float32")).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(Hd, V).astype("float32") * 0.02
+                    ).astype(jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, V, (T,)).astype("int64"))
+    got = jax.jit(lambda a, b: fused_linear_cross_entropy(a, b, labels,
+                                                          chunk_size=128)
+                  )(h, w)
+    logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+    lse = jax.scipy.special.logsumexp(logits, -1)
+    gold = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32),
+                               -1)[:, 0]
+    want = jnp.mean(lse - gold)
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-3)
+
+
+def test_fused_norms_match_reference():
+    from paddle_tpu.ops.fused_norm import fused_layer_norm, fused_rms_norm
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(64, 1024).astype("float32"))
+    wgt = jnp.asarray(rng.randn(1024).astype("float32"))
+    bias = jnp.asarray(rng.randn(1024).astype("float32"))
+
+    got = jax.jit(lambda a, w: fused_rms_norm(a, w))(x, wgt)
+    want = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * wgt
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    got = jax.jit(lambda a, w, b: fused_layer_norm(a, w, b))(x, wgt, bias)
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+    want = (x - mu) * jax.lax.rsqrt(var + 1e-5) * wgt + bias
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_int8_dequant_matmul_close_to_float():
+    from paddle_tpu.ops.int8 import quantize_per_channel, w8_matmul
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(32, 512).astype("float32")).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(512, 1024).astype("float32") * 0.05)
+    wq, scale = quantize_per_channel(w)
+    assert wq.dtype == jnp.int8
+    got = jax.jit(w8_matmul)(x, wq, scale)
+    want = x.astype(jnp.float32) @ w
+    err = np.abs(np.asarray(got, np.float32) - np.asarray(want))
+    rel = err.mean() / np.abs(np.asarray(want)).mean()
+    assert rel < 2e-2, rel
+
+
+def test_tiny_train_step_bf16_loss_decreases():
+    """End-to-end train-step smoke on the chip: flash + fused CE under jit,
+    AdamW, loss decreasing over 3 steps."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+
+    cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=512,
+                      dtype="bfloat16", use_flash_attention=True)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=None)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 512))
+                           .astype("int32"))
+    lbl = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 512))
+                           .astype("int64"))
+    losses = [float(np.asarray(eng.train_batch(ids, lbl).value))
+              for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
