@@ -29,13 +29,48 @@ class Model:
 
     # ------------------------------------------------------------------ prep
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
-        """Ref model.py:1619."""
+        """Ref model.py:1619.  ``amp_configs``: "O1"/"O2" or a dict with
+        ``level`` plus GradScaler/auto_cast knobs (init_loss_scaling,
+        incr/decr ratios, custom_white_list/custom_black_list), matching the
+        reference's _check_amp_configs surface; training then runs under
+        ``paddle.amp.auto_cast`` with dynamic loss scaling."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
         else:
             self._metrics = []
+        # parse/validate FIRST, commit to self only once everything checks
+        # out — a ValueError must not leave the Model half-configured
+        level, white, black, scaler = "O0", None, None, None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                level, cfg = amp_configs, {}
+            else:
+                cfg = dict(amp_configs)
+                level = cfg.pop("level", "O1")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
+            white = cfg.pop("custom_white_list", None)
+            black = cfg.pop("custom_black_list", None)
+            scaler_kw = {k: cfg.pop(k) for k in (
+                "init_loss_scaling", "incr_ratio", "decr_ratio",
+                "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                "use_dynamic_loss_scaling") if k in cfg}
+            if cfg:
+                raise ValueError(f"unknown amp_configs keys: {sorted(cfg)}")
+            if level != "O0":
+                from ..amp import GradScaler, decorate
+
+                scaler = GradScaler(**scaler_kw)
+                if level == "O2":
+                    # reference O2 contract: params cast to bf16, optimizer
+                    # keeps fp32 master weights (amp.decorate)
+                    decorate(self.network, optimizers=optimizer, level="O2")
+        self._amp_level = level
+        self._amp_custom_white = white
+        self._amp_custom_black = black
+        self._scaler = scaler
 
     # ------------------------------------------------------------------ steps
     def _compute_loss(self, outputs, labels):
@@ -43,18 +78,45 @@ class Model:
             raise RuntimeError("call prepare(loss=...) first")
         outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
         lbls = labels if isinstance(labels, (list, tuple)) else [labels]
-        losses = self._loss(*outs, *lbls) if not isinstance(self._loss, list) else None
-        return losses
+        if isinstance(self._loss, (list, tuple)):
+            # per-output loss fns summed (ref Model multi-output contract)
+            if not (len(self._loss) == len(outs) == len(lbls)):
+                raise ValueError(
+                    f"loss list/outputs/labels length mismatch: "
+                    f"{len(self._loss)}/{len(outs)}/{len(lbls)}")
+            parts = [fn(o, l) for fn, o, l in zip(self._loss, outs, lbls)]
+            total = parts[0]
+            for p in parts[1:]:
+                total = total + p
+            return total
+        return self._loss(*outs, *lbls)
 
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
-        loss.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        from ..amp import auto_cast
+
+        amp_on = getattr(self, "_amp_level", "O0") != "O0"
+        with auto_cast(enable=amp_on,
+                       level=self._amp_level if amp_on else "O1",
+                       custom_white_list=getattr(self, "_amp_custom_white",
+                                                 None),
+                       custom_black_list=getattr(self, "_amp_custom_black",
+                                                 None)):
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        scaler = getattr(self, "_scaler", None)
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            if update:
+                scaler.step(self._optimizer)
+                scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         return ([float(loss.item())], metrics) if metrics else [float(loss.item())]
 

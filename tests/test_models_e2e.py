@@ -430,3 +430,48 @@ class TestErnieFinetune:
         pred = np.argmax(np.asarray(m(x_t).value), -1)
         acc = (pred == labels).mean()
         assert acc >= 0.9, (acc, float(np.asarray(loss.value)))
+
+
+def test_hapi_model_amp_configs_trains():
+    """Model.prepare(amp_configs=...) parity (ref hapi/model.py:1619
+    _check_amp_configs): O1 auto_cast + dynamic loss scaling trains to high
+    accuracy; bad levels and unknown keys are rejected."""
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=paddle.nn.CrossEntropyLoss(),
+                  metrics=Accuracy(),
+                  amp_configs={"level": "O1", "init_loss_scaling": 1024.0})
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    w = rng.randn(8, 4)
+    y = (X @ w).argmax(-1).astype("int64")
+
+    class _DS(paddle.io.Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            return X[i], y[i]
+
+    model.fit(_DS(), batch_size=16, epochs=8, verbose=0)
+    res = model.evaluate(_DS(), batch_size=16)
+    assert res["acc"] > 0.8, res
+
+    with pytest.raises(ValueError):
+        model.prepare(optimizer=opt, loss=paddle.nn.CrossEntropyLoss(),
+                      amp_configs="O7")
+    with pytest.raises(ValueError):
+        model.prepare(optimizer=opt, loss=paddle.nn.CrossEntropyLoss(),
+                      amp_configs={"bogus": 1})
+
+    # loss given as a per-output list is applied and summed
+    m2 = paddle.Model(net)
+    m2.prepare(optimizer=opt, loss=[paddle.nn.CrossEntropyLoss()])
+    out = m2.train_batch([paddle.to_tensor(X[:16])], paddle.to_tensor(y[:16]))
+    assert np.isfinite(out[0])
