@@ -147,8 +147,8 @@ class PipelineParallel:
 class PipelineParallelWithInterleave(PipelineParallel):
     """Ref pipeline_parallel.py:461 — virtual pipeline stages. The eager path
     collapses to the same per-microbatch dataflow (single-controller SPMD);
-    the compiled path is `spmd_interleaved_pipeline_fn`, which implements the
-    true virtual-stage ring schedule (bubble (N-1)/(M·C) instead of (N-1)/M)."""
+    the compiled path is `spmd_interleaved_pipeline_fn`, the virtual-stage
+    ring schedule (lockstep rendering — see its bubble note)."""
 
     def __init__(self, layers, hcg, strategy):
         super().__init__(layers, hcg, strategy)
@@ -372,6 +372,182 @@ def spmd_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
     return per_shard
 
 
+def spmd_interleaved_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
+                                   num_stages: int, num_micro: int,
+                                   num_chunks: int, axis_name: str = "pipe"):
+    """Interleaved 1F1B (ref PipelineParallelWithInterleave
+    pipeline_parallel.py:461 — virtual stages in 1F1B order).
+
+    Generalizes :func:`spmd_1f1b_train_fn` to ``num_chunks`` model chunks
+    per device: logical stage L = chunk*S + dev over SC = S*C stages,
+      fwd(m) at stage L: tick t = m + L
+      bwd(m) at stage L: tick t = m + 2*SC - 1 - L
+    T = M + 2*SC - 1 ticks.  Per tick each device runs one fwd + one bwd
+    per resident chunk; activations ring-rotate dev→dev+1 advancing a
+    chunk on the S-1→0 wrap, cotangents rotate dev→dev-1 retreating a
+    chunk on the 0→S-1 wrap.  Residual rings: C x min(2*SC-1, M) boundary
+    activations — O(stages), independent of M.
+
+    HONEST BUBBLE NOTE: in this LOCKSTEP rendering every tick executes all
+    C chunks per device, so per-tick cost is constant while the tick count
+    grows from M+2S-1 to M+2SC-1 — the bubble fraction is ~2SC/(M+2SC),
+    i.e. LARGER than num_chunks=1, not smaller.  The reference's interleave
+    reduces the bubble only under per-device asynchronous scheduling (one
+    CHUNK-op per time slot); the staggered-tick SPMD equivalent is
+    ``spmd_staggered_interleaved_1f1b`` territory — until that lands,
+    prefer num_chunks=1 with schedule="1f1b" for throughput; this path
+    exists for schedule parity and for stage-granularity flexibility.
+
+    stage_fn(chunk_id, params_chunk, x) -> y (leaves WITHOUT the chunk dim)
+    params_shard leaves: [1 (pipe shard), num_chunks, ...].
+    Returns (loss, d_params_shard, d_post_params, d_micro) like the plain
+    schedule; d_params_shard keeps the [1, C, ...] layout (out_specs
+    P(axis) reassembles [S, C, ...]).
+    """
+
+    def per_shard(params_shard, post_params, micro, micro_labels):
+        to_varying = lambda tree: jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
+        micro = to_varying(micro)
+        micro_labels = to_varying(micro_labels)
+        post_params = to_varying(post_params)
+        dev = jax.lax.axis_index(axis_name)
+        S, M, C = num_stages, num_micro, num_chunks
+        SC = S * C
+        K = min(2 * SC - 1, M)
+        T = M + 2 * SC - 1
+
+        def chunk_params(c):
+            return jax.tree_util.tree_map(lambda p: p[0][c], params_shard)
+
+        def scaled_post(pp, y, lb):
+            return post_loss_fn(pp, y, lb) / M
+
+        zeros_like_t = lambda tree: jax.tree_util.tree_map(jnp.zeros_like,
+                                                           tree)
+
+        def select(pred, a, b):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(pred, x, y), a, b)
+
+        def at_chunk(tree, c):
+            return jax.tree_util.tree_map(lambda x: x[c], tree)
+
+        def tick(carry, t):
+            (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post,
+             d_micro, loss_acc) = carry
+            y_sends, dx_sends = [], []
+            for c in range(C):
+                L = c * S + dev
+                # ---- backward half for chunk c
+                mb_b = t - (2 * SC - 1 - L)
+                valid_b = (mb_b >= 0) & (mb_b < M)
+                slot_b = jnp.clip(mb_b, 0, M - 1) % K
+                x_in = jax.tree_util.tree_map(lambda r: r[c][slot_b], resid)
+                ct_in = select(L == SC - 1, pending_ct, at_chunk(bwd_grads, c))
+                _, vjp_fn = jax.vjp(
+                    lambda p, x, _c=c: stage_fn(_c, p, x),
+                    chunk_params(c), x_in)
+                dp, dx = vjp_fn(ct_in)
+                g_stk = jax.tree_util.tree_map(
+                    lambda g, d: g.at[0, c].add(jnp.where(valid_b, d, 0)),
+                    g_stk, dp)
+                if c == 0:  # L == 0 is only reachable for chunk 0
+                    write0 = valid_b & (L == 0)
+                    mb_c = jnp.clip(mb_b, 0, M - 1)
+                    d_micro = jax.tree_util.tree_map(
+                        lambda buf, d: buf.at[mb_c].set(
+                            jnp.where(write0, d, buf[mb_c])), d_micro, dx)
+                dx_sends.append(select(valid_b, dx, zeros_like_t(dx)))
+
+                # ---- forward half for chunk c
+                mb_f = t - L
+                valid_f = (mb_f >= 0) & (mb_f < M)
+                mb_cf = jnp.clip(mb_f, 0, M - 1)
+                if c == 0:  # L == 0 (feed from micro) only exists here
+                    mb = jax.tree_util.tree_map(lambda x: x[mb_cf], micro)
+                    x = select(L == 0, mb, at_chunk(fwd_acts, c))
+                else:
+                    x = at_chunk(fwd_acts, c)
+                y = stage_fn(c, chunk_params(c), x)
+                slot_f = mb_cf % K
+                resid = jax.tree_util.tree_map(
+                    lambda r, v, _c=c, _s=slot_f, _vf=valid_f: r.at[_c, _s].set(
+                        jnp.where(_vf, v, r[_c, _s])), resid, x)
+                if c == C - 1:  # L == SC-1 (head+loss) only exists here —
+                    # skipping the other chunks' dead value_and_grads saves
+                    # C-1 head+CE computations per tick (XLA cannot DCE
+                    # them: dev is traced)
+                    lb = jax.tree_util.tree_map(lambda x: x[mb_cf],
+                                                micro_labels)
+                    take = (L == SC - 1) & valid_f
+                    loss_m, (gp, gy) = jax.value_and_grad(
+                        scaled_post, argnums=(0, 1))(post_params, y, lb)
+                    loss_acc = loss_acc + jnp.where(take, loss_m, 0.0)
+                    g_post = jax.tree_util.tree_map(
+                        lambda g, d: g + jnp.where(take, d, 0), g_post, gp)
+                    pending_ct = select(take, gy, pending_ct)
+                y_sends.append(select(valid_f, y, zeros_like_t(y)))
+
+            # ---- one rotation each way for all chunks
+            y_stack = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *y_sends)
+            dx_stack = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs, axis=0), *dx_sends)
+            fwd_rot = jax.lax.ppermute(
+                y_stack, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            bwd_rot = jax.lax.ppermute(
+                dx_stack, axis_name, [(i, (i - 1) % S) for i in range(S)])
+
+            def fwd_reroute(r):
+                # dev 0 receives from dev S-1: logical c*S+S-1 -> (c+1)*S+0,
+                # so chunk c's inbox gets the sender's chunk c-1
+                shifted = jnp.concatenate([jnp.zeros_like(r[:1]), r[:-1]], 0)
+                return jnp.where(dev == 0, shifted, r)
+
+            def bwd_reroute(r):
+                # dev S-1 receives from dev 0: logical c*S -> (c-1)*S+S-1,
+                # so chunk c's inbox gets the sender's chunk c+1
+                shifted = jnp.concatenate([r[1:], jnp.zeros_like(r[:1])], 0)
+                return jnp.where(dev == S - 1, shifted, r)
+
+            fwd_acts = jax.tree_util.tree_map(fwd_reroute, fwd_rot)
+            bwd_grads = jax.tree_util.tree_map(bwd_reroute, bwd_rot)
+            return (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post,
+                    d_micro, loss_acc), None
+
+        act_proto = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]),
+                                           micro)
+        y_shape = jax.eval_shape(lambda a: stage_fn(0, chunk_params(0), a),
+                                 act_proto)
+        zvary = lambda shape, dtype: jax.lax.pcast(
+            jnp.zeros(shape, dtype), (axis_name,), to="varying")
+        carry0 = (
+            jax.tree_util.tree_map(                       # fwd_acts [C, ...]
+                lambda x: zvary((C,) + tuple(x.shape), x.dtype), act_proto),
+            jax.tree_util.tree_map(                       # bwd_grads [C, ...]
+                lambda x: zvary((C,) + tuple(x.shape), x.dtype), act_proto),
+            jax.tree_util.tree_map(                       # pending_ct
+                lambda s: zvary(tuple(s.shape), s.dtype), y_shape),
+            jax.tree_util.tree_map(                       # resid [C, K, ...]
+                lambda x: zvary((C, K) + tuple(x.shape), x.dtype), act_proto),
+            zeros_like_t(params_shard),                   # g_stk
+            zeros_like_t(post_params),                    # g_post
+            jax.tree_util.tree_map(jnp.zeros_like, micro),  # d_micro [M, ...]
+            jax.lax.pcast(jnp.float32(0.0), (axis_name,), to="varying"),
+        )
+        (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post, d_micro,
+         loss_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        loss = jax.lax.psum(loss_acc, axis_name)
+        g_post = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis_name), g_post)
+        d_micro = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis_name), d_micro)
+        return loss, g_stk, g_post, d_micro
+
+    return per_shard
+
+
 def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,
                                  num_chunks: int, axis_name: str = "pipe"):
     """Compiled INTERLEAVED pipeline (virtual stages, ref
@@ -381,8 +557,14 @@ def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro:
     L = chunk * num_stages + device, S = num_stages*num_chunks logical stages.
     Per tick every device runs all of its resident chunks (at most one
     microbatch each); activations ring-rotate via a single ppermute, and on
-    wrap-around (device N-1 → device 0) they advance to the next chunk —
-    the interleaved fill/drain with bubble (N-1)/(M*C) instead of (N-1)/M.
+    wrap-around (device N-1 → device 0) they advance to the next chunk.
+    Lockstep bubble note: every tick executes all C chunks per device, so
+    the tick count grows to M + N*C - 1 at constant per-tick cost — the
+    bubble is LARGER than num_chunks=1, not (N-1)/(M*C); the reference's
+    interleave shrink needs one chunk-op per time slot (see
+    spmd_interleaved_1f1b_train_fn's note).  chunks>1 here buys stage
+    granularity (layer counts not divisible by the device count), not
+    throughput.
 
     stage_fn(chunk_id, params_chunk, activation) -> activation
     params_shard: per-shard pytree whose leaves are [1, num_chunks, ...] —

@@ -92,8 +92,6 @@ class PipelineEngine:
         self.num_micro = num_micro
         self.num_chunks = num_chunks  # >1: interleaved virtual stages
         assert schedule in ("gpipe", "1f1b"), schedule
-        assert schedule == "gpipe" or num_chunks == 1, \
-            "1f1b schedule does not support interleaved virtual stages yet"
         self.schedule = schedule
         self.remat = remat
         self._abstract = abstract
@@ -280,8 +278,9 @@ class PipelineEngine:
                              acts.shape[1:])
         if self.num_chunks > 1:
             # interleaved virtual stages (ref PipelineParallelWithInterleave
-            # pipeline_parallel.py:461): bubble (S-1)/(M*C), differentiated
-            # end-to-end like the plain schedule
+            # pipeline_parallel.py:461), differentiated end-to-end like the
+            # plain schedule (lockstep bubble caveat: see
+            # spmd_interleaved_pipeline_fn docstring)
             def chunk_fn(chunk_id, params_chunk, x):
                 return run_blocks(params_chunk, x)
 
@@ -343,19 +342,25 @@ class PipelineEngine:
         live activations — see ``spmd_1f1b_train_fn``.  Ref:
         python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117."""
         from ..distributed.fleet.meta_parallel.pipeline_parallel import (
-            spmd_1f1b_train_fn)
+            spmd_1f1b_train_fn, spmd_interleaved_1f1b_train_fn)
 
         mesh = self.mesh
         rest_frozen_names = [n for n in self.rest
                              if n not in self._rest_trainable]
-        S, M = self.num_stages, self.num_micro
+        S, M, C = self.num_stages, self.num_micro, self.num_chunks
 
         def post_loss(pp, y, lb):
             loss = self._post_fn(pp, y, *lb)
             v = loss.value if isinstance(loss, Tensor) else loss
             return v.astype(jnp.float32)
 
-        fn = spmd_1f1b_train_fn(self._stage_fn, post_loss, S, M)
+        if C > 1:
+            def chunk_fn(chunk_id, params_chunk, x):
+                return self._run_blocks(params_chunk, x)
+
+            fn = spmd_interleaved_1f1b_train_fn(chunk_fn, post_loss, S, M, C)
+        else:
+            fn = spmd_1f1b_train_fn(self._stage_fn, post_loss, S, M)
         post_names = self._post_names
 
         def step_fn(rest, stacked, opt_state, step_count, lr, inputs, labels):
