@@ -15,7 +15,53 @@ import weakref
 from ..framework.core import (Tensor, TapeNode, backward, grad, is_grad_enabled, no_grad,
                               to_array)
 
-__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad", "hessian", "jacobian"]
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "hessian", "jacobian", "saved_tensors_hooks", "set_grad_enabled"]
+
+
+# --- saved-tensor pack/unpack hooks (ref autograd/saved_tensors_hooks.py:20)
+_saved_hooks = []
+
+
+class saved_tensors_hooks:
+    """Register a (pack_hook, unpack_hook) pair applied to tensors saved for
+    backward (ref autograd/saved_tensors_hooks.py:20) — e.g. offload
+    activations to host numpy on save, reload on use.
+
+    Scope note: eagerly-saved tensors means ``PyLayerContext.
+    save_for_backward`` here; the implicit op residuals of the tape engine
+    are captured inside jax vjp closures (XLA-managed device buffers with no
+    eager alias to hook), so the reference's LoDTensor-only caveat maps to
+    "PyLayer saves only"."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_hooks.pop()
+        return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    """paddle.set_grad_enabled parity: context manager flipping autograd
+    recording (ref framework [core] set_grad_enabled)."""
+    from ..framework.core import _grad_state
+
+    prev = _grad_state.enabled
+    _grad_state.enabled = bool(mode)
+    try:
+        yield
+    finally:
+        _grad_state.enabled = prev
 
 
 class PyLayerContext:
@@ -25,14 +71,26 @@ class PyLayerContext:
         self._non_diff = set()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        if _saved_hooks:
+            pack, _ = _saved_hooks[-1]
+            self._packed_with = _saved_hooks[-1]
+            self._saved = [pack(t) for t in tensors]
+        else:
+            self._packed_with = None
+            self._saved = list(tensors)
+
+    def _unpacked(self):
+        if getattr(self, "_packed_with", None) is not None:
+            _, unpack = self._packed_with
+            return [unpack(v) for v in self._saved]
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
     def mark_not_inplace(self, *args):
         pass

@@ -5,12 +5,15 @@ mesh axes replace process groups; jax.distributed.initialize replaces
 TCPStore+NCCL bootstrap; pjit/GSPMD sharding replaces per-rank program
 slicing.
 """
-from .collective import (Group, ProcessGroup, ReduceOp, all_gather, all_gather_object, all_reduce,
-                         all_to_all, alltoall, barrier, broadcast, broadcast_object_list,
+from .collective import (Group, ParallelMode, ProcessGroup, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, all_to_all, alltoall,
+                         alltoall_single, barrier, broadcast, broadcast_object_list,
                          destroy_process_group, get_backend, get_global_mesh, get_group,
-                         irecv, isend, new_group, recv, reduce, reduce_scatter, scatter,
-                         send, set_global_mesh, wait)
+                         irecv, is_available, isend, new_group, recv, reduce,
+                         reduce_scatter, scatter, scatter_object_list, send,
+                         set_global_mesh, split, wait)
 from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .store import TCPStore
 from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh
 from .parallel import DataParallel

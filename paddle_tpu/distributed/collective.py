@@ -318,3 +318,89 @@ def destroy_process_group(group=None):
 
 def get_backend(group=None) -> str:
     return "xla"
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all_to_all (ref communication/all_to_all.py
+    alltoall_single): the first dim is split across the group instead of
+    passing explicit tensor lists."""
+    axis = group.axis if group is not None else "data"
+    n = _axis_size(axis)
+    v = to_array(in_tensor)
+    for sizes in (in_split_sizes, out_split_sizes):
+        if sizes is not None and len(set(sizes)) > 1:
+            raise NotImplementedError(
+                "alltoall_single: unequal split sizes are not supported by "
+                "the XLA all_to_all lowering — pad to equal splits")
+    if n <= 1:
+        if out_tensor is not None and isinstance(out_tensor, Tensor):
+            out_tensor._value = v
+            return _Task(v)
+        return Tensor(v)
+    out = _run_on_axis(
+        v, axis,
+        lambda x: jax.lax.all_to_all(
+            x.reshape((n, -1) + x.shape[1:]), axis, split_axis=0,
+            concat_axis=0, tiled=False).reshape(x.shape))
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._value = out
+        return _Task(out)
+    return Tensor(out)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter picklable python objects (ref communication/scatter.py
+    scatter_object_list): rank i receives in_object_list[i] from src."""
+    idx = group.rank if group is not None else 0
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[idx])
+    return None
+
+
+def is_available() -> bool:
+    """Whether the distributed package is usable (ref parallel.py
+    is_available) — always True here: the XLA-collectives backend is
+    compiled in."""
+    return True
+
+
+class ParallelMode:
+    """Parallelism kinds (ref fleet/base/topology.py:28)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel linear/embedding over the tensor axis (ref
+    fleet/layers/mpu/mp_ops.py split:653): builds the corresponding
+    parallel layer (weights GSPMD-sharded over "tensor") and returns its
+    output on ``x``.  axis=1 on a linear splits the out-features
+    (column-parallel); axis=0 splits in-features (row-parallel)."""
+    from .fleet.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                               RowParallelLinear,
+                                               VocabParallelEmbedding)
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r} (linear|embedding)")

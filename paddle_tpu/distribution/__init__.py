@@ -716,3 +716,38 @@ def _kl_independent(p, q):
             "KL between Independents of different reinterpreted ranks")
     inner = kl_divergence(p._base, q._base)
     return p._sum_rightmost(inner, p._reinterpreted_batch_rank)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (ref
+    distribution/exponential_family.py:20): p(x;θ) = exp(<t(x),θ> - F(θ) +
+    k(x)).  Subclasses provide ``_natural_parameters`` and
+    ``_log_normalizer``; entropy comes from the Bregman identity
+    H = F(θ) - Σ θ·∇F(θ) - E[k(x)] computed with jax.grad (the reference
+    uses paddle.grad with create_graph)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nats = [jnp.asarray(_v(p), jnp.float32)
+                for p in self._natural_parameters]
+
+        def F(*ps):
+            out = self._log_normalizer(*ps)
+            return jnp.sum(_v(out))
+
+        log_norm = self._log_normalizer(*nats)
+        grads = jax.grad(F, argnums=tuple(range(len(nats))))(*nats)
+        ent = -self._mean_carrier_measure + _v(log_norm)
+        for p, g in zip(nats, grads):
+            ent = ent - p * g
+        return Tensor(ent)

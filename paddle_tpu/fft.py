@@ -51,6 +51,23 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return apply_op(lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=tuple(axes), norm=norm)), x)
 
 
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d FFT of a Hermitian-symmetric signal (real output); same
+    conjugate/irfftn composition as :func:`hfft2` over arbitrary axes
+    (ref fft.py hfftn)."""
+    ax = tuple(axes) if axes is not None else None
+    return apply_op(
+        lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=ax, norm=norm), x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of :func:`hfftn` (Hermitian-symmetric spectrum of a real
+    signal; ref fft.py ihfftn)."""
+    ax = tuple(axes) if axes is not None else None
+    return apply_op(
+        lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=ax, norm=norm)), x)
+
+
 def fftfreq(n, d=1.0, dtype=None, name=None):
     from .framework.core import Tensor
 

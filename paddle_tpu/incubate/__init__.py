@@ -13,3 +13,10 @@ from . import multiprocessing  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import DistributedFusedLamb, LBFGS, LookAhead, ModelAverage  # noqa: F401
+from . import operators  # noqa: F401
+from .operators import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv,
+                        identity_loss, softmax_mask_fuse,
+                        softmax_mask_fuse_upper_triangle)
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: F401
+                         segment_sum)

@@ -1,0 +1,171 @@
+"""incubate operators (ref: python/paddle/incubate/operators/ —
+graph_send_recv.py:36, graph_khop_sampler.py:21, graph_reindex.py:28,
+graph_sample_neighbors.py:28, softmax_mask_fuse.py:20,
+softmax_mask_fuse_upper_triangle.py:20; incubate/nn/loss.py identity_loss).
+
+The graph SAMPLING ops are host-side data-preparation (the reference runs
+them as CPU/GPU kernels at dataloading time); numpy implementations are the
+right tool — their outputs feed jitted compute.  The fused softmax ops are
+XLA compositions (the fusion the reference hand-writes in CUDA falls out of
+the compiler)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_array
+from ..framework.dispatch import apply_op
+
+__all__ = ["graph_send_recv", "graph_khop_sampler", "graph_reindex",
+           "graph_sample_neighbors", "identity_loss", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather-by-src then segment-reduce-to-dst (ref graph_send_recv.py:36);
+    alias of geometric.send_u_recv with the legacy arg name."""
+    from ..geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def _np1d(t):
+    return np.asarray(to_array(t)).reshape(-1)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Uniform neighbor sampling on a CSC graph (ref
+    graph_sample_neighbors.py:28): for each input node draw up to
+    ``sample_size`` neighbors (all when -1).  Returns (out_neighbors,
+    out_count[, out_eids])."""
+    rown = _np1d(row)
+    ptr = _np1d(colptr)
+    nodes = _np1d(input_nodes)
+    eidn = _np1d(eids) if eids is not None else None
+    # entropy from the framework generator: fresh draw per call, but the
+    # whole sequence replays after paddle.seed (reference ops honor the
+    # global seed the same way)
+    from ..framework.random import default_generator
+
+    ent = np.asarray(jax.random.key_data(
+        default_generator().next_key())).ravel().tolist()
+    rng = np.random.default_rng(ent)
+    neigh, counts, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(ptr[n]), int(ptr[n + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        neigh.append(rown[idx])
+        counts.append(len(idx))
+        if return_eids:
+            out_eids.append(eidn[idx] if eidn is not None else idx)
+    out_n = Tensor(jnp.asarray(np.concatenate(neigh)
+                               if neigh else np.zeros(0, rown.dtype)))
+    out_c = Tensor(jnp.asarray(np.asarray(counts, rown.dtype)))
+    if return_eids:
+        ee = Tensor(jnp.asarray(np.concatenate(out_eids)
+                                if out_eids else np.zeros(0, rown.dtype)))
+        return out_n, out_c, ee
+    return out_n, out_c
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex node ids to a dense [0, n) range, inputs first (ref
+    graph_reindex.py:28).  Returns (reindex_src, reindex_dst, out_nodes)."""
+    xs = _np1d(x)
+    nb = _np1d(neighbors)
+    cnt = _np1d(count)
+    # unique neighbor ids not already in x, in first-appearance order
+    seen = {int(v): i for i, v in enumerate(xs)}
+    order = list(xs)
+    for v in nb:
+        if int(v) not in seen:
+            seen[int(v)] = len(order)
+            order.append(v)
+    remap = np.vectorize(lambda v: seen[int(v)])
+    reindex_src = remap(nb) if len(nb) else np.zeros(0, np.int64)
+    dst = np.repeat(np.arange(len(xs)), cnt)
+    return (Tensor(jnp.asarray(reindex_src.astype(xs.dtype))),
+            Tensor(jnp.asarray(dst.astype(xs.dtype))),
+            Tensor(jnp.asarray(np.asarray(order, xs.dtype))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (ref graph_khop_sampler.py:21).
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids])."""
+    nodes = _np1d(input_nodes)
+    frontier = nodes
+    all_src, all_dst, all_eids = [], [], []
+    for size in sample_sizes:
+        res = graph_sample_neighbors(row, colptr, frontier,
+                                     eids=sorted_eids, sample_size=int(size),
+                                     return_eids=return_eids)
+        nb, cnt = _np1d(res[0]), _np1d(res[1])
+        all_src.append(nb)
+        all_dst.append(np.repeat(frontier, cnt))
+        if return_eids:
+            all_eids.append(_np1d(res[2]))
+        frontier = np.unique(nb)
+    src = np.concatenate(all_src) if all_src else np.zeros(0, nodes.dtype)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, nodes.dtype)
+    # dense reindex, inputs first
+    seen = {int(v): i for i, v in enumerate(nodes)}
+    order = list(nodes)
+    for v in np.concatenate([src, dst]) if len(src) else []:
+        if int(v) not in seen:
+            seen[int(v)] = len(order)
+            order.append(v)
+    remap = np.vectorize(lambda v: seen[int(v)])
+    e_src = remap(src) if len(src) else np.zeros(0, np.int64)
+    e_dst = remap(dst) if len(dst) else np.zeros(0, np.int64)
+    out = (Tensor(jnp.asarray(e_src.astype(nodes.dtype)).reshape(-1, 1)),
+           Tensor(jnp.asarray(e_dst.astype(nodes.dtype)).reshape(-1, 1)),
+           Tensor(jnp.asarray(np.asarray(order, nodes.dtype))),
+           Tensor(jnp.asarray(remap(nodes).astype(nodes.dtype))))
+    if return_eids:
+        ee = (np.concatenate(all_eids) if all_eids
+              else np.zeros(0, nodes.dtype))
+        return out + (Tensor(jnp.asarray(ee)),)
+    return out
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss head (ref incubate/nn/loss.py:21); the
+    reference uses it to anchor IPU backprop — here it is the identity with
+    the requested reduction."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return apply_op(jnp.mean, x)
+    if red == "sum":
+        return apply_op(jnp.sum, x)
+    if red == "none":
+        return apply_op(lambda v: v, x)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (ref softmax_mask_fuse.py:20 — a CUDA kernel
+    there; one XLA fusion here)."""
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the upper triangle masked out (causal; ref
+    softmax_mask_fuse_upper_triangle.py:20)."""
+
+    def f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool), k=s - a.shape[-2])
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return apply_op(f, x)

@@ -151,6 +151,9 @@ class StaticFunction:
         self._jitted = jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # enable_to_static(False): run the original dygraph code
+            return self._fn(*args, **kwargs)
         if self._jitted is None:
             self._build()
         params = state_values(self._layer) if self._layer is not None else {}
@@ -380,3 +383,29 @@ def load(path, **configs):
         with open(path + ".pdexport", "rb") as f:
             params = pickle.load(f)
     return TranslatedLayer(sd, meta, exported, params)
+
+
+# --- dy2static global switches (ref jit/api.py enable_to_static,
+# jit/dy2static/logging_utils.py set_code_level/set_verbosity) ---
+_to_static_enabled = True
+_code_level = 0
+_verbosity = 0
+
+
+def enable_to_static(enable: bool = True):
+    """Globally enable/disable @to_static conversion (ref jit/api.py:88):
+    when off, to_static-wrapped callables run eagerly."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Transformed-code dump level (ref dy2static logging_utils)."""
+    global _code_level
+    _code_level = int(level)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Dy2static logging verbosity (ref dy2static logging_utils)."""
+    global _verbosity
+    _verbosity = int(level)

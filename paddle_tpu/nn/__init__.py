@@ -1,5 +1,7 @@
 """paddle.nn parity surface (ref: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import spectral_norm  # noqa: F401  (nn-level alias, ref nn/__init__)
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_,

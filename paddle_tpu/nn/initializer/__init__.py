@@ -191,3 +191,22 @@ def calculate_gain(nonlinearity, param=None):
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+# --- global default initializers (ref fluid/initializer.py:1168) ---
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set process-wide default initializers consulted by
+    ``Layer.create_parameter`` when neither a ParamAttr initializer nor a
+    default_initializer is given (ref fluid/initializer.py:1168
+    set_global_initializer).  Pass ``None`` to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _global_bias_init if is_bias else _global_weight_init

@@ -118,6 +118,8 @@ class Layer:
             elif callable(attr):
                 init = attr
         if init is None:
+            init = I._global_initializer(is_bias)  # set_global_initializer
+        if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         value = init(shape, dtype)
         p = Parameter(value, trainable=trainable, name=name or "")

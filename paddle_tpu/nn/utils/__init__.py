@@ -1,0 +1,148 @@
+"""paddle.nn.utils parity (ref: python/paddle/nn/utils/ —
+weight_norm_hook.py weight_norm/remove_weight_norm, spectral_norm_hook.py
+spectral_norm, transform_parameters.py parameters_to_vector /
+vector_to_parameters).
+
+Reparameterizations are implemented as forward-pre-hooks recomputing the
+target weight from the stored factors before every call — the same shape as
+the reference's hook design, over the eager tape instead of C++ hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    """L2 norm over all axes except ``dim`` (ref weight_norm_hook norm_except_dim)."""
+    v = w.value if isinstance(w, Tensor) else w
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return jnp.sqrt(jnp.sum(v * v, axis=axes)).reshape(shape)
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """w = g * v / ||v|| reparameterization (ref weight_norm_hook.py
+    weight_norm): replaces ``layer.<name>`` with factors ``<name>_g`` /
+    ``<name>_v`` and recomputes the weight in a forward-pre-hook so both
+    factors train through the tape."""
+    w = getattr(layer, name)
+    g0 = _norm_except(w, dim)
+    v0 = w.value
+    g = Parameter(g0, name=f"{name}_g")
+    v = Parameter(v0, name=f"{name}_v")
+    # deregister the original parameter; register the factors
+    if name in getattr(layer, "_parameters", {}):
+        del layer._parameters[name]
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def _compute(lay, *args):
+        gv = getattr(lay, f"{name}_g")
+        vv = getattr(lay, f"{name}_v")
+        # the norm must be ON the tape: g and v both receive the full
+        # d(g·v/||v||) gradient incl. the norm-direction term
+        axes = ([i for i in range(len(vv.shape)) if i != dim]
+                if dim is not None else None)
+        if axes is None:
+            norm_t = (vv * vv).sum().sqrt()
+        else:
+            norm_t = (vv * vv).sum(axis=axes, keepdim=True).sqrt()
+        setattr(lay, name, vv * (gv / norm_t))
+
+    handle = layer.register_forward_pre_hook(lambda lay, inp: _compute(lay))
+    layer._weight_norm_hook = (handle, name, dim)
+    _compute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g·v/||v|| back into a single parameter (ref weight_norm_hook.py
+    remove_weight_norm)."""
+    handle, nm, dim = layer._weight_norm_hook
+    assert nm == name, (nm, name)
+    handle.remove()
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    w = v.value * (g.value / _norm_except(v, dim))
+    del layer._parameters[f"{name}_g"]
+    del layer._parameters[f"{name}_v"]
+    layer.add_parameter(name, Parameter(w, name=name))
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = None):
+    """w / sigma_max(w) via power iteration (ref spectral_norm_hook.py
+    spectral_norm): keeps ``<name>_orig`` trainable plus u/v power-iteration
+    buffers updated each forward."""
+    if dim is None:
+        dim = 1 if layer.__class__.__name__.lower().find("linear") >= 0 else 0
+    w = getattr(layer, name)
+    wv = np.asarray(w.value)
+    wm = np.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = rng.randn(wm.shape[0]).astype(np.float32)
+    v = rng.randn(wm.shape[1]).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+    v /= np.linalg.norm(v) + eps
+    orig = Parameter(w.value, name=f"{name}_orig")
+    if name in getattr(layer, "_parameters", {}):
+        del layer._parameters[name]
+    layer.add_parameter(f"{name}_orig", orig)
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u)))
+    layer.register_buffer(f"{name}_v", Tensor(jnp.asarray(v)))
+
+    def _compute(lay, *args):
+        wo = getattr(lay, f"{name}_orig")
+        uu = getattr(lay, f"{name}_u").value
+        vv_ = getattr(lay, f"{name}_v").value
+        wmat = jnp.moveaxis(wo.value, dim, 0).reshape(wo.value.shape[dim], -1)
+        for _ in range(n_power_iterations):
+            vv_ = wmat.T @ uu
+            vv_ = vv_ / (jnp.linalg.norm(vv_) + eps)
+            uu = wmat @ vv_
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        getattr(lay, f"{name}_u")._value = uu
+        getattr(lay, f"{name}_v")._value = vv_
+        # power iteration is no-grad (u, v are buffers), but sigma = u^T W v
+        # must differentiate through W: grad gets the -(u v^T)/sigma^2 term
+        uvT = Tensor(jnp.moveaxis(
+            jnp.outer(uu, vv_).reshape(
+                (wo.value.shape[dim],) +
+                tuple(np.delete(np.array(wo.value.shape), dim))), 0, dim))
+        sigma = (wo * uvT).sum()
+        setattr(lay, name, wo / sigma)
+
+    handle = layer.register_forward_pre_hook(lambda lay, inp: _compute(lay))
+    layer._spectral_norm_hook = (handle, name)
+    _compute(layer)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten a parameter list into one 1-D Tensor (ref
+    transform_parameters.py parameters_to_vector)."""
+    vals = [jnp.ravel(p.value) for p in parameters]
+    return Tensor(jnp.concatenate(vals)) if vals else Tensor(jnp.zeros(0))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into the parameter list (ref
+    transform_parameters.py vector_to_parameters)."""
+    v = vec.value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._value = v[off:off + n].reshape(p.shape).astype(p.value.dtype)
+        off += n
+    return parameters

@@ -41,3 +41,416 @@ def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
     return p
 
 
+
+
+# ---------------------------------------------------------------------------
+# static surface completeness (ref python/paddle/static/__init__.py __all__):
+# places, strategy configs, serialization family, metric ops, misc helpers
+# ---------------------------------------------------------------------------
+
+
+def cpu_places(device_count=None):
+    """ref static.cpu_places — host devices (XLA CPU)."""
+    from ..fluid.core import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """ref static.cuda_places — accelerator devices; on this backend the
+    accelerators are TPU chips (CustomPlace), returned for API parity."""
+    import jax
+
+    from ..fluid.core import CustomPlace
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    ids = device_ids if device_ids is not None else range(len(devs) or 1)
+    return [CustomPlace("tpu", int(i)) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def device_guard(device=None):
+    """ref static.device_guard — device placement context. XLA owns
+    placement; the guard is accepted and recorded as a no-op."""
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class BuildStrategy:
+    """ref BuildStrategy (pybind bind_build_strategy): attribute bag; the
+    XLA compiler owns fusion/memory decisions, so flags are accepted and
+    recorded only."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.enable_addto = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_auto_fusion = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+        self.build_cinn_pass = True  # the whole backend is a compiler
+
+
+class ExecutionStrategy:
+    """ref ExecutionStrategy: attribute bag (XLA runtime owns execution)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class IpuStrategy:
+    """ref IpuStrategy — Graphcore IPU backend config. IPU is outside this
+    framework's hardware scope (README non-goals cover non-TPU engines);
+    the config object exists for import parity and raises on use."""
+
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU support is not part of the TPU-native backend "
+            "(README non-goals); use the XLA/TPU path")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU support is not part of the TPU-native backend "
+            "(README non-goals)")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU support is not part of this backend")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU support is not part of this backend")
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """ref static.Print op: print the tensor when executed, pass it
+    through.  Under jit this becomes a jax.debug.print."""
+    import numpy as np
+
+    import jax
+
+    from ..framework.core import Tensor
+    from ..framework.dispatch import apply_op
+
+    def f(v):
+        jax.debug.print((message or "") + " {}", v)
+        return v
+
+    return apply_op(f, input)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """ref static.create_parameter."""
+    from ..nn.layer_base import Layer
+
+    holder = Layer()
+    return holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+class WeightNormParamAttr:
+    """ref static.WeightNormParamAttr — ParamAttr requesting g·v/||v||
+    reparameterization (apply nn.utils.weight_norm on the built layer)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..framework.param_attr import ParamAttr
+
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_attr"], k)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """ref static.accuracy op: top-k accuracy over a batch."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import apply_op
+
+    def f(pred, lbl):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = jnp.any(topk == lbl.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op(f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """ref static.auc op: batch ROC-AUC from positive-class scores
+    (threshold-bucketed, matching the reference's discretization)."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor, to_array
+
+    pred = to_array(input)
+    lbl = to_array(label).reshape(-1)
+    pos_score = pred[..., -1].reshape(-1)
+    buckets = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+    pos_hist = jnp.zeros(num_thresholds + 1).at[buckets].add(
+        (lbl == 1).astype(jnp.float32))
+    neg_hist = jnp.zeros(num_thresholds + 1).at[buckets].add(
+        (lbl == 0).astype(jnp.float32))
+    # sweep thresholds high->low accumulating TPR/FPR trapezoids
+    tp = jnp.cumsum(pos_hist[::-1])
+    fp = jnp.cumsum(neg_hist[::-1])
+    tot_p = jnp.maximum(tp[-1], 1e-9)
+    tot_n = jnp.maximum(fp[-1], 1e-9)
+    tpr = tp / tot_p
+    fpr = fp / tot_n
+    a = jnp.trapezoid(tpr, fpr) if hasattr(jnp, "trapezoid") else \
+        jnp.trapz(tpr, fpr)
+    auc_out = Tensor(a)
+    return auc_out, [auc_out]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """ref static.ctr_metric_bundle: (auc, squared error, absolute error,
+    prediction sum, label sum, instance count) for CTR models."""
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor, to_array
+
+    pred = to_array(input).reshape(-1)
+    lbl = to_array(label).reshape(-1).astype(jnp.float32)
+    a, _ = auc(input, label)
+    sqrerr = Tensor(jnp.sum((pred - lbl) ** 2))
+    abserr = Tensor(jnp.sum(jnp.abs(pred - lbl)))
+    prob = Tensor(jnp.sum(pred))
+    q = Tensor(jnp.sum(lbl))
+    pos = Tensor(jnp.sum(lbl))
+    total = Tensor(jnp.asarray(float(pred.shape[0])))
+    return a, sqrerr, abserr, prob, q, pos, total
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    """ref static exponential_decay: lr * decay_rate^(step/decay_steps)
+    (integer division when staircase)."""
+    from ..optimizer.lr import LambdaDecay
+
+    def factor(step):
+        e = step // decay_steps if staircase else step / decay_steps
+        return decay_rate ** e
+
+    return LambdaDecay(learning_rate=learning_rate, lr_lambda=factor)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref static.py_func: run a host python function as an op (the
+    reference registers it in ProgramDesc; eagerly it just runs — under jit
+    wrap with paddle_tpu.utils.cpp_extension host callbacks instead)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    if out is not None and hasattr(out, "_value") and hasattr(res, "value"):
+        out._value = res.value
+    return res
+
+
+# ---- Program/state serialization family (our own format: the protobuf
+# ProgramDesc is a documented non-goal; recorded Programs pickle cleanly
+# and params ride framework.io_state) ----
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program MANIFEST serialization (op list, var names, param metadata).
+
+    The protobuf ProgramDesc format is a documented non-goal (README); the
+    EXECUTABLE serialization of a recorded Program is
+    :func:`save_inference_model` (batch-polymorphic StableHLO).  This
+    manifest supports introspection and persistable save/load, the
+    dominant uses of ``static.save``/``static.load``."""
+    import pickle
+
+    import numpy as np
+
+    from .graph import default_main_program
+
+    prog = program or default_main_program()
+    return pickle.dumps({
+        "ops": [getattr(op, "type", getattr(op, "name", str(op)))
+                for op in prog.ops],
+        "vars": sorted(getattr(prog, "vars", {}).keys()
+                       if hasattr(prog, "vars") else []),
+        "params": {n: (tuple(np.asarray(p.value).shape),
+                       str(np.asarray(p.value).dtype))
+                   for n, p in prog.params.items()},
+    })
+
+
+def deserialize_program(data):
+    """Inverse of :func:`serialize_program`: returns the manifest dict (see
+    its docstring for the executable-program path)."""
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    import pickle
+
+    from .graph import default_main_program, global_scope
+
+    prog = program or default_main_program()
+    store = global_scope().store
+    state = {name: store.get(name, p.value)
+             for name, p in prog.params.items()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    from .graph import global_scope
+
+    state = pickle.loads(data)
+    global_scope().store.update(state)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_prefix, protocol=4, **configs):
+    """ref static.save: <prefix>.pdmodel (program manifest) + .pdiparams
+    (persistables).  Executable program export = save_inference_model
+    (StableHLO); ProgramDesc protobuf is a documented non-goal."""
+    save_to_file(model_prefix + ".pdmodel", serialize_program(None, None,
+                                                              program))
+    save_to_file(model_prefix + ".pdiparams",
+                 serialize_persistables(None, None, program=program))
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    """ref static.load: restore persistables saved by :func:`save` into the
+    executor scope (and the program's param init values)."""
+    data = load_from_file(model_prefix + ".pdiparams")
+    state = deserialize_persistables(program, data, executor)
+    for name, val in state.items():
+        if name in program.params:
+            program.params[name]._value = val
+    return state
+
+
+def load_program_state(model_prefix, var_list=None):
+    import pickle
+
+    return pickle.loads(load_from_file(model_prefix + ".pdiparams"))
+
+
+def set_program_state(program, state_dict):
+    from .graph import global_scope
+
+    global_scope().store.update(state_dict)
+    for name, val in state_dict.items():
+        if name in program.params:
+            program.params[name]._value = getattr(val, "value", val)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """ref static.normalize_program — prune to the feed→fetch subgraph; our
+    recorded Programs are already minimal, so clone is the normal form."""
+    return program.clone()
+
+
+class ExponentialMovingAverage:
+    """ref static.ExponentialMovingAverage: shadow = decay*shadow +
+    (1-decay)*param with optional bias-corrected thres_steps;
+    ``update()`` after each step, ``apply()`` context swaps shadows in
+    for evaluation, ``restore()`` swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def _tracked(self):
+        if not self._params:
+            from .graph import default_main_program
+
+            self._params = [(n, p) for n, p in
+                            default_main_program().params.items()
+                            if getattr(p, "trainable", True)]
+        return self._params
+
+    def update(self):
+        import numpy as np
+
+        self._step += 1
+        # warm-up ramp only when thres_steps is given (ref contract);
+        # otherwise constant decay from the first update
+        d = (min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+             if self._thres_steps is not None else self._decay)
+        for name, p in self._tracked():
+            cur = np.asarray(p.value)
+            prev = self._shadow.get(name, cur)
+            self._shadow[name] = d * prev + (1.0 - d) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        import jax.numpy as jnp
+
+        @contextlib.contextmanager
+        def ctx():
+            for name, p in self._tracked():
+                if name in self._shadow:
+                    self._backup[name] = p.value
+                    p._value = jnp.asarray(self._shadow[name])
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for name, p in self._tracked():
+            if name in self._backup:
+                p._value = self._backup.pop(name)

@@ -587,3 +587,154 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
                              self._padding, self._dilation, self._deformable_groups,
                              self._groups, mask)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 1-D Tensor (ref vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes (uint8 1-D Tensor) to a CHW uint8 image Tensor
+    (ref vision/ops.py decode_jpeg — nvjpeg there, PIL here: decode is
+    host-side data loading either way)."""
+    import io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs Pillow") from e
+
+    raw = bytes(np.asarray(to_array(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]  # (1, H, W)
+    else:
+        arr = arr.transpose(2, 0, 1)  # (C, H, W)
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr)))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref vision/ops.py yolo_loss:50 / CUDA
+    yolov3_loss op): per-sample sum of box (sigmoid-CE xy + L1 wh, scaled by
+    2-w*h), objectness (sigmoid-CE with IoU>ignore_thresh negatives
+    ignored), and class (sigmoid-CE, optional label smoothing) terms.
+
+    x: [N, S*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h, normalized);
+    gt_label: [N, B] int; returns [N] loss."""
+    xv = to_array(x)
+    gb = to_array(gt_box).astype(jnp.float32)
+    gl = to_array(gt_label).astype(jnp.int32)
+    gs = (to_array(gt_score).astype(jnp.float32) if gt_score is not None
+          else jnp.ones(gl.shape, jnp.float32))
+    N, _, H, W = xv.shape
+    S = len(anchor_mask)
+    C = int(class_num)
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)  # all anchors (w,h)
+    mask_an = an[np.asarray(anchor_mask)]
+    in_h, in_w = H * downsample_ratio, W * downsample_ratio
+
+    p = xv.reshape(N, S, 5 + C, H, W).astype(jnp.float32)
+    tx, ty, tw, th = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3]
+    tobj, tcls = p[:, :, 4], p[:, :, 5:]
+
+    # ---- build targets (host loop over the fixed B gt slots is traced
+    # statically; B is small)
+    B = gb.shape[1]
+    gx = gb[..., 0] * W    # [N, B] in grid units
+    gy = gb[..., 1] * H
+    gw = gb[..., 2] * in_w  # pixels
+    gh = gb[..., 3] * in_h
+    valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)
+    # best anchor per gt over ALL anchors (shape-only IoU)
+    inter = (jnp.minimum(gw[..., None], an[:, 0]) *
+             jnp.minimum(gh[..., None], an[:, 1]))
+    union = gw[..., None] * gh[..., None] + an[:, 0] * an[:, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [N, B]
+
+    obj_t = jnp.zeros((N, S, H, W))
+    obj_w = jnp.zeros((N, S, H, W))  # per-cell gt_score weight
+    xy_t = jnp.zeros((N, S, 2, H, W))
+    wh_t = jnp.zeros((N, S, 2, H, W))
+    box_w = jnp.zeros((N, S, H, W))
+    cls_t = jnp.zeros((N, S, C, H, W))
+    mask_list = list(np.asarray(anchor_mask))
+    batch = jnp.arange(N)
+    for b in range(B):
+        gi = jnp.clip(gx[:, b].astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip(gy[:, b].astype(jnp.int32), 0, H - 1)
+        for s, a_idx in enumerate(mask_list):
+            sel = valid[:, b] & (best[:, b] == a_idx)
+            w8 = jnp.where(sel, gs[:, b], 0.0)
+            obj_t = obj_t.at[batch, s, gj, gi].max(jnp.where(sel, 1.0, 0.0))
+            obj_w = obj_w.at[batch, s, gj, gi].max(w8)
+            sxy = jnp.stack([gx[:, b] - gi, gy[:, b] - gj], -1)  # in (0,1)
+            swh = jnp.stack(
+                [jnp.log(jnp.maximum(gw[:, b] / an[a_idx, 0], 1e-9)),
+                 jnp.log(jnp.maximum(gh[:, b] / an[a_idx, 1], 1e-9))], -1)
+            for d in range(2):
+                xy_t = xy_t.at[batch, s, d, gj, gi].set(
+                    jnp.where(sel, sxy[:, d], xy_t[batch, s, d, gj, gi]))
+                wh_t = wh_t.at[batch, s, d, gj, gi].set(
+                    jnp.where(sel, swh[:, d], wh_t[batch, s, d, gj, gi]))
+            scale = 2.0 - gb[:, b, 2] * gb[:, b, 3]
+            box_w = box_w.at[batch, s, gj, gi].set(
+                jnp.where(sel, scale * gs[:, b], box_w[batch, s, gj, gi]))
+            lbl = jnp.clip(gl[:, b], 0, C - 1)
+            cls_t = cls_t.at[batch, s, lbl, gj, gi].set(
+                jnp.where(sel, 1.0, cls_t[batch, s, lbl, gj, gi]))
+
+    # ---- ignore mask: predicted boxes overlapping any gt above thresh are
+    # not penalized as background
+    grid_x = jnp.arange(W, dtype=jnp.float32)
+    grid_y = jnp.arange(H, dtype=jnp.float32)[:, None]
+    px = (jax.nn.sigmoid(tx) * scale_x_y - (scale_x_y - 1) / 2 + grid_x) / W
+    py = (jax.nn.sigmoid(ty) * scale_x_y - (scale_x_y - 1) / 2 + grid_y) / H
+    pw = jnp.exp(jnp.clip(tw, -10, 10)) * mask_an[:, 0][:, None, None] / in_w
+    ph = jnp.exp(jnp.clip(th, -10, 10)) * mask_an[:, 1][:, None, None] / in_h
+
+    def iou_cell(px, py, pw, ph, qx, qy, qw, qh):
+        x1 = jnp.maximum(px - pw / 2, qx - qw / 2)
+        x2 = jnp.minimum(px + pw / 2, qx + qw / 2)
+        y1 = jnp.maximum(py - ph / 2, qy - qh / 2)
+        y2 = jnp.minimum(py + ph / 2, qy + qh / 2)
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        return inter / jnp.maximum(pw * ph + qw * qh - inter, 1e-9)
+
+    best_iou = jnp.zeros((N, S, H, W))
+    for b in range(B):
+        i = iou_cell(px, py, pw, ph,
+                     gb[:, b, 0][:, None, None, None],
+                     gb[:, b, 1][:, None, None, None],
+                     gb[:, b, 2][:, None, None, None],
+                     gb[:, b, 3][:, None, None, None])
+        best_iou = jnp.maximum(best_iou,
+                               jnp.where(valid[:, b][:, None, None, None],
+                                         i, 0.0))
+    noobj_mask = (best_iou < ignore_thresh).astype(jnp.float32)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    axes = (1, 2, 3)
+    loss_xy = jnp.sum(box_w[:, :, None] * bce(
+        jnp.stack([tx, ty], 2), xy_t), (1, 2, 3, 4))
+    loss_wh = jnp.sum(box_w[:, :, None] * jnp.abs(
+        jnp.stack([tw, th], 2) - wh_t) * obj_t[:, :, None], (1, 2, 3, 4))
+    loss_obj = jnp.sum(obj_w * bce(tobj, obj_t) * obj_t, axes) + \
+        jnp.sum(noobj_mask * bce(tobj, obj_t) * (1 - obj_t), axes)
+    smooth = 1.0 / max(C, 1) if use_label_smooth else 0.0
+    cls_target = cls_t * (1 - smooth) + smooth / max(C, 1)
+    loss_cls = jnp.sum(obj_t[:, :, None] * bce(tcls, cls_target),
+                       (1, 2, 3, 4))
+    return Tensor(loss_xy + loss_wh + loss_obj + loss_cls)

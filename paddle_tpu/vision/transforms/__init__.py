@@ -602,3 +602,9 @@ class RandomPerspective(BaseTransform):
         sy = (m[0, 0] * yy + m[0, 1] * xx + m[0, 2]) / den
         sx = (m[1, 0] * yy + m[1, 1] * xx + m[1, 2]) / den
         return _inverse_warp(arr, sy, sx, self.fill)
+
+
+from . import functional  # noqa: E402,F401
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: E402,F401
+                         adjust_hue, affine, center_crop, crop, erase, pad,
+                         perspective, rotate, to_grayscale)

@@ -426,3 +426,191 @@ class TestAudioBackend:
         assert paddle.audio.get_current_backend() == "wave"
         with pytest.raises(NotImplementedError):
             paddle.audio.set_backend("nonexistent")
+
+
+class TestNnUtils:
+    """ref python/paddle/nn/utils/ — weight_norm/spectral_norm hooks +
+    parameter vector transforms."""
+
+    def test_weight_norm_roundtrip_and_grads(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4).astype("float32"))
+        y0 = np.asarray(lin(x).value)
+        nn.utils.weight_norm(lin, "weight", dim=0)
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names \
+            and "weight" not in names
+        np.testing.assert_allclose(np.asarray(lin(x).value), y0,
+                                   rtol=1e-5, atol=1e-6)
+        b_np = np.asarray(lin.bias.value)
+        g_np = np.asarray(names["weight_g"].value)
+        v_np = np.asarray(names["weight_v"].value)
+        (lin(x) ** 2).sum().backward()
+        assert names["weight_g"].grad is not None
+        assert names["weight_v"].grad is not None
+        # grads must match jax.grad of the true reparameterized loss (the
+        # norm is ON the tape — review r3 finding)
+        import jax
+        import jax.numpy as jnp
+
+        x_np = np.asarray(x.value)
+
+        def true_loss(g, v):
+            axes = tuple(i for i in range(v.ndim) if i != 0)
+            norm = jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+            return jnp.sum((x_np @ (v * (g / norm)) + b_np) ** 2)
+
+        tg = jax.grad(true_loss, argnums=(0, 1))(jnp.asarray(g_np),
+                                                 jnp.asarray(v_np))
+        np.testing.assert_allclose(np.asarray(names["weight_g"].grad.value),
+                                   np.asarray(tg[0]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(names["weight_v"].grad.value),
+                                   np.asarray(tg[1]), rtol=1e-4, atol=1e-5)
+        nn.utils.remove_weight_norm(lin, "weight")
+        assert "weight" in dict(lin.named_parameters())
+        np.testing.assert_allclose(np.asarray(lin(x).value), y0,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_spectral_norm_unit_sigma(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        lin = nn.Linear(6, 5)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=5)
+        lin(paddle.to_tensor(np.zeros((1, 6), "float32")))
+        sigma = np.linalg.svd(np.asarray(lin.weight.value),
+                              compute_uv=False)[0]
+        assert abs(sigma - 1.0) < 0.05, sigma
+
+    def test_parameter_vector_roundtrip(self):
+        from paddle_tpu import nn
+
+        params = list(nn.Linear(3, 2).parameters())
+        vec = nn.utils.parameters_to_vector(params)
+        assert vec.shape == [8]
+        orig = [np.asarray(p.value).copy() for p in params]
+        nn.utils.vector_to_parameters(vec * 2.0, params)
+        for o, p in zip(orig, params):
+            np.testing.assert_allclose(np.asarray(p.value), o * 2, rtol=1e-6)
+
+    def test_set_global_initializer(self):
+        from paddle_tpu import nn
+
+        nn.initializer.set_global_initializer(
+            nn.initializer.Constant(0.5), nn.initializer.Constant(-1.0))
+        try:
+            lin = nn.Linear(2, 2)
+            assert np.allclose(np.asarray(lin.weight.value), 0.5)
+            assert np.allclose(np.asarray(lin.bias.value), -1.0)
+        finally:
+            nn.initializer.set_global_initializer(None)
+
+
+class TestIncubateOps:
+    """ref python/paddle/incubate/operators/ graph + fused softmax family."""
+
+    def test_segment_and_send_recv(self):
+        import paddle_tpu as p
+
+        x = p.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+        ids = p.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(
+            np.asarray(p.incubate.segment_sum(x, ids).value),
+            [[4, 6], [5, 6]])
+        out = p.incubate.graph_send_recv(
+            x, p.to_tensor(np.array([0, 1, 2, 0])),
+            p.to_tensor(np.array([1, 2, 1, 0])))
+        np.testing.assert_allclose(np.asarray(out.value),
+                                   [[1, 2], [6, 8], [3, 4]])
+
+    def test_graph_sampling_chain(self):
+        import paddle_tpu as p
+
+        row = p.to_tensor(np.array([1, 2, 0, 2, 0, 1]))
+        colptr = p.to_tensor(np.array([0, 2, 4, 6]))
+        nb, cnt = p.incubate.graph_sample_neighbors(
+            row, colptr, p.to_tensor(np.array([0, 1])), sample_size=-1)
+        assert np.asarray(cnt.value).tolist() == [2, 2]
+        rs, rd, on = p.incubate.graph_reindex(
+            p.to_tensor(np.array([0, 1])), nb, cnt)
+        assert np.asarray(on.value).tolist()[:2] == [0, 1]
+        es, ed, si, rx = p.incubate.graph_khop_sampler(
+            row, colptr, p.to_tensor(np.array([0])), [2, 2])
+        assert np.asarray(es.value).size == 6
+
+    def test_fused_softmax_and_identity_loss(self):
+        import paddle_tpu as p
+
+        a = p.to_tensor(np.random.RandomState(0).randn(2, 4, 4)
+                        .astype("float32"))
+        m = p.to_tensor(np.zeros((2, 4, 4), np.float32))
+        s1 = np.asarray(p.incubate.softmax_mask_fuse(a, m).value)
+        s2 = np.asarray(p.incubate.softmax_mask_fuse_upper_triangle(a).value)
+        assert np.allclose(s1.sum(-1), 1, atol=1e-5)
+        assert np.allclose(s2.sum(-1), 1, atol=1e-5)
+        assert abs(s2[0, 0, 1]) < 1e-6  # causal
+        assert np.isfinite(float(np.asarray(
+            p.incubate.identity_loss(a, "mean").value)))
+
+
+class TestAutogradExtras:
+    def test_set_grad_enabled(self):
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+        with paddle.autograd.set_grad_enabled(False):
+            y = (x * 2).sum()
+        assert y.stop_gradient
+        with paddle.autograd.set_grad_enabled(True):
+            z = (x * 2).sum()
+        z.backward()
+        assert x.grad is not None
+
+    def test_saved_tensors_hooks_pack_unpack(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+        packed, unpacked = [], []
+
+        def pack(t):
+            packed.append(True)
+            return np.asarray(t.value)  # offload to host
+
+        def unpack(v):
+            unpacked.append(True)
+            return paddle.to_tensor(v)
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return g * 2.0 * x
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        with saved_tensors_hooks(pack, unpack):
+            y = Sq.apply(x)
+        y.sum().backward()
+        assert packed and unpacked
+        np.testing.assert_allclose(np.asarray(x.grad.value), [6.0])
+
+
+class TestFftExtras:
+    def test_hfftn_ihfftn_roundtrip(self):
+        import paddle_tpu as p
+
+        rng = np.random.RandomState(0)
+        real = rng.randn(4, 8).astype("float64")
+        spec = p.fft.ihfftn(p.to_tensor(real))
+        back = p.fft.hfftn(spec, s=real.shape)
+        np.testing.assert_allclose(np.asarray(back.value), real,
+                                   rtol=1e-6, atol=1e-8)

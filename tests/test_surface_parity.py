@@ -1,0 +1,233 @@
+"""Namespace surface parity gate: every public name the reference exports
+from its module __init__ (__all__ when defined, else the import list) must
+resolve on our package — the module-level analogue of the tensor-op sweep
+gate (zero unexplained absences, VERDICT r2 items 4/7 methodology).
+
+Also drills the features added to close the round-3 gaps: functional
+transforms, vision io/yolo_loss, distributed extras, static
+serialization/metric family."""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+MODULES = {
+    "nn": "nn/__init__.py",
+    "nn.functional": "nn/functional/__init__.py",
+    "nn.initializer": "nn/initializer/__init__.py",
+    "nn.utils": "nn/utils/__init__.py",
+    "fft": "fft.py",
+    "signal": "signal.py",
+    "optimizer": "optimizer/__init__.py",
+    "distribution": "distribution/__init__.py",
+    "vision.transforms": "vision/transforms/__init__.py",
+    "vision.models": "vision/models/__init__.py",
+    "vision.ops": "vision/ops.py",
+    "io": "io/__init__.py",
+    "amp": "amp/__init__.py",
+    "metric": "metric/__init__.py",
+    "sparse": "sparse/__init__.py",
+    "distributed": "distributed/__init__.py",
+    "incubate": "incubate/__init__.py",
+    "static": "static/__init__.py",
+    "jit": "jit/__init__.py",
+    "autograd": "autograd/__init__.py",
+    "text": "text/__init__.py",
+}
+
+
+def _ref_surface(path):
+    tree = ast.parse(open(path).read())
+    allv, imports = None, set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                imports.add(a.asname or a.name)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    try:
+                        allv = set(ast.literal_eval(node.value))
+                    except Exception:
+                        pass
+    s = allv if allv is not None else imports
+    return {n for n in s if not n.startswith("_") and n != "*"}
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference tree absent")
+@pytest.mark.parametrize("mod,rel", sorted(MODULES.items()))
+def test_module_surface_complete(mod, rel):
+    ref = _ref_surface(os.path.join(REF, rel))
+    ours = paddle
+    for part in mod.split("."):
+        ours = getattr(ours, part)
+    missing = sorted(n for n in ref if not hasattr(ours, n))
+    assert not missing, f"paddle.{mod} missing reference names: {missing}"
+
+
+# --------------------------------------------------------------------------
+# drills for the gap-closing features
+# --------------------------------------------------------------------------
+
+
+class TestFunctionalTransforms:
+    def test_color_and_geometry_ops(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = (np.random.RandomState(0).rand(8, 10, 3) * 255).astype(np.uint8)
+        assert T.adjust_brightness(img, 2.0).max() <= 255
+        assert T.adjust_contrast(img, 0.5).shape == img.shape
+        assert T.adjust_hue(img, 0.25).shape == img.shape
+        assert T.to_grayscale(img).shape == (8, 10, 1)
+        assert T.crop(img, 2, 3, 4, 5).shape == (4, 5, 3)
+        assert T.center_crop(img, 6).shape == (6, 6, 3)
+        assert T.pad(img, 2).shape == (12, 14, 3)
+        corners = [(0, 0), (9, 0), (0, 7), (9, 7)]
+        np.testing.assert_array_equal(
+            T.perspective(img, corners, corners), img)
+        m = np.zeros((5, 5), np.uint8)
+        m[0, 0] = 9
+        assert T.rotate(m, 90).sum() == 9  # mass-preserving rotation
+        e = T.erase(np.array(img), 1, 1, 3, 3, 0)
+        assert (e[1:4, 1:4] == 0).all()
+
+
+class TestVisionIoAndYolo:
+    def test_read_decode_jpeg(self, tmp_path):
+        pytest.importorskip("PIL")
+        from PIL import Image
+
+        from paddle_tpu.vision import ops as V
+
+        img = (np.random.RandomState(0).rand(16, 20, 3) * 255
+               ).astype(np.uint8)
+        p = str(tmp_path / "t.jpg")
+        Image.fromarray(img).save(p, quality=95)
+        arr = np.asarray(V.decode_jpeg(V.read_file(p)).value)
+        assert arr.shape == (3, 16, 20)
+        assert abs(arr.astype(float).mean() -
+                   img.astype(float).mean()) < 10
+
+    def test_yolo_loss_direction(self):
+        from paddle_tpu.vision import ops as V
+
+        N, S, C, H, W = 2, 3, 4, 5, 5
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+        rng = np.random.RandomState(0)
+        x = rng.randn(N, S * (5 + C), H, W).astype("float32") * 0.1
+        gt_box = np.zeros((N, 4, 4), "float32")
+        gt_box[:, 0] = [0.5, 0.5, 0.1, 0.12]
+        gt_label = np.zeros((N, 4), "int64")
+
+        def loss_of(xa):
+            return np.asarray(V.yolo_loss(
+                paddle.to_tensor(xa), paddle.to_tensor(gt_box),
+                paddle.to_tensor(gt_label), anchors, [0, 1, 2], C,
+                0.7, 32).value)
+
+        l0 = loss_of(x)
+        assert l0.shape == (N,) and np.all(np.isfinite(l0))
+        x2 = x.copy().reshape(N, S, 5 + C, H, W)
+        x2[:, 1, 0:2, 2, 2] = 0.0
+        x2[:, 1, 2, 2, 2] = 0.0
+        x2[:, 1, 3, 2, 2] = np.log(19.2 / 30.0)
+        x2[:, 1, 4, 2, 2] = 8.0
+        x2[:, 1, 5, 2, 2] = 8.0
+        assert np.all(loss_of(x2.reshape(N, -1, H, W)) < l0)
+
+
+class TestDistributedExtras:
+    def test_misc_surface(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.is_available()
+        assert dist.ParallelMode.SHARDING_PARALLEL == 3
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(dist.alltoall_single(x).value), np.arange(8))
+        objs = []
+        dist.scatter_object_list(objs, [{"a": 1}, {"b": 2}])
+        assert objs == [{"a": 1}]
+
+    def test_split_parallel_layers(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import set_global_mesh
+        from paddle_tpu.distributed.topology import build_mesh
+
+        set_global_mesh(build_mesh(dp=2, mp=4))
+        try:
+            y = dist.split(paddle.to_tensor(
+                np.random.randn(2, 8).astype("float32")),
+                (8, 12), "linear", axis=1)
+            assert tuple(y.shape) == (2, 12)
+            e = dist.split(paddle.to_tensor(
+                np.array([[1, 2], [3, 0]], np.int64)), (16, 6), "embedding")
+            assert tuple(e.shape) == (2, 2, 6)
+        finally:
+            set_global_mesh(None)
+
+
+class TestStaticExtras:
+    def test_accuracy_auc(self):
+        import paddle_tpu.static as st
+
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32"))
+        lbl = paddle.to_tensor(np.array([[1], [0], [0]], "int64"))
+        assert abs(float(np.asarray(st.accuracy(pred, lbl).value))
+                   - 2 / 3) < 1e-6
+        p2 = paddle.to_tensor(np.array([[0.1, 0.9], [0.9, 0.1]], "float32"))
+        l2 = paddle.to_tensor(np.array([[1], [0]], "int64"))
+        assert float(np.asarray(st.auc(p2, l2)[0].value)) > 0.99
+
+    def test_program_save_load_roundtrip(self, tmp_path):
+        import paddle_tpu.static as st
+
+        paddle.enable_static()
+        try:
+            main, startup = st.Program(), st.Program()
+            with st.program_guard(main, startup):
+                x = st.data("x", [None, 4], "float32")
+                w = st.create_parameter([4, 2], "float32")
+                y = paddle.matmul(x, w)
+            exe = st.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((3, 4), "float32")}
+            out1 = exe.run(main, feed=feed, fetch_list=[y])[0]
+            prefix = str(tmp_path / "prog")
+            st.save(main, prefix)
+            manifest = st.deserialize_program(
+                st.load_from_file(prefix + ".pdmodel"))
+            assert manifest["params"]
+            state = st.load_program_state(prefix)
+            st.set_program_state(main, {k: v * 0 for k, v in state.items()})
+            assert np.allclose(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[y])[0]), 0)
+            st.load(main, prefix, exe)
+            np.testing.assert_allclose(
+                np.asarray(exe.run(main, feed=feed, fetch_list=[y])[0]),
+                np.asarray(out1), rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+    def test_ema_apply_restore(self):
+        import paddle_tpu.static as st
+
+        ema = st.ExponentialMovingAverage(0.9)
+        lin = paddle.nn.Linear(2, 2)
+        ema._params = [(n, p) for n, p in lin.named_parameters()]
+        w0 = np.asarray(lin.weight.value).copy()
+        ema.update()
+        lin.weight._value = lin.weight.value * 3.0
+        ema.update()
+        with ema.apply():
+            w_ema = np.asarray(lin.weight.value)
+            assert not np.allclose(w_ema, w0 * 3)  # shadow, not current
+        np.testing.assert_allclose(np.asarray(lin.weight.value), w0 * 3,
+                                   rtol=1e-6)  # restored
