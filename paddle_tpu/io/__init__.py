@@ -451,3 +451,7 @@ def get_worker_info():
     from .worker_pool import get_worker_info as _impl
 
     return _impl()
+
+
+from .bucketing import (LengthBucketBatchSampler, bucket_boundaries,  # noqa: E402,F401
+                        pad_to_bucket)

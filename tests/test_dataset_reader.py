@@ -334,3 +334,82 @@ class TestMultiprocessDataLoader:
 
         with pytest.raises(ValueError, match="boom-5"):
             list(paddle.io.DataLoader(_Boom(), batch_size=2, num_workers=2))
+
+
+class TestLengthBucketing:
+    """Dynamic-shape policy (SURVEY §7 hard part (e)): variable-length
+    batches must map to a FIXED shape ladder so XLA compiles O(log max_len)
+    programs instead of one per distinct length."""
+
+    def test_bucket_ladder_lane_aligned(self):
+        bs = paddle.io.bucket_boundaries(2048, min_len=32, growth=1.3)
+        assert bs[-1] == 2048 and bs == sorted(set(bs))
+        assert all(b % 8 == 0 or b == 2048 for b in bs)
+        assert len(bs) < 20  # O(log): the compile-count cap
+
+    def test_pad_to_bucket_masks_labels(self):
+        ids = np.arange(2 * 37, dtype=np.int32).reshape(2, 37)
+        labels = np.ones((2, 37), np.int64)
+        bs = paddle.io.bucket_boundaries(128, min_len=16)
+        out, lab, true_len = paddle.io.pad_to_bucket(
+            ids, bs, pad_value=0, labels=labels)
+        assert true_len == 37 and out.shape[-1] in bs
+        assert out.shape == lab.shape
+        assert (lab[:, 37:] == -100).all()  # padded positions out of loss
+        np.testing.assert_array_equal(out[:, :37], ids)
+
+    def test_sampler_bounds_compile_count(self):
+        """The real contract: one jit compile per bucket, not per length."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        lengths = rng.randint(10, 100, size=64)
+        bs = paddle.io.bucket_boundaries(100, min_len=16, growth=1.5)
+        sampler = paddle.io.LengthBucketBatchSampler(
+            lengths, batch_size=4, buckets=bs, shuffle=True)
+        shapes = set()
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x * 2)
+
+        for batch_idx in sampler:
+            S = int(max(lengths[i] for i in batch_idx))
+            ids = np.zeros((len(batch_idx), S), np.int32)
+            padded, _, _ = paddle.io.pad_to_bucket(ids, bs)
+            shapes.add(padded.shape[-1])
+            step(jnp.asarray(padded))
+        assert shapes <= set(bs)
+        assert len(shapes) <= len(bs) < len(set(lengths))
+        # every sample appears exactly once per epoch
+        seen = sorted(i for b in sampler for i in b)
+        assert seen == list(range(64))
+
+    def test_validation_and_dp_sharding(self):
+        with pytest.raises(ValueError):
+            paddle.io.bucket_boundaries(128, growth=1.0)
+        with pytest.raises(ValueError):
+            paddle.io.bucket_boundaries(4, min_len=8)
+        with pytest.raises(ValueError):  # shifted labels must be rejected
+            paddle.io.pad_to_bucket(np.zeros((2, 37), np.int32), [64],
+                                    labels=np.zeros((2, 36), np.int64))
+        lengths = np.full(32, 20)
+        ranks = [list(paddle.io.LengthBucketBatchSampler(
+            lengths, batch_size=4, buckets=[32], shuffle=False,
+            num_replicas=2, rank=r)) for r in (0, 1)]
+        assert len(ranks[0]) == len(ranks[1]) == 4
+        flat0 = {i for b in ranks[0] for i in b}
+        flat1 = {i for b in ranks[1] for i in b}
+        assert not (flat0 & flat1)  # disjoint shards
+
+    def test_sampler_epoch_reshuffle(self):
+        lengths = np.full(16, 20)
+        s = paddle.io.LengthBucketBatchSampler(lengths, batch_size=4,
+                                               buckets=[32], seed=1)
+        s.set_epoch(0)
+        e0 = [tuple(b) for b in s]
+        s.set_epoch(1)
+        e1 = [tuple(b) for b in s]
+        assert sorted(sum(e0, ())) == sorted(sum(e1, ()))
+        assert e0 != e1
