@@ -372,37 +372,35 @@ def spmd_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
     return per_shard
 
 
-def spmd_interleaved_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
-                                   num_stages: int, num_micro: int,
-                                   num_chunks: int, axis_name: str = "pipe"):
-    """Interleaved 1F1B (ref PipelineParallelWithInterleave
-    pipeline_parallel.py:461 — virtual stages in 1F1B order).
+def spmd_staggered_interleaved_1f1b(stage_fn: Callable,
+                                    post_loss_fn: Callable,
+                                    num_stages: int, num_micro: int,
+                                    num_chunks: int,
+                                    axis_name: str = "pipe"):
+    """Interleaved 1F1B with ONE chunk-op per device per tick — the
+    staggered tick chart that makes virtual stages actually shrink the
+    bubble (ref PipelineParallelWithInterleave pipeline_parallel.py:461;
+    Megatron-style grouped order):
 
-    Generalizes :func:`spmd_1f1b_train_fn` to ``num_chunks`` model chunks
-    per device: logical stage L = chunk*S + dev over SC = S*C stages,
-      fwd(m) at stage L: tick t = m + L
-      bwd(m) at stage L: tick t = m + 2*SC - 1 - L
-    T = M + 2*SC - 1 ticks.  Per tick each device runs one fwd + one bwd
-    per resident chunk; activations ring-rotate dev→dev+1 advancing a
-    chunk on the S-1→0 wrap, cotangents rotate dev→dev-1 retreating a
-    chunk on the 0→S-1 wrap.  Residual rings: C x min(2*SC-1, M) boundary
-    activations — O(stages), independent of M.
+      fwd(m) at logical stage L = c*S + d:  t = d + c*S + (m mod S)
+                                                + C*S*(m div S)
+      bwd(m):                               t = C*S + (S-1-d) + (C-1-c)*S
+                                                + (m mod S) + C*S*(m div S)
 
-    HONEST BUBBLE NOTE: in this LOCKSTEP rendering every tick executes all
-    C chunks per device, so per-tick cost is constant while the tick count
-    grows from M+2S-1 to M+2SC-1 — the bubble fraction is ~2SC/(M+2SC),
-    i.e. LARGER than num_chunks=1, not smaller.  The reference's interleave
-    reduces the bubble only under per-device asynchronous scheduling (one
-    CHUNK-op per time slot); the staggered-tick SPMD equivalent is
-    ``spmd_staggered_interleaved_1f1b`` territory — until that lands,
-    prefer num_chunks=1 with schedule="1f1b" for throughput; this path
-    exists for schedule parity and for stage-granularity flexibility.
+    For each (t, d) the decomposition is unique, so every device runs
+    exactly one fwd and one bwd slot per tick with a TRACED chunk index
+    (params are gathered by chunk inside the vjp, whose transpose
+    scatter-adds the grads back into the right chunk).  Total ticks
+    ~ C*M + (C+1)*S versus the plain schedule's M + 2S per C-times-larger
+    stage: normalized bubble drops from 2S/M to (1+1/C)*S/M.  The chart
+    also makes routing trivial: the single ppermuted activation arriving
+    each tick is exactly the operand of the receiver's scheduled op
+    (chunk advance on the S-1→0 wrap falls out of the +1-tick property).
 
-    stage_fn(chunk_id, params_chunk, x) -> y (leaves WITHOUT the chunk dim)
-    params_shard leaves: [1 (pipe shard), num_chunks, ...].
-    Returns (loss, d_params_shard, d_post_params, d_micro) like the plain
-    schedule; d_params_shard keeps the [1, C, ...] layout (out_specs
-    P(axis) reassembles [S, C, ...]).
+    Residual rings: [C, K] with K = min(3S+1, M) boundary activations per
+    chunk — O(stages), independent of M.
+    Returns (loss, d_params_shard [1, C, ...], d_post_params, d_micro)
+    like the plain schedule.
     """
 
     def per_shard(params_shard, post_params, micro, micro_labels):
@@ -413,12 +411,17 @@ def spmd_interleaved_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
         post_params = to_varying(post_params)
         dev = jax.lax.axis_index(axis_name)
         S, M, C = num_stages, num_micro, num_chunks
-        SC = S * C
-        K = min(2 * SC - 1, M)
-        T = M + 2 * SC - 1
+        CS = C * S
+        K = min(3 * S + 1, M)
+        T = (CS + (S - 1) + (C - 1) * S + ((M - 1) % S)
+             + CS * ((M - 1) // S) + 1)
 
-        def chunk_params(c):
-            return jax.tree_util.tree_map(lambda p: p[0][c], params_shard)
+        def fwd_c(pfull, x, c):
+            # c is TRACED here (one op per tick, chunk chosen by the
+            # chart): stage_fn receives it as a tracer — chunk-dependent
+            # behavior must branch with lax.switch, not Python `if`
+            pc = jax.tree_util.tree_map(lambda p: p[0][c], pfull)
+            return stage_fn(c, pc, x)
 
         def scaled_post(pp, y, lb):
             return post_loss_fn(pp, y, lb) / M
@@ -430,113 +433,99 @@ def spmd_interleaved_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
             return jax.tree_util.tree_map(
                 lambda x, y: jnp.where(pred, x, y), a, b)
 
-        def at_chunk(tree, c):
-            return jax.tree_util.tree_map(lambda x: x[c], tree)
+        def decode_fwd(t):
+            u = t - dev
+            uc = jnp.maximum(u, 0)
+            g = uc // CS
+            rem = uc % CS
+            c = rem // S
+            m = g * S + (rem % S)
+            valid = (u >= 0) & (m < M)
+            return c, jnp.clip(m, 0, M - 1), valid
+
+        def decode_bwd(t):
+            u = t - CS - (S - 1 - dev)
+            uc = jnp.maximum(u, 0)
+            g = uc // CS
+            rem = uc % CS
+            c = (C - 1) - rem // S
+            m = g * S + (rem % S)
+            valid = (u >= 0) & (m < M)
+            return c, jnp.clip(m, 0, M - 1), valid
 
         def tick(carry, t):
-            (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post,
+            (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post,
              d_micro, loss_acc) = carry
-            y_sends, dx_sends = [], []
-            for c in range(C):
-                L = c * S + dev
-                # ---- backward half for chunk c
-                mb_b = t - (2 * SC - 1 - L)
-                valid_b = (mb_b >= 0) & (mb_b < M)
-                slot_b = jnp.clip(mb_b, 0, M - 1) % K
-                x_in = jax.tree_util.tree_map(lambda r: r[c][slot_b], resid)
-                ct_in = select(L == SC - 1, pending_ct, at_chunk(bwd_grads, c))
-                _, vjp_fn = jax.vjp(
-                    lambda p, x, _c=c: stage_fn(_c, p, x),
-                    chunk_params(c), x_in)
-                dp, dx = vjp_fn(ct_in)
-                g_stk = jax.tree_util.tree_map(
-                    lambda g, d: g.at[0, c].add(jnp.where(valid_b, d, 0)),
-                    g_stk, dp)
-                if c == 0:  # L == 0 is only reachable for chunk 0
-                    write0 = valid_b & (L == 0)
-                    mb_c = jnp.clip(mb_b, 0, M - 1)
-                    d_micro = jax.tree_util.tree_map(
-                        lambda buf, d: buf.at[mb_c].set(
-                            jnp.where(write0, d, buf[mb_c])), d_micro, dx)
-                dx_sends.append(select(valid_b, dx, zeros_like_t(dx)))
 
-                # ---- forward half for chunk c
-                mb_f = t - L
-                valid_f = (mb_f >= 0) & (mb_f < M)
-                mb_cf = jnp.clip(mb_f, 0, M - 1)
-                if c == 0:  # L == 0 (feed from micro) only exists here
-                    mb = jax.tree_util.tree_map(lambda x: x[mb_cf], micro)
-                    x = select(L == 0, mb, at_chunk(fwd_acts, c))
-                else:
-                    x = at_chunk(fwd_acts, c)
-                y = stage_fn(c, chunk_params(c), x)
-                slot_f = mb_cf % K
-                resid = jax.tree_util.tree_map(
-                    lambda r, v, _c=c, _s=slot_f, _vf=valid_f: r.at[_c, _s].set(
-                        jnp.where(_vf, v, r[_c, _s])), resid, x)
-                if c == C - 1:  # L == SC-1 (head+loss) only exists here —
-                    # skipping the other chunks' dead value_and_grads saves
-                    # C-1 head+CE computations per tick (XLA cannot DCE
-                    # them: dev is traced)
-                    lb = jax.tree_util.tree_map(lambda x: x[mb_cf],
-                                                micro_labels)
-                    take = (L == SC - 1) & valid_f
-                    loss_m, (gp, gy) = jax.value_and_grad(
-                        scaled_post, argnums=(0, 1))(post_params, y, lb)
-                    loss_acc = loss_acc + jnp.where(take, loss_m, 0.0)
-                    g_post = jax.tree_util.tree_map(
-                        lambda g, d: g + jnp.where(take, d, 0), g_post, gp)
-                    pending_ct = select(take, gy, pending_ct)
-                y_sends.append(select(valid_f, y, zeros_like_t(y)))
+            # ---- backward slot (consumes last tick's cotangent)
+            c_b, m_b, valid_b = decode_bwd(t)
+            slot_b = m_b % K
+            x_in = jax.tree_util.tree_map(lambda r: r[c_b, slot_b], resid)
+            last_b = (dev == S - 1) & (c_b == C - 1)
+            ct_in = select(last_b, pending_ct, bwd_grad)
+            _, vjp_fn = jax.vjp(
+                lambda p, x: fwd_c(p, x, c_b), params_shard, x_in)
+            dp_full, dx = vjp_fn(ct_in)
+            # gather's transpose already scattered dp into chunk c_b
+            g_stk = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(valid_b, d, 0), g_stk, dp_full)
+            first_b = valid_b & (dev == 0) & (c_b == 0)
+            d_micro = jax.tree_util.tree_map(
+                lambda buf, d: buf.at[m_b].set(
+                    jnp.where(first_b, d, buf[m_b])), d_micro, dx)
+            dx_send = select(valid_b, dx, zeros_like_t(dx))
 
-            # ---- one rotation each way for all chunks
-            y_stack = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs, axis=0), *y_sends)
-            dx_stack = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs, axis=0), *dx_sends)
-            fwd_rot = jax.lax.ppermute(
-                y_stack, axis_name, [(i, (i + 1) % S) for i in range(S)])
-            bwd_rot = jax.lax.ppermute(
-                dx_stack, axis_name, [(i, (i - 1) % S) for i in range(S)])
+            # ---- forward slot
+            c_f, m_f, valid_f = decode_fwd(t)
+            mb = jax.tree_util.tree_map(lambda x: x[m_f], micro)
+            lb = jax.tree_util.tree_map(lambda x: x[m_f], micro_labels)
+            first_f = (dev == 0) & (c_f == 0)
+            x = select(first_f, mb, fwd_act)
+            y = fwd_c(params_shard, x, c_f)
+            slot_f = m_f % K
+            resid = jax.tree_util.tree_map(
+                lambda r, v: r.at[c_f, slot_f].set(
+                    jnp.where(valid_f, v, r[c_f, slot_f])), resid, x)
+            take = (dev == S - 1) & (c_f == C - 1) & valid_f
+            loss_m, (gp, gy) = jax.value_and_grad(
+                scaled_post, argnums=(0, 1))(post_params, y, lb)
+            loss_acc = loss_acc + jnp.where(take, loss_m, 0.0)
+            g_post = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(take, d, 0), g_post, gp)
+            pending_ct = select(take, gy, pending_ct)
+            y_send = select(valid_f, y, zeros_like_t(y))
 
-            def fwd_reroute(r):
-                # dev 0 receives from dev S-1: logical c*S+S-1 -> (c+1)*S+0,
-                # so chunk c's inbox gets the sender's chunk c-1
-                shifted = jnp.concatenate([jnp.zeros_like(r[:1]), r[:-1]], 0)
-                return jnp.where(dev == 0, shifted, r)
-
-            def bwd_reroute(r):
-                # dev S-1 receives from dev 0: logical c*S -> (c-1)*S+S-1,
-                # so chunk c's inbox gets the sender's chunk c+1
-                shifted = jnp.concatenate([r[1:], jnp.zeros_like(r[:1])], 0)
-                return jnp.where(dev == S - 1, shifted, r)
-
-            fwd_acts = jax.tree_util.tree_map(fwd_reroute, fwd_rot)
-            bwd_grads = jax.tree_util.tree_map(bwd_reroute, bwd_rot)
-            return (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post,
+            # ---- one rotation each way; the arriving value is exactly the
+            # receiver's next scheduled operand (chart +1-tick property)
+            fwd_act = jax.lax.ppermute(
+                y_send, axis_name, [(i, (i + 1) % S) for i in range(S)])
+            bwd_grad = jax.lax.ppermute(
+                dx_send, axis_name, [(i, (i - 1) % S) for i in range(S)])
+            return (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post,
                     d_micro, loss_acc), None
 
         act_proto = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x[0]),
                                            micro)
-        y_shape = jax.eval_shape(lambda a: stage_fn(0, chunk_params(0), a),
-                                 act_proto)
+        y_shape = jax.eval_shape(
+            lambda a: fwd_c(params_shard, a, 0), act_proto)
         zvary = lambda shape, dtype: jax.lax.pcast(
             jnp.zeros(shape, dtype), (axis_name,), to="varying")
         carry0 = (
-            jax.tree_util.tree_map(                       # fwd_acts [C, ...]
-                lambda x: zvary((C,) + tuple(x.shape), x.dtype), act_proto),
-            jax.tree_util.tree_map(                       # bwd_grads [C, ...]
-                lambda x: zvary((C,) + tuple(x.shape), x.dtype), act_proto),
-            jax.tree_util.tree_map(                       # pending_ct
+            jax.tree_util.tree_map(
+                lambda x: zvary(tuple(x.shape), x.dtype), act_proto),
+            jax.tree_util.tree_map(
+                lambda x: zvary(tuple(x.shape), x.dtype), act_proto),
+            jax.tree_util.tree_map(
                 lambda s: zvary(tuple(s.shape), s.dtype), y_shape),
-            jax.tree_util.tree_map(                       # resid [C, K, ...]
-                lambda x: zvary((C, K) + tuple(x.shape), x.dtype), act_proto),
-            zeros_like_t(params_shard),                   # g_stk
-            zeros_like_t(post_params),                    # g_post
-            jax.tree_util.tree_map(jnp.zeros_like, micro),  # d_micro [M, ...]
+            jax.tree_util.tree_map(
+                lambda x: zvary((C, K) + tuple(x.shape), x.dtype),
+                act_proto),
+            zeros_like_t(params_shard),
+            zeros_like_t(post_params),
+            jax.tree_util.tree_map(jnp.zeros_like, micro),
             jax.lax.pcast(jnp.float32(0.0), (axis_name,), to="varying"),
         )
-        (fwd_acts, bwd_grads, pending_ct, resid, g_stk, g_post, d_micro,
+        (fwd_act, bwd_grad, pending_ct, resid, g_stk, g_post, d_micro,
          loss_acc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
         loss = jax.lax.psum(loss_acc, axis_name)
         g_post = jax.tree_util.tree_map(
@@ -546,6 +535,7 @@ def spmd_interleaved_1f1b_train_fn(stage_fn: Callable, post_loss_fn: Callable,
         return loss, g_stk, g_post, d_micro
 
     return per_shard
+
 
 
 def spmd_interleaved_pipeline_fn(stage_fn: Callable, num_stages: int, num_micro: int,

@@ -342,7 +342,7 @@ class PipelineEngine:
         live activations — see ``spmd_1f1b_train_fn``.  Ref:
         python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117."""
         from ..distributed.fleet.meta_parallel.pipeline_parallel import (
-            spmd_1f1b_train_fn, spmd_interleaved_1f1b_train_fn)
+            spmd_1f1b_train_fn, spmd_staggered_interleaved_1f1b)
 
         mesh = self.mesh
         rest_frozen_names = [n for n in self.rest
@@ -358,7 +358,7 @@ class PipelineEngine:
             def chunk_fn(chunk_id, params_chunk, x):
                 return self._run_blocks(params_chunk, x)
 
-            fn = spmd_interleaved_1f1b_train_fn(chunk_fn, post_loss, S, M, C)
+            fn = spmd_staggered_interleaved_1f1b(chunk_fn, post_loss, S, M, C)
         else:
             fn = spmd_1f1b_train_fn(self._stage_fn, post_loss, S, M)
         post_names = self._post_names

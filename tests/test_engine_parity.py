@@ -282,20 +282,23 @@ def test_interleaved_pipeline_engine_matches_single_device():
                                    atol=5e-5, err_msg=k)
 
 
-def test_interleaved_1f1b_engine_matches_single_device():
-    """Interleaved 1F1B (ref PipelineParallelWithInterleave
-    pipeline_parallel.py:461 — virtual stages in true 1F1B order): loss at
-    the last LOGICAL stage inside the pipe region, per-chunk vjp backward,
-    chunk-advancing ring rotations. Weight parity vs single device."""
+@pytest.mark.parametrize("chunks,layers,micro", [(2, 8, 4), (3, 12, 5)],
+                         ids=["C2_M4", "C3_M5_odd"])
+def test_interleaved_1f1b_engine_matches_single_device(chunks, layers, micro):
+    """Staggered interleaved 1F1B (ref PipelineParallelWithInterleave
+    pipeline_parallel.py:461): ONE chunk-op per device per tick (traced
+    chunk index, vjp-transpose grad scatter), loss at the last logical
+    stage inside the pipe region. Weight parity vs single device, incl.
+    C=3 and M not divisible by S."""
     from paddle_tpu.parallel import llama_pipeline_engine
 
     cfg = _cfg()
-    cfg.num_hidden_layers = 8  # 2 stages x 2 chunks x 2 layers
+    cfg.num_hidden_layers = layers
     paddle.seed(9)
     ref_model = LlamaForCausalLM(cfg)
     init_state = {k: np.array(np.asarray(v.value))
                   for k, v in ref_model.state_dict().items()}
-    batches = _batches(cfg, n=2, B=8)
+    batches = _batches(cfg, n=2, B=2 * micro)
 
     single_mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
     ref_losses, ref_weights = _train(ref_model, single_mesh, batches)
@@ -307,7 +310,8 @@ def test_interleaved_1f1b_engine_matches_single_device():
     mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
     opt = AdamW(learning_rate=1e-2, parameters=pp_model.parameters())
     eng = llama_pipeline_engine(pp_model, optimizer=opt, mesh=mesh,
-                                num_micro=4, num_chunks=2, schedule="1f1b")
+                                num_micro=micro, num_chunks=chunks,
+                                schedule="1f1b")
     pp_losses = [float(np.asarray(eng.train_batch(
         paddle.to_tensor(x), paddle.to_tensor(y)).value))
         for x, y in batches]
