@@ -1,0 +1,31 @@
+#!/bin/bash
+# One-command on-hardware sequence (VERDICT r2 items 2/3/6) — run from the
+# repo root on a host that can reach a TPU chip.  Each stage is independent;
+# results land in BASELINE.md-ready form on stdout and under /tmp/tpu_runs.
+set -u
+mkdir -p /tmp/tpu_runs
+cd "$(dirname "$0")/.."
+
+echo "== 1. probe =="
+timeout 120 python -c "import jax; ds=jax.devices(); print('DEVOK', ds[0].platform, len(ds))" \
+  || { echo "TPU unreachable — aborting"; exit 1; }
+
+echo "== 2. compiled-Mosaic kernel tier (tests_tpu/) =="
+python -m pytest tests_tpu/ -q 2>&1 | tee /tmp/tpu_runs/tests_tpu.log | tail -3
+
+echo "== 3. flash block-size sweep (fwd, headline shape) =="
+python tools/bench_flash_sweep.py --shapes small 2>&1 | tee /tmp/tpu_runs/sweep_small.log | tail -12
+echo "== 3b. long-context sweep =="
+python tools/bench_flash_sweep.py --shapes long 2>&1 | tee /tmp/tpu_runs/sweep_long.log | tail -12
+echo "== 3c. fwd+bwd sweep (headline) =="
+python tools/bench_flash_sweep.py --shapes small --bwd 2>&1 | tee /tmp/tpu_runs/sweep_bwd.log | tail -12
+echo "adopt the winner via PT_FLASH_BLOCK_Q/PT_FLASH_BLOCK_K, then:"
+
+echo "== 4. headline bench (509M MFU + 1.3B extra) =="
+python bench.py 2>/tmp/tpu_runs/bench_err.log | tee /tmp/tpu_runs/bench.json
+
+echo "== 5. long-context rows =="
+BENCH_SKIP_LARGE=1 BENCH_B=2 BENCH_S=8192 python bench.py 2>/dev/null | tee /tmp/tpu_runs/bench_s8192.json
+BENCH_SKIP_LARGE=1 BENCH_B=1 BENCH_S=16384 python bench.py 2>/dev/null | tee /tmp/tpu_runs/bench_s16384.json
+
+echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="
