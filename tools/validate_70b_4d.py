@@ -130,6 +130,20 @@ def main():
             "pipeline ppermute missing from partitioned HLO"
         assert counts["all-reduce"] > 0
         assert counts["all-gather"] > 0, "ZeRO all-gathers missing"
+    # staggered interleaved 1F1B over the same 4D mesh: the new schedule
+    # must also lower at scale (loss-inside-pipe, traced chunk gather)
+    t0 = time.time()
+    model2 = LlamaForCausalLM(cfg)
+    opt2 = AdamW(learning_rate=1e-4, parameters=model2.parameters())
+    eng2 = llama_pipeline_engine(model2, optimizer=opt2, mesh=mesh,
+                                 num_micro=args.micro, remat=True,
+                                 abstract=True, fsdp=True,
+                                 num_chunks=2, schedule="1f1b")
+    txt2 = eng2.lower_train_step((ids,), (lbl,)).as_text()
+    n_shard2 = txt2.count("sdy.sharding") + txt2.count("mhlo.sharding")
+    print(f"1f1b-interleaved (C=2) lowered in {time.time()-t0:.0f}s; "
+          f"{len(txt2) // 1024}kB StableHLO, {n_shard2} annotations")
+    assert n_shard2 > 0
     print("70B 4D-hybrid (dp×sharding×tensor×pipe) validation OK")
 
 
