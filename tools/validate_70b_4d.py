@@ -131,19 +131,23 @@ def main():
         assert counts["all-reduce"] > 0
         assert counts["all-gather"] > 0, "ZeRO all-gathers missing"
     # staggered interleaved 1F1B over the same 4D mesh: the new schedule
-    # must also lower at scale (loss-inside-pipe, traced chunk gather)
-    t0 = time.time()
-    model2 = LlamaForCausalLM(cfg)
-    opt2 = AdamW(learning_rate=1e-4, parameters=model2.parameters())
-    eng2 = llama_pipeline_engine(model2, optimizer=opt2, mesh=mesh,
-                                 num_micro=args.micro, remat=True,
-                                 abstract=True, fsdp=True,
-                                 num_chunks=2, schedule="1f1b")
-    txt2 = eng2.lower_train_step((ids,), (lbl,)).as_text()
-    n_shard2 = txt2.count("sdy.sharding") + txt2.count("mhlo.sharding")
-    print(f"1f1b-interleaved (C=2) lowered in {time.time()-t0:.0f}s; "
-          f"{len(txt2) // 1024}kB StableHLO, {n_shard2} annotations")
-    assert n_shard2 > 0
+    # must also lower at scale (loss-inside-pipe, traced chunk gather).
+    # abstract=True only reads shapes/dtypes — reuse the SAME model/opt
+    # (a second eager build would double peak host RAM and build time)
+    if args.layers % 4 == 0:
+        t0 = time.time()
+        eng2 = llama_pipeline_engine(model, optimizer=opt, mesh=mesh,
+                                     num_micro=args.micro, remat=True,
+                                     abstract=True, fsdp=True,
+                                     num_chunks=2, schedule="1f1b")
+        txt2 = eng2.lower_train_step((ids,), (lbl,)).as_text()
+        n_shard2 = txt2.count("sdy.sharding") + txt2.count("mhlo.sharding")
+        print(f"1f1b-interleaved (C=2) lowered in {time.time()-t0:.0f}s; "
+              f"{len(txt2) // 1024}kB StableHLO, {n_shard2} annotations")
+        assert n_shard2 > 0, "no sharding annotations in 1f1b lowering"
+    else:
+        print(f"1f1b-interleaved (C=2) leg skipped: --layers {args.layers} "
+              f"not divisible by 2 stages x 2 chunks")
     print("70B 4D-hybrid (dp×sharding×tensor×pipe) validation OK")
 
 
