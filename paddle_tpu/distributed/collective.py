@@ -333,6 +333,13 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
             raise NotImplementedError(
                 "alltoall_single: unequal split sizes are not supported by "
                 "the XLA all_to_all lowering — pad to equal splits")
+    # the collective is meaningful when the input is sharded over the group
+    # axis (global chunk-ownership transpose); an eagerly replicated array
+    # is this process's own tensor — exchanged with itself (identity), the
+    # same world-per-process view the other eager collectives take
+    spec = tuple(getattr(getattr(v, "sharding", None), "spec", ()) or ())
+    if n > 1 and axis not in spec:
+        n = 1
     if n <= 1:
         if out_tensor is not None and isinstance(out_tensor, Tensor):
             out_tensor._value = v
@@ -340,9 +347,8 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
         return Tensor(v)
     out = _run_on_axis(
         v, axis,
-        lambda x: jax.lax.all_to_all(
-            x.reshape((n, -1) + x.shape[1:]), axis, split_axis=0,
-            concat_axis=0, tiled=False).reshape(x.shape))
+        lambda x: jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                     tiled=True))
     if out_tensor is not None and isinstance(out_tensor, Tensor):
         out_tensor._value = out
         return _Task(out)

@@ -145,7 +145,9 @@ class TestVisionIoAndYolo:
 class TestDistributedExtras:
     def test_misc_surface(self):
         import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import set_global_mesh
 
+        set_global_mesh(None)  # hermetic: earlier tests may leave a mesh
         assert dist.is_available()
         assert dist.ParallelMode.SHARDING_PARALLEL == 3
         x = paddle.to_tensor(np.arange(8, dtype=np.float32))
