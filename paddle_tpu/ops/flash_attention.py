@@ -676,17 +676,44 @@ def _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal: bool, scale: float,
 _FULL_K_MAX = 8192
 
 
-def _block_defaults():
-    """Tuning knobs (benchmarked via bench.py A/B; microbenchmarks are
-    unreliable through the remote-TPU tunnel)."""
+#: per-sequence-length-regime (block_q, block_k) defaults — populated from
+#: tools/bench_flash_sweep.py winners on hardware.  Key = max seq len of the
+#: regime (entries ascending); 512x512 measured best at S=2048 (BASELINE r1)
+#: and is the fallback for every regime until the sweep says otherwise.
+_BLOCK_REGIMES = {
+    4096: (512, 512),
+    16384: (512, 512),
+}
+
+
+def _block_defaults(seq_len: int = 0):
+    """Tuning knobs per shape regime (benchmarked via bench.py A/B and
+    tools/bench_flash_sweep.py).  Override order: PT_FLASH_BLOCK_Q/K
+    (global) > PT_FLASH_BLOCKS ("4096:512x512,16384:1024x512" regime map)
+    > _BLOCK_REGIMES table."""
     import os
 
-    return (int(os.environ.get("PT_FLASH_BLOCK_Q", 512)),
-            int(os.environ.get("PT_FLASH_BLOCK_K", 512)))
+    if os.environ.get("PT_FLASH_BLOCK_Q") or os.environ.get("PT_FLASH_BLOCK_K"):
+        return (int(os.environ.get("PT_FLASH_BLOCK_Q", 512)),
+                int(os.environ.get("PT_FLASH_BLOCK_K", 512)))
+    regimes = dict(_BLOCK_REGIMES)
+    env_map = os.environ.get("PT_FLASH_BLOCKS")
+    if env_map:
+        try:
+            for part in env_map.split(","):
+                s, blocks = part.split(":")
+                bq, bk = blocks.lower().split("x")
+                regimes[int(s)] = (int(bq), int(bk))
+        except ValueError:
+            pass  # malformed override: keep the table
+    for cap in sorted(regimes):
+        if seq_len <= cap:
+            return regimes[cap]
+    return regimes[max(regimes)]
 
 
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
-    dq, dk = _block_defaults()
+    dq, dk = _block_defaults(k.shape[2])
     block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_fwd_bhsd_loop(q, k, v, causal, scale, block_q, block_k)
@@ -695,7 +722,7 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
 
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal, scale,
                     block_q=None, block_k=None):
-    dq, dk = _block_defaults()
+    dq, dk = _block_defaults(k.shape[2])
     block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal, scale,
