@@ -82,6 +82,7 @@ def main():
                     help="time grad (fwd+bwd) instead of forward only")
     args = ap.parse_args()
 
+    winners = {}  # seq_len -> (bq, bk)
     for shape in SHAPES[args.shapes]:
         print(f"\n== shape B,S,H,KV,D = {shape} "
               f"({'fwd+bwd' if args.bwd else 'fwd'}) ==")
@@ -99,6 +100,15 @@ def main():
             best = rows[0]
             print(f"  BEST: {best[1]} at {best[0]:.3f} ms "
                   f"({best[2]:.1f} TFLOP/s)")
+            parts = dict(p.split("=") for p in best[1].split())
+            winners[shape[1]] = (int(parts["bq"]), int(parts["bk"]))
+    if winners:
+        # ready-to-adopt regime map for ops/flash_attention._BLOCK_REGIMES /
+        # the PT_FLASH_BLOCKS env override
+        adopt = ",".join(f"{s}:{bq}x{bk}"
+                         for s, (bq, bk) in sorted(winners.items()))
+        print(f"\nADOPT: PT_FLASH_BLOCKS=\"{adopt}\" "
+              f"(or fold into _BLOCK_REGIMES)")
 
 
 if __name__ == "__main__":
