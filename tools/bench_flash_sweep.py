@@ -93,15 +93,13 @@ def main():
             if r is None:
                 print(f"  {tag}: FAILED/OOM")
                 continue
-            rows.append((r["ms"], tag, r["tflops"]))
+            rows.append((r["ms"], bq, bk, tag, r["tflops"]))
             print(f"  {tag}: {r['ms']:7.3f} ms  {r['tflops']:6.1f} TFLOP/s")
         if rows:
             rows.sort()
-            best = rows[0]
-            print(f"  BEST: {best[1]} at {best[0]:.3f} ms "
-                  f"({best[2]:.1f} TFLOP/s)")
-            parts = dict(p.split("=") for p in best[1].split())
-            winners[shape[1]] = (int(parts["bq"]), int(parts["bk"]))
+            ms, bq, bk, tag, tflops = rows[0]
+            print(f"  BEST: {tag} at {ms:.3f} ms ({tflops:.1f} TFLOP/s)")
+            winners[shape[1]] = (bq, bk)
     if winners:
         # ready-to-adopt regime map for ops/flash_attention._BLOCK_REGIMES /
         # the PT_FLASH_BLOCKS env override
