@@ -43,29 +43,47 @@ rfftn = _mkn("rfftn")
 irfftn = _mkn("irfftn")
 
 
+def _hfftn_impl(v, s, axes, norm):
+    """hfftn == irfftn(conj(x)) with the norm swapped backward<->forward and
+    (for backward) a prod(out_sizes) scale — verified against scipy.fft
+    (ihfftn is the inverse composition)."""
+    ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
+    inner = {"backward": "backward", "forward": "backward",
+             "ortho": "ortho"}[norm]
+    r = jnp.fft.irfftn(jnp.conj(v), s=s, axes=ax, norm=inner)
+    if norm == "backward":
+        n = 1
+        for a in ax:
+            n *= r.shape[a]
+        r = r * n
+    return r
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    ax = tuple(axes) if axes is not None else tuple(range(v.ndim))
+    inner = {"backward": "forward", "forward": "backward",
+             "ortho": "ortho"}[norm]
+    return jnp.conj(jnp.fft.rfftn(v, s=s, axes=ax, norm=inner))
+
+
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=tuple(axes), norm=norm), x)
+    return apply_op(lambda v: _hfftn_impl(v, s, axes, norm), x)
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=tuple(axes), norm=norm)), x)
+    return apply_op(lambda v: _ihfftn_impl(v, s, axes, norm), x)
 
 
 def hfftn(x, s=None, axes=None, norm="backward", name=None):
-    """N-d FFT of a Hermitian-symmetric signal (real output); same
-    conjugate/irfftn composition as :func:`hfft2` over arbitrary axes
-    (ref fft.py hfftn)."""
-    ax = tuple(axes) if axes is not None else None
-    return apply_op(
-        lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=ax, norm=norm), x)
+    """N-d FFT of a Hermitian-symmetric signal (real output; ref fft.py
+    hfftn) — scipy-verified composition, see _hfftn_impl."""
+    return apply_op(lambda v: _hfftn_impl(v, s, axes, norm), x)
 
 
 def ihfftn(x, s=None, axes=None, norm="backward", name=None):
     """Inverse of :func:`hfftn` (Hermitian-symmetric spectrum of a real
     signal; ref fft.py ihfftn)."""
-    ax = tuple(axes) if axes is not None else None
-    return apply_op(
-        lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=ax, norm=norm)), x)
+    return apply_op(lambda v: _ihfftn_impl(v, s, axes, norm), x)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -613,4 +613,27 @@ class TestFftExtras:
         spec = p.fft.ihfftn(p.to_tensor(real))
         back = p.fft.hfftn(spec, s=real.shape)
         np.testing.assert_allclose(np.asarray(back.value), real,
-                                   rtol=1e-6, atol=1e-8)
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_hermitian_ffts_match_scipy_all_norms(self):
+        sfft = pytest.importorskip("scipy.fft")
+        import paddle_tpu as p
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 5) + 1j * rng.randn(4, 5)
+        r = rng.randn(4, 8)
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(
+                np.asarray(p.fft.hfftn(p.to_tensor(x), s=(4, 8),
+                                       norm=norm).value),
+                sfft.hfftn(x, s=(4, 8), norm=norm), rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(p.fft.ihfftn(p.to_tensor(r), norm=norm).value),
+                sfft.ihfftn(r, norm=norm), rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(p.fft.hfft2(p.to_tensor(x), s=(4, 8),
+                                       norm=norm).value),
+                sfft.hfft2(x, s=(4, 8), norm=norm), rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(p.fft.ihfft2(p.to_tensor(r), norm=norm).value),
+                sfft.ihfft2(r, norm=norm), rtol=1e-5, atol=1e-6)
