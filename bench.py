@@ -36,8 +36,6 @@ def peak_flops_per_chip() -> float:
 
 
 def _measure(cfg, B, S, steps, warmup, remat=False):
-    import jax
-
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM
     from paddle_tpu.optimizer import AdamW
@@ -59,15 +57,16 @@ def _measure(cfg, B, S, steps, warmup, remat=False):
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
 
-    for _ in range(warmup):
-        loss = engine.train_batch(ids, labels)
-    jax.block_until_ready(loss.value)
+    # dispatch-chain differencing (see paddle_tpu/utils/bench_timing.py):
+    # train steps serialize on-device through the donated param state;
+    # t(steps+1) - t(1) cancels the fixed tunnel round-trip cost, and
+    # block_until_ready is never trusted (it does not wait on axon)
+    from paddle_tpu.utils.bench_timing import device_time_ms
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(ids, labels)
-    jax.block_until_ready(loss.value)
-    dt = time.perf_counter() - t0
+    step_ms = device_time_ms(lambda: engine.train_batch(ids, labels),
+                             reps=steps, repeats=2, warmup=warmup)
+    loss = engine.train_batch(ids, labels)
+    dt = step_ms / 1e3 * steps
 
     tokens_per_sec = B * S * steps / dt
     flops_per_token = 6.0 * n_params  # fwd+bwd matmul FLOPs approximation

@@ -1,0 +1,78 @@
+"""Trustworthy device timing on async / tunneled backends.
+
+On the tunneled axon TPU platform ``jax.block_until_ready`` returns WITHOUT
+waiting for device execution (measured 2026-07-31: 20 flash-attention
+kernels "completed" in 0.026 ms total), so any wall-clock timing that closes
+with it reports dispatch time, not device time.  The only trustworthy sync
+point is an actual device->host transfer.
+
+The primitives here implement **dispatch-chain differencing**: dispatch N
+calls (they pipeline on-device), close with a single scalar pull, and
+subtract the identically-shaped 1-call measurement so the fixed tunnel
+round-trip cost cancels:
+
+    device_time = [t(N+1 calls + pull) - t(1 call + pull)] / N
+
+Requirement on ``fn``: repeated calls must serialize on-device — either
+through a data dependency (train steps chained via donated params) or by
+being independent launches on the same stream (the default for same-device
+jitted calls).  Every benchmark tool in the repo times through this module;
+do not hand-roll ``block_until_ready`` timing loops.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["pull_scalar", "chain_seconds", "device_time_ms"]
+
+
+def pull_scalar(out) -> float:
+    """Force a real device->host sync by fetching one scalar of ``out``.
+
+    Accepts any pytree of jax arrays or framework Tensors (anything whose
+    leaves numpy can consume after ``jnp.asarray``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(out) if l is not None]
+    leaf = leaves[0]
+    value = getattr(leaf, "value", leaf)  # framework Tensor -> jax.Array
+    return float(jnp.asarray(value).reshape(-1)[0].astype(jnp.float32))
+
+
+def chain_seconds(fn, n: int, repeats: int = 3) -> float:
+    """min-of-``repeats`` wall time of: dispatch ``fn()`` ``n`` times, then
+    one scalar pull of the last output."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn()
+        pull_scalar(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def device_time_ms(fn, reps: int = 10, repeats: int = 3,
+                   warmup: int = 1) -> float:
+    """Per-call device execution time of ``fn`` in milliseconds.
+
+    A non-positive difference means the signal (reps x per-call time) was
+    below the tunnel jitter — one retry at double the reps, then
+    ``RuntimeError``: an unstable measurement must never enter a sorted
+    benchmark table looking like a near-zero winner.
+    """
+    out = None
+    for _ in range(max(warmup, 1)):  # compile + steady-state
+        out = fn()
+    pull_scalar(out)
+    for attempt_reps in (reps, reps * 2):
+        t_long = chain_seconds(fn, attempt_reps + 1, repeats)
+        t_short = chain_seconds(fn, 1, repeats)
+        if t_long > t_short:
+            return (t_long - t_short) / attempt_reps * 1e3
+    raise RuntimeError(
+        f"unstable measurement: {reps}..{reps * 2} reps of fn stayed below "
+        f"the host/tunnel timing noise floor; raise reps")

@@ -55,11 +55,16 @@ def main():
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, (args.batch, args.prompt)).astype("int32"))
 
+    # generate() is one long autoregressive chain; a single timed run is
+    # fine but the sync must be a real transfer (block_until_ready does not
+    # wait on the tunneled axon platform)
+    from paddle_tpu.utils.bench_timing import pull_scalar
+
     out = model.generate(ids, max_new_tokens=args.new)  # compile + run
-    jax.block_until_ready(out.value)
+    pull_scalar(out)
     t0 = time.perf_counter()
     out = model.generate(ids, max_new_tokens=args.new, seed=1)
-    jax.block_until_ready(out.value)
+    pull_scalar(out)
     dt = time.perf_counter() - t0
 
     steps = args.prompt + args.new - 1

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 
 def bench_llama():
@@ -43,17 +42,12 @@ def bench_llama():
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"))
     labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
-    loss = None
-    for _ in range(2):
-        loss = engine.train_batch(ids, labels)
-    jax.block_until_ready(loss.value)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = engine.train_batch(ids, labels)
-    jax.block_until_ready(loss.value)
-    dt = (time.perf_counter() - t0) / iters
-    return {"ms_per_step": round(dt * 1e3, 2),
-            "tokens_per_s": round(B * S / dt, 1)}
+    from paddle_tpu.utils.bench_timing import device_time_ms
+
+    ms = device_time_ms(lambda: engine.train_batch(ids, labels),
+                        reps=iters, warmup=2)
+    return {"ms_per_step": round(ms, 2),
+            "tokens_per_s": round(B * S / (ms / 1e3), 1)}
 
 
 def bench_resnet50():
@@ -78,18 +72,11 @@ def bench_resnet50():
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(B, 3, 224, 224).astype("float32"))
     y = paddle.to_tensor(rng.randint(0, 10, (B,)).astype("int64"))
-    loss = None
-    for _ in range(2):
-        loss = engine.train_batch(x, y)
-    jax.block_until_ready(loss.value)
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = engine.train_batch(x, y)
-    jax.block_until_ready(loss.value)
-    dt = (time.perf_counter() - t0) / iters
-    return {"ms_per_step": round(dt * 1e3, 2),
-            "imgs_per_s": round(B / dt, 1)}
+    from paddle_tpu.utils.bench_timing import device_time_ms
+
+    ms = device_time_ms(lambda: engine.train_batch(x, y), reps=5, warmup=2)
+    return {"ms_per_step": round(ms, 2),
+            "imgs_per_s": round(B / (ms / 1e3), 1)}
 
 
 def main():

@@ -13,21 +13,12 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 
 def _bench(fn, *args, warmup=2, iters=10):
-    import jax
+    from paddle_tpu.utils.bench_timing import device_time_ms
 
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+    return device_time_ms(lambda: fn(*args), reps=iters, warmup=warmup)
 
 
 def build_suite():
@@ -80,7 +71,11 @@ def main():
 
     results = {}
     for name, (fn, shape) in build_suite().items():
-        ms = _bench(fn, iters=args.iters)
+        try:
+            ms = _bench(fn, iters=args.iters)
+        except RuntimeError as e:  # below the timing noise floor
+            print(f"{name:28s}   UNSTABLE   {shape}  ({e})")
+            continue
         results[name] = {"ms": round(ms, 4), "shape": shape}
         print(f"{name:28s} {ms:9.3f} ms   {shape}")
     if args.output:
