@@ -31,8 +31,9 @@ import numpy as np
 
 
 def peak_flops_per_chip() -> float:
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    return {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12}.get(gen, 197e12)
+    from paddle_tpu.utils.bench_timing import peak_flops
+
+    return peak_flops()
 
 
 def _measure(cfg, B, S, steps, warmup, remat=False):
@@ -140,6 +141,14 @@ def main():
         # second metric: largest-fitting config (~1.3B, remat on) — closer to
         # the 8B north star's arithmetic intensity than the 509M proxy
         try:
+            # release the 509M model/opt-state buffers before the big
+            # allocation: lingering executables + async deallocation over
+            # the tunnel caused RESOURCE_EXHAUSTED here
+            import gc
+
+            gc.collect()
+            jax.clear_caches()
+            time.sleep(3)
             big = LlamaConfig(vocab_size=32000, hidden_size=2048,
                               intermediate_size=5632, num_hidden_layers=24,
                               num_attention_heads=16, num_key_value_heads=8,
@@ -168,10 +177,16 @@ def _probe_tpu(timeout_s: float):
     code = ("import jax, sys; "
             "sys.exit(0 if any(d.platform in ('tpu', 'axon') "
             "for d in jax.devices()) else 3)")
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True,
-                              timeout=timeout_s)
+        # probes also hold the chip lock: backend init traffic during
+        # someone else's locked measurement is exactly the contention the
+        # lock exists to prevent
+        with tpu_lock(timeout_s=60.0):
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return f"TPU probe timed out ({timeout_s:.0f}s; tunnel likely down)"
     if proc.returncode == 0:
@@ -184,6 +199,25 @@ def _probe_tpu(timeout_s: float):
 _JSON_NEEDLE = '{"metric"'
 
 
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+
+def _maybe_tpu_lock(env, timeout_s):
+    """The cross-process chip lock, skipped for CPU-pinned children (they
+    don't touch the TPU) and bounded so a stuck lock holder can't blow the
+    driver's wall-clock budget (_run_with_retries' arithmetic only counts
+    time between attempts)."""
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
+    if env.get("JAX_PLATFORMS") == "cpu":
+        import contextlib
+
+        return contextlib.nullcontext()
+    return tpu_lock(timeout_s=timeout_s)
+
+
 def _run_child(env, timeout_s):
     """Run one bench child; forward its stderr tail.
 
@@ -191,9 +225,10 @@ def _run_child(env, timeout_s):
     already written to stdout; tail carries the failure description
     otherwise ('timeout' sentinel for TimeoutExpired)."""
     try:
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, capture_output=True, text=True,
-                              timeout=timeout_s)
+        with _maybe_tpu_lock(env, timeout_s=min(timeout_s, 300.0)):
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, capture_output=True, text=True,
+                                  timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return False, "timeout"
     sys.stderr.write(proc.stderr[-4000:])

@@ -677,27 +677,47 @@ _FULL_K_MAX = 8192
 
 
 #: per-sequence-length-regime (block_q, block_k) defaults — populated from
-#: tools/bench_flash_sweep.py winners on hardware.  Key = max seq len of the
-#: regime (entries ascending); 512x512 measured best at S=2048 (BASELINE r1)
-#: and is the fallback for every regime until the sweep says otherwise.
-_BLOCK_REGIMES = {
+#: tools/bench_flash_sweep.py winners measured on a real v5e chip
+#: (2026-07-31, dispatch-chain differencing).  Key = max seq len of the
+#: regime (entries ascending).  Forward and backward want DIFFERENT blocks:
+#: at S=2048 GQA the fwd kernel runs 1.2-1.9 ms at 512x1024 vs 2.05 ms at
+#: 512x512, while fwd+bwd is fastest with the bwd kernel at 512x512
+#: (4.57 ms vs 4.98 ms uniform 512x1024) — so the tables are split.
+#: S=16384 streaming regime: 1024x1024 measured 7.47 ms fwd (147 TFLOP/s,
+#: 75% of v5e peak) and 32.3 ms fwd+bwd — best for both directions.  The
+#: 8192 boundary (largest shape still on the full-K LOOP kernels, see
+#: _FULL_K_MAX) keeps the conservative 512x512 until a clean sweep of the
+#: loop kernels at that size says otherwise — the 1024x1024 winner was
+#: measured on the STREAMING kernels only.
+_BLOCK_REGIMES_FWD = {
+    4096: (512, 1024),
+    8192: (512, 512),
+    16384: (1024, 1024),
+}
+_BLOCK_REGIMES_BWD = {
     4096: (512, 512),
-    16384: (512, 512),
+    8192: (512, 512),
+    16384: (1024, 1024),
 }
 
 
-def _block_defaults(seq_len: int = 0):
+def _block_defaults(seq_len: int = 0, kind: str = "fwd"):
     """Tuning knobs per shape regime (benchmarked via bench.py A/B and
     tools/bench_flash_sweep.py).  Override order: PT_FLASH_BLOCK_Q/K
-    (global) > PT_FLASH_BLOCKS ("4096:512x512,16384:1024x512" regime map)
-    > _BLOCK_REGIMES table."""
+    (global, both directions) > PT_FLASH_BLOCKS (forward ONLY) /
+    PT_FLASH_BLOCKS_BWD (backward ONLY) regime maps
+    ("4096:512x512,16384:1024x512") > the split _BLOCK_REGIMES_FWD/_BWD
+    tables.  The fwd env var deliberately does NOT leak into the backward
+    kernel: adopting a fwd-sweep winner must not undo the measured bwd
+    default (bwd prefers smaller K blocks than fwd on every swept shape)."""
     import os
 
     if os.environ.get("PT_FLASH_BLOCK_Q") or os.environ.get("PT_FLASH_BLOCK_K"):
         return (int(os.environ.get("PT_FLASH_BLOCK_Q", 512)),
                 int(os.environ.get("PT_FLASH_BLOCK_K", 512)))
-    regimes = dict(_BLOCK_REGIMES)
-    env_map = os.environ.get("PT_FLASH_BLOCKS")
+    regimes = dict(_BLOCK_REGIMES_BWD if kind == "bwd" else _BLOCK_REGIMES_FWD)
+    env_map = os.environ.get(
+        "PT_FLASH_BLOCKS_BWD" if kind == "bwd" else "PT_FLASH_BLOCKS")
     if env_map:
         try:
             for part in env_map.split(","):
@@ -722,7 +742,7 @@ def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
 
 def _flash_bwd_bhsd(q, k, v, do, lse, delta, causal, scale,
                     block_q=None, block_k=None):
-    dq, dk = _block_defaults(k.shape[2])
+    dq, dk = _block_defaults(k.shape[2], kind="bwd")
     block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_bwd_bhsd_loop(q, k, v, do, lse, delta, causal, scale,

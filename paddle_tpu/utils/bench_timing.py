@@ -21,9 +21,75 @@ do not hand-roll ``block_until_ready`` timing loops.
 """
 from __future__ import annotations
 
+import contextlib
+import os
+import sys
 import time
 
-__all__ = ["pull_scalar", "chain_seconds", "device_time_ms"]
+__all__ = ["pull_scalar", "chain_seconds", "device_time_ms", "tpu_lock",
+           "UnstableMeasurement", "peak_flops"]
+
+_LOCK_PATH = "/tmp/paddle_tpu_bench.lock"
+
+
+class UnstableMeasurement(RuntimeError):
+    """The differencing signal never cleared the observed noise floor.
+
+    Distinct from generic RuntimeError so callers can skip-and-report
+    without accidentally swallowing real device failures (XlaRuntimeError
+    is also a RuntimeError subclass)."""
+
+
+def peak_flops(gen: str | None = None) -> float:
+    """Peak bf16 FLOP/s per chip for the generation in
+    ``PALLAS_AXON_TPU_GEN`` (default v5e).  Single source of truth for
+    bench.py and the sweep tools' physical-sanity gates."""
+    gen = gen or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return {"v5e": 197e12, "v5p": 459e12, "v4": 275e12,
+            "v6e": 918e12}.get(gen, 197e12)
+
+
+@contextlib.contextmanager
+def tpu_lock(path: str = _LOCK_PATH, timeout_s: float | None = None):
+    """Cross-process exclusivity for device-timing runs.
+
+    Two benchmark processes sharing one chip contend and corrupt each
+    other's numbers (observed 2026-07-31: a 1.2 ms kernel "measured" 34 ms
+    while a second sweep ran).  Every benchmark driver that spawns a
+    measurement child — including cheap probes — must hold this flock
+    around the child's lifetime.
+
+    ``timeout_s`` bounds the wait: on expiry the context proceeds WITHOUT
+    the lock (a possibly-contended measurement beats an unboundedly hung
+    driver) after printing a warning to stderr.
+    """
+    import fcntl
+
+    with open(path, "w") as f:
+        if timeout_s is None:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            locked = True
+        else:
+            deadline = time.monotonic() + timeout_s
+            locked = False
+            while True:
+                try:
+                    fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    locked = True
+                    break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        sys.stderr.write(
+                            f"tpu_lock: gave up after {timeout_s:.0f}s; "
+                            f"proceeding unlocked (numbers may be "
+                            f"contended)\n")
+                        break
+                    time.sleep(1.0)
+        try:
+            yield
+        finally:
+            if locked:
+                fcntl.flock(f, fcntl.LOCK_UN)
 
 
 def pull_scalar(out) -> float:
@@ -41,38 +107,58 @@ def pull_scalar(out) -> float:
     return float(jnp.asarray(value).reshape(-1)[0].astype(jnp.float32))
 
 
-def chain_seconds(fn, n: int, repeats: int = 3) -> float:
-    """min-of-``repeats`` wall time of: dispatch ``fn()`` ``n`` times, then
-    one scalar pull of the last output."""
-    best = float("inf")
+def _chain_stats(fn, n: int, repeats: int) -> tuple[float, float]:
+    """(min, max) wall time over ``repeats`` of: dispatch ``fn()`` ``n``
+    times, then one scalar pull of the last output."""
+    lo, hi = float("inf"), 0.0
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = None
         for _ in range(n):
             out = fn()
         pull_scalar(out)
-        best = min(best, time.perf_counter() - t0)
-    return best
+        dt = time.perf_counter() - t0
+        lo, hi = min(lo, dt), max(hi, dt)
+    return lo, hi
 
 
-def device_time_ms(fn, reps: int = 10, repeats: int = 3,
-                   warmup: int = 1) -> float:
+def chain_seconds(fn, n: int, repeats: int = 3) -> float:
+    """min-of-``repeats`` wall time of: dispatch ``fn()`` ``n`` times, then
+    one scalar pull of the last output."""
+    return _chain_stats(fn, n, repeats)[0]
+
+
+def device_time_ms(fn, reps: int = 10, repeats: int = 3, warmup: int = 1,
+                   min_signal_s: float | None = None,
+                   max_reps: int = 1024) -> float:
     """Per-call device execution time of ``fn`` in milliseconds.
 
-    A non-positive difference means the signal (reps x per-call time) was
-    below the tunnel jitter — one retry at double the reps, then
-    ``RuntimeError``: an unstable measurement must never enter a sorted
-    benchmark table looking like a near-zero winner.
+    Self-calibrating against the noise it actually observes: the required
+    differencing signal is ``max(4 x measured spread, 10 ms)`` (or the
+    explicit ``min_signal_s``), and reps double until the signal clears it.
+    On a quiet local backend sub-ms ops pass at small reps; on the jittery
+    tunnel the same code demands hundreds of ms of signal — the adaptive
+    floor is what keeps physically-impossible readings (observed at fixed
+    small reps) out of benchmark tables.  ``UnstableMeasurement`` is raised
+    at the reps cap rather than returning a sub-floor number.
     """
     out = None
     for _ in range(max(warmup, 1)):  # compile + steady-state
         out = fn()
     pull_scalar(out)
-    for attempt_reps in (reps, reps * 2):
-        t_long = chain_seconds(fn, attempt_reps + 1, repeats)
-        t_short = chain_seconds(fn, 1, repeats)
-        if t_long > t_short:
-            return (t_long - t_short) / attempt_reps * 1e3
-    raise RuntimeError(
-        f"unstable measurement: {reps}..{reps * 2} reps of fn stayed below "
-        f"the host/tunnel timing noise floor; raise reps")
+    while True:
+        lo_long, hi_long = _chain_stats(fn, reps + 1, repeats)
+        lo_short, hi_short = _chain_stats(fn, 1, repeats)
+        diff = lo_long - lo_short
+        spread = (hi_long - lo_long) + (hi_short - lo_short)
+        floor = (min_signal_s if min_signal_s is not None
+                 else max(4.0 * spread, 0.010))
+        if diff >= floor:
+            return diff / reps * 1e3
+        if reps >= max_reps:
+            raise UnstableMeasurement(
+                f"{reps} reps stayed below the noise floor "
+                f"(signal {diff * 1e3:.2f} ms < floor {floor * 1e3:.0f} ms, "
+                f"spread {spread * 1e3:.0f} ms); the backend is too jittery "
+                f"for this op")
+        reps *= 2

@@ -19,6 +19,7 @@ import sys
 
 SHAPES = {
     "small": [(8, 2048, 16, 8, 128)],          # the B=8 S=2048 GQA headline
+    "mid": [(2, 8192, 16, 8, 128)],            # loop-kernel upper boundary
     "long": [(1, 16384, 16, 8, 128)],          # S=16k streaming target
     "all": [(8, 2048, 16, 8, 128), (2, 8192, 16, 8, 128),
             (1, 16384, 16, 8, 128), (8, 2048, 16, 16, 128)],
@@ -57,20 +58,34 @@ print(json.dumps({"ms": ms, "tflops": flops / ms / 1e9}))
 """
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
 def run_config(shape, bq, bk, bwd):
+    repo = _REPO
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
     env = dict(os.environ)
     env["PT_FLASH_BLOCK_Q"] = str(bq)
     env["PT_FLASH_BLOCK_K"] = str(bk)
-    code = _CHILD % {"repo": os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "shape": tuple(shape), "bwd": bwd}
+    code = _CHILD % {"repo": repo, "shape": tuple(shape), "bwd": bwd}
     try:
-        out = subprocess.run([sys.executable, "-c", code], env=env,
-                             capture_output=True, text=True, timeout=600)
+        with tpu_lock():
+            out = subprocess.run([sys.executable, "-c", code], env=env,
+                                 capture_output=True, text=True, timeout=600)
         if out.returncode != 0:
             return None
         return json.loads(out.stdout.strip().splitlines()[-1])
     except (subprocess.TimeoutExpired, ValueError, IndexError):
         return None
+
+
+def _peak_tflops():
+    from paddle_tpu.utils.bench_timing import peak_flops
+
+    return peak_flops() / 1e12
 
 
 def main():
@@ -79,6 +94,7 @@ def main():
     ap.add_argument("--bwd", action="store_true",
                     help="time grad (fwd+bwd) instead of forward only")
     args = ap.parse_args()
+    peak = _peak_tflops()
 
     winners = {}  # seq_len -> (bq, bk)
     for shape in SHAPES[args.shapes]:
@@ -91,6 +107,13 @@ def main():
             if r is None:
                 print(f"  {tag}: FAILED/OOM")
                 continue
+            if r["tflops"] > peak:
+                # physically impossible (> chip peak): the differencing
+                # signal was below the tunnel jitter — never let such a row
+                # become the winner
+                print(f"  {tag}: {r['ms']:7.3f} ms  {r['tflops']:6.1f} "
+                      f"TFLOP/s  SUSPECT (> {peak:.0f} peak, excluded)")
+                continue
             rows.append((r["ms"], bq, bk, tag, r["tflops"]))
             print(f"  {tag}: {r['ms']:7.3f} ms  {r['tflops']:6.1f} TFLOP/s")
         if rows:
@@ -99,12 +122,13 @@ def main():
             print(f"  BEST: {tag} at {ms:.3f} ms ({tflops:.1f} TFLOP/s)")
             winners[shape[1]] = (bq, bk)
     if winners:
-        # ready-to-adopt regime map for ops/flash_attention._BLOCK_REGIMES /
-        # the PT_FLASH_BLOCKS env override
+        # ready-to-adopt regime map for the PT_FLASH_BLOCKS(_BWD) env
+        # override / ops/flash_attention._BLOCK_REGIMES_FWD/_BWD tables
         adopt = ",".join(f"{s}:{bq}x{bk}"
                          for s, (bq, bk) in sorted(winners.items()))
-        print(f"\nADOPT: PT_FLASH_BLOCKS=\"{adopt}\" "
-              f"(or fold into _BLOCK_REGIMES)")
+        var = "PT_FLASH_BLOCKS_BWD" if args.bwd else "PT_FLASH_BLOCKS"
+        table = "_BLOCK_REGIMES_BWD" if args.bwd else "_BLOCK_REGIMES_FWD"
+        print(f"\nADOPT: {var}=\"{adopt}\"  (or fold into {table})")
 
 
 if __name__ == "__main__":

@@ -69,11 +69,13 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
+    from paddle_tpu.utils.bench_timing import UnstableMeasurement
+
     results = {}
     for name, (fn, shape) in build_suite().items():
         try:
             ms = _bench(fn, iters=args.iters)
-        except RuntimeError as e:  # below the timing noise floor
+        except UnstableMeasurement as e:  # below the timing noise floor
             print(f"{name:28s}   UNSTABLE   {shape}  ({e})")
             continue
         results[name] = {"ms": round(ms, 4), "shape": shape}
