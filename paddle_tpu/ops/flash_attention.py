@@ -686,9 +686,9 @@ _FULL_K_MAX = 8192
 #: S=16384 streaming regime: 1024x1024 measured 7.47 ms fwd (147 TFLOP/s,
 #: 75% of v5e peak) and 32.3 ms fwd+bwd — best for both directions.  The
 #: 8192 boundary (largest shape still on the full-K LOOP kernels, see
-#: _FULL_K_MAX) keeps the conservative 512x512 until a clean sweep of the
-#: loop kernels at that size says otherwise — the 1024x1024 winner was
-#: measured on the STREAMING kernels only.
+#: _FULL_K_MAX) was swept separately: 512x512 wins both directions there
+#: (5.02 ms fwd / 18.6 ms fwd+bwd at B=2; 1024x1024 fails on the loop
+#: kernels' VMEM residency).
 _BLOCK_REGIMES_FWD = {
     4096: (512, 1024),
     8192: (512, 512),

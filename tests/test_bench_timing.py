@@ -1,0 +1,113 @@
+"""Unit tests for paddle_tpu.utils.bench_timing — the dispatch-chain
+differencing harness every benchmark tool times through.
+
+The TPU-tunnel failure modes this module exists for (async
+block_until_ready, seconds-scale jitter) are simulated with fakes; the
+real-backend behavior is exercised by the benchmark tools themselves on
+hardware (BASELINE.md round-3 on-hardware table).
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.utils import bench_timing as bt
+
+
+def test_pull_scalar_jax_array_and_tensor():
+    import paddle_tpu as paddle
+
+    assert bt.pull_scalar(jnp.arange(4.0)) == 0.0
+    assert bt.pull_scalar(paddle.to_tensor([3.0, 1.0])) == 3.0
+    # pytrees: first non-None leaf wins
+    assert bt.pull_scalar({"a": None, "b": jnp.full((2, 2), 7.0)}) == 7.0
+
+
+def test_device_time_ms_measures_a_known_busy_wait():
+    target_s = 0.004
+
+    def fn():
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < target_s:
+            pass
+        return jnp.zeros(())
+
+    ms = bt.device_time_ms(fn, reps=8, repeats=2)
+    # busy-wait is the per-call cost; allow generous slack for CI hosts
+    assert 0.5 * target_s * 1e3 <= ms <= 3.0 * target_s * 1e3
+
+
+def test_device_time_ms_raises_unstable_on_pure_jitter(monkeypatch):
+    # a "backend" where every chain takes the same time regardless of n
+    # (zero signal) but with spread: must raise, never return ~0
+    calls = iter([0.5, 0.9] * 50)
+
+    def fake_chain(fn, n, repeats):
+        a, b = next(calls), next(calls)
+        return min(a, b), max(a, b)
+
+    monkeypatch.setattr(bt, "_chain_stats", fake_chain)
+    with pytest.raises(bt.UnstableMeasurement):
+        bt.device_time_ms(lambda: jnp.zeros(()), reps=4, max_reps=16)
+
+
+def test_unstable_is_not_a_generic_runtime_error_catchall():
+    # callers catch UnstableMeasurement specifically; a raw RuntimeError
+    # (e.g. an XLA OOM) must NOT be an instance of it
+    assert issubclass(bt.UnstableMeasurement, RuntimeError)
+    assert not isinstance(RuntimeError("boom"), bt.UnstableMeasurement)
+
+
+def test_adaptive_floor_scales_with_observed_spread(monkeypatch):
+    # quiet backend: tiny spread -> small reps suffice even for a fast fn
+    seq = {"n": 0}
+
+    def fake_chain(fn, n, repeats):
+        base = 0.001 * n + 0.050  # 1 ms/call + 50 ms fixed cost, no jitter
+        return base, base + 0.0001
+
+    monkeypatch.setattr(bt, "_chain_stats", fake_chain)
+    ms = bt.device_time_ms(lambda: jnp.zeros(()), reps=16)
+    assert ms == pytest.approx(1.0, rel=0.05)
+
+
+def test_tpu_lock_times_out_and_proceeds(tmp_path, capsys):
+    lock_path = str(tmp_path / "l")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with bt.tpu_lock(lock_path):
+            entered.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    t0 = time.monotonic()
+    with bt.tpu_lock(lock_path, timeout_s=1.5):
+        waited = time.monotonic() - t0
+    release.set()
+    t.join(5)
+    assert 1.0 <= waited <= 6.0  # waited for the timeout, then proceeded
+
+
+def test_tpu_lock_serializes_two_holders(tmp_path):
+    lock_path = str(tmp_path / "l")
+    order = []
+
+    def worker(tag, hold_s):
+        with bt.tpu_lock(lock_path):
+            order.append(("in", tag))
+            time.sleep(hold_s)
+            order.append(("out", tag))
+
+    t1 = threading.Thread(target=worker, args=("a", 0.3))
+    t1.start()
+    time.sleep(0.1)
+    t2 = threading.Thread(target=worker, args=("b", 0.0))
+    t2.start()
+    t1.join(5)
+    t2.join(5)
+    assert order == [("in", "a"), ("out", "a"), ("in", "b"), ("out", "b")]

@@ -1,7 +1,13 @@
 #!/bin/bash
-# One-command on-hardware sequence (VERDICT r2 items 2/3/6) — run from the
-# repo root on a host that can reach a TPU chip.  Each stage is independent;
-# results land in BASELINE.md-ready form on stdout and under /tmp/tpu_runs.
+# One-command on-hardware sequence — run from the repo root on a host that
+# can reach a TPU chip.  Each stage is independent; results land in
+# BASELINE.md-ready form on stdout and under /tmp/tpu_runs.
+#
+# All measurement children serialize on the cross-process tpu_lock
+# (paddle_tpu/utils/bench_timing.py) — do NOT run two stages, or two copies
+# of this script, in parallel: a second workload on the shared chip
+# corrupts both sets of numbers even with the lock (the lock bounds its
+# wait and proceeds).
 set -u
 mkdir -p /tmp/tpu_runs
 cd "$(dirname "$0")/.."
@@ -13,19 +19,32 @@ timeout 120 python -c "import jax; ds=jax.devices(); print('DEVOK', ds[0].platfo
 echo "== 2. compiled-Mosaic kernel tier (tests_tpu/) =="
 python -m pytest tests_tpu/ -q 2>&1 | tee /tmp/tpu_runs/tests_tpu.log | tail -3
 
-echo "== 3. flash block-size sweep (fwd, headline shape) =="
+echo "== 3. flash block-size sweeps (fwd winners -> _BLOCK_REGIMES_FWD /"
+echo "      PT_FLASH_BLOCKS; bwd winners -> _BLOCK_REGIMES_BWD /"
+echo "      PT_FLASH_BLOCKS_BWD — the env vars are direction-specific) =="
 python tools/bench_flash_sweep.py --shapes small 2>&1 | tee /tmp/tpu_runs/sweep_small.log | tail -12
-echo "== 3b. long-context sweep =="
+python tools/bench_flash_sweep.py --shapes small --bwd 2>&1 | tee /tmp/tpu_runs/sweep_small_bwd.log | tail -12
+python tools/bench_flash_sweep.py --shapes mid 2>&1 | tee /tmp/tpu_runs/sweep_mid.log | tail -12
+python tools/bench_flash_sweep.py --shapes mid --bwd 2>&1 | tee /tmp/tpu_runs/sweep_mid_bwd.log | tail -12
 python tools/bench_flash_sweep.py --shapes long 2>&1 | tee /tmp/tpu_runs/sweep_long.log | tail -12
-echo "== 3c. fwd+bwd sweep (headline) =="
-python tools/bench_flash_sweep.py --shapes small --bwd 2>&1 | tee /tmp/tpu_runs/sweep_bwd.log | tail -12
-echo "adopt the winner via PT_FLASH_BLOCK_Q/PT_FLASH_BLOCK_K, then:"
+python tools/bench_flash_sweep.py --shapes long --bwd 2>&1 | tee /tmp/tpu_runs/sweep_long_bwd.log | tail -12
 
-echo "== 4. headline bench (509M MFU + 1.3B extra) =="
+echo "== 3b. drift-robust ranking of close sweep winners (the chip's"
+echo "       throughput drifts ~40% between quiet windows; trust medians) =="
+python tools/bench_flash_pairwise.py \
+  --configs "512x512:512x512,512x1024:512x512,512x1024:512x1024" --rounds 3 \
+  2>&1 | tee /tmp/tpu_runs/pairwise.log | tail -8
+
+echo "== 4. headline bench (509M MFU + 0.9B and S=8192 extras) =="
 python bench.py 2>/tmp/tpu_runs/bench_err.log | tee /tmp/tpu_runs/bench.json
 
-echo "== 5. long-context rows =="
+echo "== 5. explicit long-context rows =="
 BENCH_SKIP_LARGE=1 BENCH_B=2 BENCH_S=8192 python bench.py 2>/dev/null | tee /tmp/tpu_runs/bench_s8192.json
 BENCH_SKIP_LARGE=1 BENCH_B=1 BENCH_S=16384 python bench.py 2>/dev/null | tee /tmp/tpu_runs/bench_s16384.json
+
+echo "== 6. decode + conv-path model benchmarks =="
+python tools/decode_benchmark.py 2>/dev/null | tee /tmp/tpu_runs/decode_bf16.json
+python tools/decode_benchmark.py --int8 2>/dev/null | tee /tmp/tpu_runs/decode_int8.json
+python tools/model_benchmark.py 2>/dev/null | tee /tmp/tpu_runs/model_bench.json
 
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="
