@@ -125,12 +125,18 @@ def main():
         err = os.environ.get("_PADDLE_TPU_BENCH_TPU_ERROR")
         if err:
             out["error"] = f"TPU backend unavailable after retries: {err[:400]}"
+    partial_path = os.environ.get("_PADDLE_TPU_BENCH_PARTIAL")
+
+    def _checkpoint(data):
+        """Write the salvage partial: the parent emits it if this child is
+        killed during a later optional config."""
+        if partial_path:
+            with open(partial_path, "w") as f:
+                f.write(json.dumps(data))
+
     # checkpoint the headline result so the parent can salvage it if the
     # optional large-config run below blows the child's wall-clock budget
-    partial_path = os.environ.get("_PADDLE_TPU_BENCH_PARTIAL")
-    if partial_path:
-        with open(partial_path, "w") as f:
-            f.write(json.dumps(out))
+    _checkpoint(out)
 
     def _release_device_buffers():
         """Free the previous model/opt-state before the next big
@@ -141,11 +147,6 @@ def main():
         gc.collect()
         jax.clear_caches()
         time.sleep(3)
-
-    def _checkpoint(data):
-        if partial_path:
-            with open(partial_path, "w") as f:
-                f.write(json.dumps(data))
 
     extra = {}
     # only attempt the larger config if the headline left ample budget —

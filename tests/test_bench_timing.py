@@ -61,8 +61,6 @@ def test_unstable_is_not_a_generic_runtime_error_catchall():
 
 def test_adaptive_floor_scales_with_observed_spread(monkeypatch):
     # quiet backend: tiny spread -> small reps suffice even for a fast fn
-    seq = {"n": 0}
-
     def fake_chain(fn, n, repeats):
         base = 0.001 * n + 0.050  # 1 ms/call + 50 ms fixed cost, no jitter
         return base, base + 0.0001

@@ -60,12 +60,15 @@ def main():
     # wait on the tunneled axon platform)
     from paddle_tpu.utils.bench_timing import pull_scalar
 
-    out = model.generate(ids, max_new_tokens=args.new)  # compile + run
-    pull_scalar(out)
-    t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=args.new, seed=1)
-    pull_scalar(out)
-    dt = time.perf_counter() - t0
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
+    with tpu_lock(timeout_s=900.0):
+        out = model.generate(ids, max_new_tokens=args.new)  # compile + run
+        pull_scalar(out)
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=args.new, seed=1)
+        pull_scalar(out)
+        dt = time.perf_counter() - t0
 
     steps = args.prompt + args.new - 1
     tps = args.batch * steps / dt
@@ -76,7 +79,12 @@ def main():
     if hbm_bw:
         bytes_per_param = 1.0 if args.int8 else 2.0  # int8 vs bf16
         ceiling = hbm_bw / (bytes_per_param * n_params) * args.batch
-        line["roofline_tok_s"] = round(ceiling, 1)
+        # per-DECODE-step weight-streaming bound. The throughput above can
+        # legitimately exceed it: generate() runs the whole prompt as ONE
+        # flash-prefill forward, so prompt tokens are produced without
+        # streaming the weights per token (measured bf16 7.4k tok/s vs
+        # 6.4k "roofline" at prompt 128 + new 128).
+        line["decode_step_roofline_tok_s"] = round(ceiling, 1)
         line["weights"] = "int8" if args.int8 else "bf16" 
     import json
 

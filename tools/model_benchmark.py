@@ -88,10 +88,13 @@ def main():
     ap.add_argument("-o", "--output", default=None)
     ap.add_argument("--models", default="llama,resnet50")
     args = ap.parse_args()
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
     table = {"llama": bench_llama, "resnet50": bench_resnet50}
     results = {}
     for name in args.models.split(","):
-        results[name] = table[name.strip()]()
+        with tpu_lock(timeout_s=900.0):
+            results[name] = table[name.strip()]()
         print(name, results[name])
     if args.output:
         with open(args.output, "w") as f:

@@ -45,6 +45,6 @@ BENCH_SKIP_LARGE=1 BENCH_B=1 BENCH_S=16384 python bench.py 2>/dev/null | tee /tm
 echo "== 6. decode + conv-path model benchmarks =="
 python tools/decode_benchmark.py 2>/dev/null | tee /tmp/tpu_runs/decode_bf16.json
 python tools/decode_benchmark.py --int8 2>/dev/null | tee /tmp/tpu_runs/decode_int8.json
-python tools/model_benchmark.py 2>/dev/null | tee /tmp/tpu_runs/model_bench.json
+python tools/model_benchmark.py -o /tmp/tpu_runs/model_bench.json 2>/dev/null | tail -3
 
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="
