@@ -301,17 +301,16 @@ class Adam(Optimizer):
         return slots
 
     def _apply_one(self, param, grad, lr, step, slots):
-        b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        m = b1 * slots["moment1"] + (1 - b1) * grad
-        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
-        mhat = m / (1 - b1 ** step)
-        vhat = v / (1 - b2 ** step)
-        master = slots.get("master_weight", param.astype(jnp.float32))
-        new_master = master - lr * mhat / (jnp.sqrt(vhat) + eps)
-        out = {"moment1": m, "moment2": v}
+        from ..ops.fused_adamw import fused_adamw_update
+
+        new_p, m2, v2, new_master = fused_adamw_update(
+            param, grad, slots["moment1"], slots["moment2"], lr=lr,
+            step=step, b1=self._beta1, b2=self._beta2, eps=self._epsilon,
+            decay=0.0, master=slots.get("master_weight"))
+        out = {"moment1": m2, "moment2": v2}
         if "master_weight" in slots:
             out["master_weight"] = new_master
-        return new_master.astype(param.dtype), out
+        return new_p, out
 
 
 class AdamW(Adam):
@@ -387,18 +386,16 @@ class AdamW(Adam):
         return new_params, new_state
 
     def _apply_adamw(self, param, grad, lr, step, decay, slots):
-        b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        master = slots.get("master_weight", param.astype(jnp.float32))
-        master = master * (1 - lr * decay)
-        m = b1 * slots["moment1"] + (1 - b1) * grad
-        v = b2 * slots["moment2"] + (1 - b2) * grad * grad
-        mhat = m / (1 - b1 ** step)
-        vhat = v / (1 - b2 ** step)
-        new_master = master - lr * mhat / (jnp.sqrt(vhat) + eps)
-        out = {"moment1": m, "moment2": v}
+        from ..ops.fused_adamw import fused_adamw_update
+
+        new_p, m2, v2, new_master = fused_adamw_update(
+            param, grad, slots["moment1"], slots["moment2"], lr=lr,
+            step=step, b1=self._beta1, b2=self._beta2, eps=self._epsilon,
+            decay=decay, master=slots.get("master_weight"))
+        out = {"moment1": m2, "moment2": v2}
         if "master_weight" in slots:
             out["master_weight"] = new_master
-        return new_master.astype(param.dtype), out
+        return new_p, out
 
 
 class Adamax(Optimizer):

@@ -58,9 +58,7 @@ def main():
     # generate() is one long autoregressive chain; a single timed run is
     # fine but the sync must be a real transfer (block_until_ready does not
     # wait on the tunneled axon platform)
-    from paddle_tpu.utils.bench_timing import pull_scalar
-
-    from paddle_tpu.utils.bench_timing import tpu_lock
+    from paddle_tpu.utils.bench_timing import pull_scalar, tpu_lock
 
     with tpu_lock(timeout_s=900.0):
         out = model.generate(ids, max_new_tokens=args.new)  # compile + run
