@@ -58,7 +58,11 @@ class LlamaConfig:
     # fuse lm_head matmul + CE when forward() is given labels: chunked
     # logsumexp, never materializes [B,S,V] logits (ops/fused_ce.py)
     fused_lm_head_ce: bool = True
-    ce_chunk_size: int = 4096  # tokens per fused-CE chunk (dW carry vs logits tradeoff)
+    # tokens per fused-CE chunk: bigger chunks beat scan overhead (v5e
+    # A/B 2026-07-31: 4096 -> 0.671 MFU, 8192 -> 0.6806, 16384 -> 0.6824
+    # on the 509M bench step); 8192 takes most of the win at half the
+    # transient f32 [c, V] logits footprint.  PT_CE_CHUNK overrides.
+    ce_chunk_size: int = 8192
     recompute: bool = False
 
 

@@ -168,6 +168,12 @@ def fused_adamw_update(param, grad, m, v, *, lr, step, b1, b2, eps,
     """(new_param, new_m, new_v, new_master|None); falls back to the XLA
     elementwise path off-TPU / on unsupported shapes / multi-device.
 
+    Caveat: only TRACE-time kernel failures are caught here.  When the
+    pallas_call is traced inside an outer jit (the engine train step), a
+    Mosaic failure surfaces at that outer compile and propagates — with
+    the opt-in flag set, a loud error beats silently benchmarking the
+    wrong path.
+
     ``grad`` is consumed in float32 either way (the kernel upcasts
     internally), so both paths compute identical math.
     """

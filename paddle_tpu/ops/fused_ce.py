@@ -125,6 +125,11 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
     hidden: [..., H]; weight: [H, V] ([V, H] with transpose_weight, for tied
     embeddings); labels: integer [...] matching hidden's leading dims.
     """
+    import os
+
+    # PT_CE_CHUNK overrides at the single entry point so EVERY caller
+    # (llama loss, pipeline-engine post_fn) honors the on-hardware A/B knob
+    chunk_size = int(os.environ.get("PT_CE_CHUNK", chunk_size))
     if transpose_weight:
         weight = weight.T
     h2 = hidden.reshape(-1, hidden.shape[-1])

@@ -62,8 +62,18 @@ def test_fallback_is_reference(monkeypatch):
     monkeypatch.setenv("PT_FUSED_ADAMW", "0")  # kill switch -> XLA path
     p, g, m, v = _mk(seed=5)
     got = fa.fused_adamw_update(p, g, m, v, **HP)
-    want = _ref(p, g, m, v, **HP)
-    for a, b in zip(got[:3], want[:3]):
+    # independently written inline AdamW math (the pre-fusion optimizer.py
+    # expressions), NOT _reference_update — pins the fallback against the
+    # historical update rule rather than against itself
+    lr, st = HP["lr"], HP["step"]
+    b1, b2, eps, dec = HP["b1"], HP["b2"], HP["eps"], HP["decay"]
+    master = p.astype(jnp.float32) * (1 - lr * dec)
+    m_w = b1 * m + (1 - b1) * g
+    v_w = b2 * v + (1 - b2) * g * g
+    mhat = m_w / (1 - b1 ** st)
+    vhat = v_w / (1 - b2 ** st)
+    want_p = (master - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+    for a, b in zip(got[:3], (want_p, m_w, v_w)):
         np.testing.assert_array_equal(np.asarray(a, dtype=np.float32),
                                       np.asarray(b, dtype=np.float32))
 
