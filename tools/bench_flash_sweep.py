@@ -9,7 +9,7 @@ would silently reuse the first compilation) and prints a ranked table plus
 the current-default comparison.
 
 Usage (TPU):
-    python tools/bench_flash_sweep.py [--shapes small|long|all] [--bwd]
+    python tools/bench_flash_sweep.py [--shapes small|mid|long|mha|all] [--bwd]
 """
 import argparse
 import json
@@ -20,6 +20,7 @@ import sys
 SHAPES = {
     "small": [(8, 2048, 16, 8, 128)],          # the B=8 S=2048 GQA headline
     "mid": [(2, 8192, 16, 8, 128)],            # loop-kernel upper boundary
+    "mha": [(8, 2048, 16, 16, 128)],           # KV=H (GPT-family attention)
     "long": [(1, 16384, 16, 8, 128)],          # S=16k streaming target
     "all": [(8, 2048, 16, 8, 128), (2, 8192, 16, 8, 128),
             (1, 16384, 16, 8, 128), (8, 2048, 16, 16, 128)],
