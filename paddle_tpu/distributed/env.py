@@ -22,11 +22,16 @@ class ParallelEnv:
 
     @property
     def rank(self) -> int:
-        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        # env first, LAZILY: jax.process_index() initializes the XLA backend,
+        # which must not happen before jax.distributed.initialize on a
+        # launched multi-process job (the env var is set by the launch CLI)
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        return int(v) if v is not None else jax.process_index()
 
     @property
     def world_size(self) -> int:
-        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+        v = os.environ.get("PADDLE_TRAINERS_NUM")
+        return int(v) if v is not None else jax.process_count()
 
     @property
     def local_rank(self) -> int:

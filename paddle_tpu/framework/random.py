@@ -19,10 +19,14 @@ import numpy as np
 class Generator:
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
-        self.manual_seed(seed)
+        self._seed = int(seed)
+        # key is created LAZILY: jax.random.key initializes the XLA backend,
+        # which must not happen at paddle_tpu import time — a launched pod
+        # job needs jax.distributed.initialize to run first
+        self._key = None
 
     def manual_seed(self, seed: int):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             self._seed = int(seed)
             self._key = jax.random.key(int(seed))
         return self
@@ -30,16 +34,24 @@ class Generator:
     def initial_seed(self) -> int:
         return self._seed
 
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def next_key(self):
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        with self._lock:
+            self._ensure_key()
+            return jax.random.key_data(self._key)
 
     def set_state(self, state):
-        self._key = jax.random.wrap_key_data(np.asarray(state))
+        with self._lock:
+            self._key = jax.random.wrap_key_data(np.asarray(state))
 
 
 _default_generator = Generator(0)

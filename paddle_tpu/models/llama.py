@@ -123,6 +123,60 @@ def _apply_rope(x, cos, sin, pos_offset=0):
 
 
 # --------------------------------------------------------------------------- #
+# Context-parallel attention dispatch
+# --------------------------------------------------------------------------- #
+
+
+def _ring_dispatch(qr, kr, vv, rep, use_flash, causal):
+    """Bind the 'context' axis for ring attention (SURVEY §5.7 new design —
+    the reference has no context parallelism at all, grep-verified).
+
+    ``lax.ppermute`` needs a *bound* mesh axis name. Inside an outer
+    shard_map (manual-SPMD callers) the direct call succeeds. Under GSPMD
+    jit (ParallelEngine) no axis is bound, so when the active mesh carries a
+    'context' axis we open a shard_map island around the ring: batch over
+    'data', sequence over 'context', heads over 'tensor' when present —
+    CP×TP composition falls out of the head sharding. Returns None when no
+    'context' axis exists anywhere; the caller falls back to plain
+    attention (single-device parity runs).
+    """
+
+    def local(a, b, c):
+        from ..ops.flash_attention import _use_pallas
+        from ..parallel.ring_attention import ring_attention_bshd
+        from ..parallel.ring_flash_attention import ring_flash_attention_bshd
+
+        if use_flash and _use_pallas():
+            # Pallas blockwise kernels per ring hop, GQA-native
+            return ring_flash_attention_bshd(a, b, c, "context", causal=causal)
+        kx = jnp.repeat(b, rep, axis=2) if rep > 1 else b
+        vx = jnp.repeat(c, rep, axis=2) if rep > 1 else c
+        return ring_attention_bshd(a, kx, vx, "context", causal=causal)
+
+    try:
+        return local(qr, kr, vv)  # already inside shard_map binding 'context'
+    except NameError:
+        pass
+    from ..parallel.api import current_mesh, in_spmd_region
+
+    mesh = current_mesh()
+    if (mesh is None or "context" not in mesh.shape
+            or mesh.shape["context"] <= 1 or not in_spmd_region()):
+        return None
+    dp = "data" if "data" in mesh.shape else None
+    tp = "tensor" if ("tensor" in mesh.shape and mesh.shape["tensor"] > 1) \
+        else None
+    spec = P(dp, "context", tp, None)
+    from ..ops.flash_attention import _interpret
+
+    # the pallas HLO interpreter's internal dynamic_slice doesn't propagate
+    # varying-mesh-axes types; compiled runs keep the default check
+    kw = {"check_vma": False} if _interpret() else {}
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, **kw)(qr, kr, vv)
+
+
+# --------------------------------------------------------------------------- #
 # Modules
 # --------------------------------------------------------------------------- #
 
@@ -192,22 +246,11 @@ class LlamaAttention(Layer):
             rep = self.num_heads // self.num_kv_heads
             from ..ops.flash_attention import flash_attention_bshd
 
-            if self.cfg.context_parallel:
-                from ..ops.flash_attention import _use_pallas
-                from ..parallel.ring_attention import ring_attention_bshd
-                from ..parallel.ring_flash_attention import \
-                    ring_flash_attention_bshd
-
-                try:
-                    if _use_pallas():
-                        # Pallas blockwise kernels per ring hop, GQA-native
-                        return ring_flash_attention_bshd(qr, kr, vv, "context",
-                                                         causal=causal)
-                    kx = jnp.repeat(kr, rep, axis=2) if rep > 1 else kr
-                    vx = jnp.repeat(vv, rep, axis=2) if rep > 1 else vv
-                    return ring_attention_bshd(qr, kx, vx, "context", causal=causal)
-                except NameError:
-                    pass
+            if self.cfg.context_parallel and not cache_vals:
+                ring_out = _ring_dispatch(qr, kr, vv, rep,
+                                          self.cfg.use_flash_attention, causal)
+                if ring_out is not None:
+                    return ring_out
             if self.cfg.use_flash_attention:
                 # GQA handled inside the kernel (no KV repeat)
                 return flash_attention_bshd(qr, kr, vv, causal=causal)
@@ -231,6 +274,8 @@ class LlamaAttention(Layer):
         out = self.o_proj(out)
         if self.cfg.sequence_parallel:
             out = shard_constraint(out, P("data", "sep", None))
+        elif self.cfg.context_parallel:
+            out = shard_constraint(out, P("data", "context", None))
         return out
 
     def prefill(self, x, cos, sin, ck, cv):
@@ -333,6 +378,7 @@ class LlamaMLP(Layer):
         self.up_proj.weight.pspec = P(None, "tensor")
         self.down_proj.weight.pspec = P("tensor", None)
         self._sp = cfg.sequence_parallel
+        self._cp = cfg.context_parallel
 
     def forward(self, x):
         from ..nn.quant import Int8Linear
@@ -357,6 +403,8 @@ class LlamaMLP(Layer):
                            self.down_proj.weight, op_name="linear")
         if self._sp:
             out = shard_constraint(out, P("data", "sep", None))
+        elif self._cp:
+            out = shard_constraint(out, P("data", "context", None))
         return out
 
 
@@ -411,6 +459,8 @@ class LlamaModel(Layer):
         x = self.embed_tokens(input_ids)
         if self.cfg.sequence_parallel:
             x = shard_constraint(x, P("data", "sep", None))
+        elif self.cfg.context_parallel:
+            x = shard_constraint(x, P("data", "context", None))
         for i, layer in enumerate(self.layers):
             cache = caches[i] if caches is not None else None
             if self._should_recompute():

@@ -1,0 +1,178 @@
+"""Model-level context parallelism (ring attention over the 'context' axis).
+
+LlamaForCausalLM(context_parallel=True) trained through ParallelEngine on a
+mesh with a 'context' axis must reproduce the single-device run from the
+identical init — the same standard every other mesh axis meets
+(test_engine_parity.py). SURVEY §5.7 flagship new design: the reference has
+no context parallelism anywhere (grep-verified, SURVEY snapshot caveat);
+its TP all-gathers full activations so sequence length is bounded by one
+chip's HBM. Here the sequence dim of activations and attention shards over
+'context' and K/V blocks ride a ppermute ring (models/llama.py
+_ring_dispatch; parallel/ring_attention.py, ring_flash_attention.py).
+"""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.parallel import ParallelEngine
+
+
+def _cfg(**kw):
+    return LlamaConfig(**{**dict(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, dtype="float32",
+        use_flash_attention=False, tie_word_embeddings=False,
+        fused_lm_head_ce=False, context_parallel=True), **kw})
+
+
+def _batches(cfg, n=3, B=4, S=32):
+    rng = np.random.RandomState(7)
+    return [(rng.randint(0, cfg.vocab_size, (B, S)).astype("int32"),
+             rng.randint(0, cfg.vocab_size, (B, S)).astype("int64"))
+            for _ in range(n)]
+
+
+def _train(model, mesh, batches, batch_spec=P("data")):
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, donate=False, batch_spec=batch_spec)
+    losses = [float(np.asarray(eng.train_batch(
+        paddle.to_tensor(x), paddle.to_tensor(y)).value))
+        for x, y in batches]
+    eng.sync_to_model()
+    return losses, {k: np.asarray(v.value)
+                    for k, v in model.state_dict().items()}, eng
+
+
+def _run_pair(cfg, mesh_axes, shape, batches):
+    """Train from identical init on (a) one device, (b) the CP mesh."""
+    paddle.seed(42)
+    ref_model = LlamaForCausalLM(cfg)
+    init_state = {k: np.array(np.asarray(v.value))
+                  for k, v in ref_model.state_dict().items()}
+    single = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+    ref_losses, ref_weights, _ = _train(ref_model, single, batches)
+
+    paddle.seed(42)
+    cp_model = LlamaForCausalLM(cfg)
+    cp_model.set_state_dict({k: paddle.to_tensor(v)
+                             for k, v in init_state.items()})
+    devs = np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    mesh = Mesh(devs, mesh_axes)
+    cp_losses, cp_weights, eng = _train(
+        cp_model, mesh, batches, batch_spec=P("data", "context"))
+    return ref_losses, ref_weights, cp_losses, cp_weights, eng
+
+
+def test_cp_train_matches_single_device():
+    cfg = _cfg()
+    batches = _batches(cfg)
+    ref_l, ref_w, cp_l, cp_w, _ = _run_pair(
+        cfg, ("data", "context"), (2, 2), batches)
+    np.testing.assert_allclose(cp_l, ref_l, rtol=1e-4, atol=1e-5)
+    for k in ref_w:
+        np.testing.assert_allclose(cp_w[k], ref_w[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_cp_tp_composed_mesh():
+    """CP×TP: heads AND sequence sharded in the same train step — how
+    long-context actually trains (attention heads over 'tensor', sequence
+    over 'context', batch over 'data')."""
+    cfg = _cfg()
+    batches = _batches(cfg)
+    ref_l, ref_w, cp_l, cp_w, _ = _run_pair(
+        cfg, ("data", "context", "tensor"), (2, 2, 2), batches)
+    np.testing.assert_allclose(cp_l, ref_l, rtol=1e-4, atol=1e-5)
+    # ring + TP psum reorder f32 summation; AdamW's rsqrt amplifies the last
+    # ulp — a hair looser than the 2-axis case
+    for k in ref_w:
+        np.testing.assert_allclose(cp_w[k], ref_w[k], rtol=1e-3, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_cp_step_actually_rings():
+    """Guard against the silent-fallthrough regression (round-3 verdict:
+    the CP branch fell through to plain flash under GSPMD because ppermute's
+    axis was never bound): the compiled CP train step must contain
+    collective-permute ops."""
+    cfg = _cfg()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "context"))
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, donate=False,
+                         batch_spec=P("data", "context"))
+    step = eng.build_train_step()
+    (x, y) = _batches(cfg, n=1)[0]
+    import jax.numpy as jnp
+
+    lowered = step.lower(eng.params, eng.opt_state, eng._step_count,
+                         jnp.float32(1e-2), (jnp.asarray(x), jnp.asarray(y)))
+    hlo = lowered.compile().as_text()
+    assert "collective-permute" in hlo, \
+        "CP step compiled without any ring communication"
+
+
+def test_cp_pallas_ring_branch(monkeypatch):
+    """The use_flash_attention + context_parallel branch (Pallas blockwise
+    kernels per ring hop) must run — interpret mode stands in for the TPU
+    backend on CPU. One forward/loss, parity vs the jnp ring."""
+    monkeypatch.setenv("PT_FLASH_INTERPRET", "1")
+    cfg = _cfg(use_flash_attention=True)
+    batches = _batches(cfg, n=1, B=2, S=16)
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    init_state = {k: np.array(np.asarray(v.value))
+                  for k, v in model.state_dict().items()}
+    devs = np.array(jax.devices()[:2]).reshape(1, 2)
+    mesh = Mesh(devs, ("data", "context"))
+    _, _, eng = _train(model, mesh, batches[:1],
+                       batch_spec=P("data", "context"))
+    pallas_loss = [float(np.asarray(eng.train_batch(
+        paddle.to_tensor(batches[0][0]),
+        paddle.to_tensor(batches[0][1])).value))]
+
+    monkeypatch.delenv("PT_FLASH_INTERPRET")
+    cfg2 = _cfg(use_flash_attention=False)
+    paddle.seed(3)
+    model2 = LlamaForCausalLM(cfg2)
+    model2.set_state_dict({k: paddle.to_tensor(v)
+                           for k, v in init_state.items()})
+    _, _, eng2 = _train(model2, mesh, batches[:1],
+                        batch_spec=P("data", "context"))
+    jnp_loss = [float(np.asarray(eng2.train_batch(
+        paddle.to_tensor(batches[0][0]),
+        paddle.to_tensor(batches[0][1])).value))]
+    np.testing.assert_allclose(pallas_loss, jnp_loss, rtol=1e-4, atol=1e-5)
+
+
+def test_cp_sequence_actually_sharded():
+    """The parity must not come from silent replication: activations inside
+    the step must be sequence-sharded. Cheap proxy: the ring ran (HLO has
+    collective-permute — asserted above) AND the batch input arrives
+    context-sharded on its sequence dim."""
+    cfg = _cfg()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("data", "context"))
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, donate=False,
+                         batch_spec=P("data", "context"))
+    (x, y) = _batches(cfg, n=1)[0]
+    sh = eng._batch_sharding(np.asarray(x), eng.batch_spec)
+    assert sh.spec == P("data", "context"), sh.spec
+    # and a full step still runs
+    loss = float(np.asarray(eng.train_batch(
+        paddle.to_tensor(x), paddle.to_tensor(y)).value))
+    assert np.isfinite(loss)
