@@ -242,7 +242,7 @@ def _maybe_tpu_lock(env, timeout_s):
     if env.get("JAX_PLATFORMS") == "cpu":
         import contextlib
 
-        return contextlib.nullcontext()
+        return contextlib.nullcontext(True)  # "locked": no chip touched
     return tpu_lock(timeout_s=timeout_s)
 
 
@@ -253,7 +253,7 @@ def _run_child(env, timeout_s):
     already written to stdout; tail carries the failure description
     otherwise ('timeout' sentinel for TimeoutExpired)."""
     try:
-        with _maybe_tpu_lock(env, timeout_s=min(timeout_s, 300.0)):
+        with _maybe_tpu_lock(env, timeout_s=min(timeout_s, 300.0)) as locked:
             proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                   env=env, capture_output=True, text=True,
                                   timeout=timeout_s)
@@ -261,7 +261,17 @@ def _run_child(env, timeout_s):
         return False, "timeout"
     sys.stderr.write(proc.stderr[-4000:])
     if proc.returncode == 0 and _JSON_NEEDLE in proc.stdout:
-        sys.stdout.write(proc.stdout[proc.stdout.index(_JSON_NEEDLE):])
+        out = proc.stdout[proc.stdout.index(_JSON_NEEDLE):]
+        if locked is False:
+            # the chip lock timed out and this measurement ran unlocked:
+            # record the degraded condition IN the artifact, not just stderr
+            try:
+                rec = json.loads(out.strip().splitlines()[0])
+                rec["lock_contended"] = True
+                out = json.dumps(rec) + "\n"
+            except ValueError:
+                pass
+        sys.stdout.write(out)
         return True, ""
     return False, (proc.stderr or proc.stdout)[-800:]
 

@@ -128,8 +128,23 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
     import os
 
     # PT_CE_CHUNK overrides at the single entry point so EVERY caller
-    # (llama loss, pipeline-engine post_fn) honors the on-hardware A/B knob
-    chunk_size = int(os.environ.get("PT_CE_CHUNK", chunk_size))
+    # (llama loss, pipeline-engine post_fn) honors the on-hardware A/B knob.
+    # Only a positive-int value applies; anything else (empty string, 0,
+    # garbage) would surface later as an opaque trace-time error with no
+    # hint it came from the env knob, so warn and keep the caller's value.
+    override = os.environ.get("PT_CE_CHUNK")
+    if override is not None:
+        try:
+            parsed = int(override)
+        except ValueError:
+            parsed = 0
+        if parsed > 0:
+            chunk_size = parsed
+        else:
+            import warnings
+
+            warnings.warn(f"PT_CE_CHUNK={override!r} is not a positive int; "
+                          f"keeping chunk_size={chunk_size}")
     if transpose_weight:
         weight = weight.T
     h2 = hidden.reshape(-1, hidden.shape[-1])

@@ -31,6 +31,11 @@ __all__ = ["pull_scalar", "chain_seconds", "device_time_ms", "tpu_lock",
 
 _LOCK_PATH = "/tmp/paddle_tpu_bench.lock"
 
+# True when the most recent tpu_lock() acquisition timed out and the
+# measurement proceeded unlocked — drivers should surface this in their
+# emitted artifacts (see tpu_lock docstring)
+last_lock_contended = False
+
 
 class UnstableMeasurement(RuntimeError):
     """The differencing signal never cleared the observed noise floor.
@@ -61,10 +66,15 @@ def tpu_lock(path: str = _LOCK_PATH, timeout_s: float | None = None):
 
     ``timeout_s`` bounds the wait: on expiry the context proceeds WITHOUT
     the lock (a possibly-contended measurement beats an unboundedly hung
-    driver) after printing a warning to stderr.
+    driver).  The degraded state is propagated, not just printed: the
+    context yields ``locked`` (False when contended) and the module-level
+    ``last_lock_contended`` flag is set, so benchmark drivers can annotate
+    their emitted JSON — a stderr line alone is discardable (several
+    run_tpu_suite.sh stages run with 2>/dev/null).
     """
     import fcntl
 
+    global last_lock_contended
     with open(path, "w") as f:
         if timeout_s is None:
             fcntl.flock(f, fcntl.LOCK_EX)
@@ -85,8 +95,9 @@ def tpu_lock(path: str = _LOCK_PATH, timeout_s: float | None = None):
                             f"contended)\n")
                         break
                     time.sleep(1.0)
+        last_lock_contended = not locked
         try:
-            yield
+            yield locked
         finally:
             if locked:
                 fcntl.flock(f, fcntl.LOCK_UN)
@@ -102,6 +113,11 @@ def pull_scalar(out) -> float:
     import jax.numpy as jnp
 
     leaves = [l for l in jax.tree_util.tree_leaves(out) if l is not None]
+    if not leaves:
+        raise ValueError(
+            "pull_scalar: fn returned no array output to sync on (got an "
+            "empty/None pytree) — the timing harness needs at least one "
+            "device array to pull")
     leaf = leaves[0]
     value = getattr(leaf, "value", leaf)  # framework Tensor -> jax.Array
     return float(jnp.asarray(value).reshape(-1)[0].astype(jnp.float32))

@@ -63,7 +63,13 @@ def run_once(shape, fwd_blocks, bwd_blocks, fwd_only):
     code = _CHILD % {"repo": _REPO, "shape": tuple(shape),
                      "fwd_only": fwd_only}
     try:
-        with tpu_lock():
+        # bounded wait: a wedged previous lock holder must not hang the
+        # sweep forever — but a contended (unlocked) sample must not pick
+        # block-table winners either, so it is dropped, visibly
+        with tpu_lock(timeout_s=900.0) as locked:
+            if not locked:
+                print("  [pairwise] chip lock contended; sample dropped")
+                return None
             out = subprocess.run([sys.executable, "-c", code], env=env,
                                  capture_output=True, text=True, timeout=600)
         if out.returncode != 0:

@@ -73,7 +73,12 @@ def run_config(shape, bq, bk, bwd):
     env["PT_FLASH_BLOCK_K"] = str(bk)
     code = _CHILD % {"repo": repo, "shape": tuple(shape), "bwd": bwd}
     try:
-        with tpu_lock():
+        # bounded wait + contended samples dropped, same policy as the
+        # pairwise driver: corrupted timings must not become winners
+        with tpu_lock(timeout_s=900.0) as locked:
+            if not locked:
+                print("  [sweep] chip lock contended; sample dropped")
+                return None
             out = subprocess.run([sys.executable, "-c", code], env=env,
                                  capture_output=True, text=True, timeout=600)
         if out.returncode != 0:
@@ -130,6 +135,14 @@ def main():
         var = "PT_FLASH_BLOCKS_BWD" if args.bwd else "PT_FLASH_BLOCKS"
         table = "_BLOCK_REGIMES_BWD" if args.bwd else "_BLOCK_REGIMES_FWD"
         print(f"\nADOPT: {var}=\"{adopt}\"  (or fold into {table})")
+        if args.bwd:
+            # this sweep forces ONE uniform block for both directions and
+            # times fwd+bwd together, so a "bwd winner" can encode a
+            # suboptimal bwd-only choice when the fwd kernel dominates
+            print("NOTE: --bwd times fwd+bwd with a uniform block; confirm "
+                  "close winners with tools/bench_flash_pairwise.py (which "
+                  "varies fwd and bwd blocks independently) before folding "
+                  "into _BLOCK_REGIMES_BWD")
 
 
 if __name__ == "__main__":

@@ -60,7 +60,7 @@ def main():
     # wait on the tunneled axon platform)
     from paddle_tpu.utils.bench_timing import pull_scalar, tpu_lock
 
-    with tpu_lock(timeout_s=900.0):
+    with tpu_lock(timeout_s=900.0) as locked:
         out = model.generate(ids, max_new_tokens=args.new)  # compile + run
         pull_scalar(out)
         t0 = time.perf_counter()
@@ -83,7 +83,9 @@ def main():
         # streaming the weights per token (measured bf16 7.4k tok/s vs
         # 6.4k "roofline" at prompt 128 + new 128).
         line["decode_step_roofline_tok_s"] = round(ceiling, 1)
-        line["weights"] = "int8" if args.int8 else "bf16" 
+        line["weights"] = "int8" if args.int8 else "bf16"
+    if not locked:
+        line["lock_contended"] = True
     import json
 
     print(json.dumps(line))

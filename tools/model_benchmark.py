@@ -93,8 +93,10 @@ def main():
     table = {"llama": bench_llama, "resnet50": bench_resnet50}
     results = {}
     for name in args.models.split(","):
-        with tpu_lock(timeout_s=900.0):
+        with tpu_lock(timeout_s=900.0) as locked:
             results[name] = table[name.strip()]()
+        if not locked:
+            results[name]["lock_contended"] = True
         print(name, results[name])
     if args.output:
         with open(args.output, "w") as f:
