@@ -201,6 +201,65 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
     return out
 
 
+def convert_cast(pytype, x):
+    """``int(x)`` / ``float(x)`` / ``bool(x)`` over tensors (ref
+    cast_transformer.py): concrete values keep exact Python semantics;
+    tracers become dtype casts (bool() on a tracer would raise)."""
+    raw = _raw_bool(x)
+    if not _is_traced(raw):
+        return pytype(raw) if hasattr(raw, "dtype") else pytype(x)
+    import jax.numpy as jnp
+
+    from ..framework.core import Tensor
+
+    dt = {int: jnp.int64, float: jnp.float64, bool: jnp.bool_}[pytype]
+    out = jnp.asarray(raw).astype(dt)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def convert_assert(value, message=None):
+    """``assert`` statements (ref assert_transformer.py → the static Assert
+    op). Concrete predicates enforce eagerly with Python semantics; traced
+    predicates are a documented no-op — a compiled XLA program has no
+    host-side assert without the checkify transform, and numeric guards
+    (nan/inf) already live at dispatch behind FLAGS_check_nan_inf."""
+    raw = _raw_bool(value)
+    if _is_traced(raw):
+        return
+    if not bool(raw):
+        raise AssertionError("" if message is None else message)
+
+
+def convert_call(fn):
+    """Recursive callee conversion (ref call_transformer.py convert_call):
+    plain user functions get the same cached AST rewrite, so Tensor control
+    flow inside helpers converts too; everything else — builtins, classes,
+    bound methods, callables without source, closures — passes through
+    untouched via _convert_cached's own fallbacks."""
+    if inspect.isfunction(fn) and \
+            getattr(fn, "__wrapped_dy2static__", None) is None:
+        try:
+            return _convert_cached(fn)
+        except TypeError:  # unhashable exotic callables
+            return fn
+    return fn
+
+
+def convert_print(*args, sep=" ", end="\n", **kw):
+    """``print`` with traced arguments routes to jax.debug.print (prints
+    from the compiled program with real values); concrete calls keep Python
+    semantics including file=/flush=."""
+    raws = [_raw_bool(a) for a in args]
+    if any(_is_traced(r) for r in raws):
+        import jax
+
+        fmt = sep.join("{a%d}" % i for i in range(len(raws)))
+        jax.debug.print(fmt + ("" if end == "\n" else end),
+                        **{f"a{i}": r for i, r in enumerate(raws)})
+        return
+    print(*args, sep=sep, end=end, **kw)
+
+
 def convert_logical_and(lhs: Callable, rhs: Callable):
     l = lhs()
     if not _is_traced(l):
@@ -297,9 +356,175 @@ def _stmt(src: str) -> list:
     return ast.parse(textwrap.dedent(src)).body
 
 
-class _CtrlFlowTransformer(ast.NodeTransformer):
+def _walk_loop_level(node):
+    """Walk without descending into nested loops or function/class scopes —
+    break/continue found here belong to the CURRENT loop."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.While, ast.For, ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+class _ForRangeTransformer(ast.NodeTransformer):
+    """``for i in range(...)`` → counter ``while`` (ref loop_transformer.py
+    for→while lowering). Only range() targets are desugared; other iterables
+    keep Python semantics (concrete containers unroll at trace time — the
+    JAX idiom). The loop variable is assigned from a private counter at the
+    top of each iteration, so body reassignment of it cannot perturb the
+    iteration and its after-loop value matches Python's."""
+
+    def __init__(self, shadowed=frozenset()):
+        self.n = 0
+        # a local/param named `range` must not be treated as the builtin
+        self.shadowed = frozenset(shadowed)
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: node  # noqa: E731
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        it = node.iter
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name) or it.func.id != "range"
+                or "range" in self.shadowed or it.keywords
+                or any(isinstance(a, ast.Starred) for a in it.args)):
+            return node
+
+        def _literal_step(a):
+            # -1 parses as UnaryOp(USub, Constant), not Constant
+            if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                return a.value
+            if (isinstance(a, ast.UnaryOp) and isinstance(a.op, ast.USub)
+                    and isinstance(a.operand, ast.Constant)
+                    and isinstance(a.operand.value, int)):
+                return -a.operand.value
+            return None
+
+        args = it.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(value=0), args[0], 1
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], 1
+        elif len(args) == 3 and _literal_step(args[2]) not in (None, 0):
+            # non-literal steps keep the Python loop: the comparison
+            # direction must be known at rewrite time
+            start, stop, step = args[0], args[1], _literal_step(args[2])
+        else:
+            return node
+        i = self.n
+        self.n += 1
+        # NOT _PREFIX-prefixed: the counter must be a tracked store so the
+        # while conversion carries it (same rule as __fold_ret_)
+        ctr, stop_n = f"__for_i_{i}", f"__for_stop_{i}"
+        init = _stmt(f"{ctr} = 0\n{stop_n} = 0\n{node.target.id} = {ctr}")
+        init[0].value = start
+        init[1].value = stop
+        # pre-binding the target lets lax carry it (Python would leave it
+        # unbound on an empty range — a documented divergence)
+        cmp_op = "<" if step > 0 else ">"
+        loop = _stmt(f"while {ctr} {cmp_op} {stop_n}:\n"
+                     f"    {node.target.id} = {ctr}\n"
+                     f"    {ctr} = {ctr} + ({step})\n"
+                     f"    pass")[0]
+        loop.body = loop.body[:-1] + node.body
+        return init + [loop]
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """``break``/``continue`` inside loops → guard flags (ref
+    break_continue_transformer.py): the loop becomes escape-free, so the
+    control-flow pass can lower it to lax.while_loop when the predicate is
+    traced. Loops whose break/continue sit in unsupported positions (inside
+    try/with at loop level) or that also contain return/yield stay Python.
+    """
+
     def __init__(self):
         self.n = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: node  # noqa: E731
+
+    @staticmethod
+    def _has_bc(node) -> bool:
+        return any(isinstance(n, (ast.Break, ast.Continue))
+                   for n in _walk_loop_level(node))
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops eliminate their own escapes
+        if node.orelse or not any(self._has_bc(s) for s in node.body):
+            return node
+        if any(isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom))
+               for s in node.body for n in _walk_loop_level(s)):
+            return node  # return-in-loop keeps Python semantics
+        i = self.n
+        self.n += 1
+        brk, cont = f"__brk_{i}", f"__cont_{i}"
+        body = self._guard(node.body, brk, cont)
+        if body is None:
+            return node
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=brk, ctx=ast.Load())),
+            node.test])
+        # both flags pre-bound: lax.while_loop carries need a value before
+        # the loop (cont is also reset at the top of every iteration)
+        out = _stmt(f"{brk} = False\n{cont} = False")
+        loop = ast.While(test=test, body=_stmt(f"{cont} = False") + body,
+                         orelse=[])
+        return out + [loop]
+
+    def _guard(self, stmts, brk, cont):
+        """Rewrite one statement list: break/continue become flag sets and
+        everything after a flag-setting statement is wrapped in
+        ``if not (brk or cont):``. Returns None when a break/continue sits
+        somewhere this rewrite can't reach (inside try/with)."""
+        out = []
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                return out + _stmt(f"{brk} = True")  # rest is unreachable
+            if isinstance(st, ast.Continue):
+                return out + _stmt(f"{cont} = True")
+            if isinstance(st, ast.If) and self._has_bc(st):
+                b = self._guard(st.body, brk, cont)
+                o = self._guard(st.orelse, brk, cont)
+                if b is None or o is None:
+                    return None
+                out.append(ast.If(test=st.test, body=b or _stmt("pass"),
+                                  orelse=o))
+                rest = self._guard(stmts[idx + 1:], brk, cont)
+                if rest is None:
+                    return None
+                if rest:
+                    g = _stmt(f"if not ({brk} or {cont}):\n    pass")[0]
+                    g.body = rest
+                    out.append(g)
+                return out
+            if self._has_bc(st):
+                return None  # break inside try/with at loop level
+            out.append(st)
+        return out
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, shadowed=frozenset()):
+        self.n = 0
+        # names assigned anywhere in the function: a local `int = ...` or
+        # `print = ...` must not be rewritten as the builtin
+        self.shadowed = frozenset(shadowed)
 
     # don't descend into nested function/class definitions
     def visit_FunctionDef(self, node):
@@ -338,6 +563,42 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
                                attr="convert_logical_not", ctx=ast.Load()),
             args=[node.operand], keywords=[])
+
+    def _jst(self, attr):
+        return ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                             attr=attr, ctx=ast.Load())
+
+    def visit_Call(self, node):
+        """Three callee rewrites (ref cast_transformer.py,
+        call_transformer.py): int/float/bool → convert_cast; print →
+        convert_print; other plain-Name calls → convert_call(f)(...) so
+        Tensor control flow inside user helpers converts recursively."""
+        self.generic_visit(node)
+        if not isinstance(node.func, ast.Name):
+            return node  # method/attribute calls stay as-is (framework
+            #             internals must not be re-compiled)
+        name = node.func.id
+        if name in self.shadowed or name.startswith(_PREFIX) or name == _JST:
+            return node
+        if name in ("int", "float", "bool") and len(node.args) == 1 \
+                and not node.keywords:
+            return ast.Call(func=self._jst("convert_cast"),
+                            args=[ast.Name(id=name, ctx=ast.Load()),
+                                  node.args[0]], keywords=[])
+        if name == "print":
+            node.func = self._jst("convert_print")
+            return node
+        if name in _BUILTINS:
+            return node
+        node.func = ast.Call(func=self._jst("convert_call"),
+                             args=[node.func], keywords=[])
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test] + ([node.msg] if node.msg is not None else [])
+        return ast.Expr(value=ast.Call(func=self._jst("convert_assert"),
+                                       args=args, keywords=[]))
 
     def _make_branch_fn(self, name, body, tracked):
         # unpack with explicit global fallback: any assignment makes the name
@@ -511,10 +772,29 @@ def _convert_cached(fn):
     folded = _fold_tail_returns(fdef.body, [0])
     if folded is not None:
         fdef.body = folded
+    # pre-passes feeding the while conversion: for-range → counter while,
+    # then break/continue → guard flags (order matters: a desugared range
+    # loop may itself contain break/continue)
+    _, pre_stores = _name_sets(fdef.body)
+    pre_args = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args +
+                                fdef.args.kwonlyargs)}
+    for pre in (_ForRangeTransformer(shadowed=pre_stores | pre_args),
+                _BreakContinueTransformer()):
+        body = []
+        for stmt in fdef.body:
+            r = pre.visit(stmt)
+            body.extend(r if isinstance(r, list) else [r])
+        fdef.body = body
     before = ast.dump(fdef)
     # visit the body statements (visit_FunctionDef guards NESTED defs; the
     # top-level def itself must be descended into)
-    t = _CtrlFlowTransformer()
+    _, fn_stores = _name_sets(fdef.body)
+    arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args +
+                                 fdef.args.kwonlyargs)}
+    for va in (fdef.args.vararg, fdef.args.kwarg):
+        if va is not None:
+            arg_names.add(va.arg)
+    t = _CtrlFlowTransformer(shadowed=fn_stores | arg_names)
     new_body = []
     for stmt in fdef.body:
         r = t.visit(stmt)

@@ -1,0 +1,261 @@
+"""dy2static transformer-breadth gate (VERDICT r3 item 9): enumerate the
+reference's AST transformer inventory (/root/reference/python/paddle/jit/
+dy2static/*_transformer.py + ast_transformer/base_transformer) and assert
+every file is either IMPLEMENTED by a named mechanism in
+paddle_tpu/jit/dy2static.py or EXEMPT with a reason — the same
+zero-unexplained-absences methodology as the tensor-op surface gate
+(test_surface_parity.py). Functional tests below exercise each newly
+implemented transformer through @to_static.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+REF_DIR = "/root/reference/python/paddle/jit/dy2static"
+
+# file -> (status, mechanism-or-reason)
+STATUS = {
+    "ast_transformer.py": (
+        "implemented", "_convert_cached orchestrates fold + pre-passes + "
+        "_CtrlFlowTransformer (the ProgramTranslator pipeline)"),
+    "base_transformer.py": (
+        "exempt", "infrastructure base class; ast.NodeTransformer is the "
+        "native equivalent"),
+    "basic_api_transformer.py": (
+        "exempt", "rewrites dygraph API calls (to_variable etc.) to static "
+        "ops; JAX has no dygraph/static op split — tracing executes the "
+        "eager API directly"),
+    "assert_transformer.py": (
+        "implemented", "visit_Assert -> convert_assert (concrete enforced; "
+        "traced documented no-op, numeric guards via FLAGS_check_nan_inf)"),
+    "break_continue_transformer.py": (
+        "implemented", "_BreakContinueTransformer guard-flag elimination"),
+    "call_transformer.py": (
+        "implemented", "visit_Call -> convert_call recursive callee "
+        "conversion (cached, with source/closure fallbacks)"),
+    "cast_transformer.py": (
+        "implemented", "visit_Call -> convert_cast for int/float/bool over "
+        "tracers"),
+    "create_variable_transformer.py": (
+        "implemented", "UNDEF sentinel + globals() fallback in "
+        "_make_branch_fn"),
+    "decorator_transformer.py": (
+        "implemented", "decorator_list stripped at recompile; bound methods "
+        "re-bound; decorator-wrapped closures fall back to the original"),
+    "early_return_transformer.py": (
+        "implemented", "_fold_tail_returns single-exit folding"),
+    "ifelse_transformer.py": (
+        "implemented", "visit_If -> convert_ifelse (lax.cond)"),
+    "logical_transformer.py": (
+        "implemented", "visit_BoolOp/visit_UnaryOp -> "
+        "convert_logical_and/or/not"),
+    "loop_transformer.py": (
+        "implemented", "visit_While -> convert_while_loop (lax.while_loop); "
+        "_ForRangeTransformer desugars for-range; other iterables unroll at "
+        "trace (JAX idiom for concrete containers)"),
+    "return_transformer.py": (
+        "implemented", "_fold_tail_returns (returns inside loops stay "
+        "Python — same restriction class as the reference's RETURN_NO_VALUE "
+        "placeholder machinery)"),
+    "tensor_shape_transformer.py": (
+        "exempt", "rewrites x.shape into shape ops for dynamic static-graph "
+        "shapes; XLA shapes are static at trace so x.shape IS a concrete "
+        "tuple — nothing to rewrite"),
+    "typehint_transformer.py": (
+        "exempt", "annotations are inert in the recompiled source; Py3 ast "
+        "round-trips them unchanged"),
+}
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DIR), reason="reference absent")
+def test_every_reference_transformer_closed_or_exempt():
+    files = sorted(f for f in os.listdir(REF_DIR)
+                   if f.endswith("_transformer.py"))
+    unexplained = [f for f in files if f not in STATUS]
+    assert not unexplained, f"unexplained dy2static transformers: {unexplained}"
+    # and the map doesn't rot: no stale entries for removed files
+    stale = [f for f in STATUS if f not in files]
+    assert not stale, f"stale gate entries: {stale}"
+    impl = sum(1 for s, _ in STATUS.values() if s == "implemented")
+    assert impl >= 12, "breadth regressed"
+
+
+# ---------------------------------------------------------------- functional
+
+
+def _np(t):
+    return np.asarray(t.value if hasattr(t, "value") else t)
+
+
+def test_break_in_tensor_while_compiles():
+    @to_static
+    def f(x, n):
+        i = paddle.to_tensor(0)
+        s = x * 0
+        while i < n:          # traced predicate -> lax.while_loop
+            s = s + x
+            i = i + 1
+            if i >= 3:
+                break
+        return s
+
+    x = paddle.to_tensor(2.0)
+    out = f(x, paddle.to_tensor(10))
+    assert float(_np(out)) == 6.0  # 3 iterations, not 10
+
+
+def test_continue_in_tensor_while():
+    @to_static
+    def f(n):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0)
+        while i < n:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+
+    assert float(_np(f(paddle.to_tensor(6)))) == 1 + 3 + 5
+
+
+def test_for_range_traced_stop():
+    @to_static
+    def f(x, n):
+        s = x * 0
+        for i in range(n):    # traced stop: would raise un-desugared
+            s = s + x + i
+        return s
+
+    out = f(paddle.to_tensor(1.0), paddle.to_tensor(4))
+    assert float(_np(out)) == 4 * 1.0 + (0 + 1 + 2 + 3)
+
+
+def test_for_range_loop_var_after_loop():
+    @to_static
+    def f(n):
+        j = paddle.to_tensor(-1)
+        for j in range(n):
+            pass
+        return j              # Python semantics: last iterate, not stop
+
+    assert int(_np(f(paddle.to_tensor(5)))) == 4
+
+
+def test_for_range_break():
+    @to_static
+    def f(n):
+        s = paddle.to_tensor(0)
+        for i in range(n):
+            if i == 2:
+                break
+            s = s + 10
+        return s
+
+    assert int(_np(f(paddle.to_tensor(100)))) == 20
+
+
+def test_cast_of_traced_value():
+    @to_static
+    def f(x):
+        return float(x) * 2.0 + int(x)
+
+    out = f(paddle.to_tensor(3))
+    assert float(_np(out)) == 9.0
+
+
+def test_assert_concrete_enforced():
+    @to_static
+    def f(x):
+        assert x is not None, "x required"
+        return x
+
+    f(paddle.to_tensor(1.0))
+
+    # the raise path, concrete value (under jit even a bool arg is traced,
+    # which correctly takes the documented no-op path)
+    from paddle_tpu.jit.dy2static import convert_assert
+
+    with pytest.raises(AssertionError, match="boom"):
+        convert_assert(False, "boom")
+
+
+def test_assert_traced_noop():
+    @to_static
+    def f(x):
+        assert x > 100  # traced: documented no-op, must not raise
+        return x + 1
+
+    assert float(_np(f(paddle.to_tensor(1.0)))) == 2.0
+
+
+def _helper_with_tensor_if(x):
+    if x > 0:           # module-level helper: converted via convert_call
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def test_convert_call_converts_helper():
+    @to_static
+    def f(x):
+        return _helper_with_tensor_if(x) + 1
+
+    # under jit the helper's Tensor-if must lower to lax.cond, which only
+    # happens if convert_call rewrote the callee
+    assert float(_np(f(paddle.to_tensor(2.0)))) == 5.0
+    assert float(_np(f(paddle.to_tensor(-2.0)))) == -2.0
+
+
+def test_print_traced_routes_to_debug_print(capfd):
+    @to_static
+    def f(x):
+        print("value is", x)
+        return x * 2
+
+    out = f(paddle.to_tensor(21.0))
+    assert float(_np(out)) == 42.0
+    # jax.debug.print flushes through the runtime; just assert no crash and
+    # the concrete path still prints
+    from paddle_tpu.jit.dy2static import convert_print
+
+    convert_print("plain", 1)
+    captured = capfd.readouterr()
+    assert "plain 1" in captured.out
+
+
+def test_shadowed_builtin_not_rewritten():
+    @to_static
+    def f(x):
+        int = 7  # noqa: A001 — deliberate shadow
+        return x + int
+
+    assert float(_np(f(paddle.to_tensor(1.0)))) == 8.0
+
+
+def test_shadowed_range_not_desugared():
+    @to_static
+    def f(x):
+        range = lambda n: [7, 9]  # noqa: A001 — deliberate shadow
+        s = x * 0
+        for i in range(3):
+            s = s + i
+        return s
+
+    assert float(_np(f(paddle.to_tensor(0.0)))) == 16.0
+
+
+def test_for_range_negative_literal_step():
+    @to_static
+    def f(n):
+        s = paddle.to_tensor(0)
+        for i in range(n, 0, -1):   # traced stop, reversed
+            s = s + i
+        return s
+
+    assert int(_np(f(paddle.to_tensor(4)))) == 4 + 3 + 2 + 1
