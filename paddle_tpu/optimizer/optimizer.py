@@ -358,8 +358,92 @@ class AdamW(Adam):
             p._value = new_val
             slots.update(new_slots)
 
+    # ---------------------------------------------- multi-tensor (flat) apply
+    _MT_ROW = 512          # flat view (K, 512); total padded to 128*512
+
+    def _mt_active(self) -> bool:
+        """PT_MT_ADAMW=1 selects ONE fused launch over the concatenated
+        flat state instead of per-tensor updates (the overlap-preserving
+        design from the round-3 fused-AdamW postmortem; ref
+        incubate/optimizer/distributed_fused_lamb.py multi-tensor
+        precedent). Uniform-hyperparameter runs only: per-param decay
+        masks, lr ratios and master-weight mode keep the per-tensor path.
+        Multi-device TPU runs also keep it: the flat state would replicate
+        on every device (engine shards opt state by owning-param name) and
+        the kernel itself gates on single-device — all cost, no benefit.
+        The virtual CPU mesh (tests) is exempt as the correctness seam.
+        """
+        import os
+
+        multi_dev_tpu = jax.device_count() != 1 and \
+            jax.default_backend() != "cpu"
+        return (os.environ.get("PT_MT_ADAMW") == "1" and not multi_dev_tpu
+                and self._apply_decay_param_fun is None
+                and self._lr_ratio is None and not self._multi_precision)
+
+    def init_state(self, params):
+        if not self._mt_active() or len(params) < 2 or \
+                len({jnp.asarray(v).dtype if not hasattr(v, "dtype") else
+                     v.dtype for v in params.values()}) != 1:
+            return super().init_state(params)
+        import numpy as np
+
+        layout = [(n, tuple(v.shape), int(np.prod(v.shape, dtype=np.int64)))
+                  for n, v in sorted(params.items())]
+        total = sum(s for _, _, s in layout)
+        unit = 128 * self._MT_ROW  # (128, 512) min tile of the flat view
+        padded = -(-total // unit) * unit
+        self._mt_layout = layout
+        self._mt_padded = padded
+        flat = jnp.concatenate(
+            [jnp.reshape(params[n], (-1,)) for n, _, _ in layout] +
+            ([jnp.zeros((padded - total,), next(iter(params.values())).dtype)]
+             if padded > total else []))
+        p2 = flat.reshape(-1, self._MT_ROW)
+        return {"__mt__": {
+            "p": p2,
+            "moment1": jnp.zeros(p2.shape, jnp.float32),
+            "moment2": jnp.zeros(p2.shape, jnp.float32),
+        }}
+
+    def _mt_update(self, params, grads, state, lr, step):
+        from ..ops.fused_adamw import flat_adamw_update
+
+        if self._grad_clip is not None:
+            grads = _pure_grad_clip(self._grad_clip, grads)
+        mt = state["__mt__"]
+        layout, padded = self._mt_layout, self._mt_padded
+        total = sum(s for _, _, s in layout)
+        pdt = mt["p"].dtype
+        g = jnp.concatenate(
+            [jnp.reshape(grads[n], (-1,)).astype(pdt) for n, _, _ in layout] +
+            ([jnp.zeros((padded - total,), pdt)] if padded > total else []))
+        new_p2, m2, v2 = flat_adamw_update(
+            mt["p"], g.reshape(-1, self._MT_ROW), mt["moment1"],
+            mt["moment2"], lr=lr, step=step, b1=self._beta1, b2=self._beta2,
+            eps=self._epsilon, decay=self._wd_coeff)
+        flat = new_p2.reshape(-1)
+        new_params = dict(params)
+        off = 0
+        for n, shape, size in layout:
+            # static slices: XLA fuses the per-tensor reads into consumers
+            new_params[n] = jax.lax.slice(flat, (off,), (off + size,)
+                                          ).reshape(shape)
+            off += size
+        return new_params, {"__mt__": {"p": new_p2, "moment1": m2,
+                                       "moment2": v2}}
+
     def pure_update(self, params, grads, state, lr, step, pnames=None,
                     regularizers=None):
+        if "__mt__" in state:
+            missing = [n for n, _, _ in self._mt_layout
+                       if grads.get(n) is None]
+            if missing or regularizers:
+                raise ValueError(
+                    f"PT_MT_ADAMW flat state cannot skip per-tensor work "
+                    f"(missing grads {missing[:3]}... or per-param "
+                    f"regularizers); unset PT_MT_ADAMW for this run")
+            return self._mt_update(params, grads, state, lr, step)
         # AdamW decay is decoupled; a per-param ParamAttr regularizer still
         # adds its gradient (same as the eager step() path)
         regularizers = regularizers or {}

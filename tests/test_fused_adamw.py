@@ -111,6 +111,89 @@ def test_odd_shapes_pick_valid_blocks(monkeypatch):
                                rtol=2e-5, atol=2e-6)
 
 
+def test_flat_multi_tensor_matches_reference(monkeypatch):
+    """flat_adamw_update over a padded concatenated view must equal the
+    per-element reference (pad rows are fixed points)."""
+    monkeypatch.setenv("PT_FLASH_INTERPRET", "1")
+    p, g, m, v = _mk(K=128, N=512, seed=8)
+    got = fa.flat_adamw_update(p, g, m, v, **HP)
+    want = _ref(p, g, m, v, **HP)
+    for a, b in zip(got, want[:3]):
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32),
+                                   np.asarray(b, dtype=np.float32),
+                                   rtol=2e-5, atol=2e-6)
+    # zero pad region stays zero
+    z = jnp.zeros((128, 512), jnp.bfloat16)
+    zf = jnp.zeros((128, 512), jnp.float32)
+    zp, zm, zv = fa.flat_adamw_update(z, zf.astype(jnp.bfloat16), zf, zf,
+                                      **HP)
+    assert float(jnp.max(jnp.abs(zp.astype(jnp.float32)))) == 0.0
+    assert float(jnp.max(jnp.abs(zm))) == 0.0 and \
+        float(jnp.max(jnp.abs(zv))) == 0.0
+
+
+def _train_losses_weights(mt: bool, monkeypatch):
+    from jax.sharding import Mesh
+    import jax
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.parallel import ParallelEngine
+
+    if mt:
+        monkeypatch.setenv("PT_MT_ADAMW", "1")
+    else:
+        monkeypatch.delenv("PT_MT_ADAMW", raising=False)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=48,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=32,
+                      dtype="float32", use_flash_attention=False,
+                      fused_lm_head_ce=False)
+    paddle.seed(11)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                         mesh=mesh, donate=False)
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(rng.randint(0, 64, (4, 16)).astype("int32"))
+    lbl = paddle.to_tensor(rng.randint(0, 64, (4, 16)).astype("int64"))
+    losses = [float(np.asarray(eng.train_batch(ids, lbl).value))
+              for _ in range(4)]
+    eng.sync_to_model()
+    return losses, {k: np.asarray(v.value)
+                    for k, v in model.state_dict().items()}
+
+
+def test_multi_tensor_engine_parity(monkeypatch):
+    """PT_MT_ADAMW=1 (ONE flat launch for the whole model) must reproduce
+    the per-tensor path's training trajectory exactly — same XLA math on a
+    different layout."""
+    ref_l, ref_w = _train_losses_weights(False, monkeypatch)
+    mt_l, mt_w = _train_losses_weights(True, monkeypatch)
+    np.testing.assert_allclose(mt_l, ref_l, rtol=1e-6, atol=1e-7)
+    for k in ref_w:
+        np.testing.assert_allclose(mt_w[k], ref_w[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+    assert mt_l[-1] < mt_l[0]
+
+
+def test_multi_tensor_init_state_layout(monkeypatch):
+    monkeypatch.setenv("PT_MT_ADAMW", "1")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3)
+    params = {"b": jnp.ones((8, 256), jnp.float32),
+              "a": jnp.zeros((100,), jnp.float32)}
+    st = opt.init_state(params)
+    assert set(st) == {"__mt__"}
+    p2 = st["__mt__"]["p"]
+    assert p2.shape[1] == 512 and p2.shape[0] % 128 == 0
+    total = 8 * 256 + 100
+    assert p2.size >= total
+    # layout is sorted and sized correctly
+    assert [n for n, _, _ in opt._mt_layout] == ["a", "b"]
+    assert opt._mt_layout[0][2] == 100
+
+
 def test_adamw_optimizer_trains_through_engine():
     # end-to-end: the optimizer integration (fallback path on the CPU
     # mesh) still trains a toy model to decreasing loss
