@@ -52,7 +52,9 @@ def _measure(cfg, B, S, steps, warmup, remat=False):
     # loss_fn=None routes labels into forward() so the model returns the
     # fused loss directly
     engine = ParallelEngine(model, optimizer=opt, loss_fn=None,
-                            remat=remat, remat_policy="dots")
+                            remat=remat,
+                            remat_policy=os.environ.get("BENCH_REMAT_POLICY",
+                                                        "dots"))
     engine.build_train_step()
 
     rng = np.random.RandomState(0)

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..framework.core import Tensor
@@ -236,6 +237,9 @@ class LlamaAttention(Layer):
         q, k, v = self._qkv(x, B, S)
 
         def attn(qv, kv, vv, cv, sv, *cache_vals):
+            qv = checkpoint_name(qv, "qkv")
+            kv = checkpoint_name(kv, "qkv")
+            vv = checkpoint_name(vv, "qkv")
             qr = _apply_rope(qv, cv, sv, pos_offset)
             kr = _apply_rope(kv, cv, sv, pos_offset)
             if cache_vals:
@@ -269,7 +273,11 @@ class LlamaAttention(Layer):
         args = [q, k, v, Tensor(cos), Tensor(sin)]
         if cache is not None:
             args += [cache[0], cache[1]]
-        out = apply_op(attn, *args, op_name="flash_attention")
+        # remat-policy anchor (engine save_attn/offload_attn policies): the
+        # flash output is the one S²-cost intermediate worth pinning — named
+        # inside the op so eager decode pays no extra dispatch
+        out = apply_op(lambda *a: checkpoint_name(attn(*a), "attn_out"),
+                       *args, op_name="flash_attention")
         out = reshape(out, [B, S, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if self.cfg.sequence_parallel:
@@ -397,7 +405,8 @@ class LlamaMLP(Layer):
                            op_name="w8_mlp")
         else:
             def mlp(v, wg, wu, wd):
-                return jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
+                out = jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
+                return checkpoint_name(out, "mlp_out")
 
             out = apply_op(mlp, x, self.gate_proj.weight, self.up_proj.weight,
                            self.down_proj.weight, op_name="linear")

@@ -694,6 +694,12 @@ _BLOCK_REGIMES_FWD = {
     8192: (512, 512),
     16384: (1024, 1024),
 }
+#: MHA (KV == H, GPT family) wants smaller K blocks at short S than GQA:
+#: measured 2026-07-31 on v5e, H16/KV16 S=2048 fwd — 512x512 at 2.05 ms
+#: (pairwise median) vs 8% slower at the GQA winner 512x1024.  Long-S
+#: entries inherit the GQA table (the streaming regime is
+#: head-ratio-insensitive), so retunes there propagate automatically.
+_BLOCK_REGIMES_FWD_MHA = {**_BLOCK_REGIMES_FWD, 4096: (512, 512)}
 _BLOCK_REGIMES_BWD = {
     4096: (512, 512),
     8192: (512, 512),
@@ -701,21 +707,24 @@ _BLOCK_REGIMES_BWD = {
 }
 
 
-def _block_defaults(seq_len: int = 0, kind: str = "fwd"):
+def _block_defaults(seq_len: int = 0, kind: str = "fwd", mha: bool = False):
     """Tuning knobs per shape regime (benchmarked via bench.py A/B and
     tools/bench_flash_sweep.py).  Override order: PT_FLASH_BLOCK_Q/K
     (global, both directions) > PT_FLASH_BLOCKS (forward ONLY) /
     PT_FLASH_BLOCKS_BWD (backward ONLY) regime maps
     ("4096:512x512,16384:1024x512") > the split _BLOCK_REGIMES_FWD/_BWD
-    tables.  The fwd env var deliberately does NOT leak into the backward
-    kernel: adopting a fwd-sweep winner must not undo the measured bwd
-    default (bwd prefers smaller K blocks than fwd on every swept shape)."""
+    tables, with the forward table keyed on the KV/H ratio (MHA gets its
+    own measured column — tables exist so users don't need env overrides).
+    The fwd env var deliberately does NOT leak into the backward kernel:
+    adopting a fwd-sweep winner must not undo the measured bwd default
+    (bwd prefers smaller K blocks than fwd on every swept shape)."""
     import os
 
     if os.environ.get("PT_FLASH_BLOCK_Q") or os.environ.get("PT_FLASH_BLOCK_K"):
         return (int(os.environ.get("PT_FLASH_BLOCK_Q", 512)),
                 int(os.environ.get("PT_FLASH_BLOCK_K", 512)))
-    regimes = dict(_BLOCK_REGIMES_BWD if kind == "bwd" else _BLOCK_REGIMES_FWD)
+    regimes = dict(_BLOCK_REGIMES_BWD if kind == "bwd" else
+                   (_BLOCK_REGIMES_FWD_MHA if mha else _BLOCK_REGIMES_FWD))
     env_map = os.environ.get(
         "PT_FLASH_BLOCKS_BWD" if kind == "bwd" else "PT_FLASH_BLOCKS")
     if env_map:
@@ -733,7 +742,7 @@ def _block_defaults(seq_len: int = 0, kind: str = "fwd"):
 
 
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
-    dq, dk = _block_defaults(k.shape[2])
+    dq, dk = _block_defaults(k.shape[2], mha=k.shape[1] == q.shape[1])
     block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:
         return _flash_fwd_bhsd_loop(q, k, v, causal, scale, block_q, block_k)

@@ -215,12 +215,31 @@ class ParallelEngine:
             if self.remat:
                 # keep MXU outputs, recompute elementwise (the reference's
                 # recompute granularity is whole-layer; saving dot outputs is
-                # the better HBM/FLOP tradeoff on TPU)
+                # the better HBM/FLOP tradeoff on TPU). Named policies rely
+                # on the checkpoint_name annotations in models/llama.py
+                # ("attn_out", "qkv", "mlp_out").
+                cp = jax.checkpoint_policies
                 policy = None
                 if self.remat_policy == "dots":
-                    policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                    policy = cp.dots_with_no_batch_dims_saveable
                 elif self.remat_policy == "nothing":
-                    policy = jax.checkpoint_policies.nothing_saveable
+                    policy = cp.nothing_saveable
+                elif self.remat_policy == "save_attn":
+                    policy = cp.save_only_these_names("attn_out")
+                elif self.remat_policy == "save_attn_mlp":
+                    policy = cp.save_only_these_names("attn_out", "mlp_out")
+                elif self.remat_policy == "save_qkv_attn":
+                    policy = cp.save_only_these_names("attn_out", "qkv")
+                elif self.remat_policy == "offload_attn":
+                    # activations ride host RAM instead of being recomputed
+                    policy = cp.save_and_offload_only_these_names(
+                        names_which_can_be_saved=[],
+                        names_which_can_be_offloaded=["attn_out", "mlp_out"],
+                        offload_src="device", offload_dst="pinned_host")
+                elif self.remat_policy is not None and \
+                        self.remat_policy != "none":
+                    raise ValueError(
+                        f"unknown remat_policy {self.remat_policy!r}")
                 loss_of_ = jax.checkpoint(loss_of, policy=policy)
             else:
                 loss_of_ = loss_of

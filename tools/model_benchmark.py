@@ -83,14 +83,101 @@ def bench_resnet50():
             "imgs_per_s": round(B / (ms / 1e3), 1)}
 
 
+def bench_ernie():
+    """BASELINE config 2: ERNIE-3.0 base finetune (12L H768 A12, seq-cls,
+    B=32 S=128 — the canonical PaddleNLP finetune recipe shape). MFU uses
+    ~6·N·tokens like the llama bench (encoder fwd+bwd matmul estimate)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.ernie import (ErnieConfig,
+                                         ErnieForSequenceClassification,
+                                         ernie_tiny_config)
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu:
+        cfg = ErnieConfig(vocab_size=40000, hidden_size=768,
+                          num_hidden_layers=12, num_attention_heads=12,
+                          intermediate_size=3072, hidden_dropout_prob=0.1,
+                          attention_probs_dropout_prob=0.1,
+                          max_position_embeddings=2048)
+        B, S, iters = 32, 128, 8
+    else:
+        cfg = ernie_tiny_config()
+        B, S, iters = 4, 32, 3
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = AdamW(learning_rate=5e-5, parameters=model.parameters())
+    engine = ParallelEngine(model, optimizer=opt, loss_fn=model.loss_fn,
+                            remat=False)
+    engine.build_train_step()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, S))
+                           .astype("int32"))
+    labels = paddle.to_tensor(rng.randint(0, 2, (B,)).astype("int64"))
+    from paddle_tpu.utils.bench_timing import device_time_ms, peak_flops
+
+    ms = device_time_ms(lambda: engine.train_batch(ids, labels),
+                        reps=iters, warmup=2)
+    toks = B * S / (ms / 1e3)
+    return {"ms_per_step": round(ms, 2),
+            "tokens_per_s": round(toks, 1),
+            "examples_per_s": round(B / (ms / 1e3), 1),
+            "mfu_6nd": round(toks * 6.0 * n_params / peak_flops(), 4),
+            "params_m": round(n_params / 1e6, 1)}
+
+
+def bench_ocr_rec():
+    """BASELINE config 5 (rec side): the CRNN+CTC recipe from
+    examples/ocr_recognition.py — conv tower + BiLSTM + CTC, the actual
+    PP-OCRv4-style rec training step, not a ResNet proxy."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.parallel import ParallelEngine
+    from paddle_tpu.vision.models import CRNN, crnn_ctc_loss
+    from paddle_tpu.nn import Layer
+
+    class CRNNWithLoss(Layer):
+        def __init__(self, rec):
+            super().__init__()
+            self.rec = rec
+
+        def forward(self, imgs, labels, lengths):
+            return crnn_ctc_loss(self.rec(imgs), labels, lengths)
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    B, iters = (64, 8) if on_tpu else (8, 3)
+    model = CRNNWithLoss(CRNN(num_classes=10, in_channels=1))
+    opt = Adam(learning_rate=1e-3, parameters=model.parameters())
+    engine = ParallelEngine(model, optimizer=opt, loss_fn=None, remat=False)
+    engine.build_train_step()
+    rng = np.random.RandomState(0)
+    imgs = paddle.to_tensor(rng.rand(B, 1, 32, 96).astype("float32"))
+    labels = paddle.to_tensor(rng.randint(1, 11, (B, 5)).astype("int32"))
+    lengths = paddle.to_tensor(np.full((B,), 5, np.int32))
+    from paddle_tpu.utils.bench_timing import device_time_ms
+
+    ms = device_time_ms(lambda: engine.train_batch(imgs, labels, lengths),
+                        reps=iters, warmup=2)
+    return {"ms_per_step": round(ms, 2),
+            "imgs_per_s": round(B / (ms / 1e3), 1)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-o", "--output", default=None)
-    ap.add_argument("--models", default="llama,resnet50")
+    ap.add_argument("--models", default="llama,resnet50,ernie,ocr_rec")
     args = ap.parse_args()
     from paddle_tpu.utils.bench_timing import tpu_lock
 
-    table = {"llama": bench_llama, "resnet50": bench_resnet50}
+    table = {"llama": bench_llama, "resnet50": bench_resnet50,
+             "ernie": bench_ernie, "ocr_rec": bench_ocr_rec}
     results = {}
     for name in args.models.split(","):
         with tpu_lock(timeout_s=900.0) as locked:
