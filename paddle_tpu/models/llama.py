@@ -396,7 +396,7 @@ class LlamaMLP(Layer):
 
             def mlp8(v, wgq, sg, wuq, su, wdq, sd):
                 h = jax.nn.silu(w8_matmul(v, wgq, sg)) * w8_matmul(v, wuq, su)
-                return w8_matmul(h, wdq, sd)
+                return checkpoint_name(w8_matmul(h, wdq, sd), "mlp_out")
 
             out = apply_op(mlp8, x,
                            self.gate_proj.weight_q, self.gate_proj.weight_scale,

@@ -60,10 +60,20 @@ class ParallelEngine:
     def __init__(self, model, optimizer=None, loss_fn: Optional[Callable] = None,
                  mesh: Optional[Mesh] = None, fsdp: bool = False, remat: bool = False,
                  remat_policy: Optional[str] = "dots", batch_spec: Any = P("data"),
-                 donate: bool = True, abstract: bool = False):
+                 donate: bool = True, abstract: bool = False,
+                 offload_opt_state: bool = False,
+                 alias_model_params: bool = False):
         """abstract=True keeps params/opt-state as ShapeDtypeStructs — the
         step can be .lower()ed (AOT partitioning validation at any scale)
-        but not executed."""
+        but not executed.
+
+        offload_opt_state=True parks the optimizer moments in host RAM
+        (pinned_host memory) between steps — the compiled step streams them
+        d2h/h2d through PCIe, freeing ~8 bytes/param of HBM so a ~2-3B
+        AdamW config fits one 16 GB chip (ref group_sharded_stage3.py:60
+        cpu_offload semantics, done as XLA memory kinds instead of tensor
+        .cpu() hooks). Single-device path only.
+        """
         from ..distributed.collective import get_global_mesh
 
         self.model = model
@@ -79,9 +89,34 @@ class ParallelEngine:
         self.batch_spec = batch_spec
         self._donate = donate
         self._abstract = abstract
+        self._offload_opt = offload_opt_state
+        # alias_model_params=True skips the defensive params copy (single-
+        # device path): saves a full param-size HBM allocation on big
+        # models, at the cost that the eager model is INVALID until
+        # sync_to_model (donation consumes the shared buffers)
+        self._alias_params = alias_model_params
+        if offload_opt_state and self.mesh.size > 1:
+            raise NotImplementedError(
+                "offload_opt_state is single-device; multi-chip runs shard "
+                "the state over the mesh instead (ZeRO)")
         self._build_state()
         self._train_step = None
         self._eval_step = None
+
+    @staticmethod
+    def _host_sharding():
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if "pinned_host" not in kinds:
+            # the CPU backend has no device-placement custom call at all
+            # (annotate_device_placement unregistered) — offload is a
+            # TPU-backend feature, verified on chip (BASELINE.md round 4)
+            raise NotImplementedError(
+                f"offload_opt_state needs a backend with pinned_host "
+                f"memory; this backend has {sorted(kinds)}")
+        return SingleDeviceSharding(dev, memory_kind="pinned_host")
 
     # ------------------------------------------------------------------ state
     def _build_state(self):
@@ -121,10 +156,28 @@ class ParallelEngine:
             # copy: self.params gets donated every step; aliasing the model's
             # live Parameter buffers would invalidate eager use of the model
             # (model(x), p.value) until sync_to_model
-            self.params = {name: jnp.copy(v) for name, v in vals.items()}
-            self.opt_state = (self.optimizer.init_state(
-                {n: v for n, v in self.params.items() if n in self._trainable})
-                if self.optimizer is not None else {})
+            self.params = (dict(vals) if self._alias_params else
+                           {name: jnp.copy(v) for name, v in vals.items()})
+            train = {n: v for n, v in self.params.items()
+                     if n in self._trainable}
+            if self.optimizer is None:
+                self.opt_state = {}
+            elif self._offload_opt:
+                if getattr(self.optimizer, "_mt_active", lambda: False)():
+                    raise ValueError(
+                        "offload_opt_state and PT_MT_ADAMW are mutually "
+                        "exclusive (the flat state has no per-param layout "
+                        "to stream); unset one")
+                # init the slots INSIDE a jit whose out_shardings are host
+                # memory: materializing the full f32 state on device first
+                # (19 GB at 2.4B) is exactly what offload must avoid
+                host = self._host_sharding()
+                sds = jax.eval_shape(self.optimizer.init_state, train)
+                self.opt_state = jax.jit(
+                    self.optimizer.init_state,
+                    out_shardings=jax.tree.map(lambda _: host, sds))(train)
+            else:
+                self.opt_state = self.optimizer.init_state(train)
             return
         self.params = {
             name: jax.device_put(v, _sharding_of(mesh, self.specs.get(name, P())))
@@ -245,8 +298,13 @@ class ParallelEngine:
                 loss_of_ = loss_of
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_of_, has_aux=True)(train)
-            new_train, new_state = opt.pure_update(train, grads, opt_state, lr,
-                                                   step_count + 1)
+            if self._offload_opt and opt_state:
+                new_train, new_state = self._offloaded_update(
+                    opt, train, grads, opt_state, lr, step_count + 1, loss)
+            else:
+                new_train, new_state = opt.pure_update(train, grads,
+                                                       opt_state, lr,
+                                                       step_count + 1)
             if self._spmd:
                 # keep shardings stable across steps
                 new_train = {
@@ -259,8 +317,79 @@ class ParallelEngine:
 
         self._step_count = jnp.zeros((), jnp.int32)
         donate = (0, 1, 2) if self._donate else ()
-        self._train_step = jax.jit(step_fn, donate_argnums=donate)
+        jit_kw = {}
+        if self._offload_opt and self.opt_state and not hasattr(
+                self.optimizer, "_apply_one") and not hasattr(
+                self.optimizer, "_apply_adamw"):
+            raise NotImplementedError(
+                "offload_opt_state needs a per-param update rule "
+                "(_apply_one/_apply_adamw)")
+        if self._offload_opt and self.opt_state:
+            # pin the NEW opt state back to host memory; everything else
+            # (None = unspecified) stays wherever XLA puts it
+            host = self._host_sharding()
+            jit_kw["out_shardings"] = (
+                None, jax.tree.map(lambda _: host, self.opt_state), None,
+                None)
+        self._train_step = jax.jit(step_fn, donate_argnums=donate, **jit_kw)
         return self._train_step
+
+    def _offloaded_update(self, opt, train, grads, opt_state, lr, step,
+                          loss):
+        """Per-param optimizer update with host-resident moments, SEQUENCED.
+
+        A naive whole-tree h2d materializes every moment tensor in HBM at
+        once (measured RESOURCE_EXHAUSTED at 2.4B on v5e — XLA hoists the
+        transfers), defeating the offload. Here each param's moments are
+        transferred, updated and sent back inside a data-dependency chain:
+        an optimization_barrier makes param i+1's h2d depend on a scalar
+        from param i's new state, bounding peak HBM to ~one param's
+        moments. Updates therefore don't overlap backward — host offload
+        trades step time for fit, by design (ref
+        group_sharded_stage3.py:60 cpu-offload has the same tradeoff).
+        """
+        from jax.sharding import SingleDeviceSharding
+
+        from ..optimizer.optimizer import _pure_grad_clip
+
+        dev_s = SingleDeviceSharding(jax.devices()[0], memory_kind="device")
+        host = self._host_sharding()
+        apply_adamw = getattr(opt, "_apply_adamw", None)
+        # same pre-update semantics as pure_update: clip, decay masking,
+        # L2-as-grad for non-decoupled optimizers
+        if opt._grad_clip is not None:
+            grads = _pure_grad_clip(opt._grad_clip, grads)
+        new_train, new_state = {}, {}
+        token = loss * 0.0
+        for n in sorted(train):
+            g = grads.get(n)
+            if g is None:
+                new_train[n] = train[n]
+                new_state[n] = opt_state.get(n, {})
+                continue
+            g = g.astype(jnp.float32)
+            slots = {
+                k: jax.device_put(
+                    jax.lax.optimization_barrier((v, token))[0], dev_s)
+                for k, v in opt_state[n].items()}
+            if apply_adamw is not None:
+                decay = opt._wd_coeff
+                if opt._apply_decay_param_fun is not None and \
+                        not opt._apply_decay_param_fun(n):
+                    decay = 0.0
+                np_, ns = apply_adamw(train[n], g, lr, step, decay, slots)
+            else:
+                if opt._use_l2_decay() and opt._l2_coeff:
+                    g = g + opt._reg_grad(train[n].astype(jnp.float32))
+                np_, ns = opt._apply_one(train[n], g, lr, step, slots)
+            # chain the NEXT transfer on one element of this update
+            first = next(iter(ns.values()))
+            token = jax.lax.convert_element_type(
+                first.ravel()[0], jnp.float32) * 0.0
+            new_train[n] = np_
+            new_state[n] = {k: jax.device_put(v, host)
+                            for k, v in ns.items()}
+        return new_train, new_state
 
     def train_batch(self, *batch):
         """Run one compiled, sharded train step; returns host loss."""

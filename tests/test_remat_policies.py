@@ -105,3 +105,36 @@ def test_save_attn_actually_saves_fewer_residuals():
     assert "LlamaAttention" in with_names and "LlamaMLP" in with_names, \
         with_names[-500:]
     assert "LlamaAttention" not in without and "LlamaMLP" not in without
+
+
+def test_offload_opt_state_requires_pinned_host():
+    """The CPU backend has no pinned_host memory (and no placement custom
+    call) — the engine must say so clearly instead of failing mid-compile.
+    The trains-and-stays-on-host behavior is verified ON CHIP
+    (tools/bench_offload.py; BASELINE.md round 4)."""
+    paddle.seed(0)
+    cfg = llama_tiny_config(use_flash_attention=False)
+    m = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+    kinds = {mm.kind for mm in jax.devices()[0].addressable_memories()}
+    if "pinned_host" in kinds:
+        pytest.skip("TPU backend: covered by the on-chip benchmark")
+    with pytest.raises(NotImplementedError, match="pinned_host"):
+        ParallelEngine(m, optimizer=opt, loss_fn=m.loss_fn, mesh=mesh,
+                       offload_opt_state=True)
+
+
+def test_offload_multi_device_raises():
+    from jax.sharding import Mesh
+
+    cfg = llama_tiny_config(use_flash_attention=False)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("data",))
+    with pytest.raises(NotImplementedError):
+        ParallelEngine(m, optimizer=opt, loss_fn=m.loss_fn, mesh=mesh,
+                       offload_opt_state=True)
