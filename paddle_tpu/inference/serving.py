@@ -52,7 +52,16 @@ class GenerationServer:
 
     def __init__(self, model, max_batch: int = 4, max_len: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128),
-                 eos_token_id: Optional[int] = None, seed: int = 0):
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 tick_window: int = 1):
+        """``tick_window``: decode ticks per host round trip. 1 = exact
+        per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
+        before the host sees the tokens — eos detection and slot refill lag
+        by up to k-1 tokens (the surplus is discarded), in exchange for
+        amortizing the device→host sync: on a tunneled backend the
+        round-trip dominates a decode tick by ~100×, and even on a local
+        host it bounds tick-rate. The serving analogue of generate()'s
+        fully-compiled scan loop."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         self.model = model
@@ -65,6 +74,9 @@ class GenerationServer:
                 f"no prompt bucket fits max_len={max_len} "
                 f"(prompt_buckets={tuple(prompt_buckets)})")
         self.eos = eos_token_id
+        if tick_window < 1:
+            raise ValueError(f"tick_window must be >= 1, got {tick_window}")
+        self.tick_window = int(tick_window)
         self.params = state_values(model)
 
         from ..framework.dtype import convert_dtype
@@ -74,9 +86,12 @@ class GenerationServer:
         cdtype = convert_dtype(cfg.dtype)
         self._caches = [jnp.zeros((max_batch, max_len, kv, d), cdtype)
                         for _ in range(2 * cfg.num_hidden_layers)]
-        self.pos = jnp.zeros((max_batch,), jnp.int32)
-        self.tokens = jnp.zeros((max_batch,), jnp.int32)
-        self.temps = jnp.zeros((max_batch,), jnp.float32)
+        # per-slot scalars live HOST-side (numpy): slot assignment would
+        # otherwise cost one eager device dispatch per field per request —
+        # each a full round trip on a tunneled backend
+        self.pos = np.zeros((max_batch,), np.int32)
+        self.tokens = np.zeros((max_batch,), np.int32)
+        self.temps = np.zeros((max_batch,), np.float32)
         self._step_no = 0
         self._base_key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Request]] = [None] * max_batch
@@ -97,36 +112,55 @@ class GenerationServer:
                             self.model.model.embed_tokens.weight)
         return self.model.lm_head(h)
 
-    def _decode_fn(self, params, tokens, flat_caches, pos, temps, key):
-        """One tick: advance every slot by one token. Per-slot temperature:
-        temp == 0 → greedy argmax; temp > 0 → categorical sample at that
-        temperature (each slot draws from its own key)."""
+    def _decode_fn(self, params, tokens, flat_caches, pos, temps, active,
+                   key):
+        """``tick_window`` ticks as one compiled region: each tick advances
+        every slot by one token (per-slot temperature: temp == 0 → greedy
+        argmax; temp > 0 → categorical at that temperature). ``active``
+        masks position advance so idle slots don't drift their cache write
+        row. Returns the (k, B) token stack + final caches."""
         model = self.model
-        caches = [(Tensor(flat_caches[2 * i]), Tensor(flat_caches[2 * i + 1]))
-                  for i in range(self.cfg.num_hidden_layers)]
 
-        def call():
-            h, new = model.model.decode_step(Tensor(tokens[:, None]), caches,
-                                             pos)
-            return self._head(h), new
+        def one_tick(carry, k):
+            toks, flat_c, p = carry
+            caches = [(Tensor(flat_c[2 * i]), Tensor(flat_c[2 * i + 1]))
+                      for i in range(self.cfg.num_hidden_layers)]
 
-        logits, new = functional_call(model, params, call_fn=call)
-        flat = []
-        for ck, cv in new:
-            flat += [ck.value, cv.value]
-        lg = logits.value[:, 0].astype(jnp.float32)       # (B, V)
-        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        # categorical draws independent samples per row with one key
-        sampled = jax.random.categorical(
-            key, lg / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
-        return jnp.where(temps > 0, sampled, greedy), flat
+            def call():
+                h, new = model.model.decode_step(Tensor(toks[:, None]),
+                                                 caches, p)
+                return self._head(h), new
+
+            logits, new = functional_call(model, params, call_fn=call)
+            flat = []
+            for ck, cv in new:
+                flat += [ck.value, cv.value]
+            lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                jax.random.fold_in(key, k),
+                lg / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
+            nxt = jnp.where(temps > 0, sampled, greedy)
+            return (nxt, flat, p + active), nxt
+
+        if self.tick_window == 1:
+            (_, flat, _), stack = one_tick((tokens, flat_caches, pos), 0)
+            return stack[None], flat
+        (_, flat, _), stack = jax.lax.scan(
+            one_tick, (tokens, flat_caches, pos),
+            jnp.arange(self.tick_window))
+        return stack, flat
 
     def _prefill(self, bucket: int):
+        """Prefill + slot scatter as ONE jitted call (donated pool): the
+        per-layer eager `.at[slot].set` scatters cost 2·L dispatches per
+        request otherwise — each a tunnel round trip."""
         if bucket not in self._prefills:
             model = self.model
 
-            def fn(params, prompt, true_len):
-                """prompt [1, bucket] right-padded; logits at true_len-1."""
+            def fn(params, prompt, true_len, pool, slot):
+                """prompt [1, bucket] right-padded; logits at true_len-1;
+                the request's cache rows scatter into pool[slot]."""
                 kvs = self.cfg.num_key_value_heads
                 d = self.cfg.hidden_size // self.cfg.num_attention_heads
                 from ..framework.dtype import convert_dtype
@@ -146,9 +180,10 @@ class GenerationServer:
                 flat = []
                 for ck, cv in new:
                     flat += [ck.value, cv.value]
-                return logits.value[:, 0].astype(jnp.float32), flat
+                pool = [p.at[slot].set(row[0]) for p, row in zip(pool, flat)]
+                return logits.value[:, 0].astype(jnp.float32), pool
 
-            self._prefills[bucket] = jax.jit(fn)
+            self._prefills[bucket] = jax.jit(fn, donate_argnums=(3,))
         return self._prefills[bucket]
 
     # --------------------------------------------------------------- requests
@@ -179,25 +214,25 @@ class GenerationServer:
         bucket = self._bucket_for(n)
         prompt = np.zeros((1, bucket), np.int32)
         prompt[0, :n] = req.prompt
-        lg, flat = self._prefill(bucket)(self.params, jnp.asarray(prompt), n)
-        # the FIRST generated token honors the request temperature too
+        # one compiled call: prefill + scatter into the slot's pool rows.
+        # Rows beyond the true prompt length hold right-pad garbage, but
+        # decode writes sequentially from pos=n, overwriting each such row
+        # BEFORE the attention mask (arange <= pos) can reach it.
+        lg, self._caches = self._prefill(bucket)(
+            self.params, jnp.asarray(prompt), n, self._caches, slot)
+        # the FIRST generated token honors the request temperature too;
+        # sample/argmax on the still-on-device logits so each assignment
+        # costs exactly ONE host sync
         if req.temperature > 0:
             k = jax.random.fold_in(self._base_key, (req.rid << 20) | 1)
-            first = jax.random.categorical(
-                k, lg / max(req.temperature, 1e-6)).astype(jnp.int32)
+            first = int(jax.random.categorical(
+                k, lg / max(req.temperature, 1e-6))[0])
         else:
-            first = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        # scatter this request's cache rows into the slot. Rows beyond the
-        # true prompt length hold right-pad garbage, but decode writes
-        # sequentially from pos=n, overwriting each such row BEFORE the
-        # attention mask (arange <= pos) can reach it — never attended.
-        for i in range(len(self._caches)):
-            self._caches[i] = self._caches[i].at[slot, :self.max_len].set(
-                flat[i][0])
-        self.pos = self.pos.at[slot].set(n)
-        self.tokens = self.tokens.at[slot].set(int(first[0]))
-        self.temps = self.temps.at[slot].set(req.temperature)
-        req.generated.append(int(first[0]))
+            first = int(jnp.argmax(lg, axis=-1)[0])
+        self.pos[slot] = n
+        self.tokens[slot] = first
+        self.temps[slot] = req.temperature
+        req.generated.append(first)
         self._slots[slot] = req
 
     def _fill_free_slots(self) -> None:
@@ -206,7 +241,8 @@ class GenerationServer:
                 self._assign(s, self._queue.popleft())
 
     def step(self) -> int:
-        """One decode tick across all occupied slots; returns #active."""
+        """One decode window (``tick_window`` ticks) across all occupied
+        slots; returns #active."""
         self._fill_free_slots()
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None]
@@ -214,29 +250,40 @@ class GenerationServer:
             return 0
         self._step_no += 1
         key = jax.random.fold_in(self._base_key, self._step_no)
-        nxt, self._caches = self._decode(self.params, self.tokens,
-                                         self._caches, self.pos, self.temps,
-                                         key)
         active_mask = np.zeros((self.max_batch,), np.int32)
         active_mask[active] = 1
         # only occupied slots advance — idle slots must not drift their
         # write position (their garbage scatters would eventually go OOB)
-        self.pos = self.pos + jnp.asarray(active_mask)
-        self.tokens = nxt
-        nxt_host = np.asarray(nxt)
-        pos_host = np.asarray(self.pos)
+        stack, self._caches = self._decode(
+            self.params, jnp.asarray(self.tokens), self._caches,
+            jnp.asarray(self.pos), jnp.asarray(self.temps),
+            jnp.asarray(active_mask), key)
+        k = self.tick_window
+        nxt_host = np.asarray(stack)          # (k, B)
+        self.pos = self.pos + active_mask * k
+        self.tokens = nxt_host[-1].copy()
+        pos_after = self.pos
         for s in active:
             req = self._slots[s]
-            tok = int(nxt_host[s])
-            finished_last = (self.eos is not None and
-                             req.generated[-1] == self.eos)
-            if not finished_last:
-                req.generated.append(tok)
-            if (finished_last or len(req.generated) >= req.max_new_tokens
-                    or int(pos_host[s]) >= self.max_len - 1):
+            done = False
+            for t in range(k):
+                tok = int(nxt_host[t, s])
+                finished_last = (self.eos is not None and
+                                 req.generated[-1] == self.eos)
+                if not finished_last:
+                    req.generated.append(tok)
+                pos_t = int(pos_after[s]) - k + t + 1
+                if (finished_last
+                        or len(req.generated) >= req.max_new_tokens
+                        or pos_t >= self.max_len - 1):
+                    done = True
+                    break
+            if done:
+                # window surplus past completion is discarded (tick_window
+                # semantics); the slot frees for next window's refill
                 self._results[req.rid] = req.prompt + req.generated[
                     :req.max_new_tokens]
-                self._slots[s] = None  # freed: refilled next tick
+                self._slots[s] = None
         return sum(sl is not None for sl in self._slots) + len(self._queue)
 
     def run(self) -> Dict[int, List[int]]:

@@ -80,3 +80,33 @@ class TestGenerationServer:
             paddle.to_tensor(np.asarray([p_sample], np.int32)),
             max_new_tokens=6).value)[0].tolist()
         assert res[rs] != greedy_alt
+
+
+def test_tick_window_greedy_parity():
+    """tick_window batches device ticks per host sync; greedy outputs must
+    be IDENTICAL to the exact per-token server (surplus discarded)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import GenerationServer
+    from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=160,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 128, n).tolist() for n in (5, 17, 33)]
+
+    def run(window):
+        srv = GenerationServer(model, max_batch=2, max_len=160,
+                               prompt_buckets=(32, 64), tick_window=window)
+        rids = [srv.submit(p, max_new_tokens=9) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    exact = run(1)
+    windowed = run(4)
+    assert exact == windowed
