@@ -223,11 +223,24 @@ class LlamaAttention(Layer):
         self.o_proj.weight.pspec = P("tensor", None)
 
     def _qkv(self, x, B, S):
-        """q/k/v projections. (Fusing the three into one concatenated int8
-        matmul was measured 2026-07 at 6962 vs 7626 tok/s unfused — the
-        output splits cost more than the saved kernel launches — so the
-        projections stay separate.)"""
-        q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        """q/k/v projections. The int8 decode path can fuse the three into
+        ONE concatenated matmul (quantize_int8 with PT_W8_FUSED_QKV=1 —
+        single weight stream + kernel launch per step; see the measured
+        A/B in BASELINE.md round 4)."""
+        if getattr(self, "_w8_split", None):
+            from ..ops.int8 import w8_matmul
+
+            nq, nk, nv = self._w8_split
+
+            def qkv8(v, wq, s):
+                o = w8_matmul(v, wq, s)
+                return o[..., :nq], o[..., nq:nq + nk], o[..., nq + nk:]
+
+            q, k, v = apply_op(qkv8, x, self.qkv_fused.weight_q,
+                               self.qkv_fused.weight_scale,
+                               op_name="w8_qkv")
+        else:
+            q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
         return (reshape(q, [B, S, self.num_heads, self.head_dim]),
                 reshape(k, [B, S, self.num_kv_heads, self.head_dim]),
                 reshape(v, [B, S, self.num_kv_heads, self.head_dim]))
@@ -554,12 +567,33 @@ class LlamaForCausalLM(Layer):
         HBM-bound on parameter bytes, int8 halves them). Embedding stays in
         the model dtype (it is gathered, not matmul'd). In-place; returns
         self. Use for inference only — int8 weights do not train."""
-        from ..nn.quant import Int8Linear
+        import os
 
+        from ..nn.quant import Int8Linear
+        from ..ops.int8 import quantize_per_channel
+
+        fuse_qkv = os.environ.get("PT_W8_FUSED_QKV") == "1"
         for layer in self.model.layers:
             att, mlp = layer.self_attn, layer.mlp
-            for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
-                setattr(att, name, Int8Linear.from_linear(getattr(att, name)))
+            if fuse_qkv:
+                # one [K, Nq+Nk+Nv] int8 weight (per-channel scales are
+                # column-independent, so fused == separate numerically);
+                # the bf16 projections are dropped from the module tree so
+                # the decode weight stream isn't paid twice
+                wcat = jnp.concatenate(
+                    [att.q_proj.weight.value, att.k_proj.weight.value,
+                     att.v_proj.weight.value], axis=1)
+                w_q, sc = quantize_per_channel(wcat)
+                att._w8_split = (int(att.q_proj.weight.shape[1]),
+                                 int(att.k_proj.weight.shape[1]),
+                                 int(att.v_proj.weight.shape[1]))
+                att.qkv_fused = Int8Linear(w_q, sc)
+                att.q_proj = att.k_proj = att.v_proj = None
+                att.o_proj = Int8Linear.from_linear(att.o_proj)
+            else:
+                for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                    setattr(att, name,
+                            Int8Linear.from_linear(getattr(att, name)))
             for name in ("gate_proj", "up_proj", "down_proj"):
                 setattr(mlp, name, Int8Linear.from_linear(getattr(mlp, name)))
         if not self.cfg.tie_word_embeddings:

@@ -113,3 +113,36 @@ def test_w8_pallas_kernel_interpreted_matches_jnp():
             (wq.astype(jnp.float32) * scale[None, :]))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-2, atol=1e-2)
+
+
+def test_fused_qkv_matches_unfused(monkeypatch):
+    """PT_W8_FUSED_QKV=1 concatenates q/k/v into one int8 matmul; greedy
+    generation must match the unfused int8 path exactly (per-channel scales
+    are column-independent, so the quantization is identical)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      dtype="float32", use_flash_attention=False)
+    rng = np.random.RandomState(3)
+    ids = paddle.to_tensor(rng.randint(1, 128, (2, 12)).astype("int32"))
+
+    paddle.seed(5)
+    m1 = LlamaForCausalLM(cfg)
+    sd = {k: np.array(np.asarray(v.value)) for k, v in m1.state_dict().items()}
+    monkeypatch.delenv("PT_W8_FUSED_QKV", raising=False)
+    out1 = np.asarray(m1.quantize_int8().generate(ids, max_new_tokens=8).value)
+
+    paddle.seed(5)
+    m2 = LlamaForCausalLM(cfg)
+    m2.set_state_dict({k: paddle.to_tensor(v) for k, v in sd.items()})
+    monkeypatch.setenv("PT_W8_FUSED_QKV", "1")
+    out2 = np.asarray(m2.quantize_int8().generate(ids, max_new_tokens=8).value)
+    np.testing.assert_array_equal(out1, out2)
+    # the bf16 projections are really gone (no double weight stream)
+    names = [n for n, _ in m2.model.layers[0].self_attn.named_buffers()]
+    assert any("qkv_fused" in n for n in names), names
