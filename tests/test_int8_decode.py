@@ -143,6 +143,11 @@ def test_fused_qkv_matches_unfused(monkeypatch):
     monkeypatch.setenv("PT_W8_FUSED_QKV", "1")
     out2 = np.asarray(m2.quantize_int8().generate(ids, max_new_tokens=8).value)
     np.testing.assert_array_equal(out1, out2)
-    # the bf16 projections are really gone (no double weight stream)
-    names = [n for n, _ in m2.model.layers[0].self_attn.named_buffers()]
-    assert any("qkv_fused" in n for n in names), names
+    # the bf16 projections are really gone (no double weight stream):
+    # check the PARAMETER store, where the dropped Linears lived
+    att = m2.model.layers[0].self_attn
+    pnames = [n for n, _ in att.named_parameters()]
+    assert not any(p in n for n in pnames
+                   for p in ("q_proj", "k_proj", "v_proj")), pnames
+    bnames = [n for n, _ in att.named_buffers()]
+    assert any("qkv_fused" in n for n in bnames), bnames
