@@ -21,7 +21,7 @@ import sys
 import time
 from typing import List
 
-from .rendezvous import HTTPMaster
+from .rendezvous import ETCDMaster, HTTPMaster
 
 
 def _free_port() -> int:
@@ -197,21 +197,27 @@ def launch(argv=None) -> int:
         node_rank = 0
         master = None
     else:
-        master_ep = args.master or f"{_local_ip()}:{_free_port()}"
-        master_host = master_ep.rsplit(":", 1)[0]
-        # the master host may be named by loopback, hostname, or LAN ip —
-        # resolve spellings of "this machine" before deciding to host.
-        # (0.0.0.0 is deliberately NOT local: with the wildcard every node
-        # would claim mastership and split-brain its own private store)
-        local_names = {_local_ip(), "127.0.0.1", "localhost",
-                       socket.gethostname()}
-        try:
-            local_names.add(socket.gethostbyname(socket.gethostname()))
-        except OSError:
-            pass
-        is_master = args.rank in (0, -1) and (args.master is None or
-                                              master_host in local_names)
-        master = HTTPMaster(master_ep, is_master, nnodes)
+        if args.master is not None and args.master.startswith("etcd://"):
+            # external etcd rendezvous (ref controllers/master.py:177):
+            # the cluster scheduler owns the store; nobody hosts anything
+            master = ETCDMaster(args.master, nnodes)
+        else:
+            master_ep = args.master or f"{_local_ip()}:{_free_port()}"
+            master_host = master_ep.rsplit(":", 1)[0]
+            # the master host may be named by loopback, hostname, or LAN
+            # ip — resolve spellings of "this machine" before deciding to
+            # host. (0.0.0.0 is deliberately NOT local: with the wildcard
+            # every node would claim mastership and split-brain its own
+            # private store)
+            local_names = {_local_ip(), "127.0.0.1", "localhost",
+                           socket.gethostname()}
+            try:
+                local_names.add(socket.gethostbyname(socket.gethostname()))
+            except OSError:
+                pass
+            is_master = args.rank in (0, -1) and (args.master is None or
+                                                  master_host in local_names)
+            master = HTTPMaster(master_ep, is_master, nnodes)
         my_ep = f"{_local_ip()}:{_free_port()}"
         # identity for slot claims: explicit env id (stable across elastic
         # restarts) > explicit rank (pins slot rank directly) > the unique

@@ -174,3 +174,121 @@ class HTTPMaster:
 
     def stop(self):
         self.store.close()
+
+
+class ETCDMaster:
+    """Rendezvous through an EXTERNAL etcd cluster (ref
+    launch/controllers/master.py:177 ETCDMaster — the deployment story when
+    a cluster scheduler owns etcd). Same ``sync_peers`` contract as
+    HTTPMaster, speaking the etcd v3 gRPC-gateway JSON API directly
+    (``/v3/kv/put``, ``/v3/kv/range``, ``/v3/kv/txn``) so no client
+    library is needed: a txn comparing ``create_revision == 0`` is the
+    atomic set-if-absent that claims a rank slot.
+
+    Select from the CLI with ``--master etcd://host:port``.
+    """
+
+    def __init__(self, endpoint: str, nnodes: int, timeout: float = 300.0):
+        if endpoint.startswith("etcd://"):
+            endpoint = endpoint[len("etcd://"):]
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.base = endpoint.rstrip("/")
+        self.nnodes = nnodes
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- etcd ops
+    @staticmethod
+    def _b64(s) -> str:
+        import base64
+
+        if isinstance(s, str):
+            s = s.encode()
+        return base64.b64encode(s).decode()
+
+    @staticmethod
+    def _unb64(s) -> bytes:
+        import base64
+
+        return base64.b64decode(s)
+
+    def _call(self, path: str, body: dict) -> dict:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:  # transient server side — retry
+                    err: OSError = e
+                else:
+                    # 4xx is a real misconfiguration (auth, wrong gateway
+                    # path, bad txn) — surface it, don't spin to "timeout"
+                    raise RuntimeError(
+                        f"etcd {self.base}{path} rejected the request: "
+                        f"HTTP {e.code} {e.reason}") from e
+            except OSError as e:
+                err = e
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"etcd at {self.base} unreachable ({err})")
+            time.sleep(0.5)
+
+    @staticmethod
+    def _prefix_end(prefix: bytes) -> bytes:
+        """etcd range_end for a prefix scan: prefix with last byte + 1."""
+        return prefix[:-1] + bytes([prefix[-1] + 1])
+
+    def _put(self, key: str, value: str):
+        self._call("/v3/kv/put", {"key": self._b64(key),
+                                  "value": self._b64(value)})
+
+    def _range_prefix(self, prefix: str) -> Dict[bytes, bytes]:
+        p = prefix.encode()
+        r = self._call("/v3/kv/range", {
+            "key": self._b64(p), "range_end": self._b64(self._prefix_end(p))})
+        return {self._unb64(kv["key"]): self._unb64(kv["value"])
+                for kv in (r.get("kvs") or [])}
+
+    def _delete_prefix(self, prefix: str):
+        p = prefix.encode()
+        self._call("/v3/kv/deleterange", {
+            "key": self._b64(p), "range_end": self._b64(self._prefix_end(p))})
+
+    # -------------------------------------------------------------- contract
+    def sync_peers(self, my_endpoint: str, job_id: str = "default",
+                   node_id: str = None, preferred_slot: int = None
+                   ) -> List[str]:
+        """Reference ETCDMaster.sync_peers algorithm (master.py:190): every
+        arriving node WIPES the job prefix first (clearing stale keys left
+        by dead incarnations on the persistent external store), then
+        repeatedly republishes its own key and polls until exactly
+        ``nnodes`` keys exist — a self-healing barrier (a late joiner's
+        wipe is repaired by every live node's republish loop). Keys are
+        rank-numbered when ``preferred_slot`` pins the rank, else
+        node-identity-named and ordered alphabetically (the reference's
+        sorted-pod-name rule)."""
+        me = node_id or my_endpoint
+        prefix = f"peers/{job_id}/"
+        key = prefix + (f"r/{preferred_slot:08d}" if preferred_slot
+                        is not None else f"n/{me}")
+        self._delete_prefix(prefix)
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            self._put(key, my_endpoint)
+            kvs = self._range_prefix(prefix)
+            if len(kvs) == self.nnodes:
+                return [v.decode() for _, v in sorted(kvs.items())]
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"rendezvous: {self.nnodes} peers never assembled under "
+            f"{prefix!r} within {self.timeout:.0f}s")
+
+    def stop(self):
+        pass  # the cluster's etcd outlives the job

@@ -1,0 +1,131 @@
+"""ETCDMaster rendezvous (ref launch/controllers/master.py:177) against a
+minimal in-process etcd v3 gRPC-gateway fake — validates the JSON protocol
+shapes (put / prefix range / deleterange) and the reference's wipe-then-
+republish barrier semantics without an etcd binary.
+"""
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddle_tpu.distributed.launch.rendezvous import ETCDMaster
+
+
+class _FakeEtcd(BaseHTTPRequestHandler):
+    store = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _read(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n).decode() or "{}")
+
+    def _send(self, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    @staticmethod
+    def _kv(key, value):
+        return {"key": base64.b64encode(key).decode(),
+                "value": base64.b64encode(value).decode()}
+
+    def do_POST(self):
+        body = self._read()
+        key = base64.b64decode(body.get("key", ""))
+        end = base64.b64decode(body["range_end"]) \
+            if body.get("range_end") else None
+
+        def in_range(k):
+            return k >= key and (end is None and k == key or
+                                 end is not None and k < end)
+
+        with self.lock:
+            if self.path == "/v3/kv/put":
+                self.store[key] = base64.b64decode(body["value"])
+                return self._send({})
+            if self.path == "/v3/kv/range":
+                kvs = [self._kv(k, v) for k, v in sorted(self.store.items())
+                       if in_range(k)]
+                return self._send({"kvs": kvs, "count": str(len(kvs))})
+            if self.path == "/v3/kv/deleterange":
+                gone = [k for k in self.store if in_range(k)]
+                for k in gone:
+                    del self.store[k]
+                return self._send({"deleted": str(len(gone))})
+        self.send_response(404)
+        self.end_headers()
+
+
+@pytest.fixture()
+def etcd():
+    _FakeEtcd.store = {}
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeEtcd)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"etcd://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _sync_concurrently(etcd, specs, nnodes=2, job="j1"):
+    """specs: list of (endpoint, node_id, preferred_slot)."""
+    out, errs = {}, []
+
+    def go(ep, nid, slot):
+        m = ETCDMaster(etcd, nnodes=nnodes, timeout=20.0)
+        try:
+            out[nid] = m.sync_peers(ep, job_id=job, node_id=nid,
+                                    preferred_slot=slot)
+        except Exception as e:  # noqa: BLE001 — surfaced via errs
+            errs.append(e)
+
+    ts = [threading.Thread(target=go, args=s) for s in specs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs, errs
+    return out
+
+
+def test_two_nodes_agree_on_endpoint_list(etcd):
+    out = _sync_concurrently(etcd, [("10.0.0.1:70", "a", None),
+                                    ("10.0.0.2:71", "b", None)])
+    assert out["a"] == out["b"]
+    assert sorted(out["a"]) == ["10.0.0.1:70", "10.0.0.2:71"]
+
+
+def test_explicit_ranks_order_the_list(etcd):
+    out = _sync_concurrently(etcd, [("10.0.0.9:70", "r1", 1),
+                                    ("10.0.0.8:70", "r0", 0)])
+    assert out["r0"] == out["r1"] == ["10.0.0.8:70", "10.0.0.9:70"]
+
+
+def test_stale_keys_from_dead_incarnation_are_wiped(etcd):
+    """A previous run with the same job_id left endpoint keys on the
+    persistent store; the next incarnation must not return them (the wipe +
+    republish barrier — ref master.py delete_prefix)."""
+    m = ETCDMaster(etcd, nnodes=2, timeout=20.0)
+    m._put("peers/j1/n/dead-node-1", "10.9.9.9:1")
+    m._put("peers/j1/n/dead-node-2", "10.9.9.8:1")
+    out = _sync_concurrently(etcd, [("10.0.0.1:70", "a", None),
+                                    ("10.0.0.2:71", "b", None)])
+    assert sorted(out["a"]) == ["10.0.0.1:70", "10.0.0.2:71"]
+
+
+def test_http_4xx_surfaces_immediately(etcd):
+    m = ETCDMaster(etcd, nnodes=2, timeout=20.0)
+    m.base = m.base  # real fake server: unknown path → 404
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="HTTP 404"):
+        m._call("/v3/kv/nosuch", {})
+    assert time.monotonic() - t0 < 5.0  # no 300s retry spin

@@ -225,3 +225,87 @@ def test_fused_adamw_kernel_matches_reference(monkeypatch):
                                rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(got[2]), np.asarray(v2),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_flash_bwd_streaming_grid_s16384():
+    """S=16384 BACKWARD through the streaming split kernels (the round-3
+    tier only covered the forward at this length). Causality + a dO that is
+    nonzero only on the first 1024 query rows make the true grads exactly
+    computable from a 1024-dense reference: dq[:1024] matches it, and
+    dk/dv beyond the first 1024 keys must be ZERO — while the real
+    1024x1024 streaming grid still executes over the full length."""
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    s16 = 16384
+    q, k, v = _qkv(7, s=s16, kv=2)
+    q = q[:1, :4]
+    k = k[:1]
+    v = v[:1]
+
+    def loss_flash(a, b, c):
+        out = flash_attention(a, b, c, True).astype(jnp.float32)
+        return jnp.sum(out[:, :, :1024] * 0.01)
+
+    dq, dk, dv = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+
+    def loss_ref(a, b, c):
+        return jnp.sum(_ref(a, b, c, True) * 0.01)
+
+    rq, rk, rv = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(
+        q[:, :, :1024], k[:, :, :1024], v[:, :, :1024])
+    np.testing.assert_allclose(np.asarray(dq[:, :, :1024], np.float32),
+                               np.asarray(rq, np.float32),
+                               rtol=5e-2, atol=5e-2, err_msg="dq prefix")
+    np.testing.assert_allclose(np.asarray(dk[:, :, :1024], np.float32),
+                               np.asarray(rk, np.float32),
+                               rtol=5e-2, atol=5e-2, err_msg="dk prefix")
+    np.testing.assert_allclose(np.asarray(dv[:, :, :1024], np.float32),
+                               np.asarray(rv, np.float32),
+                               rtol=5e-2, atol=5e-2, err_msg="dv prefix")
+    # zero-dO rows contribute nothing past the prefix
+    assert float(jnp.max(jnp.abs(dk[:, :, 1024:].astype(jnp.float32)))) == 0.0
+    assert float(jnp.max(jnp.abs(dv[:, :, 1024:].astype(jnp.float32)))) == 0.0
+    assert float(jnp.max(jnp.abs(dq[:, :, 1024:].astype(jnp.float32)))) == 0.0
+
+
+def test_fused_transformer_layer_on_chip():
+    """incubate FusedTransformerEncoderLayer (fused qkv matmul + flash SDPA
+    + fused norms) compiled bf16 on chip vs a plain f32 jnp re-derivation
+    from the same weights (round-3 weak item: no on-chip fused-transformer
+    case)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    d, heads, ffn = 256, 8, 512
+    paddle.seed(11)
+    layer = FusedTransformerEncoderLayer(d, heads, ffn, dropout_rate=0.0)
+    layer.eval()
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 512, d).astype("float32") * 0.1
+
+    out = np.asarray(layer(paddle.to_tensor(x)).value, np.float32)
+
+    # f32 reference from the layer's own weights
+    g = {n: np.asarray(p.value, np.float32)
+         for n, p in layer.named_parameters()}
+    qkv = x @ g["fused_attn.qkv_weight"] + g["fused_attn.qkv_bias"]
+    B, S = x.shape[:2]
+    qkv = qkv.reshape(B, S, 3, heads, d // heads)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(d // heads)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    att = np.einsum("bhst,bthd->bshd", p, v).reshape(B, S, d)
+    att = att @ g["fused_attn.linear_weight"] + g["fused_attn.linear_bias"]
+    h = x + att
+
+    def ln(y, w, b):
+        mu = y.mean(-1, keepdims=True)
+        var = y.var(-1, keepdims=True)
+        return (y - mu) / np.sqrt(var + 1e-5) * w + b
+
+    h = ln(h, g["fused_attn.post_ln.weight"], g["fused_attn.post_ln.bias"])
+    f = np.maximum(h @ g["ffn.linear1.weight"] + g["ffn.linear1.bias"], 0.0)
+    f = f @ g["ffn.linear2.weight"] + g["ffn.linear2.bias"]
+    want = ln(h + f, g["ffn.norm.weight"], g["ffn.norm.bias"])
+    np.testing.assert_allclose(out, want, rtol=3e-2, atol=3e-2)
