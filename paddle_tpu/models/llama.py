@@ -55,6 +55,9 @@ class LlamaConfig:
     # parallelism knobs
     context_parallel: bool = False  # ring attention over 'context' axis
     sequence_parallel: bool = False  # shard activations over 'sep'
+    # with sequence_parallel: attention via Ulysses head<->seq all_to_all
+    # on the 'sep' axis instead of GSPMD's gather (SURVEY §5.7 optional leg)
+    ulysses_parallel: bool = False
     use_flash_attention: bool = True
     # fuse lm_head matmul + CE when forward() is given labels: chunked
     # logsumexp, never materializes [B,S,V] logits (ops/fused_ce.py)
@@ -128,19 +131,62 @@ def _apply_rope(x, cos, sin, pos_offset=0):
 # --------------------------------------------------------------------------- #
 
 
-def _ring_dispatch(qr, kr, vv, rep, use_flash, causal):
-    """Bind the 'context' axis for ring attention (SURVEY §5.7 new design —
-    the reference has no context parallelism at all, grep-verified).
+def _attn_island(axis, local, qr, kr, vv, head_divisible=False):
+    """Shared scaffolding for attention shard_map islands.
 
-    ``lax.ppermute`` needs a *bound* mesh axis name. Inside an outer
-    shard_map (manual-SPMD callers) the direct call succeeds. Under GSPMD
-    jit (ParallelEngine) no axis is bound, so when the active mesh carries a
-    'context' axis we open a shard_map island around the ring: batch over
-    'data', sequence over 'context', heads over 'tensor' when present —
-    CP×TP composition falls out of the head sharding. Returns None when no
-    'context' axis exists anywhere; the caller falls back to plain
+    The sequence-axis collectives (``ppermute`` for the ring,
+    ``all_to_all`` for Ulysses) need a *bound* mesh axis name. Inside an
+    outer shard_map (manual-SPMD callers) the direct ``local`` call
+    succeeds. Under GSPMD jit (ParallelEngine) no axis is bound, so when
+    the active mesh carries ``axis`` we open a shard_map island: batch
+    over 'data', sequence over ``axis``, heads over 'tensor' when present
+    (CP×TP / SP×TP composition falls out of the head sharding). Returns
+    None when the axis exists nowhere — the caller falls back to plain
     attention (single-device parity runs).
+
+    ``head_divisible``: Ulysses additionally needs local head counts
+    divisible by the axis size; an explicit user request that can't be
+    honored warns instead of silently degrading.
     """
+    try:
+        return local(qr, kr, vv)  # already inside a shard_map binding axis
+    except NameError:
+        pass
+    from ..parallel.api import current_mesh, in_spmd_region
+
+    mesh = current_mesh()
+    if (mesh is None or axis not in mesh.shape or mesh.shape[axis] <= 1
+            or not in_spmd_region()):
+        return None
+    tp = "tensor" if ("tensor" in mesh.shape and mesh.shape["tensor"] > 1) \
+        else None
+    if head_divisible:
+        n = mesh.shape[axis]
+        tpn = mesh.shape[tp] if tp else 1
+        h, hkv = qr.shape[2], kr.shape[2]
+        if h % tpn or hkv % tpn or (h // tpn) % n or (hkv // tpn) % n:
+            import warnings
+
+            warnings.warn(
+                f"ulysses_parallel requested but head counts {h}/{hkv} are "
+                f"not divisible by the '{axis}' axis ({n}"
+                f"{f' x tensor {tpn}' if tp else ''}); falling back to "
+                f"GSPMD attention", UserWarning)
+            return None
+    dp = "data" if "data" in mesh.shape else None
+    spec = P(dp, axis, tp, None)
+    from ..ops.flash_attention import _interpret
+
+    # the pallas HLO interpreter's internal dynamic_slice doesn't propagate
+    # varying-mesh-axes types; compiled runs keep the default check
+    kw = {"check_vma": False} if _interpret() else {}
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, **kw)(qr, kr, vv)
+
+
+def _ring_dispatch(qr, kr, vv, rep, use_flash, causal):
+    """Ring attention over the 'context' axis (SURVEY §5.7 new design —
+    the reference has no context parallelism at all, grep-verified)."""
 
     def local(a, b, c):
         from ..ops.flash_attention import _use_pallas
@@ -154,27 +200,35 @@ def _ring_dispatch(qr, kr, vv, rep, use_flash, causal):
         vx = jnp.repeat(c, rep, axis=2) if rep > 1 else c
         return ring_attention_bshd(a, kx, vx, "context", causal=causal)
 
-    try:
-        return local(qr, kr, vv)  # already inside shard_map binding 'context'
-    except NameError:
-        pass
-    from ..parallel.api import current_mesh, in_spmd_region
+    return _attn_island("context", local, qr, kr, vv)
 
-    mesh = current_mesh()
-    if (mesh is None or "context" not in mesh.shape
-            or mesh.shape["context"] <= 1 or not in_spmd_region()):
-        return None
-    dp = "data" if "data" in mesh.shape else None
-    tp = "tensor" if ("tensor" in mesh.shape and mesh.shape["tensor"] > 1) \
-        else None
-    spec = P(dp, "context", tp, None)
-    from ..ops.flash_attention import _interpret
 
-    # the pallas HLO interpreter's internal dynamic_slice doesn't propagate
-    # varying-mesh-axes types; compiled runs keep the default check
-    kw = {"check_vma": False} if _interpret() else {}
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, **kw)(qr, kr, vv)
+def _ulysses_dispatch(qr, kr, vv, use_flash, causal):
+    """Ulysses sequence parallelism at the model level (SURVEY §5.7
+    optional leg; ref absent): all_to_all swaps the sharded dim seq→heads,
+    full-sequence attention runs on the local head slice, and a second
+    all_to_all swaps back. GQA needs no handling here — the flash kernel
+    and the dense reference both route shared KV heads internally."""
+
+    def attn_fn(a, b, c):
+        from ..ops.flash_attention import _use_pallas, flash_attention_bshd
+
+        if use_flash and _use_pallas():
+            return flash_attention_bshd(a, b, c, causal=causal)
+        from ..ops.flash_attention import _ref_bhsd
+
+        out = _ref_bhsd(jnp.swapaxes(a, 1, 2), jnp.swapaxes(b, 1, 2),
+                        jnp.swapaxes(c, 1, 2), causal,
+                        1.0 / math.sqrt(a.shape[-1]))
+        return jnp.swapaxes(out, 1, 2)
+
+    def local(a, b, c):
+        from ..parallel.ring_attention import ulysses_attention_bshd
+
+        return ulysses_attention_bshd(a, b, c, "sep", causal=causal,
+                                      attn_fn=attn_fn)
+
+    return _attn_island("sep", local, qr, kr, vv, head_divisible=True)
 
 
 # --------------------------------------------------------------------------- #
@@ -268,6 +322,12 @@ class LlamaAttention(Layer):
                                           self.cfg.use_flash_attention, causal)
                 if ring_out is not None:
                     return ring_out
+            if self.cfg.sequence_parallel and self.cfg.ulysses_parallel \
+                    and not cache_vals:
+                uly_out = _ulysses_dispatch(
+                    qr, kr, vv, self.cfg.use_flash_attention, causal)
+                if uly_out is not None:
+                    return uly_out
             if self.cfg.use_flash_attention:
                 # GQA handled inside the kernel (no KV repeat)
                 return flash_attention_bshd(qr, kr, vv, causal=causal)
