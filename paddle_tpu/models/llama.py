@@ -62,11 +62,12 @@ class LlamaConfig:
     # fuse lm_head matmul + CE when forward() is given labels: chunked
     # logsumexp, never materializes [B,S,V] logits (ops/fused_ce.py)
     fused_lm_head_ce: bool = True
-    # tokens per fused-CE chunk: bigger chunks beat scan overhead (v5e
-    # A/B 2026-07-31: 4096 -> 0.671 MFU, 8192 -> 0.6806, 16384 -> 0.6824
-    # on the 509M bench step); 8192 takes most of the win at half the
-    # transient f32 [c, V] logits footprint.  PT_CE_CHUNK overrides.
-    ce_chunk_size: int = 8192
+    # tokens per fused-CE chunk: bigger chunks beat scan overhead. v5e
+    # bracketed A/B on the 509M bench step (2026-08-01): 16384 -> 0.690 /
+    # 0.6815 MFU vs 8192 -> 0.6752 / 0.675 — adopted. Transient f32 [c, V]
+    # logits = chunk*vocab*4 B; at vocab >~100k (llama3) consider 8192 via
+    # PT_CE_CHUNK unless the lm-head/CE is vocab-sharded over 'tensor'.
+    ce_chunk_size: int = 16384
     recompute: bool = False
 
 
