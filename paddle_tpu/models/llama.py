@@ -603,10 +603,15 @@ class LlamaForCausalLM(Layer):
 
             tied = self.cfg.tie_word_embeddings
             w = self.model.embed_tokens.weight if tied else self.lm_head.weight
+            # long-S cap: at S>8192 the streaming-flash residuals peak
+            # together with the CE's transient f32 [c, V] logits — chunk
+            # 16384 OOMs the S=16384 B=1 config on v5e (measured
+            # 2026-08-01) while 8192 runs it at the recorded 0.4185 MFU
+            chunk = self.cfg.ce_chunk_size if input_ids.shape[1] <= 8192 \
+                else min(self.cfg.ce_chunk_size, 8192)
             return apply_op(
                 lambda hv, wv, lv: fused_linear_cross_entropy(
-                    hv, wv, lv, chunk_size=self.cfg.ce_chunk_size,
-                    transpose_weight=tied),
+                    hv, wv, lv, chunk_size=chunk, transpose_weight=tied),
                 h, w, labels, op_name="fused_linear_cross_entropy")
         if self.cfg.tie_word_embeddings:
             logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
