@@ -603,12 +603,10 @@ class LlamaForCausalLM(Layer):
 
             tied = self.cfg.tie_word_embeddings
             w = self.model.embed_tokens.weight if tied else self.lm_head.weight
-            # long-S cap: at S>8192 the streaming-flash residuals peak
-            # together with the CE's transient f32 [c, V] logits — chunk
-            # 16384 OOMs the S=16384 B=1 config on v5e (measured
-            # 2026-08-01) while 8192 runs it at the recorded 0.4185 MFU
-            chunk = self.cfg.ce_chunk_size if input_ids.shape[1] <= 8192 \
-                else min(self.cfg.ce_chunk_size, 8192)
+            from ..ops.fused_ce import capped_chunk_size
+
+            chunk = capped_chunk_size(self.cfg.ce_chunk_size,
+                                      input_ids.shape[1])
             return apply_op(
                 lambda hv, wv, lv: fused_linear_cross_entropy(
                     hv, wv, lv, chunk_size=chunk, transpose_weight=tied),

@@ -116,6 +116,15 @@ def _flce_bwd(ignore_index, chunk_size, res, g):
 _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
+def capped_chunk_size(chunk_size: int, seq_len: int) -> int:
+    """Long-sequence cap, shared by EVERY fused-CE caller (llama forward,
+    pipeline post_fn): at S>8192 the streaming-flash residuals peak
+    together with the CE's transient f32 [c, V] logits — chunk 16384 OOMs
+    the S=16384 B=1 config on v5e (measured 2026-08-01) while 8192
+    reproduces the recorded 0.4185 MFU."""
+    return chunk_size if seq_len <= 8192 else min(chunk_size, 8192)
+
+
 def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
                                chunk_size: int = 1024,
                                transpose_weight: bool = False):

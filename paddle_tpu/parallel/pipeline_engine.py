@@ -517,11 +517,15 @@ def llama_pipeline_engine(model, optimizer=None, mesh=None, num_micro: int = 2,
             else params["lm_head.weight"]
         if lm.cfg.fused_lm_head_ce:
             # chunked fused lm-head+CE: never materializes [B,S,V] logits
-            # (same memory design as the non-pipelined engine path)
-            from ..ops.fused_ce import fused_linear_cross_entropy
+            # (same memory design as the non-pipelined engine path, incl.
+            # the shared long-S chunk cap)
+            from ..ops.fused_ce import (capped_chunk_size,
+                                        fused_linear_cross_entropy)
 
             return fused_linear_cross_entropy(
-                h, w, labels, chunk_size=lm.cfg.ce_chunk_size,
+                h, w, labels,
+                chunk_size=capped_chunk_size(lm.cfg.ce_chunk_size,
+                                             labels.shape[-1]),
                 transpose_weight=tied)
         logits = h @ (w.T if tied else w)
         return F.cross_entropy(Tensor(logits), Tensor(labels),
