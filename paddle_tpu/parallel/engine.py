@@ -104,6 +104,27 @@ class ParallelEngine:
         self._eval_step = None
 
     @staticmethod
+    def _place(v, sharding):
+        """Materialize a host value under `sharding`. Multi-process: the
+        mesh spans non-addressable devices, so assemble the global array
+        from the (identical-per-process) host value — each process
+        materializes only its addressable shards (ref parallel.py:108
+        sync_params broadcast; identical host values replace the
+        broadcast)."""
+        if jax.process_count() <= 1:
+            # copy first: device_put may alias the source buffer (zero-copy
+            # same-device path), and the engine donates its state every
+            # step — an aliased source (the model's live eager param)
+            # would be deleted by the first step, breaking the "params are
+            # copied in once" contract
+            if isinstance(v, jax.Array):
+                v = jnp.copy(v)
+            return jax.device_put(v, sharding)
+        arr = np.asarray(v)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    @staticmethod
     def _host_sharding():
         from jax.sharding import SingleDeviceSharding
 
@@ -121,6 +142,14 @@ class ParallelEngine:
     # ------------------------------------------------------------------ state
     def _build_state(self):
         mesh = self.mesh
+        # multi-process: a committed single-device jnp scalar can't enter a
+        # jit spanning the global mesh; a host value is treated as
+        # replicated (identical across processes by construction). Lives
+        # here (not build_train_step) so engine_state_dict works on a
+        # freshly built engine; set_engine_state may overwrite it.
+        self._step_count = (np.zeros((), np.int32)
+                            if jax.process_count() > 1
+                            else jnp.zeros((), jnp.int32))
         # single-device mesh: keep plain (unsharded) arrays — NamedSharding
         # inputs route jit through the SPMD partitioner, which compiles a
         # measurably worse program around Pallas custom calls (6x step time
@@ -179,20 +208,34 @@ class ParallelEngine:
             else:
                 self.opt_state = self.optimizer.init_state(train)
             return
+        multiproc = jax.process_count() > 1
         self.params = {
-            name: jax.device_put(v, _sharding_of(mesh, self.specs.get(name, P())))
+            name: self._place(v, _sharding_of(mesh, self.specs.get(name, P())))
             for name, v in vals.items()
         }
         if self.optimizer is not None:
             train_params = {n: v for n, v in self.params.items() if n in self._trainable}
-            state = self.optimizer.init_state(train_params)
             # opt state shards like its param (ZeRO-1/2: ref
             # dygraph_sharding_optimizer.py — state lives sharded)
-            self.opt_state = {
-                n: {k: jax.device_put(v, _sharding_of(mesh, self.specs.get(n, P())))
-                    for k, v in slots.items()}
-                for n, slots in state.items()
-            }
+            state_sh = {
+                n: _sharding_of(mesh, self.specs.get(n, P()))
+                for n in train_params}
+            if multiproc:
+                # eager ops on global arrays with non-addressable shards are
+                # rejected; init through jit so XLA produces sharded state
+                sds = jax.eval_shape(self.optimizer.init_state, train_params)
+                out_sh = {n: {k: state_sh[n] for k in slots}
+                          for n, slots in sds.items()}
+                self.opt_state = jax.jit(
+                    self.optimizer.init_state,
+                    out_shardings=out_sh)(train_params)
+            else:
+                state = self.optimizer.init_state(train_params)
+                self.opt_state = {
+                    n: {k: jax.device_put(v, state_sh[n])
+                        for k, v in slots.items()}
+                    for n, slots in state.items()
+                }
         else:
             self.opt_state = {}
 
@@ -231,6 +274,46 @@ class ParallelEngine:
     @staticmethod
     def _raw(v):
         return v.value if isinstance(v, Tensor) else v
+
+    def _assemble_batch(self, batch):
+        """Device-ready batch tuple, shared by train_batch/eval_batch.
+
+        Multi-process (ref test_dist_base.py:899 per-rank readers): each
+        process passes its LOCAL shard of the batch; the global array is
+        assembled against the batch sharding without any cross-host gather
+        of example data. Unlike the single-process path (which silently
+        replicates a ragged batch), an unevenly-divisible local shard is an
+        error here — the data never exists in one place to replicate —
+        so pad to the bucket (io.LengthBucketBatchSampler) instead."""
+        def spec_of(i):
+            return (self.batch_spec[i]
+                    if isinstance(self.batch_spec, (list, tuple))
+                    else self.batch_spec)
+
+        if self._spmd and jax.process_count() > 1:
+            out = []
+            for i, b in enumerate(batch):
+                arr = np.asarray(b.value if isinstance(b, Tensor) else b)
+                spec = _filter_spec(spec_of(i), self.mesh)
+                try:
+                    out.append(jax.make_array_from_process_local_data(
+                        _sharding_of(self.mesh, spec), arr))
+                except ValueError as e:
+                    raise ValueError(
+                        f"per-process batch shard of shape {arr.shape} "
+                        f"does not assemble evenly under spec {spec} on "
+                        f"mesh {dict(self.mesh.shape)}; pad the local "
+                        f"shard to an even split (see io bucketing "
+                        f"helpers)") from e
+            return tuple(out)
+        batch_vals = tuple(
+            b.value if isinstance(b, Tensor) else jnp.asarray(b)
+            for b in batch)
+        if self._spmd:
+            batch_vals = tuple(
+                jax.device_put(b, self._batch_sharding(b, spec_of(i)))
+                for i, b in enumerate(batch_vals))
+        return batch_vals
 
     def _batch_sharding(self, arr, spec):
         """NamedSharding for one batch array: drop mesh axes the array's dims
@@ -315,7 +398,6 @@ class ParallelEngine:
             frozen = {**frozen, **new_bufs}
             return {**new_train, **frozen}, new_state, step_count + 1, loss
 
-        self._step_count = jnp.zeros((), jnp.int32)
         donate = (0, 1, 2) if self._donate else ()
         jit_kw = {}
         if self._offload_opt and self.opt_state and not hasattr(
@@ -396,14 +478,7 @@ class ParallelEngine:
         if self._train_step is None:
             self.build_train_step()
         lr = self.optimizer.get_lr()
-        batch_vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                           for b in batch)
-        if self._spmd:
-            batch_vals = tuple(
-                jax.device_put(b, self._batch_sharding(
-                    b, self.batch_spec if not isinstance(self.batch_spec, (list, tuple))
-                    else self.batch_spec[i]))
-                for i, b in enumerate(batch_vals))
+        batch_vals = self._assemble_batch(batch)
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
         from ..framework.monitor import monitor_add
@@ -426,11 +501,53 @@ class ParallelEngine:
                 return self._loss_from_batch(params, batch)
 
             self._eval_step = jax.jit(ev)
-        batch_vals = tuple(b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                           for b in batch)
-        return Tensor(self._eval_step(self.params, batch_vals))
+        return Tensor(self._eval_step(self.params,
+                                      self._assemble_batch(batch)))
 
     # ------------------------------------------------------------------- sync
+    def engine_state_dict(self):
+        """Host snapshot of the FULL engine training state (params +
+        optimizer moments + step counter) for checkpoint/resume across
+        elastic restarts (ref auto_checkpoint.py exactly-once resume; the
+        reference snapshots executor scope vars, here the donated jit
+        state). Values come back as numpy; sharded arrays are gathered —
+        multi-process callers need replicated or addressable state (DP/
+        ZeRO-replicated layouts qualify; every rank then writes an
+        identical snapshot, so rank-local files are interchangeable)."""
+        return {
+            "params": jax.tree.map(np.asarray, dict(self.params)),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "step": int(np.asarray(self._step_count)),
+        }
+
+    def set_engine_state(self, state):
+        """Inverse of engine_state_dict: re-place host values against this
+        engine's shardings (works across process/mesh layouts as long as
+        shapes match — the reshard is the placement)."""
+        mesh = self.mesh
+        if self._spmd:
+            self.params = {
+                n: self._place(v, _sharding_of(mesh, self.specs.get(n, P())))
+                for n, v in state["params"].items()}
+            self.opt_state = {
+                n: {k: self._place(v, _sharding_of(mesh, self.specs.get(n, P())))
+                    for k, v in slots.items()}
+                for n, slots in state["opt_state"].items()}
+        else:
+            self.params = {n: jnp.asarray(v)
+                           for n, v in state["params"].items()}
+            if self._offload_opt and self.opt_state:
+                host = self._host_sharding()
+                self.opt_state = jax.tree.map(
+                    lambda v: jax.device_put(v, host), state["opt_state"])
+            else:
+                self.opt_state = jax.tree.map(jnp.asarray,
+                                              state["opt_state"])
+        step = state.get("step", 0)
+        self._step_count = (np.asarray(step, np.int32)
+                            if jax.process_count() > 1
+                            else jnp.asarray(step, jnp.int32))
+
     def sync_to_model(self):
         store = {**dict(self.model.named_parameters()),
                  **dict(self.model.named_buffers())}
