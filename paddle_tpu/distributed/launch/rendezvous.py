@@ -261,6 +261,24 @@ class ETCDMaster:
         self._call("/v3/kv/deleterange", {
             "key": self._b64(p), "range_end": self._b64(self._prefix_end(p))})
 
+    def _txn_claim(self, key: str, value: str):
+        """Atomic set-if-absent: a txn comparing ``create_revision == 0``
+        puts the key iff it does not exist, else reads the current owner.
+        Returns (claimed, current_value)."""
+        k = self._b64(key)
+        r = self._call("/v3/kv/txn", {
+            "compare": [{"key": k, "target": "CREATE",
+                         "result": "EQUAL", "create_revision": "0"}],
+            "success": [{"request_put": {
+                "key": k, "value": self._b64(value)}}],
+            "failure": [{"request_range": {"key": k}}],
+        })
+        if r.get("succeeded"):
+            return True, value.encode()
+        rng = (r.get("responses") or [{}])[0].get("response_range", {})
+        kvs = rng.get("kvs") or []
+        return False, (self._unb64(kvs[0]["value"]) if kvs else b"")
+
     # -------------------------------------------------------------- contract
     def sync_peers(self, my_endpoint: str, job_id: str = "default",
                    node_id: str = None, preferred_slot: int = None
@@ -276,15 +294,37 @@ class ETCDMaster:
         sorted-pod-name rule)."""
         me = node_id or my_endpoint
         prefix = f"peers/{job_id}/"
-        key = prefix + (f"r/{preferred_slot:08d}" if preferred_slot
-                        is not None else f"n/{me}")
+        pinned = preferred_slot is not None
+        key = prefix + (f"r/{preferred_slot:08d}" if pinned
+                        else f"n/{me}")
+        owner_key = prefix + f"o/{preferred_slot:08d}" if pinned else None
         self._delete_prefix(prefix)
         deadline = time.monotonic() + self.timeout
         while time.monotonic() < deadline:
+            if pinned:
+                # txn-based slot claim: two nodes pinning the same rank is
+                # a launch misconfiguration — the loser FAILS FAST instead
+                # of hanging the barrier to timeout. Re-asserted each loop
+                # because a late joiner's wipe clears claims too.
+                ok, cur = self._txn_claim(owner_key, me)
+                if not ok and cur != me.encode():
+                    raise RuntimeError(
+                        f"rendezvous: rank slot {preferred_slot} already "
+                        f"claimed by {cur.decode()!r} (this node is "
+                        f"{me!r}) — two launchers pinned the same --rank")
             self._put(key, my_endpoint)
             kvs = self._range_prefix(prefix)
-            if len(kvs) == self.nnodes:
-                return [v.decode() for _, v in sorted(kvs.items())]
+            eps = {k: v for k, v in kvs.items()
+                   if not k.startswith(prefix.encode() + b"o/")}
+            kinds = {k[len(prefix):len(prefix) + 2] for k in eps}
+            if len(kinds) > 1:
+                raise RuntimeError(
+                    "rendezvous: some launchers pinned --rank and some "
+                    "did not — pinned (r/) and unpinned (n/) entries do "
+                    "not order against each other; use --rank on all "
+                    "nodes or none")
+            if len(eps) == self.nnodes:
+                return [v.decode() for _, v in sorted(eps.items())]
             time.sleep(0.5)
         raise TimeoutError(
             f"rendezvous: {self.nnodes} peers never assembled under "

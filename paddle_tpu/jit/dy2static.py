@@ -205,6 +205,11 @@ def convert_cast(pytype, x):
     """``int(x)`` / ``float(x)`` / ``bool(x)`` over tensors (ref
     cast_transformer.py): concrete values keep exact Python semantics;
     tracers become dtype casts (bool() on a tracer would raise)."""
+    if pytype not in (int, float, bool):
+        # the callee name resolved to something else at runtime — a
+        # module-global shadowing the builtin (the AST rewrite only sees
+        # function-local shadows): honor the user's object
+        return pytype(x)
     raw = _raw_bool(x)
     if not _is_traced(raw):
         return pytype(raw) if hasattr(raw, "dtype") else pytype(x)
@@ -245,10 +250,20 @@ def convert_call(fn):
     return fn
 
 
-def convert_print(*args, sep=" ", end="\n", **kw):
+def convert_print(*args, sep=" ", end="\n", _pt_fn=None, **kw):
     """``print`` with traced arguments routes to jax.debug.print (prints
     from the compiled program with real values); concrete calls keep Python
-    semantics including file=/flush=."""
+    semantics including file=/flush=. ``_pt_fn`` carries the runtime-
+    resolved ``print`` from the rewritten call site: when a module-global
+    shadows the builtin, the user's callable runs instead."""
+    import builtins
+
+    if _pt_fn is not None and _pt_fn is not builtins.print:
+        if sep != " ":
+            kw["sep"] = sep
+        if end != "\n":
+            kw["end"] = end
+        return _pt_fn(*args, **kw)
     raws = [_raw_bool(a) for a in args]
     if any(_is_traced(r) for r in raws):
         import jax
@@ -586,6 +601,11 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                             args=[ast.Name(id=name, ctx=ast.Load()),
                                   node.args[0]], keywords=[])
         if name == "print":
+            # pass the runtime-resolved `print` so a module-global shadow
+            # keeps the user's callable (function-local shadows are already
+            # in self.shadowed)
+            node.keywords.append(ast.keyword(
+                arg="_pt_fn", value=ast.Name(id="print", ctx=ast.Load())))
             node.func = self._jst("convert_print")
             return node
         if name in _BUILTINS:

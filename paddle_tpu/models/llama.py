@@ -150,9 +150,17 @@ def _attn_island(axis, local, qr, kr, vv, head_divisible=False):
     honored warns instead of silently degrading.
     """
     try:
-        return local(qr, kr, vv)  # already inside a shard_map binding axis
+        # explicit binding probe: axis_index raises NameError iff `axis` is
+        # not bound here. Probing with the tiny op (instead of running
+        # `local` and catching ITS NameError) keeps a genuine NameError bug
+        # inside the ring/Ulysses kernels loud instead of silently
+        # rerouting to a different attention path (ADVICE r4).
+        jax.lax.axis_index(axis)
+        bound = True
     except NameError:
-        pass
+        bound = False
+    if bound:
+        return local(qr, kr, vv)  # already inside a shard_map binding axis
     from ..parallel.api import current_mesh, in_spmd_region
 
     mesh = current_mesh()

@@ -382,6 +382,13 @@ class AdamW(Adam):
                 and self._lr_ratio is None and not self._multi_precision)
 
     def init_state(self, params):
+        """With PT_MT_ADAMW=1 the flat state's 'p' buffer IS the
+        authoritative weight copy from this point on: _mt_update rebuilds
+        params from it and ignores incoming values, so any external param
+        mutation (checkpoint load, set_state_dict, sync_from_model) must
+        happen BEFORE engine/opt state init — later loads are silently
+        discarded. Re-init the state (or unset PT_MT_ADAMW) to load
+        weights mid-run."""
         if not self._mt_active() or len(params) < 2 or \
                 len({jnp.asarray(v).dtype if not hasattr(v, "dtype") else
                      v.dtype for v in params.values()}) != 1:
@@ -414,10 +421,14 @@ class AdamW(Adam):
         mt = state["__mt__"]
         layout, padded = self._mt_layout, self._mt_padded
         total = sum(s for _, _, s in layout)
-        pdt = mt["p"].dtype
+        # grads concat in f32: the kernel's grad operand upcasts internally
+        # regardless of the param dtype, so a bf16 concat would throw away
+        # gradient precision the per-tensor path keeps
         g = jnp.concatenate(
-            [jnp.reshape(grads[n], (-1,)).astype(pdt) for n, _, _ in layout] +
-            ([jnp.zeros((padded - total,), pdt)] if padded > total else []))
+            [jnp.reshape(grads[n], (-1,)).astype(jnp.float32)
+             for n, _, _ in layout] +
+            ([jnp.zeros((padded - total,), jnp.float32)]
+             if padded > total else []))
         new_p2, m2, v2 = flat_adamw_update(
             mt["p"], g.reshape(-1, self._MT_ROW), mt["moment1"],
             mt["moment2"], lr=lr, step=step, b1=self._beta1, b2=self._beta2,

@@ -13,6 +13,7 @@ axis (ref dygraph_sharding_optimizer.py:29, group_sharded_stage{2,3}.py).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -62,10 +63,18 @@ class ParallelEngine:
                  remat_policy: Optional[str] = "dots", batch_spec: Any = P("data"),
                  donate: bool = True, abstract: bool = False,
                  offload_opt_state: bool = False,
-                 alias_model_params: bool = False):
+                 alias_model_params: bool = False,
+                 grad_accum: int = 1):
         """abstract=True keeps params/opt-state as ShapeDtypeStructs — the
         step can be .lower()ed (AOT partitioning validation at any scale)
         but not executed.
+
+        grad_accum=k splits each train_batch into k microbatches scanned
+        inside the compiled step (leading batch dim must divide by k), with
+        ONE optimizer update on the mean gradient — amortizes the
+        optimizer/PCIe cost on the offload path (ref
+        gradient_merge_optimizer.py; PT_ACCUM_DTYPE sets the accumulator
+        dtype, default float32).
 
         offload_opt_state=True parks the optimizer moments in host RAM
         (pinned_host memory) between steps — the compiled step streams them
@@ -90,6 +99,9 @@ class ParallelEngine:
         self._donate = donate
         self._abstract = abstract
         self._offload_opt = offload_opt_state
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         # alias_model_params=True skips the defensive params copy (single-
         # device path): saves a full param-size HBM allocation on big
         # models, at the cost that the eager model is INVALID until
@@ -337,12 +349,12 @@ class ParallelEngine:
             train = {n: v for n, v in params.items() if n in self._trainable}
             frozen = {n: v for n, v in params.items() if n not in self._trainable}
 
-            def loss_of(tr):
+            def loss_of(tr, mb, frozen_vals):
                 # aux = buffers the forward reassigned (BN running stats):
                 # captured from the eager side effect and carried as a jit
                 # output so the compiled path matches eager BN semantics
                 mutated = {}
-                loss = self._loss_from_batch({**tr, **frozen}, batch,
+                loss = self._loss_from_batch({**tr, **frozen_vals}, mb,
                                              state_out=mutated)
                 new_bufs = {n: self._raw(v) for n, v in mutated.items()
                             if n not in self._trainable}
@@ -379,8 +391,44 @@ class ParallelEngine:
                 loss_of_ = jax.checkpoint(loss_of, policy=policy)
             else:
                 loss_of_ = loss_of
-            (loss, new_bufs), grads = jax.value_and_grad(
-                loss_of_, has_aux=True)(train)
+            accum = self.grad_accum
+            if accum > 1:
+                # gradient accumulation (ref gradient_merge_optimizer.py /
+                # group_sharded k-microbatch amortization): scan over k
+                # microbatches, sum grads, one optimizer update — divides
+                # the per-step optimizer/PCIe cost by k on the offload path
+                mbs = jax.tree.map(
+                    lambda b: b.reshape((accum, b.shape[0] // accum)
+                                        + b.shape[1:]), batch)
+                acc_dtype = jnp.dtype(
+                    os.environ.get("PT_ACCUM_DTYPE", "float32"))
+
+                def body(carry, mb_i):
+                    acc_l, acc_g, frozen_cur = carry
+                    # buffers (BN running stats) thread microbatch →
+                    # microbatch, matching eager sequential semantics (ref
+                    # gradient_merge: each micro-step runs a full forward)
+                    (l, bufs), g = jax.value_and_grad(
+                        loss_of_, has_aux=True)(train, mb_i, frozen_cur)
+                    acc_g = jax.tree.map(
+                        lambda a, gi: a + gi.astype(a.dtype), acc_g, g)
+                    return ((acc_l + l.astype(jnp.float32), acc_g,
+                             {**frozen_cur, **bufs}), None)
+
+                zero_g = {n: jnp.zeros(v.shape, acc_dtype)
+                          for n, v in train.items()}
+                (loss_sum, grads, frozen_out), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zero_g, frozen),
+                    mbs)
+                loss = loss_sum / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                # every frozen entry rides the carry (mutated-or-not is
+                # only known under the trace); unchanged ones are
+                # pass-through values XLA elides
+                new_bufs = frozen_out
+            else:
+                (loss, new_bufs), grads = jax.value_and_grad(
+                    loss_of_, has_aux=True)(train, batch, frozen)
             if self._offload_opt and opt_state:
                 new_train, new_state = self._offloaded_update(
                     opt, train, grads, opt_state, lr, step_count + 1, loss)
@@ -418,17 +466,29 @@ class ParallelEngine:
 
     def _offloaded_update(self, opt, train, grads, opt_state, lr, step,
                           loss):
-        """Per-param optimizer update with host-resident moments, SEQUENCED.
+        """Per-param optimizer update with host-resident moments, streamed
+        through a WINDOWED transfer chain.
 
         A naive whole-tree h2d materializes every moment tensor in HBM at
         once (measured RESOURCE_EXHAUSTED at 2.4B on v5e — XLA hoists the
         transfers), defeating the offload. Here each param's moments are
-        transferred, updated and sent back inside a data-dependency chain:
-        an optimization_barrier makes param i+1's h2d depend on a scalar
-        from param i's new state, bounding peak HBM to ~one param's
-        moments. Updates therefore don't overlap backward — host offload
-        trades step time for fit, by design (ref
-        group_sharded_stage3.py:60 cpu-offload has the same tradeoff).
+        transferred, updated and sent back inside a data-dependency chain
+        built from optimization_barriers:
+
+        - h2d_i is gated on h2d_{i-1} (PCIe h2d traffic serializes) AND on
+          update_{i-W} (at most W ≈ PT_OFFLOAD_WINDOW moment sets live in
+          HBM). W=1 is the round-4 strict chain; W>=2 double-buffers:
+          param i+1's moments stream in while param i updates and its new
+          state streams OUT (h2d/d2h ride opposite PCIe directions).
+        - params walk in REVERSE name order (PT_OFFLOAD_ORDER=backward,
+          default): backward produces grads for the LAST layers first, so
+          updates and transfers start while earlier layers' backward still
+          computes instead of stalling on the first param's grad.
+
+        Host offload still trades step time for fit (ref
+        group_sharded_stage3.py:60 cpu-offload, whose point is that the
+        tradeoff is tunable) — the window + order make the PCIe pipe the
+        only cost, not the scheduling.
         """
         from jax.sharding import SingleDeviceSharding
 
@@ -441,19 +501,40 @@ class ParallelEngine:
         # L2-as-grad for non-decoupled optimizers
         if opt._grad_clip is not None:
             grads = _pure_grad_clip(opt._grad_clip, grads)
+        window = max(1, int(os.environ.get("PT_OFFLOAD_WINDOW", "2")))
+        order = os.environ.get("PT_OFFLOAD_ORDER", "backward")
+        if order not in ("backward", "forward"):
+            raise ValueError(
+                f"PT_OFFLOAD_ORDER must be 'backward' or 'forward', got "
+                f"{order!r}")
+        names = sorted(train)
+        if order == "backward":
+            names = list(reversed(names))
+
+        def scalar_token(v):
+            return jax.lax.convert_element_type(
+                v.ravel()[0], jnp.float32) * 0.0
+
         new_train, new_state = {}, {}
-        token = loss * 0.0
-        for n in sorted(train):
+        h2d_token = loss * 0.0
+        update_tokens = []
+        i = -1  # running index into the live (grad-bearing) params
+        for n in names:
             g = grads.get(n)
             if g is None:
                 new_train[n] = train[n]
                 new_state[n] = opt_state.get(n, {})
                 continue
             g = g.astype(jnp.float32)
+            i += 1
+            gate = h2d_token
+            if i >= window:
+                gate = gate + update_tokens[i - window]
             slots = {
                 k: jax.device_put(
-                    jax.lax.optimization_barrier((v, token))[0], dev_s)
+                    jax.lax.optimization_barrier((v, gate))[0], dev_s)
                 for k, v in opt_state[n].items()}
+            h2d_token = scalar_token(next(iter(slots.values())))
             if apply_adamw is not None:
                 decay = opt._wd_coeff
                 if opt._apply_decay_param_fun is not None and \
@@ -464,10 +545,7 @@ class ParallelEngine:
                 if opt._use_l2_decay() and opt._l2_coeff:
                     g = g + opt._reg_grad(train[n].astype(jnp.float32))
                 np_, ns = opt._apply_one(train[n], g, lr, step, slots)
-            # chain the NEXT transfer on one element of this update
-            first = next(iter(ns.values()))
-            token = jax.lax.convert_element_type(
-                first.ravel()[0], jnp.float32) * 0.0
+            update_tokens.append(scalar_token(next(iter(ns.values()))))
             new_train[n] = np_
             new_state[n] = {k: jax.device_put(v, host)
                             for k, v in ns.items()}
@@ -479,6 +557,12 @@ class ParallelEngine:
             self.build_train_step()
         lr = self.optimizer.get_lr()
         batch_vals = self._assemble_batch(batch)
+        if self.grad_accum > 1:
+            for b in batch_vals:
+                if b.shape[0] % self.grad_accum:
+                    raise ValueError(
+                        f"grad_accum={self.grad_accum} needs the leading "
+                        f"batch dim to divide evenly, got {b.shape}")
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
         from ..framework.monitor import monitor_add

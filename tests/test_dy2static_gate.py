@@ -259,3 +259,32 @@ def test_for_range_negative_literal_step():
         return s
 
     assert int(_np(f(paddle.to_tensor(4)))) == 4 + 3 + 2 + 1
+
+
+def test_module_global_shadowed_builtins_resolve_to_user_objects():
+    """A module-global shadowing int/print is invisible to the AST pass
+    (only function-local stores are); the converted callsite must still run
+    the USER's object, not the builtin rewrite (ADVICE r4)."""
+    import textwrap
+    import types
+
+    mod = types.ModuleType("shadow_mod")
+    src = textwrap.dedent("""
+        calls = []
+
+        def int(x):  # noqa: A001 — deliberate module-global shadow
+            calls.append("int")
+            return 7
+
+        def print(*a, **k):  # noqa: A001
+            calls.append("print")
+
+        def f(x):
+            print("hello", 1)
+            return int(x)
+    """)
+    exec(compile(src, "<shadow_mod>", "exec"), mod.__dict__)
+    g = to_static(mod.f)
+    out = g(3.0)
+    assert out == 7
+    assert mod.calls == ["print", "int"], mod.calls

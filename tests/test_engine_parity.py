@@ -408,3 +408,46 @@ def test_pipeline_checkpoint_reshards_across_pp_degree():
         paddle.to_tensor(batches[1][1])).value))
 
     np.testing.assert_allclose(l4, l1, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=k on the same total batch must match accum=1: the scanned
+    microbatch mean-of-grads equals the full-batch grad for a mean loss
+    (ref gradient_merge_optimizer semantics). SGD, not AdamW: Adam's
+    first-step g/|g| shape turns reduction-order LSB noise into O(lr)
+    weight flips at near-zero grads, which no tolerance survives."""
+    from paddle_tpu.optimizer import SGD
+
+    cfg = _cfg()
+    batches = _batches(cfg, n=3, B=4, S=16)
+
+    def train_sgd(accum):
+        paddle.seed(7)
+        m = LlamaForCausalLM(cfg)
+        opt = SGD(learning_rate=1e-1, parameters=m.parameters())
+        eng = ParallelEngine(m, optimizer=opt, loss_fn=m.loss_fn,
+                             grad_accum=accum)
+        losses = [float(np.asarray(eng.train_batch(x, y).value))
+                  for x, y in batches]
+        eng.sync_to_model()
+        return losses, {k: np.asarray(v.value)
+                        for k, v in m.state_dict().items()}
+
+    l1, w1 = train_sgd(1)
+    l2, w2 = train_sgd(2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-7)
+    for k in w1:
+        np.testing.assert_allclose(w1[k], w2[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_grad_accum_rejects_ragged_batch():
+    cfg = _cfg()
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+    eng = ParallelEngine(m, optimizer=opt, loss_fn=m.loss_fn, grad_accum=2)
+    x = np.zeros((3, 16), "int32")
+    y = np.zeros((3, 16), "int64")
+    with pytest.raises(ValueError, match="grad_accum"):
+        eng.train_batch(x, y)

@@ -60,6 +60,31 @@ class _FakeEtcd(BaseHTTPRequestHandler):
                 for k in gone:
                     del self.store[k]
                 return self._send({"deleted": str(len(gone))})
+            if self.path == "/v3/kv/txn":
+                ok = True
+                for c in body.get("compare", []):
+                    ck = base64.b64decode(c["key"])
+                    if c.get("target") == "CREATE":
+                        absent_wanted = str(
+                            c.get("create_revision", "0")) == "0"
+                        ok = ok and ((ck not in self.store)
+                                     if absent_wanted
+                                     else (ck in self.store))
+                branch = "success" if ok else "failure"
+                responses = []
+                for op in body.get(branch, []):
+                    if "request_put" in op:
+                        put = op["request_put"]
+                        self.store[base64.b64decode(put["key"])] = \
+                            base64.b64decode(put["value"])
+                        responses.append({"response_put": {}})
+                    elif "request_range" in op:
+                        k = base64.b64decode(op["request_range"]["key"])
+                        kvs = ([self._kv(k, self.store[k])]
+                               if k in self.store else [])
+                        responses.append({"response_range": {"kvs": kvs}})
+                return self._send({"succeeded": ok,
+                                   "responses": responses})
         self.send_response(404)
         self.end_headers()
 
@@ -129,3 +154,60 @@ def test_http_4xx_surfaces_immediately(etcd):
     with pytest.raises(RuntimeError, match="HTTP 404"):
         m._call("/v3/kv/nosuch", {})
     assert time.monotonic() - t0 < 5.0  # no 300s retry spin
+
+
+def test_duplicate_pinned_slot_fails_fast(etcd):
+    """Two launchers pinning the same --rank: the txn claim makes the loser
+    error immediately instead of overwriting the winner's key and hanging
+    the barrier to the 300s timeout."""
+    import time
+
+    out, errs = {}, []
+    t0 = time.monotonic()
+
+    def go(ep, nid):
+        # short barrier timeout: the WINNER can never assemble 2 peers
+        # once its partner bailed — only the loser's fail-fast is under
+        # test here
+        m = ETCDMaster(etcd, nnodes=2, timeout=8.0)
+        try:
+            out[nid] = m.sync_peers(ep, job_id="dup", node_id=nid,
+                                    preferred_slot=0)
+        except Exception as e:  # noqa: BLE001 — inspected below
+            errs.append((time.monotonic() - t0, e))
+
+    ts = [threading.Thread(target=go, args=("10.0.0.1:70", "a")),
+          threading.Thread(target=go, args=("10.0.0.2:71", "b"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    # exactly one loses the claim — fast, with an actionable message —
+    # while the winner parks in its barrier (here: times out at 8s)
+    claims = [(dt, e) for dt, e in errs
+              if isinstance(e, RuntimeError)
+              and "pinned the same --rank" in str(e)]
+    assert len(claims) == 1, (errs, out)
+    assert claims[0][0] < 6.0, claims
+
+
+def test_mixed_pinned_unpinned_raises(etcd):
+    """Pinned (r/) and unpinned (n/) entries do not order against each
+    other; a mixed job must error, not silently mis-rank."""
+    out, errs = {}, []
+
+    def go(ep, nid, slot):
+        m = ETCDMaster(etcd, nnodes=2, timeout=30.0)
+        try:
+            out[nid] = m.sync_peers(ep, job_id="mix", node_id=nid,
+                                    preferred_slot=slot)
+        except RuntimeError as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=go, args=("10.0.0.1:70", "a", 0)),
+          threading.Thread(target=go, args=("10.0.0.2:71", "b", None))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(25)
+    assert errs and all("pinned --rank" in str(e) for e in errs), (errs, out)

@@ -20,7 +20,7 @@ def _free_port():
 @pytest.fixture
 def store():
     s = TCPStore("127.0.0.1", _free_port(), is_master=True, world_size=1,
-                 timeout=10)
+                 timeout=60)
     yield s
     s.close()
 
@@ -71,20 +71,20 @@ class TestTCPStoreNative:
         """3 'ranks' (threads with their own client connections) all arrive."""
         port = _free_port()
         master = TCPStore("127.0.0.1", port, is_master=True, world_size=3,
-                          timeout=10)
+                          timeout=60)
         results = []
 
         def worker():
             c = TCPStore("127.0.0.1", port, is_master=False, world_size=3,
-                         timeout=10)
-            c.barrier("b0", timeout=10)
+                         timeout=60)
+            c.barrier("b0", timeout=60)
             results.append(1)
             c.close()
 
         ts = [threading.Thread(target=worker) for _ in range(2)]
         for t in ts:
             t.start()
-        master.barrier("b0", timeout=10)
+        master.barrier("b0", timeout=60)
         for t in ts:
             t.join()
         assert len(results) == 2
@@ -94,9 +94,9 @@ class TestTCPStoreNative:
         """Successive barriers must each synchronize (round-numbered keys)."""
         port = _free_port()
         master = TCPStore("127.0.0.1", port, is_master=True, world_size=2,
-                          timeout=10)
+                          timeout=60)
         worker = TCPStore("127.0.0.1", port, is_master=False, world_size=2,
-                          timeout=10)
+                          timeout=60)
         order = []
 
         def w():
@@ -148,8 +148,8 @@ class TestTCPStoreNative:
         from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
 
         port = _free_port()
-        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
-        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=60)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=60)
         r = {}
         t = threading.Thread(
             target=lambda: r.setdefault("w", w.sync_peers("10.0.0.2:7002")))
@@ -158,7 +158,7 @@ class TestTCPStoreNative:
         t.join()
         assert eps == r["w"]
         # "restart" of node 2: same endpoint syncs again and gets same list
-        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=60)
         assert w2.sync_peers("10.0.0.2:7002") == eps
         w2.stop()
         w.stop()
@@ -171,8 +171,8 @@ class TestTCPStoreNative:
         from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
 
         port = _free_port()
-        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
-        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=60)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=60)
         r = {}
         t = threading.Thread(target=lambda: r.setdefault(
             "w", w.sync_peers("10.0.0.2:7002", node_id="node-b")))
@@ -181,7 +181,7 @@ class TestTCPStoreNative:
         t.join()
         assert eps == ["10.0.0.1:7001", "10.0.0.2:7002"]
         # node-b relaunches on a different port: same slot, new endpoint
-        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        w2 = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=60)
         eps2 = w2.sync_peers("10.0.0.2:9999", node_id="node-b")
         assert eps2 == ["10.0.0.1:7001", "10.0.0.2:9999"]
         w2.stop()
@@ -233,8 +233,8 @@ class TestTCPStoreNative:
         from paddle_tpu.distributed.launch.rendezvous import HTTPMaster
 
         port = _free_port()
-        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=10)
-        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=10)
+        m = HTTPMaster(f"127.0.0.1:{port}", True, nnodes=2, timeout=60)
+        w = HTTPMaster(f"127.0.0.1:{port}", False, nnodes=2, timeout=60)
         # rank-1 node arrives FIRST but must land in slot 1
         r = {}
         t = threading.Thread(target=lambda: r.setdefault(
@@ -259,7 +259,7 @@ class TestTCPStoreNative:
         code = (
             "import sys; sys.path.insert(0, %r)\n"
             "from paddle_tpu.distributed import TCPStore\n"
-            "s = TCPStore('127.0.0.1', %d, is_master=False, world_size=2, timeout=10)\n"
+            "s = TCPStore('127.0.0.1', %d, is_master=False, world_size=2, timeout=60)\n"
             "s.set('from_child', b'pid-ok')\n"
             "print(s.wait('from_parent', 10).decode())\n"
             "s.close()\n" % (os.path.dirname(os.path.dirname(

@@ -29,7 +29,21 @@ def main():
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--accum", type=int, default=1,
+                    help="grad-accumulation microbatches per optimizer "
+                         "step (batch is the MICRObatch size; the step "
+                         "consumes batch*accum examples)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="PT_OFFLOAD_WINDOW override")
+    ap.add_argument("--order", default=None,
+                    help="PT_OFFLOAD_ORDER override (backward|forward)")
+    ap.add_argument("--remat", default="dots",
+                    help="remat policy (dots|offload_attn|none)")
     args = ap.parse_args()
+    if args.window is not None:
+        os.environ["PT_OFFLOAD_WINDOW"] = str(args.window)
+    if args.order is not None:
+        os.environ["PT_OFFLOAD_ORDER"] = args.order
 
     import jax
     import numpy as np
@@ -56,16 +70,19 @@ def main():
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
         engine = ParallelEngine(model, optimizer=opt, loss_fn=None,
-                                remat=True, remat_policy="dots",
+                                remat=args.remat != "none",
+                                remat_policy=args.remat,
                                 offload_opt_state=True,
-                                alias_model_params=True)
+                                alias_model_params=True,
+                                grad_accum=args.accum)
         engine.build_train_step()
         rng = np.random.RandomState(0)
+        B = args.batch * args.accum
         ids = paddle.to_tensor(
-            rng.randint(0, cfg.vocab_size, (args.batch, args.seq))
+            rng.randint(0, cfg.vocab_size, (B, args.seq))
             .astype("int32"))
         labels = paddle.to_tensor(
-            rng.randint(0, cfg.vocab_size, (args.batch, args.seq))
+            rng.randint(0, cfg.vocab_size, (B, args.seq))
             .astype("int64"))
         ms = device_time_ms(lambda: engine.train_batch(ids, labels),
                             reps=args.steps, repeats=2, warmup=1)
@@ -73,7 +90,7 @@ def main():
         kinds = {v.sharding.memory_kind
                  for slots in engine.opt_state.values()
                  for v in slots.values()}
-    tps = args.batch * args.seq / (ms / 1e3)
+    tps = args.batch * args.accum * args.seq / (ms / 1e3)
     mfu = tps * 6.0 * n_params / peak_flops()
     line = {"metric": "llama_offload_opt_tokens_per_sec_1chip",
             "value": round(tps, 1),

@@ -1,0 +1,152 @@
+"""Per-op device profile of one train-step config (VERDICT r5 item 2: the
+S=16384 step has a 0.4185 MFU with no train-level accounting).
+
+Traces N steps with jax.profiler, parses the Chrome trace the xplane
+converter writes, and buckets device-op time into attention kernels /
+lm-head+CE / optimizer updates / other fusions — so "is long-S bound by
+the 9-plane attention kernel or by CE/scan overhead?" gets a measured
+answer instead of an inference.
+
+Usage: python tools/profile_step.py [--seq 16384 --batch 1]
+       [--layers 12 --hidden 2048]   # 509M headline dims by default
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def bucket_of(name: str) -> str:
+    n = name.lower()
+    if "flash" in n or "attention" in n or "mosaic" in n:
+        return "attention_kernels"
+    if "ce" in n and ("fused" in n or "chunk" in n):
+        return "lmhead_ce"
+    if "log_softmax" in n or "logits" in n or "take_along" in n:
+        return "lmhead_ce"
+    if "adam" in n or "mul_sub" in n or ("fusion" in n and "sqrt" in n):
+        return "optimizer"
+    if "copy" in n or "transpose" in n:
+        return "copy_transpose"
+    if "fusion" in n or "dot" in n or "conv" in n:
+        return "matmul_fusions"
+    return "other"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=16384)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--inter", type=int, default=5632)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--keep", default=None,
+                    help="keep the trace dir at this path")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel import ParallelEngine
+    from paddle_tpu.utils.bench_timing import device_time_ms, tpu_lock
+
+    assert any(d.platform in ("tpu", "axon") for d in jax.devices()), \
+        "profile_step wants the real chip"
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=args.hidden,
+                      intermediate_size=args.inter,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=args.hidden // 128,
+                      num_key_value_heads=max(args.hidden // 256, 1),
+                      max_position_embeddings=args.seq, dtype="bfloat16",
+                      use_flash_attention=True)
+    paddle.seed(0)
+    trace_dir = args.keep or tempfile.mkdtemp(prefix="pt_trace_")
+    with tpu_lock(timeout_s=900.0) as locked:
+        model = LlamaForCausalLM(cfg)
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+        engine = ParallelEngine(model, optimizer=opt, loss_fn=None,
+                                remat=args.remat)
+        engine.build_train_step()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size,
+                        (args.batch, args.seq)).astype("int32"))
+        labels = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size,
+                        (args.batch, args.seq)).astype("int64"))
+        ms = device_time_ms(lambda: engine.train_batch(ids, labels),
+                            reps=2, warmup=2)  # warms compile + cache
+        jax.profiler.start_trace(trace_dir)
+        for _ in range(args.steps):
+            engine.train_batch(ids, labels)
+        # force completion INSIDE the trace window
+        float(np.asarray(engine.train_batch(ids, labels).value))
+        jax.profiler.stop_trace()
+
+    traces = glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    assert traces, f"no trace written under {trace_dir}"
+    with gzip.open(sorted(traces)[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device lanes: pick pids whose process names mention TPU/device
+    pid_names = {e["pid"]: e["args"].get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    dev_pids = {p for p, n in pid_names.items()
+                if "tpu" in n.lower() or "device" in n.lower()
+                or "/device" in n.lower()}
+    if not dev_pids:  # fall back: everything that isn't python/host
+        dev_pids = {p for p, n in pid_names.items()
+                    if "python" not in n.lower() and "host" not in n.lower()}
+    agg, buckets = {}, {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        dur = e.get("dur", 0) / 1e3  # ms
+        a = agg.setdefault(name, [0, 0.0])
+        a[0] += 1
+        a[1] += dur
+        buckets[bucket_of(name)] = buckets.get(bucket_of(name), 0.0) + dur
+        total += dur
+    steps_traced = args.steps + 1
+    print(f"\n== device-op profile: {n_params/1e6:.0f}M, B={args.batch} "
+          f"S={args.seq} remat={args.remat} ({steps_traced} steps traced, "
+          f"step {ms:.1f} ms) ==")
+    print(f"total device-op time {total:.1f} ms "
+          f"({total / steps_traced:.1f} ms/step vs {ms:.1f} wall — "
+          f"overlap if smaller)")
+    print("\n-- buckets --")
+    for b, t in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        print(f"  {b:<20} {t:>9.1f} ms  {100 * t / max(total, 1e-9):5.1f}%")
+    print(f"\n-- top {args.top} ops --")
+    for name, (calls, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]
+                                   )[:args.top]:
+        print(f"  {t:>9.2f} ms  x{calls:<5} [{bucket_of(name):<16}] "
+              f"{name[:90]}")
+    if not locked:
+        print("(lock_contended)")
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
