@@ -112,13 +112,36 @@ def main():
 
     B = int(os.environ.get("BENCH_B", B))
     S = int(os.environ.get("BENCH_S", S))
-    mfu, tokens_per_sec, n_params, loss = _measure(cfg, B, S, steps, warmup)
+    # B=8 is the 16 GB ceiling config on a QUIET chip; on the shared
+    # tunneled chip a co-tenant could hold memory. Rather than lose the
+    # headline to someone else's residency, step the batch down and say
+    # so (defensive only — never observed to trigger).
+    headline_note = ""
+    ladder = [B] + [x for x in (6, 4, 2) if x < B]
+    for i, b_try in enumerate(ladder):
+        try:
+            mfu, tokens_per_sec, n_params, loss = _measure(
+                cfg, b_try, S, steps, warmup)
+            if b_try != B:
+                headline_note = (f"; NOTE B stepped down {B}->{b_try}: "
+                                 f"RESOURCE_EXHAUSTED at B={B}")
+            B = b_try
+            break
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) or i == len(ladder) - 1:
+                raise
+            import gc
+
+            gc.collect()
+            jax.clear_caches()
+            time.sleep(5)
 
     out = {
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu, 4),
         "unit": f"MFU, 509M-proxy model (tokens/s={tokens_per_sec:.0f}, "
-                f"params={n_params/1e6:.0f}M, B={B}, S={S}, loss={loss:.3f})",
+                f"params={n_params/1e6:.0f}M, B={B}, S={S}, "
+                f"loss={loss:.3f}{headline_note})",
         "vs_baseline": round(mfu / 0.40, 4),
     }
     if not on_tpu:

@@ -52,6 +52,49 @@ class ExpertMLP(Layer):
         return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
 
 
+def _dispatch_mode() -> str:
+    """dense (one-hot einsums) | sparse (scatter index + gathers).
+
+    The dense GShard dispatch is O(T·E·C·d) MXU work — measured 86% of the
+    whole MoE forward at E=8/C=5120 on v5e (tools/bench_moe.py r5). The
+    sparse path builds an (E, C) slot→token index with ONE int scatter and
+    moves activations with two gathers, O(T·K·d) traffic — the same
+    token→bucket contraction the reference does with assign_pos +
+    global_scatter custom ops (assign_pos_op.cu), done with XLA
+    scatter/gather instead."""
+    import os
+
+    return os.environ.get("PT_MOE_DISPATCH", "sparse")
+
+
+def _sparse_dispatch(flat, topi, pos, keep, E, C):
+    """Returns (buckets (E,C,d), take_back(out_buckets, topv) -> (T,d)).
+
+    Slot grid has C+1 columns per expert; column C is the shared overflow
+    trash (scatter collisions there are masked out). Gradients flow through
+    the activation gathers; the index scatter is integer-valued."""
+    T, d = flat.shape
+    K = topi.shape[1]
+    e_flat = topi.reshape(-1)
+    p_flat = jnp.where(keep, pos, C).reshape(-1)
+    slot = e_flat * (C + 1) + p_flat
+    n_slots = E * (C + 1)
+    tok_of_slot = jnp.zeros((n_slots,), jnp.int32).at[slot].set(
+        jnp.arange(T * K, dtype=jnp.int32) // K)
+    filled = jnp.zeros((n_slots,), flat.dtype).at[slot].max(
+        jnp.ones((T * K,), flat.dtype))
+    grid = tok_of_slot.reshape(E, C + 1)[:, :C]
+    fill = filled.reshape(E, C + 1)[:, :C]
+    buckets = flat[grid] * fill[..., None]
+
+    def take_back(out_buckets, topv):
+        y = out_buckets[e_flat, jnp.minimum(p_flat, C - 1)]  # (T*K, d)
+        w = (topv.reshape(-1) * keep.reshape(-1).astype(topv.dtype))
+        return (y * w[:, None]).reshape(T, K, d).sum(axis=1)
+
+    return buckets, take_back
+
+
 class MoELayer(Layer):
     """Ref moe_layer.py:260 — same constructor spirit; `experts` may be an
     ExpertMLP (fast stacked path) or a list of Layers (generic path)."""
@@ -113,6 +156,13 @@ class MoELayer(Layer):
             pos_in_e = jnp.cumsum(flat_oh, axis=0) - flat_oh  # (T*K, E)
             pos = jnp.sum(pos_in_e * flat_oh, axis=-1).reshape(T, K)
             keep = pos < C
+            if _dispatch_mode() == "sparse":
+                buckets, take_back = _sparse_dispatch(flat, topi, pos, keep,
+                                                      E, C)
+                out_buckets = self.experts.run_experts(buckets, w1, w2,
+                                                       b1, b2)
+                out = take_back(out_buckets, topv.astype(xv.dtype))
+                return out.reshape(xv.shape), aux
             # combine/dispatch one-hots (GShard formulation): overflow → 0 row
             oh_e = jax.nn.one_hot(topi, E, dtype=xv.dtype)          # (T,K,E)
             oh_c = jax.nn.one_hot(jnp.where(keep, pos, C), C,

@@ -127,6 +127,14 @@ def _apply_rope(x, cos, sin, pos_offset=0):
     return _rope_rotate(x, c, s)
 
 
+def _apply_rope_bhsd(x, cos, sin, pos_offset=0):
+    """x: (B, H, S, D) — the kernel-native head-major layout."""
+    S = x.shape[2]
+    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, S, 0)[None, None, :, :]
+    s = jax.lax.dynamic_slice_in_dim(sin, pos_offset, S, 0)[None, None, :, :]
+    return _rope_rotate(x, c, s)
+
+
 # --------------------------------------------------------------------------- #
 # Context-parallel attention dispatch
 # --------------------------------------------------------------------------- #
@@ -316,6 +324,25 @@ class LlamaAttention(Layer):
             qv = checkpoint_name(qv, "qkv")
             kv = checkpoint_name(kv, "qkv")
             vv = checkpoint_name(vv, "qkv")
+            if (self.cfg.use_flash_attention and not cache_vals
+                    and not self.cfg.context_parallel
+                    and not (self.cfg.sequence_parallel
+                             and self.cfg.ulysses_parallel)):
+                # BHSD-NATIVE training path: swap to head-major BEFORE rope
+                # so the layout change fuses into the rope elementwise (and
+                # the inverse transposes fold into the o-proj/vjp dots) —
+                # at S=16k the standalone (B,S,H,D)<->(B,H,S,D) copies
+                # around the custom call were ~33% of the step (r5 per-op
+                # profile, tools/profile_step.py)
+                from ..ops.flash_attention import flash_attention
+
+                qh = _apply_rope_bhsd(jnp.swapaxes(qv, 1, 2), cv, sv,
+                                      pos_offset)
+                kh = _apply_rope_bhsd(jnp.swapaxes(kv, 1, 2), cv, sv,
+                                      pos_offset)
+                out = flash_attention(qh, kh, jnp.swapaxes(vv, 1, 2),
+                                      causal=True)
+                return jnp.swapaxes(out, 1, 2)
             qr = _apply_rope(qv, cv, sv, pos_offset)
             kr = _apply_rope(kv, cv, sv, pos_offset)
             if cache_vals:

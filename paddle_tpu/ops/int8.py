@@ -104,11 +104,14 @@ def w8_matmul(x, w_q, scale):
     M = x2.shape[0]
     out_dtype = x.dtype
     # the streaming int8 kernel only wins when the matmul is weight-read
-    # bound (single-token decode, tiny M). Prefill/training shapes (M large)
-    # re-use each weight block M times — there the dequantize-once XLA path
-    # is the right program, and huge x blocks would blow VMEM anyway.
+    # bound (single-token decode, M = decode batch). Prefill/training
+    # shapes re-use each weight block M times — there the dequantize-once
+    # XLA path is the right program. The old M<=256 gate let per-request
+    # SERVER prefills (M = one prompt bucket, 32-128) onto the streaming
+    # kernel and collapsed under-load int8 serving to 62 tok/s (r5,
+    # BASELINE.md); decode batches are <=16 in every shipped config.
     usable = (_use_pallas() and K % _LANE == 0 and N % _LANE == 0 and
-              M <= 256)
+              M <= 16)
     if usable:
         try:
             out = _w8_matmul_pallas(x2, w_q, scale, out_dtype)

@@ -102,10 +102,22 @@ def main():
         flat = jnp.asarray(rng.randn(T, D).astype("float32") * 0.1)
         buckets = jnp.asarray(rng.randn(E, C, D).astype("float32") * 0.1)
 
-        @jax.jit
-        def full_moe(xv):
+        # decomposition runs BOTH dispatch modes explicitly (the env is
+        # read at trace time): full_moe in the current default mode plus a
+        # forced-dense full pass, so dispatch_share always compares the
+        # dense dispatch against the DENSE step it is part of
+        cur_mode = os.environ.get("PT_MOE_DISPATCH", "sparse")
+
+        def full_moe_fn(xv):
             out = moe(paddle.to_tensor(xv)).value
             return out.ravel()[0]
+
+        full_moe = jax.jit(full_moe_fn)
+        _ = full_moe(flat)  # trace in cur_mode
+        os.environ["PT_MOE_DISPATCH"] = "dense"
+        full_moe_dense = jax.jit(lambda xv: full_moe_fn(xv) + 0.0)
+        _ = full_moe_dense(flat)  # trace in dense mode
+        os.environ["PT_MOE_DISPATCH"] = cur_mode
 
         @jax.jit
         def experts_only(bk):
@@ -130,16 +142,22 @@ def main():
             return out.ravel()[0]
 
         moe_ms = device_time_ms(lambda: full_moe(flat), reps=5, warmup=2)
+        moe_dense_ms = device_time_ms(lambda: full_moe_dense(flat), reps=5,
+                                      warmup=2)
         exp_ms = device_time_ms(lambda: experts_only(buckets), reps=5,
                                 warmup=2)
         disp_ms = device_time_ms(lambda: gate_dispatch_only(flat), reps=5,
                                  warmup=2)
 
     tok_s = T / (step_ms / 1e3)
-    # active FLOPs: experts compute on E*C token slots (fwd+bwd 3x),
-    # plus dispatch/combine einsums (T*E*C*D each, 2 in fwd)
+    # active FLOPs: experts compute on E*C token slots (fwd+bwd 3x). The
+    # dense one-hot dispatch adds T*E*C*D einsums; the sparse path moves
+    # the same tokens with gathers (no MXU FLOPs) — count dispatch FLOPs
+    # only when they are actually executed
     expert_flops = 2 * E * C * (2 * D * H) * 3 * L
-    dispatch_flops = 2 * (2 * T * E * C * D) * 3 * L
+    dispatch_flops = (2 * (2 * T * E * C * D) * 3 * L
+                      if os.environ.get("PT_MOE_DISPATCH",
+                                        "sparse") == "dense" else 0)
     mfu = (expert_flops + dispatch_flops) / (step_ms / 1e3) / peak_flops()
     line = {
         "metric": "moe_train_tokens_per_sec_1chip",
@@ -147,11 +165,14 @@ def main():
         "unit": f"tok/s ({L}L MoE-FFN d{D} E{E} top{K} C{C}, "
                 f"{n_params/1e6:.0f}M params, loss={loss:.4f})",
         "ms_per_step": round(step_ms, 2),
+        "dispatch_mode": os.environ.get("PT_MOE_DISPATCH", "sparse"),
         "mfu_active": round(mfu, 4),
         "decomp_ms": {"full_moe_fwd": round(moe_ms, 2),
+                      "full_moe_fwd_dense": round(moe_dense_ms, 2),
                       "experts_only_fwd": round(exp_ms, 2),
-                      "gate_dispatch_combine_fwd": round(disp_ms, 2)},
-        "dispatch_share": round(disp_ms / moe_ms, 3) if moe_ms else None,
+                      "dense_gate_dispatch_combine_fwd": round(disp_ms, 2)},
+        "dense_dispatch_share": (round(disp_ms / moe_dense_ms, 3)
+                                 if moe_dense_ms else None),
     }
     if not locked:
         line["lock_contended"] = True

@@ -89,9 +89,20 @@ def main():
 
         h2d = jax.jit(h2d_fn)
         rt = jax.jit(roundtrip_fn)
-        chains = {w: jax.jit(lambda hs, w=w: chain_fn(hs, w)[0],
-                             out_shardings=dev_s)
-                  for w in (1, 2, 4)}
+        # the d2h outs MUST be jit OUTPUTS (host shardings): returning only
+        # the scalar lets XLA dead-code-eliminate every d2h and the "chain"
+        # measures h2d alone (r5 code-review catch — the first "full
+        # duplex" rows were unsupported)
+        chains = {}
+        for w in (1, 2, 4):
+            jitted = jax.jit(lambda hs, w=w: chain_fn(hs, w),
+                             out_shardings=(dev_s, [host_s] * args.blocks))
+
+            def run(jitted=jitted):
+                s, _outs = jitted(hosts)
+                return s
+
+            chains[w] = run
 
         gib = args.mb / 1024.0
         res = {}
@@ -105,8 +116,7 @@ def main():
                             "gib_s_each_way": round(2 * gib / (ms / 1e3), 2)}
         chain_gib = 2 * gib * args.blocks  # both directions, k blocks
         for w, fn in chains.items():
-            ms = device_time_ms(lambda fn=fn: fn(hosts), reps=args.reps,
-                                repeats=2, warmup=2)
+            ms = device_time_ms(fn, reps=args.reps, repeats=2, warmup=2)
             res[f"chain_w{w}"] = {
                 "ms": round(ms, 2),
                 "gib_s_total": round(chain_gib / (ms / 1e3), 2)}

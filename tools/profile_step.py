@@ -8,7 +8,7 @@ the 9-plane attention kernel or by CE/scan overhead?" gets a measured
 answer instead of an inference.
 
 Usage: python tools/profile_step.py [--seq 16384 --batch 1]
-       [--layers 12 --hidden 2048]   # 509M headline dims by default
+       [--layers 8 --hidden 2048]    # 509M headline dims by default
 """
 from __future__ import annotations
 
@@ -23,16 +23,27 @@ import tempfile
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def bucket_of(name: str) -> str:
+def bucket_of(name: str, args: dict) -> str:
+    """Buckets keyed on the HLO metadata, not the mangled event name: the
+    flash BACKWARD kernels surface as `transpose_jvp___*` (the autodiff
+    transpose of the custom_vjp) with hlo_category=custom-call — name
+    matching alone mislabels them as layout copies (r5 lesson)."""
     n = name.lower()
-    if "flash" in n or "attention" in n or "mosaic" in n:
-        return "attention_kernels"
-    if "ce" in n and ("fused" in n or "chunk" in n):
+    tf_op = str(args.get("tf_op", "")).lower()
+    cat = str(args.get("hlo_category", "")).lower()
+    src_line = str(args.get("source", ""))
+    if "pallas" in tf_op or "custom-call" in cat or "mosaic" in n:
+        if "flash" in src_line or "llama.py" in src_line or "flash" in n:
+            return "attention_kernels"
+        return "custom_calls"
+    if "fused_ce" in src_line or "log_softmax" in n or "take_along" in n:
         return "lmhead_ce"
-    if "log_softmax" in n or "logits" in n or "take_along" in n:
-        return "lmhead_ce"
-    if "adam" in n or "mul_sub" in n or ("fusion" in n and "sqrt" in n):
+    if "while" in n:
+        return "loops(ce_chunks/stream)"
+    if "optimizer" in src_line or "adam" in n:
         return "optimizer"
+    if n and n[0].isdigit() or n.startswith("jit_"):
+        return "_step_markers"  # parent regions, excluded from totals
     if "copy" in n or "transpose" in n:
         return "copy_transpose"
     if "fusion" in n or "dot" in n or "conv" in n:
@@ -44,7 +55,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=16384)
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--layers", type=int, default=8)
     ap.add_argument("--hidden", type=int, default=2048)
     ap.add_argument("--inter", type=int, default=5632)
     ap.add_argument("--steps", type=int, default=3)
@@ -52,8 +63,20 @@ def main():
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--keep", default=None,
                     help="keep the trace dir at this path")
+    ap.add_argument("--parse-only", default=None,
+                    help="re-analyze an existing trace dir; no chip run")
     args = ap.parse_args()
 
+    if args.parse_only:
+        meta = {}
+        mp = os.path.join(args.parse_only, "pt_profile_meta.json")
+        if os.path.exists(mp):
+            meta = json.load(open(mp))
+        return analyze(args.parse_only, args,
+                       ms=meta.get("step_ms", 0.0),
+                       n_params=meta.get("n_params", 0),
+                       steps_traced=meta.get("steps_traced",
+                                             args.steps + 1))
     import jax
     import numpy as np
 
@@ -97,6 +120,18 @@ def main():
         float(np.asarray(engine.train_batch(ids, labels).value))
         jax.profiler.stop_trace()
 
+    with open(os.path.join(trace_dir, "pt_profile_meta.json"), "w") as f:
+        json.dump({"step_ms": ms, "n_params": n_params,
+                   "steps_traced": args.steps + 1,
+                   "config": vars(args)}, f)
+    analyze(trace_dir, args, ms, n_params, args.steps + 1)
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
+def analyze(trace_dir, args, ms, n_params, steps_traced):
     traces = glob.glob(os.path.join(
         trace_dir, "**", "*.trace.json.gz"), recursive=True)
     assert traces, f"no trace written under {trace_dir}"
@@ -119,13 +154,15 @@ def main():
         if e.get("ph") != "X" or e.get("pid") not in dev_pids:
             continue
         name = e.get("name", "?")
+        b = bucket_of(name, e.get("args", {}))
         dur = e.get("dur", 0) / 1e3  # ms
-        a = agg.setdefault(name, [0, 0.0])
+        a = agg.setdefault(name, [0, 0.0, b])
         a[0] += 1
         a[1] += dur
-        buckets[bucket_of(name)] = buckets.get(bucket_of(name), 0.0) + dur
+        if b == "_step_markers":
+            continue  # parent spans would double-count their children
+        buckets[b] = buckets.get(b, 0.0) + dur
         total += dur
-    steps_traced = args.steps + 1
     print(f"\n== device-op profile: {n_params/1e6:.0f}M, B={args.batch} "
           f"S={args.seq} remat={args.remat} ({steps_traced} steps traced, "
           f"step {ms:.1f} ms) ==")
@@ -136,16 +173,9 @@ def main():
     for b, t in sorted(buckets.items(), key=lambda kv: -kv[1]):
         print(f"  {b:<20} {t:>9.1f} ms  {100 * t / max(total, 1e-9):5.1f}%")
     print(f"\n-- top {args.top} ops --")
-    for name, (calls, t) in sorted(agg.items(), key=lambda kv: -kv[1][1]
-                                   )[:args.top]:
-        print(f"  {t:>9.2f} ms  x{calls:<5} [{bucket_of(name):<16}] "
-              f"{name[:90]}")
-    if not locked:
-        print("(lock_contended)")
-    if not args.keep:
-        import shutil
-
-        shutil.rmtree(trace_dir, ignore_errors=True)
+    for name, (calls, t, b) in sorted(agg.items(), key=lambda kv: -kv[1][1]
+                                      )[:args.top]:
+        print(f"  {t:>9.2f} ms  x{calls:<5} [{b:<16}] {name[:90]}")
 
 
 if __name__ == "__main__":

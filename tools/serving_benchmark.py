@@ -30,11 +30,20 @@ def main():
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-new", type=int, default=64)
-    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--max-len", type=int, default=None)
     ap.add_argument("--tick-window", type=int, default=16,
                     help="decode ticks per host round trip (amortizes the "
                          "d2h sync; 1 = exact per-token semantics)")
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 (model.quantize_int8()) under "
+                         "the same load — composes the decode win with "
+                         "the tick-window server")
+    ap.add_argument("--long-prompts", action="store_true",
+                    help="mixed prompts 64-512 over buckets (64,128,256,"
+                         "512); raises max-len to 768 unless given")
     args = ap.parse_args()
+    if args.max_len is None:
+        args.max_len = 768 if args.long_prompts else 256
 
     import jax
     import numpy as np
@@ -63,7 +72,8 @@ def main():
 
     def burst(server, n):
         """Mixed prompt lengths across the bucket ladder."""
-        lens = rng.choice([16, 30, 64, 100, 128], size=n)
+        lens = rng.choice([64, 128, 256, 400, 512] if args.long_prompts
+                          else [16, 30, 64, 100, 128], size=n)
         rids = {}
         for ln in lens:
             prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
@@ -78,9 +88,13 @@ def main():
     lock = tpu_lock(timeout_s=900.0) if on_tpu else \
         contextlib.nullcontext(True)
     with lock as locked:
+        if args.int8:
+            model.quantize_int8()
         server = GenerationServer(model, max_batch=args.slots,
                                   max_len=args.max_len,
-                                  prompt_buckets=(32, 64, 128),
+                                  prompt_buckets=((64, 128, 256, 512)
+                                                  if args.long_prompts
+                                                  else (32, 64, 128)),
                                   tick_window=args.tick_window)
         # warmup drain: compiles the decode tick + all prefill buckets
         burst(server, min(args.slots, 4))
@@ -106,8 +120,10 @@ def main():
     line = {"metric": "serving_continuous_batching_tok_s_1chip",
             "value": round(gen_tokens / dt, 1),
             "unit": f"generated tok/s ({args.requests} reqs, {args.slots} "
-                    f"slots, max_new={args.max_new}, mixed prompts 16-128, "
+                    f"slots, max_new={args.max_new}, mixed prompts "
+                    f"{'64-512' if args.long_prompts else '16-128'}, "
                     f"tick_window={args.tick_window}, "
+                    f"{'int8' if args.int8 else 'bf16'} weights, "
                     f"params={n_params/1e6:.0f}M)",
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
             "wall_s": round(dt, 2)}
