@@ -29,23 +29,49 @@ from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
 class ExpertMLP(Layer):
     """Stacked expert FFN weights: (E, d_model, d_hidden) + (E, d_hidden,
-    d_model), expert dim sharded over the 'expert' axis."""
+    d_model), expert dim sharded over the 'expert' axis.
 
-    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+    ``gated=True`` makes each expert a bias-free SwiGLU (gate/up/down —
+    the Llama/Mixtral expert shape) instead of the two-matmul GELU MLP."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu",
+                 gated: bool = False):
         super().__init__()
         self.num_experts = num_experts
+        self.gated = gated
         self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
                                         default_initializer=XavierUniform())
         self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
                                         default_initializer=XavierUniform())
-        self.b1 = self.create_parameter([num_experts, d_hidden], is_bias=True)
-        self.b2 = self.create_parameter([num_experts, d_model], is_bias=True)
-        for p in (self.w1, self.w2, self.b1, self.b2):
-            p.pspec = P("expert")
+        if gated:
+            self.w3 = self.create_parameter(
+                [num_experts, d_model, d_hidden],
+                default_initializer=XavierUniform())
+            self.w3.pspec = P("expert")
+        else:
+            self.b1 = self.create_parameter([num_experts, d_hidden],
+                                            is_bias=True)
+            self.b2 = self.create_parameter([num_experts, d_model],
+                                            is_bias=True)
+            self.b1.pspec = P("expert")
+            self.b2.pspec = P("expert")
+        self.w1.pspec = P("expert")
+        self.w2.pspec = P("expert")
         self.activation = activation
 
-    def run_experts(self, buckets, w1, w2, b1, b2):
+    def expert_params(self):
+        if self.gated:
+            return (self.w1, self.w2, self.w3)
+        return (self.w1, self.w2, self.b1, self.b2)
+
+    def run_experts(self, buckets, w1, w2, *rest):
         """buckets: (E, C, d) — per-expert token buffers."""
+        if self.gated:
+            (w3,) = rest
+            h = jax.nn.silu(jnp.einsum("ecd,edh->ech", buckets, w1)) * \
+                jnp.einsum("ecd,edh->ech", buckets, w3)
+            return jnp.einsum("ech,ehd->ecd", h, w2)
+        b1, b2 = rest
         act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
         h = jnp.einsum("ecd,edh->ech", buckets, w1) + b1[:, None, :]
         h = act(h)
@@ -102,7 +128,7 @@ class MoELayer(Layer):
     def __init__(self, d_model, experts=None, gate=None, moe_group=None, mp_group=None,
                  recompute_interval=0, capacity_factor: float = 1.25, top_k: int = 2,
                  num_experts: Optional[int] = None, d_hidden: Optional[int] = None,
-                 **kwargs):
+                 gated_experts: bool = False, **kwargs):
         super().__init__()
         self.d_model = d_model
         if isinstance(gate, dict):
@@ -113,7 +139,8 @@ class MoELayer(Layer):
             gate_type = "gshard"
         if experts is None:
             assert num_experts and d_hidden, "need num_experts + d_hidden or experts"
-            experts = ExpertMLP(num_experts, d_model, d_hidden)
+            experts = ExpertMLP(num_experts, d_model, d_hidden,
+                                gated=gated_experts)
         if isinstance(experts, (list, tuple)):
             from .....nn.layer.container import LayerList
 
@@ -145,7 +172,7 @@ class MoELayer(Layer):
         gate_w = self.gate.weight
         gate_obj = self.gate
 
-        def f(xv, gw, w1, w2, b1, b2):
+        def f(xv, gw, *ws):
             flat = xv.reshape(-1, xv.shape[-1])  # (T, d)
             T = flat.shape[0]
             C = max(int(cf * T * K / E), 1)
@@ -159,8 +186,7 @@ class MoELayer(Layer):
             if _dispatch_mode() == "sparse":
                 buckets, take_back = _sparse_dispatch(flat, topi, pos, keep,
                                                       E, C)
-                out_buckets = self.experts.run_experts(buckets, w1, w2,
-                                                       b1, b2)
+                out_buckets = self.experts.run_experts(buckets, *ws)
                 out = take_back(out_buckets, topv.astype(xv.dtype))
                 return out.reshape(xv.shape), aux
             # combine/dispatch one-hots (GShard formulation): overflow → 0 row
@@ -169,14 +195,14 @@ class MoELayer(Layer):
                                   dtype=xv.dtype)                    # (T,K,C)
             dispatch = jnp.einsum("tke,tkc->tec", oh_e, oh_c)        # (T,E,C)
             buckets = jnp.einsum("tec,td->ecd", dispatch, flat)
-            out_buckets = self.experts.run_experts(buckets, w1, w2, b1, b2)
+            out_buckets = self.experts.run_experts(buckets, *ws)
             combine = jnp.einsum("tke,tkc,tk->tec", oh_e, oh_c,
                                  topv.astype(xv.dtype))
             out = jnp.einsum("tec,ecd->td", combine, out_buckets)
             return out.reshape(xv.shape), aux
 
-        out, aux = apply_op(f, x, gate_w, self.experts.w1, self.experts.w2,
-                            self.experts.b1, self.experts.b2, op_name="moe")
+        out, aux = apply_op(f, x, gate_w, *self.experts.expert_params(),
+                            op_name="moe")
         self.gate.loss = aux
         return out
 

@@ -69,6 +69,16 @@ class LlamaConfig:
     # PT_CE_CHUNK unless the lm-head/CE is vocab-sharded over 'tensor'.
     ce_chunk_size: int = 16384
     recompute: bool = False
+    # Mixtral-style MoE FFN (0 = dense). Experts are SwiGLU of the dense
+    # MLP's shape, stacked (E, d, d_ff) and sharded over the 'expert' mesh
+    # axis; routing = GShard top-k with capacity buckets + load-balance aux
+    # loss folded into the LM loss (ref incubate moe_layer.py integrated at
+    # model level; the reference has no model-family MoE transformer)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_every: int = 1  # every Nth decoder layer gets the MoE FFN
+    moe_aux_coeff: float = 0.01
 
 
 def llama3_8b_config(**kw) -> LlamaConfig:
@@ -526,13 +536,48 @@ class LlamaMLP(Layer):
         return out
 
 
-class LlamaDecoderLayer(Layer):
+class LlamaMoEMLP(Layer):
+    """MoE FFN slot-in for LlamaMLP: top-k routed SwiGLU experts over the
+    'expert' mesh axis (SURVEY §2.3 EP at model level — parity test
+    `tests/test_moe_llama.py`)."""
+
     def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        from ..incubate.distributed.models.moe import MoELayer
+
+        self.moe = MoELayer(d_model=cfg.hidden_size,
+                            num_experts=cfg.moe_num_experts,
+                            d_hidden=cfg.intermediate_size,
+                            top_k=cfg.moe_top_k,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            gated_experts=True)
+        self._sp = cfg.sequence_parallel
+        self._cp = cfg.context_parallel
+
+    @property
+    def aux_loss(self):
+        return self.moe.gate.loss
+
+    def forward(self, x):
+        out = self.moe(x)
+        out = apply_op(lambda v: checkpoint_name(v, "mlp_out"), out,
+                       op_name="moe_out")
+        if self._sp:
+            out = shard_constraint(out, P("data", "sep", None))
+        elif self._cp:
+            out = shard_constraint(out, P("data", "context", None))
+        return out
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig, layer_idx: int = 0):
         super().__init__()
         self.input_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.self_attn = LlamaAttention(cfg)
         self.post_attention_layernorm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
-        self.mlp = LlamaMLP(cfg)
+        use_moe = (cfg.moe_num_experts > 0
+                   and layer_idx % max(cfg.moe_every, 1) == 0)
+        self.mlp = LlamaMoEMLP(cfg) if use_moe else LlamaMLP(cfg)
         self._recompute = cfg.recompute
 
     def forward(self, x, cos, sin, cache=None, pos_offset=0):
@@ -561,10 +606,21 @@ class LlamaModel(Layer):
         self.cfg = cfg
         from ..framework.dtype import convert_dtype
 
+        if cfg.moe_num_experts > 0 and cfg.recompute:
+            # the eager recompute wrapper (fleet/recompute PyLayer) replays
+            # the forward under no_grad, so the gate.loss side-channel the
+            # aux loss reads would be DETACHED — the router would silently
+            # never learn. The compiled path is fine: use
+            # ParallelEngine(remat=True), whose jax.checkpoint replays
+            # differentiably.
+            raise ValueError(
+                "moe_num_experts > 0 with cfg.recompute=True detaches the "
+                "load-balance aux loss in eager training; use "
+                "ParallelEngine(remat=True) instead of cfg.recompute")
         self.embed_tokens = Embedding(cfg.vocab_size, cfg.hidden_size)
         self.embed_tokens.weight.pspec = P("tensor", None)
-        self.layers = LayerList([LlamaDecoderLayer(cfg)
-                                 for _ in range(cfg.num_hidden_layers)])
+        self.layers = LayerList([LlamaDecoderLayer(cfg, layer_idx=i)
+                                 for i in range(cfg.num_hidden_layers)])
         self.norm = LlamaRMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         cos, sin = _rope_tables(head_dim, cfg.max_position_embeddings, cfg.rope_theta)
@@ -631,6 +687,16 @@ class LlamaForCausalLM(Layer):
 
                 self.lm_head._convert_dtype(convert_dtype(cfg.dtype))
 
+    def _moe_aux(self):
+        """Sum of the MoE gates' load-balance losses from the last forward
+        (None for dense configs)."""
+        total = None
+        for layer in self.model.layers:
+            aux = getattr(layer.mlp, "aux_loss", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return total
+
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
         if labels is not None and self.cfg.fused_lm_head_ce:
@@ -642,10 +708,14 @@ class LlamaForCausalLM(Layer):
 
             chunk = capped_chunk_size(self.cfg.ce_chunk_size,
                                       input_ids.shape[1])
-            return apply_op(
+            loss = apply_op(
                 lambda hv, wv, lv: fused_linear_cross_entropy(
                     hv, wv, lv, chunk_size=chunk, transpose_weight=tied),
                 h, w, labels, op_name="fused_linear_cross_entropy")
+            aux = self._moe_aux()
+            if aux is not None:
+                loss = loss + self.cfg.moe_aux_coeff * aux
+            return loss
         if self.cfg.tie_word_embeddings:
             logits = apply_op(lambda v, w: jnp.matmul(v, w.T), h,
                               self.model.embed_tokens.weight)
@@ -653,11 +723,25 @@ class LlamaForCausalLM(Layer):
             logits = self.lm_head(h)
         if labels is None:
             return logits
-        return self.loss_fn(logits, labels)
+        loss = self.loss_fn(logits, labels)
+        aux = self._moe_aux()
+        if aux is not None:
+            loss = loss + self.cfg.moe_aux_coeff * aux
+        return loss
 
     def loss_fn(self, logits, labels):
-        """Next-token CE with fp32 softmax (ParallelCrossEntropy math)."""
-        return F.cross_entropy(logits, labels, reduction="mean")
+        """Next-token CE with fp32 softmax (ParallelCrossEntropy math).
+
+        MoE configs: the gates' load-balance aux loss (recorded by the
+        forward that produced ``logits``) is folded in here too, so
+        ``ParallelEngine(loss_fn=model.loss_fn)`` trains the router. A
+        fully external loss_fn must add ``cfg.moe_aux_coeff *
+        model._moe_aux()`` itself or the routing degenerates."""
+        loss = F.cross_entropy(logits, labels, reduction="mean")
+        aux = self._moe_aux()
+        if aux is not None:
+            loss = loss + self.cfg.moe_aux_coeff * aux
+        return loss
 
     def quantize_int8(self):
         """Convert every projection (q/k/v/o, gate/up/down, lm_head) to
@@ -674,6 +758,11 @@ class LlamaForCausalLM(Layer):
         fuse_qkv = os.environ.get("PT_W8_FUSED_QKV") == "1"
         for layer in self.model.layers:
             att, mlp = layer.self_attn, layer.mlp
+            if isinstance(mlp, LlamaMoEMLP):
+                # MoE experts stay in the model dtype: the stacked einsum
+                # path has no per-expert int8 kernel yet (routing keeps the
+                # active weight bytes at K/E of the dense equivalent anyway)
+                mlp = None
             if fuse_qkv:
                 # one [K, Nq+Nk+Nv] int8 weight (per-channel scales are
                 # column-independent, so fused == separate numerically);
@@ -693,8 +782,10 @@ class LlamaForCausalLM(Layer):
                 for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
                     setattr(att, name,
                             Int8Linear.from_linear(getattr(att, name)))
-            for name in ("gate_proj", "up_proj", "down_proj"):
-                setattr(mlp, name, Int8Linear.from_linear(getattr(mlp, name)))
+            if mlp is not None:
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    setattr(mlp, name,
+                            Int8Linear.from_linear(getattr(mlp, name)))
         if not self.cfg.tie_word_embeddings:
             self.lm_head = Int8Linear.from_linear(self.lm_head)
         self._gen_cache = {}  # old compiled loops close over bf16 params
