@@ -28,6 +28,7 @@ _SLOW_FILES = {
     "test_static_amp_shims.py", "test_tcp_store.py",
     "test_distributed_extras.py", "test_extensions.py",
     "test_auto_parallel_partition.py", "test_fleet_executor.py",
+    "test_multiprocess_train.py", "test_moe_llama.py",
     "test_serving.py", "test_op_sweep_extended.py", "test_sequence_ops.py",
     "test_functional_sweep.py",
 }

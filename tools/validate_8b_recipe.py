@@ -39,6 +39,9 @@ def main():
     ap.add_argument("--cp", action="store_true",
                     help="long-context leg: S=32768 over dp2 x zero4 x "
                          "tp2 x context4 with ring attention")
+    ap.add_argument("--moe", action="store_true",
+                    help="MoE leg: 8-expert Mixtral-proxy over dp2 x "
+                         "zero4 x expert8, sparse dispatch")
     ap.add_argument("--cp_seq", type=int, default=32768)
     ap.add_argument("--cp_layers", type=int, default=2)
     args = ap.parse_args()
@@ -132,6 +135,72 @@ def main():
 
     if args.cp:
         validate_cp_leg(args)
+    if args.moe:
+        validate_moe_leg(args)
+
+
+def validate_moe_leg(args):
+    """MoE/EP at recipe scale: an 8-expert Mixtral-proxy train step AOT-
+    compiled over dp2 × sharding4 × expert8 (64 devices) — expert weights
+    sharded over 'expert', token exchange via the collectives GSPMD
+    inserts around the sparse dispatch gathers."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama3_8b_config
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.parallel.engine import ParallelEngine
+
+    devs = np.asarray(jax.devices()[:64]).reshape(2, 4, 8)
+    mesh = Mesh(devs, ("data", "sharding", "expert"))
+    cfg = llama3_8b_config(num_hidden_layers=args.layers,
+                           max_position_embeddings=args.seq,
+                           dtype="float32", moe_num_experts=8,
+                           moe_top_k=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    print(f"MoE leg: {n_params/1e9:.1f}B total params "
+          f"({args.layers}L x 8 experts), mesh dp2 x zero4 x expert8")
+    opt = AdamW(learning_rate=3e-4, parameters=model.parameters())
+    eng = ParallelEngine(model, optimizer=opt, loss_fn=None, mesh=mesh,
+                         fsdp=True, remat=True, abstract=True)
+    step = eng.build_train_step()
+    B = args.batch
+    ids = jax.ShapeDtypeStruct(
+        (B, args.seq), jnp.int32,
+        sharding=NamedSharding(mesh, P("data", None)))
+    lbl = jax.ShapeDtypeStruct(
+        (B, args.seq), jnp.int64,
+        sharding=NamedSharding(mesh, P("data", None)))
+    p_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+             for k, v in eng.params.items()}
+    st_abs = jax.tree.map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                       sharding=v.sharding),
+        eng.opt_state)
+    sc = jax.ShapeDtypeStruct((), jnp.int32)
+    t0 = time.time()
+    compiled = step.lower(p_abs, st_abs, sc, 3e-4, (ids, lbl)).compile()
+    hlo = compiled.as_text()
+    counts = {c: hlo.count(c) for c in
+              ("all-to-all", "all-gather", "all-reduce", "reduce-scatter",
+               "collective-permute")}
+    print(f"  compiled in {time.time()-t0:.0f}s; collective sites: "
+          f"{counts}")
+    assert counts["all-reduce"] > 0
+    # expert exchange: the sparse dispatch's gathers over expert-sharded
+    # buckets lower to all-to-all / all-gather+dynamic-slice families —
+    # SOME expert-axis data exchange must exist
+    assert counts["all-to-all"] + counts["all-gather"] + \
+        counts["collective-permute"] > 0, "no expert token exchange"
+    print("Llama-3-8B MoE leg (dp2 x zero4 x expert8) validation OK")
 
 
 def validate_cp_leg(args):
