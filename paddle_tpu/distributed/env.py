@@ -78,7 +78,13 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
         return ParallelEnv()
     env = ParallelEnv()
     eps = env.trainer_endpoints
-    n = num_processes if num_processes is not None else (len(eps) or None)
+    n = num_processes
+    if n is None:
+        # PADDLE_TRAINERS_NUM = nnodes * nproc_per_node; the endpoint list
+        # is per-NODE, so len(eps) undercounts --nproc_per_node > 1 jobs
+        # (every local process shares node 0's coordinator endpoint)
+        wn = os.environ.get("PADDLE_TRAINERS_NUM")
+        n = int(wn) if wn is not None else (len(eps) or None)
     if coordinator_address is None and eps:
         coordinator_address = eps[0]
     if os.environ.get("PADDLE_HEARTBEAT_FILE"):

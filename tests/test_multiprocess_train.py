@@ -273,3 +273,48 @@ def test_elastic_kill_training_rank_resumes(tmp_path):
             np.testing.assert_allclose(
                 got[f"w_{k}"], v, rtol=1e-4, atol=1e-5,
                 err_msg=f"rank {r} weight {k} after kill+resume")
+
+
+_NPROC_CHILD = """
+import os, sys
+sys.path.insert(0, '/root/repo')
+os.environ.pop('XLA_FLAGS', None)
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import paddle_tpu.distributed as dist
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+env = dist.init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+mesh = Mesh(np.array(jax.devices()), ('x',))
+local = np.full((1,), env.rank + 1.0, np.float32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P('x')), local)
+import jax.numpy as jnp
+out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+print('PSUM', float(np.asarray(out)))
+assert float(np.asarray(out)) == 3.0
+"""
+
+
+def test_single_launcher_nproc_per_node(tmp_path):
+    """--nproc_per_node 2 under ONE launcher (the single-host multi-process
+    layout): PADDLE_TRAINERS_NUM (nnodes*nproc) must drive
+    jax.distributed.initialize, not the per-NODE endpoint count — a
+    len(endpoints)=1 fallback would silently initialize a 1-process world
+    (r5 fix)."""
+    script = tmp_path / "train.py"
+    script.write_text(_NPROC_CHILD)
+    out = open(tmp_path / "launcher.log", "wb")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "0",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd="/root/repo", stdout=out, stderr=out)
+    assert p.wait(timeout=240) == 0, \
+        (tmp_path / "launcher.log").read_text()[-1500:]
+    for r in (0, 1):
+        log = (tmp_path / "log" / f"workerlog.{r}").read_text()
+        assert "PSUM 3.0" in log, log[-800:]
