@@ -110,3 +110,40 @@ def test_tick_window_greedy_parity():
     exact = run(1)
     windowed = run(4)
     assert exact == windowed
+
+
+def test_tick_window_with_temperature_sampling():
+    """Sampling composes with the tick window: a temp>0 request inside a
+    windowed scan must produce valid ids that differ from greedy, while a
+    greedy slot in the SAME window still matches model.generate."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import GenerationServer
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=96,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(9)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(4)
+    p_greedy = rng.randint(1, 128, (8,)).tolist()
+    p_sample = rng.randint(1, 128, (8,)).tolist()
+    ref = np.asarray(model.generate(
+        paddle.to_tensor(np.asarray([p_greedy], np.int32)),
+        max_new_tokens=8).value)[0].tolist()
+
+    srv = GenerationServer(model, max_batch=2, max_len=96,
+                           prompt_buckets=(16,), tick_window=8)
+    rg = srv.submit(p_greedy, max_new_tokens=8)
+    rs = srv.submit(p_sample, max_new_tokens=8, temperature=1.0)
+    res = srv.run()
+    assert res[rg] == ref[:len(res[rg])]
+    toks = res[rs][len(p_sample):]
+    assert all(0 <= t < cfg.vocab_size for t in toks)
+    greedy_alt = np.asarray(model.generate(
+        paddle.to_tensor(np.asarray([p_sample], np.int32)),
+        max_new_tokens=8).value)[0].tolist()
+    assert res[rs] != greedy_alt
