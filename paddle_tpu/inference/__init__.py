@@ -252,4 +252,5 @@ def load_predictor(path: str) -> Predictor:
     return Predictor(fn, params, names, [])
 
 
+from .paged_cache import BlockAllocator  # noqa: E402,F401
 from .serving import GenerationServer  # noqa: E402,F401

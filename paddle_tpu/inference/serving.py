@@ -8,10 +8,27 @@ compiled decode step advances every active slot per tick (static shapes —
 compiled exactly once), and finished slots are freed and refilled mid-flight
 so throughput is never quantized by batch boundaries (continuous batching).
 
-Prefill runs per request at bucketed prompt lengths (one compile per
-bucket), producing cache rows that are scattered into the slot. The decode
-step uses the model's vector-position path (`LlamaAttention.decode` with
-``pos [B]``): every slot attends at its own depth.
+Two KV-cache backends share the slot machinery (``cache=`` ctor arg):
+
+- ``"dense"`` (the reference oracle): a ``2·L·(max_batch, max_len, KV, D)``
+  slab, one cache row span per slot. Prefill runs per request at bucketed
+  prompt lengths (one compile per bucket) and scatters into the slot.
+- ``"paged"``: a shared pool of fixed-size blocks + per-slot block tables
+  (ops/paged_attention.py, inference/paged_cache.py). HBM is proportional
+  to ACTIVE tokens instead of ``max_batch · max_len``; prompts stream
+  through ONE compiled fixed-chunk prefill program (chunked prefill — no
+  per-bucket compile family, no head-of-line blocking: each server step
+  advances one chunk per prefilling slot, then runs the decode tick for
+  the slots already decoding); full prompt blocks are content-hashed and
+  refcount-shared, so a repeated prefix (shared system prompt) prefills
+  once (prefix caching). Greedy outputs are token-exact vs the dense
+  server. See docs/serving.md.
+
+The decode step uses the model's vector-position path (``pos [B]``): every
+slot attends at its own depth. Sampling routes through
+``models/generation.py`` (``sample_token_rows`` in the compiled tick,
+``next_token`` for the prefill-produced first token) so per-request
+``temperature``/``top_k``/``top_p`` match ``model.generate`` semantics.
 """
 from __future__ import annotations
 
@@ -33,14 +50,20 @@ class _Request:
     prompt: List[int]
     max_new_tokens: int
     temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
     generated: List[int] = field(default_factory=list)
     done: bool = False
+    # paged-path state
+    table: List[int] = field(default_factory=list)   # block ids, in order
+    hashes: List[int] = field(default_factory=list)  # chain hash per full blk
+    pf_next: int = 0                                 # next prefill position
 
 
 class GenerationServer:
     """Continuous-batching decode server for a ``LlamaForCausalLM`` —
-    greedy by default, per-request temperature sampling via
-    ``submit(..., temperature=...)``.
+    greedy by default, per-request sampling via
+    ``submit(..., temperature=, top_k=, top_p=)``.
 
     Usage::
 
@@ -53,7 +76,9 @@ class GenerationServer:
     def __init__(self, model, max_batch: int = 4, max_len: int = 256,
                  prompt_buckets: Sequence[int] = (32, 64, 128),
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 tick_window: int = 1):
+                 tick_window: int = 1, cache: str = "dense",
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 32):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -61,18 +86,22 @@ class GenerationServer:
         amortizing the device→host sync: on a tunneled backend the
         round-trip dominates a decode tick by ~100×, and even on a local
         host it bounds tick-rate. The serving analogue of generate()'s
-        fully-compiled scan loop."""
+        fully-compiled scan loop.
+
+        ``cache="paged"``: block-table KV pool. ``block_size`` tokens per
+        block; ``num_blocks`` bounds total KV memory (default: dense
+        parity, ``max_batch·ceil(max_len/block_size)+1``); prompts prefill
+        in fixed ``prefill_chunk``-token chunks (rounded up to a block
+        multiple). ``prompt_buckets`` is ignored on the paged path."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
+        if cache not in ("dense", "paged"):
+            raise ValueError(f"cache must be 'dense' or 'paged', got {cache!r}")
         self.model = model
         self.cfg = cfg
+        self.cache_mode = cache
         self.max_batch = max_batch
         self.max_len = max_len
-        self.buckets = sorted(b for b in prompt_buckets if b <= max_len)
-        if not self.buckets:
-            raise ValueError(
-                f"no prompt bucket fits max_len={max_len} "
-                f"(prompt_buckets={tuple(prompt_buckets)})")
         self.eos = eos_token_id
         if tick_window < 1:
             raise ValueError(f"tick_window must be >= 1, got {tick_window}")
@@ -84,24 +113,64 @@ class GenerationServer:
         kv = cfg.num_key_value_heads
         d = cfg.hidden_size // cfg.num_attention_heads
         cdtype = convert_dtype(cfg.dtype)
-        self._caches = [jnp.zeros((max_batch, max_len, kv, d), cdtype)
-                        for _ in range(2 * cfg.num_hidden_layers)]
         # per-slot scalars live HOST-side (numpy): slot assignment would
         # otherwise cost one eager device dispatch per field per request —
         # each a full round trip on a tunneled backend
         self.pos = np.zeros((max_batch,), np.int32)
         self.tokens = np.zeros((max_batch,), np.int32)
         self.temps = np.zeros((max_batch,), np.float32)
+        self.topks = np.zeros((max_batch,), np.int32)
+        self.topps = np.zeros((max_batch,), np.float32)
         self._step_no = 0
         self._base_key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         self._queue: deque = deque()
         self._results: Dict[int, List[int]] = {}
         self._next_rid = 0
-        # donate the KV pool: XLA updates the caches in place instead of
-        # copying 2·L·(max_batch, max_len, KV, D) every decoded token
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
-        self._prefills: Dict[int, object] = {}  # bucket -> jitted fn
+
+        if cache == "dense":
+            self.buckets = sorted(b for b in prompt_buckets if b <= max_len)
+            if not self.buckets:
+                raise ValueError(
+                    f"no prompt bucket fits max_len={max_len} "
+                    f"(prompt_buckets={tuple(prompt_buckets)})")
+            self._caches = [jnp.zeros((max_batch, max_len, kv, d), cdtype)
+                            for _ in range(2 * cfg.num_hidden_layers)]
+            # donate the KV pool: XLA updates the caches in place instead of
+            # copying 2·L·(max_batch, max_len, KV, D) every decoded token
+            self._decode = jax.jit(self._decode_fn, donate_argnums=(2,))
+            self._prefills: Dict[int, object] = {}  # bucket -> jitted fn
+        else:
+            from .paged_cache import BlockAllocator
+
+            bs = int(block_size)
+            if bs < 1:
+                raise ValueError(f"block_size must be >= 1, got {block_size}")
+            self.block_size = bs
+            chunk = int(prefill_chunk)
+            if chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            self.prefill_chunk = -(-chunk // bs) * bs  # round up to blocks
+            entries = -(-max_len // bs)  # ceil: real table entries per slot
+            self._max_entries = entries
+            # slack entries (always 0 = scratch) so the chunk's table
+            # dynamic_slice never clamps and window-surplus decode writes
+            # past max_len land in scratch instead of a live block
+            self._table_width = entries + self.prefill_chunk // bs
+            if num_blocks is None:
+                num_blocks = max_batch * entries + 1  # dense parity + scratch
+            self.alloc = BlockAllocator(int(num_blocks), bs)
+            self._pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
+                           for _ in range(2 * cfg.num_hidden_layers)]
+            self._bt = np.zeros((max_batch, self._table_width), np.int32)
+            # True while the slot is streaming prompt chunks; None once the
+            # slot decodes (or is empty)
+            self._prefilling: List[Optional[bool]] = [None] * max_batch
+            self._decode_paged = jax.jit(self._decode_paged_fn,
+                                         donate_argnums=(2,))
+            self._chunk_prefill = jax.jit(self._chunk_prefill_fn,
+                                          donate_argnums=(2,))
 
     # ------------------------------------------------------------ compiled fns
     def _head(self, h):
@@ -112,13 +181,14 @@ class GenerationServer:
                             self.model.model.embed_tokens.weight)
         return self.model.lm_head(h)
 
-    def _decode_fn(self, params, tokens, flat_caches, pos, temps, active,
-                   key):
+    def _decode_fn(self, params, tokens, flat_caches, pos, temps, topks,
+                   topps, active, key):
         """``tick_window`` ticks as one compiled region: each tick advances
-        every slot by one token (per-slot temperature: temp == 0 → greedy
-        argmax; temp > 0 → categorical at that temperature). ``active``
-        masks position advance so idle slots don't drift their cache write
-        row. Returns the (k, B) token stack + final caches."""
+        every slot by one token (per-slot sampling via
+        ``generation.sample_token_rows``: temp == 0 → greedy argmax;
+        temp > 0 → categorical with that row's top-k/top-p filter).
+        ``active`` masks position advance so idle slots don't drift their
+        cache write row. Returns the (k, B) token stack + final caches."""
         model = self.model
 
         def one_tick(carry, k):
@@ -136,11 +206,10 @@ class GenerationServer:
             for ck, cv in new:
                 flat += [ck.value, cv.value]
             lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
-            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            sampled = jax.random.categorical(
-                jax.random.fold_in(key, k),
-                lg / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
-            nxt = jnp.where(temps > 0, sampled, greedy)
+            from ..models.generation import sample_token_rows
+
+            nxt = sample_token_rows(lg, jax.random.fold_in(key, k), temps,
+                                    topks, topps)
             return (nxt, flat, p + active), nxt
 
         if self.tick_window == 1:
@@ -151,10 +220,70 @@ class GenerationServer:
             jnp.arange(self.tick_window))
         return stack, flat
 
+    def _decode_paged_fn(self, params, tokens, flat_pools, tables, pos,
+                         temps, topks, topps, active, key):
+        """Paged twin of :meth:`_decode_fn`: K/V reads/writes go through
+        per-slot block tables into the shared pool. ``tables``: int32
+        (B, table_width) — the server zeroes rows of idle/prefilling slots
+        so their masked ticks write only the scratch block."""
+        model = self.model
+
+        def one_tick(carry, k):
+            toks, flat_p, p = carry
+            pools = [(Tensor(flat_p[2 * i]), Tensor(flat_p[2 * i + 1]))
+                     for i in range(self.cfg.num_hidden_layers)]
+
+            def call():
+                h, new = model.model.paged_decode_step(Tensor(toks[:, None]),
+                                                       pools, tables, p)
+                return self._head(h), new
+
+            logits, new = functional_call(model, params, call_fn=call)
+            flat = []
+            for kp, vp in new:
+                flat += [kp.value, vp.value]
+            lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
+            from ..models.generation import sample_token_rows
+
+            nxt = sample_token_rows(lg, jax.random.fold_in(key, k), temps,
+                                    topks, topps)
+            return (nxt, flat, p + active), nxt
+
+        if self.tick_window == 1:
+            (_, flat, _), stack = one_tick((tokens, flat_pools, pos), 0)
+            return stack[None], flat
+        (_, flat, _), stack = jax.lax.scan(
+            one_tick, (tokens, flat_pools, pos),
+            jnp.arange(self.tick_window))
+        return stack, flat
+
+    def _chunk_prefill_fn(self, params, chunk, flat_pools, table, start,
+                          last_idx):
+        """ONE compiled program for every prefill chunk of every prompt
+        length: chunk (1, C) right-padded; K/V scatter into the slot's
+        block table at block-aligned ``start``; returns fp32 logits at
+        local index ``last_idx`` (the last real prompt token on the final
+        chunk; ignored on earlier chunks) + updated pools."""
+        model = self.model
+        pools = [(Tensor(flat_pools[2 * i]), Tensor(flat_pools[2 * i + 1]))
+                 for i in range(self.cfg.num_hidden_layers)]
+
+        def call():
+            h, new = model.model.paged_prefill_chunk(Tensor(chunk), pools,
+                                                     table, start)
+            last = jax.lax.dynamic_slice_in_dim(h.value, last_idx, 1, 1)
+            return self._head(Tensor(last)), new
+
+        logits, new = functional_call(model, params, call_fn=call)
+        flat = []
+        for kp, vp in new:
+            flat += [kp.value, vp.value]
+        return logits.value[:, 0].astype(jnp.float32), flat
+
     def _prefill(self, bucket: int):
-        """Prefill + slot scatter as ONE jitted call (donated pool): the
-        per-layer eager `.at[slot].set` scatters cost 2·L dispatches per
-        request otherwise — each a tunnel round trip."""
+        """Dense-path prefill + slot scatter as ONE jitted call (donated
+        pool): the per-layer eager `.at[slot].set` scatters cost 2·L
+        dispatches per request otherwise — each a tunnel round trip."""
         if bucket not in self._prefills:
             model = self.model
 
@@ -188,18 +317,40 @@ class GenerationServer:
 
     # --------------------------------------------------------------- requests
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0) -> int:
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("prompt must contain at least one token id")
+        for t in prompt:
+            if isinstance(t, bool) or not isinstance(t, (int, np.integer)):
+                raise ValueError(
+                    f"prompt must be a sequence of int token ids, got "
+                    f"{type(t).__name__}: {t!r}")
+        prompt = [int(t) for t in prompt]
+        if isinstance(max_new_tokens, bool) or \
+                not isinstance(max_new_tokens, (int, np.integer)) or \
+                max_new_tokens <= 0:
+            raise ValueError(
+                f"max_new_tokens must be a positive int, got "
+                f"{max_new_tokens!r}")
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len={self.max_len}")
         if temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
-        self._bucket_for(len(prompt))  # validate against buckets up front
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if self.cache_mode == "dense":
+            self._bucket_for(len(prompt))  # validate against buckets up front
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, list(prompt), max_new_tokens,
-                                    temperature=float(temperature)))
+        self._queue.append(_Request(rid, prompt, int(max_new_tokens),
+                                    temperature=float(temperature),
+                                    top_k=int(top_k), top_p=float(top_p)))
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -208,6 +359,25 @@ class GenerationServer:
                 return b
         raise ValueError(f"prompt length {n} exceeds largest bucket "
                          f"{self.buckets[-1]}")
+
+    def _first_token(self, req: _Request, lg) -> int:
+        """Sample the first generated token from prefill logits (1, V) —
+        same ``next_token`` as model.generate, so temperature/top_k/top_p
+        semantics match; one host sync per assignment."""
+        from ..models.generation import next_token
+
+        key = jax.random.fold_in(self._base_key, (req.rid << 20) | 1)
+        nxt, _ = next_token(lg, key, req.temperature, req.top_k, req.top_p)
+        return int(nxt[0])
+
+    def _activate_slot(self, slot: int, req: _Request, first: int) -> None:
+        """Move a freshly-prefilled request into the decode phase."""
+        self.pos[slot] = len(req.prompt)
+        self.tokens[slot] = first
+        self.temps[slot] = req.temperature
+        self.topks[slot] = req.top_k
+        self.topps[slot] = req.top_p
+        req.generated.append(first)
 
     def _assign(self, slot: int, req: _Request) -> None:
         n = len(req.prompt)
@@ -220,48 +390,129 @@ class GenerationServer:
         # BEFORE the attention mask (arange <= pos) can reach it.
         lg, self._caches = self._prefill(bucket)(
             self.params, jnp.asarray(prompt), n, self._caches, slot)
-        # the FIRST generated token honors the request temperature too;
-        # sample/argmax on the still-on-device logits so each assignment
-        # costs exactly ONE host sync
-        if req.temperature > 0:
-            k = jax.random.fold_in(self._base_key, (req.rid << 20) | 1)
-            first = int(jax.random.categorical(
-                k, lg / max(req.temperature, 1e-6))[0])
-        else:
-            first = int(jnp.argmax(lg, axis=-1)[0])
-        self.pos[slot] = n
-        self.tokens[slot] = first
-        self.temps[slot] = req.temperature
-        req.generated.append(first)
+        self._activate_slot(slot, req, self._first_token(req, lg))
         self._slots[slot] = req
 
     def _fill_free_slots(self) -> None:
         for s in range(self.max_batch):
             if self._slots[s] is None and self._queue:
-                self._assign(s, self._queue.popleft())
+                req = self._queue.popleft()
+                if self.cache_mode == "paged":
+                    self._admit_paged(s, req)
+                else:
+                    self._assign(s, req)
 
-    def step(self) -> int:
-        """One decode window (``tick_window`` ticks) across all occupied
-        slots; returns #active."""
+    # ---------------------------------------------------------- paged path
+    def _admit_paged(self, slot: int, req: _Request) -> None:
+        """Claim a slot: reuse cached prefix blocks (prefix caching — the
+        matched span skips prefill entirely) and start chunked prefill at
+        the first uncached block boundary."""
+        req.table = self.alloc.match_prefix(req.prompt)
+        req.hashes = self.alloc.chain_hashes(req.prompt)
+        req.pf_next = len(req.table) * self.block_size
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(req.table)] = req.table
+        self._prefilling[slot] = True
+        self._slots[slot] = req
+
+    def _ensure_blocks(self, slot: int, entries: int) -> None:
+        """Grow the slot's block table to >= ``entries`` real entries
+        (capped at ceil(max_len/block_size); writes past that land in
+        scratch by construction)."""
+        req = self._slots[slot]
+        entries = min(entries, self._max_entries)
+        while len(req.table) < entries:
+            bid = self.alloc.alloc()
+            req.table.append(bid)
+            self._bt[slot, len(req.table) - 1] = bid
+
+    def _prefill_chunk_step(self, slot: int) -> None:
+        """Advance one prompt chunk for a prefilling slot; on the final
+        chunk, sample the first token and flip the slot to decoding."""
+        req = self._slots[slot]
+        n = len(req.prompt)
+        bs = self.block_size
+        C = self.prefill_chunk
+        start = req.pf_next
+        end = min(start + C, n)
+        self._ensure_blocks(slot, -(-end // bs))
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :end - start] = req.prompt[start:end]
+        last_idx = (n - 1 - start) if end == n else 0
+        lg, self._pools = self._chunk_prefill(
+            self.params, jnp.asarray(chunk), self._pools,
+            jnp.asarray(self._bt[slot]), jnp.int32(start),
+            jnp.int32(last_idx))
+        # publish the prompt blocks this chunk completed for prefix reuse
+        for i in range(start // bs, end // bs):
+            self.alloc.register(req.table[i], req.hashes[i])
+        req.pf_next = start + C
+        if end == n:
+            self._activate_slot(slot, req, self._first_token(req, lg))
+            self._prefilling[slot] = None
+
+    def _step_paged(self) -> int:
         self._fill_free_slots()
+        # chunked prefill interleaves with decode: ONE chunk per prefilling
+        # slot per step, so a long prompt never blocks slots mid-decode
+        # (no head-of-line blocking) and short requests keep streaming out
+        for s in range(self.max_batch):
+            if self._slots[s] is not None and self._prefilling[s]:
+                self._prefill_chunk_step(s)
         active = [s for s in range(self.max_batch)
-                  if self._slots[s] is not None]
-        if not active:
-            return 0
-        self._step_no += 1
-        key = jax.random.fold_in(self._base_key, self._step_no)
-        active_mask = np.zeros((self.max_batch,), np.int32)
-        active_mask[active] = 1
-        # only occupied slots advance — idle slots must not drift their
-        # write position (their garbage scatters would eventually go OOB)
-        stack, self._caches = self._decode(
-            self.params, jnp.asarray(self.tokens), self._caches,
-            jnp.asarray(self.pos), jnp.asarray(self.temps),
-            jnp.asarray(active_mask), key)
-        k = self.tick_window
-        nxt_host = np.asarray(stack)          # (k, B)
+                  if self._slots[s] is not None and not self._prefilling[s]]
+        if active:
+            self._step_no += 1
+            key = jax.random.fold_in(self._base_key, self._step_no)
+            k = self.tick_window
+            for s in active:
+                self._ensure_blocks(s, -(-(int(self.pos[s]) + k) //
+                                         self.block_size))
+            active_mask = np.zeros((self.max_batch,), np.int32)
+            active_mask[active] = 1
+            # idle/prefilling rows run masked: zeroed table + pos 0 routes
+            # their (discarded) cache writes to the scratch block
+            bt = np.where(active_mask[:, None] > 0, self._bt, 0)
+            posv = self.pos * active_mask
+            stack, self._pools = self._decode_paged(
+                self.params, jnp.asarray(self.tokens), self._pools,
+                jnp.asarray(bt), jnp.asarray(posv), jnp.asarray(self.temps),
+                jnp.asarray(self.topks), jnp.asarray(self.topps),
+                jnp.asarray(active_mask), key)
+            self._harvest_window(np.asarray(stack), active, active_mask)
+        return sum(sl is not None for sl in self._slots) + len(self._queue)
+
+    def _release_slot(self, slot: int) -> None:
+        req = self._slots[slot]
+        self._slots[slot] = None
+        if self.cache_mode == "paged":
+            for bid in req.table:
+                self.alloc.free(bid)
+            req.table = []
+            self._bt[slot, :] = 0
+            self._prefilling[slot] = None
+            self.pos[slot] = 0
+            self.tokens[slot] = 0
+            self.temps[slot] = 0.0
+            self.topks[slot] = 0
+            self.topps[slot] = 0.0
+
+    def kv_stats(self) -> Dict[str, int]:
+        """Paged-pool occupancy/prefix-cache counters (empty for dense)."""
+        if self.cache_mode != "paged":
+            return {}
+        return self.alloc.stats()
+
+    # ------------------------------------------------------------- stepping
+    def _harvest_window(self, nxt_host, active, active_mask) -> None:
+        """Fold one decode window's (k, B) token stack into the per-request
+        state: append tokens, detect eos/max-new/max-len completion (window
+        surplus past completion is discarded — tick_window semantics) and
+        free finished slots for next window's refill."""
+        k = nxt_host.shape[0]
         self.pos = self.pos + active_mask * k
-        self.tokens = nxt_host[-1].copy()
+        self.tokens = np.where(active_mask > 0, nxt_host[-1],
+                               self.tokens).astype(np.int32)
         pos_after = self.pos
         for s in active:
             req = self._slots[s]
@@ -279,11 +530,34 @@ class GenerationServer:
                     done = True
                     break
             if done:
-                # window surplus past completion is discarded (tick_window
-                # semantics); the slot frees for next window's refill
                 self._results[req.rid] = req.prompt + req.generated[
                     :req.max_new_tokens]
-                self._slots[s] = None
+                self._release_slot(s)
+
+    def step(self) -> int:
+        """One server step: admit queued requests, advance one prefill
+        chunk per prefilling slot (paged), then one decode window
+        (``tick_window`` ticks) across decoding slots; returns #remaining
+        (occupied slots + queued)."""
+        if self.cache_mode == "paged":
+            return self._step_paged()
+        self._fill_free_slots()
+        active = [s for s in range(self.max_batch)
+                  if self._slots[s] is not None]
+        if not active:
+            return 0
+        self._step_no += 1
+        key = jax.random.fold_in(self._base_key, self._step_no)
+        active_mask = np.zeros((self.max_batch,), np.int32)
+        active_mask[active] = 1
+        # only occupied slots advance — idle slots must not drift their
+        # write position (their garbage scatters would eventually go OOB)
+        stack, self._caches = self._decode(
+            self.params, jnp.asarray(self.tokens), self._caches,
+            jnp.asarray(self.pos), jnp.asarray(self.temps),
+            jnp.asarray(self.topks), jnp.asarray(self.topps),
+            jnp.asarray(active_mask), key)
+        self._harvest_window(np.asarray(stack), active, active_mask)
         return sum(sl is not None for sl in self._slots) + len(self._queue)
 
     def run(self) -> Dict[int, List[int]]:

@@ -43,6 +43,36 @@ def next_token(logits, rng, temperature: float, top_k: int,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
 
 
+def sample_token_rows(logits, key, temps, top_ks, top_ps):
+    """Per-ROW ``next_token`` for the serving decode tick: row ``i`` uses
+    ``temps[i]`` (0 → greedy argmax), ``top_ks[i]`` (0 → off) and
+    ``top_ps[i]`` (0 → off) — the same filtering math as :func:`next_token`
+    (top-k cutoff at the k-th largest, then nucleus over the filtered
+    distribution), vectorized so one compiled tick can mix greedy and
+    sampled slots. ``logits``: (B, V); temps/top_ps float32 [B], top_ks
+    int32 [B]; ``key`` is consumed directly (the server folds a fresh key
+    per tick)."""
+    lg = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    V = lg.shape[-1]
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]            # descending
+    kidx = jnp.clip(top_ks - 1, 0, V - 1)
+    kth = jnp.take_along_axis(srt, kidx[:, None], axis=-1)
+    lg = jnp.where((top_ks > 0)[:, None] & (lg < kth), -1e30, lg)
+    # nucleus over the top-k-FILTERED logits (next_token ordering)
+    srt = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cdf = jnp.cumsum(probs, axis=-1)
+    keep = jnp.concatenate(
+        [jnp.ones((lg.shape[0], 1), bool), cdf[:, :-1] < top_ps[:, None]],
+        axis=-1)
+    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[:, None]
+    nucleus = ((top_ps > 0) & (top_ps < 1))[:, None]
+    lg = jnp.where(nucleus & (lg < cutoff), -1e30, lg)
+    sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 def advance_tokens(toks, done, nxt, t, prompt_len: int, total_len: int,
                    eos_token_id: Optional[int]):
     """Write the step-t output token into the buffer: within the prompt the

@@ -129,6 +129,19 @@ def _apply_rope_rows(x, cos, sin, pos):
     return _rope_rotate(x, c, s)
 
 
+def _apply_rope_chunk(x, cos, sin, start):
+    """x: (B, C, H, D) at positions ``start + arange(C)`` with traced
+    ``start`` (chunked prefill). Per-row gather with edge-clamp instead of
+    a dynamic_slice: a padded final chunk may overrun the rope table, and
+    dynamic_slice would CLAMP the start down, mis-rotating the real
+    positions — clamped rows here are only ever discarded padding."""
+    S = x.shape[1]
+    idx = jnp.clip(start + jnp.arange(S), 0, cos.shape[0] - 1)
+    c = jnp.take(cos, idx, axis=0)[None, :, None, :]
+    s = jnp.take(sin, idx, axis=0)[None, :, None, :]
+    return _rope_rotate(x, c, s)
+
+
 def _apply_rope(x, cos, sin, pos_offset=0):
     """x: (B, S, H, D); rotate pairs (x[..., :D/2], x[..., D/2:])."""
     S = x.shape[1]
@@ -490,6 +503,60 @@ class LlamaAttention(Layer):
         out = reshape(out, [B, 1, H * D])
         return self.o_proj(out), ck, cv
 
+    def paged_decode(self, x, cos, sin, kp, vp, block_tables, pos):
+        """Single-token decode against the PAGED pool: K/V of the new token
+        scatter through the block table at ``pos``; attention gathers
+        context by table (ops/paged_attention.py). kp/vp: Tensors
+        (num_blocks, bs, KV, D); block_tables: traced int32 (B, M); pos:
+        traced int32 [B]. Numerically mirrors the dense vector-pos
+        ``decode`` so paged/dense greedy outputs agree token-exactly."""
+        B = x.shape[0]
+        H, D = self.num_heads, self.head_dim
+        q, k, v = self._qkv(x, B, 1)
+
+        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+            from ..ops.paged_attention import (paged_decode_attention,
+                                               write_decode_kv)
+
+            qr = _apply_rope_rows(qv, cosv, sinv, pos)
+            kr = _apply_rope_rows(kv, cosv, sinv, pos)
+            kpv, vpv = write_decode_kv(kpv, vpv, kr[:, 0], vv[:, 0],
+                                       block_tables, pos)
+            out = paged_decode_attention(qr, kpv, vpv, block_tables, pos)
+            return out, kpv, vpv
+
+        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
+                               op_name="paged_decode_attention")
+        out = reshape(out, [B, 1, H * D])
+        return self.o_proj(out), kp, vp
+
+    def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
+        """One fixed-size prefill CHUNK through the paged pool: queries sit
+        at positions ``start + arange(C)`` (``start`` traced, block-aligned,
+        C a multiple of the block size), their K/V scatter into consecutive
+        table entries, and attention runs against ALL paged context written
+        so far (earlier chunks + shared prefix blocks) with a causal mask.
+        x: (1, C, hidden); block_table: traced int32 (M,)."""
+        B, S = x.shape[0], x.shape[1]
+        H, D = self.num_heads, self.head_dim
+        q, k, v = self._qkv(x, B, S)
+
+        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+            from ..ops.paged_attention import (paged_prefill_attention,
+                                               write_chunk_kv)
+
+            qr = _apply_rope_chunk(qv, cosv, sinv, start)
+            kr = _apply_rope_chunk(kv, cosv, sinv, start)
+            kpv, vpv = write_chunk_kv(kpv, vpv, kr[0], vv[0], block_table,
+                                      start)
+            out = paged_prefill_attention(qr, kpv, vpv, block_table, start)
+            return out, kpv, vpv
+
+        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
+                               op_name="paged_prefill_attention")
+        out = reshape(out, [B, S, H * D])
+        return self.o_proj(out), kp, vp
+
 
 class LlamaMLP(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -599,6 +666,20 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, ck, cv
 
+    def paged_decode(self, x, cos, sin, kp, vp, block_tables, pos):
+        a, kp, vp = self.self_attn.paged_decode(self.input_layernorm(x), cos,
+                                                sin, kp, vp, block_tables, pos)
+        h = x + a
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, kp, vp
+
+    def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
+        a, kp, vp = self.self_attn.paged_prefill_chunk(
+            self.input_layernorm(x), cos, sin, kp, vp, block_table, start)
+        h = x + a
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, kp, vp
+
 
 class LlamaModel(Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -664,6 +745,34 @@ class LlamaModel(Layer):
         for layer, (ck, cv) in zip(self.layers, caches):
             x, ck, cv = layer.prefill(x, self._cos, self._sin, ck, cv)
             new.append((ck, cv))
+        return self.norm(x), new
+
+    def paged_decode_step(self, token, pools, block_tables, pos):
+        """Paged continuous-batching decode: like :meth:`decode_step` but
+        K/V read/write goes through per-row block tables into the shared
+        block pool. token: Tensor (B, 1); pools: list of (kp, vp) Tensors
+        (num_blocks, bs, KV, D) per layer; block_tables: traced int32
+        (B, M); pos: traced int32 [B]."""
+        x = self.embed_tokens(token)
+        new = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            x, kp, vp = layer.paged_decode(x, self._cos, self._sin, kp, vp,
+                                           block_tables, pos)
+            new.append((kp, vp))
+        return self.norm(x), new
+
+    def paged_prefill_chunk(self, input_ids, pools, block_table, start):
+        """Stream ONE prompt chunk into the paged pool (chunked prefill:
+        the same compiled program serves every chunk of every prompt
+        length — no per-bucket compile family). input_ids: Tensor (1, C);
+        start: traced int32 block-aligned chunk origin. Returns (normed
+        hidden for the chunk, new pools)."""
+        x = self.embed_tokens(input_ids)
+        new = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            x, kp, vp = layer.paged_prefill_chunk(x, self._cos, self._sin,
+                                                  kp, vp, block_table, start)
+            new.append((kp, vp))
         return self.norm(x), new
 
     def _should_recompute(self):

@@ -4,6 +4,10 @@ fused_multi_transformer_op.cu, fmha_ref.h) and hand-written PHI GPU kernels.
 """
 from .flash_attention import flash_attention, flash_attention_bshd
 from .fused_norm import fused_rms_norm, fused_layer_norm
+from .paged_attention import (gather_block_kv, paged_decode_attention,
+                              paged_prefill_attention, write_chunk_kv,
+                              write_decode_kv)
 
 __all__ = ["flash_attention", "flash_attention_bshd", "fused_rms_norm",
-           "fused_layer_norm"]
+           "fused_layer_norm", "gather_block_kv", "paged_decode_attention",
+           "paged_prefill_attention", "write_chunk_kv", "write_decode_kv"]

@@ -1,0 +1,139 @@
+"""Paged KV-cache attention — block-table decode + chunked-prefill ops.
+
+The serving KV cache stops being a dense ``(max_batch, max_len, KV, D)``
+slab and becomes a POOL of fixed-size blocks ``(num_blocks, block_size,
+KV, D)`` plus per-request block tables (int32 rows of block ids). HBM is
+then proportional to *active* tokens, not to ``max_batch · max_len``
+(vLLM's PagedAttention, adapted to the XLA/TPU constraints: static shapes
+everywhere, tables are data not shapes).
+
+Layout is chosen Pallas-ready, mirroring the flash kernels in
+``flash_attention.py``:
+
+- pools are BLOCK-MAJOR ``(N, bs, KV, D)`` so one block's K (or V) is a
+  contiguous ``(bs, KV, D)`` tile — exactly the unit a Mosaic kernel
+  streams through VMEM;
+- block tables are small int32 operands — on TPU they become
+  ``PrefetchScalarGridSpec`` scalar-prefetch args feeding the K/V
+  BlockSpec ``index_map`` (the kernel grid walks ``table[i]`` instead of
+  ``i``, which is the whole trick of paged attention);
+- the decode gather and the chunk scatter below are the pure-jnp
+  REFERENCE path: CPU tier-1 runs it, and a future Pallas kernel must
+  match it bit-for-bit on the masked region.
+
+All masks/softmax run in fp32 with the same ``-1e30`` fill as the dense
+decode path (``models/llama.py LlamaAttention.decode``) so greedy outputs
+stay token-exact between dense and paged servers.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gather_block_kv(pool, block_tables):
+    """Gather per-row K (or V) context from the block pool.
+
+    pool: (N, bs, KV, D); block_tables: int32 (B, M) (or (M,) for one
+    row). Returns (B, M*bs, KV, D) — the dense-equivalent context window,
+    where table entry 0 conventionally points at the scratch block and is
+    masked out by the caller's position mask.
+    """
+    bt = block_tables if block_tables.ndim == 2 else block_tables[None]
+    gathered = pool[bt]                       # (B, M, bs, KV, D)
+    b, m, bs = gathered.shape[:3]
+    return gathered.reshape(b, m * bs, *pool.shape[2:])
+
+
+def write_decode_kv(k_pool, v_pool, k, v, block_tables, pos):
+    """Scatter ONE new token's K/V per row through the block table.
+
+    k/v: (B, KV, D); block_tables: (B, M); pos: int32 (B,) — token
+    position of each row. Writes land at ``(table[b, pos//bs], pos%bs)``.
+    Rows the server parked on the scratch block (idle/prefilling slots)
+    harmlessly overwrite scratch.
+    """
+    bs = k_pool.shape[1]
+    rows = jnp.arange(block_tables.shape[0])
+    bid = block_tables[rows, pos // bs]
+    off = pos % bs
+    k_pool = k_pool.at[bid, off].set(k.astype(k_pool.dtype))
+    v_pool = v_pool.at[bid, off].set(v.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def write_chunk_kv(k_pool, v_pool, k, v, block_table, start):
+    """Scatter a prefill CHUNK's K/V into consecutive table entries.
+
+    k/v: (C, KV, D) with C a multiple of ``bs``; block_table: (M,);
+    start: traced int32, block-aligned chunk origin. The chunk occupies
+    table entries [start//bs, start//bs + C//bs) — a dynamic_slice of the
+    table, then one blocked scatter (the Pallas version would walk the
+    same slice as scalar-prefetch grid indices).
+    """
+    bs = k_pool.shape[1]
+    nb = k.shape[0] // bs
+    blocks = jax.lax.dynamic_slice_in_dim(block_table, start // bs, nb, 0)
+    k_pool = k_pool.at[blocks].set(
+        k.reshape(nb, bs, *k.shape[1:]).astype(k_pool.dtype))
+    v_pool = v_pool.at[blocks].set(
+        v.reshape(nb, bs, *v.shape[1:]).astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, pos):
+    """Single-token decode attention through block tables (GQA-native).
+
+    q: (B, 1, H, D) rope'd queries; pools: (N, bs, KV, D); block_tables:
+    (B, M); pos: int32 (B,) current position per row (the new token's K/V
+    must already be written at ``pos``). Attends over positions
+    ``<= pos[b]`` of the gathered context. Pure-jnp reference — same
+    grouped einsum as the dense ``LlamaAttention.decode`` vector-pos path
+    so the two servers agree token-exactly.
+    """
+    B, _, H, D = q.shape
+    KV = k_pool.shape[2]
+    rep = H // KV
+    ck = gather_block_kv(k_pool, block_tables)    # (B, L, KV, D)
+    cv = gather_block_kv(v_pool, block_tables)
+    L = ck.shape[1]
+    qg = q.reshape(B, 1, KV, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck).astype(
+        jnp.float32) / math.sqrt(D)
+    mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, -1).astype(q.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
+    return out.reshape(B, 1, H, D)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, block_table, start):
+    """Chunked-prefill attention: one chunk of queries against ALL paged
+    context written so far (earlier chunks + shared prefix blocks) plus
+    the causal part of the chunk itself.
+
+    q: (1, C, H, D) rope'd queries at positions ``start + arange(C)``;
+    block_table: (M,) single request row; the chunk's K/V must already be
+    scattered into the pool (``write_chunk_kv``). Key positions beyond a
+    query's position are masked, so right-pad garbage in the final chunk
+    and unallocated (scratch) table entries never reach a real query.
+    """
+    B, C, H, D = q.shape
+    KV = k_pool.shape[2]
+    rep = H // KV
+    ck = gather_block_kv(k_pool, block_table)     # (1, L, KV, D)
+    cv = gather_block_kv(v_pool, block_table)
+    L = ck.shape[1]
+    qg = q.reshape(B, C, KV, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck).astype(
+        jnp.float32) / math.sqrt(D)
+    qpos = start + jnp.arange(C)                  # (C,)
+    mask = (jnp.arange(L)[None, :] <= qpos[:, None])[None, None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, -1).astype(q.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
+    return out.reshape(B, C, H, D)

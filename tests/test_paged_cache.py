@@ -1,0 +1,104 @@
+"""BlockAllocator unit tests (inference/paged_cache.py): free-list
+round-trip, refcount sharing, chained prefix matching with the last-token
+rule, LRU retention/eviction, and occupancy stats."""
+import pytest
+
+from paddle_tpu.inference.paged_cache import SCRATCH_BLOCK, BlockAllocator
+
+
+def test_alloc_free_roundtrip():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    ids = [a.alloc() for _ in range(4)]
+    assert len(set(ids)) == 4
+    assert SCRATCH_BLOCK not in ids          # block 0 is reserved scratch
+    assert a.blocks_in_use == 4 and a.blocks_free == 0
+    for bid in ids:
+        a.free(bid)
+    assert a.blocks_in_use == 0 and a.blocks_free == 4
+    # freed private blocks (no hash) recirculate
+    again = [a.alloc() for _ in range(4)]
+    assert set(again) == set(ids)
+
+
+def test_exhaustion_raises():
+    a = BlockAllocator(num_blocks=3, block_size=4)
+    a.alloc(), a.alloc()
+    with pytest.raises(RuntimeError, match="exhausted"):
+        a.alloc()
+
+
+def test_refcount_sharing():
+    a = BlockAllocator(num_blocks=4, block_size=2)
+    bid = a.alloc()
+    a.register(bid, chain_hash=123)
+    a.ref(bid)                               # second request shares it
+    a.free(bid)
+    assert a.blocks_in_use == 1              # still held by the first user
+    a.free(bid)
+    assert a.blocks_in_use == 0
+    assert a.blocks_cached == 1              # hashed block is RETAINED
+    a.ref(bid)                               # revived from the cache
+    assert a.blocks_in_use == 1 and a.blocks_cached == 0
+
+
+def test_prefix_match_chained_and_last_token_rule():
+    bs = 4
+    a = BlockAllocator(num_blocks=8, block_size=bs)
+    prompt = list(range(10, 10 + 3 * bs))    # exactly 3 full blocks
+    hashes = a.chain_hashes(prompt)
+    assert len(hashes) == 3
+    blocks = [a.alloc() for _ in range(3)]
+    for bid, h in zip(blocks, hashes):
+        a.register(bid, h)
+    for bid in blocks:
+        a.free(bid)                          # all cached now
+
+    # same prompt + tail: every full block matches, capped at (n-1)//bs
+    hit = a.match_prefix(prompt + [7])       # n=13 -> cap 3
+    assert hit == blocks
+    for bid in hit:
+        a.free(bid)
+    # exact multiple: n=12 -> cap (12-1)//4 = 2 — the last block must be
+    # recomputed so its final-token logits exist (last-token rule)
+    hit = a.match_prefix(prompt)
+    assert hit == blocks[:2]
+    for bid in hit:
+        a.free(bid)
+    # divergence in the second block stops the chain after block 0
+    div = list(prompt)
+    div[bs + 1] += 1
+    hit = a.match_prefix(div + [7])
+    assert hit == blocks[:1]
+    for bid in hit:
+        a.free(bid)
+    assert a.prefix_hit_blocks == 3 + 2 + 1
+
+
+def test_lru_eviction_prefers_free_then_oldest():
+    bs = 2
+    a = BlockAllocator(num_blocks=4, block_size=bs)   # 3 usable
+    b1, b2 = a.alloc(), a.alloc()
+    a.register(b1, 111)
+    a.register(b2, 222)
+    a.free(b1)
+    a.free(b2)                               # cached in age order b1, b2
+    b3 = a.alloc()                           # free list still has one
+    assert b3 not in (b1, b2)
+    b4 = a.alloc()                           # must evict OLDEST cached = b1
+    assert b4 == b1 and a.evictions == 1
+    assert a.match_prefix([1] * 100) == []   # b1's hash is gone
+    # b2 still matchable
+    a.ref(b2)
+    assert a.blocks_in_use == 3
+
+
+def test_stats_and_peak():
+    a = BlockAllocator(num_blocks=6, block_size=4)
+    ids = [a.alloc() for _ in range(4)]
+    for bid in ids[:3]:
+        a.free(bid)
+    s = a.stats()
+    assert s["peak_blocks_in_use"] == 4
+    assert s["blocks_in_use"] == 1
+    assert s["fresh_allocs"] == 4
+    assert s["num_blocks"] == 6 and s["block_size"] == 4

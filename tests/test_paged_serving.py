@@ -1,0 +1,213 @@
+"""Paged KV-cache serving (cache='paged'): block-table decode + chunked
+prefill + prefix caching must be TOKEN-EXACT vs the dense server (the
+reference oracle) and vs compiled model.generate, under slot churn. Quick
+tier on CPU — this is tier-1's coverage of the paged serving path."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def test_paged_matches_dense_and_generate_under_churn():
+    """6 requests through 2 slots: greedy paged output must equal both the
+    dense server's and model.generate's, with mid-flight slot refill and
+    multi-chunk prefill (prompt 20 > chunk 8)."""
+    model, cfg = _model()
+    rng = np.random.RandomState(0)
+    # repeated lengths keep the generate-compile count down
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 12, 7, 3, 12, 20)]
+    refs = []
+    for p in prompts:
+        out = model.generate(paddle.to_tensor(np.asarray([p], np.int32)),
+                             max_new_tokens=8)
+        refs.append(np.asarray(out.value)[0].tolist())
+
+    dense = GenerationServer(model, max_batch=2, max_len=64,
+                             prompt_buckets=(32,))
+    rd = [dense.submit(p, max_new_tokens=8) for p in prompts]
+    outd = dense.run()
+    paged = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                             block_size=4, prefill_chunk=8)
+    rp = [paged.submit(p, max_new_tokens=8) for p in prompts]
+    outp = paged.run()
+    for i, (a, b) in enumerate(zip(rd, rp)):
+        assert outp[b] == refs[i], f"paged != generate for request {i}"
+        assert outp[b] == outd[a], f"paged != dense for request {i}"
+    # every block was released on completion
+    assert paged.kv_stats()["blocks_in_use"] == 0
+
+
+def test_prefix_cache_hit_allocates_no_new_prompt_blocks():
+    """Second request with the same prompt must reuse every FULL prompt
+    block (prefix caching): fresh allocations cover only the tail block
+    (last-token rule) + decode blocks."""
+    model, cfg = _model()
+    rng = np.random.RandomState(1)
+    bs, max_new = 4, 5
+    prompt = rng.randint(1, cfg.vocab_size, 9).tolist()  # 2 full blocks + 1
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=bs, prefill_chunk=8)
+    r1 = srv.submit(prompt, max_new_tokens=max_new)
+    out1 = srv.run()
+    s1 = srv.kv_stats()
+    r2 = srv.submit(prompt, max_new_tokens=max_new)
+    out2 = srv.run()
+    s2 = srv.kv_stats()
+    assert out1[r1] == out2[r2]              # cached K/V is bit-identical
+    full_prompt_blocks = (len(prompt) - 1) // bs
+    assert s2["prefix_hit_blocks"] - s1["prefix_hit_blocks"] == \
+        full_prompt_blocks
+    # total entries a request needs minus the reused prefix = its fresh ones
+    total_entries = -(-(len(prompt) + max_new) // bs)
+    assert s2["fresh_allocs"] - s1["fresh_allocs"] == \
+        total_entries - full_prompt_blocks
+    assert s2["fresh_allocs"] - s1["fresh_allocs"] < s1["fresh_allocs"]
+
+
+def test_tick_window_eos_lag_paged():
+    """tick_window > 1 on the paged path: eos detection lags inside the
+    window but the surplus is discarded — outputs must be IDENTICAL to the
+    exact per-token paged server, truncated at eos."""
+    model, cfg = _model()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, cfg.vocab_size, n).tolist() for n in (5, 17, 33)]
+
+    def run(window, eos=None):
+        srv = GenerationServer(model, max_batch=2, max_len=160, cache="paged",
+                               block_size=4, prefill_chunk=16,
+                               tick_window=window, eos_token_id=eos)
+        rids = [srv.submit(p, max_new_tokens=9) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    exact = run(1)
+    assert exact == run(4)                   # greedy window parity, no eos
+    eos = exact[0][len(prompts[0]) + 3]      # appears mid-generation
+    with_eos = run(1, eos=eos)
+    assert with_eos == run(4, eos=eos)       # eos-lag surplus discarded
+    assert len(with_eos[0]) < len(exact[0])  # eos actually truncated
+
+
+def test_sampling_params_route_through_next_token():
+    """submit(..., top_k=, top_p=) reaches the compiled tick: a greedy slot
+    sharing the window with a filtered-sampling slot still matches
+    model.generate, and the sampled tokens are valid ids."""
+    model, cfg = _model()
+    rng = np.random.RandomState(3)
+    p_greedy = rng.randint(1, cfg.vocab_size, 6).tolist()
+    p_sample = rng.randint(1, cfg.vocab_size, 6).tolist()
+    ref = np.asarray(model.generate(
+        paddle.to_tensor(np.asarray([p_greedy], np.int32)),
+        max_new_tokens=6).value)[0].tolist()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8)
+    rg = srv.submit(p_greedy, max_new_tokens=6)
+    rs = srv.submit(p_sample, max_new_tokens=6, temperature=1.0, top_k=8,
+                    top_p=0.9)
+    res = srv.run()
+    assert res[rg] == ref
+    toks = res[rs][len(p_sample):]
+    assert all(0 <= t < cfg.vocab_size for t in toks)
+
+
+def test_sample_token_rows_matches_next_token_filters():
+    """The vectorized per-row sampler (models/generation.py) must apply the
+    same top-k/top-p support as next_token's scalar filters and reduce to
+    argmax at temperature 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.generation import sample_token_rows
+
+    rng = np.random.RandomState(4)
+    logits = rng.randn(12).astype(np.float32) * 2
+
+    def allowed(temp, k, p):
+        lg = logits.astype(np.float64) / temp
+        if k > 0:
+            kth = np.sort(lg)[-k]
+            lg = np.where(lg < kth, -1e30, lg)
+        if 0 < p < 1:
+            srt = np.sort(lg)[::-1]
+            probs = np.exp(srt - srt.max())
+            probs /= probs.sum()
+            cdf = np.cumsum(probs)
+            keep = np.concatenate([[True], cdf[:-1] < p])
+            lg = np.where(lg < srt[keep].min(), -1e30, lg)
+        return set(np.nonzero(lg > -1e29)[0].tolist())
+
+    n = 64
+    lg = jnp.asarray(np.tile(logits, (n, 1)))
+    for k, p in [(3, 0.0), (0, 0.5), (4, 0.6)]:
+        draws = sample_token_rows(
+            lg, jax.random.PRNGKey(0), jnp.full((n,), 1.0, jnp.float32),
+            jnp.full((n,), k, jnp.int32), jnp.full((n,), p, jnp.float32))
+        assert set(np.asarray(draws).tolist()) <= allowed(1.0, k, p), (k, p)
+    # temperature 0 → argmax regardless of filters
+    greedy = sample_token_rows(
+        lg[:2], jax.random.PRNGKey(1), jnp.zeros((2,), jnp.float32),
+        jnp.asarray([3, 0], jnp.int32), jnp.asarray([0.5, 0.0], jnp.float32))
+    assert np.asarray(greedy).tolist() == [int(np.argmax(logits))] * 2
+
+
+@pytest.mark.parametrize("cache", ["dense", "paged"])
+def test_submit_validation(cache):
+    model, cfg = _model()
+    kw = dict(cache="paged", block_size=4) if cache == "paged" else \
+        dict(prompt_buckets=(16,))
+    srv = GenerationServer(model, max_batch=2, max_len=64, **kw)
+    with pytest.raises(ValueError, match="at least one token"):
+        srv.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="positive int"):
+        srv.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError, match="positive int"):
+        srv.submit([1, 2], max_new_tokens=-3)
+    with pytest.raises(ValueError, match="int token ids"):
+        srv.submit([1.5, 2], max_new_tokens=4)
+    with pytest.raises(ValueError, match="int token ids"):
+        srv.submit(["a", 2], max_new_tokens=4)
+    with pytest.raises(ValueError, match="top_k"):
+        srv.submit([1, 2], max_new_tokens=4, top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        srv.submit([1, 2], max_new_tokens=4, top_p=1.5)
+    # numpy ints (tokenizer output) are fine
+    rid = srv.submit(np.asarray([3, 4, 5], np.int64), max_new_tokens=2)
+    out = srv.run()
+    assert len(out[rid]) == 5
+
+
+def test_serving_benchmark_paged_smoke():
+    """tools/serving_benchmark.py --paged --json emits one machine-readable
+    JSON line with tok/s and the peak-block stat (quick-tier CPU smoke of
+    the whole paged path, benchmark driver included)."""
+    proc = subprocess.run(
+        [sys.executable, "tools/serving_benchmark.py", "--paged", "--json",
+         "--requests", "5", "--slots", "2", "--max-new", "6",
+         "--tick-window", "2", "--block-size", "8", "--prefill-chunk", "16"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["kv_cache"] == "paged"
+    assert rec["value"] > 0
+    assert rec["peak_kv_blocks"] >= 1
+    assert rec["peak_kv_blocks"] <= rec["kv_blocks_total"]

@@ -8,11 +8,21 @@ throughput-under-load number; tools/decode_benchmark.py measures only raw
 against a slot pool smaller than the burst (so refill churns), and reports
 generated tok/s + per-request completion latency p50/p95.
 
+``--paged`` switches the server to the block-table KV pool (chunked
+prefill + prefix caching, docs/serving.md): the JSON line then also
+carries ``peak_kv_blocks``/``kv_blocks_total``/``kv_block_size`` so the
+memory-proportionality claim (peak blocks ~ active tokens, not
+``slots·max_len``) is measured, not asserted. ``--json`` emits exactly ONE
+machine-readable JSON line on stdout (bench.py style); without it the same
+line is printed plus a human-readable summary on stderr.
+
 Sync honesty: every server tick pulls next-token ids to host
 (np.asarray in ``step``), so wall-clock over the drain IS device time —
 no reliance on block_until_ready (which lies on the tunneled backend).
 
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
+       [--paged [--block-size 16] [--num-blocks N] [--prefill-chunk 64]]
+       [--json]
 """
 from __future__ import annotations
 
@@ -41,6 +51,19 @@ def main():
     ap.add_argument("--long-prompts", action="store_true",
                     help="mixed prompts 64-512 over buckets (64,128,256,"
                          "512); raises max-len to 768 unless given")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache: block-table pool + chunked "
+                         "prefill + prefix caching (cache='paged')")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="tokens per KV block (paged only)")
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="total KV blocks in the pool (paged only; default "
+                         "sizes for dense parity)")
+    ap.add_argument("--prefill-chunk", type=int, default=64,
+                    help="tokens per chunked-prefill program (paged only)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit exactly one machine-readable JSON line "
+                         "(bench.py style) on stdout and nothing else")
     args = ap.parse_args()
     if args.max_len is None:
         args.max_len = 768 if args.long_prompts else 256
@@ -84,19 +107,28 @@ def main():
 
     from paddle_tpu.utils.bench_timing import tpu_lock
 
+    def make_server():
+        if args.paged:
+            return GenerationServer(
+                model, max_batch=args.slots, max_len=args.max_len,
+                tick_window=args.tick_window, cache="paged",
+                block_size=args.block_size, num_blocks=args.num_blocks,
+                prefill_chunk=args.prefill_chunk)
+        return GenerationServer(model, max_batch=args.slots,
+                                max_len=args.max_len,
+                                prompt_buckets=((64, 128, 256, 512)
+                                                if args.long_prompts
+                                                else (32, 64, 128)),
+                                tick_window=args.tick_window)
+
     # CPU smoke runs don't touch the chip — don't serialize on its lock
     lock = tpu_lock(timeout_s=900.0) if on_tpu else \
         contextlib.nullcontext(True)
     with lock as locked:
         if args.int8:
             model.quantize_int8()
-        server = GenerationServer(model, max_batch=args.slots,
-                                  max_len=args.max_len,
-                                  prompt_buckets=((64, 128, 256, 512)
-                                                  if args.long_prompts
-                                                  else (32, 64, 128)),
-                                  tick_window=args.tick_window)
-        # warmup drain: compiles the decode tick + all prefill buckets
+        server = make_server()
+        # warmup drain: compiles the decode tick + the prefill program(s)
         burst(server, min(args.slots, 4))
         server.run()
 
@@ -125,11 +157,26 @@ def main():
                     f"tick_window={args.tick_window}, "
                     f"{'int8' if args.int8 else 'bf16'} weights, "
                     f"params={n_params/1e6:.0f}M)",
+            "kv_cache": "paged" if args.paged else "dense",
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
             "wall_s": round(dt, 2)}
+    if args.paged:
+        stats = server.kv_stats()
+        line["peak_kv_blocks"] = stats["peak_blocks_in_use"]
+        line["kv_blocks_total"] = stats["num_blocks"]
+        line["kv_block_size"] = stats["block_size"]
+        line["prefix_hit_blocks"] = stats["prefix_hit_blocks"]
+        line["prefill_chunk"] = server.prefill_chunk
     if not locked:
         line["lock_contended"] = True
     print(json.dumps(line))
+    if not args.json:
+        mode = "paged" if args.paged else "dense"
+        extra = (f", peak blocks {line.get('peak_kv_blocks')}/"
+                 f"{line.get('kv_blocks_total')}" if args.paged else "")
+        print(f"[{mode}] {line['value']} tok/s, p50 {line['p50_s']}s, "
+              f"p95 {line['p95_s']}s over {line['wall_s']}s{extra}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
