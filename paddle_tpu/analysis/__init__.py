@@ -1,0 +1,21 @@
+"""paddle_tpu.analysis — tracing-safety static analysis (graftlint) and
+the dynamic jit-cache regression guard.
+
+The reference Paddle bakes correctness tooling into the framework
+(nan/inf sanitizer wiring, op checkers); the TPU-native analogue guards
+the hazards of a traced stack: host syncs, traced-value control flow,
+impure RNG, silent recompilation. See docs/static_analysis.md.
+"""
+from .baseline import (build_baseline, filter_new, load_baseline,
+                       save_baseline)
+from .engine import (Finding, ModuleContext, Rule, all_rules, analyze_paths,
+                     analyze_source, parse_suppressions, register)
+from .recompile_guard import (JitCacheGuard, RecompileError, compile_count,
+                              jit_cache_guard)
+
+__all__ = [
+    "Finding", "ModuleContext", "Rule", "register", "all_rules",
+    "analyze_source", "analyze_paths", "parse_suppressions",
+    "load_baseline", "save_baseline", "build_baseline", "filter_new",
+    "JitCacheGuard", "RecompileError", "jit_cache_guard", "compile_count",
+]

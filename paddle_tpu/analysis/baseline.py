@@ -1,0 +1,71 @@
+"""graftlint baseline: committed ledger of pre-existing violations.
+
+Existing debt must not block the gate (the analyzer lands on a codebase
+with live findings), but NEW violations must fail immediately. The
+baseline maps finding fingerprints (``path::rule::stripped-source-line``
+— line-number-free, so edits elsewhere in a file don't churn it) to
+occurrence counts. A finding is "new" once its fingerprint count is
+exhausted; a fingerprint that no longer matches anything is stale and is
+dropped on the next ``--update-baseline``.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from .engine import Finding
+
+__all__ = ["load_baseline", "save_baseline", "build_baseline", "filter_new"]
+
+_VERSION = 1
+
+
+def load_baseline(path) -> Dict[str, int]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text(encoding="utf-8"))
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"baseline {p}: unsupported version {data.get('version')!r}")
+    entries = data.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def save_baseline(path, entries: Dict[str, int]) -> None:
+    p = Path(path)
+    payload = {
+        "version": _VERSION,
+        "comment": "graftlint debt ledger — regenerate with "
+                   "`python tools/graftlint.py paddle_tpu --update-baseline`",
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    p.write_text(json.dumps(payload, indent=1) + "\n", encoding="utf-8")
+
+
+def build_baseline(findings: Sequence[Finding]) -> Dict[str, int]:
+    return dict(Counter(f.key() for f in findings))
+
+
+def filter_new(findings: Sequence[Finding], baseline: Dict[str, int],
+               ) -> Tuple[List[Finding], int, int]:
+    """Split findings against the baseline.
+
+    Returns (new_findings, #baselined, #stale) where #stale counts
+    baseline occurrences no current finding consumed (removed code —
+    worth an ``--update-baseline`` to keep the ledger honest).
+    """
+    budget = dict(baseline)
+    new: List[Finding] = []
+    n_base = 0
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            n_base += 1
+        else:
+            new.append(f)
+    stale = sum(v for v in budget.values() if v > 0)
+    return new, n_base, stale

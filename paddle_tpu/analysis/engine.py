@@ -1,0 +1,268 @@
+"""graftlint rule engine: AST-based tracing-safety analysis for the
+jax_graft codebase.
+
+The reference Paddle ships heavy op-level correctness tooling (nan/inf
+sanitizers, kernel checkers under paddle/fluid/framework/details/). A
+pjit-based stack has a different hazard class: *tracer-unsafe Python* —
+host syncs in library code, Python control flow on traced values, impure
+RNG inside trace regions — which breaks or silently deoptimizes only once
+the code runs under ``jax.jit`` on a real TPU. Those patterns are
+statically detectable, so we detect them statically.
+
+Design:
+
+- A :class:`Rule` visits one parsed module (:class:`ModuleContext`) and
+  yields :class:`Finding`s. Rules register via :func:`register` so the
+  set is pluggable (tools, tests and the pytest gate all share it).
+- Per-line suppression: ``# graftlint: noqa`` silences every rule on
+  that line; ``# graftlint: noqa[host-sync,np-random]`` silences only
+  the listed rules (ids like ``GL001`` also accepted).
+- Existing debt is tracked in a committed baseline (see baseline.py)
+  instead of blocking the gate; new violations fail immediately.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+__all__ = [
+    "Finding", "ModuleContext", "Rule", "register", "all_rules",
+    "parse_suppressions", "analyze_source", "analyze_paths", "iter_py_files",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based
+    rule_id: str       # "GL001"
+    rule_name: str     # "host-sync"
+    message: str
+    snippet: str = ""  # stripped source line (baseline fingerprint)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule_id} [{self.rule_name}] {self.message}")
+
+    def key(self) -> str:
+        """Baseline fingerprint — deliberately line-number-free so
+        unrelated edits above a known violation don't churn the baseline."""
+        return f"{self.path}::{self.rule_id}::{self.snippet}"
+
+
+# Modules whose *job* is host-side data preparation: RNG-based synthesis
+# and numpy math there is the workload, not a tracing hazard.
+_DATA_MODULE_PARTS = (
+    "dataset", "vision", "io", "text", "audio", "reader", "hub",
+)
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule may look at for one module."""
+
+    path: str                      # repo-relative posix path
+    tree: ast.Module
+    lines: List[str]
+    is_data_module: bool = False
+    # function names (local defs / lambdas assigned to names) that flow
+    # into jax.jit in this module, plus defs decorated with jit
+    jitted_names: frozenset = frozenset()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``name``/``description`` and
+    implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(path=ctx.path, line=line,
+                       col=getattr(node, "col_offset", 0),
+                       rule_id=self.id, rule_name=self.name,
+                       message=message, snippet=ctx.line_text(line))
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id or not cls.name:
+        raise ValueError(f"rule {cls.__name__} needs id and name")
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # import for side effect: rule modules self-register
+    from . import rules  # noqa: F401
+
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+# --------------------------------------------------------------------------- #
+# Suppressions
+# --------------------------------------------------------------------------- #
+
+_NOQA_RE = re.compile(r"#\s*graftlint:\s*noqa(?:\[([^\]]*)\])?", re.I)
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, Optional[frozenset]]:
+    """Map 1-based line numbers to suppressed rule sets.
+
+    ``None`` means blanket (all rules); otherwise a frozenset of
+    lower-cased rule names/ids listed in ``noqa[...]``.
+    """
+    out: Dict[int, Optional[frozenset]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _NOQA_RE.search(text)
+        if not m:
+            continue
+        spec = m.group(1)
+        if spec is None or not spec.strip():
+            out[i] = None
+        else:
+            out[i] = frozenset(
+                s.strip().lower() for s in spec.split(",") if s.strip())
+    return out
+
+
+def _suppressed(f: Finding, sup: Dict[int, Optional[frozenset]]) -> bool:
+    rules = sup.get(f.line, False)
+    if rules is False:
+        return False
+    if rules is None:
+        return True
+    return f.rule_id.lower() in rules or f.rule_name.lower() in rules
+
+
+# --------------------------------------------------------------------------- #
+# Per-module analysis
+# --------------------------------------------------------------------------- #
+
+
+def _collect_jitted_names(tree: ast.Module) -> frozenset:
+    """Names of functions this module hands to jax.jit — via decorator
+    (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``) or call-site
+    (``jax.jit(fn)``). Used by the effect-in-jit rule."""
+
+    def is_jit_ref(node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute):
+            return node.attr == "jit"
+        if isinstance(node, ast.Name):
+            return node.id in ("jit", "pjit")
+        return False
+
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if is_jit_ref(target):
+                    names.add(node.name)
+                elif (isinstance(dec, ast.Call) and dec.args
+                      and is_jit_ref(dec.args[0])):  # @partial(jax.jit, ...)
+                    names.add(node.name)
+        elif isinstance(node, ast.Call) and is_jit_ref(node.func):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Attribute):
+                    names.add(a.attr)
+    return frozenset(names)
+
+
+def _is_data_module(rel_path: str) -> bool:
+    parts = Path(rel_path).parts
+    return any(p.split(".")[0] in _DATA_MODULE_PARTS for p in parts)
+
+
+def analyze_source(src: str, path: str,
+                   rules: Optional[Sequence[Rule]] = None,
+                   ) -> Tuple[List[Finding], int]:
+    """Analyze one module's source. Returns (active findings, #suppressed)."""
+    rules = list(rules) if rules is not None else all_rules()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=0,
+                        rule_id="GL000", rule_name="syntax-error",
+                        message=f"could not parse: {e.msg}")], 0
+    lines = src.splitlines()
+    ctx = ModuleContext(path=path, tree=tree, lines=lines,
+                        is_data_module=_is_data_module(path),
+                        jitted_names=_collect_jitted_names(tree))
+    sup = parse_suppressions(lines)
+    active: List[Finding] = []
+    n_suppressed = 0
+    seen = set()
+    for rule in rules:
+        for f in rule.check(ctx):
+            dk = (f.path, f.line, f.col, f.rule_id)
+            if dk in seen:
+                continue
+            seen.add(dk)
+            if _suppressed(f, sup):
+                n_suppressed += 1
+            else:
+                active.append(f)
+    active.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return active, n_suppressed
+
+
+def iter_py_files(paths: Sequence[str], root: Optional[Path] = None):
+    """Yield (abs_path, repo_relative_posix) for every .py under ``paths``."""
+    root = Path(root) if root is not None else Path.cwd()
+    for p in paths:
+        base = Path(p)
+        if not base.is_absolute():
+            base = root / base
+        if base.is_file():
+            files = [base]
+        else:
+            files = sorted(base.rglob("*.py"))
+        for f in files:
+            try:
+                rel = f.resolve().relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            yield f, rel
+
+
+def analyze_paths(paths: Sequence[str], root: Optional[Path] = None,
+                  rules: Optional[Sequence[Rule]] = None,
+                  ) -> Tuple[List[Finding], int, int]:
+    """Analyze every .py file under ``paths``.
+
+    Returns (findings, #files, #suppressed)."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    n_files = 0
+    n_sup = 0
+    for f, rel in iter_py_files(paths, root):
+        n_files += 1
+        src = f.read_text(encoding="utf-8")
+        got, sup = analyze_source(src, rel, rules)
+        findings.extend(got)
+        n_sup += sup
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule_id))
+    return findings, n_files, n_sup

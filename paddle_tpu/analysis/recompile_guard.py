@@ -1,0 +1,122 @@
+"""Dynamic companion to graftlint: a jit-cache regression guard.
+
+Static analysis catches tracer-unsafe *code*; this guard catches
+tracer-unsafe *behavior* — silent recompilations in a steady-state loop.
+A serving decode tick or a train step must compile exactly once; a shape
+or dtype that wobbles per step (a Python int that sometimes arrives as
+np.int64, a donated buffer whose sharding changed, a weak_type flip)
+recompiles every step and turns a μs dispatch into a multi-second stall,
+visible only as mysterious slowness on the TPU.
+
+Implementation: ``jax.monitoring`` emits a
+``/jax/compilation_cache/compile_requests_use_cache`` event for every
+backend compile (cache miss). One process-wide listener feeds a counter;
+the guard snapshots it around a block and fails if it moved more than
+``allowed`` (default 0).
+
+Usage::
+
+    from paddle_tpu.analysis import jit_cache_guard
+
+    # warm up: run one step of every program the loop uses
+    server.step()
+    with jit_cache_guard("paged decode steady state"):
+        for _ in range(8):
+            server.step()          # any recompile here raises
+
+As a pytest fixture::
+
+    @pytest.fixture
+    def no_recompiles():
+        with jit_cache_guard("steady state") as g:
+            yield g
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["RecompileError", "JitCacheGuard", "jit_cache_guard",
+           "compile_count"]
+
+
+class RecompileError(AssertionError):
+    """A guarded block triggered jit cache misses (recompilation)."""
+
+
+_lock = threading.Lock()
+_installed = False
+_compiles = 0
+_recent: List[str] = []          # last few event names, for diagnostics
+_RECENT_MAX = 16
+
+# every backend compile (jit cache miss) records one of these, whether or
+# not the persistent compilation cache is enabled
+_COMPILE_EVENT_PREFIX = "/jax/compilation_cache/compile_requests"
+
+
+def _on_event(name: str, **kwargs) -> None:
+    global _compiles
+    if name.startswith(_COMPILE_EVENT_PREFIX):
+        with _lock:
+            _compiles += 1
+            _recent.append(name)
+            del _recent[:-_RECENT_MAX]
+
+
+def _ensure_listener() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax
+
+        jax.monitoring.register_event_listener(_on_event)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Process-wide backend-compile (cache-miss) count since the listener
+    was installed. Monotonic; meaningful as deltas."""
+    _ensure_listener()
+    with _lock:
+        return _compiles
+
+
+class JitCacheGuard:
+    """Context manager asserting jit cache-miss counts stay flat.
+
+    ``allowed`` tolerates a known number of one-off compiles inside the
+    block (e.g. a first-use epilogue); steady-state loops should keep the
+    default 0. The count is process-wide — don't run unrelated jax work
+    concurrently with a guarded block.
+    """
+
+    def __init__(self, label: str = "", allowed: int = 0):
+        self.label = label
+        self.allowed = int(allowed)
+        self.start: Optional[int] = None
+        self.compiles: Optional[int] = None
+
+    def __enter__(self) -> "JitCacheGuard":
+        self.start = compile_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.compiles = compile_count() - self.start
+        if exc_type is None and self.compiles > self.allowed:
+            with _lock:
+                recent = ", ".join(_recent[-min(self.compiles, 4):])
+            where = f" [{self.label}]" if self.label else ""
+            raise RecompileError(
+                f"jit cache regression{where}: {self.compiles} backend "
+                f"compile(s) inside a steady-state block (allowed "
+                f"{self.allowed}). Something retraces per step — check for "
+                f"wobbling shapes/dtypes/static args or un-donated buffers. "
+                f"Recent events: {recent}")
+        return False
+
+
+def jit_cache_guard(label: str = "", allowed: int = 0) -> JitCacheGuard:
+    """Factory matching the class (reads better at call sites)."""
+    return JitCacheGuard(label=label, allowed=allowed)

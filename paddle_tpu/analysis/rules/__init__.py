@@ -1,0 +1,72 @@
+"""graftlint rule set. Importing this package registers every rule with
+the engine registry (engine.all_rules imports it for that side effect).
+
+Shared AST helpers live here; the rules themselves are grouped by hazard
+family: host_sync (device→host syncs), control_flow (traced-value
+branching, effects inside jit), purity (RNG/default/except hygiene),
+jit_hygiene (jax.jit call-site quality).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+__all__ = ["attr_chain", "contains_jnp_call", "contains_value_attr"]
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains: ``np.random.rand`` → that
+    string; anything rooted in a non-Name (call result, subscript)
+    returns None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_JNP_ROOTS = ("jnp.", "jax.numpy.", "jax.nn.", "jax.lax.", "lax.")
+
+
+def _is_jnp_chain(chain: Optional[str]) -> bool:
+    return chain is not None and chain.startswith(_JNP_ROOTS)
+
+
+def contains_jnp_call(node: ast.AST) -> bool:
+    """True if the expression contains a call into jnp/jax.numpy/jax.nn/
+    jax.lax — i.e. its value is (or derives from) a traced/device array."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_jnp_chain(attr_chain(sub.func)):
+            return True
+    return False
+
+
+# reading these off a device array is free host-side metadata, not data
+_METADATA_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "sharding", "aval", "weak_type",
+})
+
+
+def contains_value_attr(node: ast.AST) -> bool:
+    """True if the expression touches DEVICE DATA via a ``.value``/
+    ``._value`` attribute (the Tensor-unwrap idiom). Metadata projections
+    of it (``x.value.shape``, ``._value.dtype``) are pruned — they're
+    host-resident and free."""
+
+    def visit(n: ast.AST) -> bool:
+        if isinstance(n, ast.Attribute):
+            if n.attr in _METADATA_ATTRS:
+                return False
+            if n.attr in ("value", "_value"):
+                return True
+            return visit(n.value)
+        return any(visit(c) for c in ast.iter_child_nodes(n))
+
+    return visit(node)
+
+
+# registration side effects
+from . import control_flow, host_sync, jit_hygiene, purity  # noqa: E402,F401

@@ -1,0 +1,104 @@
+"""Control-flow rules: Python branching on traced values and host side
+effects inside jit-compiled functions.
+
+``if``/``while`` on a traced array forces concretization: under jit it
+raises; in eager mode it blocks on the device AND guarantees the code can
+never move under ``jax.jit`` without a rewrite to ``lax.cond``/``select``.
+Side effects (wall-clock reads, prints, global RNG) inside a jitted
+function run once at trace time and then never again — a classic silent
+staleness bug (the traced value is baked into the executable).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain, contains_jnp_call, contains_value_attr
+
+
+@register
+class TracedBranchRule(Rule):
+    """GL002: Python ``if``/``while``/ternary/assert whose test is a jnp
+    expression — data-dependent host control flow."""
+
+    id = "GL002"
+    name = "traced-branch"
+    description = ("Python control flow on a jnp value concretizes the "
+                   "array (host sync; ConcretizationTypeError under jit) — "
+                   "use lax.cond/jnp.where or branch on static metadata")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            else:
+                continue
+            if contains_jnp_call(test) or self._compares_device(test):
+                yield self.finding(
+                    ctx, node,
+                    f"{kind} condition evaluates a traced/device value — "
+                    f"rewrite with jnp.where/lax.cond or hoist the decision "
+                    f"to static metadata")
+
+    @staticmethod
+    def _compares_device(test: ast.AST) -> bool:
+        """Comparison where one side unwraps a Tensor (`x.value > 0`)."""
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare):
+                sides = [sub.left] + list(sub.comparators)
+                if any(contains_value_attr(s) for s in sides):
+                    return True
+        return False
+
+
+_EFFECT_CALLS = {
+    "time.time": "wall-clock read",
+    "time.perf_counter": "wall-clock read",
+    "time.monotonic": "wall-clock read",
+    "datetime.now": "wall-clock read",
+    "print": "stdout write",
+    "input": "stdin read",
+    "open": "file I/O",
+}
+
+
+@register
+class EffectInJitRule(Rule):
+    """GL008: host side effects inside a function this module jit-compiles.
+    They execute at trace time only — the compiled executable replays the
+    traced constant forever after."""
+
+    id = "GL008"
+    name = "effect-in-jit"
+    description = ("time.time()/print()/np.random/file I/O inside a jitted "
+                   "function runs once at trace time and never again — "
+                   "hoist it out or pass the value as an argument")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.jitted_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in ctx.jitted_names:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = attr_chain(sub.func)
+                if chain in _EFFECT_CALLS:
+                    yield self.finding(
+                        ctx, sub,
+                        f"{chain}() inside jitted '{node.name}' is a "
+                        f"{_EFFECT_CALLS[chain]}: it happens at trace time "
+                        f"only, then the compiled value is frozen")
+                elif chain is not None and chain.startswith(
+                        ("np.random.", "numpy.random.", "random.")):
+                    yield self.finding(
+                        ctx, sub,
+                        f"{chain}() inside jitted '{node.name}' draws ONE "
+                        f"value at trace time — every compiled call replays "
+                        f"it; thread a jax.random key instead")

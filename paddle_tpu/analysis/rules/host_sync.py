@@ -1,0 +1,109 @@
+"""Host-sync rules: code that silently pulls a device array to the host.
+
+On TPU every such pull is a blocking device→host round trip that also
+fences the XLA dispatch queue; one per gradient per step (the pattern
+this rule was written against — nn/clip.py's old global-norm loop) turns
+a fused reduction into a serial sync storm. Under ``jax.jit`` tracing the
+same code raises ``ConcretizationTypeError`` instead, so these sites are
+latent jit-compatibility bugs too.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain, contains_jnp_call, contains_value_attr
+
+
+def _derives_from_device(node: ast.AST) -> bool:
+    return contains_jnp_call(node) or contains_value_attr(node)
+
+
+@register
+class HostSyncRule(Rule):
+    """GL001: ``float()``/``int()``/``bool()`` over a jnp expression,
+    ``.item()``/``.tolist()`` calls, and ``np.asarray()`` of a device
+    value — each one a blocking device→host sync."""
+
+    id = "GL001"
+    name = "host-sync"
+    description = ("float()/int()/bool()/.item()/.tolist()/np.asarray() on "
+                   "a device value blocks on a device->host transfer (and "
+                   "fails to trace under jit)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.is_data_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # float(jnp.sum(...)) / int(x.value.max()) / bool(jnp.any(...))
+            if (isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool")
+                    and node.args
+                    and _derives_from_device(node.args[0])):
+                yield self.finding(
+                    ctx, node,
+                    f"{fn.id}() on a device value is a blocking host sync — "
+                    f"keep the computation in jnp (traced) instead")
+            # x.item() / x.tolist() — Tensor/jax.Array host pulls
+            elif (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("item", "tolist")
+                    and not node.keywords):
+                chain = attr_chain(fn.value)
+                # dict.items() is different; .item with args is ndarray
+                # indexing — still a pull, still flagged
+                yield self.finding(
+                    ctx, node,
+                    f".{fn.attr}() pulls the array to the host; in library "
+                    f"code prefer traced jnp ops (chain: "
+                    f"{chain or '<expr>'})")
+            # np.asarray(t.value) / np.array(jnp....)
+            elif (isinstance(fn, ast.Attribute)
+                    and fn.attr in ("asarray", "array")
+                    and attr_chain(fn) in ("np.asarray", "np.array",
+                                           "numpy.asarray", "numpy.array")
+                    and node.args
+                    and _derives_from_device(node.args[0])):
+                yield self.finding(
+                    ctx, node,
+                    f"np.{fn.attr}() of a device value forces a host copy — "
+                    f"stay in jnp, or sync once at a deliberate boundary")
+
+
+_NP_MATH = frozenset({
+    "sum", "mean", "dot", "matmul", "einsum", "exp", "log", "sqrt",
+    "square", "abs", "maximum", "minimum", "max", "min", "prod", "tanh",
+    "power", "clip", "argmax", "argmin", "linalg.norm", "cumsum", "where",
+})
+
+
+@register
+class NumpyOnTensorRule(Rule):
+    """GL006: numpy math applied to a Tensor's device value. The result
+    is a HOST ndarray: the transfer is implicit, gradients are severed,
+    and the op runs on CPU instead of the MXU."""
+
+    id = "GL006"
+    name = "np-on-tensor"
+    description = ("np.<math>(x.value) silently computes on host — use the "
+                   "jnp equivalent so XLA fuses it on device")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if ctx.is_data_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None or not chain.startswith(("np.", "numpy.")):
+                continue
+            tail = chain.split(".", 1)[1]
+            if tail not in _NP_MATH:
+                continue
+            if any(contains_value_attr(a) for a in node.args):
+                yield self.finding(
+                    ctx, node,
+                    f"{chain}() over a Tensor value runs on host and severs "
+                    f"the autograd tape — use jnp.{tail}")

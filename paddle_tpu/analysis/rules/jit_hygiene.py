@@ -1,0 +1,97 @@
+"""jax.jit call-site hygiene: parameters that should be static.
+
+A Python scalar argument traced as a device value costs an abstract
+0-d array where the function actually needs a CONCRETE value — shapes
+(``jnp.zeros(n)``), trip counts (``range(n)``), flags (``if mode:``).
+Passing it dynamically either fails to trace or, when it happens to
+trace, retraces/recompiles on every distinct value anyway — without the
+cache key making that intent explicit. Declaring ``static_argnums`` is
+both the fix and the documentation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain
+
+_SHAPE_FNS = (
+    "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty", "jnp.arange",
+    "jnp.linspace", "jnp.eye", "jax.numpy.zeros", "jax.numpy.ones",
+)
+
+
+def _static_positions(fn: ast.FunctionDef) -> Dict[str, str]:
+    """Param name → why it must be concrete, for params used in
+    static-only positions inside ``fn``'s own body (nested defs excluded:
+    their params are the nested function's problem)."""
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    params.discard("self")
+    out: Dict[str, str] = {}
+    nested = {n for sub in ast.walk(fn)
+              if isinstance(sub, (ast.FunctionDef, ast.Lambda)) and sub is not fn
+              for n in ast.walk(sub)}
+    for node in ast.walk(fn):
+        if node in nested:
+            continue
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if isinstance(node.func, ast.Name) and node.func.id == "range":
+                for a in node.args:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Name) and n.id in params:
+                            out.setdefault(n.id, "loop trip count (range)")
+            elif chain in _SHAPE_FNS and node.args:
+                for n in ast.walk(node.args[0]):
+                    if isinstance(n, ast.Name) and n.id in params:
+                        out.setdefault(n.id, f"array shape ({chain})")
+        elif isinstance(node, (ast.If, ast.While)):
+            t = node.test
+            if isinstance(t, ast.Name) and t.id in params:
+                out.setdefault(t.id, "Python branch condition")
+            elif isinstance(t, ast.Compare) and isinstance(t.left, ast.Name) \
+                    and t.left.id in params \
+                    and all(isinstance(c, ast.Constant) for c in t.comparators):
+                out.setdefault(t.left.id, "Python branch condition")
+    return out
+
+
+@register
+class StaticArgnumsRule(Rule):
+    """GL007: ``jax.jit(fn)`` without static_argnums/static_argnames where
+    ``fn`` (resolvable in the same module) uses a parameter in a position
+    that requires a concrete Python value."""
+
+    id = "GL007"
+    name = "static-argnums"
+    description = ("jitted function uses a parameter as shape/trip-count/"
+                   "branch — declare it in static_argnums so the intent "
+                   "(recompile per value) is explicit and tracing succeeds")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        defs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef)}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                continue
+            if any(kw.arg in ("static_argnums", "static_argnames")
+                   for kw in node.keywords):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            fn = defs.get(node.args[0].id)
+            if fn is None:
+                continue
+            hits = _static_positions(fn)
+            if hits:
+                detail = "; ".join(f"'{p}' used as {why}"
+                                   for p, why in sorted(hits.items()))
+                yield self.finding(
+                    ctx, node,
+                    f"jax.jit({fn.name}) without static_argnums, but "
+                    f"{detail} — these need concrete values at trace time")

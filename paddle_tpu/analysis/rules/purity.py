@@ -1,0 +1,106 @@
+"""Purity/hygiene rules: global-state RNG, mutable defaults, bare except.
+
+``np.random`` global-state draws in library code break two contracts at
+once: determinism (any other consumer advances the stream — a model's
+init changes because a dataloader shuffled first) and traceability (the
+draw happens at trace time under jit; see GL008). Library randomness
+must route through paddle_tpu.framework.random so `paddle.seed` governs
+one reproducible stream.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain
+
+# global-stream draws: order-dependent on every other np.random consumer
+_GLOBAL_DRAWS = frozenset({
+    "seed", "rand", "randn", "randint", "random", "random_sample",
+    "permutation", "shuffle", "choice", "uniform", "normal", "binomial",
+    "beta", "poisson", "exponential", "standard_normal", "bytes",
+})
+
+
+@register
+class NpRandomRule(Rule):
+    """GL003: ``np.random.*`` in library modules. Global-stream draws are
+    flagged everywhere; even seeded local generators
+    (``RandomState``/``default_rng``) are flagged outside data modules —
+    library randomness must come from framework.random so ``paddle.seed``
+    controls it (and TP-aware RNG can partition it)."""
+
+    id = "GL003"
+    name = "np-random"
+    description = ("np.random in library code breaks determinism and "
+                   "tracing — route through paddle_tpu.framework.random "
+                   "(derived_rng/next_key)")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            chain = None
+            if isinstance(node, ast.Attribute):
+                chain = attr_chain(node)
+            if chain is None or not chain.startswith(("np.random.",
+                                                      "numpy.random.")):
+                continue
+            tail = chain.split("random.", 1)[1]
+            if "." in tail:  # only the direct member, not sub-attrs
+                continue
+            if tail in _GLOBAL_DRAWS:
+                yield self.finding(
+                    ctx, node,
+                    f"{chain} uses the GLOBAL numpy stream — any other "
+                    f"consumer reorders it; use framework.random.derived_rng")
+            elif (not ctx.is_data_module
+                    and tail in ("RandomState", "default_rng")):
+                yield self.finding(
+                    ctx, node,
+                    f"{chain} creates an ad-hoc generator outside "
+                    f"framework.random — paddle.seed cannot govern it; use "
+                    f"framework.random.derived_rng")
+
+
+@register
+class MutableDefaultRule(Rule):
+    """GL004: mutable default argument — shared across calls, a classic
+    aliasing bug that state-carrying server/engine classes cannot afford."""
+
+    id = "GL004"
+    name = "mutable-default"
+    description = "list/dict/set/call default arguments are shared across calls"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            args = node.args
+            for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                  ast.DictComp, ast.SetComp)):
+                    name = getattr(node, "name", "<lambda>")
+                    yield self.finding(
+                        ctx, d,
+                        f"mutable default in '{name}' is evaluated once and "
+                        f"shared by every call — default to None and build "
+                        f"inside")
+
+
+@register
+class BareExceptRule(Rule):
+    """GL005: bare ``except:`` — swallows KeyboardInterrupt/SystemExit and
+    masks tracer leaks (jax errors surface as plain Exceptions)."""
+
+    id = "GL005"
+    name = "bare-except"
+    description = "bare except: catches SystemExit/KeyboardInterrupt too"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare except: catches everything incl. SystemExit — "
+                    "name the exception (at minimum `except Exception`)")

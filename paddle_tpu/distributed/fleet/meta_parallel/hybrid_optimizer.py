@@ -29,15 +29,19 @@ class HybridParallelClipGrad:
         grads = [g for _, g in params_grads if g is not None]
         if not grads:
             return params_grads
-        sq = sum(float(jnp.sum(jnp.square(g.value.astype(jnp.float32)))) for g in grads)
+        # single traced reduction (see nn/clip.py ClipGradByGlobalNorm):
+        # the squared norm and the scale stay 0-d device scalars — the only
+        # cross-process hop is the all_reduce itself
+        sq = sum(jnp.sum(jnp.square(g.value.astype(jnp.float32)))
+                 for g in grads)
         if get_world_size() > 1:
-            t = Tensor(jnp.asarray(sq))
+            t = Tensor(sq)
             all_reduce(t, op=ReduceOp.SUM)
-            sq = float(t.value)
-        global_norm = sq ** 0.5
+            sq = t.value
         clip_norm = getattr(self._clip, "clip_norm", 1.0)
-        scale = min(clip_norm / max(global_norm, 1e-12), 1.0)
-        return [(p, None if g is None else Tensor(g.value * scale))
+        scale = jnp.minimum(clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12), 1.0)
+        return [(p, None if g is None else
+                 Tensor((g.value * scale).astype(g.value.dtype)))
                 for p, g in params_grads]
 
 

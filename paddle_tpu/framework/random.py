@@ -64,8 +64,37 @@ def default_generator() -> Generator:
 def seed(s: int):
     """paddle.seed parity."""
     _default_generator.manual_seed(s)
-    np.random.seed(int(s) % (2 ** 32))
+    # legacy consumers (user code, datasets) still read the global numpy
+    # stream; seeding it here is the API's contract
+    np.random.seed(int(s) % (2 ** 32))  # graftlint: noqa[np-random]
     return _default_generator
+
+
+def derived_rng(*entropy) -> np.random.Generator:
+    """Seeded LOCAL numpy generator for host-side library randomness
+    (init heuristics, negative sampling, graph subsampling).
+
+    Derives from the framework seed plus caller-supplied entropy (ints or
+    strings — strings are hashed stably), so the stream is reproducible
+    after ``paddle.seed`` yet immune to — and invisible to — every other
+    ``np.random`` consumer. For FRESH draws per call, mix in
+    ``next_key()``'s key data as entropy. This is the sanctioned
+    replacement for ``np.random.RandomState``/``default_rng`` in library
+    modules (graftlint GL003)."""
+    import zlib
+
+    ints = [_default_generator.initial_seed() & 0xFFFFFFFF]
+    for e in entropy:
+        if isinstance(e, (bool, str, bytes)):
+            b = e if isinstance(e, bytes) else str(e).encode()
+            ints.append(zlib.crc32(b))
+        elif isinstance(e, (int, np.integer)):
+            ints.append(int(e) & 0xFFFFFFFFFFFFFFFF)
+        else:
+            raise TypeError(
+                f"derived_rng entropy must be int/str/bytes, got "
+                f"{type(e).__name__}")
+    return np.random.default_rng(ints)  # graftlint: noqa[np-random]
 
 
 def get_rng_state():

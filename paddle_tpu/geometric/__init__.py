@@ -99,7 +99,9 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                      return_eids=False, perm_buffer=None, name=None):
     """CSC neighbor sampling (host-side, dynamic shapes — eager only)."""
-    rng = np.random.RandomState(0)
+    from ..framework.random import derived_rng
+
+    rng = derived_rng("geometric.sample_neighbors")
     rows = np.asarray(to_array(row))
     cptr = np.asarray(to_array(colptr))
     nodes = np.asarray(to_array(input_nodes))

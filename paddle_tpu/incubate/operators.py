@@ -52,11 +52,11 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     # entropy from the framework generator: fresh draw per call, but the
     # whole sequence replays after paddle.seed (reference ops honor the
     # global seed the same way)
-    from ..framework.random import default_generator
+    from ..framework.random import default_generator, derived_rng
 
-    ent = np.asarray(jax.random.key_data(
+    ent = np.asarray(jax.random.key_data(  # graftlint: noqa[host-sync]
         default_generator().next_key())).ravel().tolist()
-    rng = np.random.default_rng(ent)
+    rng = derived_rng(*ent)
     neigh, counts, out_eids = [], [], []
     for n in nodes:
         lo, hi = int(ptr[n]), int(ptr[n + 1])

@@ -1,6 +1,8 @@
 """Gradient clipping (ref: python/paddle/fluid/clip.py ClipGradByGlobalNorm etc.)."""
 from __future__ import annotations
 
+import functools
+
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
@@ -60,9 +62,14 @@ class ClipGradByGlobalNorm(ClipGradBase):
         grads = [g for _, g in params_grads if g is not None]
         if not grads:
             return params_grads
-        sq = sum(float(jnp.sum(jnp.square(g.value.astype(jnp.float32)))) for g in grads)
-        global_norm = sq ** 0.5
-        scale = min(self.clip_norm / max(global_norm, 1e-12), 1.0)
+        # ONE traced reduction tree — the old per-grad float() was a
+        # blocking device->host sync per gradient per step; the scale now
+        # stays a 0-d device scalar end to end (same math as the compiled
+        # path's _pure_grad_clip, so eager and jit stay bit-consistent)
+        sq = sum(jnp.sum(jnp.square(g.value.astype(jnp.float32)))
+                 for g in grads)
+        scale = jnp.minimum(
+            self.clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12), 1.0)
         out = []
         for p, g in params_grads:
             if g is None:
@@ -84,16 +91,20 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
     grads = [p.grad for p in parameters if p.grad is not None]
     if not grads:
         return Tensor(jnp.zeros(()))
+    # traced reduction tree + unconditional min(scale, 1) multiply: no
+    # per-grad host sync and no Python branch on a device scalar (the
+    # scale==1 multiply is exact, so numerics match the old branchy form)
     if norm_type == float("inf"):
-        total = max(float(jnp.max(jnp.abs(g.value))) for g in grads)
+        total = functools.reduce(
+            jnp.maximum, (jnp.max(jnp.abs(g.value)) for g in grads))
     else:
-        total = sum(float(jnp.sum(jnp.power(jnp.abs(g.value.astype(jnp.float32)),
-                                            norm_type))) for g in grads) ** (1.0 / norm_type)
-    scale = max_norm / (total + 1e-6)
-    if scale < 1.0:
-        for p in parameters:
-            if p.grad is not None:
-                p.grad = Tensor(p.grad.value * scale)
+        total = sum(jnp.sum(jnp.power(jnp.abs(g.value.astype(jnp.float32)),
+                                      norm_type))
+                    for g in grads) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor((p.grad.value * scale).astype(p.grad.value.dtype))
     return Tensor(jnp.asarray(total))
 
 

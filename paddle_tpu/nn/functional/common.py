@@ -323,7 +323,9 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     d = convert_dtype(dtype)
 
     def f(lengths):
-        m = maxlen if maxlen is not None else int(jnp.max(lengths))
+        # data-dependent output width: maxlen must be concrete (eager-only
+        # path when maxlen is None)
+        m = maxlen if maxlen is not None else int(jnp.max(lengths))  # graftlint: noqa[host-sync]
         rng = jnp.arange(m)
         return (rng[None, :] < lengths[..., None]).astype(d)
 

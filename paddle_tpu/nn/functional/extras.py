@@ -379,6 +379,8 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     if len(pos) >= num_samples:
         sampled = pos
     else:
+        from ...framework.random import derived_rng
+
         if seed is None:
             # advance the framework generator: fresh draw per call, still
             # reproducible as a sequence after paddle.seed
@@ -386,12 +388,12 @@ def class_center_sample(label, num_classes, num_samples, group=None,
 
             from ...framework.random import default_generator
 
-            entropy = np.asarray(_jax.random.key_data(
+            entropy = np.asarray(_jax.random.key_data(  # graftlint: noqa[host-sync]
                 default_generator().next_key())).ravel().tolist()
         else:
             entropy = [int(seed)]
         # local generator: never perturbed by (or perturbing) np.random
-        gen = np.random.default_rng(entropy + [len(pos), num_classes])
+        gen = derived_rng(*entropy, len(pos), num_classes)
         rest = np.setdiff1d(np.arange(num_classes), pos)
         extra = gen.permutation(rest)[:num_samples - len(pos)]
         sampled = np.sort(np.concatenate([pos, extra]))

@@ -87,12 +87,17 @@ def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
     buffers updated each forward."""
     if dim is None:
         dim = 1 if layer.__class__.__name__.lower().find("linear") >= 0 else 0
+    from ...framework.random import derived_rng
+
     w = getattr(layer, name)
-    wv = np.asarray(w.value)
+    # one-time host copy at hook-install (init only, never per-forward)
+    wv = np.asarray(w.value)  # graftlint: noqa[host-sync]
     wm = np.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
-    rng = np.random.RandomState(0)
-    u = rng.randn(wm.shape[0]).astype(np.float32)
-    v = rng.randn(wm.shape[1]).astype(np.float32)
+    # power-iteration init: seeded via the framework generator (GL003) —
+    # deterministic per (shape, paddle.seed), not the global numpy stream
+    rng = derived_rng("spectral_norm", wm.shape[0], wm.shape[1])
+    u = rng.standard_normal(wm.shape[0]).astype(np.float32)
+    v = rng.standard_normal(wm.shape[1]).astype(np.float32)
     u /= np.linalg.norm(u) + eps
     v /= np.linalg.norm(v) + eps
     orig = Parameter(w.value, name=f"{name}_orig")

@@ -192,7 +192,9 @@ class ReduceOnPlateau(LRScheduler):
         from ..framework.core import Tensor
 
         if isinstance(metrics, Tensor):
-            metrics = float(metrics.item())
+            # epoch-cadence host decision: ReduceOnPlateau compares the
+            # metric on the host once per step() call, outside any jit
+            metrics = float(metrics.item())  # graftlint: noqa[host-sync]
         self.last_epoch += 1
         if self.best is None:
             self.best = metrics

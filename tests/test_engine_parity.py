@@ -451,3 +451,31 @@ def test_grad_accum_rejects_ragged_batch():
     y = np.zeros((3, 16), "int64")
     with pytest.raises(ValueError, match="grad_accum"):
         eng.train_batch(x, y)
+
+
+@pytest.mark.graftlint
+def test_train_step_steady_state_zero_recompiles():
+    """jit-cache regression guard on the engine train loop: after the
+    first train_batch compiles pure_update, every subsequent same-shape
+    batch must be a cache hit. A retrace per step (wobbling batch dtype,
+    non-weak python scalar, donation mismatch) is the classic silent TPU
+    throughput killer graftlint's dynamic companion exists to catch."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    cfg = _cfg()
+    paddle.seed(11)
+    m = LlamaForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-2, parameters=m.parameters())
+    eng = ParallelEngine(m, optimizer=opt, loss_fn=m.loss_fn,
+                         mesh=Mesh(np.array(jax.devices()[:1]).reshape(1),
+                                   ("data",)))
+    batches = _batches(cfg, n=4)
+    x0, y0 = batches[0]
+    eng.train_batch(paddle.to_tensor(x0), paddle.to_tensor(y0))  # warm-up
+
+    with jit_cache_guard("engine train steady state") as g:
+        losses = [float(np.asarray(eng.train_batch(
+            paddle.to_tensor(x), paddle.to_tensor(y)).value))
+            for x, y in batches[1:]]
+    assert g.compiles == 0
+    assert all(np.isfinite(losses))

@@ -1,0 +1,440 @@
+"""graftlint: tracing-safety static analyzer + jit-cache guard.
+
+Three layers under test:
+  1. the rule engine on synthetic fixtures — one TP and one TN per rule,
+     so every rule's trigger AND its sharp edge (what it must NOT flag)
+     are pinned;
+  2. the machinery — suppression parsing, baseline round-trip, CLI exit
+     codes, and the repo gate (paddle_tpu lints clean against the
+     committed baseline: NEW violations fail this test);
+  3. the dynamic companion — jit_cache_guard detects backend recompiles
+     via jax.monitoring and stays silent on cache hits.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from paddle_tpu.analysis import (JitCacheGuard, RecompileError, all_rules,
+                                 analyze_paths, analyze_source,
+                                 build_baseline, filter_new, jit_cache_guard,
+                                 load_baseline, parse_suppressions,
+                                 save_baseline)
+
+pytestmark = pytest.mark.graftlint
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE = REPO / "tools" / "graftlint_baseline.json"
+
+
+def lint(src, path="paddle_tpu/lib/mod.py"):
+    findings, _ = analyze_source(textwrap.dedent(src), path, all_rules())
+    return findings
+
+
+def rule_ids(src, path="paddle_tpu/lib/mod.py"):
+    return sorted({f.rule_id for f in lint(src, path)})
+
+
+# --------------------------------------------------------------------------- #
+# Per-rule fixtures: true positive + true negative
+# --------------------------------------------------------------------------- #
+
+
+class TestHostSyncGL001:
+    def test_float_of_jnp_value(self):
+        assert "GL001" in rule_ids("""
+            import jax.numpy as jnp
+            def f(x):
+                return float(jnp.sum(x))
+        """)
+
+    def test_item_and_tolist(self):
+        ids = [f.rule_id for f in lint("""
+            def f(t):
+                a = t.value.item()
+                b = t.value.tolist()
+                return a, b
+        """)]
+        assert ids.count("GL001") == 2
+
+    def test_np_asarray_of_device_value(self):
+        assert "GL001" in rule_ids("""
+            import numpy as np
+            def f(t):
+                return np.asarray(t.value) * 2
+        """)
+
+    def test_metadata_access_is_not_a_sync(self):
+        # .shape/.size/.dtype on a device array is free host metadata
+        assert rule_ids("""
+            import numpy as np
+            def f(t):
+                n = int(t.value.size)
+                s = np.array(t.value.shape)
+                return n, s, t.value.dtype
+        """) == []
+
+    def test_plain_python_float_untouched(self):
+        assert rule_ids("""
+            def f(x):
+                return float(x) + int(x)
+        """) == []
+
+    def test_data_modules_exempt(self):
+        src = """
+            import numpy as np
+            def load(t):
+                return np.asarray(t.value)
+        """
+        assert "GL001" in rule_ids(src)
+        assert rule_ids(src, "paddle_tpu/vision/transforms.py") == []
+
+
+class TestTracedBranchGL002:
+    def test_if_on_jnp_expression(self):
+        assert "GL002" in rule_ids("""
+            import jax.numpy as jnp
+            def f(x):
+                if jnp.max(x) > 0:
+                    return x
+                return -x
+        """)
+
+    def test_while_on_device_value(self):
+        assert "GL002" in rule_ids("""
+            def f(t):
+                while t.value > 0:
+                    t = step(t)
+                return t
+        """)
+
+    def test_shape_branch_is_static(self):
+        assert rule_ids("""
+            def f(t):
+                if t.value.shape[0] > 2:
+                    return t
+                return None
+        """) == []
+
+
+class TestNpRandomGL003:
+    def test_global_stream_draw(self):
+        assert "GL003" in rule_ids("""
+            import numpy as np
+            def init():
+                return np.random.randn(4)
+        """)
+
+    def test_seeded_generator_ok_in_library(self):
+        assert rule_ids("""
+            import numpy as np
+            def init(rng):
+                return rng.standard_normal(4)
+        """) == []
+
+    def test_default_rng_flagged_outside_data_modules_only(self):
+        src = """
+            import numpy as np
+            gen = np.random.default_rng(0)
+        """
+        assert "GL003" in rule_ids(src)
+        assert rule_ids(src, "paddle_tpu/io/reader.py") == []
+
+
+class TestMutableDefaultGL004:
+    def test_list_default(self):
+        assert "GL004" in rule_ids("""
+            def f(x, acc=[]):
+                acc.append(x)
+                return acc
+        """)
+
+    def test_none_and_tuple_defaults_ok(self):
+        assert rule_ids("""
+            def f(x, acc=None, dims=(1, 2)):
+                return x
+        """) == []
+
+
+class TestBareExceptGL005:
+    def test_bare_except(self):
+        assert "GL005" in rule_ids("""
+            def f():
+                try:
+                    return g()
+                except:
+                    return None
+        """)
+
+    def test_typed_except_ok(self):
+        assert rule_ids("""
+            def f():
+                try:
+                    return g()
+                except (ValueError, KeyError):
+                    return None
+        """) == []
+
+
+class TestNpOnTensorGL006:
+    def test_np_math_on_device_value(self):
+        assert "GL006" in rule_ids("""
+            import numpy as np
+            def f(t):
+                return np.matmul(t.value, t.value)
+        """)
+
+    def test_np_math_on_host_arrays_ok(self):
+        assert rule_ids("""
+            import numpy as np
+            def f(a, b):
+                return np.matmul(a, b)
+        """) == []
+
+
+class TestStaticArgnumsGL007:
+    SRC = """
+        import jax
+        import jax.numpy as jnp
+
+        def build(n, x):
+            acc = x
+            for i in range(n):
+                acc = acc + jnp.ones(())
+            return acc
+
+        {jit_line}
+    """
+
+    def test_loop_bound_param_without_static(self):
+        assert "GL007" in rule_ids(
+            self.SRC.format(jit_line="g = jax.jit(build)"))
+
+    def test_declared_static_argnums_ok(self):
+        assert rule_ids(self.SRC.format(
+            jit_line="g = jax.jit(build, static_argnums=(0,))")) == []
+
+
+class TestEffectInJitGL008:
+    def test_time_inside_jitted_fn(self):
+        assert "GL008" in rule_ids("""
+            import time
+            import jax
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x + t0
+        """)
+
+    def test_time_outside_jit_ok(self):
+        assert rule_ids("""
+            import time
+            def wall():
+                return time.time()
+        """) == []
+
+    def test_callsite_jit_detection(self):
+        assert "GL008" in rule_ids("""
+            import jax
+            def step(x):
+                print(x)
+                return x
+            fast = jax.jit(step)
+        """)
+
+
+class TestSyntaxErrorGL000:
+    def test_unparseable_module_reports_gl000(self):
+        assert rule_ids("def broken(:\n    pass") == ["GL000"]
+
+
+# --------------------------------------------------------------------------- #
+# Suppressions
+# --------------------------------------------------------------------------- #
+
+
+class TestSuppressions:
+    def test_parse_blanket_and_scoped(self):
+        sup = parse_suppressions([
+            "x = 1  # graftlint: noqa",
+            "y = 2  # graftlint: noqa[host-sync, GL003]",
+            "z = 3",
+        ])
+        assert sup[1] is None
+        assert sup[2] == frozenset({"host-sync", "gl003"})
+        assert 3 not in sup
+
+    def test_scoped_noqa_silences_only_named_rule(self):
+        findings, n_sup = analyze_source(textwrap.dedent("""
+            import jax.numpy as jnp
+            def f(x):
+                return float(jnp.sum(x))  # graftlint: noqa[host-sync]
+        """), "paddle_tpu/lib/mod.py", all_rules())
+        assert findings == [] and n_sup == 1
+
+    def test_wrong_rule_name_does_not_suppress(self):
+        findings, n_sup = analyze_source(textwrap.dedent("""
+            import jax.numpy as jnp
+            def f(x):
+                return float(jnp.sum(x))  # graftlint: noqa[np-random]
+        """), "paddle_tpu/lib/mod.py", all_rules())
+        assert [f.rule_id for f in findings] == ["GL001"] and n_sup == 0
+
+    def test_blanket_noqa(self):
+        findings, n_sup = analyze_source(
+            "import numpy as np\nx = np.random.rand(3)  # graftlint: noqa\n",
+            "paddle_tpu/lib/mod.py", all_rules())
+        assert findings == [] and n_sup == 1
+
+
+# --------------------------------------------------------------------------- #
+# Baseline
+# --------------------------------------------------------------------------- #
+
+
+class TestBaseline:
+    SRC = """
+        import jax.numpy as jnp
+        def f(x):
+            return float(jnp.sum(x))
+    """
+
+    def test_round_trip_and_filter(self, tmp_path):
+        findings = lint(self.SRC)
+        assert findings
+        base = build_baseline(findings)
+        p = tmp_path / "base.json"
+        save_baseline(p, base)
+        loaded = load_baseline(p)
+        new, n_base, n_stale = filter_new(findings, loaded)
+        assert new == [] and n_base == len(findings) and n_stale == 0
+
+    def test_fingerprint_survives_line_shift(self):
+        # same violation, pushed 3 lines down: baseline still matches
+        shifted = "#\n#\n#\n" + textwrap.dedent(self.SRC)
+        base = build_baseline(lint(self.SRC))
+        moved, _ = analyze_source(shifted, "paddle_tpu/lib/mod.py",
+                                  all_rules())
+        new, n_base, _ = filter_new(moved, base)
+        assert new == [] and n_base == len(moved)
+
+    def test_new_violation_not_masked(self):
+        base = build_baseline(lint(self.SRC))
+        grown = textwrap.dedent(self.SRC) + "\ndef g(t):\n    return t.value.item()\n"
+        findings, _ = analyze_source(grown, "paddle_tpu/lib/mod.py",
+                                     all_rules())
+        new, _, _ = filter_new(findings, base)
+        assert [f.rule_id for f in new] == ["GL001"]
+
+
+# --------------------------------------------------------------------------- #
+# Repo gate + CLI
+# --------------------------------------------------------------------------- #
+
+
+class TestRepoGate:
+    def test_repo_lints_clean_against_committed_baseline(self):
+        """THE gate: paddle_tpu must produce no findings beyond the
+        committed baseline. If this fails you either fix the new
+        violation, noqa it with a rationale, or (for deliberate debt)
+        re-run tools/graftlint.py --update-baseline and justify the diff
+        in review."""
+        findings, n_files, _ = analyze_paths(["paddle_tpu"], root=REPO)
+        assert n_files > 200  # sanity: we really walked the tree
+        new, _, n_stale = filter_new(findings, load_baseline(BASELINE))
+        assert not new, "NEW graftlint findings:\n" + "\n".join(
+            f.format() for f in new)
+        # optional hygiene: fixed debt should be removed from the baseline
+        assert n_stale < 25, "baseline has grown badly stale — regenerate"
+
+    def test_cli_exit_codes(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f(x):\n    return x + 1\n")
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def f(x):\n    return float(jnp.sum(x))\n")
+        cli = [sys.executable, str(REPO / "tools" / "graftlint.py")]
+        r = subprocess.run(cli + [str(clean), "--no-baseline", "--root",
+                                  str(tmp_path)], capture_output=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(cli + [str(dirty), "--no-baseline", "--json",
+                                  "--root", str(tmp_path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 1
+        payload = json.loads(r.stdout)
+        assert payload["ok"] is False
+        assert payload["by_rule"].get("GL001") == 1
+
+    def test_cli_baseline_update_then_clean(self, tmp_path):
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax.numpy as jnp\n\n"
+            "def f(x):\n    return float(jnp.sum(x))\n")
+        base = tmp_path / "base.json"
+        cli = [sys.executable, str(REPO / "tools" / "graftlint.py"),
+               str(dirty), "--baseline", str(base), "--root", str(tmp_path)]
+        assert subprocess.run(cli + ["--update-baseline"],
+                              capture_output=True).returncode == 0
+        assert subprocess.run(cli, capture_output=True).returncode == 0
+
+    def test_cli_list_rules(self):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "graftlint.py"),
+             "--list-rules"], capture_output=True, text=True)
+        assert r.returncode == 0
+        for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+                    "GL007", "GL008"):
+            assert rid in r.stdout
+
+
+# --------------------------------------------------------------------------- #
+# jit-cache guard (dynamic companion)
+# --------------------------------------------------------------------------- #
+
+
+class TestJitCacheGuard:
+    def test_cached_call_passes(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones((4,)))  # warm
+        with jit_cache_guard("cached call") as g:
+            f(jnp.ones((4,)))
+        assert g.compiles == 0
+
+    def test_recompile_raises_with_diagnostics(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones((2,)))
+        with pytest.raises(RecompileError, match="jit cache regression"):
+            with jit_cache_guard("shape wobble"):
+                f(jnp.ones((3,)))  # new shape → backend compile
+
+    def test_allowed_budget_tolerates_known_compiles(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x - 1)
+        x = jnp.ones((5,))  # materialize outside: ones() is a compile too
+        with JitCacheGuard("first use", allowed=1) as g:
+            f(x)
+        assert g.compiles == 1
+
+    def test_guard_does_not_mask_inner_exception(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 3)
+        with pytest.raises(ValueError, match="inner"):
+            with jit_cache_guard("exception passthrough"):
+                f(jnp.ones((7,)))  # compiles, but the real error wins
+                raise ValueError("inner")

@@ -74,6 +74,45 @@ class TestSGDAdam:
         opt.step()
         np.testing.assert_allclose(npt(p), [-0.6, -0.8], rtol=1e-5)
 
+    def test_grad_clip_global_norm_below_threshold_is_identity(self):
+        """Grads under the norm must pass through exactly (the unconditional
+        min(scale,1) multiply in the traced form must not perturb them)."""
+        p = paddle.framework.Parameter(np.zeros(2, np.float32))
+        clip = nn.ClipGradByGlobalNorm(100.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        p.grad = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        opt.step()
+        np.testing.assert_allclose(npt(p), [-3.0, -4.0], rtol=1e-6)
+
+    def test_grad_clip_by_norm_per_tensor(self):
+        """ClipGradByNorm scales each grad by ITS OWN norm (not global)."""
+        p1 = paddle.framework.Parameter(np.zeros(2, np.float32))
+        p2 = paddle.framework.Parameter(np.zeros(1, np.float32))
+        clip = nn.ClipGradByNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                            grad_clip=clip)
+        p1.grad = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        p2.grad = paddle.to_tensor(np.array([0.5], np.float32))
+        opt.step()
+        np.testing.assert_allclose(npt(p1), [-0.6, -0.8], rtol=1e-5)
+        np.testing.assert_allclose(npt(p2), [-0.5], rtol=1e-5)  # under norm
+
+    def test_clip_grad_norm_functional(self):
+        """nn.utils-style clip_grad_norm_: traced L2 and inf-norm paths."""
+        from paddle_tpu.nn.clip import clip_grad_norm_
+
+        p = paddle.framework.Parameter(np.zeros(2, np.float32))
+        p.grad = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        total = clip_grad_norm_([p], max_norm=1.0)
+        np.testing.assert_allclose(float(total), 5.0, rtol=1e-5)
+        np.testing.assert_allclose(npt(p.grad), [0.6, 0.8], rtol=1e-4)
+
+        q = paddle.framework.Parameter(np.zeros(2, np.float32))
+        q.grad = paddle.to_tensor(np.array([-8.0, 2.0], np.float32))
+        total = clip_grad_norm_([q], max_norm=4.0, norm_type=float("inf"))
+        np.testing.assert_allclose(float(total), 8.0, rtol=1e-5)
+        np.testing.assert_allclose(npt(q.grad), [-4.0, 1.0], rtol=1e-4)
+
 
 class TestConvergence:
     def test_linear_regression_converges(self):

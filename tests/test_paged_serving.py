@@ -192,6 +192,35 @@ def test_submit_validation(cache):
     assert len(out[rid]) == 5
 
 
+@pytest.mark.graftlint
+def test_paged_decode_steady_state_zero_recompiles():
+    """jit-cache regression guard on the paged decode loop: after a full
+    warm-up generation (chunked prefill + decode + refill all compiled
+    once), a SECOND wave of requests — different lengths, slot churn,
+    prefix-cache misses — must run with ZERO backend compiles. A shape or
+    dtype that wobbles per tick (table width, mask dtype, un-donated pool)
+    would recompile every step and show up here, not on the TPU bill."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8)
+    rng = np.random.RandomState(3)
+    warm = [rng.randint(1, cfg.vocab_size, (n,)).tolist() for n in (5, 12)]
+    for p in warm:
+        srv.submit(p, max_new_tokens=8)
+    srv.run()  # compiles _chunk_prefill, _decode_paged, sampling epilogue
+
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (7, 3, 20, 9)]
+    rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    with jit_cache_guard("paged serving steady state") as g:
+        out = srv.run()
+    assert g.compiles == 0
+    for r, p in zip(rids, prompts):
+        assert len(out[r]) == len(p) + 8
+
+
 def test_serving_benchmark_paged_smoke():
     """tools/serving_benchmark.py --paged --json emits one machine-readable
     JSON line with tok/s and the peak-block stat (quick-tier CPU smoke of

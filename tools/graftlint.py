@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""graftlint CLI — JAX/TPU tracing-safety static analyzer.
+
+Usage:
+    python tools/graftlint.py paddle_tpu              # lint against baseline
+    python tools/graftlint.py paddle_tpu --json       # machine-readable
+    python tools/graftlint.py paddle_tpu --update-baseline
+    python tools/graftlint.py --list-rules
+
+Exit codes: 0 = clean (all findings baselined/suppressed), 1 = new
+violations, 2 = usage/internal error.
+
+Importing paddle_tpu.analysis pulls no jax — the linter runs anywhere
+(pre-commit, CI containers without an accelerator runtime).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from paddle_tpu.analysis import (all_rules, analyze_paths, build_baseline,
+                                 filter_new, load_baseline, save_baseline)
+
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "graftlint_baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                    help="files/directories to lint (default: paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file (default: tools/graftlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings and exit 0")
+    ap.add_argument("--root", default=str(REPO_ROOT),
+                    help="root for repo-relative paths (default: repo root)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}  {r.name:<16} {r.description}")
+        return 0
+
+    paths = args.paths or ["paddle_tpu"]
+    try:
+        findings, n_files, n_sup = analyze_paths(paths, root=Path(args.root))
+    except OSError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        save_baseline(args.baseline, build_baseline(findings))
+        print(f"graftlint: baseline updated — {len(findings)} finding(s) "
+              f"across {n_files} file(s) -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, n_base, n_stale = filter_new(findings, baseline)
+
+    if args.as_json:
+        by_rule = Counter(f.rule_id for f in new)
+        print(json.dumps({
+            "files": n_files,
+            "findings": len(findings),
+            "new": [f.__dict__ for f in new],
+            "baselined": n_base,
+            "suppressed": n_sup,
+            "stale_baseline_entries": n_stale,
+            "by_rule": dict(sorted(by_rule.items())),
+            "ok": not new,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        print(f"graftlint: {n_files} files, {len(findings)} finding(s): "
+              f"{len(new)} new, {n_base} baselined, {n_sup} suppressed"
+              + (f", {n_stale} stale baseline entries "
+                 f"(run --update-baseline)" if n_stale else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,14 @@ set -u
 mkdir -p /tmp/tpu_runs
 cd "$(dirname "$0")/.."
 
+echo "== 0. graftlint (tracing-safety gate; fails on NEW findings only) =="
+if ! python tools/graftlint.py paddle_tpu > /tmp/tpu_runs/graftlint.log 2>&1; then
+  tail -10 /tmp/tpu_runs/graftlint.log
+  echo "graftlint found new tracer-unsafe code — fix or baseline before burning chip time"
+  exit 1
+fi
+tail -2 /tmp/tpu_runs/graftlint.log
+
 echo "== 1. probe =="
 timeout 120 python -c "import jax; ds=jax.devices(); print('DEVOK', ds[0].platform, len(ds))" \
   || { echo "TPU unreachable — aborting"; exit 1; }
