@@ -254,3 +254,5 @@ def load_predictor(path: str) -> Predictor:
 
 from .paged_cache import BlockAllocator  # noqa: E402,F401
 from .serving import GenerationServer  # noqa: E402,F401
+from .speculative import (DraftModelDrafter, NgramDrafter,  # noqa: E402,F401
+                          SpecConfig)

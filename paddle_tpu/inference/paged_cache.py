@@ -132,6 +132,17 @@ class BlockAllocator:
         else:
             self._free.append(bid)
 
+    def truncate(self, table: List[int], n_tokens: int) -> List[int]:
+        """Refcount-safely release the tail of ``table`` so it covers only
+        ``n_tokens`` positions — the speculative ROLLBACK primitive: blocks
+        reserved for drafted tokens that the verify step rejected go back
+        through :meth:`free` (shared prefix blocks just drop a ref; hashed
+        blocks land on the LRU). Returns the kept prefix of ``table``."""
+        keep = -(-n_tokens // self.block_size)  # ceil; 0 tokens keeps none
+        for bid in table[keep:]:
+            self.free(bid)
+        return list(table[:keep])
+
     # --------------------------------------------------------- prefix caching
     def chain_hashes(self, tokens: Sequence[int]) -> List[int]:
         """Chained content hash per FULL block of ``tokens``."""

@@ -54,6 +54,7 @@ class _Request:
     top_p: float = 0.0
     generated: List[int] = field(default_factory=list)
     done: bool = False
+    draft_k: Optional[int] = None                    # per-request spec budget
     # paged-path state
     table: List[int] = field(default_factory=list)   # block ids, in order
     hashes: List[int] = field(default_factory=list)  # chain hash per full blk
@@ -78,7 +79,7 @@ class GenerationServer:
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  tick_window: int = 1, cache: str = "dense",
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 prefill_chunk: int = 32):
+                 prefill_chunk: int = 32, spec=None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -92,11 +93,25 @@ class GenerationServer:
         block; ``num_blocks`` bounds total KV memory (default: dense
         parity, ``max_batch·ceil(max_len/block_size)+1``); prompts prefill
         in fixed ``prefill_chunk``-token chunks (rounded up to a block
-        multiple). ``prompt_buckets`` is ignored on the paged path."""
+        multiple). ``prompt_buckets`` is ignored on the paged path.
+
+        ``spec=SpecConfig(k=4)``: speculative decoding on the paged path —
+        a drafter proposes k tokens per tick and ONE compiled verify
+        program scores all k+1 window positions with exact accept/reject
+        (greedy output token-exact vs the plain server; sampling output
+        distribution provably unchanged). Requires ``cache='paged'`` and
+        ``tick_window=1``. See inference/speculative.py, docs/serving.md."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
             raise ValueError(f"cache must be 'dense' or 'paged', got {cache!r}")
+        self.spec = None
+        if spec is not None:
+            if cache != "paged":
+                raise ValueError(
+                    "spec= (speculative decoding) requires cache='paged'")
+            spec.validate()
+            self.spec = spec
         self.model = model
         self.cfg = cfg
         self.cache_mode = cache
@@ -156,21 +171,79 @@ class GenerationServer:
             self._max_entries = entries
             # slack entries (always 0 = scratch) so the chunk's table
             # dynamic_slice never clamps and window-surplus decode writes
-            # past max_len land in scratch instead of a live block
-            self._table_width = entries + self.prefill_chunk // bs
+            # past max_len land in scratch instead of a live block; the
+            # speculative verify window writes k+1 positions per tick, so
+            # its surplus past max_len can be wider than one chunk's
+            slack = self.prefill_chunk // bs
+            if self.spec is not None:
+                # a fused spec trip writes up to tick_window (or turbo)
+                # windows of k+1 positions past a row's last live
+                # position; a gated plain trip writes gate_ticks positions
+                wmax = max(self.tick_window, int(self.spec.turbo_windows))
+                slack = max(slack, -(-(wmax * (int(self.spec.k) + 1)) // bs),
+                            -(-int(self.spec.gate_ticks) // bs))
+            self._table_width = entries + slack
             if num_blocks is None:
                 num_blocks = max_batch * entries + 1  # dense parity + scratch
             self.alloc = BlockAllocator(int(num_blocks), bs)
             self._pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
                            for _ in range(2 * cfg.num_hidden_layers)]
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
+            # device-side mirror of (temps, topks, topps[, kcaps]): these
+            # change only when a slot activates/releases, but were being
+            # re-uploaded every trip (~0.1ms eager dispatch each)
+            self._samp_dev = None
             # True while the slot is streaming prompt chunks; None once the
             # slot decodes (or is empty)
             self._prefilling: List[Optional[bool]] = [None] * max_batch
+            # ``greedy`` (the trailing static arg) specializes the program
+            # for all-temp-0 ticks: XLA folds the whole sampling pipeline
+            # (top-k/top-p filtering = per-row sorts over the vocab) down
+            # to one argmax — measured ~2.3ms/window at CPU bench shapes.
+            # At most two variants ever compile (greedy / mixed).
             self._decode_paged = jax.jit(self._decode_paged_fn,
-                                         donate_argnums=(2,))
+                                         donate_argnums=(2,),
+                                         static_argnums=(10, 11))
             self._chunk_prefill = jax.jit(self._chunk_prefill_fn,
                                           donate_argnums=(2,))
+            if self.spec is not None:
+                self.spec_k = int(self.spec.k)
+                self.drafter = self.spec.build_drafter(max_len)
+                # fusible drafters (in-program drafting, e.g. the n-gram
+                # matcher) scan tick_window draft→verify→accept windows in
+                # ONE program per host trip; host-side drafters need a
+                # round trip per window
+                self._spec_fused = bool(getattr(self.drafter, "fusible",
+                                                False))
+                if not self._spec_fused and self.tick_window != 1:
+                    raise ValueError(
+                        f"tick_window={tick_window} with spec= needs an "
+                        f"in-program (fusible) drafter such as 'ngram'; "
+                        f"drafter {type(self.drafter).__name__} proposes "
+                        f"host-side and supports tick_window=1 only")
+                self._spec_windows = self.tick_window if self._spec_fused \
+                    else 1
+                # per-slot draft budget (host-side, like pos/temps): rows
+                # with kcap 0 run a plain decode tick inside the verify
+                # program — idle/prefilling slots are masked this way
+                self.kcaps = np.zeros((max_batch,), np.int32)
+                self._spec_proposed = 0
+                self._spec_accepted = 0
+                # dynamic speculation gate (see SpecConfig.gate_low):
+                # >0 = this many plain-decode trips before the next
+                # speculative probe; turbo = long-trip tier while the
+                # whole batch accepts near-k drafts per window
+                self._spec_gate_off = 0
+                self._spec_plain_windows = 0
+                self._spec_turbo = False
+                if self._spec_fused:
+                    self._spec_scan = jax.jit(self._spec_scan_fn,
+                                              donate_argnums=(2,),
+                                              static_argnums=(11, 12))
+                else:
+                    self._spec_verify = jax.jit(self._spec_verify_fn,
+                                                donate_argnums=(3,),
+                                                static_argnums=(12,))
 
     # ------------------------------------------------------------ compiled fns
     def _head(self, h):
@@ -221,11 +294,16 @@ class GenerationServer:
         return stack, flat
 
     def _decode_paged_fn(self, params, tokens, flat_pools, tables, pos,
-                         temps, topks, topps, active, key):
+                         temps, topks, topps, active, key, greedy=False,
+                         ticks=None):
         """Paged twin of :meth:`_decode_fn`: K/V reads/writes go through
         per-slot block tables into the shared pool. ``tables``: int32
         (B, table_width) — the server zeroes rows of idle/prefilling slots
-        so their masked ticks write only the scratch block."""
+        so their masked ticks write only the scratch block. ``greedy`` is
+        STATIC (jit cache key): True promises every active row has temp 0
+        and compiles sampling down to argmax. ``ticks`` (STATIC) overrides
+        ``tick_window`` — the speculative server's gated plain trips run
+        longer windows than its verify trips (SpecConfig.gate_ticks)."""
         model = self.model
 
         def one_tick(carry, k):
@@ -243,18 +321,21 @@ class GenerationServer:
             for kp, vp in new:
                 flat += [kp.value, vp.value]
             lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
-            from ..models.generation import sample_token_rows
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            else:
+                from ..models.generation import sample_token_rows
 
-            nxt = sample_token_rows(lg, jax.random.fold_in(key, k), temps,
-                                    topks, topps)
+                nxt = sample_token_rows(lg, jax.random.fold_in(key, k),
+                                        temps, topks, topps)
             return (nxt, flat, p + active), nxt
 
-        if self.tick_window == 1:
+        n = self.tick_window if ticks is None else ticks
+        if n == 1:
             (_, flat, _), stack = one_tick((tokens, flat_pools, pos), 0)
             return stack[None], flat
         (_, flat, _), stack = jax.lax.scan(
-            one_tick, (tokens, flat_pools, pos),
-            jnp.arange(self.tick_window))
+            one_tick, (tokens, flat_pools, pos), jnp.arange(n))
         return stack, flat
 
     def _chunk_prefill_fn(self, params, chunk, flat_pools, table, start,
@@ -279,6 +360,114 @@ class GenerationServer:
         for kp, vp in new:
             flat += [kp.value, vp.value]
         return logits.value[:, 0].astype(jnp.float32), flat
+
+    def _spec_verify_fn(self, params, tokens, proposals, flat_pools, tables,
+                        pos, temps, topks, topps, kcaps, key, qprobs,
+                        greedy=False):
+        """ONE fused speculative tick: target-score the whole window
+        [current token, k drafts] through the paged verify path, then run
+        exact accept/reject — all on device, so the host sees only the
+        (B, W) emitted-token block and the (B,) accepted counts (one sync
+        per tick, same as plain decode). ``qprobs`` is None for
+        deterministic drafters (one-hot q synthesized inside the program);
+        per-row ``kcaps`` force-stop lets requests run mixed draft_k (and
+        masks idle slots at kcap 0) without changing compiled shapes."""
+        model = self.model
+        pools = [(Tensor(flat_pools[2 * i]), Tensor(flat_pools[2 * i + 1]))
+                 for i in range(self.cfg.num_hidden_layers)]
+        window = jnp.concatenate([tokens[:, None], proposals], axis=1)
+
+        def call():
+            h, new = model.model.paged_verify_step(Tensor(window), pools,
+                                                   tables, pos)
+            return self._head(h), new
+
+        logits, new = functional_call(model, params, call_fn=call)
+        flat = []
+        for kp, vp in new:
+            flat += [kp.value, vp.value]
+        from .speculative import speculative_accept
+
+        out, acc = speculative_accept(
+            logits.value.astype(jnp.float32), proposals, temps, topks,
+            topps, kcaps, key, qprobs, greedy=greedy)
+        return out, acc, flat
+
+    def _spec_scan_fn(self, params, ctx, flat_pools, tables, pos, temps,
+                      topks, topps, kcaps, active, key, greedy=False,
+                      windows=None):
+        """``tick_window`` speculative windows as ONE compiled program —
+        the drafter runs IN-PROGRAM (``drafter.propose_device``, e.g. the
+        jnp prompt-lookup matcher), so draft → multi-token verify → exact
+        accept → context/position update runs on device and the host pays
+        one round trip per ``tick_window·(k+1)`` potential tokens.
+        ``ctx``: int32 (B, max_len), row b's prompt+generated tokens
+        valid through index ``pos[b]`` — accepted tokens are appended to
+        it after each window so the next window drafts from them.
+        Emitted-token surplus past eos/max-new is discarded by the host
+        harvest, exactly like the plain ``tick_window`` decode scan.
+        ``windows`` (STATIC) overrides the per-trip window count — the
+        turbo tier of the speculation gate (SpecConfig.turbo_windows)
+        runs long trips while the whole batch is accepting near-k."""
+        model = self.model
+        k = self.spec_k
+        W = k + 1
+        B, L = ctx.shape
+        S = self._spec_windows if windows is None else windows
+        rows = jnp.arange(B)
+        from .speculative import speculative_accept
+
+        def one_window(carry, w):
+            c, flat_p, p = carry
+            pools = [(Tensor(flat_p[2 * i]), Tensor(flat_p[2 * i + 1]))
+                     for i in range(self.cfg.num_hidden_layers)]
+            cur = jnp.take_along_axis(c, p[:, None], axis=1)      # (B, 1)
+            proposals = self.drafter.propose_device(c, p, k)
+            window = jnp.concatenate([cur, proposals], axis=1)
+
+            def call():
+                h, new = model.model.paged_verify_step(Tensor(window),
+                                                       pools, tables, p)
+                return self._head(h), new
+
+            logits, new = functional_call(model, params, call_fn=call)
+            flat = []
+            for kp, vp in new:
+                flat += [kp.value, vp.value]
+            out, acc = speculative_accept(
+                logits.value.astype(jnp.float32), proposals, temps, topks,
+                topps, kcaps, jax.random.fold_in(key, w), None,
+                greedy=greedy)
+            # append the emitted tokens (accepted drafts + correction) to
+            # the context so the next window drafts from them; clamped
+            # writes past L-1 only touch rows the harvest will release
+            widx = jnp.minimum(p[:, None] + 1 + jnp.arange(W)[None, :],
+                               L - 1)
+            keep = ((jnp.arange(W)[None, :] <= acc[:, None])
+                    & (active > 0)[:, None])
+            vals = jnp.where(keep, out, jnp.take_along_axis(c, widx, axis=1))
+            c = c.at[rows[:, None], widx].set(vals)
+            # clamp: only surplus windows past max_len (discarded by the
+            # harvest) ever hit L-1 — without it the ``cur`` gather goes
+            # out of bounds (fill-mode -> garbage token id -> NaN
+            # embedding) and the NaN K/V written to scratch poisons every
+            # row whose table padding points there (0 * NaN in p @ V)
+            p = jnp.minimum(p + (acc + 1) * active, L - 1)
+            return (c, flat, p), (out, acc)
+
+        # UNROLLED, not lax.scan/while_loop: on CPU the loop constructs
+        # copy the multi-MB KV pools through the carry every trip (~ms of
+        # pure memcpy); straight-line code lets XLA alias the pool
+        # buffers through all S windows for free. S is small and static,
+        # so program size stays modest and the jit cache sees one shape.
+        carry = (ctx, flat_pools, pos)
+        outs, accs = [], []
+        for w in range(S):
+            carry, (out, acc) = one_window(carry, w)
+            outs.append(out)
+            accs.append(acc)
+        _, flat, _ = carry
+        return jnp.stack(outs), jnp.stack(accs), flat
 
     def _prefill(self, bucket: int):
         """Dense-path prefill + slot scatter as ONE jitted call (donated
@@ -318,7 +507,7 @@ class GenerationServer:
     # --------------------------------------------------------------- requests
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0) -> int:
+               top_p: float = 0.0, draft_k: Optional[int] = None) -> int:
         prompt = list(prompt)
         if not prompt:
             raise ValueError("prompt must contain at least one token id")
@@ -344,13 +533,28 @@ class GenerationServer:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         if not 0.0 <= top_p <= 1.0:
             raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if draft_k is not None:
+            if self.spec is None:
+                raise ValueError(
+                    "draft_k= requires a server built with "
+                    "spec=SpecConfig(...)")
+            if isinstance(draft_k, bool) or \
+                    not isinstance(draft_k, (int, np.integer)) or draft_k < 0:
+                raise ValueError(
+                    f"draft_k must be an int >= 0, got {draft_k!r}")
+            if draft_k > self.spec_k:
+                raise ValueError(
+                    f"draft_k ({draft_k}) exceeds spec.k ({self.spec_k}) — "
+                    f"the compiled verify-window width; raise SpecConfig.k")
+            draft_k = int(draft_k)
         if self.cache_mode == "dense":
             self._bucket_for(len(prompt))  # validate against buckets up front
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt, int(max_new_tokens),
                                     temperature=float(temperature),
-                                    top_k=int(top_k), top_p=float(top_p)))
+                                    top_k=int(top_k), top_p=float(top_p),
+                                    draft_k=draft_k))
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -363,7 +567,11 @@ class GenerationServer:
     def _first_token(self, req: _Request, lg) -> int:
         """Sample the first generated token from prefill logits (1, V) —
         same ``next_token`` as model.generate, so temperature/top_k/top_p
-        semantics match; one host sync per assignment."""
+        semantics match; one host sync per assignment. Greedy requests
+        skip the eager sampling-op chain (fold_in + filtering, ~1ms of
+        dispatch per admission) for a host argmax — same token."""
+        if req.temperature == 0.0:
+            return int(np.argmax(np.asarray(lg[0])))
         from ..models.generation import next_token
 
         key = jax.random.fold_in(self._base_key, (req.rid << 20) | 1)
@@ -377,7 +585,23 @@ class GenerationServer:
         self.temps[slot] = req.temperature
         self.topks[slot] = req.top_k
         self.topps[slot] = req.top_p
+        if self.spec is not None:
+            self.kcaps[slot] = (self.spec_k if req.draft_k is None
+                                else req.draft_k)
+        if self.cache_mode == "paged":
+            self._samp_dev = None
         req.generated.append(first)
+
+    def _samp_arrays(self):
+        """Device copies of the per-slot sampling params (+ draft caps),
+        re-uploaded only after a slot transition."""
+        if self._samp_dev is None:
+            kc = (jnp.asarray(self.kcaps) if self.spec is not None
+                  else None)
+            self._samp_dev = (jnp.asarray(self.temps),
+                              jnp.asarray(self.topks),
+                              jnp.asarray(self.topps), kc)
+        return self._samp_dev
 
     def _assign(self, slot: int, req: _Request) -> None:
         n = len(req.prompt)
@@ -451,6 +675,13 @@ class GenerationServer:
             self._activate_slot(slot, req, self._first_token(req, lg))
             self._prefilling[slot] = None
 
+    def _all_greedy(self, rows) -> bool:
+        """True iff every listed slot decodes at temperature 0 — the
+        STATIC specialization key for the decode/verify programs (temp 0
+        rows ignore top-k/top-p, so temps alone decides). Flipping the
+        flag costs one extra compile, then both variants are cached."""
+        return all(float(self.temps[s]) == 0.0 for s in rows)
+
     def _step_paged(self) -> int:
         self._fill_free_slots()
         # chunked prefill interleaves with decode: ONE chunk per prefilling
@@ -463,24 +694,210 @@ class GenerationServer:
                   if self._slots[s] is not None and not self._prefilling[s]]
         if active:
             self._step_no += 1
-            key = jax.random.fold_in(self._base_key, self._step_no)
-            k = self.tick_window
-            for s in active:
-                self._ensure_blocks(s, -(-(int(self.pos[s]) + k) //
-                                         self.block_size))
-            active_mask = np.zeros((self.max_batch,), np.int32)
-            active_mask[active] = 1
-            # idle/prefilling rows run masked: zeroed table + pos 0 routes
-            # their (discarded) cache writes to the scratch block
-            bt = np.where(active_mask[:, None] > 0, self._bt, 0)
-            posv = self.pos * active_mask
-            stack, self._pools = self._decode_paged(
-                self.params, jnp.asarray(self.tokens), self._pools,
-                jnp.asarray(bt), jnp.asarray(posv), jnp.asarray(self.temps),
-                jnp.asarray(self.topks), jnp.asarray(self.topps),
-                jnp.asarray(active_mask), key)
-            self._harvest_window(np.asarray(stack), active, active_mask)
+            # the greedy-specialized programs never read the key — skip
+            # the per-step eager fold_in dispatch (~0.4ms) for it
+            key = (self._base_key if self._all_greedy(active)
+                   else jax.random.fold_in(self._base_key, self._step_no))
+            if self.spec is not None:
+                # dynamic speculation gate: while recent acceptance is
+                # below spec.gate_low, drafts are a net loss (a verify
+                # window costs ~(k+1)x a decode tick but advances 1 token
+                # when all drafts miss) — run the plain decode program
+                # for spec.gate_cooldown trips, then probe again. Both
+                # programs compile during warmup; switching is free.
+                if self._spec_gate_off > 0:
+                    self._spec_gate_off -= 1
+                    self._spec_plain_windows += self.spec.gate_ticks
+                    self._plain_decode_trip(active, key,
+                                            self.spec.gate_ticks)
+                else:
+                    self._spec_tick(active, key)
+                return (sum(sl is not None for sl in self._slots)
+                        + len(self._queue))
+            self._plain_decode_trip(active, key)
         return sum(sl is not None for sl in self._slots) + len(self._queue)
+
+    def _plain_decode_trip(self, active, key, ticks=None) -> None:
+        """One plain (non-speculative) decode trip: ``ticks`` (default
+        ``tick_window``) ticks in one compiled program across the listed
+        slots."""
+        k = self.tick_window if ticks is None else ticks
+        for s in active:
+            self._ensure_blocks(s, -(-(int(self.pos[s]) + k) //
+                                     self.block_size))
+        active_mask = np.zeros((self.max_batch,), np.int32)
+        active_mask[active] = 1
+        # idle/prefilling rows run masked: zeroed table + pos 0 routes
+        # their (discarded) cache writes to the scratch block
+        bt = np.where(active_mask[:, None] > 0, self._bt, 0)
+        posv = self.pos * active_mask
+        temps, topks, topps, _ = self._samp_arrays()
+        stack, self._pools = self._decode_paged(
+            self.params, jnp.asarray(self.tokens), self._pools,
+            jnp.asarray(bt), jnp.asarray(posv), temps, topks, topps,
+            jnp.asarray(active_mask), key, self._all_greedy(active), ticks)
+        self._harvest_window(np.asarray(stack), active, active_mask)
+
+    # ----------------------------------------------------------- speculative
+    def _spec_tick(self, active, key) -> None:
+        """One speculative server tick: draft k tokens per decoding slot,
+        verify all k+1 window positions in one fused program, accept/reject
+        exactly — emitting 1..k+1 tokens per slot per window with the same
+        compiled shapes every tick regardless of acceptance. Fusible
+        drafters scan ``tick_window`` whole windows on device per host
+        round trip; host-side drafters run one window per trip."""
+        k = self.spec_k
+        S = self._spec_windows
+        if self._spec_turbo and self.spec.turbo_windows > S:
+            S = self.spec.turbo_windows
+        # reserve blocks for every window of the trip up front (speculative
+        # append); rejected-draft tail entries are truncated back in harvest
+        for s in active:
+            self._ensure_blocks(s, -(-(int(self.pos[s]) + S * (k + 1)) //
+                                     self.block_size))
+        active_mask = np.zeros((self.max_batch,), np.int32)
+        active_mask[active] = 1
+        bt = np.where(active_mask[:, None] > 0, self._bt, 0)
+        posv = self.pos * active_mask
+        # nonzero kcaps exist only on activated, unreleased slots — exactly
+        # the active set — so the cached device kcaps already carries the
+        # idle/prefilling row masking
+        temps, topks, topps, kcaps = self._samp_arrays()
+        if self._spec_fused:
+            ctx = np.zeros((self.max_batch, self.max_len), np.int32)
+            for s in active:
+                req = self._slots[s]
+                toks = req.prompt + req.generated
+                ctx[s, :len(toks)] = toks
+            outs, accs, self._pools = self._spec_scan(
+                self.params, jnp.asarray(ctx), self._pools,
+                jnp.asarray(bt), jnp.asarray(posv), temps, topks, topps,
+                kcaps, jnp.asarray(active_mask), key,
+                self._all_greedy(active), S)
+        else:
+            contexts: List[Optional[List[int]]] = [None] * self.max_batch
+            for s in active:
+                req = self._slots[s]
+                contexts[s] = req.prompt + req.generated
+            proposals, qprobs = self.drafter.propose(
+                contexts, k, temps=self.temps,
+                key=jax.random.fold_in(key, 1))
+            out, acc, self._pools = self._spec_verify(
+                self.params, jnp.asarray(self.tokens),
+                jnp.asarray(proposals), self._pools, jnp.asarray(bt),
+                jnp.asarray(posv), temps, topks, topps,
+                kcaps, jax.random.fold_in(key, 2),
+                None if qprobs is None else jnp.asarray(qprobs),
+                self._all_greedy(active))
+            outs, accs = np.asarray(out)[None], np.asarray(acc)[None]
+        accs = np.asarray(accs)
+        self._harvest_spec(np.asarray(outs), accs, active)
+        if self.spec.gate_cooldown:
+            m = float(accs[:, active].mean())
+            # below gate_low mean accepted drafts/window, drafting is a
+            # net loss — fall back to plain decode, probe again later
+            if m < self.spec.gate_low:
+                self._spec_gate_off = self.spec.gate_cooldown
+                self._spec_turbo = False
+            else:
+                # near-k acceptance across the batch: switch to long
+                # trips (turbo_windows per program) so the host round
+                # trip amortizes over many more emitted tokens
+                self._spec_turbo = (self._spec_fused
+                                    and self.spec.turbo_windows > 0
+                                    and m >= self.spec_k - 1)
+
+    def _harvest_spec(self, outs, accs, active) -> None:
+        """Fold a trip's verify windows into per-request state. Window w of
+        row ``s`` emits ``outs[w, s, :accs[w, s]+1]`` (accepted drafts,
+        then one correction/bonus) — appended under the exact same
+        eos/max-new/max-len walk as :meth:`_harvest_window`, so an eos
+        inside a window truncates the bonus token and later drafts (and
+        any later windows) and final results match the plain server token
+        for token. Surviving slots advance ``pos`` by the emitted count
+        and give back the blocks reserved for rejected drafts
+        (``BlockAllocator.truncate`` — refcount-safe rollback; the
+        rejected positions' stale K/V is overwritten by the next window
+        before any query can attend it)."""
+        S = outs.shape[0]
+        for s in active:
+            req = self._slots[s]
+            kcap = int(self.kcaps[s])
+            new_pos = int(self.pos[s])
+            last_tok = int(self.tokens[s])
+            done = False
+            if self.eos is None:
+                # no-eos fast path: the only stop conditions are budget
+                # counters, so each window's emission is a slice — skips
+                # the per-token python walk (~1ms/trip at bench shapes)
+                gen = req.generated
+                for w in range(S):
+                    a = int(accs[w, s])
+                    self._spec_proposed += kcap
+                    self._spec_accepted += a
+                    limit = min(req.max_new_tokens - len(gen),
+                                self.max_len - 1 - new_pos)
+                    take = a + 1
+                    if take >= limit:
+                        take = limit
+                        done = True
+                    # outs is host numpy by the time harvest runs — the
+                    # one sync already happened in _spec_tick
+                    gen.extend(outs[w, s, :take].tolist())  # graftlint: noqa[host-sync]
+                    new_pos += take
+                    if done:
+                        break
+                if done:
+                    self._results[req.rid] = req.prompt + gen[
+                        :req.max_new_tokens]
+                    self._release_slot(s)
+                else:
+                    self.pos[s] = new_pos
+                    self.tokens[s] = gen[-1]
+                    req.table = self.alloc.truncate(req.table, new_pos)
+                    self._bt[s, len(req.table):] = 0
+                continue
+            for w in range(S):
+                a = int(accs[w, s])
+                self._spec_proposed += kcap
+                self._spec_accepted += a
+                for j in range(a + 1):
+                    tok = int(outs[w, s, j])
+                    finished_last = (self.eos is not None and
+                                     req.generated[-1] == self.eos)
+                    if not finished_last:
+                        req.generated.append(tok)
+                    pos_t = new_pos + j + 1
+                    if (finished_last
+                            or len(req.generated) >= req.max_new_tokens
+                            or pos_t >= self.max_len - 1):
+                        done = True
+                        break
+                if done:
+                    break
+                new_pos += a + 1
+                last_tok = int(outs[w, s, a])
+            if done:
+                self._results[req.rid] = req.prompt + req.generated[
+                    :req.max_new_tokens]
+                self._release_slot(s)
+            else:
+                self.pos[s] = new_pos
+                self.tokens[s] = last_tok
+                req.table = self.alloc.truncate(req.table, new_pos)
+                self._bt[s, len(req.table):] = 0
+
+    def spec_metrics(self) -> Dict[str, float]:
+        """Draft/accept counters for the speculative path (empty when
+        spec is off). ``acceptance_rate`` = accepted / proposed drafts."""
+        if self.spec is None:
+            return {}
+        prop = self._spec_proposed
+        return {"draft_tokens_proposed": prop,
+                "draft_tokens_accepted": self._spec_accepted,
+                "acceptance_rate":
+                    (self._spec_accepted / prop) if prop else 0.0,
+                "gated_plain_windows": self._spec_plain_windows}
 
     def _release_slot(self, slot: int) -> None:
         req = self._slots[slot]
@@ -496,6 +913,9 @@ class GenerationServer:
             self.temps[slot] = 0.0
             self.topks[slot] = 0
             self.topps[slot] = 0.0
+            if self.spec is not None:
+                self.kcaps[slot] = 0
+            self._samp_dev = None
 
     def kv_stats(self) -> Dict[str, int]:
         """Paged-pool occupancy/prefix-cache counters (empty for dense)."""
@@ -517,6 +937,23 @@ class GenerationServer:
         for s in active:
             req = self._slots[s]
             done = False
+            if self.eos is None:
+                # no-eos fast path (see _harvest_spec): emission is one
+                # slice per window instead of a per-token python walk
+                gen = req.generated
+                limit = min(req.max_new_tokens - len(gen),
+                            self.max_len - 1 - (int(pos_after[s]) - k))
+                take = k
+                if take >= limit:
+                    take = limit
+                    done = True
+                # nxt_host is host numpy — the window's one sync is done
+                gen.extend(nxt_host[:take, s].tolist())  # graftlint: noqa[host-sync]
+                if done:
+                    self._results[req.rid] = req.prompt + gen[
+                        :req.max_new_tokens]
+                    self._release_slot(s)
+                continue
             for t in range(k):
                 tok = int(nxt_host[t, s])
                 finished_last = (self.eos is not None and

@@ -43,15 +43,13 @@ def next_token(logits, rng, temperature: float, top_k: int,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
 
 
-def sample_token_rows(logits, key, temps, top_ks, top_ps):
-    """Per-ROW ``next_token`` for the serving decode tick: row ``i`` uses
-    ``temps[i]`` (0 → greedy argmax), ``top_ks[i]`` (0 → off) and
-    ``top_ps[i]`` (0 → off) — the same filtering math as :func:`next_token`
-    (top-k cutoff at the k-th largest, then nucleus over the filtered
-    distribution), vectorized so one compiled tick can mix greedy and
-    sampled slots. ``logits``: (B, V); temps/top_ps float32 [B], top_ks
-    int32 [B]; ``key`` is consumed directly (the server folds a fresh key
-    per tick)."""
+def filtered_logits_rows(logits, temps, top_ks, top_ps):
+    """Per-row temperature-scaled, top-k/top-p-filtered logits — the
+    filtering core shared by :func:`sample_token_rows` (decode tick) and
+    the speculative verify's target distribution
+    (``inference/speculative.py``), factored out so the two can never
+    drift. Filtered-out entries are ``-1e30``; rows with temp 0 are
+    meaningful only through their argmax (callers keep a greedy branch)."""
     lg = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     V = lg.shape[-1]
     srt = jnp.sort(lg, axis=-1)[:, ::-1]            # descending
@@ -67,7 +65,28 @@ def sample_token_rows(logits, key, temps, top_ks, top_ps):
         axis=-1)
     cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[:, None]
     nucleus = ((top_ps > 0) & (top_ps < 1))[:, None]
-    lg = jnp.where(nucleus & (lg < cutoff), -1e30, lg)
+    return jnp.where(nucleus & (lg < cutoff), -1e30, lg)
+
+
+def filtered_probs_rows(logits, temps, top_ks, top_ps):
+    """Softmax of :func:`filtered_logits_rows` — the exact distribution a
+    sampled row draws from, as probabilities. This is the ``p`` of
+    speculative rejection sampling: accepting against it makes the
+    speculative output distribution provably equal to the dense tick's."""
+    return jax.nn.softmax(filtered_logits_rows(logits, temps, top_ks,
+                                               top_ps), axis=-1)
+
+
+def sample_token_rows(logits, key, temps, top_ks, top_ps):
+    """Per-ROW ``next_token`` for the serving decode tick: row ``i`` uses
+    ``temps[i]`` (0 → greedy argmax), ``top_ks[i]`` (0 → off) and
+    ``top_ps[i]`` (0 → off) — the same filtering math as :func:`next_token`
+    (top-k cutoff at the k-th largest, then nucleus over the filtered
+    distribution), vectorized so one compiled tick can mix greedy and
+    sampled slots. ``logits``: (B, V); temps/top_ps float32 [B], top_ks
+    int32 [B]; ``key`` is consumed directly (the server folds a fresh key
+    per tick)."""
+    lg = filtered_logits_rows(logits, temps, top_ks, top_ps)
     sampled = jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)

@@ -129,6 +129,19 @@ def _apply_rope_rows(x, cos, sin, pos):
     return _rope_rotate(x, c, s)
 
 
+def _apply_rope_window(x, cos, sin, pos):
+    """x: (B, W, H, D) at positions ``pos[:, None] + arange(W)`` with
+    per-row int32 ``pos`` (speculative verify window: every slot's window
+    starts at its own depth). Edge-clamped like :func:`_apply_rope_chunk`:
+    rows past the table are masked window surplus the harvest discards."""
+    W = x.shape[1]
+    idx = jnp.clip(pos[:, None] + jnp.arange(W)[None, :], 0,
+                   cos.shape[0] - 1)                     # (B, W)
+    c = jnp.take(cos, idx, axis=0)[:, :, None, :]
+    s = jnp.take(sin, idx, axis=0)[:, :, None, :]
+    return _rope_rotate(x, c, s)
+
+
 def _apply_rope_chunk(x, cos, sin, start):
     """x: (B, C, H, D) at positions ``start + arange(C)`` with traced
     ``start`` (chunked prefill). Per-row gather with edge-clamp instead of
@@ -530,6 +543,34 @@ class LlamaAttention(Layer):
         out = reshape(out, [B, 1, H * D])
         return self.o_proj(out), kp, vp
 
+    def paged_verify_attn(self, x, cos, sin, kp, vp, block_tables, pos):
+        """Multi-token speculative VERIFY window against the paged pool:
+        K/V for all W = k+1 window tokens scatter through the block table
+        at ``pos..pos+k``; attention gathers context by table with the
+        in-window causal mask (query j sees positions ≤ pos+j). x:
+        (B, W, hidden); block_tables: traced int32 (B, M); pos: traced
+        int32 [B]. At W = 1 this is numerically the paged ``decode`` —
+        which is what makes greedy speculative output token-exact vs the
+        dense server."""
+        B, W = x.shape[0], x.shape[1]
+        H, D = self.num_heads, self.head_dim
+        q, k, v = self._qkv(x, B, W)
+
+        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+            from ..ops.paged_attention import (paged_verify_attention,
+                                               write_window_kv)
+
+            qr = _apply_rope_window(qv, cosv, sinv, pos)
+            kr = _apply_rope_window(kv, cosv, sinv, pos)
+            kpv, vpv = write_window_kv(kpv, vpv, kr, vv, block_tables, pos)
+            out = paged_verify_attention(qr, kpv, vpv, block_tables, pos)
+            return out, kpv, vpv
+
+        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
+                               op_name="paged_verify_attention")
+        out = reshape(out, [B, W, H * D])
+        return self.o_proj(out), kp, vp
+
     def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
         """One fixed-size prefill CHUNK through the paged pool: queries sit
         at positions ``start + arange(C)`` (``start`` traced, block-aligned,
@@ -673,6 +714,13 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, kp, vp
 
+    def paged_verify(self, x, cos, sin, kp, vp, block_tables, pos):
+        a, kp, vp = self.self_attn.paged_verify_attn(
+            self.input_layernorm(x), cos, sin, kp, vp, block_tables, pos)
+        h = x + a
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        return out, kp, vp
+
     def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
         a, kp, vp = self.self_attn.paged_prefill_chunk(
             self.input_layernorm(x), cos, sin, kp, vp, block_table, start)
@@ -757,6 +805,23 @@ class LlamaModel(Layer):
         new = []
         for layer, (kp, vp) in zip(self.layers, pools):
             x, kp, vp = layer.paged_decode(x, self._cos, self._sin, kp, vp,
+                                           block_tables, pos)
+            new.append((kp, vp))
+        return self.norm(x), new
+
+    def paged_verify_step(self, tokens, pools, block_tables, pos):
+        """Speculative verify: score a WINDOW of W = k+1 tokens per row in
+        one program — :meth:`paged_decode_step` generalized from 1 to W
+        positions (W = 1 is plain decode). tokens: Tensor (B, W) = current
+        token followed by the k drafted tokens, at positions
+        ``pos[b] + arange(W)``; pools/block_tables/pos as in
+        :meth:`paged_decode_step`. Returns (normed hidden (B, W, hidden),
+        new pools) — the caller projects to logits for all W positions and
+        runs rejection sampling."""
+        x = self.embed_tokens(tokens)
+        new = []
+        for layer, (kp, vp) in zip(self.layers, pools):
+            x, kp, vp = layer.paged_verify(x, self._cos, self._sin, kp, vp,
                                            block_tables, pos)
             new.append((kp, vp))
         return self.norm(x), new

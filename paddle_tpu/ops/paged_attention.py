@@ -1,4 +1,5 @@
-"""Paged KV-cache attention — block-table decode + chunked-prefill ops.
+"""Paged KV-cache attention — block-table decode, chunked-prefill and
+multi-token (speculative verify) window ops.
 
 The serving KV cache stops being a dense ``(max_batch, max_len, KV, D)``
 slab and becomes a POOL of fixed-size blocks ``(num_blocks, block_size,
@@ -49,21 +50,33 @@ def gather_block_kv(pool, block_tables):
     return gathered.reshape(b, m * bs, *pool.shape[2:])
 
 
-def write_decode_kv(k_pool, v_pool, k, v, block_tables, pos):
-    """Scatter ONE new token's K/V per row through the block table.
+def write_window_kv(k_pool, v_pool, k, v, block_tables, pos):
+    """Scatter a WINDOW of new tokens' K/V per row through the block table.
 
-    k/v: (B, KV, D); block_tables: (B, M); pos: int32 (B,) — token
-    position of each row. Writes land at ``(table[b, pos//bs], pos%bs)``.
-    Rows the server parked on the scratch block (idle/prefilling slots)
-    harmlessly overwrite scratch.
+    k/v: (B, W, KV, D); block_tables: (B, M); pos: int32 (B,) — row ``b``'s
+    token ``j`` lands at position ``pos[b] + j``, i.e. at
+    ``(table[b, (pos+j)//bs], (pos+j)%bs)``. W = 1 is the plain decode
+    write; W = k+1 is the speculative verify window (positions past the
+    accepted prefix hold rejected-token K/V that the NEXT window
+    overwrites before any query can attend it). Rows the server parked on
+    the scratch block (idle/prefilling slots) harmlessly overwrite
+    scratch.
     """
     bs = k_pool.shape[1]
-    rows = jnp.arange(block_tables.shape[0])
-    bid = block_tables[rows, pos // bs]
-    off = pos % bs
+    W = k.shape[1]
+    pj = pos[:, None] + jnp.arange(W)[None, :]          # (B, W)
+    bid = jnp.take_along_axis(block_tables, pj // bs, axis=1)
+    off = pj % bs
     k_pool = k_pool.at[bid, off].set(k.astype(k_pool.dtype))
     v_pool = v_pool.at[bid, off].set(v.astype(v_pool.dtype))
     return k_pool, v_pool
+
+
+def write_decode_kv(k_pool, v_pool, k, v, block_tables, pos):
+    """Scatter ONE new token's K/V per row — :func:`write_window_kv` at
+    W = 1. k/v: (B, KV, D)."""
+    return write_window_kv(k_pool, v_pool, k[:, None], v[:, None],
+                           block_tables, pos)
 
 
 def write_chunk_kv(k_pool, v_pool, k, v, block_table, start):
@@ -85,30 +98,47 @@ def write_chunk_kv(k_pool, v_pool, k, v, block_table, start):
     return k_pool, v_pool
 
 
-def paged_decode_attention(q, k_pool, v_pool, block_tables, pos):
-    """Single-token decode attention through block tables (GQA-native).
+def paged_verify_attention(q, k_pool, v_pool, block_tables, pos):
+    """Multi-token verify attention through block tables (GQA-native) —
+    the decode window generalized from 1 to W positions.
 
-    q: (B, 1, H, D) rope'd queries; pools: (N, bs, KV, D); block_tables:
-    (B, M); pos: int32 (B,) current position per row (the new token's K/V
-    must already be written at ``pos``). Attends over positions
-    ``<= pos[b]`` of the gathered context. Pure-jnp reference — same
-    grouped einsum as the dense ``LlamaAttention.decode`` vector-pos path
-    so the two servers agree token-exactly.
+    q: (B, W, H, D) rope'd queries at positions ``pos[b] + arange(W)``;
+    pools: (N, bs, KV, D); block_tables: (B, M); pos: int32 (B,) window
+    start per row (the window's K/V must already be written at
+    ``pos..pos+W-1``, :func:`write_window_kv`). IN-WINDOW CAUSAL MASK:
+    query j attends context positions ``<= pos[b] + j`` — earlier window
+    tokens are visible, later ones (and any stale rejected K/V beyond the
+    window) are not. W = 1 reduces exactly to single-token decode.
+    Pure-jnp reference, block-major and Pallas-ready (the block table is
+    the scalar-prefetch arg of a future kernel); scratch-block-0 masking
+    is preserved — zeroed table rows write and read only scratch. Same
+    grouped einsum / fp32-softmax as the dense ``LlamaAttention.decode``
+    vector-pos path so greedy speculative output is token-exact vs the
+    dense server.
     """
-    B, _, H, D = q.shape
+    B, W, H, D = q.shape
     KV = k_pool.shape[2]
     rep = H // KV
     ck = gather_block_kv(k_pool, block_tables)    # (B, L, KV, D)
     cv = gather_block_kv(v_pool, block_tables)
     L = ck.shape[1]
-    qg = q.reshape(B, 1, KV, rep, D)
+    qg = q.reshape(B, W, KV, rep, D)
     scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck).astype(
         jnp.float32) / math.sqrt(D)
-    mask = (jnp.arange(L)[None, :] <= pos[:, None])[:, None, None, None, :]
+    qpos = pos[:, None] + jnp.arange(W)[None, :]  # (B, W)
+    mask = (jnp.arange(L)[None, None, :] <=
+            qpos[:, :, None])[:, None, None, :, :]
     scores = jnp.where(mask, scores, NEG_INF)
     p = jax.nn.softmax(scores, -1).astype(q.dtype)
     out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
-    return out.reshape(B, 1, H, D)
+    return out.reshape(B, W, H, D)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, pos):
+    """Single-token decode attention — :func:`paged_verify_attention` at
+    W = 1 (mask ``arange(L) <= pos + 0`` is the plain ``<= pos``).
+    q: (B, 1, H, D)."""
+    return paged_verify_attention(q, k_pool, v_pool, block_tables, pos)
 
 
 def paged_prefill_attention(q, k_pool, v_pool, block_table, start):

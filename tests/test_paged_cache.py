@@ -102,3 +102,34 @@ def test_stats_and_peak():
     assert s["blocks_in_use"] == 1
     assert s["fresh_allocs"] == 4
     assert s["num_blocks"] == 6 and s["block_size"] == 4
+
+
+def test_lru_reclaim_under_pressure():
+    """Pool pressure with a warm prefix cache: alloc() must consume the
+    whole free list first, then reclaim cached blocks in LRU order (their
+    hashes dropping out of match_prefix one by one), and only raise once
+    every block is referenced by a live request — retention never causes
+    an allocation failure, it only delays reuse."""
+    bs = 2
+    a = BlockAllocator(num_blocks=7, block_size=bs)      # 6 usable
+    live = [a.alloc(), a.alloc()]
+    cached = [a.alloc() for _ in range(3)]
+    for i, bid in enumerate(cached):
+        a.register(bid, chain_hash=1000 + i)
+        a.free(bid)                                      # LRU age order
+    assert a.blocks_cached == 3 and a.blocks_free == 1
+    b_free = a.alloc()                                   # free list first
+    assert b_free not in cached and a.evictions == 0
+    # pressure: the next three allocs must evict cached[0], [1], [2]
+    got = [a.alloc() for _ in range(3)]
+    assert got == cached and a.evictions == 3
+    assert a.blocks_cached == 0
+    # every hash is gone from the prefix index
+    for i in range(3):
+        assert a._by_hash.get(1000 + i) is None
+    # all 6 usable blocks now live -> true exhaustion
+    with pytest.raises(RuntimeError, match="exhausted"):
+        a.alloc()
+    # releasing a LIVE unhashed block recirculates it immediately
+    a.free(live[0])
+    assert a.alloc() == live[0]

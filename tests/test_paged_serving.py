@@ -240,3 +240,64 @@ def test_serving_benchmark_paged_smoke():
     assert rec["value"] > 0
     assert rec["peak_kv_blocks"] >= 1
     assert rec["peak_kv_blocks"] <= rec["kv_blocks_total"]
+
+
+def test_spec_eos_inside_accepted_window():
+    """An eos emitted as an ACCEPTED DRAFT mid-window must truncate the
+    rest of that window (bonus token, later drafts) and every later
+    window of the trip — outputs token-exact vs the dense per-token
+    server with the same eos."""
+    from paddle_tpu.inference.speculative import SpecConfig
+
+    model, cfg = _model()
+    rng = np.random.RandomState(5)
+    motif = rng.randint(1, 100, 5).tolist()
+    prompts = [(motif * 6)[:n] for n in (13, 9, 21)]
+
+    def dense_run(eos=None):
+        srv = GenerationServer(model, max_batch=2, max_len=64,
+                               prompt_buckets=(32,), eos_token_id=eos)
+        rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    def spec_run(eos=None):
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8, tick_window=2,
+                               eos_token_id=eos, spec=SpecConfig(k=3))
+        rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    free = dense_run()
+    assert spec_run() == free
+    # choose an eos a few tokens into the longest generation: with a
+    # motif-locked greedy stream and k=3 drafts it lands inside an
+    # accepted window, not at a window boundary
+    eos = free[0][len(prompts[0]) + 5]
+    with_eos = dense_run(eos=eos)
+    assert spec_run(eos=eos) == with_eos
+    assert len(with_eos[0]) < len(free[0])       # eos actually truncated
+
+
+def test_submit_spec_param_validation():
+    """draft_k is a spec-server-only knob with a hard [0, spec.k] range."""
+    from paddle_tpu.inference.speculative import SpecConfig
+
+    model, cfg = _model()
+    plain = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                             block_size=4)
+    with pytest.raises(ValueError, match="spec=SpecConfig"):
+        plain.submit([1, 2], max_new_tokens=4, draft_k=2)
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, spec=SpecConfig(k=2))
+    for bad in (-1, True, 1.5):
+        with pytest.raises(ValueError, match="draft_k"):
+            srv.submit([1, 2], max_new_tokens=4, draft_k=bad)
+    with pytest.raises(ValueError, match="exceeds spec.k"):
+        srv.submit([1, 2], max_new_tokens=4, draft_k=3)
+    # in-range budgets (0 = plain decode for that request) are accepted
+    srv.submit([1, 2], max_new_tokens=2, draft_k=0)
+    srv.submit([1, 2], max_new_tokens=2, draft_k=2)
+    out = srv.run()
+    assert all(len(v) == 4 for v in out.values())

@@ -1,0 +1,433 @@
+"""Speculative decoding (inference/speculative.py + the paged server's
+spec path): drafter host/device equivalence, exact accept/reject (greedy
+bit-exactness and sampling distribution-exactness), the dynamic
+speculation gate, and the zero-steady-state-recompile contract. Quick
+tier on CPU — tier-1's coverage of the speculative serving path."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.inference.speculative import (NgramDrafter, SpecConfig,
+                                              ngram_propose_device,
+                                              speculative_accept)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160, seed=7, hidden=64, layers=2):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=hidden,
+                      intermediate_size=2 * hidden, num_hidden_layers=layers,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(seed)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _motif_prompt(rng, n, period=5):
+    motif = rng.randint(1, 100, period).tolist()
+    return (motif * (n // period + 1))[:n]
+
+
+# --------------------------------------------------------------------------- #
+# Drafters
+# --------------------------------------------------------------------------- #
+
+
+def test_ngram_drafter_host_propose():
+    d = NgramDrafter(max_ngram=3, min_ngram=1)
+    # repetition: suffix [7, 8] last occurred at index 1 -> continue [9, 7, 8]
+    ctx = [7, 8, 9, 7, 8]
+    assert d.propose_one(ctx, 3).tolist() == [9, 7, 8]
+    # continuation shorter than k pads by repeating the context's last token
+    assert d.propose_one([5, 6, 5], 4).tolist() == [6, 5, 5, 5]
+    # no match at any n >= min_ngram: repeat the last token
+    assert d.propose_one([1, 2, 3, 4], 2).tolist() == [4, 4]
+    # single-token context can't match (needs a continuation)
+    assert d.propose_one([9], 2).tolist() == [9, 9]
+    # longest n-gram wins over a more recent shorter match
+    ctx = [1, 2, 3, 50, 2, 3, 60, 1, 2, 3]
+    assert d.propose_one(ctx, 1).tolist() == [50]
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(max_ngram=2, min_ngram=3)
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(max_ngram=2, min_ngram=0)
+
+
+def test_ngram_host_device_equivalence():
+    """The in-program jnp matcher must propose exactly what the host numpy
+    drafter proposes, across motif/random/short/degenerate contexts."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    d = NgramDrafter(max_ngram=3, min_ngram=1)
+    k, L = 4, 48
+    contexts = [
+        _motif_prompt(rng, 17),
+        rng.randint(1, 100, 31).tolist(),
+        [3],
+        [4, 4, 4, 4, 4],
+        _motif_prompt(rng, 40, period=7),
+        rng.randint(1, 5, 25).tolist(),        # tiny vocab: dense matches
+        [1, 2, 3, 50, 2, 3, 60, 1, 2, 3],
+    ]
+    B = len(contexts)
+    buf = np.zeros((B, L), np.int32)
+    pos = np.zeros((B,), np.int32)
+    for i, c in enumerate(contexts):
+        buf[i, :len(c)] = c
+        pos[i] = len(c) - 1
+    dev = np.asarray(ngram_propose_device(
+        jnp.asarray(buf), jnp.asarray(pos), k, max_ngram=3, min_ngram=1))
+    for i, c in enumerate(contexts):
+        host = d.propose_one(c, k)
+        assert dev[i].tolist() == host.tolist(), (i, c)
+
+
+# --------------------------------------------------------------------------- #
+# Exact acceptance
+# --------------------------------------------------------------------------- #
+
+
+def test_speculative_accept_greedy_matches_oracle():
+    """Greedy acceptance == leading argmax matches (capped at kcap), with
+    the first mismatch position's argmax as the correction; the static
+    greedy=True specialization is token-identical to the general path."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    B, k, V = 5, 3, 16
+    logits = rng.randn(B, k + 1, V).astype(np.float32)
+    tgt = logits.argmax(-1)
+    proposals = tgt[:, :k].copy()
+    proposals[1, 1] += 1          # mismatch at j=1 -> acc 1
+    proposals[2, 0] += 1          # mismatch at j=0 -> acc 0
+    kcaps = np.asarray([k, k, k, 2, 0], np.int32)   # forced stops on 3, 4
+    zeros = jnp.zeros((B,), jnp.float32)
+    args = (jnp.asarray(logits), jnp.asarray(proposals), zeros,
+            jnp.zeros((B,), jnp.int32), zeros, jnp.asarray(kcaps),
+            jax.random.PRNGKey(0))
+    out_g, acc_g = speculative_accept(*args, greedy=True)
+    out_m, acc_m = speculative_accept(*args, greedy=False)
+    out_g, acc_g = np.asarray(out_g), np.asarray(acc_g)
+    assert acc_g.tolist() == [3, 1, 0, 2, 0]
+    for b in range(B):
+        a = acc_g[b]
+        want = proposals[b, :a].tolist() + [int(tgt[b, a])]
+        assert out_g[b, :a + 1].tolist() == want, b
+    # static specialization changes the program, never the tokens
+    assert acc_g.tolist() == np.asarray(acc_m).tolist()
+    for b in range(B):
+        a = acc_g[b]
+        assert out_g[b, :a + 1].tolist() == \
+            np.asarray(out_m)[b, :a + 1].tolist(), b
+
+
+def test_speculative_accept_distribution_exact():
+    """Rejection sampling must leave the OUTPUT DISTRIBUTION equal to the
+    filtered target distribution: over many keys, the first emitted
+    token's histogram matches p regardless of what the drafter proposed
+    (the Leviathan/Chen exactness guarantee, checked empirically)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    V, N = 8, 8000
+    logits = rng.randn(1, 2, V).astype(np.float32) * 1.5   # k=1, W=2
+    p = np.exp(logits[0, 0] - logits[0, 0].max())
+    p /= p.sum()
+
+    def first_tok(key, prop):
+        out, acc = speculative_accept(
+            jnp.asarray(logits), jnp.asarray([[prop]], jnp.int32),
+            jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1,), jnp.float32), jnp.ones((1,), jnp.int32), key)
+        return out[0, 0]
+
+    keys = jax.random.split(jax.random.PRNGKey(0), N)
+    for prop in (int(p.argmax()), int(p.argmin())):
+        toks = np.asarray(jax.jit(jax.vmap(lambda k: first_tok(k, prop)))(
+            keys))
+        hist = np.bincount(toks, minlength=V) / N
+        assert np.abs(hist - p).max() < 0.03, (prop, hist, p)
+    # kcap 0 force-stops the row: no draft consumed, emitted token still ~ p
+    def forced(key):
+        out, acc = speculative_accept(
+            jnp.asarray(logits), jnp.asarray([[3]], jnp.int32),
+            jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32), key)
+        return out[0, 0], acc[0]
+
+    toks, accs = jax.jit(jax.vmap(forced))(keys)
+    assert int(np.asarray(accs).max()) == 0
+    hist = np.bincount(np.asarray(toks), minlength=V) / N
+    assert np.abs(hist - p).max() < 0.03
+
+
+# --------------------------------------------------------------------------- #
+# Server integration — greedy token-exactness under churn
+# --------------------------------------------------------------------------- #
+
+
+def test_spec_greedy_exact_vs_dense_under_churn():
+    """8 requests through 2 slots with mixed draft_k budgets: greedy
+    speculative output must be token-identical to the dense server's, with
+    slot churn, multi-chunk prefill, and the dynamic gate switching
+    between spec and plain trips mid-drain."""
+    model, cfg = _model()
+    rng = np.random.RandomState(3)
+    prompts = [_motif_prompt(rng, n) for n in (11, 24, 7)] + \
+        [rng.randint(1, cfg.vocab_size, n).tolist() for n in (5, 19, 12)] + \
+        [_motif_prompt(rng, 16, period=3), [9, 9, 9, 9]]
+    kws = [{}, {"draft_k": 0}, {"draft_k": 1}, {}, {"draft_k": 2}, {}, {},
+           {"draft_k": 0}]
+
+    dense = GenerationServer(model, max_batch=2, max_len=64,
+                             prompt_buckets=(32,))
+    rd = [dense.submit(p, max_new_tokens=10) for p in prompts]
+    outd = dense.run()
+
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, tick_window=2,
+                           spec=SpecConfig(k=3))
+    rs = [srv.submit(p, max_new_tokens=10, **kw)
+          for p, kw in zip(prompts, kws)]
+    outs = srv.run()
+    for i, (a, b) in enumerate(zip(rd, rs)):
+        assert outs[b] == outd[a], f"spec != dense for request {i}"
+    # every block released, metrics consistent
+    assert srv.kv_stats()["blocks_in_use"] == 0
+    sm = srv.spec_metrics()
+    assert sm["draft_tokens_proposed"] > 0
+    assert 0.0 <= sm["acceptance_rate"] <= 1.0
+    assert sm["draft_tokens_accepted"] <= sm["draft_tokens_proposed"]
+
+
+def test_spec_sampling_rows_mixed_with_greedy():
+    """A greedy slot sharing verify windows with a temperature-sampling
+    slot must still match the dense greedy oracle token for token; the
+    sampled row completes with valid token ids."""
+    model, cfg = _model()
+    rng = np.random.RandomState(4)
+    p_greedy = _motif_prompt(rng, 13)
+    p_sample = rng.randint(1, cfg.vocab_size, 9).tolist()
+    dense = GenerationServer(model, max_batch=2, max_len=64,
+                             prompt_buckets=(32,))
+    rid = dense.submit(p_greedy, max_new_tokens=8)
+    ref = dense.run()[rid]
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, tick_window=2,
+                           spec=SpecConfig(k=2))
+    rg = srv.submit(p_greedy, max_new_tokens=8)
+    rs = srv.submit(p_sample, max_new_tokens=8, temperature=0.9, top_k=12,
+                    top_p=0.9)
+    res = srv.run()
+    assert res[rg] == ref
+    toks = res[rs][len(p_sample):]
+    assert len(toks) == 8
+    assert all(0 <= t < cfg.vocab_size for t in toks)
+
+
+def test_draft_model_drafter_greedy_exact():
+    """The small-LM drafter (host orchestration, tick_window=1) must keep
+    the greedy output token-exact vs dense — acceptance moves throughput,
+    never tokens."""
+    model, cfg = _model()
+    paddle.seed(11)
+    dcfg = LlamaConfig(vocab_size=cfg.vocab_size, hidden_size=32,
+                       intermediate_size=64, num_hidden_layers=1,
+                       num_attention_heads=2, num_key_value_heads=1,
+                       max_position_embeddings=cfg.max_position_embeddings,
+                       dtype="float32", use_flash_attention=False)
+    draft = LlamaForCausalLM(dcfg)
+    rng = np.random.RandomState(5)
+    prompts = [_motif_prompt(rng, 10),
+               rng.randint(1, cfg.vocab_size, 6).tolist()]
+    dense = GenerationServer(model, max_batch=2, max_len=64,
+                             prompt_buckets=(32,))
+    rd = [dense.submit(p, max_new_tokens=6) for p in prompts]
+    outd = dense.run()
+    srv = GenerationServer(
+        model, max_batch=2, max_len=64, cache="paged", block_size=4,
+        prefill_chunk=8,
+        spec=SpecConfig(k=2, drafter="model", draft_model=draft))
+    rs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    outs = srv.run()
+    for a, b in zip(rd, rs):
+        assert outs[b] == outd[a]
+    # a host-side drafter can't fuse windows: tick_window > 1 must refuse
+    with pytest.raises(ValueError, match="fusible"):
+        GenerationServer(
+            model, max_batch=2, max_len=64, cache="paged", tick_window=2,
+            spec=SpecConfig(k=2, drafter="model", draft_model=draft))
+
+
+def test_spec_max_len_boundary_exact():
+    """Requests that fill the KV buffer to the brim: the verify scan's
+    surplus window positions clamp at max_len-1 (writes land in rows the
+    harvest discards). Regression for the scratch-poisoning bug where an
+    out-of-bounds context gather produced NaN K/V that corrupted OTHER
+    rows through their zero table padding."""
+    model, cfg = _model(max_pos=64)
+    rng = np.random.RandomState(6)
+    prompts = [_motif_prompt(rng, 8), rng.randint(1, 128, 6).tolist()]
+    new = [24, 26]                     # len + new == max_len=32 exactly
+    dense = GenerationServer(model, max_batch=2, max_len=32,
+                             prompt_buckets=(32,))
+    rd = [dense.submit(p, max_new_tokens=n) for p, n in zip(prompts, new)]
+    outd = dense.run()
+    srv = GenerationServer(model, max_batch=2, max_len=32, cache="paged",
+                           block_size=4, prefill_chunk=8, tick_window=2,
+                           spec=SpecConfig(k=3))
+    rs = [srv.submit(p, max_new_tokens=n) for p, n in zip(prompts, new)]
+    outs = srv.run()
+    for a, b in zip(rd, rs):
+        assert outs[b] == outd[a]
+
+
+# --------------------------------------------------------------------------- #
+# The dynamic speculation gate
+# --------------------------------------------------------------------------- #
+
+
+def test_spec_gate_counts_plain_windows_and_stays_exact():
+    """Drafter-hostile traffic (random tokens: prompt lookup always
+    misses) must trip the gate — plain-decode windows show up in
+    spec_metrics — and gating must never change greedy tokens: outputs
+    equal the gate-disabled server's and the dense oracle's. The turbo
+    long-trip tier is exercised on drafter-friendly traffic."""
+    model, cfg = _model()
+    rng = np.random.RandomState(8)
+    hostile = [rng.randint(1, cfg.vocab_size, n).tolist()
+               for n in (9, 14, 6, 11)]
+    dense = GenerationServer(model, max_batch=2, max_len=64,
+                             prompt_buckets=(32,))
+    rd = [dense.submit(p, max_new_tokens=12) for p in hostile]
+    outd = dense.run()
+
+    def spec_run(spec_cfg, prompts, new=12):
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8, tick_window=2,
+                               spec=spec_cfg)
+        rids = [srv.submit(p, max_new_tokens=new) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids], srv.spec_metrics()
+
+    gated, gm = spec_run(
+        SpecConfig(k=3, gate_low=2.0, gate_cooldown=2, gate_ticks=4),
+        hostile)
+    ungated, um = spec_run(SpecConfig(k=3, gate_cooldown=0), hostile)
+    assert gm["gated_plain_windows"] > 0          # the gate actually fired
+    assert um["gated_plain_windows"] == 0         # cooldown 0 disables it
+    ref = [outd[r] for r in rd]
+    assert gated == ref
+    assert ungated == ref
+
+    # turbo tier: high-acceptance traffic, long trips — still exact
+    friendly = [_motif_prompt(rng, n) for n in (15, 10, 21, 8)]
+    rd2 = [dense.submit(p, max_new_tokens=12) for p in friendly]
+    outd2 = dense.run()
+    turbo, _ = spec_run(
+        SpecConfig(k=3, gate_cooldown=2, gate_ticks=4, turbo_windows=4),
+        friendly)
+    assert turbo == [outd2[r] for r in rd2]
+
+
+def test_spec_config_validation():
+    for bad in (0, -1, True, 1.5):
+        with pytest.raises(ValueError, match="spec.k"):
+            SpecConfig(k=bad).validate()
+    with pytest.raises(ValueError, match="drafter"):
+        SpecConfig(drafter="beam").validate()
+    with pytest.raises(ValueError, match="draft_model"):
+        SpecConfig(drafter="model").validate()
+    with pytest.raises(ValueError, match="ngram_min"):
+        SpecConfig(ngram_max=1, ngram_min=2).validate()
+    for bad in (-1, True, 2.5):
+        with pytest.raises(ValueError, match="gate_cooldown"):
+            SpecConfig(gate_cooldown=bad).validate()
+    with pytest.raises(ValueError, match="gate_low"):
+        SpecConfig(gate_low=-0.5).validate()
+    for bad in (0, -2, True):
+        with pytest.raises(ValueError, match="gate_ticks"):
+            SpecConfig(gate_ticks=bad).validate()
+    for bad in (-1, True):
+        with pytest.raises(ValueError, match="turbo_windows"):
+            SpecConfig(turbo_windows=bad).validate()
+    SpecConfig().validate()                       # defaults are valid
+    SpecConfig(gate_cooldown=0, turbo_windows=8).validate()
+    # spec requires the paged cache
+    model, _ = _model()
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(model, max_batch=2, max_len=64,
+                         prompt_buckets=(32,), spec=SpecConfig())
+
+
+# --------------------------------------------------------------------------- #
+# Compile discipline
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.graftlint
+def test_spec_steady_state_zero_recompiles():
+    """jit-cache guard on the speculative loop: after a warm-up wave that
+    exercises chunked prefill, the fused verify scan, AND the gated
+    plain-decode program (drafter-hostile prompts guarantee the gate
+    fires), a second wave — different lengths, churn, gate flapping both
+    directions — must run with ZERO backend compiles. The static args
+    (greedy flag, spec window count, gate_ticks) are jit cache keys; a
+    wobble in any of them would recompile here, not on the TPU bill."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    model, cfg = _model()
+    rng = np.random.RandomState(9)
+    srv = GenerationServer(
+        model, max_batch=2, max_len=64, cache="paged", block_size=4,
+        prefill_chunk=8, tick_window=2,
+        spec=SpecConfig(k=2, gate_low=2.0, gate_cooldown=1, gate_ticks=2))
+    # hostile prompts: acceptance ~0 -> the gate trips -> the gated plain
+    # program compiles during warm-up alongside prefill + the verify scan
+    warm = [rng.randint(1, cfg.vocab_size, n).tolist() for n in (5, 12)]
+    for p in warm:
+        srv.submit(p, max_new_tokens=16)
+    srv.run()
+    assert srv.spec_metrics()["gated_plain_windows"] > 0
+
+    prompts = [_motif_prompt(rng, 14), rng.randint(1, 128, 7).tolist(),
+               _motif_prompt(rng, 20, period=4),
+               rng.randint(1, 128, 3).tolist()]
+    rids = [srv.submit(p, max_new_tokens=12) for p in prompts]
+    with jit_cache_guard("speculative serving steady state") as g:
+        out = srv.run()
+    assert g.compiles == 0
+    for r, p in zip(rids, prompts):
+        assert len(out[r]) == len(p) + 12
+
+
+def test_serving_benchmark_spec_smoke():
+    """tools/serving_benchmark.py --paged --spec --repeat-suffix --json:
+    one machine-readable line carrying acceptance_rate and the draft
+    counters (CPU smoke of the whole speculative path, driver included)."""
+    proc = subprocess.run(
+        [sys.executable, "tools/serving_benchmark.py", "--paged", "--json",
+         "--spec", "2", "--repeat-suffix", "--requests", "4", "--slots", "2",
+         "--max-new", "8", "--tick-window", "2",
+         "--block-size", "8", "--prefill-chunk", "16"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["kv_cache"] == "paged"
+    assert rec["spec_k"] == 2
+    assert rec["spec_drafter"] == "ngram"
+    assert rec["value"] > 0
+    assert 0.0 <= rec["acceptance_rate"] <= 1.0
+    assert rec["draft_tokens_accepted"] <= rec["draft_tokens_proposed"]

@@ -55,4 +55,24 @@ python tools/decode_benchmark.py 2>/dev/null | tee /tmp/tpu_runs/decode_bf16.jso
 python tools/decode_benchmark.py --int8 2>/dev/null | tee /tmp/tpu_runs/decode_int8.json
 python tools/model_benchmark.py -o /tmp/tpu_runs/model_bench.json 2>/dev/null | tail -3
 
+echo "== 7. serving under load (continuous batching; paged + speculative) =="
+python tools/serving_benchmark.py --json 2>/dev/null | tee /tmp/tpu_runs/serving_dense.json
+python tools/serving_benchmark.py --paged --json 2>/dev/null | tee /tmp/tpu_runs/serving_paged.json
+python tools/serving_benchmark.py --paged --repeat-suffix --json 2>/dev/null | tee /tmp/tpu_runs/serving_paged_rs.json
+python tools/serving_benchmark.py --paged --spec 4 --repeat-suffix --json 2>/dev/null | tee /tmp/tpu_runs/serving_spec.json
+python - <<'PY'
+# spec smoke gate: the speculative line must carry a sane acceptance_rate
+# and beat the paged repeat-suffix baseline (same workload, same chip)
+import json
+spec = json.load(open("/tmp/tpu_runs/serving_spec.json"))
+base = json.load(open("/tmp/tpu_runs/serving_paged_rs.json"))
+assert 0.0 <= spec["acceptance_rate"] <= 1.0, spec
+ratio = spec["value"] / base["value"]
+print(f"spec/paged repeat-suffix ratio: {ratio:.2f} "
+      f"(accept {spec['acceptance_rate']:.2f})")
+if ratio < 1.0:
+    raise SystemExit("speculative decoding SLOWER than paged baseline — "
+                     "check the gate (SpecConfig.gate_low) before shipping")
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

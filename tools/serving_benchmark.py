@@ -20,8 +20,15 @@ Sync honesty: every server tick pulls next-token ids to host
 (np.asarray in ``step``), so wall-clock over the drain IS device time —
 no reliance on block_until_ready (which lies on the tunneled backend).
 
+``--spec K`` stacks speculative decoding on the paged server (drafter →
+one fused k+1-wide verify program, exact acceptance): the JSON line gains
+``acceptance_rate`` and ``draft_tokens_proposed/accepted``;
+``--repeat-suffix`` switches to the repeated-suffix workload where
+prompt-lookup drafting shines.
+
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
-       [--paged [--block-size 16] [--num-blocks N] [--prefill-chunk 64]]
+       [--paged [--block-size 16] [--num-blocks N] [--prefill-chunk 64]
+        [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]]
        [--json]
 """
 from __future__ import annotations
@@ -39,11 +46,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--slots", type=int, default=8)
-    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="generated tokens per request (default 64; 128 "
+                         "under --repeat-suffix, whose long-form "
+                         "repetitive generations are the point)")
     ap.add_argument("--max-len", type=int, default=None)
-    ap.add_argument("--tick-window", type=int, default=16,
+    ap.add_argument("--tick-window", type=int, default=None,
                     help="decode ticks per host round trip (amortizes the "
-                         "d2h sync; 1 = exact per-token semantics)")
+                         "d2h sync; 1 = exact per-token semantics). Default "
+                         "16; 4 under --spec, where each window already "
+                         "advances up to k+1 tokens so fewer windows per "
+                         "trip keep per-trip emission comparable while "
+                         "cutting surplus verify work past finished "
+                         "requests")
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 (model.quantize_int8()) under "
                          "the same load — composes the decode win with "
@@ -61,12 +76,40 @@ def main():
                          "sizes for dense parity)")
     ap.add_argument("--prefill-chunk", type=int, default=64,
                     help="tokens per chunked-prefill program (paged only)")
+    ap.add_argument("--spec", type=int, default=0, metavar="K",
+                    help="speculative decoding with K drafts per verify "
+                         "window (paged only). The ngram drafter runs "
+                         "in-program, so tick-window verify windows fuse "
+                         "into one compiled scan per host trip; the model "
+                         "drafter forces tick-window=1. JSON line gains "
+                         "acceptance_rate + draft_tokens_proposed/accepted")
+    ap.add_argument("--spec-drafter", choices=("ngram", "model"),
+                    default="ngram",
+                    help="drafter: prompt-lookup n-gram (hermetic) or a "
+                         "small draft llama sharing the tokenizer")
+    ap.add_argument("--repeat-suffix", action="store_true",
+                    help="repeated-suffix workload: prompts tile a short "
+                         "motif, so generation loops the drafter can "
+                         "predict — the speculative showcase")
     ap.add_argument("--json", action="store_true",
                     help="emit exactly one machine-readable JSON line "
                          "(bench.py style) on stdout and nothing else")
     args = ap.parse_args()
+    if args.max_new is None:
+        args.max_new = 128 if args.repeat_suffix else 64
     if args.max_len is None:
         args.max_len = 768 if args.long_prompts else 256
+        if args.repeat_suffix:
+            args.max_len = max(args.max_len, 128 + args.max_new)
+    if args.spec:
+        if not args.paged:
+            ap.error("--spec requires --paged (the verify op is paged)")
+        if args.spec_drafter == "model":
+            args.tick_window = 1  # host-side drafter: one window per trip
+        elif args.tick_window is None:
+            args.tick_window = 4
+    if args.tick_window is None:
+        args.tick_window = 16
 
     import jax
     import numpy as np
@@ -83,8 +126,12 @@ def main():
                           max_position_embeddings=args.max_len,
                           dtype="bfloat16", use_flash_attention=True)
     else:
-        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
-                          intermediate_size=128, num_hidden_layers=2,
+        # CPU stand-in: hidden 128 keeps the decode tick matmul-bound —
+        # at hidden 64 per-op overhead swamps compute and every serving
+        # ratio (tick-window, spec verify width) measures dispatch, not
+        # the design
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=2,
                           max_position_embeddings=args.max_len,
                           dtype="float32", use_flash_attention=False)
@@ -93,13 +140,21 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     rng = np.random.RandomState(0)
 
+    motif = rng.randint(1, cfg.vocab_size, 8).tolist()
+
     def burst(server, n):
         """Mixed prompt lengths across the bucket ladder."""
         lens = rng.choice([64, 128, 256, 400, 512] if args.long_prompts
                           else [16, 30, 64, 100, 128], size=n)
         rids = {}
         for ln in lens:
-            prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
+            if args.repeat_suffix:
+                # tile one shared motif: greedy decoding locks onto the
+                # repetition, which prompt-lookup drafts perfectly — and
+                # the shared prefix exercises the prefix cache
+                prompt = (motif * (int(ln) // len(motif) + 1))[:int(ln)]
+            else:
+                prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
             rids[server.submit(prompt, max_new_tokens=args.max_new)] = int(ln)
         return rids
 
@@ -109,11 +164,33 @@ def main():
 
     def make_server():
         if args.paged:
+            spec = None
+            if args.spec:
+                from paddle_tpu.inference.speculative import SpecConfig
+
+                draft_model = None
+                if args.spec_drafter == "model":
+                    paddle.seed(1)
+                    dcfg = LlamaConfig(
+                        vocab_size=cfg.vocab_size,
+                        hidden_size=cfg.hidden_size // 2,
+                        intermediate_size=cfg.intermediate_size // 2,
+                        num_hidden_layers=max(cfg.num_hidden_layers // 4, 1),
+                        num_attention_heads=max(
+                            cfg.num_attention_heads // 2, 1),
+                        num_key_value_heads=max(
+                            cfg.num_key_value_heads // 2, 1),
+                        max_position_embeddings=args.max_len,
+                        dtype=cfg.dtype,
+                        use_flash_attention=cfg.use_flash_attention)
+                    draft_model = LlamaForCausalLM(dcfg)
+                spec = SpecConfig(k=args.spec, drafter=args.spec_drafter,
+                                  draft_model=draft_model)
             return GenerationServer(
                 model, max_batch=args.slots, max_len=args.max_len,
                 tick_window=args.tick_window, cache="paged",
                 block_size=args.block_size, num_blocks=args.num_blocks,
-                prefill_chunk=args.prefill_chunk)
+                prefill_chunk=args.prefill_chunk, spec=spec)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -167,13 +244,24 @@ def main():
         line["kv_block_size"] = stats["block_size"]
         line["prefix_hit_blocks"] = stats["prefix_hit_blocks"]
         line["prefill_chunk"] = server.prefill_chunk
+    if args.spec:
+        sm = server.spec_metrics()
+        line["spec_k"] = args.spec
+        line["spec_drafter"] = args.spec_drafter
+        line["acceptance_rate"] = round(sm["acceptance_rate"], 4)
+        line["draft_tokens_proposed"] = sm["draft_tokens_proposed"]
+        line["draft_tokens_accepted"] = sm["draft_tokens_accepted"]
     if not locked:
         line["lock_contended"] = True
     print(json.dumps(line))
     if not args.json:
         mode = "paged" if args.paged else "dense"
+        if args.spec:
+            mode += f"+spec{args.spec}:{args.spec_drafter}"
         extra = (f", peak blocks {line.get('peak_kv_blocks')}/"
                  f"{line.get('kv_blocks_total')}" if args.paged else "")
+        if args.spec:
+            extra += f", accept {line['acceptance_rate']:.2f}"
         print(f"[{mode}] {line['value']} tok/s, p50 {line['p50_s']}s, "
               f"p95 {line['p95_s']}s over {line['wall_s']}s{extra}",
               file=sys.stderr)
