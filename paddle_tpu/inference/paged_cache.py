@@ -36,7 +36,8 @@ SCRATCH_BLOCK = 0
 class BlockAllocator:
     """Refcounted fixed-size KV block allocator with prefix caching."""
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 kv_quant: str = "none", bytes_per_block: int = 0):
         if num_blocks < 2:
             raise ValueError(f"num_blocks must be >= 2 (one scratch + one "
                              f"usable), got {num_blocks}")
@@ -44,6 +45,11 @@ class BlockAllocator:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # the quant mode seeds the hash chain: int8 and fp pools store
+        # different bits for the same tokens, so their prefix blocks must
+        # never alias even if allocator state ever crossed server instances
+        self.kv_quant = kv_quant
+        self.bytes_per_block = int(bytes_per_block)
         # LIFO free list over ids 1..N-1 (0 = scratch)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._ref: Dict[int, int] = {}
@@ -54,6 +60,7 @@ class BlockAllocator:
         self.peak_in_use = 0
         self.fresh_allocs = 0
         self.prefix_hit_blocks = 0
+        self.prefix_lookup_blocks = 0
         self.evictions = 0
 
     # ----------------------------------------------------------------- stats
@@ -71,14 +78,22 @@ class BlockAllocator:
         return len(self._free)
 
     def stats(self) -> Dict[str, int]:
+        looked = self.prefix_lookup_blocks
         return {"num_blocks": self.num_blocks,
                 "block_size": self.block_size,
                 "blocks_in_use": self.blocks_in_use,
                 "blocks_cached": self.blocks_cached,
+                "blocks_free": self.blocks_free,
                 "peak_blocks_in_use": self.peak_in_use,
                 "fresh_allocs": self.fresh_allocs,
                 "prefix_hit_blocks": self.prefix_hit_blocks,
-                "evictions": self.evictions}
+                "prefix_lookup_blocks": looked,
+                "prefix_hit_rate":
+                    (self.prefix_hit_blocks / looked) if looked else 0.0,
+                "evictions": self.evictions,
+                "kv_quant": self.kv_quant,
+                "bytes_per_block": self.bytes_per_block,
+                "bytes_in_use": self.bytes_per_block * self.blocks_in_use}
 
     def _note_use(self):
         self.peak_in_use = max(self.peak_in_use, self.blocks_in_use)
@@ -148,7 +163,7 @@ class BlockAllocator:
         """Chained content hash per FULL block of ``tokens``."""
         bs = self.block_size
         out: List[int] = []
-        h = 0
+        h = hash(("kv_quant", self.kv_quant))
         for i in range(len(tokens) // bs):
             h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
             out.append(h)
@@ -168,6 +183,7 @@ class BlockAllocator:
                 break
             self.ref(bid)
             out.append(bid)
+        self.prefix_lookup_blocks += len(hashes)
         self.prefix_hit_blocks += len(out)
         return out
 

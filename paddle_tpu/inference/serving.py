@@ -44,6 +44,25 @@ from ..framework.core import Tensor
 from ..jit import functional_call, state_values
 
 
+def kv_block_bytes(cfg, block_size: int, kv_quant: str = "none") -> int:
+    """HBM bytes one KV block costs across ALL layers (K + V pools, plus
+    the f32 scale rows for the int8 pool) — the unit `pool_bytes=` sizing
+    and the benchmark's ``kv_bytes_per_token`` are derived from."""
+    from ..framework.dtype import convert_dtype
+
+    import jax.numpy as jnp
+
+    kv = cfg.num_key_value_heads
+    d = cfg.hidden_size // cfg.num_attention_heads
+    if kv_quant == "int8":
+        # int8 codes + one f32 scale per (block, kv head)
+        per_pool = block_size * kv * d * 1 + kv * 4
+    else:
+        itemsize = jnp.zeros((), convert_dtype(cfg.dtype)).dtype.itemsize
+        per_pool = block_size * kv * d * itemsize
+    return 2 * cfg.num_hidden_layers * per_pool
+
+
 @dataclass
 class _Request:
     rid: int
@@ -79,7 +98,9 @@ class GenerationServer:
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  tick_window: int = 1, cache: str = "dense",
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 prefill_chunk: int = 32, spec=None):
+                 prefill_chunk: int = 32, spec=None,
+                 kv_quant: str = "none",
+                 pool_bytes: Optional[int] = None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -100,11 +121,37 @@ class GenerationServer:
         program scores all k+1 window positions with exact accept/reject
         (greedy output token-exact vs the plain server; sampling output
         distribution provably unchanged). Requires ``cache='paged'`` and
-        ``tick_window=1``. See inference/speculative.py, docs/serving.md."""
+        ``tick_window=1``. See inference/speculative.py, docs/serving.md.
+
+        ``kv_quant="int8"`` (paged only): store the KV pool as int8 codes
+        + f32 per-block-per-head scales (symmetric absmax) — half the
+        bytes of bf16 per block, so ~2× resident blocks at the same pool
+        budget and ~2× less KV traffic per decode tick. Dequant is FUSED
+        into the compiled attention programs (ops/paged_attention.py
+        ``*_q`` twins); the quant mode is fixed at construction so every
+        program compiles once at warmup, same as the fp path.
+
+        ``pool_bytes``: size the pool by HBM byte budget instead of block
+        count — ``num_blocks = pool_bytes // kv_block_bytes(...)``. The
+        int8 pool reports ~2× (bf16) / ~4× (f32) the blocks for the same
+        budget. Mutually exclusive with ``num_blocks``."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
             raise ValueError(f"cache must be 'dense' or 'paged', got {cache!r}")
+        if kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8', got {kv_quant!r}")
+        if kv_quant != "none" and cache != "paged":
+            raise ValueError("kv_quant='int8' requires cache='paged' "
+                             "(the dense slab has no block pool to quantize)")
+        if pool_bytes is not None:
+            if cache != "paged":
+                raise ValueError("pool_bytes= requires cache='paged'")
+            if num_blocks is not None:
+                raise ValueError(
+                    "pass either num_blocks= or pool_bytes=, not both")
+        self.kv_quant = kv_quant
         self.spec = None
         if spec is not None:
             if cache != "paged":
@@ -183,11 +230,35 @@ class GenerationServer:
                 slack = max(slack, -(-(wmax * (int(self.spec.k) + 1)) // bs),
                             -(-int(self.spec.gate_ticks) // bs))
             self._table_width = entries + slack
+            per_block = kv_block_bytes(cfg, bs, kv_quant)
             if num_blocks is None:
-                num_blocks = max_batch * entries + 1  # dense parity + scratch
-            self.alloc = BlockAllocator(int(num_blocks), bs)
-            self._pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
-                           for _ in range(2 * cfg.num_hidden_layers)]
+                if pool_bytes is not None:
+                    # byte-budget sizing: this is where the int8 pool's
+                    # ~2× capacity win comes from — same budget, half the
+                    # bytes per block, twice the resident blocks
+                    num_blocks = max(2, int(pool_bytes) // per_block)
+                else:
+                    num_blocks = max_batch * entries + 1  # dense parity
+            self.alloc = BlockAllocator(int(num_blocks), bs,
+                                        kv_quant=kv_quant,
+                                        bytes_per_block=per_block)
+            if kv_quant == "int8":
+                # per layer: K codes, K scales, V codes, V scales — the
+                # scale rows ride in the flat pool list so donation and
+                # in-place updates cover them too
+                self._pools = []
+                for _ in range(cfg.num_hidden_layers):
+                    for _kv in range(2):
+                        self._pools.append(jnp.zeros(
+                            (int(num_blocks), bs, kv, d), jnp.int8))
+                        self._pools.append(jnp.zeros(
+                            (int(num_blocks), kv), jnp.float32))
+            else:
+                self._pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
+                               for _ in range(2 * cfg.num_hidden_layers)]
+            # tensors per layer entry in the flat pool list: fp (K, V) = 2;
+            # int8 (Kq, Kscale, Vq, Vscale) = 4
+            self._pool_stride = 4 if kv_quant == "int8" else 2
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
             # device-side mirror of (temps, topks, topps[, kcaps]): these
             # change only when a slot activates/releases, but were being
@@ -246,6 +317,19 @@ class GenerationServer:
                                                 static_argnums=(12,))
 
     # ------------------------------------------------------------ compiled fns
+    def _pool_views(self, flat_p):
+        """Group the flat per-layer pool list back into per-layer tuples:
+        fp → (K, V); int8 → (Kq, Kscale, Vq, Vscale). The model's paged
+        methods branch on the tuple arity, so the same compiled-fn bodies
+        serve both pool formats."""
+        st = self._pool_stride
+        return [tuple(Tensor(flat_p[st * i + j]) for j in range(st))
+                for i in range(self.cfg.num_hidden_layers)]
+
+    @staticmethod
+    def _flat_pools(new):
+        return [t.value for entry in new for t in entry]
+
     def _head(self, h):
         from ..framework.dispatch import apply_op
 
@@ -308,8 +392,7 @@ class GenerationServer:
 
         def one_tick(carry, k):
             toks, flat_p, p = carry
-            pools = [(Tensor(flat_p[2 * i]), Tensor(flat_p[2 * i + 1]))
-                     for i in range(self.cfg.num_hidden_layers)]
+            pools = self._pool_views(flat_p)
 
             def call():
                 h, new = model.model.paged_decode_step(Tensor(toks[:, None]),
@@ -317,9 +400,7 @@ class GenerationServer:
                 return self._head(h), new
 
             logits, new = functional_call(model, params, call_fn=call)
-            flat = []
-            for kp, vp in new:
-                flat += [kp.value, vp.value]
+            flat = self._flat_pools(new)
             lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
             if greedy:
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -346,8 +427,7 @@ class GenerationServer:
         local index ``last_idx`` (the last real prompt token on the final
         chunk; ignored on earlier chunks) + updated pools."""
         model = self.model
-        pools = [(Tensor(flat_pools[2 * i]), Tensor(flat_pools[2 * i + 1]))
-                 for i in range(self.cfg.num_hidden_layers)]
+        pools = self._pool_views(flat_pools)
 
         def call():
             h, new = model.model.paged_prefill_chunk(Tensor(chunk), pools,
@@ -356,10 +436,7 @@ class GenerationServer:
             return self._head(Tensor(last)), new
 
         logits, new = functional_call(model, params, call_fn=call)
-        flat = []
-        for kp, vp in new:
-            flat += [kp.value, vp.value]
-        return logits.value[:, 0].astype(jnp.float32), flat
+        return logits.value[:, 0].astype(jnp.float32), self._flat_pools(new)
 
     def _spec_verify_fn(self, params, tokens, proposals, flat_pools, tables,
                         pos, temps, topks, topps, kcaps, key, qprobs,
@@ -373,8 +450,7 @@ class GenerationServer:
         per-row ``kcaps`` force-stop lets requests run mixed draft_k (and
         masks idle slots at kcap 0) without changing compiled shapes."""
         model = self.model
-        pools = [(Tensor(flat_pools[2 * i]), Tensor(flat_pools[2 * i + 1]))
-                 for i in range(self.cfg.num_hidden_layers)]
+        pools = self._pool_views(flat_pools)
         window = jnp.concatenate([tokens[:, None], proposals], axis=1)
 
         def call():
@@ -383,9 +459,7 @@ class GenerationServer:
             return self._head(h), new
 
         logits, new = functional_call(model, params, call_fn=call)
-        flat = []
-        for kp, vp in new:
-            flat += [kp.value, vp.value]
+        flat = self._flat_pools(new)
         from .speculative import speculative_accept
 
         out, acc = speculative_accept(
@@ -419,8 +493,7 @@ class GenerationServer:
 
         def one_window(carry, w):
             c, flat_p, p = carry
-            pools = [(Tensor(flat_p[2 * i]), Tensor(flat_p[2 * i + 1]))
-                     for i in range(self.cfg.num_hidden_layers)]
+            pools = self._pool_views(flat_p)
             cur = jnp.take_along_axis(c, p[:, None], axis=1)      # (B, 1)
             proposals = self.drafter.propose_device(c, p, k)
             window = jnp.concatenate([cur, proposals], axis=1)
@@ -431,9 +504,7 @@ class GenerationServer:
                 return self._head(h), new
 
             logits, new = functional_call(model, params, call_fn=call)
-            flat = []
-            for kp, vp in new:
-                flat += [kp.value, vp.value]
+            flat = self._flat_pools(new)
             out, acc = speculative_accept(
                 logits.value.astype(jnp.float32), proposals, temps, topks,
                 topps, kcaps, jax.random.fold_in(key, w), None,

@@ -516,87 +516,132 @@ class LlamaAttention(Layer):
         out = reshape(out, [B, 1, H * D])
         return self.o_proj(out), ck, cv
 
-    def paged_decode(self, x, cos, sin, kp, vp, block_tables, pos):
+    def paged_decode(self, x, cos, sin, pool, block_tables, pos):
         """Single-token decode against the PAGED pool: K/V of the new token
         scatter through the block table at ``pos``; attention gathers
-        context by table (ops/paged_attention.py). kp/vp: Tensors
-        (num_blocks, bs, KV, D); block_tables: traced int32 (B, M); pos:
-        traced int32 [B]. Numerically mirrors the dense vector-pos
-        ``decode`` so paged/dense greedy outputs agree token-exactly."""
+        context by table (ops/paged_attention.py). ``pool``: per-layer
+        tuple of Tensors — ``(kp, vp)`` f32/bf16 pools
+        (num_blocks, bs, KV, D), or ``(kq, ks, vq, vs)`` int8 pools + f32
+        per-block-per-head scales (kv_quant="int8": dequant is fused into
+        the attention, the pool is never materialized in full precision);
+        block_tables: traced int32 (B, M); pos: traced int32 [B].
+        Numerically mirrors the dense vector-pos ``decode`` so paged/dense
+        greedy outputs agree token-exactly."""
         B = x.shape[0]
         H, D = self.num_heads, self.head_dim
         q, k, v = self._qkv(x, B, 1)
 
-        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
-            from ..ops.paged_attention import (paged_decode_attention,
-                                               write_decode_kv)
+        if len(pool) == 4:
+            def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
+                from ..ops.paged_attention import (paged_decode_attention_q,
+                                                   write_decode_kv_q)
 
-            qr = _apply_rope_rows(qv, cosv, sinv, pos)
-            kr = _apply_rope_rows(kv, cosv, sinv, pos)
-            kpv, vpv = write_decode_kv(kpv, vpv, kr[:, 0], vv[:, 0],
-                                       block_tables, pos)
-            out = paged_decode_attention(qr, kpv, vpv, block_tables, pos)
-            return out, kpv, vpv
+                qr = _apply_rope_rows(qv, cosv, sinv, pos)
+                kr = _apply_rope_rows(kv, cosv, sinv, pos)
+                kqv, ksv, vqv, vsv = write_decode_kv_q(
+                    kqv, ksv, vqv, vsv, kr[:, 0], vv[:, 0], block_tables, pos)
+                out = paged_decode_attention_q(qr, kqv, ksv, vqv, vsv,
+                                               block_tables, pos)
+                return out, kqv, ksv, vqv, vsv
+        else:
+            def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+                from ..ops.paged_attention import (paged_decode_attention,
+                                                   write_decode_kv)
 
-        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
-                               op_name="paged_decode_attention")
+                qr = _apply_rope_rows(qv, cosv, sinv, pos)
+                kr = _apply_rope_rows(kv, cosv, sinv, pos)
+                kpv, vpv = write_decode_kv(kpv, vpv, kr[:, 0], vv[:, 0],
+                                           block_tables, pos)
+                out = paged_decode_attention(qr, kpv, vpv, block_tables, pos)
+                return out, kpv, vpv
+
+        out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
+                              op_name="paged_decode_attention")
         out = reshape(out, [B, 1, H * D])
-        return self.o_proj(out), kp, vp
+        return self.o_proj(out), tuple(pool)
 
-    def paged_verify_attn(self, x, cos, sin, kp, vp, block_tables, pos):
+    def paged_verify_attn(self, x, cos, sin, pool, block_tables, pos):
         """Multi-token speculative VERIFY window against the paged pool:
         K/V for all W = k+1 window tokens scatter through the block table
         at ``pos..pos+k``; attention gathers context by table with the
         in-window causal mask (query j sees positions ≤ pos+j). x:
-        (B, W, hidden); block_tables: traced int32 (B, M); pos: traced
-        int32 [B]. At W = 1 this is numerically the paged ``decode`` —
-        which is what makes greedy speculative output token-exact vs the
-        dense server."""
+        (B, W, hidden); ``pool`` as in :meth:`paged_decode`; block_tables:
+        traced int32 (B, M); pos: traced int32 [B]. At W = 1 this is
+        numerically the paged ``decode`` — which is what makes greedy
+        speculative output token-exact vs the dense server."""
         B, W = x.shape[0], x.shape[1]
         H, D = self.num_heads, self.head_dim
         q, k, v = self._qkv(x, B, W)
 
-        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
-            from ..ops.paged_attention import (paged_verify_attention,
-                                               write_window_kv)
+        if len(pool) == 4:
+            def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
+                from ..ops.paged_attention import (paged_verify_attention_q,
+                                                   write_window_kv_q)
 
-            qr = _apply_rope_window(qv, cosv, sinv, pos)
-            kr = _apply_rope_window(kv, cosv, sinv, pos)
-            kpv, vpv = write_window_kv(kpv, vpv, kr, vv, block_tables, pos)
-            out = paged_verify_attention(qr, kpv, vpv, block_tables, pos)
-            return out, kpv, vpv
+                qr = _apply_rope_window(qv, cosv, sinv, pos)
+                kr = _apply_rope_window(kv, cosv, sinv, pos)
+                kqv, ksv, vqv, vsv = write_window_kv_q(
+                    kqv, ksv, vqv, vsv, kr, vv, block_tables, pos)
+                out = paged_verify_attention_q(qr, kqv, ksv, vqv, vsv,
+                                               block_tables, pos)
+                return out, kqv, ksv, vqv, vsv
+        else:
+            def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+                from ..ops.paged_attention import (paged_verify_attention,
+                                                   write_window_kv)
 
-        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
-                               op_name="paged_verify_attention")
+                qr = _apply_rope_window(qv, cosv, sinv, pos)
+                kr = _apply_rope_window(kv, cosv, sinv, pos)
+                kpv, vpv = write_window_kv(kpv, vpv, kr, vv, block_tables,
+                                           pos)
+                out = paged_verify_attention(qr, kpv, vpv, block_tables, pos)
+                return out, kpv, vpv
+
+        out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
+                              op_name="paged_verify_attention")
         out = reshape(out, [B, W, H * D])
-        return self.o_proj(out), kp, vp
+        return self.o_proj(out), tuple(pool)
 
-    def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
+    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start):
         """One fixed-size prefill CHUNK through the paged pool: queries sit
         at positions ``start + arange(C)`` (``start`` traced, block-aligned,
         C a multiple of the block size), their K/V scatter into consecutive
         table entries, and attention runs against ALL paged context written
         so far (earlier chunks + shared prefix blocks) with a causal mask.
-        x: (1, C, hidden); block_table: traced int32 (M,)."""
+        x: (1, C, hidden); ``pool`` as in :meth:`paged_decode`;
+        block_table: traced int32 (M,)."""
         B, S = x.shape[0], x.shape[1]
         H, D = self.num_heads, self.head_dim
         q, k, v = self._qkv(x, B, S)
 
-        def step(qv, kv, vv, kpv, vpv, cosv, sinv):
-            from ..ops.paged_attention import (paged_prefill_attention,
-                                               write_chunk_kv)
+        if len(pool) == 4:
+            def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
+                from ..ops.paged_attention import (paged_prefill_attention_q,
+                                                   write_chunk_kv_q)
 
-            qr = _apply_rope_chunk(qv, cosv, sinv, start)
-            kr = _apply_rope_chunk(kv, cosv, sinv, start)
-            kpv, vpv = write_chunk_kv(kpv, vpv, kr[0], vv[0], block_table,
-                                      start)
-            out = paged_prefill_attention(qr, kpv, vpv, block_table, start)
-            return out, kpv, vpv
+                qr = _apply_rope_chunk(qv, cosv, sinv, start)
+                kr = _apply_rope_chunk(kv, cosv, sinv, start)
+                kqv, ksv, vqv, vsv = write_chunk_kv_q(
+                    kqv, ksv, vqv, vsv, kr[0], vv[0], block_table, start)
+                out = paged_prefill_attention_q(qr, kqv, ksv, vqv, vsv,
+                                                block_table, start)
+                return out, kqv, ksv, vqv, vsv
+        else:
+            def step(qv, kv, vv, kpv, vpv, cosv, sinv):
+                from ..ops.paged_attention import (paged_prefill_attention,
+                                                   write_chunk_kv)
 
-        out, kp, vp = apply_op(step, q, k, v, kp, vp, Tensor(cos), Tensor(sin),
-                               op_name="paged_prefill_attention")
+                qr = _apply_rope_chunk(qv, cosv, sinv, start)
+                kr = _apply_rope_chunk(kv, cosv, sinv, start)
+                kpv, vpv = write_chunk_kv(kpv, vpv, kr[0], vv[0], block_table,
+                                          start)
+                out = paged_prefill_attention(qr, kpv, vpv, block_table, start)
+                return out, kpv, vpv
+
+        out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
+                              op_name="paged_prefill_attention")
         out = reshape(out, [B, S, H * D])
-        return self.o_proj(out), kp, vp
+        return self.o_proj(out), tuple(pool)
 
 
 class LlamaMLP(Layer):
@@ -707,26 +752,26 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, ck, cv
 
-    def paged_decode(self, x, cos, sin, kp, vp, block_tables, pos):
-        a, kp, vp = self.self_attn.paged_decode(self.input_layernorm(x), cos,
-                                                sin, kp, vp, block_tables, pos)
+    def paged_decode(self, x, cos, sin, pool, block_tables, pos):
+        a, pool = self.self_attn.paged_decode(self.input_layernorm(x), cos,
+                                              sin, pool, block_tables, pos)
         h = x + a
         out = h + self.mlp(self.post_attention_layernorm(h))
-        return out, kp, vp
+        return out, pool
 
-    def paged_verify(self, x, cos, sin, kp, vp, block_tables, pos):
-        a, kp, vp = self.self_attn.paged_verify_attn(
-            self.input_layernorm(x), cos, sin, kp, vp, block_tables, pos)
+    def paged_verify(self, x, cos, sin, pool, block_tables, pos):
+        a, pool = self.self_attn.paged_verify_attn(
+            self.input_layernorm(x), cos, sin, pool, block_tables, pos)
         h = x + a
         out = h + self.mlp(self.post_attention_layernorm(h))
-        return out, kp, vp
+        return out, pool
 
-    def paged_prefill_chunk(self, x, cos, sin, kp, vp, block_table, start):
-        a, kp, vp = self.self_attn.paged_prefill_chunk(
-            self.input_layernorm(x), cos, sin, kp, vp, block_table, start)
+    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start):
+        a, pool = self.self_attn.paged_prefill_chunk(
+            self.input_layernorm(x), cos, sin, pool, block_table, start)
         h = x + a
         out = h + self.mlp(self.post_attention_layernorm(h))
-        return out, kp, vp
+        return out, pool
 
 
 class LlamaModel(Layer):
@@ -798,15 +843,16 @@ class LlamaModel(Layer):
     def paged_decode_step(self, token, pools, block_tables, pos):
         """Paged continuous-batching decode: like :meth:`decode_step` but
         K/V read/write goes through per-row block tables into the shared
-        block pool. token: Tensor (B, 1); pools: list of (kp, vp) Tensors
-        (num_blocks, bs, KV, D) per layer; block_tables: traced int32
-        (B, M); pos: traced int32 [B]."""
+        block pool. token: Tensor (B, 1); pools: list of per-layer pool
+        tuples — ``(kp, vp)`` Tensors (num_blocks, bs, KV, D), or
+        ``(kq, ks, vq, vs)`` for the int8 pool (kv_quant="int8");
+        block_tables: traced int32 (B, M); pos: traced int32 [B]."""
         x = self.embed_tokens(token)
         new = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            x, kp, vp = layer.paged_decode(x, self._cos, self._sin, kp, vp,
-                                           block_tables, pos)
-            new.append((kp, vp))
+        for layer, pool in zip(self.layers, pools):
+            x, pool = layer.paged_decode(x, self._cos, self._sin, pool,
+                                         block_tables, pos)
+            new.append(pool)
         return self.norm(x), new
 
     def paged_verify_step(self, tokens, pools, block_tables, pos):
@@ -820,10 +866,10 @@ class LlamaModel(Layer):
         runs rejection sampling."""
         x = self.embed_tokens(tokens)
         new = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            x, kp, vp = layer.paged_verify(x, self._cos, self._sin, kp, vp,
-                                           block_tables, pos)
-            new.append((kp, vp))
+        for layer, pool in zip(self.layers, pools):
+            x, pool = layer.paged_verify(x, self._cos, self._sin, pool,
+                                         block_tables, pos)
+            new.append(pool)
         return self.norm(x), new
 
     def paged_prefill_chunk(self, input_ids, pools, block_table, start):
@@ -834,10 +880,10 @@ class LlamaModel(Layer):
         hidden for the chunk, new pools)."""
         x = self.embed_tokens(input_ids)
         new = []
-        for layer, (kp, vp) in zip(self.layers, pools):
-            x, kp, vp = layer.paged_prefill_chunk(x, self._cos, self._sin,
-                                                  kp, vp, block_table, start)
-            new.append((kp, vp))
+        for layer, pool in zip(self.layers, pools):
+            x, pool = layer.paged_prefill_chunk(x, self._cos, self._sin,
+                                                pool, block_table, start)
+            new.append(pool)
         return self.norm(x), new
 
     def _should_recompute(self):

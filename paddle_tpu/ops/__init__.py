@@ -4,10 +4,18 @@ fused_multi_transformer_op.cu, fmha_ref.h) and hand-written PHI GPU kernels.
 """
 from .flash_attention import flash_attention, flash_attention_bshd
 from .fused_norm import fused_rms_norm, fused_layer_norm
-from .paged_attention import (gather_block_kv, paged_decode_attention,
-                              paged_prefill_attention, write_chunk_kv,
-                              write_decode_kv)
+from .paged_attention import (gather_block_kv, gather_block_scales,
+                              paged_decode_attention,
+                              paged_decode_attention_q,
+                              paged_prefill_attention,
+                              paged_prefill_attention_q,
+                              quantize_block_kv, write_chunk_kv,
+                              write_chunk_kv_q, write_decode_kv,
+                              write_decode_kv_q)
 
 __all__ = ["flash_attention", "flash_attention_bshd", "fused_rms_norm",
-           "fused_layer_norm", "gather_block_kv", "paged_decode_attention",
-           "paged_prefill_attention", "write_chunk_kv", "write_decode_kv"]
+           "fused_layer_norm", "gather_block_kv", "gather_block_scales",
+           "paged_decode_attention", "paged_decode_attention_q",
+           "paged_prefill_attention", "paged_prefill_attention_q",
+           "quantize_block_kv", "write_chunk_kv", "write_chunk_kv_q",
+           "write_decode_kv", "write_decode_kv_q"]

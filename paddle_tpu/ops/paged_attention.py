@@ -167,3 +167,172 @@ def paged_prefill_attention(q, k_pool, v_pool, block_table, start):
     p = jax.nn.softmax(scores, -1).astype(q.dtype)
     out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
     return out.reshape(B, C, H, D)
+
+
+# --------------------------------------------------------------------------- #
+# Quantized pool (kv_quant="int8"): the pool stores int8 codes
+# (num_blocks, bs, KV, D) plus one f32 scale per (block, kv-head)
+# (num_blocks, KV) — symmetric absmax, value ≈ code · scale. Half the HBM
+# bytes of bf16 (a quarter of f32), so ~2× resident blocks at the same pool
+# budget AND ~2× less KV traffic per decode step. The attention twins below
+# fold the dequant INTO the program — scale lands on the QK accumulator and
+# on p before the V accumulation — so a full-precision pool is never
+# materialized (XLA fuses the scale multiply into the surrounding einsum;
+# a Pallas kernel would apply it on the VMEM tile). int8 codes (|q| ≤ 127)
+# are exact in bf16/f32, so the only error is the quantization rounding.
+# --------------------------------------------------------------------------- #
+
+_QEPS = 1e-8   # scale floor: an all-zero block quantizes to scale ~0 with
+               # zero codes instead of dividing by zero
+
+
+def quantize_block_kv(x):
+    """(N, bs, KV, D) float → ((N, bs, KV, D) int8, (N, KV) f32 scale);
+    symmetric absmax per block per kv head."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=(1, 3))                  # (N, KV)
+    scale = jnp.maximum(absmax, _QEPS) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[:, None, :, None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_block_kv(q, scale):
+    """Inverse of :func:`quantize_block_kv` — TEST/reference helper only;
+    the serving programs never materialize this."""
+    return q.astype(jnp.float32) * scale[:, None, :, None]
+
+
+def _insert_token_q(qpool, scales, tok, bid, off):
+    """Insert one token's K (or V) (B, KV, D) at slot ``off`` of block
+    ``bid`` per row, requantizing each touched block in ONE pass: the block
+    is reconstructed (codes · scale), the token dropped in, the per-head
+    absmax recomputed and the whole block re-coded. When the new token
+    does not move a head's absmax the scale is unchanged and old codes
+    round-trip exactly (round(q·s/s) == q); only a scale-raising outlier
+    re-rounds its block, bounding the error at scale/2 per value."""
+    B = tok.shape[0]
+    rows = jnp.arange(B)
+    blk = qpool[bid].astype(jnp.float32) * \
+        scales[bid][:, None, :, None]                   # (B, bs, KV, D)
+    blk = blk.at[rows, off].set(tok.astype(jnp.float32))
+    amax = jnp.max(jnp.abs(blk), axis=(1, 3))           # (B, KV)
+    ns = jnp.maximum(amax, _QEPS) / 127.0
+    q = jnp.clip(jnp.round(blk / ns[:, None, :, None]), -127,
+                 127).astype(jnp.int8)
+    # duplicate bids only occur for masked rows parked on scratch block 0
+    # (whichever row wins, the content stays finite and is never attended)
+    return qpool.at[bid].set(q), scales.at[bid].set(ns)
+
+
+def write_window_kv_q(kq, ks, vq, vs, k, v, block_tables, pos):
+    """Quantizing twin of :func:`write_window_kv`: scatter a WINDOW of new
+    tokens' K/V into the int8 pool, rescaling each touched block in one
+    pass per token. k/v: (B, W, KV, D) float; kq/vq: (N, bs, KV, D) int8;
+    ks/vs: (N, KV) f32. W is small and static (1 = decode, k+1 = verify
+    window), so the per-token walk unrolls at trace time — a Pallas kernel
+    would fold the whole window into one block pass."""
+    bs = kq.shape[1]
+    W = k.shape[1]
+    for j in range(W):
+        pj = pos + j
+        bid = jnp.take_along_axis(block_tables, (pj // bs)[:, None],
+                                  axis=1)[:, 0]
+        off = pj % bs
+        kq, ks = _insert_token_q(kq, ks, k[:, j], bid, off)
+        vq, vs = _insert_token_q(vq, vs, v[:, j], bid, off)
+    return kq, ks, vq, vs
+
+
+def write_decode_kv_q(kq, ks, vq, vs, k, v, block_tables, pos):
+    """Quantizing twin of :func:`write_decode_kv` — one token per row.
+    k/v: (B, KV, D)."""
+    return write_window_kv_q(kq, ks, vq, vs, k[:, None], v[:, None],
+                             block_tables, pos)
+
+
+def write_chunk_kv_q(kq, ks, vq, vs, k, v, block_table, start):
+    """Quantizing twin of :func:`write_chunk_kv`: a prefill chunk fully
+    overwrites its blocks, so each block is quantized FRESH (no rescale
+    pass). k/v: (C, KV, D), C a multiple of ``bs``."""
+    bs = kq.shape[1]
+    nb = k.shape[0] // bs
+    blocks = jax.lax.dynamic_slice_in_dim(block_table, start // bs, nb, 0)
+    knew, ksn = quantize_block_kv(k.reshape(nb, bs, *k.shape[1:]))
+    vnew, vsn = quantize_block_kv(v.reshape(nb, bs, *v.shape[1:]))
+    return (kq.at[blocks].set(knew), ks.at[blocks].set(ksn),
+            vq.at[blocks].set(vnew), vs.at[blocks].set(vsn))
+
+
+def gather_block_scales(scales, block_tables, block_size):
+    """Per-TOKEN scale view of the per-block scales: (N, KV) gathered
+    through (B, M) tables and repeated across the block → (B, L, KV),
+    L = M·block_size — aligned with :func:`gather_block_kv`'s context."""
+    bt = block_tables if block_tables.ndim == 2 else block_tables[None]
+    return jnp.repeat(scales[bt], block_size, axis=1)
+
+
+def paged_verify_attention_q(q, kq, ks, vq, vs, block_tables, pos):
+    """Fused-dequant twin of :func:`paged_verify_attention`: attention
+    reads int8 K/V codes and applies the per-block-per-head scales INSIDE
+    the program — k's scale multiplies the fp32 QK accumulator
+    ((q·k_q)·s == q·(k_q·s), scales are per kv head so they commute with
+    the D-contraction), v's scale folds into p before the V accumulation
+    (p·(v_q·s) == (p·s)·v_q, per-head scales commute with the
+    L-contraction) — never materializing a dequantized pool. Masking /
+    softmax semantics are identical to the fp twin."""
+    B, W, H, D = q.shape
+    KV = kq.shape[2]
+    bs = kq.shape[1]
+    rep = H // KV
+    ckq = gather_block_kv(kq, block_tables)       # (B, L, KV, D) int8
+    cvq = gather_block_kv(vq, block_tables)
+    ksl = gather_block_scales(ks, block_tables, bs)   # (B, L, KV) f32
+    vsl = gather_block_scales(vs, block_tables, bs)
+    L = ckq.shape[1]
+    qg = q.reshape(B, W, KV, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg,
+                        ckq.astype(q.dtype)).astype(jnp.float32)
+    scores = scores * jnp.transpose(ksl, (0, 2, 1))[:, :, None, None, :] \
+        / math.sqrt(D)
+    qpos = pos[:, None] + jnp.arange(W)[None, :]  # (B, W)
+    mask = (jnp.arange(L)[None, None, :] <=
+            qpos[:, :, None])[:, None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, -1).astype(q.dtype)
+    pv = p * jnp.transpose(vsl, (0, 2, 1))[:, :, None, None, :].astype(
+        p.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", pv, cvq.astype(q.dtype))
+    return out.reshape(B, W, H, D)
+
+
+def paged_decode_attention_q(q, kq, ks, vq, vs, block_tables, pos):
+    """Single-token fused-dequant decode — :func:`paged_verify_attention_q`
+    at W = 1. q: (B, 1, H, D)."""
+    return paged_verify_attention_q(q, kq, ks, vq, vs, block_tables, pos)
+
+
+def paged_prefill_attention_q(q, kq, ks, vq, vs, block_table, start):
+    """Fused-dequant twin of :func:`paged_prefill_attention` — one prefill
+    chunk of queries against the quantized paged context."""
+    B, C, H, D = q.shape
+    KV = kq.shape[2]
+    bs = kq.shape[1]
+    rep = H // KV
+    ckq = gather_block_kv(kq, block_table)        # (1, L, KV, D) int8
+    cvq = gather_block_kv(vq, block_table)
+    ksl = gather_block_scales(ks, block_table, bs)    # (1, L, KV) f32
+    vsl = gather_block_scales(vs, block_table, bs)
+    L = ckq.shape[1]
+    qg = q.reshape(B, C, KV, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg,
+                        ckq.astype(q.dtype)).astype(jnp.float32)
+    scores = scores * jnp.transpose(ksl, (0, 2, 1))[:, :, None, None, :] \
+        / math.sqrt(D)
+    qpos = start + jnp.arange(C)                  # (C,)
+    mask = (jnp.arange(L)[None, :] <= qpos[:, None])[None, None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, -1).astype(q.dtype)
+    pv = p * jnp.transpose(vsl, (0, 2, 1))[:, :, None, None, :].astype(
+        p.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", pv, cvq.astype(q.dtype))
+    return out.reshape(B, C, H, D)

@@ -151,3 +151,62 @@ def test_fused_qkv_matches_unfused(monkeypatch):
                    for p in ("q_proj", "k_proj", "v_proj")), pnames
     bnames = [n for n, _ in att.named_buffers()]
     assert any("qkv_fused" in n for n in bnames), bnames
+
+
+class TestW8PathHeuristic:
+    """Pin WHICH program w8_matmul picks per shape — the M<=16 reuse gate
+    (ops/int8.py:106-114): single-token decode batches stream int8 weights
+    through the Pallas kernel; prefill/training shapes (M large, each
+    weight block reused M times) must take the dequantize-once XLA path."""
+
+    def _spy(self, monkeypatch):
+        from paddle_tpu.ops import int8 as int8_mod
+
+        calls = []
+        real = int8_mod._w8_matmul_pallas
+
+        def spy(x2, w_q, scale, out_dtype, block_n=0):
+            calls.append(x2.shape)
+            return real(x2, w_q, scale, out_dtype, block_n)
+
+        monkeypatch.setattr(int8_mod, "_w8_matmul_pallas", spy)
+        return calls
+
+    def _run(self, M, K, N):
+        from paddle_tpu.ops.int8 import quantize_per_channel, w8_matmul
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(M, K).astype("float32")
+        w_q, scale = quantize_per_channel(rng.randn(K, N).astype("float32"))
+        out = np.asarray(w8_matmul(x, w_q, scale))
+        ref = x @ (np.asarray(w_q, np.float32) * np.asarray(scale)[None, :])
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_decode_shape_streams(self, monkeypatch):
+        # M<=16, aligned K/N: the weight-read-bound regime → Pallas path
+        monkeypatch.setenv("PT_FLASH_INTERPRET", "1")
+        calls = self._spy(monkeypatch)
+        self._run(16, 128, 128)
+        assert calls == [(16, 128)]
+
+    def test_prefill_shape_dequantizes_once(self, monkeypatch):
+        # M>16 (prefill/training: weights reused M times) → XLA dequant
+        monkeypatch.setenv("PT_FLASH_INTERPRET", "1")
+        calls = self._spy(monkeypatch)
+        self._run(32, 128, 128)
+        assert calls == []
+
+    def test_unaligned_k_falls_back(self, monkeypatch):
+        # K not a lane multiple can't tile the MXU → XLA dequant
+        monkeypatch.setenv("PT_FLASH_INTERPRET", "1")
+        calls = self._spy(monkeypatch)
+        self._run(8, 96, 128)
+        assert calls == []
+
+    def test_cpu_without_interpret_dequantizes(self, monkeypatch):
+        # no TPU and no interpret flag: _use_pallas() is False even at
+        # decode shapes — the gate must consult the backend, not just M
+        monkeypatch.delenv("PT_FLASH_INTERPRET", raising=False)
+        calls = self._spy(monkeypatch)
+        self._run(8, 128, 128)
+        assert calls == []

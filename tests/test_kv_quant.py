@@ -1,0 +1,319 @@
+"""Quantized paged KV cache (kv_quant='int8'): int8 block pool +
+per-block-per-head scales with dequant FUSED into the paged attention
+programs (ops/paged_attention.py *_q twins). Quick tier on CPU — covers
+the op-level quantization semantics, the server-level token-exactness vs
+the fp paged path, the zero-steady-state-recompile guarantee, and the
+capacity win at a fixed pool byte budget."""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import GenerationServer, kv_block_bytes
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+# --------------------------------------------------------------------- ops
+class TestQuantOps:
+    def test_roundtrip_error_bound_and_zero_block_guard(self):
+        from paddle_tpu.ops.paged_attention import (dequantize_block_kv,
+                                                    quantize_block_kv)
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 4, 2, 8).astype("float32")
+        x[2] = 0.0                      # all-zero block: scale must not be 0
+        q, s = quantize_block_kv(x)
+        assert np.asarray(q).dtype == np.int8
+        assert s.shape == (3, 2)
+        assert (np.asarray(s) > 0).all()
+        deq = np.asarray(dequantize_block_kv(q, s))
+        # symmetric absmax: |err| <= scale/2 per value, per (block, head)
+        err = np.abs(deq - x)
+        bound = np.asarray(s)[:, None, :, None] * 0.5 + 1e-7
+        assert (err <= bound).all()
+        # the zero block decodes to exactly zero (codes are all 0)
+        assert (deq[2] == 0).all()
+
+    def test_unchanged_scale_roundtrips_codes_exactly(self):
+        """Inserting a token that does NOT raise a head's absmax must leave
+        every other slot's codes bit-identical: round(q*s/s) == q."""
+        from paddle_tpu.ops.paged_attention import (quantize_block_kv,
+                                                    write_decode_kv_q)
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 2, 8).astype("float32")
+        kq, ks = quantize_block_kv(x)
+        vq, vs = quantize_block_kv(x)
+        before_k = np.array(np.asarray(kq))
+        # small token (won't move absmax) into block 1 slot 2, one row
+        tok = (0.01 * rng.randn(1, 2, 8)).astype("float32")
+        bt = np.array([[1]], np.int32)
+        nkq, nks, nvq, nvs = write_decode_kv_q(
+            kq, ks, vq, vs, jnp.asarray(tok), jnp.asarray(tok), jnp.asarray(bt),
+            jnp.asarray([2], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(nks), np.asarray(ks))
+        got = np.asarray(nkq)
+        # untouched slots of block 1 keep their exact codes
+        mask = np.ones((4,), bool)
+        mask[2] = False
+        np.testing.assert_array_equal(got[1][mask], before_k[1][mask])
+        # block 0 untouched entirely
+        np.testing.assert_array_equal(got[0], before_k[0])
+
+    def test_late_outlier_rescales_block(self):
+        """A late token that RAISES a head's absmax must rescale the block:
+        the new scale covers the outlier and earlier values stay within
+        the (new, coarser) scale/2 rounding bound."""
+        from paddle_tpu.ops.paged_attention import write_decode_kv_q
+        from paddle_tpu.ops.paged_attention import quantize_block_kv
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 4, 2, 8).astype("float32")
+        kq, ks = quantize_block_kv(x)
+        vq, vs = quantize_block_kv(x)
+        old_scale = np.array(np.asarray(ks))
+        outlier = np.full((1, 2, 8), 50.0, "float32")   # >> existing absmax
+        bt = np.array([[1]], np.int32)
+        nkq, nks, _, _ = write_decode_kv_q(
+            kq, ks, vq, vs, jnp.asarray(outlier), jnp.asarray(outlier),
+            jnp.asarray(bt), jnp.asarray([3], jnp.int32))
+        ns = np.asarray(nks)
+        assert (ns[1] > old_scale[1]).all()             # scale raised
+        assert (ns[0] == old_scale[0]).all()            # other block kept
+        deq = np.asarray(nkq)[1].astype(np.float32) * ns[1][None, :, None]
+        # outlier itself is representable within rounding
+        np.testing.assert_allclose(deq[3], outlier[0], atol=ns[1].max() * 0.5)
+        # earlier tokens survive with the coarser scale's bound
+        err = np.abs(deq[:3] - x[1, :3])
+        assert (err <= ns[1][None, :, None] * 0.5 + 1e-6).all()
+
+    def test_fused_dequant_attention_matches_dequantized_reference(self):
+        """The fused-scale program must equal attention over an explicitly
+        dequantized pool — scales commute with both contractions."""
+        from paddle_tpu.ops.paged_attention import (
+            dequantize_block_kv, paged_verify_attention,
+            paged_verify_attention_q, quantize_block_kv)
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(3)
+        N, bs, KV, D, H, B, W = 5, 4, 2, 8, 4, 2, 3
+        kf = rng.randn(N, bs, KV, D).astype("float32")
+        vf = rng.randn(N, bs, KV, D).astype("float32")
+        kq, ks = quantize_block_kv(kf)
+        vq, vs = quantize_block_kv(vf)
+        q = rng.randn(B, W, H, D).astype("float32")
+        bt = np.array([[1, 2], [3, 4]], np.int32)
+        pos = np.array([4, 2], np.int32)
+        fused = np.asarray(paged_verify_attention_q(
+            jnp.asarray(q), kq, ks, vq, vs, jnp.asarray(bt),
+            jnp.asarray(pos)))
+        ref = np.asarray(paged_verify_attention(
+            jnp.asarray(q), dequantize_block_kv(kq, ks),
+            dequantize_block_kv(vq, vs), jnp.asarray(bt), jnp.asarray(pos)))
+        np.testing.assert_allclose(fused, ref, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------------ server
+def test_int8_paged_matches_fp_paged_and_dense_greedy():
+    """Greedy int8 paged output must be token-identical to the unquantized
+    paged server AND the dense oracle on the quick-tier prompt set, under
+    slot churn and multi-chunk prefill."""
+    model, cfg = _model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 12, 7, 3, 12, 20)]
+
+    def run(**kw):
+        srv = GenerationServer(model, max_batch=2, max_len=64, **kw)
+        rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids], srv
+
+    dense, _ = run(prompt_buckets=(32,))
+    fp, _ = run(cache="paged", block_size=4, prefill_chunk=8)
+    q, srv = run(cache="paged", block_size=4, prefill_chunk=8,
+                 kv_quant="int8")
+    assert q == fp, "int8 paged diverged from fp paged"
+    assert q == dense, "int8 paged diverged from the dense oracle"
+    assert srv.kv_stats()["blocks_in_use"] == 0
+    assert srv.kv_stats()["kv_quant"] == "int8"
+
+
+def test_int8_zero_steady_state_recompiles_second_wave():
+    """After a warm-up wave, a second wave (new lengths, churn, prefix
+    misses) on the int8 pool must run with ZERO backend compiles —
+    including speculative gate transitions (probe → gated plain → probe)."""
+    from paddle_tpu.analysis import jit_cache_guard
+    from paddle_tpu.inference.speculative import SpecConfig
+
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, kv_quant="int8",
+                           spec=SpecConfig(k=3, drafter="ngram"))
+    rng = np.random.RandomState(3)
+    for p in [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+              for n in (5, 12)]:
+        srv.submit(p, max_new_tokens=8)
+    srv.run()  # compiles prefill + verify + gated plain decode programs
+
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (7, 3, 20, 9)]
+    rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    with jit_cache_guard("int8 paged steady state") as g:
+        out = srv.run()
+    assert g.compiles == 0
+    for r, p in zip(rids, prompts):
+        assert len(out[r]) == len(p) + 8
+
+
+def test_int8_spec_eos_inside_window_matches_plain():
+    """eos emitted mid-window on the QUANTIZED pool: speculative output
+    must still match the plain int8 server token for token, and stop at
+    eos (window surplus discarded)."""
+    from paddle_tpu.inference.speculative import SpecConfig
+
+    model, cfg = _model()
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (6, 11, 4)]
+
+    def run(spec):
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8,
+                               kv_quant="int8", eos_token_id=None, spec=spec)
+        rids = [srv.submit(p, max_new_tokens=10) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    plain = run(None)
+    # pick an eos that actually occurs mid-generation in the plain output
+    eos = None
+    for toks, p in zip(plain, prompts):
+        gen = toks[len(p):]
+        if len(gen) > 2:
+            eos = gen[2]
+            break
+    assert eos is not None
+
+    def run_eos(spec):
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8,
+                               kv_quant="int8", eos_token_id=eos, spec=spec)
+        rids = [srv.submit(p, max_new_tokens=10) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    pe = run_eos(None)
+    se = run_eos(SpecConfig(k=3, drafter="ngram"))
+    assert se == pe
+    # at least one request truncated at eos
+    assert any(len(t) < len(p) + 10 or t[-1] == eos
+               for t, p in zip(se, prompts))
+
+
+def test_int8_prefix_blocks_lru_reclaimed_under_pressure():
+    """A tiny int8 pool: cached (quantized) prefix blocks must be evicted
+    LRU-style to satisfy later requests instead of failing allocation, and
+    the outputs stay correct."""
+    model, cfg = _model()
+    rng = np.random.RandomState(9)
+    shared = rng.randint(1, cfg.vocab_size, (12,)).tolist()
+    others = [rng.randint(1, cfg.vocab_size, (12,)).tolist()
+              for _ in range(3)]
+
+    ref_srv = GenerationServer(model, max_batch=1, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8,
+                               kv_quant="int8")
+    refs = {}
+    for p in [shared] + others:
+        rid = ref_srv.submit(p, max_new_tokens=6)
+        refs[tuple(p)] = ref_srv.run()[rid]
+
+    # pool sized so the cached prefix of `shared` must be evicted to admit
+    # the other prompts: 12-token prompt + 6 decode -> ceil(18/4)=5 blocks
+    # live per request, +1 scratch; 8 total leaves <=2 spare
+    srv = GenerationServer(model, max_batch=1, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, kv_quant="int8",
+                           num_blocks=8)
+    out = []
+    for p in [shared] + others + [shared]:
+        rid = srv.submit(p, max_new_tokens=6)
+        out.append((tuple(p), srv.run()[rid]))
+    for key, toks in out:
+        assert toks == refs[key]
+    assert srv.alloc.stats()["evictions"] > 0
+
+
+def test_pool_bytes_budget_gives_2x_blocks():
+    """At the SAME byte budget the int8 pool must hold >=1.8x the blocks
+    of the fp pool (f32 model: ~3.9x; bf16 would be ~2x) — the acceptance
+    criterion behind the --kv-quant capacity claim."""
+    model, cfg = _model()
+    budget = 40 * kv_block_bytes(cfg, 8, "none")
+    fp = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                          block_size=8, pool_bytes=budget)
+    q = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                         block_size=8, kv_quant="int8", pool_bytes=budget)
+    assert fp.alloc.num_blocks == 40
+    assert q.alloc.num_blocks >= 1.8 * fp.alloc.num_blocks
+    # and the per-token byte figure is correspondingly smaller
+    bpt_fp = kv_block_bytes(cfg, 8, "none") / 8
+    bpt_q = kv_block_bytes(cfg, 8, "int8") / 8
+    assert bpt_q <= 0.55 * bpt_fp
+
+
+def test_kv_quant_ctor_validation():
+    model, cfg = _model()
+    with pytest.raises(ValueError, match="kv_quant"):
+        GenerationServer(model, max_len=64, cache="paged", kv_quant="fp8")
+    with pytest.raises(ValueError, match="requires cache='paged'"):
+        GenerationServer(model, max_len=64, cache="dense", kv_quant="int8")
+    with pytest.raises(ValueError, match="not both"):
+        GenerationServer(model, max_len=64, cache="paged", num_blocks=8,
+                         pool_bytes=1 << 20)
+    with pytest.raises(ValueError, match="pool_bytes"):
+        GenerationServer(model, max_len=64, cache="dense",
+                         pool_bytes=1 << 20)
+
+
+def test_serving_benchmark_int8_smoke():
+    """tools/serving_benchmark.py --paged --kv-quant int8 --guard-recompiles
+    --json: one JSON line, int8 fields present, equal-budget pool shows the
+    capacity win, and the measured drain stays recompile-free."""
+    proc = subprocess.run(
+        [sys.executable, "tools/serving_benchmark.py", "--paged", "--json",
+         "--kv-quant", "int8", "--guard-recompiles",
+         "--requests", "5", "--slots", "2", "--max-new", "6",
+         "--tick-window", "2", "--block-size", "8", "--prefill-chunk", "16"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(pathlib.Path(__file__).resolve().parents[1]),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["kv_quant"] == "int8"
+    assert rec["value"] > 0
+    # equal-budget sizing: >= 1.8x the default fp block count (2 slots,
+    # max_len 256, block 8 -> 65 fp blocks)
+    fp_default = 2 * (256 // 8) + 1
+    assert rec["kv_blocks_total"] >= 1.8 * fp_default
+    assert rec["kv_bytes_per_token"] > 0
+    assert rec["kv_pool_bytes"] >= rec["kv_blocks_total"] * rec[
+        "kv_bytes_per_token"] * rec["kv_block_size"] * 0.9

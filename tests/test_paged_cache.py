@@ -133,3 +133,43 @@ def test_lru_reclaim_under_pressure():
     # releasing a LIVE unhashed block recirculates it immediately
     a.free(live[0])
     assert a.alloc() == live[0]
+
+
+def test_stats_bytes_and_hit_rate_counters():
+    """Observability counters added for the quantized pool: bytes_in_use
+    tracks live blocks at the configured bytes_per_block, and the
+    prefix-cache hit rate is hits / lookups over match_prefix calls."""
+    a = BlockAllocator(num_blocks=8, block_size=2, kv_quant="int8",
+                       bytes_per_block=100)
+    s = a.stats()
+    assert s["kv_quant"] == "int8" and s["bytes_per_block"] == 100
+    assert s["bytes_in_use"] == 0 and s["blocks_free"] == 7
+    assert s["prefix_hit_rate"] == 0.0          # no lookups yet: no 0/0
+
+    toks = [1, 2, 3, 4, 5]                       # 2 full blocks + tail
+    bids = [a.alloc(), a.alloc()]
+    assert a.stats()["bytes_in_use"] == 200
+    for bid, h in zip(bids, a.chain_hashes(toks)):
+        a.register(bid, h)
+    # miss: nothing cached yet under a different prefix
+    assert a.match_prefix([9, 9, 9, 9, 9]) == []
+    # hit: both full blocks match ((n-1)//bs caps at 2)
+    assert a.match_prefix(toks) == bids
+    s = a.stats()
+    assert s["prefix_lookup_blocks"] == 4        # 2 probed per call
+    assert s["prefix_hit_blocks"] == 2
+    assert s["prefix_hit_rate"] == 0.5
+    assert s["blocks_free"] == 5
+    assert s["bytes_in_use"] == 200              # re-refs, no new blocks
+
+
+def test_quant_mode_isolates_prefix_hashes():
+    """int8 and fp pools store different bits for the same tokens: the
+    quant mode seeds the hash chain, so their prefix blocks never alias."""
+    toks = list(range(8))
+    a_fp = BlockAllocator(num_blocks=4, block_size=4)
+    a_q = BlockAllocator(num_blocks=4, block_size=4, kv_quant="int8")
+    assert a_fp.chain_hashes(toks) != a_q.chain_hashes(toks)
+    # same mode still produces identical chains (the cache works at all)
+    b_q = BlockAllocator(num_blocks=4, block_size=4, kv_quant="int8")
+    assert a_q.chain_hashes(toks) == b_q.chain_hashes(toks)

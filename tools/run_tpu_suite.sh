@@ -60,6 +60,26 @@ python tools/serving_benchmark.py --json 2>/dev/null | tee /tmp/tpu_runs/serving
 python tools/serving_benchmark.py --paged --json 2>/dev/null | tee /tmp/tpu_runs/serving_paged.json
 python tools/serving_benchmark.py --paged --repeat-suffix --json 2>/dev/null | tee /tmp/tpu_runs/serving_paged_rs.json
 python tools/serving_benchmark.py --paged --spec 4 --repeat-suffix --json 2>/dev/null | tee /tmp/tpu_runs/serving_spec.json
+python tools/serving_benchmark.py --paged --kv-quant int8 --guard-recompiles --json 2>/dev/null | tee /tmp/tpu_runs/serving_paged_int8.json \
+  || { echo "int8 KV serving pass FAILED (recompile guard or crash)"; exit 1; }
+python - <<'PY'
+# int8 KV gate: equal byte budget must hold >=1.8x the blocks of the fp
+# pool (the bandwidth/capacity claim), and tok/s must not regress >20%
+# (drift margin; the two runs share a chip minutes apart)
+import json
+q = json.load(open("/tmp/tpu_runs/serving_paged_int8.json"))
+fp = json.load(open("/tmp/tpu_runs/serving_paged.json"))
+blocks_ratio = q["kv_blocks_total"] / fp["kv_blocks_total"]
+tok_ratio = q["value"] / fp["value"]
+print(f"int8/fp blocks at equal budget: {blocks_ratio:.2f}x, "
+      f"tok/s ratio: {tok_ratio:.2f} "
+      f"(kv_bytes_per_token {q['kv_bytes_per_token']} vs "
+      f"{fp['kv_bytes_per_token']})")
+assert blocks_ratio >= 1.8, "int8 pool capacity win below 1.8x"
+if tok_ratio < 0.8:
+    raise SystemExit("int8 KV serving slower than fp paged beyond drift "
+                     "margin — check the fused-dequant programs")
+PY
 python - <<'PY'
 # spec smoke gate: the speculative line must carry a sane acceptance_rate
 # and beat the paged repeat-suffix baseline (same workload, same chip)

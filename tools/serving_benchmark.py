@@ -76,6 +76,18 @@ def main():
                          "sizes for dense parity)")
     ap.add_argument("--prefill-chunk", type=int, default=64,
                     help="tokens per chunked-prefill program (paged only)")
+    ap.add_argument("--kv-quant", choices=("none", "int8"), default="none",
+                    help="KV pool storage (paged only): int8 stores blocks "
+                         "as int8 codes + per-block-per-head f32 scales "
+                         "with dequant fused into the attention programs. "
+                         "Without --num-blocks, the pool is sized to the "
+                         "SAME byte budget the fp pool would get, so the "
+                         "JSON's kv_blocks_total shows the capacity win "
+                         "directly (~2x bf16 / ~4x f32)")
+    ap.add_argument("--guard-recompiles", action="store_true",
+                    help="wrap the measured drain in jit_cache_guard: any "
+                         "steady-state recompile after warmup fails the "
+                         "run (exit 1)")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K drafts per verify "
                          "window (paged only). The ngram drafter runs "
@@ -101,6 +113,9 @@ def main():
         args.max_len = 768 if args.long_prompts else 256
         if args.repeat_suffix:
             args.max_len = max(args.max_len, 128 + args.max_new)
+    if args.kv_quant != "none" and not args.paged:
+        ap.error("--kv-quant requires --paged (the int8 pool is the "
+                 "block pool)")
     if args.spec:
         if not args.paged:
             ap.error("--spec requires --paged (the verify op is paged)")
@@ -160,6 +175,7 @@ def main():
 
     import contextlib
 
+    from paddle_tpu.analysis.recompile_guard import jit_cache_guard
     from paddle_tpu.utils.bench_timing import tpu_lock
 
     def make_server():
@@ -186,11 +202,25 @@ def main():
                     draft_model = LlamaForCausalLM(dcfg)
                 spec = SpecConfig(k=args.spec, drafter=args.spec_drafter,
                                   draft_model=draft_model)
+            pool_bytes = None
+            num_blocks = args.num_blocks
+            if args.kv_quant != "none" and num_blocks is None:
+                # equal-HBM comparison: hand the int8 server the byte
+                # budget the DEFAULT fp pool would occupy (dense parity:
+                # slots*ceil(max_len/bs)+1 blocks) and let it derive its
+                # block count — kv_blocks_total then reports the capacity
+                # win at constant memory instead of constant blocks
+                from paddle_tpu.inference.serving import kv_block_bytes
+
+                bs = args.block_size
+                fp_blocks = args.slots * (-(-args.max_len // bs)) + 1
+                pool_bytes = fp_blocks * kv_block_bytes(cfg, bs, "none")
             return GenerationServer(
                 model, max_batch=args.slots, max_len=args.max_len,
                 tick_window=args.tick_window, cache="paged",
-                block_size=args.block_size, num_blocks=args.num_blocks,
-                prefill_chunk=args.prefill_chunk, spec=spec)
+                block_size=args.block_size, num_blocks=num_blocks,
+                prefill_chunk=args.prefill_chunk, spec=spec,
+                kv_quant=args.kv_quant, pool_bytes=pool_bytes)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -210,17 +240,20 @@ def main():
         server.run()
 
         rids = burst(server, args.requests)
-        t0 = time.perf_counter()
-        done_at = {}
-        while True:
-            remaining = server.step()
-            now = time.perf_counter()
-            for rid in list(server._results):
-                if rid not in done_at:
-                    done_at[rid] = now - t0
-            if remaining == 0:
-                break
-        dt = time.perf_counter() - t0
+        guard = (jit_cache_guard("serving_benchmark measured drain")
+                 if args.guard_recompiles else contextlib.nullcontext())
+        with guard:
+            t0 = time.perf_counter()
+            done_at = {}
+            while True:
+                remaining = server.step()
+                now = time.perf_counter()
+                for rid in list(server._results):
+                    if rid not in done_at:
+                        done_at[rid] = now - t0
+                if remaining == 0:
+                    break
+            dt = time.perf_counter() - t0
         out = server._results
     gen_tokens = sum(len(v) - rids[r] for r, v in out.items() if r in rids)
     lats = sorted(done_at[r] for r in rids if r in done_at)
@@ -244,6 +277,13 @@ def main():
         line["kv_block_size"] = stats["block_size"]
         line["prefix_hit_blocks"] = stats["prefix_hit_blocks"]
         line["prefill_chunk"] = server.prefill_chunk
+        line["kv_quant"] = args.kv_quant
+        # bytes one cached token costs across all layers (K+V, incl.
+        # scale rows amortized over the block) — the bandwidth/capacity
+        # figure the int8 pool halves vs bf16 (quarters vs f32)
+        line["kv_bytes_per_token"] = round(
+            stats["bytes_per_block"] / stats["block_size"], 2)
+        line["kv_pool_bytes"] = stats["bytes_per_block"] * stats["num_blocks"]
     if args.spec:
         sm = server.spec_metrics()
         line["spec_k"] = args.spec
