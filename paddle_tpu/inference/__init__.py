@@ -252,7 +252,12 @@ def load_predictor(path: str) -> Predictor:
     return Predictor(fn, params, names, [])
 
 
+from .kv_offload import (HostKVPool, KVOffloadEngine,  # noqa: E402,F401
+                         SwapHandle)
 from .paged_cache import BlockAllocator  # noqa: E402,F401
+from .scheduler import (PRIORITY_HIGH, PRIORITY_LOW,  # noqa: E402,F401
+                        PRIORITY_NORMAL, AdmissionError, SchedEntry,
+                        Scheduler)
 from .serving import GenerationServer  # noqa: E402,F401
 from .speculative import (DraftModelDrafter, NgramDrafter,  # noqa: E402,F401
                           SpecConfig)

@@ -1,0 +1,212 @@
+"""Host KV offload — swap-preemption for the paged serving pool.
+
+The mechanism half of overload handling (policy lives in
+``inference/scheduler.py``): when the device block pool runs dry, the
+server preempts a victim request by copying its KV blocks — fp rows, or
+int8 codes + f32 scales, the engine is pool-format agnostic — into a
+host-memory pool, freeing the HBM blocks for more urgent work. On resume
+the blocks are restored and the request continues exactly where it
+stopped: greedy output is token-identical to an un-preempted run because
+the round trip is a bit-exact copy of whatever the pool held.
+
+Why swapping beats recompute here: a decoding request's KV past the
+prompt was produced by its own sampled continuation — re-prefilling
+``prompt + generated`` would rebuild it through a different program
+(chunked prefill vs decode steps) with different float rounding, beyond
+re-spending the FLOPs. Prefill-only work IS recomputable, which is why
+``GenerationServer`` aborts (not swaps) victims still in prefill.
+
+Compile discipline (the zero-steady-state-recompile guarantee must
+survive preemption):
+
+- The device↔host copies are EAGER ops, not new jitted programs, and
+  they run at ONE fixed shape: every gather/scatter covers the full
+  ``table_width`` rows of the slot's block table, padded with the
+  scratch block. A swap of 3 blocks and a swap of 30 compile the same
+  executables (once, at the first preemption); nothing is keyed on how
+  many blocks a victim happens to hold.
+- Scatter padding targets block 0 — the reserved scratch block that
+  absorbs masked writes everywhere else in the paged path — so the
+  fixed-width restore can never touch a live block.
+
+Prefix-cache integration: the victim's chain hashes ride along in the
+:class:`SwapHandle`. Swap-out releases the device blocks through the
+normal refcount path, so hashed prompt blocks land on the allocator's
+LRU — still resident, still shareable. Swap-in first re-matches those
+hashes (``BlockAllocator.match_hashes``): every hit is a block restored
+WITHOUT an upload (or a byte of HBM traffic), and every uploaded full
+prompt block is re-registered under its hash so restored requests keep
+participating in prefix sharing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SwapHandle", "HostKVPool", "KVOffloadEngine"]
+
+
+@dataclass
+class SwapHandle:
+    """Resume ticket for one preempted request: where it stopped, which
+    chain hashes its prompt blocks carry, and how much host memory the
+    parked copy occupies. The block CONTENTS live in the
+    :class:`HostKVPool` under ``rid``."""
+
+    rid: int
+    n_tokens: int            # KV-valid positions [0, n_tokens)
+    last_token: int          # next decode input (its KV is not written yet)
+    n_blocks: int            # live table entries parked on host
+    hashes: List[int] = field(default_factory=list)  # leading full-prompt-block chain hashes
+    nbytes: int = 0          # logical bytes charged to the host pool
+
+
+class HostKVPool:
+    """Byte-budgeted host store for swapped block stacks.
+
+    ``capacity_bytes=None`` means unbounded (the default server setting —
+    host DRAM dwarfs HBM); a bounded pool makes :meth:`put` refuse once
+    full, which the server treats as "this victim cannot be preempted".
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None):
+        if capacity_bytes is not None and capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes must be >= 0 or None, got {capacity_bytes}")
+        self.capacity_bytes = capacity_bytes
+        self._store: Dict[int, List[np.ndarray]] = {}
+        self.bytes_in_use = 0
+        self.bytes_peak = 0
+        self.puts = 0
+        self.takes = 0
+
+    def fits(self, nbytes: int) -> bool:
+        return (self.capacity_bytes is None
+                or self.bytes_in_use + nbytes <= self.capacity_bytes)
+
+    def put(self, rid: int, arrays: List[np.ndarray], nbytes: int) -> bool:
+        if rid in self._store:
+            raise KeyError(f"request {rid} already has a parked KV copy")
+        if not self.fits(nbytes):
+            return False
+        self._store[rid] = arrays
+        self.bytes_in_use += nbytes
+        self.bytes_peak = max(self.bytes_peak, self.bytes_in_use)
+        self.puts += 1
+        return True
+
+    def take(self, rid: int, nbytes: int) -> List[np.ndarray]:
+        arrays = self._store.pop(rid)
+        self.bytes_in_use -= nbytes
+        self.takes += 1
+        return arrays
+
+    def discard(self, rid: int, nbytes: int) -> None:
+        if self._store.pop(rid, None) is not None:
+            self.bytes_in_use -= nbytes
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
+class KVOffloadEngine:
+    """Swap-out / swap-in over a server's flat pool list.
+
+    Stateless between calls except for the host pool: the caller passes
+    the current (donation-rotated) ``pools`` list each time and takes the
+    updated list back from :meth:`swap_in`.
+    """
+
+    def __init__(self, alloc, table_width: int,
+                 capacity_bytes: Optional[int] = None):
+        self.alloc = alloc
+        self.table_width = int(table_width)
+        self.host = HostKVPool(capacity_bytes)
+
+    # ------------------------------------------------------------- swap out
+    def swap_out(self, rid: int, table: Sequence[int], hashes: Sequence[int],
+                 pools: List[Any], n_tokens: int,
+                 last_token: int) -> Optional[SwapHandle]:
+        """Park a request's KV on host and free its device blocks.
+
+        ``table`` must already be truncated to exactly the blocks covering
+        ``n_tokens`` (the server drops speculative reservations first).
+        Returns None — and changes nothing — when the host pool is full.
+        """
+        import jax.numpy as jnp
+
+        a = self.alloc
+        n = len(table)
+        nbytes = n * a.bytes_per_block
+        if not self.host.fits(nbytes):
+            return None
+        # fixed-width gather: pad the index vector with the scratch block
+        # so every swap runs the same-shaped copy (see module docstring)
+        idx = np.zeros((self.table_width,), np.int32)
+        idx[:n] = table
+        for bid in table:                 # freeze against LRU churn mid-copy
+            a.pin(bid)
+        try:
+            didx = jnp.asarray(idx)
+            # the d2h pull IS the point of offload — one sync per pool
+            # tensor, outside any trace
+            arrays = [np.asarray(p[didx]) for p in pools]  # graftlint: noqa[host-sync]
+        finally:
+            for bid in table:
+                a.unpin(bid)
+        if not self.host.put(rid, arrays, nbytes):
+            return None
+        for bid in table:
+            a.free(bid)                   # hashed blocks land on the LRU
+        a.note_swap_out(n, nbytes)
+        return SwapHandle(rid=rid, n_tokens=int(n_tokens),
+                          last_token=int(last_token), n_blocks=n,
+                          hashes=list(hashes), nbytes=nbytes)
+
+    # -------------------------------------------------------------- swap in
+    def restore_cost(self, handle: SwapHandle) -> int:
+        """Upper bound on fresh device blocks a resume needs (hash matches
+        can only lower it) — the server's admission headroom check."""
+        return handle.n_blocks
+
+    def swap_in(self, handle: SwapHandle,
+                pools: List[Any]) -> Optional[Tuple[List[int], List[Any]]]:
+        """Restore a parked request: re-match still-resident prefix blocks
+        by chain hash (free — no upload), allocate + upload the rest, and
+        re-register restored full prompt blocks for prefix sharing.
+
+        Returns ``(table, pools)`` with the updated pool list, or None —
+        changing nothing — if the device pool lacks headroom (the caller
+        keeps the entry queued and tries again later).
+        """
+        import jax.numpy as jnp
+
+        a = self.alloc
+        matched = a.match_hashes(handle.hashes)
+        need = handle.n_blocks - len(matched)
+        if a.blocks_free + a.evictable_cached < need:
+            for bid in matched:           # roll back: nothing restored
+                a.free(bid)
+            return None
+        fresh = [a.alloc() for _ in range(need)]
+        table = matched + fresh
+        arrays = self.host.take(handle.rid, handle.nbytes)
+        if fresh:
+            # fixed-width scatter: matched rows and padding target the
+            # scratch block (duplicate writes there are discarded noise)
+            idx = np.zeros((self.table_width,), np.int32)
+            idx[len(matched):handle.n_blocks] = fresh
+            didx = jnp.asarray(idx)
+            pools = [p.at[didx].set(jnp.asarray(arr).astype(p.dtype))
+                     for p, arr in zip(pools, arrays)]
+        for i in range(len(matched), min(len(handle.hashes), len(table))):
+            a.register(table[i], handle.hashes[i])
+        a.note_swap_in(handle.n_blocks, handle.nbytes)
+        return table, pools
+
+    def discard(self, handle: SwapHandle) -> None:
+        """Drop a parked copy without restoring it (cancelled request)."""
+        self.host.discard(handle.rid, handle.nbytes)
+        self.alloc.note_host_release(handle.nbytes)

@@ -28,7 +28,7 @@ Design (vLLM's block manager, trimmed to what the TPU server needs):
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Set
 
 SCRATCH_BLOCK = 0
 
@@ -56,12 +56,21 @@ class BlockAllocator:
         self._hash_of: Dict[int, int] = {}   # bid -> chain hash
         self._by_hash: Dict[int, int] = {}   # chain hash -> bid
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # cached, ref==0
+        # pinned blocks (live or cached) are frozen: never LRU-evicted —
+        # the swap engine pins a victim's blocks for the device→host copy
+        # so prefix reclaim can't recycle one mid-swap
+        self._pinned: Set[int] = set()
         # stats
         self.peak_in_use = 0
         self.fresh_allocs = 0
         self.prefix_hit_blocks = 0
         self.prefix_lookup_blocks = 0
         self.evictions = 0
+        # swap bookkeeping (inference/kv_offload.py drives these)
+        self.swap_out_blocks = 0
+        self.swap_in_blocks = 0
+        self.host_bytes_in_use = 0
+        self.host_bytes_peak = 0
 
     # ----------------------------------------------------------------- stats
     @property
@@ -76,6 +85,15 @@ class BlockAllocator:
     @property
     def blocks_free(self) -> int:
         return len(self._free)
+
+    @property
+    def pinned_blocks(self) -> int:
+        return len(self._pinned)
+
+    @property
+    def evictable_cached(self) -> int:
+        """Cached blocks eviction may actually reclaim (unpinned)."""
+        return sum(1 for bid in self._lru if bid not in self._pinned)
 
     def stats(self) -> Dict[str, int]:
         looked = self.prefix_lookup_blocks
@@ -93,7 +111,29 @@ class BlockAllocator:
                 "evictions": self.evictions,
                 "kv_quant": self.kv_quant,
                 "bytes_per_block": self.bytes_per_block,
-                "bytes_in_use": self.bytes_per_block * self.blocks_in_use}
+                "bytes_in_use": self.bytes_per_block * self.blocks_in_use,
+                "pinned_blocks": self.pinned_blocks,
+                "swap_out_blocks": self.swap_out_blocks,
+                "swap_in_blocks": self.swap_in_blocks,
+                "host_bytes_in_use": self.host_bytes_in_use,
+                "host_bytes_peak": self.host_bytes_peak}
+
+    # ------------------------------------------------------- swap bookkeeping
+    def note_swap_out(self, nblocks: int, nbytes: int) -> None:
+        """Record ``nblocks`` parked to host (``nbytes`` of host pool)."""
+        self.swap_out_blocks += nblocks
+        self.host_bytes_in_use += nbytes
+        self.host_bytes_peak = max(self.host_bytes_peak,
+                                   self.host_bytes_in_use)
+
+    def note_swap_in(self, nblocks: int, nbytes: int) -> None:
+        """Record ``nblocks`` restored from host (releasing ``nbytes``)."""
+        self.swap_in_blocks += nblocks
+        self.host_bytes_in_use -= nbytes
+
+    def note_host_release(self, nbytes: int) -> None:
+        """Record a parked copy discarded without restore (cancel)."""
+        self.host_bytes_in_use -= nbytes
 
     def _note_use(self):
         self.peak_in_use = max(self.peak_in_use, self.blocks_in_use)
@@ -102,18 +142,25 @@ class BlockAllocator:
     def alloc(self) -> int:
         """Hand out one private block (ref=1, no hash). Prefers the free
         list; falls back to evicting the coldest cached prefix block."""
+        bid = None
         if self._free:
             bid = self._free.pop()
-        elif self._lru:
-            bid, _ = self._lru.popitem(last=False)  # oldest
+        else:
+            # oldest UNPINNED cached block; pinned ones are mid-swap (or
+            # otherwise frozen) and must survive reclaim
+            for cand in self._lru:
+                if cand not in self._pinned:
+                    bid = cand
+                    break
+            if bid is None:
+                raise RuntimeError(
+                    f"paged KV pool exhausted: all {self.num_blocks - 1} "
+                    f"usable blocks are referenced by live requests or "
+                    f"pinned — raise num_blocks or lower max_batch/max_len")
+            del self._lru[bid]
             h = self._hash_of.pop(bid)
             self._by_hash.pop(h, None)
             self.evictions += 1
-        else:
-            raise RuntimeError(
-                f"paged KV pool exhausted: all {self.num_blocks - 1} blocks "
-                f"are referenced by live requests — raise num_blocks or "
-                f"lower max_batch/max_len")
         self._ref[bid] = 1
         self.fresh_allocs += 1
         self._note_use()
@@ -146,6 +193,21 @@ class BlockAllocator:
             self._lru.move_to_end(bid)
         else:
             self._free.append(bid)
+
+    # --------------------------------------------------------------- pinning
+    def pin(self, bid: int) -> None:
+        """Freeze a live or cached block against LRU eviction. Refcounts
+        are untouched — pinning is orthogonal to sharing, which is what
+        keeps swap, prefix reclaim, and speculative rollback from fighting
+        over the same counter. Idempotent."""
+        if bid not in self._ref and bid not in self._lru:
+            raise KeyError(f"block {bid} is neither live nor cached")
+        self._pinned.add(bid)
+
+    def unpin(self, bid: int) -> None:
+        """Release a pin (idempotent; unknown bids are a no-op so teardown
+        paths can unpin unconditionally)."""
+        self._pinned.discard(bid)
 
     def truncate(self, table: List[int], n_tokens: int) -> List[int]:
         """Refcount-safely release the tail of ``table`` so it covers only
@@ -185,6 +247,22 @@ class BlockAllocator:
             out.append(bid)
         self.prefix_lookup_blocks += len(hashes)
         self.prefix_hit_blocks += len(out)
+        return out
+
+    def match_hashes(self, hashes: Sequence[int]) -> List[int]:
+        """Longest still-resident prefix of an explicit chain-hash list,
+        re-ref'd for the caller — the swap-in fast path: every hit is a
+        block restored without an upload. Unlike :meth:`match_prefix`
+        this takes hashes (a :class:`~.kv_offload.SwapHandle` carries
+        them), not tokens, and doesn't touch the prefix-hit counters —
+        resume reuse and prefill-skip reuse are different economics."""
+        out: List[int] = []
+        for h in hashes:
+            bid = self._by_hash.get(h)
+            if bid is None:
+                break
+            self.ref(bid)
+            out.append(bid)
         return out
 
     def register(self, bid: int, chain_hash: int) -> None:

@@ -1,0 +1,229 @@
+"""Request scheduling for ``GenerationServer`` — priorities, deadlines,
+fair queuing, admission control, cancellation.
+
+The MPK split (PAPERS.md, arXiv:2512.22219) keeps the compiled decode /
+prefill / verify programs FIXED-SHAPE and pushes every scheduling dynamic
+to the host runtime. This module is that host runtime's policy half: it
+owns the waiting-request queue and decides, at each server step, which
+request is admitted to a slot next. The mechanism half — preempting a
+running request by swapping its KV blocks to host memory and restoring
+them later — lives in ``inference/kv_offload.py``; the two meet in
+``GenerationServer._step_paged``.
+
+Design constraints, in order:
+
+- **No device work.** Everything here is pure host Python over small
+  lists — a pop is O(queue depth) with tiny constants. Policy never
+  touches compiled-program shapes, so switching policies (or preempting
+  and resuming a request) triggers zero recompiles.
+- **Overload is a policy outcome, not a stall.** The pre-scheduler server
+  had one behavior under pressure: queued requests waited forever behind
+  whatever held the pool. With a scheduler, overload becomes: low
+  priority work is preempted (KV swapped to host), TTL'd queue entries
+  expire, and admission pushes back (``AdmissionError``) once the queue
+  passes ``max_queue`` — all measurable via counters.
+- **Cooperative cancellation.** The server is single-threaded; a cancel
+  takes effect at the next step boundary, where the request's blocks are
+  rolled back through the same refcount-safe ``truncate`` path the
+  speculative rollback uses.
+
+Three built-in policies (``GenerationServer(policy=...)``):
+
+- ``"fifo"`` (default): submission order. Exactly the pre-scheduler
+  behavior when nothing else (priority/TTL/cancel) is used.
+- ``"priority"``: strict priority classes (lower value = more urgent),
+  FIFO within a class; entries carrying a deadline order ahead of
+  no-deadline peers, earliest first (EDF within the class).
+- ``"wfq"``: weighted fair queuing ACROSS TENANTS within each priority
+  class. Classic virtual-time WFQ: tenant ``t`` with weight ``w_t``
+  charges each request ``cost / w_t`` of virtual time past the tenant's
+  previous finish tag, and pops are lowest-tag-first — a tenant's share
+  of admissions converges to ``w_t / sum(w)`` regardless of how fast it
+  submits, so one chatty tenant cannot starve the rest.
+
+Preempted requests re-enter the queue with their original ``seq`` and a
+``preempted`` flag that orders them ahead of waiting peers in the same
+class: they hold host-pool bytes (or lost prefill work), so draining them
+first bounds both swap residency and resume latency.
+
+TTLs bound QUEUE WAIT, not execution: an entry whose deadline passes
+while still waiting (never admitted) is dropped by ``expire()`` and the
+server reports it ``"expired"``. Once a request has run at all —
+including a preempted-and-requeued one — it is never expired, only
+cancelled explicitly.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["AdmissionError", "SchedEntry", "Scheduler",
+           "PRIORITY_HIGH", "PRIORITY_NORMAL", "PRIORITY_LOW"]
+
+# Priority classes: plain ints, lower = more urgent. Any int >= 0 works
+# (the three names are conventional anchors, not an enum cage).
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+_POLICIES = ("fifo", "priority", "wfq")
+
+
+class AdmissionError(RuntimeError):
+    """Backpressure: the queue is at ``max_queue`` — the caller should
+    shed load or retry later, not silently deepen the backlog."""
+
+
+@dataclass
+class SchedEntry:
+    """One waiting (or preempted) request as the scheduler sees it. The
+    payload ``req`` is opaque — the scheduler never reads token ids."""
+
+    req: Any
+    rid: int
+    priority: int = PRIORITY_NORMAL
+    tenant: str = "default"
+    deadline: Optional[float] = None    # absolute clock time; None = no TTL
+    seq: int = 0                        # admission order, stable across requeue
+    cost: float = 1.0                   # WFQ charge (est. total tokens)
+    vtag: float = 0.0                   # WFQ finish tag, set at submit
+    preempted: bool = False             # requeued after losing its slot
+    started: bool = False               # was admitted at least once
+    swap: Any = None                    # kv_offload.SwapHandle when swapped out
+
+
+class Scheduler:
+    """Policy-ordered waiting queue with admission control and TTLs.
+
+    ``clock`` is injectable (default ``time.monotonic``) so deadline
+    behavior is deterministic under test. ``weights`` maps tenant name to
+    WFQ weight (default 1.0; ignored by fifo/priority).
+    """
+
+    def __init__(self, policy: str = "fifo",
+                 max_queue: Optional[int] = None,
+                 default_ttl_s: Optional[float] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, "
+                             f"got {policy!r}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if default_ttl_s is not None and not default_ttl_s > 0:
+            raise ValueError(
+                f"default_ttl_s must be > 0, got {default_ttl_s}")
+        self.policy = policy
+        self.max_queue = max_queue
+        self.default_ttl_s = default_ttl_s
+        self.weights = dict(weights or {})
+        for t, w in self.weights.items():
+            if not w > 0:
+                raise ValueError(f"tenant {t!r} weight must be > 0, got {w}")
+        self._clock = clock
+        self._q: List[SchedEntry] = []
+        self._seq = 0
+        # WFQ virtual time: advances to each popped entry's finish tag;
+        # per-tenant last tag keeps a tenant's backlog serialized
+        self._vnow = 0.0
+        self._tenant_tag: Dict[str, float] = {}
+        # counters (read by GenerationServer.sched_metrics)
+        self.submitted = 0
+        self.expired = 0
+        self.cancelled = 0
+
+    # ------------------------------------------------------------------ intake
+    def submit(self, req: Any, rid: int, *, priority: int = PRIORITY_NORMAL,
+               tenant: str = "default", ttl_s: Optional[float] = None,
+               cost: float = 1.0) -> SchedEntry:
+        """Admit one request to the queue; raises :class:`AdmissionError`
+        when the queue is full (backpressure — shed, don't bury)."""
+        if isinstance(priority, bool) or not isinstance(priority, int) \
+                or priority < 0:
+            raise ValueError(f"priority must be an int >= 0 "
+                             f"(0 = most urgent), got {priority!r}")
+        if ttl_s is not None and not ttl_s > 0:
+            raise ValueError(f"ttl_s must be > 0, got {ttl_s!r}")
+        if self.max_queue is not None and len(self._q) >= self.max_queue:
+            raise AdmissionError(
+                f"queue full ({len(self._q)}/{self.max_queue} waiting) — "
+                f"backpressure: retry later or raise max_queue")
+        ttl = ttl_s if ttl_s is not None else self.default_ttl_s
+        now = self._clock()
+        w = self.weights.get(tenant, 1.0)
+        tag = max(self._vnow, self._tenant_tag.get(tenant, 0.0)) \
+            + float(cost) / w
+        self._tenant_tag[tenant] = tag
+        ent = SchedEntry(req=req, rid=rid, priority=priority, tenant=tenant,
+                         deadline=(now + ttl) if ttl is not None else None,
+                         seq=self._seq, cost=float(cost), vtag=tag)
+        self._seq += 1
+        self._q.append(ent)
+        self.submitted += 1
+        return ent
+
+    def requeue(self, ent: SchedEntry) -> None:
+        """Return a preempted entry to the queue. Never subject to
+        admission control (it was already admitted once); its original
+        ``seq``/``vtag`` plus the ``preempted`` flag order it ahead of
+        waiting peers in its class."""
+        ent.preempted = True
+        ent.started = True
+        self._q.append(ent)
+
+    # ------------------------------------------------------------------ order
+    def _key(self, ent: SchedEntry):
+        head = (ent.priority, 0 if ent.preempted else 1)
+        if self.policy == "fifo":
+            return (0 if ent.preempted else 1, ent.seq)
+        if self.policy == "priority":
+            dl = ent.deadline if ent.deadline is not None else float("inf")
+            return head + (dl, ent.seq)
+        return head + (ent.vtag, ent.seq)            # wfq
+
+    def peek(self) -> Optional[SchedEntry]:
+        if not self._q:
+            return None
+        return min(self._q, key=self._key)
+
+    def pop(self) -> Optional[SchedEntry]:
+        ent = self.peek()
+        if ent is None:
+            return None
+        self._q.remove(ent)
+        if self.policy == "wfq":
+            self._vnow = max(self._vnow, ent.vtag)
+        return ent
+
+    # --------------------------------------------------------------- removal
+    def cancel(self, rid: int) -> Optional[SchedEntry]:
+        """Remove a waiting entry by rid; returns it (or None if the rid
+        is not queued — it may be running, finished, or unknown)."""
+        for ent in self._q:
+            if ent.rid == rid:
+                self._q.remove(ent)
+                self.cancelled += 1
+                return ent
+        return None
+
+    def expire(self) -> List[SchedEntry]:
+        """Drop and return every never-started entry whose deadline has
+        passed. Preempted entries are exempt: their work (host-side KV,
+        or a partial prefill) is already paid for — kill those with
+        :meth:`cancel`, not a timer."""
+        now = self._clock()
+        out = [e for e in self._q
+               if e.deadline is not None and e.deadline <= now
+               and not e.started]
+        for e in out:
+            self._q.remove(e)
+        self.expired += len(out)
+        return out
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def waiting(self) -> List[SchedEntry]:
+        """Current queue in pop order (for introspection/tests)."""
+        return sorted(self._q, key=self._key)

@@ -32,9 +32,9 @@ slot attends at its own depth. Sampling routes through
 """
 from __future__ import annotations
 
-from collections import deque
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,7 @@ import numpy as np
 
 from ..framework.core import Tensor
 from ..jit import functional_call, state_values
+from .scheduler import PRIORITY_NORMAL, SchedEntry, Scheduler
 
 
 def kv_block_bytes(cfg, block_size: int, kv_quant: str = "none") -> int:
@@ -74,6 +75,7 @@ class _Request:
     generated: List[int] = field(default_factory=list)
     done: bool = False
     draft_k: Optional[int] = None                    # per-request spec budget
+    sched: Any = None                                # its scheduler.SchedEntry
     # paged-path state
     table: List[int] = field(default_factory=list)   # block ids, in order
     hashes: List[int] = field(default_factory=list)  # chain hash per full blk
@@ -100,7 +102,9 @@ class GenerationServer:
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  prefill_chunk: int = 32, spec=None,
                  kv_quant: str = "none",
-                 pool_bytes: Optional[int] = None):
+                 pool_bytes: Optional[int] = None,
+                 policy=None,
+                 host_pool_bytes: Optional[int] = None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -134,7 +138,17 @@ class GenerationServer:
         ``pool_bytes``: size the pool by HBM byte budget instead of block
         count — ``num_blocks = pool_bytes // kv_block_bytes(...)``. The
         int8 pool reports ~2× (bf16) / ~4× (f32) the blocks for the same
-        budget. Mutually exclusive with ``num_blocks``."""
+        budget. Mutually exclusive with ``num_blocks``.
+
+        ``policy``: request-scheduling hook — None (FIFO, the
+        pre-scheduler behavior), a policy name (``"fifo"`` / ``"priority"``
+        / ``"wfq"``), or a configured :class:`~.scheduler.Scheduler`
+        (for ``max_queue``/TTL/tenant weights). See inference/scheduler.py.
+
+        ``host_pool_bytes`` (paged only): byte cap for the host KV pool
+        that swap-preemption parks victim blocks in. None = unbounded
+        (host DRAM dwarfs HBM); 0 disables swapping entirely — under
+        pressure victims then stall instead of parking."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -151,6 +165,9 @@ class GenerationServer:
             if num_blocks is not None:
                 raise ValueError(
                     "pass either num_blocks= or pool_bytes=, not both")
+        if host_pool_bytes is not None and cache != "paged":
+            raise ValueError("host_pool_bytes= requires cache='paged' "
+                             "(only the block pool can swap to host)")
         self.kv_quant = kv_quant
         self.spec = None
         if spec is not None:
@@ -186,8 +203,29 @@ class GenerationServer:
         self._step_no = 0
         self._base_key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Request]] = [None] * max_batch
-        self._queue: deque = deque()
+        if policy is None:
+            self._sched = Scheduler()
+        elif isinstance(policy, Scheduler):
+            self._sched = policy
+        elif isinstance(policy, str):
+            self._sched = Scheduler(policy=policy)
+        else:
+            raise ValueError(
+                f"policy must be None, a policy name ('fifo'/'priority'/"
+                f"'wfq'), or a Scheduler instance, got {policy!r}")
         self._results: Dict[int, List[int]] = {}
+        self._dropped: Dict[int, str] = {}   # rid -> "cancelled" | "expired"
+        # per-rid wall-clock marks (submit/first-token/done) — the
+        # benchmark derives TTFT and per-token latency from these
+        self._req_metrics: Dict[int, Dict[str, float]] = {}
+        self._wall = time.monotonic
+        # preemption / overload counters (read via sched_metrics)
+        self._preemptions = 0
+        self._prefill_aborts = 0
+        self._resumes = 0
+        self._stalls = 0
+        self._stall_streak = 0
+        self._idle_streak = 0
         self._next_rid = 0
 
         if cache == "dense":
@@ -259,6 +297,10 @@ class GenerationServer:
             # tensors per layer entry in the flat pool list: fp (K, V) = 2;
             # int8 (Kq, Kscale, Vq, Vscale) = 4
             self._pool_stride = 4 if kv_quant == "int8" else 2
+            from .kv_offload import KVOffloadEngine
+
+            self._offload = KVOffloadEngine(self.alloc, self._table_width,
+                                            capacity_bytes=host_pool_bytes)
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
             # device-side mirror of (temps, topks, topps[, kcaps]): these
             # change only when a slot activates/releases, but were being
@@ -578,7 +620,14 @@ class GenerationServer:
     # --------------------------------------------------------------- requests
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0, draft_k: Optional[int] = None) -> int:
+               top_p: float = 0.0, draft_k: Optional[int] = None,
+               priority: int = PRIORITY_NORMAL, tenant: str = "default",
+               ttl_s: Optional[float] = None) -> int:
+        """Queue one request; returns its rid. ``priority`` (lower = more
+        urgent), ``tenant`` (WFQ fairness bucket), and ``ttl_s`` (max
+        queue wait before the request expires unstarted) feed the
+        scheduler; raises :class:`~.scheduler.AdmissionError` when a
+        bounded queue is full (backpressure)."""
         prompt = list(prompt)
         if not prompt:
             raise ValueError("prompt must contain at least one token id")
@@ -618,14 +667,42 @@ class GenerationServer:
                     f"draft_k ({draft_k}) exceeds spec.k ({self.spec_k}) — "
                     f"the compiled verify-window width; raise SpecConfig.k")
             draft_k = int(draft_k)
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError(
+                f"tenant must be a non-empty string, got {tenant!r}")
         if self.cache_mode == "dense":
             self._bucket_for(len(prompt))  # validate against buckets up front
+        else:
+            # feasibility gate: a request whose worst-case block need —
+            # final position plus the transient decode-window (or
+            # speculative-window) reservation — exceeds the pool could
+            # never finish; admitting it would wedge the scheduler behind
+            # an unsatisfiable reservation, so reject it at the door
+            if self.spec is not None:
+                wmax = max(self.tick_window, int(self.spec.turbo_windows))
+                trans = max(wmax * (self.spec_k + 1),
+                            int(self.spec.gate_ticks))
+            else:
+                trans = self.tick_window
+            worst = len(prompt) + max_new_tokens - 1 + trans
+            need = min(self._max_entries, -(-worst // self.block_size))
+            if need > self.alloc.num_blocks - 1:
+                raise ValueError(
+                    f"request needs up to {need} KV blocks but the pool "
+                    f"has {self.alloc.num_blocks - 1} usable — it could "
+                    f"never be scheduled; raise num_blocks/pool_bytes or "
+                    f"shorten the request")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, int(max_new_tokens),
-                                    temperature=float(temperature),
-                                    top_k=int(top_k), top_p=float(top_p),
-                                    draft_k=draft_k))
+        req = _Request(rid, prompt, int(max_new_tokens),
+                       temperature=float(temperature),
+                       top_k=int(top_k), top_p=float(top_p),
+                       draft_k=draft_k)
+        # cost = estimated total tokens: the WFQ charge a tenant pays
+        req.sched = self._sched.submit(
+            req, rid, priority=priority, tenant=tenant, ttl_s=ttl_s,
+            cost=float(len(prompt) + max_new_tokens))
+        self._req_metrics[rid] = {"submit_t": self._wall()}
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -662,6 +739,9 @@ class GenerationServer:
         if self.cache_mode == "paged":
             self._samp_dev = None
         req.generated.append(first)
+        m = self._req_metrics.get(req.rid)
+        if m is not None:
+            m.setdefault("first_token_t", self._wall())
 
     def _samp_arrays(self):
         """Device copies of the per-slot sampling params (+ draft caps),
@@ -689,13 +769,59 @@ class GenerationServer:
         self._slots[slot] = req
 
     def _fill_free_slots(self) -> None:
+        """Admit waiting requests into free slots in scheduler-policy
+        order. Paged admission is gated on block headroom, with NO
+        head-of-line bypass: skipping an inadmissible head for a smaller,
+        later entry could starve the head forever — and strict order is
+        safe because a draining pool always reopens headroom."""
         for s in range(self.max_batch):
-            if self._slots[s] is None and self._queue:
-                req = self._queue.popleft()
-                if self.cache_mode == "paged":
-                    self._admit_paged(s, req)
-                else:
-                    self._assign(s, req)
+            if self._slots[s] is not None:
+                continue
+            ent = self._sched.peek()
+            if ent is None:
+                break
+            if self.cache_mode == "paged" and not self._admissible(ent):
+                break
+            self._sched.pop()
+            ent.started = True
+            if ent.swap is not None:
+                if not self._resume_swapped(s, ent):
+                    # headroom moved between the check and the restore
+                    # (hash matches changed) — requeue, retry next step
+                    self._sched.requeue(ent)
+                    break
+            elif self.cache_mode == "paged":
+                self._admit_paged(s, ent.req)
+            else:
+                self._assign(s, ent.req)
+
+    def _service_queue(self) -> None:
+        """Queue maintenance at the top of every step: expire TTL'd
+        waiters, fill free slots in policy order, then — paged only — if
+        a strictly-more-urgent entry is stuck behind a full batch,
+        preempt the least-urgent running request for it (one victim per
+        step bounds preemption churn)."""
+        for ent in self._sched.expire():
+            self._drop_entry(ent, "expired")
+        self._fill_free_slots()
+        if self.cache_mode != "paged":
+            return
+        ent = self._sched.peek()
+        if ent is not None and all(sl is not None for sl in self._slots):
+            v = self._pick_victim(ent.priority)
+            if v is not None and self._preempt_slot(v):
+                self._fill_free_slots()
+
+    def _drop_entry(self, ent: SchedEntry, reason: str) -> None:
+        """A queued entry leaves without finishing: record why, stamp its
+        metrics closed, release any parked host KV."""
+        self._dropped[ent.rid] = reason
+        m = self._req_metrics.get(ent.rid)
+        if m is not None:
+            m["done_t"] = self._wall()
+        if ent.swap is not None:
+            self._offload.discard(ent.swap)
+            ent.swap = None
 
     # ---------------------------------------------------------- paged path
     def _admit_paged(self, slot: int, req: _Request) -> None:
@@ -721,6 +847,166 @@ class GenerationServer:
             req.table.append(bid)
             self._bt[slot, len(req.table) - 1] = bid
 
+    # ------------------------------------------------- preemption / offload
+    def _admissible(self, ent: SchedEntry) -> bool:
+        """Block-headroom gate for paged admission: the entry's first
+        allocation burst (whole prompt for a fresh request — conservative,
+        so a long prompt can't thrash in and straight back out mid-
+        prefill; parked block count for a swapped one) PLUS one spare
+        block must be reclaimable right now."""
+        if ent.swap is not None:
+            need = self._offload.restore_cost(ent.swap)
+        else:
+            need = min(self._max_entries,
+                       -(-len(ent.req.prompt) // self.block_size))
+        usable = self.alloc.num_blocks - 1
+        headroom = min(need + 1, usable)
+        return (self.alloc.blocks_free
+                + self.alloc.evictable_cached) >= headroom
+
+    def _resume_swapped(self, slot: int, ent: SchedEntry) -> bool:
+        """Restore a swapped-out request into ``slot`` exactly where it
+        stopped: KV blocks back from host (prefix-hash hits skip the
+        upload), position/next-token/sampling scalars from the request.
+        Greedy continuation is token-identical to the un-preempted run —
+        the round trip is bit-exact and the decode program sees the same
+        state it would have seen. Returns False (entry untouched) if
+        device headroom vanished."""
+        req = ent.req
+        res = self._offload.swap_in(ent.swap, self._pools)
+        if res is None:
+            return False
+        handle, ent.swap = ent.swap, None
+        req.table, self._pools = res
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(req.table)] = req.table
+        self._prefilling[slot] = None
+        self._slots[slot] = req
+        self.pos[slot] = handle.n_tokens
+        self.tokens[slot] = handle.last_token
+        self.temps[slot] = req.temperature
+        self.topks[slot] = req.top_k
+        self.topps[slot] = req.top_p
+        if self.spec is not None:
+            self.kcaps[slot] = (self.spec_k if req.draft_k is None
+                                else req.draft_k)
+        self._samp_dev = None
+        self._resumes += 1
+        return True
+
+    def _pick_victim(self, than_priority: int,
+                     exclude=()) -> Optional[int]:
+        """Least-urgent occupied slot STRICTLY less urgent than
+        ``than_priority`` — equal-priority peers never preempt each other
+        (that way lies ping-pong). Prefers prefilling victims (aborting
+        them loses recomputable work only) and then the largest block
+        holder (frees the most pool per preemption)."""
+        best, best_key = None, None
+        for s in range(self.max_batch):
+            if s in exclude:
+                continue
+            req = self._slots[s]
+            if req is None:
+                continue
+            pr = req.sched.priority
+            if pr <= than_priority:
+                continue
+            key = (pr, 1 if self._prefilling[s] else 0, len(req.table))
+            if best_key is None or key > best_key:
+                best, best_key = s, key
+        return best
+
+    def _preempt_slot(self, s: int) -> bool:
+        """Evict the request in slot ``s`` and requeue it. A slot still
+        prefilling is ABORTED — its KV is recomputable, nothing is
+        generated yet, and registered prompt blocks stay on the LRU so
+        the re-run's prefix match skips them anyway. A decoding slot
+        SWAPS: its table (truncated of speculative reservations) parks in
+        host memory via the offload engine for a bit-exact resume.
+        Returns False — slot untouched — when the host pool is full."""
+        req = self._slots[s]
+        ent = req.sched
+        if self._prefilling[s]:
+            for bid in req.table:
+                self.alloc.free(bid)
+            req.table = []
+            req.pf_next = 0
+            self._prefill_aborts += 1
+        else:
+            n = int(self.pos[s])
+            req.table = self.alloc.truncate(req.table, n)
+            handle = self._offload.swap_out(
+                req.rid, req.table,
+                req.hashes[:min(len(req.hashes), len(req.table))],
+                self._pools, n_tokens=n, last_token=int(self.tokens[s]))
+            if handle is None:
+                return False
+            req.table = []
+            ent.swap = handle
+            self._preemptions += 1
+        self._slots[s] = None
+        self._bt[s, :] = 0
+        self._prefilling[s] = None
+        self.pos[s] = 0
+        self.tokens[s] = 0
+        self.temps[s] = 0.0
+        self.topks[s] = 0
+        self.topps[s] = 0.0
+        if self.spec is not None:
+            self.kcaps[s] = 0
+        self._samp_dev = None
+        self._sched.requeue(ent)
+        return True
+
+    def _reserve_or_preempt(self, s: int, entries: int) -> str:
+        """Grow slot ``s``'s table to ``entries``, preempting less-urgent
+        slots when the pool is dry. Returns ``"ok"`` (reserved),
+        ``"gone"`` (``s`` itself yielded — no victim outranked it, so it
+        released its own blocks and requeued; the rest of the batch
+        drains and it resumes when pressure clears), or ``"stall"``
+        (nothing preemptable and the host pool refused the swap — ``s``
+        keeps its state and simply sits out this trip)."""
+        tried = {s}
+        while True:
+            try:
+                self._ensure_blocks(s, entries)
+                return "ok"
+            except RuntimeError:
+                v = self._pick_victim(self._slots[s].sched.priority,
+                                      exclude=tried)
+                if v is not None:
+                    tried.add(v)
+                    self._preempt_slot(v)
+                    continue
+                if self._preempt_slot(s):
+                    return "gone"
+                self._stalls += 1
+                return "stall"
+
+    def _reserve_active(self, active, need_fn) -> List[int]:
+        """Reserve each decoding slot's blocks for the coming trip, most
+        urgent first — under pool pressure this is where swap-preemption
+        fires. Returns the surviving slot list (victims dropped out of
+        ``active``; stalled slots skip the trip but keep their state)."""
+        out = []
+        for s in sorted(active, key=lambda i: (self._slots[i].sched.priority,
+                                               i)):
+            if self._slots[s] is None:
+                continue        # preempted as a victim earlier in the loop
+            if self._reserve_or_preempt(s, need_fn(s)) == "ok":
+                out.append(s)
+        out.sort()
+        if not out and active:
+            self._stall_streak += 1
+            if self._stall_streak > 256:
+                raise RuntimeError(
+                    "paged pool wedged: 256 consecutive trips made no "
+                    "progress (every slot stalled on block reservation) — "
+                    "raise num_blocks/pool_bytes or host_pool_bytes")
+        else:
+            self._stall_streak = 0
+        return out
+
     def _prefill_chunk_step(self, slot: int) -> None:
         """Advance one prompt chunk for a prefilling slot; on the final
         chunk, sample the first token and flip the slot to decoding."""
@@ -730,7 +1016,8 @@ class GenerationServer:
         C = self.prefill_chunk
         start = req.pf_next
         end = min(start + C, n)
-        self._ensure_blocks(slot, -(-end // bs))
+        if self._reserve_or_preempt(slot, -(-end // bs)) != "ok":
+            return      # aborted as its own victim, or stalled — no chunk
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :end - start] = req.prompt[start:end]
         last_idx = (n - 1 - start) if end == n else 0
@@ -754,7 +1041,7 @@ class GenerationServer:
         return all(float(self.temps[s]) == 0.0 for s in rows)
 
     def _step_paged(self) -> int:
-        self._fill_free_slots()
+        self._service_queue()
         # chunked prefill interleaves with decode: ONE chunk per prefilling
         # slot per step, so a long prompt never blocks slots mid-decode
         # (no head-of-line blocking) and short requests keep streaming out
@@ -765,10 +1052,6 @@ class GenerationServer:
                   if self._slots[s] is not None and not self._prefilling[s]]
         if active:
             self._step_no += 1
-            # the greedy-specialized programs never read the key — skip
-            # the per-step eager fold_in dispatch (~0.4ms) for it
-            key = (self._base_key if self._all_greedy(active)
-                   else jax.random.fold_in(self._base_key, self._step_no))
             if self.spec is not None:
                 # dynamic speculation gate: while recent acceptance is
                 # below spec.gate_low, drafts are a net loss (a verify
@@ -779,23 +1062,38 @@ class GenerationServer:
                 if self._spec_gate_off > 0:
                     self._spec_gate_off -= 1
                     self._spec_plain_windows += self.spec.gate_ticks
-                    self._plain_decode_trip(active, key,
-                                            self.spec.gate_ticks)
+                    self._plain_decode_trip(active, self.spec.gate_ticks)
                 else:
-                    self._spec_tick(active, key)
-                return (sum(sl is not None for sl in self._slots)
-                        + len(self._queue))
-            self._plain_decode_trip(active, key)
-        return sum(sl is not None for sl in self._slots) + len(self._queue)
+                    self._spec_tick(active)
+            else:
+                self._plain_decode_trip(active)
+        occupied = sum(sl is not None for sl in self._slots)
+        if occupied == 0 and len(self._sched) > 0:
+            # every slot empty yet entries wait: admission must succeed
+            # against an idle pool, so a persistent streak means state
+            # corruption (e.g. leaked pins) — fail loudly, don't spin
+            self._idle_streak += 1
+            if self._idle_streak > 64:
+                raise RuntimeError(
+                    "scheduler wedged: 64 steps with empty slots and a "
+                    "non-empty queue — allocator headroom never recovered")
+        else:
+            self._idle_streak = 0
+        return occupied + len(self._sched)
 
-    def _plain_decode_trip(self, active, key, ticks=None) -> None:
+    def _plain_decode_trip(self, active, ticks=None) -> None:
         """One plain (non-speculative) decode trip: ``ticks`` (default
         ``tick_window``) ticks in one compiled program across the listed
         slots."""
         k = self.tick_window if ticks is None else ticks
-        for s in active:
-            self._ensure_blocks(s, -(-(int(self.pos[s]) + k) //
-                                     self.block_size))
+        active = self._reserve_active(
+            active, lambda s: -(-(int(self.pos[s]) + k) // self.block_size))
+        if not active:
+            return
+        # the greedy-specialized programs never read the key — skip the
+        # per-step eager fold_in dispatch (~0.4ms) for it
+        key = (self._base_key if self._all_greedy(active)
+               else jax.random.fold_in(self._base_key, self._step_no))
         active_mask = np.zeros((self.max_batch,), np.int32)
         active_mask[active] = 1
         # idle/prefilling rows run masked: zeroed table + pos 0 routes
@@ -810,7 +1108,7 @@ class GenerationServer:
         self._harvest_window(np.asarray(stack), active, active_mask)
 
     # ----------------------------------------------------------- speculative
-    def _spec_tick(self, active, key) -> None:
+    def _spec_tick(self, active) -> None:
         """One speculative server tick: draft k tokens per decoding slot,
         verify all k+1 window positions in one fused program, accept/reject
         exactly — emitting 1..k+1 tokens per slot per window with the same
@@ -823,9 +1121,13 @@ class GenerationServer:
             S = self.spec.turbo_windows
         # reserve blocks for every window of the trip up front (speculative
         # append); rejected-draft tail entries are truncated back in harvest
-        for s in active:
-            self._ensure_blocks(s, -(-(int(self.pos[s]) + S * (k + 1)) //
-                                     self.block_size))
+        active = self._reserve_active(
+            active, lambda s: -(-(int(self.pos[s]) + S * (k + 1)) //
+                                self.block_size))
+        if not active:
+            return
+        key = (self._base_key if self._all_greedy(active)
+               else jax.random.fold_in(self._base_key, self._step_no))
         active_mask = np.zeros((self.max_batch,), np.int32)
         active_mask[active] = 1
         bt = np.where(active_mask[:, None] > 0, self._bt, 0)
@@ -919,8 +1221,7 @@ class GenerationServer:
                     if done:
                         break
                 if done:
-                    self._results[req.rid] = req.prompt + gen[
-                        :req.max_new_tokens]
+                    self._emit_result(req)
                     self._release_slot(s)
                 else:
                     self.pos[s] = new_pos
@@ -949,8 +1250,7 @@ class GenerationServer:
                 new_pos += a + 1
                 last_tok = int(outs[w, s, a])
             if done:
-                self._results[req.rid] = req.prompt + req.generated[
-                    :req.max_new_tokens]
+                self._emit_result(req)
                 self._release_slot(s)
             else:
                 self.pos[s] = new_pos
@@ -969,6 +1269,89 @@ class GenerationServer:
                 "acceptance_rate":
                     (self._spec_accepted / prop) if prop else 0.0,
                 "gated_plain_windows": self._spec_plain_windows}
+
+    def _emit_result(self, req: _Request) -> None:
+        """A request finished: publish its tokens, close its metrics."""
+        self._results[req.rid] = req.prompt + req.generated[
+            :req.max_new_tokens]
+        m = self._req_metrics.get(req.rid)
+        if m is not None:
+            m["done_t"] = self._wall()
+            m["n_generated"] = min(len(req.generated), req.max_new_tokens)
+
+    # ---------------------------------------------------- request lifecycle
+    def cancel(self, rid: int) -> bool:
+        """Cooperative cancel, effective immediately at the host level: a
+        waiting (or swapped-out) request leaves the queue and any parked
+        host KV is discarded; a running request's blocks — including the
+        speculative-window tail reservation — roll back through the same
+        refcount-safe ``BlockAllocator.truncate`` path that speculative
+        rejection uses, returning the allocator to its pre-submit
+        occupancy. Returns False for unknown or already-finished rids;
+        cancelled requests never appear in results (``status(rid)`` says
+        ``"cancelled"``)."""
+        ent = self._sched.cancel(rid)
+        if ent is not None:
+            self._drop_entry(ent, "cancelled")
+            return True
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is not None and req.rid == rid:
+                if self.cache_mode == "paged":
+                    req.table = self.alloc.truncate(req.table, 0)
+                self._dropped[rid] = "cancelled"
+                m = self._req_metrics.get(rid)
+                if m is not None:
+                    m["done_t"] = self._wall()
+                self._release_slot(s)
+                return True
+        return False
+
+    def status(self, rid: int) -> str:
+        """One of ``done / cancelled / expired / running / prefilling /
+        swapped / preempted / queued / unknown``."""
+        if rid in self._results:
+            return "done"
+        if rid in self._dropped:
+            return self._dropped[rid]
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is not None and req.rid == rid:
+                return "prefilling" if (self.cache_mode == "paged"
+                                        and self._prefilling[s]) \
+                    else "running"
+        for ent in self._sched.waiting():
+            if ent.rid == rid:
+                if ent.swap is not None:
+                    return "swapped"
+                return "preempted" if ent.preempted else "queued"
+        return "unknown"
+
+    def sched_metrics(self) -> Dict[str, Any]:
+        """Scheduler + preemption counters (all cache modes; swap fields
+        appear on the paged path only)."""
+        m = {"policy": self._sched.policy,
+             "queue_depth": len(self._sched),
+             "submitted": self._sched.submitted,
+             "expired": self._sched.expired,
+             "cancelled": sum(1 for v in self._dropped.values()
+                              if v == "cancelled"),
+             "preemptions": self._preemptions,
+             "prefill_aborts": self._prefill_aborts,
+             "resumes": self._resumes,
+             "stalled_reservations": self._stalls}
+        if self.cache_mode == "paged":
+            m["host_bytes_in_use"] = self._offload.host.bytes_in_use
+            m["host_bytes_peak"] = self._offload.host.bytes_peak
+            m["swapped_waiting"] = sum(
+                1 for e in self._sched.waiting() if e.swap is not None)
+        return m
+
+    def request_metrics(self) -> Dict[int, Dict[str, float]]:
+        """Per-rid wall-clock marks — ``submit_t``, ``first_token_t``,
+        ``done_t``, ``n_generated`` — from which TTFT and per-token
+        latency are derived (tools/serving_benchmark.py)."""
+        return self._req_metrics
 
     def _release_slot(self, slot: int) -> None:
         req = self._slots[slot]
@@ -1021,8 +1404,7 @@ class GenerationServer:
                 # nxt_host is host numpy — the window's one sync is done
                 gen.extend(nxt_host[:take, s].tolist())  # graftlint: noqa[host-sync]
                 if done:
-                    self._results[req.rid] = req.prompt + gen[
-                        :req.max_new_tokens]
+                    self._emit_result(req)
                     self._release_slot(s)
                 continue
             for t in range(k):
@@ -1038,8 +1420,7 @@ class GenerationServer:
                     done = True
                     break
             if done:
-                self._results[req.rid] = req.prompt + req.generated[
-                    :req.max_new_tokens]
+                self._emit_result(req)
                 self._release_slot(s)
 
     def step(self) -> int:
@@ -1049,7 +1430,7 @@ class GenerationServer:
         (occupied slots + queued)."""
         if self.cache_mode == "paged":
             return self._step_paged()
-        self._fill_free_slots()
+        self._service_queue()
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None]
         if not active:
@@ -1066,7 +1447,7 @@ class GenerationServer:
             jnp.asarray(self.topks), jnp.asarray(self.topps),
             jnp.asarray(active_mask), key)
         self._harvest_window(np.asarray(stack), active, active_mask)
-        return sum(sl is not None for sl in self._slots) + len(self._queue)
+        return sum(sl is not None for sl in self._slots) + len(self._sched)
 
     def run(self) -> Dict[int, List[int]]:
         """Drain the queue; returns {rid: prompt+generated token ids}."""

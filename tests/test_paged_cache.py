@@ -173,3 +173,68 @@ def test_quant_mode_isolates_prefix_hashes():
     # same mode still produces identical chains (the cache works at all)
     b_q = BlockAllocator(num_blocks=4, block_size=4, kv_quant="int8")
     assert a_q.chain_hashes(toks) == b_q.chain_hashes(toks)
+
+
+def test_pin_blocks_eviction_and_swap_counters():
+    """Swap-preemption additions (inference/kv_offload.py drives these):
+    pinned blocks are frozen against LRU reclaim but keep normal
+    refcounts; note_swap_out/in maintain the swap + host-byte counters
+    surfaced by stats()."""
+    a = BlockAllocator(num_blocks=4, block_size=2, bytes_per_block=64)
+    b1, b2, b3 = a.alloc(), a.alloc(), a.alloc()
+    a.register(b1, 11)
+    a.register(b2, 22)
+    a.free(b1)
+    a.free(b2)                                # cached, age order b1 b2
+    a.pin(b1)                                 # freeze the LRU head
+    assert a.pinned_blocks == 1
+    assert a.evictable_cached == 1            # only b2 reclaimable
+    a.free(b3)                                # unhashed -> free list
+    assert a.alloc() == b3                    # free list first, no evict
+    assert a.alloc() == b2                    # pinned b1 is SKIPPED
+    assert a.evictions == 1
+    a.unpin(b1)
+    assert a.evictable_cached == 1
+    assert a.alloc() == b1                    # unpinned: reclaimable again
+    # pinning a live block works too; exhaustion message mentions pins
+    a.pin(b1)
+    with pytest.raises(RuntimeError, match="pinned"):
+        a.alloc()
+    a.unpin(b1)
+    a.unpin(12345)                            # unknown bid: no-op
+    with pytest.raises(KeyError):
+        a.pin(12345)                          # neither live nor cached
+
+    s = a.stats()
+    assert s["swap_out_blocks"] == 0 and s["swap_in_blocks"] == 0
+    a.note_swap_out(3, 192)
+    a.note_swap_out(1, 64)
+    a.note_swap_in(2, 128)
+    s = a.stats()
+    assert s["swap_out_blocks"] == 4 and s["swap_in_blocks"] == 2
+    assert s["host_bytes_in_use"] == 128 and s["host_bytes_peak"] == 256
+    a.note_host_release(128)                  # discarded parked copy
+    assert a.stats()["host_bytes_in_use"] == 0
+    assert a.stats()["pinned_blocks"] == 0
+
+
+def test_match_hashes_walks_and_refs_without_hit_counters():
+    """match_hashes (the swap-in fast path) re-refs the longest resident
+    prefix of an explicit hash chain, stops at the first miss, and leaves
+    the prefix-cache hit-rate counters untouched — resume reuse is not a
+    prefill skip."""
+    a = BlockAllocator(num_blocks=6, block_size=2)
+    bids = [a.alloc() for _ in range(3)]
+    for bid, h in zip(bids, (101, 102, 103)):
+        a.register(bid, h)
+    for bid in bids:
+        a.free(bid)
+    hit = a.match_hashes([101, 102, 999, 103])
+    assert hit == bids[:2]                    # stops at the 999 miss
+    assert a.blocks_in_use == 2
+    s = a.stats()
+    assert s["prefix_lookup_blocks"] == 0     # counters untouched
+    assert s["prefix_hit_blocks"] == 0
+    for bid in hit:
+        a.free(bid)
+    assert a.match_hashes([555]) == []

@@ -1,0 +1,408 @@
+"""Overload-safe scheduling + host KV offload (inference/scheduler.py,
+inference/kv_offload.py, and their GenerationServer integration):
+policy ordering, WFQ fairness, admission backpressure, TTL expiry,
+cooperative cancellation, and — the core claim — swap-preemption that
+resumes TOKEN-IDENTICAL to an un-preempted run for both fp and int8 KV
+pools, with zero steady-state recompiles. Quick tier on CPU."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.kv_offload import HostKVPool
+from paddle_tpu.inference.scheduler import (PRIORITY_HIGH, PRIORITY_LOW,
+                                            PRIORITY_NORMAL, AdmissionError,
+                                            Scheduler)
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+# --------------------------------------------------------------------------
+# Scheduler unit tests (pure host, no model)
+# --------------------------------------------------------------------------
+
+def test_fifo_orders_by_submission_and_preempted_first():
+    s = Scheduler("fifo")
+    a = s.submit("a", 0)
+    b = s.submit("b", 1)
+    c = s.submit("c", 2)
+    assert s.pop() is a
+    # a preempted entry outranks every waiting peer — it holds paid-for
+    # work (host KV or lost prefill), so it drains first
+    s.requeue(a)
+    assert [s.pop(), s.pop(), s.pop()] == [a, b, c]
+    assert len(s) == 0 and s.pop() is None
+
+
+def test_priority_classes_with_edf_tiebreak():
+    s = Scheduler("priority", default_ttl_s=None, clock=lambda: 100.0)
+    lo = s.submit("lo", 0, priority=PRIORITY_LOW)
+    hi_late = s.submit("hl", 1, priority=PRIORITY_HIGH, ttl_s=50.0)
+    hi_soon = s.submit("hs", 2, priority=PRIORITY_HIGH, ttl_s=10.0)
+    hi_none = s.submit("hn", 3, priority=PRIORITY_HIGH)
+    nm = s.submit("nm", 4, priority=PRIORITY_NORMAL)
+    # within the high class: earliest deadline first, no-deadline last
+    assert [e.rid for e in s.waiting()] == [2, 1, 3, 4, 0]
+    assert s.pop() is hi_soon and s.pop() is hi_late and s.pop() is hi_none
+    assert s.pop() is nm and s.pop() is lo
+
+
+def test_wfq_share_follows_tenant_weights():
+    """Tenant A (weight 3) vs B (weight 1), both with a deep backlog of
+    equal-cost requests: pops interleave ~3:1 — the chatty tenant cannot
+    starve the light one, and vice versa."""
+    s = Scheduler("wfq", weights={"a": 3.0, "b": 1.0})
+    for i in range(12):
+        s.submit(f"a{i}", i, tenant="a", cost=1.0)
+    for i in range(12):
+        s.submit(f"b{i}", 100 + i, tenant="b", cost=1.0)
+    first8 = [s.pop().tenant for _ in range(8)]
+    assert first8.count("a") == 6 and first8.count("b") == 2
+    # equal weights degrade to alternation regardless of submit order
+    s2 = Scheduler("wfq")
+    for i in range(4):
+        s2.submit(f"x{i}", i, tenant="x", cost=1.0)
+    for i in range(4):
+        s2.submit(f"y{i}", 10 + i, tenant="y", cost=1.0)
+    order = [s2.pop().tenant for _ in range(8)]
+    assert order.count("x") == 4 and order[:2] in (["x", "y"], ["y", "x"])
+
+
+def test_admission_control_backpressure():
+    s = Scheduler("fifo", max_queue=2)
+    s.submit("a", 0)
+    s.submit("b", 1)
+    with pytest.raises(AdmissionError, match="queue full"):
+        s.submit("c", 2)
+    s.pop()
+    s.submit("c", 3)                          # space reopened
+    # requeue bypasses admission: the entry was already admitted once
+    ent = s.pop()
+    s.submit("d", 4)
+    s.requeue(ent)
+    assert len(s) == 3
+
+
+def test_ttl_expires_only_never_started_entries():
+    t = [0.0]
+    s = Scheduler("fifo", default_ttl_s=10.0, clock=lambda: t[0])
+    a = s.submit("a", 0)
+    b = s.submit("b", 1, ttl_s=100.0)         # per-request override
+    ran = s.pop()                             # a starts
+    assert ran is a
+    s.requeue(ran)                            # preempted — exempt from TTL
+    t[0] = 50.0
+    dead = s.expire()
+    assert dead == [] or all(e.started for e in dead) is False
+    assert [e.rid for e in dead] == []        # b at ttl 100 not due yet
+    t[0] = 150.0
+    dead = s.expire()
+    assert [e.rid for e in dead] == [1]       # b expired; a exempt
+    assert s.expired == 1
+    assert s.pop() is a and len(s) == 0
+
+
+def test_cancel_and_validation():
+    s = Scheduler("priority")
+    s.submit("a", 0)
+    ent = s.cancel(0)
+    assert ent is not None and ent.req == "a" and s.cancel(0) is None
+    assert s.cancelled == 1
+    with pytest.raises(ValueError, match="priority"):
+        s.submit("x", 1, priority=-1)
+    with pytest.raises(ValueError, match="ttl_s"):
+        s.submit("x", 2, ttl_s=0.0)
+    with pytest.raises(ValueError, match="policy"):
+        Scheduler("lifo")
+    with pytest.raises(ValueError, match="weight"):
+        Scheduler("wfq", weights={"t": 0.0})
+
+
+def test_host_pool_budget():
+    p = HostKVPool(capacity_bytes=100)
+    assert p.put(1, [np.zeros(4)], 60)
+    assert not p.put(2, [np.zeros(4)], 60)    # would exceed the cap
+    assert p.put(2, [np.zeros(4)], 40)
+    assert p.bytes_in_use == 100 and p.bytes_peak == 100
+    p.take(1, 60)
+    assert p.bytes_in_use == 40 and len(p) == 1
+    p.discard(2, 40)
+    p.discard(2, 40)                          # idempotent
+    assert p.bytes_in_use == 0
+    with pytest.raises(ValueError):
+        HostKVPool(capacity_bytes=-1)
+
+
+# --------------------------------------------------------------------------
+# Server integration: swap-preemption, priorities, cancellation
+# --------------------------------------------------------------------------
+
+_PROMPT_LENS = (12, 7, 19, 5)
+
+
+def _prompts(cfg, lens=_PROMPT_LENS):
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, cfg.vocab_size, (n,)).tolist() for n in lens]
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_preempted_resume_is_token_identical(kv_quant):
+    """THE offload contract: a request preempted mid-decode (KV swapped to
+    host) and later resumed emits exactly the tokens an un-preempted run
+    emits — bit-exact KV round trip + identical program state. Checked
+    against the ample-pool paged server and (fp) the dense oracle."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+
+    ample = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                             block_size=8, prefill_chunk=16,
+                             kv_quant=kv_quant)
+    ra = [ample.submit(p, max_new_tokens=12) for p in prompts]
+    base = ample.run()
+    assert ample.sched_metrics()["preemptions"] == 0
+
+    # 6 usable blocks << peak demand (~7-8) -> decode-phase preemption
+    tight = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                             block_size=8, prefill_chunk=16, num_blocks=7,
+                             policy="priority", kv_quant=kv_quant)
+    rt = [tight.submit(p, max_new_tokens=12, priority=i % 2)
+          for i, p in enumerate(prompts)]
+    out = tight.run()
+    sm = tight.sched_metrics()
+    assert sm["preemptions"] > 0 and sm["resumes"] > 0, sm
+    for a, b in zip(ra, rt):
+        assert out[b] == base[a], "preempted run diverged from baseline"
+    if kv_quant == "none":
+        dense = GenerationServer(model, max_batch=2, max_len=96,
+                                 prompt_buckets=(32,))
+        rd = [dense.submit(p, max_new_tokens=12) for p in prompts]
+        outd = dense.run()
+        for a, b in zip(rd, rt):
+            assert out[b] == outd[a], "preempted run diverged from dense"
+    ks = tight.kv_stats()
+    assert ks["swap_out_blocks"] > 0 and ks["swap_in_blocks"] > 0
+    assert ks["swap_out_blocks"] == ks["swap_in_blocks"]
+    assert ks["host_bytes_in_use"] == 0       # everything restored
+    assert ks["host_bytes_peak"] > 0
+    assert ks["blocks_in_use"] == 0 and ks["pinned_blocks"] == 0
+
+
+def test_priority_preempts_running_low_for_waiting_high():
+    """Proactive preemption: with every slot busy on LOW work, a HIGH
+    submission must evict a victim and finish first (bounded TTFT for
+    urgent traffic is the whole point of priority classes)."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, (16, 14))
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16,
+                           policy="priority")
+    lows = [srv.submit(p, max_new_tokens=24, priority=PRIORITY_LOW)
+            for p in prompts]
+    for _ in range(4):                        # lows occupy both slots
+        srv.step()
+    assert all(srv.status(r) in ("running", "prefilling") for r in lows)
+    hi = srv.submit(_prompts(cfg, (9,))[0], max_new_tokens=4,
+                    priority=PRIORITY_HIGH)
+    srv.step()
+    # one low victim lost its slot to the high request
+    assert srv.status(hi) in ("running", "prefilling", "done")
+    assert sum(srv.status(r) in ("swapped", "preempted", "queued")
+               for r in lows) == 1
+    done_order = []
+    seen = set()
+    while srv.step():
+        for r in (hi, *lows):
+            if srv.status(r) == "done" and r not in seen:
+                seen.add(r)
+                done_order.append(r)
+    out = srv.run()
+    assert done_order[0] == hi
+    assert srv.sched_metrics()["preemptions"] \
+        + srv.sched_metrics()["prefill_aborts"] >= 1
+    assert len(out[hi]) == 9 + 4
+    for r, p in zip(lows, prompts):
+        assert len(out[r]) == len(p) + 24
+
+
+def test_cancel_mid_spec_window_rolls_back_blocks():
+    """Cancelling a decoding request mid-speculative-window must return
+    the allocator to its pre-submit occupancy through the truncate path:
+    the spec-window tail reservation and all held blocks released, no
+    refcount leaked, conservation invariant intact."""
+    from paddle_tpu.inference.speculative import SpecConfig
+
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=4, prefill_chunk=8,
+                           spec=SpecConfig(k=4, gate_cooldown=0))
+    a = srv.alloc
+    usable = a.num_blocks - 1
+    pre = (a.blocks_in_use, a.blocks_free + a.evictable_cached)
+    rid = srv.submit(_prompts(cfg, (10,))[0], max_new_tokens=40)
+    keep = srv.submit(_prompts(cfg, (6,))[0], max_new_tokens=8)
+    for _ in range(4):                        # prefill + spec windows ran
+        srv.step()
+    assert srv.status(rid) == "running"
+    # the slot holds prompt+generated blocks (the speculative tail
+    # reservation is trimmed back at each verify, so between steps the
+    # table is exactly ceil(pos/bs) — the cancel must release all of it)
+    s = next(i for i in range(2) if srv._slots[i] is not None
+             and srv._slots[i].rid == rid)
+    held = len(srv._slots[s].table)
+    assert held >= -(-int(srv.pos[s]) // srv.block_size) > 0
+    assert srv.cancel(rid) is True
+    assert srv.status(rid) == "cancelled"
+    assert srv.cancel(rid) is False           # second cancel is a no-op
+    out = srv.run()                           # the survivor still finishes
+    assert rid not in out and len(out[keep]) == 6 + 8
+    assert a.blocks_in_use == pre[0]          # pre-submit occupancy
+    assert a.blocks_in_use + a.blocks_cached + a.blocks_free == usable
+    assert srv.sched_metrics()["cancelled"] == 1
+
+
+def test_cancel_queued_and_swapped_discards_host_copy():
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    srv = GenerationServer(model, max_batch=1, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, num_blocks=5,
+                           policy="priority")
+    lo = srv.submit(prompts[0], max_new_tokens=16, priority=PRIORITY_LOW)
+    for _ in range(3):                        # lo prefills, starts decoding
+        srv.step()
+    assert srv.status(lo) == "running"
+    q = srv.submit(prompts[1], max_new_tokens=4, priority=PRIORITY_LOW)
+    assert srv.status(q) == "queued"
+    assert srv.cancel(q) is True              # cancelled while waiting
+    hi = srv.submit(prompts[3], max_new_tokens=4, priority=PRIORITY_HIGH)
+    for _ in range(12):
+        if srv.status(lo) == "swapped":
+            break
+        srv.step()
+    assert srv.status(lo) == "swapped"        # evicted for the high req
+    assert srv.sched_metrics()["host_bytes_in_use"] > 0
+    assert srv.cancel(lo) is True             # parked host copy discarded
+    assert srv.sched_metrics()["host_bytes_in_use"] == 0
+    out = srv.run()
+    assert set(out) == {hi}
+    assert srv.kv_stats()["host_bytes_in_use"] == 0
+    assert srv.cancel(999) is False and srv.status(999) == "unknown"
+
+
+def test_ttl_expiry_and_admission_through_server():
+    """The policy= hook takes a configured Scheduler: a bounded queue
+    raises AdmissionError through submit(), and a TTL'd entry that never
+    reaches a slot is dropped as 'expired' (not silently lost)."""
+    model, cfg = _model()
+    t = [0.0]
+    sched = Scheduler("fifo", max_queue=2, clock=lambda: t[0])
+    srv = GenerationServer(model, max_batch=1, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, policy=sched)
+    prompts = _prompts(cfg)
+    a = srv.submit(prompts[0], max_new_tokens=6)
+    b = srv.submit(prompts[1], max_new_tokens=6, ttl_s=5.0)
+    with pytest.raises(AdmissionError):       # slots fill at step(), so the
+        srv.submit(prompts[3], max_new_tokens=6)  # queue is at 2/2 already
+    srv.step()                                # a admitted; b waits
+    c = srv.submit(prompts[2], max_new_tokens=6)
+    t[0] = 10.0                               # b's deadline passes queued
+    out = srv.run()
+    assert srv.status(b) == "expired" and b not in out
+    assert len(out[a]) == len(prompts[0]) + 6
+    assert len(out[c]) == len(prompts[2]) + 6
+    assert srv.sched_metrics()["expired"] == 1
+
+
+def test_overload_drains_without_deadlock_and_infeasible_rejected():
+    """Demand far beyond the pool: every request still completes (preempt
+    / swap / resume churn, no deadlock), and a request that could NEVER
+    fit is rejected at submit instead of wedging the queue."""
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=3, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, num_blocks=9,
+                           policy="wfq")
+    with pytest.raises(ValueError, match="never be scheduled"):
+        srv.submit(list(range(1, 70)), max_new_tokens=20)  # needs > pool
+    rng = np.random.RandomState(5)
+    rids = {}
+    for i in range(8):
+        p = rng.randint(1, cfg.vocab_size, (int(rng.choice([5, 9, 14])),))
+        rids[srv.submit(p.tolist(), max_new_tokens=10,
+                        tenant=("a", "b")[i % 2])] = len(p)
+    out = srv.run()
+    assert set(out) == set(rids)
+    for r, n in rids.items():
+        assert len(out[r]) == n + 10
+    ks = srv.kv_stats()
+    assert ks["blocks_in_use"] == 0 and ks["host_bytes_in_use"] == 0
+    m = srv.request_metrics()
+    assert all("done_t" in m[r] and "first_token_t" in m[r] for r in rids)
+
+
+@pytest.mark.graftlint
+def test_swap_preemption_steady_state_zero_recompiles():
+    """jit-cache guard over the preemption path: after ONE warm
+    preempt/resume cycle (which compiles the fixed-width gather/scatter
+    copies exactly once), a second overload wave — different lengths,
+    fresh churn — must run with ZERO backend compiles. A swap keyed on
+    the victim's block count would recompile per preemption and fail
+    here."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, num_blocks=7,
+                           policy="priority")
+    warm = _prompts(cfg)
+    for i, p in enumerate(warm):
+        srv.submit(p, max_new_tokens=12, priority=i % 2)
+    srv.run()
+    assert srv.sched_metrics()["preemptions"] > 0  # the path IS warm
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (11, 6, 17, 8)]
+    rids = [srv.submit(p, max_new_tokens=12, priority=i % 2)
+            for i, p in enumerate(prompts)]
+    pre = srv.sched_metrics()["preemptions"]
+    with jit_cache_guard("swap-preemption steady state") as g:
+        out = srv.run()
+    assert g.compiles == 0
+    assert srv.sched_metrics()["preemptions"] > pre  # wave 2 preempted too
+    for r, p in zip(rids, prompts):
+        assert len(out[r]) == len(p) + 12
+
+
+def test_serving_benchmark_overload_smoke():
+    """The overload benchmark mode end to end: open-loop bursty arrivals,
+    priority scheduling, pool < demand — one JSON line with TTFT/TPOT
+    percentiles, nonzero swap counters, and per-class TTFT splits."""
+    proc = subprocess.run(
+        [sys.executable, "tools/serving_benchmark.py", "--paged", "--json",
+         "--requests", "10", "--slots", "3", "--max-new", "12",
+         "--tick-window", "2", "--block-size", "8", "--prefill-chunk", "16",
+         "--pool-frac", "0.35", "--scheduler", "priority",
+         "--mixed-priority", "--arrival-rate", "400", "--burst", "4",
+         "--seed", "3"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    for key in ("ttft_p50_s", "ttft_p95_s", "tpot_p50_ms", "tpot_p95_ms",
+                "ttft_p95_s_high", "preemptions", "swap_out_blocks",
+                "swap_in_blocks"):
+        assert key in line, key
+    assert line["seed"] == 3 and line["scheduler"] == "priority"
+    assert line["swap_out_blocks"] > 0        # overload actually overloaded
+    assert line["ttft_p95_s"] >= line["ttft_p50_s"] >= 0.0

@@ -95,4 +95,27 @@ if ratio < 1.0:
                      "check the gate (SpecConfig.gate_low) before shipping")
 PY
 
+echo "== 7b. overload smoke (scheduler + swap-preemption under pressure) =="
+python tools/serving_benchmark.py --paged --pool-frac 0.35 --scheduler priority \
+  --mixed-priority --arrival-rate 400 --burst 4 --seed 3 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_overload.json \
+  || { echo "overload serving pass FAILED (deadlock or crash)"; exit 1; }
+python - <<'PY'
+# overload gate: the starved pool must actually exercise swap-preemption,
+# and priority scheduling must keep high-priority TTFT below low-priority
+# (the whole point of the scheduler) with an absolute ceiling as a
+# deadlock/livelock tripwire
+import json
+r = json.load(open("/tmp/tpu_runs/serving_overload.json"))
+print(f"preemptions {r['preemptions']} aborts {r['prefill_aborts']} "
+      f"swap {r['swap_out_blocks']}/{r['swap_in_blocks']} blocks, "
+      f"ttft_p95 high {r['ttft_p95_s_high']:.3f}s low {r['ttft_p95_s_low']:.3f}s")
+assert r["swap_out_blocks"] > 0, "pool never pressured — no swap exercised"
+assert r["ttft_p95_s_high"] <= r["ttft_p95_s_low"], \
+    "priority inversion: high-priority TTFT above low-priority"
+if r["ttft_p95_s_high"] > 30.0:
+    raise SystemExit("high-priority p95 TTFT unbounded under overload — "
+                     "scheduler wedged or preemption not firing")
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

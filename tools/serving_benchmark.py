@@ -26,8 +26,25 @@ one fused k+1-wide verify program, exact acceptance): the JSON line gains
 ``--repeat-suffix`` switches to the repeated-suffix workload where
 prompt-lookup drafting shines.
 
+Overload / scheduling (docs/serving.md "Scheduling and host KV offload"):
+``--arrival-rate R`` switches from the submit-everything burst to an
+OPEN-LOOP bursty generator — requests arrive in ``--burst``-sized clumps
+on a pre-drawn timeline (exponential inter-burst gaps at R req/s overall)
+that does NOT wait for the server, so queueing delay shows up in TTFT
+instead of being hidden by closed-loop self-pacing. The whole traffic
+trace (lengths, arrival times, priorities) is drawn up front from
+``--seed``, so a run is reproducible end to end. ``--pool-frac F``
+shrinks the KV pool to F× dense parity (demand > pool → swap-preemption
+fires), ``--scheduler priority --mixed-priority`` splits traffic across
+priority classes/tenants, and the JSON line gains
+``ttft_p50_s/ttft_p95_s`` (plus per-class splits), ``tpot_p50_ms/
+tpot_p95_ms``, and the preemption/swap counters.
+
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
-       [--paged [--block-size 16] [--num-blocks N] [--prefill-chunk 64]
+       [--seed 0] [--arrival-rate R --burst B]
+       [--scheduler fifo|priority|wfq [--mixed-priority]]
+       [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
+        [--host-pool-mb M] [--prefill-chunk 64]
         [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]]
        [--json]
 """
@@ -103,10 +120,42 @@ def main():
                     help="repeated-suffix workload: prompts tile a short "
                          "motif, so generation loops the drafter can "
                          "predict — the speculative showcase")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds the whole traffic trace (prompt lengths, "
+                         "contents, arrival times, priority assignment) — "
+                         "same seed, same workload, run to run")
+    ap.add_argument("--arrival-rate", type=float, default=None, metavar="R",
+                    help="open-loop arrivals at R requests/s overall, in "
+                         "--burst clumps with exponential inter-burst gaps "
+                         "(drawn from --seed). Without it, all requests "
+                         "are submitted up front (closed-loop burst)")
+    ap.add_argument("--burst", type=int, default=4,
+                    help="requests per arrival clump in open-loop mode")
+    ap.add_argument("--scheduler", choices=("fifo", "priority", "wfq"),
+                    default="fifo",
+                    help="GenerationServer policy= (inference/scheduler.py)")
+    ap.add_argument("--mixed-priority", action="store_true",
+                    help="assign priorities round-robin (high/normal/low) "
+                         "and tenants (a/b) so --scheduler priority|wfq "
+                         "has classes to separate; the JSON line then "
+                         "splits TTFT percentiles per class")
+    ap.add_argument("--pool-frac", type=float, default=None, metavar="F",
+                    help="shrink the paged pool to F x dense parity so "
+                         "demand exceeds the pool and swap-preemption "
+                         "fires (overload mode; paged only)")
+    ap.add_argument("--host-pool-mb", type=float, default=None,
+                    help="cap the host swap pool (default unbounded); "
+                         "0 disables swapping — victims stall instead")
     ap.add_argument("--json", action="store_true",
                     help="emit exactly one machine-readable JSON line "
                          "(bench.py style) on stdout and nothing else")
     args = ap.parse_args()
+    if args.pool_frac is not None and not args.paged:
+        ap.error("--pool-frac requires --paged")
+    if args.host_pool_mb is not None and not args.paged:
+        ap.error("--host-pool-mb requires --paged")
+    if args.burst < 1:
+        ap.error("--burst must be >= 1")
     if args.max_new is None:
         args.max_new = 128 if args.repeat_suffix else 64
     if args.max_len is None:
@@ -150,15 +199,19 @@ def main():
                           num_attention_heads=4, num_key_value_heads=2,
                           max_position_embeddings=args.max_len,
                           dtype="float32", use_flash_attention=False)
-    paddle.seed(0)
+    paddle.seed(0)   # model weights are part of the benchmark definition
     model = LlamaForCausalLM(cfg)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    rng = np.random.RandomState(0)
+    # --seed governs TRAFFIC only: same weights, different load trace
+    rng = np.random.RandomState(args.seed)
 
     motif = rng.randint(1, cfg.vocab_size, 8).tolist()
+    _counter = [0]
+    prios = {}
 
     def burst(server, n):
-        """Mixed prompt lengths across the bucket ladder."""
+        """Mixed prompt lengths across the bucket ladder; round-robin
+        priority classes + tenants under --mixed-priority."""
         lens = rng.choice([64, 128, 256, 400, 512] if args.long_prompts
                           else [16, 30, 64, 100, 128], size=n)
         rids = {}
@@ -170,7 +223,16 @@ def main():
                 prompt = (motif * (int(ln) // len(motif) + 1))[:int(ln)]
             else:
                 prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
-            rids[server.submit(prompt, max_new_tokens=args.max_new)] = int(ln)
+            i = _counter[0]
+            _counter[0] += 1
+            prio, tenant = 1, "default"
+            if args.mixed_priority:
+                prio = (0, 1, 2)[i % 3]
+                tenant = ("a", "b")[i % 2]
+            rid = server.submit(prompt, max_new_tokens=args.max_new,
+                                priority=prio, tenant=tenant)
+            rids[rid] = int(ln)
+            prios[rid] = prio
         return rids
 
     import contextlib
@@ -202,6 +264,8 @@ def main():
                     draft_model = LlamaForCausalLM(dcfg)
                 spec = SpecConfig(k=args.spec, drafter=args.spec_drafter,
                                   draft_model=draft_model)
+            host_pool = (None if args.host_pool_mb is None
+                         else int(args.host_pool_mb * 1e6))
             pool_bytes = None
             num_blocks = args.num_blocks
             if args.kv_quant != "none" and num_blocks is None:
@@ -215,18 +279,29 @@ def main():
                 bs = args.block_size
                 fp_blocks = args.slots * (-(-args.max_len // bs)) + 1
                 pool_bytes = fp_blocks * kv_block_bytes(cfg, bs, "none")
+            if args.pool_frac is not None:
+                # overload mode: pool sized BELOW peak demand, so the
+                # scheduler must preempt (swap KV to host) to make room
+                if pool_bytes is not None:
+                    pool_bytes = max(1, int(pool_bytes * args.pool_frac))
+                elif num_blocks is None:
+                    parity = args.slots * (-(-args.max_len
+                                             // args.block_size)) + 1
+                    num_blocks = max(4, int(parity * args.pool_frac))
             return GenerationServer(
                 model, max_batch=args.slots, max_len=args.max_len,
                 tick_window=args.tick_window, cache="paged",
                 block_size=args.block_size, num_blocks=num_blocks,
                 prefill_chunk=args.prefill_chunk, spec=spec,
-                kv_quant=args.kv_quant, pool_bytes=pool_bytes)
+                kv_quant=args.kv_quant, pool_bytes=pool_bytes,
+                policy=args.scheduler, host_pool_bytes=host_pool)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
                                                 if args.long_prompts
                                                 else (32, 64, 128)),
-                                tick_window=args.tick_window)
+                                tick_window=args.tick_window,
+                                policy=args.scheduler)
 
     # CPU smoke runs don't touch the chip — don't serialize on its lock
     lock = tpu_lock(timeout_s=900.0) if on_tpu else \
@@ -239,26 +314,58 @@ def main():
         burst(server, min(args.slots, 4))
         server.run()
 
-        rids = burst(server, args.requests)
+        # pre-draw the whole open-loop arrival timeline from the seeded
+        # rng — the trace is fixed before the clock starts, so it cannot
+        # react to server speed (open loop) and replays exactly per seed
+        schedule = []
+        if args.arrival_rate is not None:
+            t, left = 0.0, args.requests
+            while left > 0:
+                n = min(args.burst, left)
+                schedule.append((t, n))
+                left -= n
+                t += float(rng.exponential(args.burst / args.arrival_rate))
+        rids = {} if schedule else burst(server, args.requests)
         guard = (jit_cache_guard("serving_benchmark measured drain")
                  if args.guard_recompiles else contextlib.nullcontext())
         with guard:
             t0 = time.perf_counter()
             done_at = {}
+            pending = list(schedule)
             while True:
+                now = time.perf_counter() - t0
+                while pending and pending[0][0] <= now:
+                    rids.update(burst(server, pending.pop(0)[1]))
                 remaining = server.step()
-                now = time.perf_counter()
+                now = time.perf_counter() - t0
                 for rid in list(server._results):
                     if rid not in done_at:
-                        done_at[rid] = now - t0
+                        done_at[rid] = now
                 if remaining == 0:
-                    break
+                    if not pending:
+                        break
+                    # open-loop lull: nothing in flight, next clump later
+                    time.sleep(max(0.0, min(pending[0][0] - now, 0.01)))
             dt = time.perf_counter() - t0
         out = server._results
     gen_tokens = sum(len(v) - rids[r] for r, v in out.items() if r in rids)
     lats = sorted(done_at[r] for r in rids if r in done_at)
     p50 = lats[len(lats) // 2]
     p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+    # TTFT (submit -> first generated token, queue wait included) and
+    # per-token decode latency, from the server's per-request marks
+    rm = server.request_metrics()
+    ttft = {r: rm[r]["first_token_t"] - rm[r]["submit_t"]
+            for r in rids if "first_token_t" in rm.get(r, {})}
+    tpot_ms = [1e3 * (m["done_t"] - m["first_token_t"])
+               / (m["n_generated"] - 1)
+               for r in rids for m in [rm.get(r, {})]
+               if "done_t" in m and m.get("n_generated", 0) > 1]
     line = {"metric": "serving_continuous_batching_tok_s_1chip",
             "value": round(gen_tokens / dt, 1),
             "unit": f"generated tok/s ({args.requests} reqs, {args.slots} "
@@ -269,7 +376,31 @@ def main():
                     f"params={n_params/1e6:.0f}M)",
             "kv_cache": "paged" if args.paged else "dense",
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
-            "wall_s": round(dt, 2)}
+            "wall_s": round(dt, 2),
+            "seed": args.seed, "scheduler": args.scheduler,
+            "ttft_p50_s": round(pct(list(ttft.values()), 0.50) or 0.0, 4),
+            "ttft_p95_s": round(pct(list(ttft.values()), 0.95) or 0.0, 4),
+            "tpot_p50_ms": round(pct(tpot_ms, 0.50) or 0.0, 3),
+            "tpot_p95_ms": round(pct(tpot_ms, 0.95) or 0.0, 3)}
+    if args.arrival_rate is not None:
+        line["arrival_rate"] = args.arrival_rate
+        line["burst"] = args.burst
+    if args.mixed_priority:
+        for cls, name in ((0, "high"), (1, "normal"), (2, "low")):
+            xs = [v for r, v in ttft.items() if prios.get(r) == cls]
+            line[f"ttft_p95_s_{name}"] = round(pct(xs, 0.95) or 0.0, 4)
+    sm = server.sched_metrics()
+    if sm["preemptions"] or sm["prefill_aborts"] or sm["expired"] \
+            or args.pool_frac is not None or args.scheduler != "fifo":
+        line["preemptions"] = sm["preemptions"]
+        line["prefill_aborts"] = sm["prefill_aborts"]
+        line["resumes"] = sm["resumes"]
+        line["expired"] = sm["expired"]
+        if args.paged:
+            ks = server.kv_stats()
+            line["swap_out_blocks"] = ks["swap_out_blocks"]
+            line["swap_in_blocks"] = ks["swap_in_blocks"]
+            line["host_bytes_peak"] = ks["host_bytes_peak"]
     if args.paged:
         stats = server.kv_stats()
         line["peak_kv_blocks"] = stats["peak_blocks_in_use"]
