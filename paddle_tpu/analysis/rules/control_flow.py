@@ -102,3 +102,64 @@ class EffectInJitRule(Rule):
                         f"{chain}() inside jitted '{node.name}' draws ONE "
                         f"value at trace time — every compiled call replays "
                         f"it; thread a jax.random key instead")
+
+
+@register
+class AdapterBranchInJitRule(Rule):
+    """GL009: Python branching on adapter ids inside a jitted function.
+    The multi-adapter serving contract (inference/lora.py) is that
+    adapter selection happens by GATHER — per-slot indices into the
+    stacked pool tensors — so adapter churn never changes compiled
+    shapes. A Python ``if``/``while``/ternary on an adapter id either
+    concretizes a traced index (error under jit) or, if the id arrives
+    as a static arg, forks the jit cache per adapter — the per-adapter
+    recompile storm the pool exists to prevent."""
+
+    id = "GL009"
+    name = "adapter-branch-in-jit"
+    description = ("Python control flow on an adapter id inside a jitted "
+                   "function — adapter selection must be a static-shape "
+                   "gather from the pooled factors (inference/lora.py), "
+                   "never a data-dependent branch: traced ids raise, "
+                   "static ids recompile once per adapter")
+
+    # identifiers that carry adapter identity through the serving stack
+    _EXACT = ("aidx",)
+    _SUBSTR = ("adapter",)
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.jitted_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in ctx.jitted_names:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                name = self._adapter_name(sub.test)
+                if name is not None:
+                    kind = type(sub).__name__.lower()
+                    yield self.finding(
+                        ctx, sub,
+                        f"{kind} on adapter id '{name}' inside jitted "
+                        f"'{node.name}' — gather the slot's factors from "
+                        f"the pool by index (static shapes) instead of "
+                        f"branching on which adapter is active")
+
+    @classmethod
+    def _adapter_name(cls, test: ast.AST):
+        """First identifier in the test that names an adapter id."""
+        for sub in ast.walk(test):
+            ident = None
+            if isinstance(sub, ast.Name):
+                ident = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ident = sub.attr
+            if ident is None:
+                continue
+            low = ident.lower()
+            if low in cls._EXACT or any(s in low for s in cls._SUBSTR):
+                return ident
+        return None

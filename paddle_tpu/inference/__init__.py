@@ -254,6 +254,8 @@ def load_predictor(path: str) -> Predictor:
 
 from .kv_offload import (HostKVPool, KVOffloadEngine,  # noqa: E402,F401
                          SwapHandle)
+from .lora import (Adapter, AdapterPool, AdapterRegistry,  # noqa: E402,F401
+                   LoRAConfig, adapter_page_bytes)
 from .paged_cache import BlockAllocator  # noqa: E402,F401
 from .scheduler import (PRIORITY_HIGH, PRIORITY_LOW,  # noqa: E402,F401
                         PRIORITY_NORMAL, AdmissionError, SchedEntry,

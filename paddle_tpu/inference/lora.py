@@ -1,0 +1,420 @@
+"""Multi-tenant LoRA serving: adapter registry + paged adapter-weight pool.
+
+Hundreds of per-customer fine-tuned adapters served over ONE base model.
+The device footprint is bounded by a fixed page count, not the adapter
+population:
+
+- :class:`AdapterRegistry` is the host tier — every registered adapter
+  keeps its f32 A/B factors in host memory (numpy), so "offload" for a
+  cold adapter is simply dropping its device page; re-activation is an
+  upload, never a recompute.
+- :class:`AdapterPool` is the device tier — ``max_live_adapters`` fixed-
+  size pages inside per-target stacked tensors, padded to a static
+  ``max_rank``. Page lifecycle (refcounts, LRU retention of released
+  pages, pin/unpin, eviction of the coldest unpinned page) reuses
+  :class:`~.paged_cache.BlockAllocator` verbatim — an adapter page is a
+  block of rank-padded factors instead of a block of K/V. Page 0 is the
+  permanently-zero NULL adapter (the analogue of the KV scratch block):
+  rows without an adapter gather page 0 and get an exact zero delta, so
+  the decode program needs no branching on "has adapter".
+
+The batched heterogeneous-adapter delta (BGMV style): every compiled
+serving program takes the flat pool tensors plus a per-row int32 page
+index; :meth:`AdapterPool.gather_rows` gathers per-row A/B factors and
+``nn.lora.bgmv`` applies ``y += (x @ A) @ B * (alpha/r)`` as two skinny
+f32 matmuls. All shapes are static — registering, evicting, or swapping
+adapters only changes pool *values* (functional ``.at[page].set``
+uploads), so adapter churn causes zero steady-state recompiles.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .paged_cache import BlockAllocator
+
+# (layer, target) addressing: the seven Llama projection sites. Order is
+# load-bearing — it fixes the flat pool tensor layout.
+LORA_TARGETS = ("q", "k", "v", "o", "gate", "up", "down")
+
+NULL_PAGE = 0
+
+# module-path suffix -> short target key (parses nn.lora export dicts)
+_PATH_TARGETS = {"q_proj": "q", "k_proj": "k", "v_proj": "v", "o_proj": "o",
+                 "gate_proj": "gate", "up_proj": "up", "down_proj": "down"}
+
+
+def target_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(in, out) dims per target for a Llama-family config."""
+    h = cfg.hidden_size
+    hd = h // cfg.num_attention_heads
+    q_out = cfg.num_attention_heads * hd
+    kv_out = cfg.num_key_value_heads * hd
+    return {"q": (h, q_out), "k": (h, kv_out), "v": (h, kv_out),
+            "o": (q_out, h),
+            "gate": (h, cfg.intermediate_size),
+            "up": (h, cfg.intermediate_size),
+            "down": (cfg.intermediate_size, h)}
+
+
+def adapter_page_bytes(cfg, max_rank: int,
+                       targets: Sequence[str] = LORA_TARGETS) -> int:
+    """f32 bytes of ONE rank-padded adapter page across all layers/targets
+    (the adapter analogue of ``serving.kv_block_bytes``)."""
+    dims = target_dims(cfg)
+    L = cfg.num_hidden_layers
+    n = 0
+    for t in targets:
+        i, o = dims[t]
+        n += L * (i * max_rank + max_rank * o)
+    return 4 * n + 4  # + the page's scale slot
+
+
+@dataclasses.dataclass
+class Adapter:
+    """One registered adapter: host-resident f32 factors keyed by
+    (layer_idx, target). ``uid`` is unique per registration so a pool can
+    tell a re-registered name from a warm cached page."""
+    name: str
+    rank: int
+    alpha: float
+    weights: Dict[Tuple[int, str], Tuple[np.ndarray, np.ndarray]]
+    uid: int = 0
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes + b.nbytes for a, b in self.weights.values())
+
+
+def _parse_path_key(key: str) -> Optional[Tuple[int, str]]:
+    """'model.layers.3.self_attn.q_proj' -> (3, 'q'); None if unparseable."""
+    parts = key.split(".")
+    tname = _PATH_TARGETS.get(parts[-1])
+    if tname is None:
+        return None
+    for i, p in enumerate(parts):
+        if p == "layers" and i + 1 < len(parts) and parts[i + 1].isdigit():
+            return int(parts[i + 1]), tname
+    return None
+
+
+class AdapterRegistry:
+    """Host-side adapter store (the cold/offload tier). Registration
+    normalizes factors to f32 numpy keyed by (layer_idx, target); the
+    factors stay resident for the adapter's lifetime so an evicted device
+    page can always be re-uploaded."""
+
+    def __init__(self):
+        self._adapters: Dict[str, Adapter] = {}
+        self._next_uid = 1
+
+    def register(self, name: str, weights: Dict, rank: Optional[int] = None,
+                 alpha: Optional[float] = None) -> Adapter:
+        """``weights``: either {(layer_idx, target): (A, B)} with short
+        target keys from :data:`LORA_TARGETS`, or an ``nn.lora`` export
+        dict keyed by module path (its ``__meta__`` supplies rank/alpha)."""
+        if name in self._adapters:
+            raise ValueError(f"adapter {name!r} already registered")
+        norm: Dict[Tuple[int, str], Tuple[np.ndarray, np.ndarray]] = {}
+        meta = weights.get("__meta__") if isinstance(weights, dict) else None
+        for key, ab in weights.items():
+            if key == "__meta__":
+                continue
+            if isinstance(key, str):
+                parsed = _parse_path_key(key)
+                if parsed is None:
+                    raise ValueError(
+                        f"adapter {name!r}: unrecognized module path {key!r}")
+                lk = parsed
+                a, b = ab["A"], ab["B"]
+            else:
+                lk = (int(key[0]), str(key[1]))
+                a, b = ab
+            norm[lk] = (np.asarray(a, dtype=np.float32),
+                        np.asarray(b, dtype=np.float32))
+        if not norm:
+            raise ValueError(f"adapter {name!r} has no weights")
+        if meta is not None:
+            rank = rank if rank is not None else int(meta["rank"])
+            alpha = alpha if alpha is not None else float(meta["alpha"])
+        ranks = {a.shape[1] for a, _ in norm.values()}
+        if rank is None:
+            if len(ranks) != 1:
+                raise ValueError(f"adapter {name!r}: mixed ranks {ranks} "
+                                 f"need an explicit rank=")
+            rank = ranks.pop()
+        for (l, t), (a, b) in norm.items():
+            if a.shape[1] != rank or b.shape[0] != rank:
+                raise ValueError(
+                    f"adapter {name!r} ({l}, {t}): factor rank "
+                    f"{a.shape[1]}/{b.shape[0]} != declared rank {rank}")
+        ad = Adapter(name=name, rank=int(rank),
+                     alpha=float(alpha if alpha is not None else rank),
+                     weights=norm, uid=self._next_uid)
+        self._next_uid += 1
+        self._adapters[name] = ad
+        return ad
+
+    def unregister(self, name: str) -> None:
+        del self._adapters[name]
+
+    def get(self, name: str) -> Adapter:
+        return self._adapters[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._adapters
+
+    def __len__(self) -> int:
+        return len(self._adapters)
+
+    def names(self) -> List[str]:
+        return list(self._adapters)
+
+    @property
+    def host_bytes(self) -> int:
+        return sum(a.nbytes for a in self._adapters.values())
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Serving-side pool shape — fixed at server construction so every
+    compiled program's adapter arguments are static."""
+    registry: AdapterRegistry
+    max_live_adapters: int = 8
+    max_rank: int = 8
+    targets: Tuple[str, ...] = LORA_TARGETS
+
+    def validate(self):
+        if self.max_live_adapters < 1:
+            raise ValueError(f"max_live_adapters must be >= 1, got "
+                             f"{self.max_live_adapters}")
+        if self.max_rank < 1:
+            raise ValueError(f"max_rank must be >= 1, got {self.max_rank}")
+        bad = [t for t in self.targets if t not in LORA_TARGETS]
+        if bad:
+            raise ValueError(f"unknown LoRA targets {bad}; "
+                             f"valid: {LORA_TARGETS}")
+
+
+class AdapterPool:
+    """Device-resident paged pool of rank-padded adapter factors.
+
+    Layout: per target ``t`` two stacked tensors ``A_t`` of shape
+    (pages, L, in_t, max_rank) and ``B_t`` (pages, L, max_rank, out_t),
+    plus one (pages,) f32 scale vector with alpha/r pre-baked — flat list
+    ``[A_t0, B_t0, A_t1, B_t1, ..., scale]`` handed to the compiled
+    programs. ``pages = max_live_adapters + 1``; page 0 is the null
+    adapter (all-zero factors, scale 0).
+
+    Residency reuses :class:`BlockAllocator` over page ids: acquire()
+    refs a resident page or allocates one (evicting the coldest unpinned
+    released page) and uploads from the registry; release() drops the ref
+    but RETAINS the page on the LRU so the next request for the same
+    adapter is a hit, not an upload. True ranks < max_rank upload into
+    zero-padded columns, which keeps the batched delta exact per adapter.
+    """
+
+    def __init__(self, model_cfg, cfg: LoRAConfig):
+        cfg.validate()
+        self.registry = cfg.registry
+        self.max_live_adapters = cfg.max_live_adapters
+        self.max_rank = cfg.max_rank
+        self.targets = tuple(cfg.targets)
+        self.num_layers = model_cfg.num_hidden_layers
+        self._dims = target_dims(model_cfg)
+        self.page_bytes = adapter_page_bytes(model_cfg, self.max_rank,
+                                             self.targets)
+        pages = self.max_live_adapters + 1
+        self.alloc = BlockAllocator(pages, 1, kv_quant="none",
+                                    bytes_per_block=self.page_bytes)
+        L, R = self.num_layers, self.max_rank
+        flat = []
+        for t in self.targets:
+            i, o = self._dims[t]
+            flat.append(jnp.zeros((pages, L, i, R), jnp.float32))
+            flat.append(jnp.zeros((pages, L, R, o), jnp.float32))
+        flat.append(jnp.zeros((pages,), jnp.float32))
+        self._flat = flat
+        self._resident: Dict[str, int] = {}    # name -> page (live or cached)
+        self._page_name: Dict[int, str] = {}
+        self._page_uid: Dict[int, int] = {}    # page -> registration uid
+        self._validated: Dict[str, int] = {}   # name -> validated uid
+        # stats
+        self.hits = 0
+        self.uploads = 0
+
+    # ------------------------------------------------------------- validation
+    def validate(self, name: str) -> Adapter:
+        """Submit-time feasibility gate: the adapter must exist, fit the
+        pool's rank budget, and its factors must match the model's
+        projection shapes. Raises ValueError with an actionable message."""
+        try:
+            ad = self.registry.get(name)
+        except KeyError:
+            raise ValueError(f"unknown adapter {name!r} — register it "
+                             f"before submit") from None
+        if self._validated.get(name) == ad.uid:
+            return ad
+        if ad.rank > self.max_rank:
+            raise ValueError(
+                f"adapter {name!r} rank {ad.rank} exceeds the pool's "
+                f"max_rank {self.max_rank} — it cannot fit an adapter page")
+        for (l, t), (a, b) in ad.weights.items():
+            if t not in self.targets:
+                raise ValueError(f"adapter {name!r} targets {t!r} which this "
+                                 f"pool does not serve ({self.targets})")
+            if l < 0 or l >= self.num_layers:
+                raise ValueError(f"adapter {name!r} addresses layer {l} of a "
+                                 f"{self.num_layers}-layer model")
+            i, o = self._dims[t]
+            if a.shape != (i, ad.rank) or b.shape != (ad.rank, o):
+                raise ValueError(
+                    f"adapter {name!r} ({l}, {t}): factor shapes "
+                    f"{a.shape}/{b.shape} do not match model dims "
+                    f"({i}, r)/(r, {o})")
+        self._validated[name] = ad.uid
+        return ad
+
+    # -------------------------------------------------------------- residency
+    def is_resident(self, name: str) -> bool:
+        return name in self._resident
+
+    def can_acquire(self, name: str) -> bool:
+        """Admission headroom check — True when acquire() cannot fail."""
+        try:
+            ad = self.registry.get(name)
+        except KeyError:
+            return False
+        page = self._resident.get(name)
+        if page is not None and self._page_uid.get(page) == ad.uid:
+            return True
+        return self.alloc.blocks_free + self.alloc.evictable_cached >= 1
+
+    def acquire(self, name: str) -> int:
+        """Take a ref on the adapter's device page, uploading (and possibly
+        evicting the coldest released adapter) on a miss. Returns the page
+        id the request's slot carries into the decode program."""
+        ad = self.validate(name)
+        page = self._resident.get(name)
+        if page is not None and self._page_uid.get(page) != ad.uid:
+            # re-registered under the same name: the cached page holds the
+            # OLD factors. Orphan it (normal LRU pressure reclaims it) and
+            # fall through to a fresh upload.
+            self._resident.pop(name, None)
+            self._page_name.pop(page, None)
+            self._page_uid.pop(page, None)
+            page = None
+        if page is not None:
+            self.alloc.ref(page)
+            self.hits += 1
+            return page
+        page = self.alloc.alloc()  # may raise: every page refed or pinned
+        old = self._page_name.pop(page, None)
+        if old is not None:
+            self._resident.pop(old, None)
+        self._page_uid.pop(page, None)
+        # a hash makes free() retain the page on the allocator's LRU, which
+        # is exactly the warm-adapter cache; uid-keyed so a re-registered
+        # name can never collide with its own stale page
+        self.alloc.register(page, hash(("adapter", name, ad.uid)))
+        self._upload(page, ad)
+        self.uploads += 1
+        self._resident[name] = page
+        self._page_name[page] = name
+        self._page_uid[page] = ad.uid
+        return page
+
+    def release(self, page: int) -> None:
+        """Drop one ref on a page (LRU-retained for warm reuse)."""
+        if page == NULL_PAGE:
+            return
+        self.alloc.free(page)
+
+    def pin(self, name: str) -> None:
+        self.alloc.pin(self._resident[name])
+
+    def unpin(self, name: str) -> None:
+        page = self._resident.get(name)
+        if page is not None:
+            self.alloc.unpin(page)
+
+    def warm(self, names: Iterable[str]) -> None:
+        """Replay queued-demand order (most urgent FIRST) into the page
+        LRU so eviction under pressure reclaims the adapter whose tenants
+        hold the least scheduler share last-to-first. This is how WFQ
+        shares govern adapter residency: the scheduler ranks waiting
+        adapters, the pool keeps that ranking warm."""
+        for name in reversed(list(names)):
+            page = self._resident.get(name)
+            if page is not None:
+                self.alloc.touch(page)
+
+    # ---------------------------------------------------------------- device
+    def device_tensors(self) -> List:
+        """The flat pool list for a compiled-program call."""
+        return list(self._flat)
+
+    def _upload(self, page: int, ad: Adapter) -> None:
+        """Write one adapter's rank-padded factors into ``page`` via
+        functional updates — pool shapes never change, so uploads are
+        eager device stores, not recompiles."""
+        L, R = self.num_layers, self.max_rank
+        for ti, t in enumerate(self.targets):
+            i, o = self._dims[t]
+            a_stack = np.zeros((L, i, R), np.float32)
+            b_stack = np.zeros((L, R, o), np.float32)
+            for (l, lt), (a, b) in ad.weights.items():
+                if lt != t:
+                    continue
+                a_stack[l, :, :ad.rank] = a
+                b_stack[l, :ad.rank, :] = b
+            self._flat[2 * ti] = self._flat[2 * ti].at[page].set(
+                jnp.asarray(a_stack))
+            self._flat[2 * ti + 1] = self._flat[2 * ti + 1].at[page].set(
+                jnp.asarray(b_stack))
+        self._flat[-1] = self._flat[-1].at[page].set(ad.scale)
+
+    def gather_rows(self, flat: Sequence, idx) -> List[Dict[str, Tuple]]:
+        """Inside a traced program: gather per-row factors for page index
+        vector ``idx`` (B,) int32. Returns a per-layer list of
+        {target: (A (B, in, R), B (B, R, out), scale (B,))} raw jnp —
+        the shape ``models/llama.py`` threads to ``nn.lora.bgmv``. The
+        static python loops unroll at trace time; nothing here branches
+        on adapter values."""
+        scale = flat[-1][idx]
+        out: List[Dict[str, Tuple]] = [dict() for _ in range(self.num_layers)]
+        for ti, t in enumerate(self.targets):
+            ag = flat[2 * ti][idx]       # (B, L, in, R)
+            bg = flat[2 * ti + 1][idx]   # (B, L, R, out)
+            for l in range(self.num_layers):
+                out[l][t] = (ag[:, l], bg[:, l], scale)
+        return out
+
+    # ----------------------------------------------------------------- stats
+    @property
+    def pool_bytes(self) -> int:
+        return self.page_bytes * (self.max_live_adapters + 1)
+
+    @property
+    def hit_rate(self) -> float:
+        n = self.hits + self.uploads
+        return self.hits / n if n else 0.0
+
+    def stats(self) -> Dict:
+        return {"adapter_pages": self.max_live_adapters,
+                "adapter_page_bytes": self.page_bytes,
+                "adapter_pool_bytes": self.pool_bytes,
+                "adapters_registered": len(self.registry),
+                "adapters_resident": len(self._resident),
+                "adapter_hits": self.hits,
+                "adapter_uploads": self.uploads,
+                "adapter_hit_rate": self.hit_rate,
+                "adapter_evictions": self.alloc.evictions,
+                "adapter_host_bytes": self.registry.host_bytes}

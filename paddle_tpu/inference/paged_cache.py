@@ -209,6 +209,14 @@ class BlockAllocator:
         paths can unpin unconditionally)."""
         self._pinned.discard(bid)
 
+    def touch(self, bid: int) -> None:
+        """Refresh a CACHED block's LRU position (most-recently-used) so
+        eviction reaches it last. Live or unknown blocks are a no-op —
+        callers use this to keep blocks with queued demand warm (the
+        adapter pool replays WFQ order through it) without taking a ref."""
+        if bid in self._lru:
+            self._lru.move_to_end(bid)
+
     def truncate(self, table: List[int], n_tokens: int) -> List[int]:
         """Refcount-safely release the tail of ``table`` so it covers only
         ``n_tokens`` positions — the speculative ROLLBACK primitive: blocks

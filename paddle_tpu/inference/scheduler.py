@@ -91,6 +91,7 @@ class SchedEntry:
     preempted: bool = False             # requeued after losing its slot
     started: bool = False               # was admitted at least once
     swap: Any = None                    # kv_offload.SwapHandle when swapped out
+    adapter: Optional[str] = None       # LoRA adapter name (None = base model)
 
 
 class Scheduler:
@@ -136,7 +137,8 @@ class Scheduler:
     # ------------------------------------------------------------------ intake
     def submit(self, req: Any, rid: int, *, priority: int = PRIORITY_NORMAL,
                tenant: str = "default", ttl_s: Optional[float] = None,
-               cost: float = 1.0) -> SchedEntry:
+               cost: float = 1.0,
+               adapter: Optional[str] = None) -> SchedEntry:
         """Admit one request to the queue; raises :class:`AdmissionError`
         when the queue is full (backpressure — shed, don't bury)."""
         if isinstance(priority, bool) or not isinstance(priority, int) \
@@ -157,7 +159,8 @@ class Scheduler:
         self._tenant_tag[tenant] = tag
         ent = SchedEntry(req=req, rid=rid, priority=priority, tenant=tenant,
                          deadline=(now + ttl) if ttl is not None else None,
-                         seq=self._seq, cost=float(cost), vtag=tag)
+                         seq=self._seq, cost=float(cost), vtag=tag,
+                         adapter=adapter)
         self._seq += 1
         self._q.append(ent)
         self.submitted += 1
@@ -227,3 +230,18 @@ class Scheduler:
     def waiting(self) -> List[SchedEntry]:
         """Current queue in pop order (for introspection/tests)."""
         return sorted(self._q, key=self._key)
+
+    def adapter_demand(self) -> List[str]:
+        """Distinct adapter names the queue wants, in pop-priority order —
+        the policy's view of adapter residency pressure. The server replays
+        this through ``AdapterPool.warm`` so that under WFQ the adapters of
+        high-share tenants stay most-recently-used in the pool's LRU and
+        evict last."""
+        out: List[str] = []
+        seen = set()
+        for ent in self.waiting():
+            a = ent.adapter
+            if a is not None and a not in seen:
+                seen.add(a)
+                out.append(a)
+        return out

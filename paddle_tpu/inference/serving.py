@@ -75,6 +75,7 @@ class _Request:
     generated: List[int] = field(default_factory=list)
     done: bool = False
     draft_k: Optional[int] = None                    # per-request spec budget
+    adapter: Optional[str] = None                    # LoRA adapter (None = base)
     sched: Any = None                                # its scheduler.SchedEntry
     # paged-path state
     table: List[int] = field(default_factory=list)   # block ids, in order
@@ -104,7 +105,8 @@ class GenerationServer:
                  kv_quant: str = "none",
                  pool_bytes: Optional[int] = None,
                  policy=None,
-                 host_pool_bytes: Optional[int] = None):
+                 host_pool_bytes: Optional[int] = None,
+                 lora=None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -148,7 +150,18 @@ class GenerationServer:
         ``host_pool_bytes`` (paged only): byte cap for the host KV pool
         that swap-preemption parks victim blocks in. None = unbounded
         (host DRAM dwarfs HBM); 0 disables swapping entirely — under
-        pressure victims then stall instead of parking."""
+        pressure victims then stall instead of parking.
+
+        ``lora=LoRAConfig(registry, ...)`` (paged only): multi-tenant LoRA
+        serving. Each request may name an adapter (``submit(adapter=...)``)
+        whose low-rank factors live in a paged device pool
+        (inference/lora.py) alongside the KV pool; the compiled
+        decode/prefill/verify programs gather each slot's factors by
+        adapter index and apply the delta in-program (BGMV), padded to the
+        config's static ``max_live_adapters``/``max_rank`` — so adapter
+        churn (register/evict/swap) causes zero steady-state recompiles.
+        Greedy output with adapter X is token-identical to the dense model
+        with X's weights merged in. See docs/serving.md."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -168,6 +181,10 @@ class GenerationServer:
         if host_pool_bytes is not None and cache != "paged":
             raise ValueError("host_pool_bytes= requires cache='paged' "
                              "(only the block pool can swap to host)")
+        if lora is not None and cache != "paged":
+            raise ValueError("lora= (multi-adapter serving) requires "
+                             "cache='paged' — the adapter pool shares the "
+                             "paged slot/eviction machinery")
         self.kv_quant = kv_quant
         self.spec = None
         if spec is not None:
@@ -227,6 +244,7 @@ class GenerationServer:
         self._stall_streak = 0
         self._idle_streak = 0
         self._next_rid = 0
+        self._lora = None
 
         if cache == "dense":
             self.buckets = sorted(b for b in prompt_buckets if b <= max_len)
@@ -302,6 +320,14 @@ class GenerationServer:
             self._offload = KVOffloadEngine(self.alloc, self._table_width,
                                             capacity_bytes=host_pool_bytes)
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
+            # per-slot adapter page index into the LoRA pool; 0 = the
+            # permanently-zero NULL page, so adapterless slots need no
+            # branching inside the compiled programs
+            self.aidx = np.zeros((max_batch,), np.int32)
+            if lora is not None:
+                from .lora import AdapterPool
+
+                self._lora = AdapterPool(cfg, lora)
             # device-side mirror of (temps, topks, topps[, kcaps]): these
             # change only when a slot activates/releases, but were being
             # re-uploaded every trip (~0.1ms eager dispatch each)
@@ -316,7 +342,7 @@ class GenerationServer:
             # At most two variants ever compile (greedy / mixed).
             self._decode_paged = jax.jit(self._decode_paged_fn,
                                          donate_argnums=(2,),
-                                         static_argnums=(10, 11))
+                                         static_argnums=(12, 13))
             self._chunk_prefill = jax.jit(self._chunk_prefill_fn,
                                           donate_argnums=(2,))
             if self.spec is not None:
@@ -352,11 +378,11 @@ class GenerationServer:
                 if self._spec_fused:
                     self._spec_scan = jax.jit(self._spec_scan_fn,
                                               donate_argnums=(2,),
-                                              static_argnums=(11, 12))
+                                              static_argnums=(13, 14))
                 else:
                     self._spec_verify = jax.jit(self._spec_verify_fn,
                                                 donate_argnums=(3,),
-                                                static_argnums=(12,))
+                                                static_argnums=(14,))
 
     # ------------------------------------------------------------ compiled fns
     def _pool_views(self, flat_p):
@@ -371,6 +397,22 @@ class GenerationServer:
     @staticmethod
     def _flat_pools(new):
         return [t.value for entry in new for t in entry]
+
+    def _gather_lora(self, lora_flat, aidx):
+        """Gather each row's adapter factors from the paged LoRA pool —
+        one batched take per stacked tensor, inside the compiled program.
+        ``lora_flat`` is empty when LoRA is off → None (the model's paged
+        methods skip the delta entirely)."""
+        if not lora_flat:
+            return None
+        return self._lora.gather_rows(list(lora_flat), aidx)
+
+    def _lora_flat(self):
+        """Current adapter-pool tensors for a compiled-program call — ()
+        when LoRA is off (the programs then skip the gather entirely).
+        Host-side: the pool list changes identity on adapter upload but
+        never shape, so churn re-runs nothing."""
+        return self._lora.device_tensors() if self._lora is not None else ()
 
     def _head(self, h):
         from ..framework.dispatch import apply_op
@@ -420,8 +462,8 @@ class GenerationServer:
         return stack, flat
 
     def _decode_paged_fn(self, params, tokens, flat_pools, tables, pos,
-                         temps, topks, topps, active, key, greedy=False,
-                         ticks=None):
+                         temps, topks, topps, active, key, aidx=None,
+                         lora_flat=(), greedy=False, ticks=None):
         """Paged twin of :meth:`_decode_fn`: K/V reads/writes go through
         per-slot block tables into the shared pool. ``tables``: int32
         (B, table_width) — the server zeroes rows of idle/prefilling slots
@@ -429,8 +471,12 @@ class GenerationServer:
         STATIC (jit cache key): True promises every active row has temp 0
         and compiles sampling down to argmax. ``ticks`` (STATIC) overrides
         ``tick_window`` — the speculative server's gated plain trips run
-        longer windows than its verify trips (SpecConfig.gate_ticks)."""
+        longer windows than its verify trips (SpecConfig.gate_ticks).
+        ``aidx``/``lora_flat``: per-slot adapter page indices + the LoRA
+        pool's stacked factor tensors — gathered ONCE per trip (rows are
+        loop-invariant across ticks) and applied in-program (BGMV)."""
         model = self.model
+        lora = self._gather_lora(lora_flat, aidx)
 
         def one_tick(carry, k):
             toks, flat_p, p = carry
@@ -438,7 +484,8 @@ class GenerationServer:
 
             def call():
                 h, new = model.model.paged_decode_step(Tensor(toks[:, None]),
-                                                       pools, tables, p)
+                                                       pools, tables, p,
+                                                       lora=lora)
                 return self._head(h), new
 
             logits, new = functional_call(model, params, call_fn=call)
@@ -462,18 +509,22 @@ class GenerationServer:
         return stack, flat
 
     def _chunk_prefill_fn(self, params, chunk, flat_pools, table, start,
-                          last_idx):
+                          last_idx, aidx=None, lora_flat=()):
         """ONE compiled program for every prefill chunk of every prompt
         length: chunk (1, C) right-padded; K/V scatter into the slot's
         block table at block-aligned ``start``; returns fp32 logits at
         local index ``last_idx`` (the last real prompt token on the final
-        chunk; ignored on earlier chunks) + updated pools."""
+        chunk; ignored on earlier chunks) + updated pools. ``aidx`` is the
+        prefilling slot's adapter page index, shape (1,) — prompt tokens
+        must see the same adapter delta the decode ticks will."""
         model = self.model
         pools = self._pool_views(flat_pools)
+        lora = self._gather_lora(lora_flat, aidx)
 
         def call():
             h, new = model.model.paged_prefill_chunk(Tensor(chunk), pools,
-                                                     table, start)
+                                                     table, start,
+                                                     lora=lora)
             last = jax.lax.dynamic_slice_in_dim(h.value, last_idx, 1, 1)
             return self._head(Tensor(last)), new
 
@@ -482,7 +533,7 @@ class GenerationServer:
 
     def _spec_verify_fn(self, params, tokens, proposals, flat_pools, tables,
                         pos, temps, topks, topps, kcaps, key, qprobs,
-                        greedy=False):
+                        aidx=None, lora_flat=(), greedy=False):
         """ONE fused speculative tick: target-score the whole window
         [current token, k drafts] through the paged verify path, then run
         exact accept/reject — all on device, so the host sees only the
@@ -493,11 +544,12 @@ class GenerationServer:
         masks idle slots at kcap 0) without changing compiled shapes."""
         model = self.model
         pools = self._pool_views(flat_pools)
+        lora = self._gather_lora(lora_flat, aidx)
         window = jnp.concatenate([tokens[:, None], proposals], axis=1)
 
         def call():
             h, new = model.model.paged_verify_step(Tensor(window), pools,
-                                                   tables, pos)
+                                                   tables, pos, lora=lora)
             return self._head(h), new
 
         logits, new = functional_call(model, params, call_fn=call)
@@ -510,8 +562,8 @@ class GenerationServer:
         return out, acc, flat
 
     def _spec_scan_fn(self, params, ctx, flat_pools, tables, pos, temps,
-                      topks, topps, kcaps, active, key, greedy=False,
-                      windows=None):
+                      topks, topps, kcaps, active, key, aidx=None,
+                      lora_flat=(), greedy=False, windows=None):
         """``tick_window`` speculative windows as ONE compiled program —
         the drafter runs IN-PROGRAM (``drafter.propose_device``, e.g. the
         jnp prompt-lookup matcher), so draft → multi-token verify → exact
@@ -531,6 +583,7 @@ class GenerationServer:
         B, L = ctx.shape
         S = self._spec_windows if windows is None else windows
         rows = jnp.arange(B)
+        lora = self._gather_lora(lora_flat, aidx)
         from .speculative import speculative_accept
 
         def one_window(carry, w):
@@ -542,7 +595,8 @@ class GenerationServer:
 
             def call():
                 h, new = model.model.paged_verify_step(Tensor(window),
-                                                       pools, tables, p)
+                                                       pools, tables, p,
+                                                       lora=lora)
                 return self._head(h), new
 
             logits, new = functional_call(model, params, call_fn=call)
@@ -622,12 +676,16 @@ class GenerationServer:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, draft_k: Optional[int] = None,
                priority: int = PRIORITY_NORMAL, tenant: str = "default",
-               ttl_s: Optional[float] = None) -> int:
+               ttl_s: Optional[float] = None,
+               adapter: Optional[str] = None) -> int:
         """Queue one request; returns its rid. ``priority`` (lower = more
         urgent), ``tenant`` (WFQ fairness bucket), and ``ttl_s`` (max
         queue wait before the request expires unstarted) feed the
         scheduler; raises :class:`~.scheduler.AdmissionError` when a
-        bounded queue is full (backpressure)."""
+        bounded queue is full (backpressure). ``adapter`` names a
+        registered LoRA adapter (requires ``lora=``) — unknown names,
+        ranks past the pool's ``max_rank``, and shape-incompatible
+        adapters are rejected HERE, not at admission time."""
         prompt = list(prompt)
         if not prompt:
             raise ValueError("prompt must contain at least one token id")
@@ -670,6 +728,15 @@ class GenerationServer:
         if not isinstance(tenant, str) or not tenant:
             raise ValueError(
                 f"tenant must be a non-empty string, got {tenant!r}")
+        if adapter is not None:
+            if self._lora is None:
+                raise ValueError(
+                    "adapter= requires a server built with "
+                    "lora=LoRAConfig(...) on the paged path")
+            # full ladder: registered? rank <= max_rank? targets/layers/
+            # shapes match the pool layout? — fail at the door, not after
+            # the request has queued behind a day of traffic
+            self._lora.validate(adapter)
         if self.cache_mode == "dense":
             self._bucket_for(len(prompt))  # validate against buckets up front
         else:
@@ -697,12 +764,13 @@ class GenerationServer:
         req = _Request(rid, prompt, int(max_new_tokens),
                        temperature=float(temperature),
                        top_k=int(top_k), top_p=float(top_p),
-                       draft_k=draft_k)
+                       draft_k=draft_k, adapter=adapter)
         # cost = estimated total tokens: the WFQ charge a tenant pays
         req.sched = self._sched.submit(
             req, rid, priority=priority, tenant=tenant, ttl_s=ttl_s,
-            cost=float(len(prompt) + max_new_tokens))
-        self._req_metrics[rid] = {"submit_t": self._wall()}
+            cost=float(len(prompt) + max_new_tokens), adapter=adapter)
+        self._req_metrics[rid] = {"submit_t": self._wall(),
+                                  "tenant": tenant}
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -744,14 +812,16 @@ class GenerationServer:
             m.setdefault("first_token_t", self._wall())
 
     def _samp_arrays(self):
-        """Device copies of the per-slot sampling params (+ draft caps),
-        re-uploaded only after a slot transition."""
+        """Device copies of the per-slot sampling params (+ draft caps and
+        adapter page indices), re-uploaded only after a slot transition."""
         if self._samp_dev is None:
             kc = (jnp.asarray(self.kcaps) if self.spec is not None
                   else None)
+            ai = (jnp.asarray(self.aidx) if self._lora is not None
+                  else None)
             self._samp_dev = (jnp.asarray(self.temps),
                               jnp.asarray(self.topks),
-                              jnp.asarray(self.topps), kc)
+                              jnp.asarray(self.topps), kc, ai)
         return self._samp_dev
 
     def _assign(self, slot: int, req: _Request) -> None:
@@ -803,6 +873,12 @@ class GenerationServer:
         step bounds preemption churn)."""
         for ent in self._sched.expire():
             self._drop_entry(ent, "expired")
+        if self._lora is not None:
+            # replay the queue's adapter demand (pop-priority order)
+            # through the pool's LRU: high-share tenants' adapters become
+            # most-recently-used and so evict LAST — WFQ shares govern
+            # adapter residency, not just slot admission
+            self._lora.warm(self._sched.adapter_demand())
         self._fill_free_slots()
         if self.cache_mode != "paged":
             return
@@ -827,7 +903,13 @@ class GenerationServer:
     def _admit_paged(self, slot: int, req: _Request) -> None:
         """Claim a slot: reuse cached prefix blocks (prefix caching — the
         matched span skips prefill entirely) and start chunked prefill at
-        the first uncached block boundary."""
+        the first uncached block boundary. A request with an adapter
+        acquires its pool page here (upload on miss, warm revival on hit)
+        and holds the ref until the slot releases or is preempted."""
+        if self._lora is not None:
+            self.aidx[slot] = (self._lora.acquire(req.adapter)
+                               if req.adapter is not None else 0)
+            self._samp_dev = None
         req.table = self.alloc.match_prefix(req.prompt)
         req.hashes = self.alloc.chain_hashes(req.prompt)
         req.pf_next = len(req.table) * self.block_size
@@ -854,6 +936,11 @@ class GenerationServer:
         so a long prompt can't thrash in and straight back out mid-
         prefill; parked block count for a swapped one) PLUS one spare
         block must be reclaimable right now."""
+        if self._lora is not None and ent.req.adapter is not None \
+                and not self._lora.can_acquire(ent.req.adapter):
+            # every adapter page is held by a running slot: admitting
+            # would fail the acquire — wait for a slot to release/preempt
+            return False
         if ent.swap is not None:
             need = self._offload.restore_cost(ent.swap)
         else:
@@ -876,6 +963,12 @@ class GenerationServer:
         res = self._offload.swap_in(ent.swap, self._pools)
         if res is None:
             return False
+        if self._lora is not None:
+            # re-acquire AFTER the KV restore committed: _admissible
+            # already vouched for can_acquire, and acquiring first would
+            # leak the adapter ref if swap_in failed
+            self.aidx[slot] = (self._lora.acquire(req.adapter)
+                               if req.adapter is not None else 0)
         handle, ent.swap = ent.swap, None
         req.table, self._pools = res
         self._bt[slot, :] = 0
@@ -954,6 +1047,12 @@ class GenerationServer:
         self.topps[s] = 0.0
         if self.spec is not None:
             self.kcaps[s] = 0
+        if self._lora is not None:
+            # drop the victim's adapter ref: the page goes CACHED (LRU),
+            # so a quick resume revives it without re-upload while a
+            # different adapter under pressure may claim the page
+            self._lora.release(int(self.aidx[s]))
+            self.aidx[s] = 0
         self._samp_dev = None
         self._sched.requeue(ent)
         return True
@@ -1021,10 +1120,12 @@ class GenerationServer:
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :end - start] = req.prompt[start:end]
         last_idx = (n - 1 - start) if end == n else 0
+        aidx = (jnp.asarray(self.aidx[slot:slot + 1])
+                if self._lora is not None else None)
         lg, self._pools = self._chunk_prefill(
             self.params, jnp.asarray(chunk), self._pools,
             jnp.asarray(self._bt[slot]), jnp.int32(start),
-            jnp.int32(last_idx))
+            jnp.int32(last_idx), aidx, self._lora_flat())
         # publish the prompt blocks this chunk completed for prefix reuse
         for i in range(start // bs, end // bs):
             self.alloc.register(req.table[i], req.hashes[i])
@@ -1100,11 +1201,12 @@ class GenerationServer:
         # their (discarded) cache writes to the scratch block
         bt = np.where(active_mask[:, None] > 0, self._bt, 0)
         posv = self.pos * active_mask
-        temps, topks, topps, _ = self._samp_arrays()
+        temps, topks, topps, _, aidx = self._samp_arrays()
         stack, self._pools = self._decode_paged(
             self.params, jnp.asarray(self.tokens), self._pools,
             jnp.asarray(bt), jnp.asarray(posv), temps, topks, topps,
-            jnp.asarray(active_mask), key, self._all_greedy(active), ticks)
+            jnp.asarray(active_mask), key, aidx, self._lora_flat(),
+            self._all_greedy(active), ticks)
         self._harvest_window(np.asarray(stack), active, active_mask)
 
     # ----------------------------------------------------------- speculative
@@ -1135,7 +1237,7 @@ class GenerationServer:
         # nonzero kcaps exist only on activated, unreleased slots — exactly
         # the active set — so the cached device kcaps already carries the
         # idle/prefilling row masking
-        temps, topks, topps, kcaps = self._samp_arrays()
+        temps, topks, topps, kcaps, aidx = self._samp_arrays()
         if self._spec_fused:
             ctx = np.zeros((self.max_batch, self.max_len), np.int32)
             for s in active:
@@ -1145,8 +1247,8 @@ class GenerationServer:
             outs, accs, self._pools = self._spec_scan(
                 self.params, jnp.asarray(ctx), self._pools,
                 jnp.asarray(bt), jnp.asarray(posv), temps, topks, topps,
-                kcaps, jnp.asarray(active_mask), key,
-                self._all_greedy(active), S)
+                kcaps, jnp.asarray(active_mask), key, aidx,
+                self._lora_flat(), self._all_greedy(active), S)
         else:
             contexts: List[Optional[List[int]]] = [None] * self.max_batch
             for s in active:
@@ -1161,7 +1263,7 @@ class GenerationServer:
                 jnp.asarray(posv), temps, topks, topps,
                 kcaps, jax.random.fold_in(key, 2),
                 None if qprobs is None else jnp.asarray(qprobs),
-                self._all_greedy(active))
+                aidx, self._lora_flat(), self._all_greedy(active))
             outs, accs = np.asarray(out)[None], np.asarray(acc)[None]
         accs = np.asarray(accs)
         self._harvest_spec(np.asarray(outs), accs, active)
@@ -1329,7 +1431,8 @@ class GenerationServer:
 
     def sched_metrics(self) -> Dict[str, Any]:
         """Scheduler + preemption counters (all cache modes; swap fields
-        appear on the paged path only)."""
+        appear on the paged path only; adapter-pool fields and the
+        per-tenant TTFT/TPOT breakdown when ``lora=`` is configured)."""
         m = {"policy": self._sched.policy,
              "queue_depth": len(self._sched),
              "submitted": self._sched.submitted,
@@ -1345,12 +1448,43 @@ class GenerationServer:
             m["host_bytes_peak"] = self._offload.host.bytes_peak
             m["swapped_waiting"] = sum(
                 1 for e in self._sched.waiting() if e.swap is not None)
+        m["tenants"] = self._tenant_breakdown()
+        if self._lora is not None:
+            m.update(self._lora.stats())
         return m
+
+    def _tenant_breakdown(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant latency percentiles over COMPLETED requests: TTFT
+        (submit → first token) and TPOT (per-token after the first) p50 /
+        p95 — the multi-tenant fairness view the benchmark reports."""
+        buckets: Dict[str, Dict[str, List[float]]] = {}
+        for rm in self._req_metrics.values():
+            t = rm.get("tenant")
+            if t is None or "done_t" not in rm or "first_token_t" not in rm:
+                continue
+            b = buckets.setdefault(t, {"ttft": [], "tpot": []})
+            b["ttft"].append(rm["first_token_t"] - rm["submit_t"])
+            n = int(rm.get("n_generated", 0))
+            if n > 1:
+                b["tpot"].append(
+                    (rm["done_t"] - rm["first_token_t"]) / (n - 1))
+        out: Dict[str, Dict[str, float]] = {}
+        for t, b in buckets.items():
+            row = {"completed": float(len(b["ttft"]))}
+            for name, xs in b.items():
+                if xs:
+                    row[f"{name}_p50_ms"] = float(
+                        np.percentile(xs, 50) * 1e3)
+                    row[f"{name}_p95_ms"] = float(
+                        np.percentile(xs, 95) * 1e3)
+            out[t] = row
+        return out
 
     def request_metrics(self) -> Dict[int, Dict[str, float]]:
         """Per-rid wall-clock marks — ``submit_t``, ``first_token_t``,
-        ``done_t``, ``n_generated`` — from which TTFT and per-token
-        latency are derived (tools/serving_benchmark.py)."""
+        ``done_t``, ``n_generated`` (plus the request's ``tenant``) —
+        from which TTFT and per-token latency are derived
+        (tools/serving_benchmark.py)."""
         return self._req_metrics
 
     def _release_slot(self, slot: int) -> None:
@@ -1369,6 +1503,9 @@ class GenerationServer:
             self.topps[slot] = 0.0
             if self.spec is not None:
                 self.kcaps[slot] = 0
+            if self._lora is not None:
+                self._lora.release(int(self.aidx[slot]))
+                self.aidx[slot] = 0
             self._samp_dev = None
 
     def kv_stats(self) -> Dict[str, int]:

@@ -32,6 +32,16 @@ class GPTConfig:
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-5
     dtype: str = "float32"
+    # LoRA flag: rank > 0 wraps the block projections (nn/lora.py) at
+    # construction — GPT's adapters ride the training path + merge_lora
+    # export; the pooled multi-adapter serving path is Llama's
+    lora_rank: int = 0
+    lora_alpha: float = None
+    lora_targets: tuple = None  # default: GPT_LORA_TARGETS
+
+
+# fused qkv + attn out + both MLP Linears — every projection in a GPTBlock
+GPT_LORA_TARGETS = ("c_attn", "c_proj", "c_fc", "c_out")
 
 
 def gpt2_small_config(**kw):
@@ -186,6 +196,23 @@ class GPTForCausalLM(Layer, GenerationMixin):
         super().__init__()
         self.cfg = cfg
         self.transformer = GPTModel(cfg)
+        if cfg.lora_rank:
+            self.attach_lora(cfg.lora_rank, alpha=cfg.lora_alpha,
+                             targets=cfg.lora_targets)
+
+    def attach_lora(self, rank, alpha=None, targets=None):
+        """Wrap the block projections with trainable LoRA factors; the
+        fused c_attn wrap adapts q/k/v through one (h, 3h) residual."""
+        from ..nn.lora import attach_lora
+
+        return attach_lora(self, rank, alpha=alpha,
+                           targets=targets or GPT_LORA_TARGETS)
+
+    def merge_lora(self, targets=None):
+        """Fold adapter deltas back into the base weights (dense export)."""
+        from ..nn.lora import merge_lora
+
+        return merge_lora(self, targets=targets or GPT_LORA_TARGETS)
 
     def forward(self, input_ids):
         h = self.transformer(input_ids)

@@ -79,6 +79,18 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_every: int = 1  # every Nth decoder layer gets the MoE FFN
     moe_aux_coeff: float = 0.01
+    # training-side LoRA flag: rank > 0 wraps the projection Linears with
+    # trainable A/B factors at construction (nn/lora.py). The SERVING
+    # multi-adapter path is orthogonal — it threads pooled factors through
+    # the paged programs per request and wants a CLEAN base model.
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
+    lora_targets: Optional[tuple] = None  # default: all seven projections
+
+
+# attribute names attach_lora/merge_lora wrap when cfg.lora_targets is None
+LLAMA_LORA_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                      "gate_proj", "up_proj", "down_proj")
 
 
 def llama3_8b_config(**kw) -> LlamaConfig:
@@ -329,11 +341,15 @@ class LlamaAttention(Layer):
         self.v_proj.weight.pspec = P(None, "tensor")
         self.o_proj.weight.pspec = P("tensor", None)
 
-    def _qkv(self, x, B, S):
+    def _qkv(self, x, B, S, lora=None):
         """q/k/v projections. The int8 decode path can fuse the three into
         ONE concatenated matmul (quantize_int8 with PT_W8_FUSED_QKV=1 —
         single weight stream + kernel launch per step; see the measured
-        A/B in BASELINE.md round 4)."""
+        A/B in BASELINE.md round 4). ``lora``: per-layer dict of gathered
+        per-row (A, B, scale) factors keyed "q"/"k"/"v" (serving
+        multi-adapter path) — the delta is additive AFTER the base
+        projection, so it composes with both the fp and fused-int8
+        branches."""
         if getattr(self, "_w8_split", None):
             from ..ops.int8 import w8_matmul
 
@@ -348,9 +364,27 @@ class LlamaAttention(Layer):
                                op_name="w8_qkv")
         else:
             q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        if lora is not None:
+            from ..nn.lora import bgmv
+
+            if "q" in lora:
+                q = q + bgmv(x, lora["q"])
+            if "k" in lora:
+                k = k + bgmv(x, lora["k"])
+            if "v" in lora:
+                v = v + bgmv(x, lora["v"])
         return (reshape(q, [B, S, self.num_heads, self.head_dim]),
                 reshape(k, [B, S, self.num_kv_heads, self.head_dim]),
                 reshape(v, [B, S, self.num_kv_heads, self.head_dim]))
+
+    def _o_lora(self, out, lora):
+        """Output projection plus the gathered per-row "o" delta."""
+        proj = self.o_proj(out)
+        if lora is not None and "o" in lora:
+            from ..nn.lora import bgmv
+
+            proj = proj + bgmv(out, lora["o"])
+        return proj
 
     def forward(self, x, cos, sin, cache=None, pos_offset=0):
         B, S = x.shape[0], x.shape[1]
@@ -516,7 +550,8 @@ class LlamaAttention(Layer):
         out = reshape(out, [B, 1, H * D])
         return self.o_proj(out), ck, cv
 
-    def paged_decode(self, x, cos, sin, pool, block_tables, pos):
+    def paged_decode(self, x, cos, sin, pool, block_tables, pos,
+                     lora=None):
         """Single-token decode against the PAGED pool: K/V of the new token
         scatter through the block table at ``pos``; attention gathers
         context by table (ops/paged_attention.py). ``pool``: per-layer
@@ -529,7 +564,7 @@ class LlamaAttention(Layer):
         greedy outputs agree token-exactly."""
         B = x.shape[0]
         H, D = self.num_heads, self.head_dim
-        q, k, v = self._qkv(x, B, 1)
+        q, k, v = self._qkv(x, B, 1, lora=lora)
 
         if len(pool) == 4:
             def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
@@ -558,9 +593,10 @@ class LlamaAttention(Layer):
         out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
                               op_name="paged_decode_attention")
         out = reshape(out, [B, 1, H * D])
-        return self.o_proj(out), tuple(pool)
+        return self._o_lora(out, lora), tuple(pool)
 
-    def paged_verify_attn(self, x, cos, sin, pool, block_tables, pos):
+    def paged_verify_attn(self, x, cos, sin, pool, block_tables, pos,
+                          lora=None):
         """Multi-token speculative VERIFY window against the paged pool:
         K/V for all W = k+1 window tokens scatter through the block table
         at ``pos..pos+k``; attention gathers context by table with the
@@ -571,7 +607,7 @@ class LlamaAttention(Layer):
         speculative output token-exact vs the dense server."""
         B, W = x.shape[0], x.shape[1]
         H, D = self.num_heads, self.head_dim
-        q, k, v = self._qkv(x, B, W)
+        q, k, v = self._qkv(x, B, W, lora=lora)
 
         if len(pool) == 4:
             def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
@@ -600,9 +636,10 @@ class LlamaAttention(Layer):
         out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
                               op_name="paged_verify_attention")
         out = reshape(out, [B, W, H * D])
-        return self.o_proj(out), tuple(pool)
+        return self._o_lora(out, lora), tuple(pool)
 
-    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start):
+    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start,
+                            lora=None):
         """One fixed-size prefill CHUNK through the paged pool: queries sit
         at positions ``start + arange(C)`` (``start`` traced, block-aligned,
         C a multiple of the block size), their K/V scatter into consecutive
@@ -612,7 +649,7 @@ class LlamaAttention(Layer):
         block_table: traced int32 (M,)."""
         B, S = x.shape[0], x.shape[1]
         H, D = self.num_heads, self.head_dim
-        q, k, v = self._qkv(x, B, S)
+        q, k, v = self._qkv(x, B, S, lora=lora)
 
         if len(pool) == 4:
             def step(qv, kv, vv, kqv, ksv, vqv, vsv, cosv, sinv):
@@ -641,7 +678,7 @@ class LlamaAttention(Layer):
         out, *pool = apply_op(step, q, k, v, *pool, Tensor(cos), Tensor(sin),
                               op_name="paged_prefill_attention")
         out = reshape(out, [B, S, H * D])
-        return self.o_proj(out), tuple(pool)
+        return self._o_lora(out, lora), tuple(pool)
 
 
 class LlamaMLP(Layer):
@@ -660,10 +697,15 @@ class LlamaMLP(Layer):
         self._sp = cfg.sequence_parallel
         self._cp = cfg.context_parallel
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
         from ..nn.quant import Int8Linear
 
         if isinstance(self.gate_proj, Int8Linear):  # weight-only decode mode
+            if lora is not None:
+                raise NotImplementedError(
+                    "pooled LoRA deltas on a weight-only int8 MLP are not "
+                    "supported — serve LoRA over fp base weights (int8 KV "
+                    "quant is fine)")
             from ..ops.int8 import w8_matmul
 
             def mlp8(v, wgq, sg, wuq, su, wdq, sd):
@@ -675,6 +717,34 @@ class LlamaMLP(Layer):
                            self.up_proj.weight_q, self.up_proj.weight_scale,
                            self.down_proj.weight_q, self.down_proj.weight_scale,
                            op_name="w8_mlp")
+        elif lora is not None and any(k in lora for k in ("gate", "up", "down")):
+            # decomposed SwiGLU so the gathered per-row deltas land on the
+            # same activations the fused lambda would see — XLA re-fuses the
+            # chain inside the jitted serving program
+            from ..nn.lora import bgmv
+
+            g = apply_op(lambda v, w: jnp.matmul(v, w), x,
+                         self.gate_proj.weight, op_name="linear")
+            if "gate" in lora:
+                g = g + bgmv(x, lora["gate"])
+            u = apply_op(lambda v, w: jnp.matmul(v, w), x,
+                         self.up_proj.weight, op_name="linear")
+            if "up" in lora:
+                u = u + bgmv(x, lora["up"])
+            h = apply_op(lambda a, b: jax.nn.silu(a) * b, g, u,
+                         op_name="swiglu")
+            out = apply_op(
+                lambda v, w: checkpoint_name(jnp.matmul(v, w), "mlp_out"),
+                h, self.down_proj.weight, op_name="linear")
+            if "down" in lora:
+                out = out + bgmv(h, lora["down"])
+        elif not isinstance(self.gate_proj, Linear):
+            # training-side LoRALinear wrap (attach_lora): go through the
+            # layer calls so each projection applies its own A/B residual
+            h = apply_op(lambda a, b: jax.nn.silu(a) * b,
+                         self.gate_proj(x), self.up_proj(x), op_name="swiglu")
+            out = apply_op(lambda v: checkpoint_name(v, "mlp_out"),
+                           self.down_proj(h), op_name="mlp_out")
         else:
             def mlp(v, wg, wu, wd):
                 out = jnp.matmul(jax.nn.silu(jnp.matmul(v, wg)) * jnp.matmul(v, wu), wd)
@@ -711,7 +781,10 @@ class LlamaMoEMLP(Layer):
     def aux_loss(self):
         return self.moe.gate.loss
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
+        if lora is not None:
+            raise NotImplementedError(
+                "pooled LoRA deltas are not supported on MoE FFN layers")
         out = self.moe(x)
         out = apply_op(lambda v: checkpoint_name(v, "mlp_out"), out,
                        op_name="moe_out")
@@ -752,25 +825,29 @@ class LlamaDecoderLayer(Layer):
         out = h + self.mlp(self.post_attention_layernorm(h))
         return out, ck, cv
 
-    def paged_decode(self, x, cos, sin, pool, block_tables, pos):
+    def paged_decode(self, x, cos, sin, pool, block_tables, pos, lora=None):
         a, pool = self.self_attn.paged_decode(self.input_layernorm(x), cos,
-                                              sin, pool, block_tables, pos)
+                                              sin, pool, block_tables, pos,
+                                              lora=lora)
         h = x + a
-        out = h + self.mlp(self.post_attention_layernorm(h))
+        out = h + self.mlp(self.post_attention_layernorm(h), lora=lora)
         return out, pool
 
-    def paged_verify(self, x, cos, sin, pool, block_tables, pos):
+    def paged_verify(self, x, cos, sin, pool, block_tables, pos, lora=None):
         a, pool = self.self_attn.paged_verify_attn(
-            self.input_layernorm(x), cos, sin, pool, block_tables, pos)
+            self.input_layernorm(x), cos, sin, pool, block_tables, pos,
+            lora=lora)
         h = x + a
-        out = h + self.mlp(self.post_attention_layernorm(h))
+        out = h + self.mlp(self.post_attention_layernorm(h), lora=lora)
         return out, pool
 
-    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start):
+    def paged_prefill_chunk(self, x, cos, sin, pool, block_table, start,
+                            lora=None):
         a, pool = self.self_attn.paged_prefill_chunk(
-            self.input_layernorm(x), cos, sin, pool, block_table, start)
+            self.input_layernorm(x), cos, sin, pool, block_table, start,
+            lora=lora)
         h = x + a
-        out = h + self.mlp(self.post_attention_layernorm(h))
+        out = h + self.mlp(self.post_attention_layernorm(h), lora=lora)
         return out, pool
 
 
@@ -840,22 +917,26 @@ class LlamaModel(Layer):
             new.append((ck, cv))
         return self.norm(x), new
 
-    def paged_decode_step(self, token, pools, block_tables, pos):
+    def paged_decode_step(self, token, pools, block_tables, pos, lora=None):
         """Paged continuous-batching decode: like :meth:`decode_step` but
         K/V read/write goes through per-row block tables into the shared
         block pool. token: Tensor (B, 1); pools: list of per-layer pool
         tuples — ``(kp, vp)`` Tensors (num_blocks, bs, KV, D), or
         ``(kq, ks, vq, vs)`` for the int8 pool (kv_quant="int8");
-        block_tables: traced int32 (B, M); pos: traced int32 [B]."""
+        block_tables: traced int32 (B, M); pos: traced int32 [B]; ``lora``:
+        None or a per-layer list of gathered per-row adapter factors
+        (``inference.lora.AdapterPool.gather_rows``) — all static shapes,
+        so the multi-adapter program is the single-adapter program."""
         x = self.embed_tokens(token)
         new = []
-        for layer, pool in zip(self.layers, pools):
+        for i, (layer, pool) in enumerate(zip(self.layers, pools)):
             x, pool = layer.paged_decode(x, self._cos, self._sin, pool,
-                                         block_tables, pos)
+                                         block_tables, pos,
+                                         lora=None if lora is None else lora[i])
             new.append(pool)
         return self.norm(x), new
 
-    def paged_verify_step(self, tokens, pools, block_tables, pos):
+    def paged_verify_step(self, tokens, pools, block_tables, pos, lora=None):
         """Speculative verify: score a WINDOW of W = k+1 tokens per row in
         one program — :meth:`paged_decode_step` generalized from 1 to W
         positions (W = 1 is plain decode). tokens: Tensor (B, W) = current
@@ -866,13 +947,15 @@ class LlamaModel(Layer):
         runs rejection sampling."""
         x = self.embed_tokens(tokens)
         new = []
-        for layer, pool in zip(self.layers, pools):
+        for i, (layer, pool) in enumerate(zip(self.layers, pools)):
             x, pool = layer.paged_verify(x, self._cos, self._sin, pool,
-                                         block_tables, pos)
+                                         block_tables, pos,
+                                         lora=None if lora is None else lora[i])
             new.append(pool)
         return self.norm(x), new
 
-    def paged_prefill_chunk(self, input_ids, pools, block_table, start):
+    def paged_prefill_chunk(self, input_ids, pools, block_table, start,
+                            lora=None):
         """Stream ONE prompt chunk into the paged pool (chunked prefill:
         the same compiled program serves every chunk of every prompt
         length — no per-bucket compile family). input_ids: Tensor (1, C);
@@ -880,9 +963,10 @@ class LlamaModel(Layer):
         hidden for the chunk, new pools)."""
         x = self.embed_tokens(input_ids)
         new = []
-        for layer, pool in zip(self.layers, pools):
-            x, pool = layer.paged_prefill_chunk(x, self._cos, self._sin,
-                                                pool, block_table, start)
+        for i, (layer, pool) in enumerate(zip(self.layers, pools)):
+            x, pool = layer.paged_prefill_chunk(
+                x, self._cos, self._sin, pool, block_table, start,
+                lora=None if lora is None else lora[i])
             new.append(pool)
         return self.norm(x), new
 
@@ -906,6 +990,26 @@ class LlamaForCausalLM(Layer):
                 from ..framework.dtype import convert_dtype
 
                 self.lm_head._convert_dtype(convert_dtype(cfg.dtype))
+        if cfg.lora_rank:
+            self.attach_lora(cfg.lora_rank, alpha=cfg.lora_alpha,
+                             targets=cfg.lora_targets)
+
+    def attach_lora(self, rank, alpha=None, targets=None):
+        """Wrap the projection Linears with trainable LoRA factors
+        (nn/lora.py); ``targets`` defaults to all of
+        :data:`LLAMA_LORA_TARGETS`. Base weights freeze; only A/B train."""
+        from ..nn.lora import attach_lora
+
+        return attach_lora(self, rank, alpha=alpha,
+                           targets=targets or LLAMA_LORA_TARGETS)
+
+    def merge_lora(self, targets=None):
+        """Fold trained adapter deltas into the base weights and restore
+        plain Linears — the dense-equivalent export the serving exactness
+        tests compare against."""
+        from ..nn.lora import merge_lora
+
+        return merge_lora(self, targets=targets or LLAMA_LORA_TARGETS)
 
     def _moe_aux(self):
         """Sum of the MoE gates' load-balance losses from the last forward

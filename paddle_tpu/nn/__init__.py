@@ -39,6 +39,8 @@ from .layer.transformer import (MultiHeadAttention, Transformer, TransformerDeco
 from .layer.extras import (BeamSearchDecoder, HSigmoidLoss, MaxUnPool1D, MaxUnPool3D,
                            PairwiseDistance, RNNTLoss, Softmax2D,
                            TripletMarginWithDistanceLoss, dynamic_decode)
+from .lora import (LoRALinear, attach_lora, export_adapter, load_adapter,
+                   lora_parameters, merge_lora)
 from ..framework.param_attr import ParamAttr  # noqa: F401  (paddle.ParamAttr alias)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -248,6 +248,49 @@ class TestEffectInJitGL008:
         """)
 
 
+class TestAdapterBranchInJitGL009:
+    def test_if_on_adapter_id_inside_jitted_fn(self):
+        assert "GL009" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def decode(x, adapter_id):
+                if adapter_id > 0:
+                    return x * 2
+                return x
+        """)
+
+    def test_ternary_on_aidx_at_jit_callsite(self):
+        assert "GL009" in rule_ids("""
+            import jax
+            def decode(x, aidx):
+                return x * 2 if aidx else x
+            fast = jax.jit(decode)
+        """)
+
+    def test_gather_by_adapter_index_ok(self):
+        # the sanctioned pattern: static-shape gather, no branching
+        assert "GL009" not in rule_ids("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def decode(x, pool_a, aidx):
+                a = jnp.take(pool_a, aidx, axis=0)
+                return x + jnp.einsum("bsh,bhr->bsr", x, a).sum()
+        """)
+
+    def test_host_side_adapter_branch_ok(self):
+        # admission-control python OUTSIDE jit is exactly where adapter
+        # branching belongs
+        assert "GL009" not in rule_ids("""
+            def admit(req, pool):
+                if req.adapter is not None:
+                    return pool.acquire(req.adapter)
+                return 0
+        """)
+
+
 class TestSyntaxErrorGL000:
     def test_unparseable_module_reports_gl000(self):
         assert rule_ids("def broken(:\n    pass") == ["GL000"]
@@ -389,7 +432,7 @@ class TestRepoGate:
              "--list-rules"], capture_output=True, text=True)
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                    "GL007", "GL008"):
+                    "GL007", "GL008", "GL009"):
             assert rid in r.stdout
 
 

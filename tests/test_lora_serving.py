@@ -1,0 +1,336 @@
+"""Multi-tenant LoRA serving (inference/lora.py): paged multi-adapter
+decode must be TOKEN-EXACT vs the dense model with that adapter's weights
+merged in — fp AND int8-KV — while the adapter pool's page lifecycle
+(acquire/release, LRU retention, pin, eviction under pressure) mirrors the
+KV BlockAllocator's discipline, with zero steady-state recompiles across
+adapter churn. Quick tier on CPU."""
+import copy
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (AdapterRegistry, GenerationServer,
+                                  LoRAConfig)
+from paddle_tpu.inference.lora import (LORA_TARGETS, AdapterPool,
+                                       target_dims)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+_TGT_MODS = {"q": "self_attn.q_proj", "k": "self_attn.k_proj",
+             "v": "self_attn.v_proj", "o": "self_attn.o_proj",
+             "gate": "mlp.gate_proj", "up": "mlp.up_proj",
+             "down": "mlp.down_proj"}
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _adapter_weights(cfg, rank, seed, targets=LORA_TARGETS):
+    rng = np.random.RandomState(seed)
+    dims = target_dims(cfg)
+    w = {}
+    for layer in range(cfg.num_hidden_layers):
+        for t in targets:
+            fi, fo = dims[t]
+            w[(layer, t)] = (
+                rng.normal(0, 0.02, (fi, rank)).astype(np.float32),
+                rng.normal(0, 0.05, (rank, fo)).astype(np.float32))
+    return w
+
+
+def _merged(model, weights, rank, alpha):
+    """Dense reference: deep-copy the base model and fold each target's
+    ``scale * A @ B`` delta straight into its weight."""
+    m = copy.deepcopy(model)
+    s = alpha / rank
+    for (layer, t), (A, B) in weights.items():
+        mod = m.model.layers[layer]
+        for part in _TGT_MODS[t].split("."):
+            mod = getattr(mod, part)
+        W = np.asarray(mod.weight.numpy(), np.float32)
+        mod.weight.set_value((W + s * (A @ B)).astype(np.float32))
+    return m
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_multi_adapter_paged_matches_merged_dense(kv_quant):
+    """Heterogeneous batch — two adapters of DIFFERENT rank plus an
+    adapterless row decoding in the same compiled programs — must emit
+    exactly the tokens each per-adapter MERGED model emits solo."""
+    model, cfg = _model()
+    w1 = _adapter_weights(cfg, 4, seed=1)
+    w2 = _adapter_weights(cfg, 2, seed=2)
+    reg = AdapterRegistry()
+    reg.register("a1", w1, rank=4, alpha=8.0)
+    reg.register("a2", w2, rank=2, alpha=2.0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (6, 4, 9)]
+
+    srv = GenerationServer(model, max_batch=3, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, kv_quant=kv_quant,
+                           lora=LoRAConfig(reg, max_live_adapters=4,
+                                           max_rank=4))
+    rids = [srv.submit(prompts[0], max_new_tokens=8, adapter="a1"),
+            srv.submit(prompts[1], max_new_tokens=8, adapter="a2"),
+            srv.submit(prompts[2], max_new_tokens=8)]
+    out = srv.run()
+
+    for rid, w, meta, p in ((rids[0], w1, (4, 8.0), prompts[0]),
+                            (rids[1], w2, (2, 2.0), prompts[1]),
+                            (rids[2], None, None, prompts[2])):
+        ref_model = model if w is None else _merged(model, w, *meta)
+        ref = GenerationServer(ref_model, max_batch=1, max_len=64,
+                               cache="paged", block_size=4, prefill_chunk=8,
+                               kv_quant=kv_quant)
+        rr = ref.submit(p, max_new_tokens=8)
+        assert out[rid] == ref.run()[rr], (kv_quant, meta)
+    # slot release dropped every adapter ref; KV pool fully drained
+    assert srv.alloc.blocks_in_use == 0
+    assert srv._lora.alloc.blocks_in_use == 0
+
+
+def test_train_export_serve_roundtrip(tmp_path):
+    """Train-side nn.lora checkpoint → registry → paged serving must match
+    the same model with merge_lora() folded in: the two halves of the
+    subsystem agree on what an adapter means."""
+    from paddle_tpu.nn.lora import attach_lora, export_adapter, merge_lora
+
+    model, cfg = _model()
+    tuned = copy.deepcopy(model)
+    attach_lora(tuned, rank=4, alpha=8.0,
+                targets=("q_proj", "v_proj", "up_proj"))
+    # stand-in for a training run: kick every B off its zero init
+    rng = np.random.RandomState(5)
+    for _, layer in tuned.named_sublayers(include_self=True):
+        if type(layer).__name__ == "LoRALinear":
+            layer.lora_B.set_value(
+                rng.normal(0, 0.05, layer.lora_B.shape).astype(np.float32))
+    path = str(tmp_path / "adapter.npz")
+    export_adapter(tuned, path)
+
+    reg = AdapterRegistry()
+    from paddle_tpu.nn.lora import load_adapter
+
+    reg.register("tuned", load_adapter(path))
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8,
+                           lora=LoRAConfig(reg, max_live_adapters=2,
+                                           max_rank=4,
+                                           targets=("q", "v", "up")))
+    prompt = [3, 14, 15, 9, 2, 6, 5]
+    rid = srv.submit(prompt, max_new_tokens=10, adapter="tuned")
+    got = srv.run()[rid]
+
+    merged = merge_lora(tuned, targets=("q_proj", "v_proj", "up_proj"))
+    ref = GenerationServer(merged, max_batch=1, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8)
+    rr = ref.submit(prompt, max_new_tokens=10)
+    assert got == ref.run()[rr]
+
+
+def test_submit_adapter_validation():
+    """The whole rejection ladder fires at submit() — before the request
+    can queue: no lora config, unknown name, rank past the pool's
+    max_rank, and shape-incompatible factors."""
+    model, cfg = _model()
+    plain = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                             block_size=4)
+    with pytest.raises(ValueError, match="lora=LoRAConfig"):
+        plain.submit([1, 2, 3], max_new_tokens=4, adapter="a1")
+
+    reg = AdapterRegistry()
+    reg.register("ok", _adapter_weights(cfg, 2, seed=1), rank=2, alpha=4.0)
+    reg.register("fat", _adapter_weights(cfg, 8, seed=2), rank=8, alpha=8.0)
+    bad = _adapter_weights(cfg, 2, seed=3)
+    A, B = bad[(0, "q")]
+    bad[(0, "q")] = (A[:-1], B)          # wrong in_features
+    reg.register("misshapen", bad, rank=2, alpha=4.0)
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4,
+                           lora=LoRAConfig(reg, max_live_adapters=2,
+                                           max_rank=4))
+    with pytest.raises(ValueError, match="unknown adapter"):
+        srv.submit([1, 2, 3], max_new_tokens=4, adapter="nope")
+    with pytest.raises(ValueError, match="exceeds the pool's max_rank"):
+        srv.submit([1, 2, 3], max_new_tokens=4, adapter="fat")
+    with pytest.raises(ValueError, match="shape"):
+        srv.submit([1, 2, 3], max_new_tokens=4, adapter="misshapen")
+    # the ladder rejected at the door: nothing queued, nothing resident
+    assert len(srv._sched) == 0
+    rid = srv.submit([1, 2, 3], max_new_tokens=4, adapter="ok")
+    assert len(srv.run()[rid]) == 7
+
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(model, max_batch=2, max_len=64,
+                         lora=LoRAConfig(reg, max_live_adapters=2,
+                                         max_rank=4))
+
+
+@pytest.mark.graftlint
+def test_zero_recompiles_across_adapter_churn():
+    """6 adapters through a 2-page pool: register/evict/upload churn on
+    every refill, plus an adapterless request — all steady-state trips
+    must hit the jit cache (the static-shape gather is the whole design).
+    Late registration (after warmup) must also not recompile."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    for i in range(5):
+        reg.register(f"a{i}", _adapter_weights(cfg, 2, seed=10 + i),
+                     rank=2, alpha=4.0)
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8,
+                           lora=LoRAConfig(reg, max_live_adapters=2,
+                                           max_rank=2))
+    rng = np.random.RandomState(3)
+    # warmup: compile prefill + decode with the lora args in place
+    for i in range(2):
+        srv.submit(rng.randint(1, cfg.vocab_size, (6,)).tolist(),
+                   max_new_tokens=6, adapter=f"a{i}")
+    srv.run()
+
+    reg.register("late", _adapter_weights(cfg, 2, seed=99), rank=2,
+                 alpha=4.0)  # registered AFTER warmup: upload only, no trace
+    rids = []
+    with jit_cache_guard("lora adapter churn") as g:
+        for i, name in enumerate(("a2", "a3", "a4", "late", None, "a0")):
+            rids.append(srv.submit(
+                rng.randint(1, cfg.vocab_size, (4 + i,)).tolist(),
+                max_new_tokens=6, adapter=name))
+        out = srv.run()
+    assert g.compiles == 0
+    assert all(len(out[r]) >= 7 for r in rids)
+    st = srv._lora.stats()
+    assert st["adapter_evictions"] > 0, st   # churn actually happened
+    assert st["adapter_uploads"] >= 6, st
+
+
+def test_pinned_adapter_page_survives_pool_pressure():
+    """AdapterPool page lifecycle under pressure: a PINNED resident
+    adapter's page is never reclaimed — eviction takes the unpinned
+    cached page; with every page pinned-or-live, acquire refuses."""
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    for i in range(3):
+        reg.register(f"a{i}", _adapter_weights(cfg, 2, seed=20 + i),
+                     rank=2, alpha=4.0)
+    pool = AdapterPool(cfg, LoRAConfig(reg, max_live_adapters=2, max_rank=2))
+    p0 = pool.acquire("a0")
+    p1 = pool.acquire("a1")
+    pool.release(p0)
+    pool.release(p1)                  # both cached, a0 is LRU-coldest
+    pool.pin("a0")
+    p2 = pool.acquire("a2")           # pressure: must evict, but NOT a0
+    assert pool.is_resident("a0") and not pool.is_resident("a1")
+    assert pool.stats()["adapter_evictions"] == 1
+    # a2 live + a0 pinned = no reclaimable page anywhere
+    assert not pool.can_acquire("a1")
+    with pytest.raises(RuntimeError):
+        pool.acquire("a1")
+    pool.unpin("a0")
+    assert pool.can_acquire("a1")     # unpinned page is fair game again
+    pool.release(p2)
+    pool.acquire("a1")
+    assert pool.is_resident("a1")
+
+
+def test_adapter_refcount_conserved_across_preempt_swap_resume():
+    """A high-priority burst preempts a decoding LoRA request (KV swaps to
+    host, adapter ref drops); the victim resumes and finishes token-exact.
+    Afterwards BOTH allocators — KV blocks and adapter pages — must show
+    zero live refs: nothing leaked through the preempt/resume cycle."""
+    model, cfg = _model()
+    w = _adapter_weights(cfg, 2, seed=31)
+    reg = AdapterRegistry()
+    reg.register("a0", w, rank=2, alpha=4.0)
+    reg.register("hot", _adapter_weights(cfg, 2, seed=32), rank=2, alpha=4.0)
+
+    srv = GenerationServer(model, max_batch=1, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, num_blocks=7,
+                           policy="priority",
+                           lora=LoRAConfig(reg, max_live_adapters=1,
+                                           max_rank=2))
+    victim = srv.submit([5, 9, 2, 7, 6, 1], max_new_tokens=12,
+                        adapter="a0", priority=2)
+    for _ in range(4):               # decode a few ticks, then preempt
+        srv.step()
+    hot = srv.submit([4, 4, 8], max_new_tokens=6, adapter="hot", priority=0)
+    got_victim = srv.run()[victim]
+    assert srv._preemptions >= 1     # the single slot WAS displaced
+    assert srv._resumes >= 1
+    assert srv.alloc.blocks_in_use == 0
+    assert srv._lora.alloc.blocks_in_use == 0          # refs conserved
+    assert srv._lora.stats()["adapter_evictions"] >= 1  # 1-page pool churned
+
+    # the victim's tokens must be IDENTICAL to an UNINTERRUPTED solo decode
+    # (bit-exact swap/resume with the adapter attached)
+    ref = GenerationServer(model, max_batch=1, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8,
+                           lora=LoRAConfig(reg, max_live_adapters=1,
+                                           max_rank=2))
+    rr = ref.submit([5, 9, 2, 7, 6, 1], max_new_tokens=12, adapter="a0")
+    assert got_victim == ref.run()[rr]
+
+
+def test_wfq_demand_governs_adapter_residency():
+    """Scheduler.adapter_demand() lists waiting adapters in pop order;
+    AdapterPool.warm() replays it so the tenant the policy favors keeps
+    its adapter resident — the coldest page belongs to the LAST tenant in
+    demand order, and pressure evicts that one."""
+    from paddle_tpu.inference.scheduler import Scheduler
+
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    for i in range(3):
+        reg.register(f"a{i}", _adapter_weights(cfg, 2, seed=40 + i),
+                     rank=2, alpha=4.0)
+    sched = Scheduler(policy="wfq", weights={"gold": 8.0, "bronze": 1.0})
+    sched.submit(object(), 0, tenant="bronze", cost=64.0, adapter="a1")
+    sched.submit(object(), 1, tenant="gold", cost=64.0, adapter="a0")
+    # gold's 8x weight pops first despite submitting second
+    assert sched.adapter_demand() == ["a0", "a1"]
+
+    pool = AdapterPool(cfg, LoRAConfig(reg, max_live_adapters=2, max_rank=2))
+    pool.release(pool.acquire("a0"))
+    pool.release(pool.acquire("a1"))   # LRU order now: a0 coldest
+    pool.warm(sched.adapter_demand())  # demand says a0 matters MOST
+    pool.acquire("a2")                 # pressure: one cached page must go
+    assert pool.is_resident("a0")      # warm() saved the favored tenant
+    assert not pool.is_resident("a1")
+
+
+def test_per_tenant_sched_metrics_and_adapter_stats():
+    """sched_metrics() carries the adapter-pool counters and a per-tenant
+    TTFT/TPOT p50/p95 breakdown over completed requests."""
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    reg.register("a0", _adapter_weights(cfg, 2, seed=50), rank=2, alpha=4.0)
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, policy="wfq",
+                           lora=LoRAConfig(reg, max_live_adapters=2,
+                                           max_rank=2))
+    rng = np.random.RandomState(1)
+    for i in range(4):
+        srv.submit(rng.randint(1, cfg.vocab_size, (5,)).tolist(),
+                   max_new_tokens=6, tenant=("t0", "t1")[i % 2],
+                   adapter="a0" if i % 2 == 0 else None)
+    srv.run()
+    m = srv.sched_metrics()
+    assert m["adapter_pool_bytes"] > 0
+    assert m["adapters_registered"] == 1
+    assert m["adapter_hits"] + m["adapter_uploads"] >= 2
+    assert 0.0 <= m["adapter_hit_rate"] <= 1.0
+    for t in ("t0", "t1"):
+        row = m["tenants"][t]
+        assert row["completed"] == 2.0
+        assert row["ttft_p50_ms"] > 0 and row["ttft_p95_ms"] >= row[
+            "ttft_p50_ms"]
+        assert "tpot_p50_ms" in row and "tpot_p95_ms" in row

@@ -218,6 +218,48 @@ def test_pin_blocks_eviction_and_swap_counters():
     assert a.stats()["pinned_blocks"] == 0
 
 
+def test_touch_reorders_lru_and_pinned_adapter_pages_survive_pressure():
+    """Adapter-pool contract on the raw allocator: touch() promotes a
+    CACHED block to MRU (so warm() can replay scheduler demand into the
+    eviction order), is a strict no-op on live/unknown blocks, and a
+    pinned adapter page is never reclaimed no matter how cold — with
+    refcounts conserved through the whole churn."""
+    a = BlockAllocator(num_blocks=4, block_size=1, bytes_per_block=64)
+    p1, p2, p3 = a.alloc(), a.alloc(), a.alloc()
+    a.register(p1, hash(("adapter", "a1", 1)))
+    a.register(p2, hash(("adapter", "a2", 1)))
+    a.register(p3, hash(("adapter", "a3", 1)))
+    a.touch(p1)                               # LIVE: must not enter the LRU
+    for p in (p1, p2, p3):
+        a.free(p)                             # cached; age order p1 p2 p3
+    a.touch(p1)                               # coldest -> MRU
+    a.touch(99999)                            # unknown: no-op, no raise
+    assert a.alloc() == p2                    # p1 was saved by the touch
+    assert a.alloc() == p3
+    assert a.alloc() == p1                    # demoted back to coldest
+    assert a.evictions == 3
+
+    # pinned-under-pressure: pin one cached page, fill every other block
+    a2 = BlockAllocator(num_blocks=4, block_size=1, bytes_per_block=64)
+    q1, q2 = a2.alloc(), a2.alloc()
+    a2.register(q1, hash(("adapter", "pinned", 1)))
+    a2.register(q2, hash(("adapter", "victim", 1)))
+    a2.free(q1)
+    a2.free(q2)
+    a2.pin(q1)                                # q1 is older AND pinned
+    q3 = a2.alloc()                           # free block first
+    assert q3 not in (q1, q2)
+    assert a2.alloc() == q2                   # eviction skips pinned q1
+    assert a2.evictions == 1
+    a2.touch(q1)                              # touching a pinned page is fine
+    with pytest.raises(RuntimeError, match="pinned"):
+        a2.alloc()                            # q1 is the only cached page
+    assert a2.blocks_in_use == 2              # the two live allocs, no leak
+    a2.unpin(q1)
+    assert a2.alloc() == q1                   # reclaimable the moment it
+    assert a2.blocks_in_use == 3              # ... is unpinned
+
+
 def test_match_hashes_walks_and_refs_without_hit_counters():
     """match_hashes (the swap-in fast path) re-refs the longest resident
     prefix of an explicit hash chain, stops at the first miss, and leaves

@@ -118,4 +118,33 @@ if r["ttft_p95_s_high"] > 30.0:
                      "scheduler wedged or preemption not firing")
 PY
 
+echo "== 7c. multi-tenant LoRA smoke (adapter churn + per-tenant fairness) =="
+python tools/serving_benchmark.py --paged --lora-adapters 8 --lora-rank 8 \
+  --lora-live 4 --scheduler wfq --mixed-priority --guard-recompiles --json \
+  2>/dev/null | tee /tmp/tpu_runs/serving_lora.json \
+  || { echo "LoRA serving pass FAILED (recompile guard or crash)"; exit 1; }
+python - <<'PY'
+# LoRA gate: 8 adapters over a 4-page pool must churn (uploads beyond the
+# first fill, evictions firing) WITHOUT recompiles (guard above), every
+# tenant must complete work, and the multi-adapter path must hold >=80%
+# of no-adapter paged throughput (BGMV delta cost bound)
+import json
+r = json.load(open("/tmp/tpu_runs/serving_lora.json"))
+base = json.load(open("/tmp/tpu_runs/serving_paged.json"))
+ratio = r["value"] / base["value"]
+print(f"lora/paged tok/s ratio: {ratio:.2f} "
+      f"(uploads {r['adapter_uploads']}, evictions "
+      f"{r['adapter_evictions']}, hit-rate {r['adapter_hit_rate']:.2f}, "
+      f"pool {r['adapter_pool_bytes']} B)")
+assert r["lora_adapters"] == 8 and r["lora_live"] == 4, r
+assert r["adapter_uploads"] >= 8, "every adapter should upload at least once"
+assert r["adapter_evictions"] > 0, "8 adapters over 4 pages never evicted"
+assert r["adapter_pool_bytes"] > 0, r
+assert len(r["tenants"]) == 8 and all(
+    t["completed"] > 0 for t in r["tenants"].values()), r["tenants"]
+if ratio < 0.8:
+    raise SystemExit("multi-adapter serving below 80% of paged baseline — "
+                     "BGMV delta or adapter gather regressed")
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

@@ -101,6 +101,21 @@ def main():
                          "SAME byte budget the fp pool would get, so the "
                          "JSON's kv_blocks_total shows the capacity win "
                          "directly (~2x bf16 / ~4x f32)")
+    ap.add_argument("--lora-adapters", type=int, default=0, metavar="N",
+                    help="multi-tenant LoRA workload (paged only): register "
+                         "N random adapters, assign requests round-robin "
+                         "(request i uses adapter a{i%%N}, tenant t{i%%N}) "
+                         "so adapter residency churns; JSON line gains "
+                         "adapter_pool_bytes / adapter_hit_rate / "
+                         "adapter_uploads + the per-tenant TTFT/TPOT "
+                         "breakdown")
+    ap.add_argument("--lora-rank", type=int, default=8,
+                    help="rank of every generated adapter (and the pool's "
+                         "max_rank)")
+    ap.add_argument("--lora-live", type=int, default=None, metavar="M",
+                    help="adapter-pool pages = max concurrently-resident "
+                         "adapters (default min(N, slots)); N > M forces "
+                         "LRU eviction + re-upload churn")
     ap.add_argument("--guard-recompiles", action="store_true",
                     help="wrap the measured drain in jit_cache_guard: any "
                          "steady-state recompile after warmup fails the "
@@ -165,6 +180,16 @@ def main():
     if args.kv_quant != "none" and not args.paged:
         ap.error("--kv-quant requires --paged (the int8 pool is the "
                  "block pool)")
+    if args.lora_adapters:
+        if not args.paged:
+            ap.error("--lora-adapters requires --paged (the adapter pool "
+                     "shares the paged slot machinery)")
+        if args.int8:
+            ap.error("--lora-adapters is incompatible with --int8 weights "
+                     "(serve LoRA over fp base weights; --kv-quant int8 "
+                     "is fine)")
+        if args.lora_rank < 1:
+            ap.error("--lora-rank must be >= 1")
     if args.spec:
         if not args.paged:
             ap.error("--spec requires --paged (the verify op is paged)")
@@ -209,6 +234,32 @@ def main():
     _counter = [0]
     prios = {}
 
+    lora_cfg, lora_live = None, 0
+    if args.lora_adapters:
+        from paddle_tpu.inference.lora import (LORA_TARGETS, AdapterRegistry,
+                                               LoRAConfig, target_dims)
+
+        # adapter factors ride the traffic seed: same seed, same tenants'
+        # weights — the model stays the fixed benchmark-definition model
+        arng = np.random.RandomState(args.seed + 17)
+        dims = target_dims(cfg)
+        reg = AdapterRegistry()
+        for a in range(args.lora_adapters):
+            w = {}
+            for layer in range(cfg.num_hidden_layers):
+                for t in LORA_TARGETS:
+                    fi, fo = dims[t]
+                    w[(layer, t)] = (
+                        arng.normal(0, 0.02, (fi, args.lora_rank))
+                        .astype(np.float32),
+                        arng.normal(0, 0.02, (args.lora_rank, fo))
+                        .astype(np.float32))
+            reg.register(f"a{a}", w, rank=args.lora_rank,
+                         alpha=2.0 * args.lora_rank)
+        lora_live = args.lora_live or min(args.lora_adapters, args.slots)
+        lora_cfg = LoRAConfig(reg, max_live_adapters=lora_live,
+                              max_rank=args.lora_rank)
+
     def burst(server, n):
         """Mixed prompt lengths across the bucket ladder; round-robin
         priority classes + tenants under --mixed-priority."""
@@ -225,12 +276,18 @@ def main():
                 prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
             i = _counter[0]
             _counter[0] += 1
-            prio, tenant = 1, "default"
+            prio, tenant, adapter = 1, "default", None
             if args.mixed_priority:
                 prio = (0, 1, 2)[i % 3]
                 tenant = ("a", "b")[i % 2]
+            if args.lora_adapters:
+                # one tenant per adapter: the WFQ share → adapter
+                # residency coupling is what the workload exercises
+                adapter = f"a{i % args.lora_adapters}"
+                tenant = f"t{i % args.lora_adapters}"
             rid = server.submit(prompt, max_new_tokens=args.max_new,
-                                priority=prio, tenant=tenant)
+                                priority=prio, tenant=tenant,
+                                adapter=adapter)
             rids[rid] = int(ln)
             prios[rid] = prio
         return rids
@@ -294,7 +351,8 @@ def main():
                 block_size=args.block_size, num_blocks=num_blocks,
                 prefill_chunk=args.prefill_chunk, spec=spec,
                 kv_quant=args.kv_quant, pool_bytes=pool_bytes,
-                policy=args.scheduler, host_pool_bytes=host_pool)
+                policy=args.scheduler, host_pool_bytes=host_pool,
+                lora=lora_cfg)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -415,6 +473,16 @@ def main():
         line["kv_bytes_per_token"] = round(
             stats["bytes_per_block"] / stats["block_size"], 2)
         line["kv_pool_bytes"] = stats["bytes_per_block"] * stats["num_blocks"]
+    if args.lora_adapters:
+        am = server.sched_metrics()
+        line["lora_adapters"] = args.lora_adapters
+        line["lora_rank"] = args.lora_rank
+        line["lora_live"] = lora_live
+        line["adapter_pool_bytes"] = am["adapter_pool_bytes"]
+        line["adapter_hit_rate"] = round(am["adapter_hit_rate"], 4)
+        line["adapter_uploads"] = am["adapter_uploads"]
+        line["adapter_evictions"] = am["adapter_evictions"]
+        line["tenants"] = am["tenants"]
     if args.spec:
         sm = server.spec_metrics()
         line["spec_k"] = args.spec
@@ -429,6 +497,9 @@ def main():
         mode = "paged" if args.paged else "dense"
         if args.spec:
             mode += f"+spec{args.spec}:{args.spec_drafter}"
+        if args.lora_adapters:
+            mode += (f"+lora{args.lora_adapters}r{args.lora_rank}"
+                     f"/{lora_live}live")
         extra = (f", peak blocks {line.get('peak_kv_blocks')}/"
                  f"{line.get('kv_blocks_total')}" if args.paged else "")
         if args.spec:
