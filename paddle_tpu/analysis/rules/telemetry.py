@@ -1,0 +1,97 @@
+"""Telemetry hygiene: no metric/span/flight calls inside jitted bodies.
+
+The serving telemetry contract (``inference/telemetry.py``) is host-only:
+registry counters, span tracers, and the flight recorder run BETWEEN
+device programs, never inside them. A telemetry call inside a jitted
+function is doubly wrong — it executes once at trace time (so the metric
+records the trace, not the steady state) and it tempts a ``.item()``/
+host sync to read the value being recorded, breaking the async dispatch
+pipeline the serving loop depends on.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+
+@register
+class TelemetryInJitRule(Rule):
+    """GL010: metrics/span/flight-recorder mutation inside a function this
+    module jit-compiles. Telemetry is host-side observability; inside a
+    traced body the call fires once at trace time and never again, so the
+    instrument silently reports trace-time state forever."""
+
+    id = "GL010"
+    name = "telemetry-in-jit"
+    description = ("counter/histogram/span/flight-recorder calls inside a "
+                   "jitted function run at trace time only — record around "
+                   "the compiled call on the host side "
+                   "(inference/telemetry.py is host-only by contract)")
+
+    # receiver components that name a telemetry object outright
+    _RECV_EXACT = frozenset({
+        "telemetry", "tracer", "registry", "metrics", "flight",
+        "recorder", "tel",
+    })
+    # receiver components that name one by convention
+    _RECV_SUBSTR = ("telemetry", "metric", "tracer", "flight", "span",
+                    "counter", "gauge", "histogram")
+    # mutating methods of the telemetry API surface
+    _METHODS = frozenset({
+        "inc", "add", "observe", "set", "begin", "end", "record",
+        "instant", "complete", "close", "span",
+    })
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.jitted_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in ctx.jitted_names:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                hit = self._telemetry_call(sub)
+                if hit is not None:
+                    recv, meth = hit
+                    yield self.finding(
+                        ctx, sub,
+                        f"{recv}.{meth}() inside jitted '{node.name}' — "
+                        f"telemetry is host-only: it fires at trace time, "
+                        f"not per step; move the call outside the compiled "
+                        f"function and record around the dispatch")
+
+    @classmethod
+    def _telemetry_call(cls, call: ast.Call) -> Optional[tuple]:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        meth = func.attr
+        if meth not in cls._METHODS:
+            return None
+        # walk the receiver, peeling intermediate get-or-create calls
+        # (reg.histogram("h").observe(...)); a subscript root (.at[].set)
+        # yields no components and stays clean
+        parts = []
+        node = func.value
+        while True:
+            if isinstance(node, ast.Call):
+                node = node.func
+            elif isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            elif isinstance(node, ast.Name):
+                parts.append(node.id)
+                break
+            else:
+                break
+        for part in parts:
+            low = part.lstrip("_").lower()
+            if low in cls._RECV_EXACT or any(
+                    s in low for s in cls._RECV_SUBSTR):
+                return ".".join(reversed(parts)), meth
+        return None

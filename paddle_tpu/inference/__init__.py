@@ -263,3 +263,5 @@ from .scheduler import (PRIORITY_HIGH, PRIORITY_LOW,  # noqa: E402,F401
 from .serving import GenerationServer  # noqa: E402,F401
 from .speculative import (DraftModelDrafter, NgramDrafter,  # noqa: E402,F401
                           SpecConfig)
+from .telemetry import (FlightRecorder, MetricsRegistry,  # noqa: E402,F401
+                        ServingTelemetry, SpanTracer, watchdog)

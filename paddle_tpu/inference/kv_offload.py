@@ -107,6 +107,12 @@ class HostKVPool:
         if self._store.pop(rid, None) is not None:
             self.bytes_in_use -= nbytes
 
+    def stats(self) -> Dict[str, int]:
+        return {"bytes_in_use": self.bytes_in_use,
+                "bytes_peak": self.bytes_peak,
+                "puts": self.puts, "takes": self.takes,
+                "parked": len(self._store)}
+
     def __len__(self) -> int:
         return len(self._store)
 
@@ -124,6 +130,11 @@ class KVOffloadEngine:
         self.alloc = alloc
         self.table_width = int(table_width)
         self.host = HostKVPool(capacity_bytes)
+        # optional ServingTelemetry (inference/telemetry.py): the owning
+        # server sets this so swap copies emit per-request spans + the
+        # serving_swap_{out,in}_s histograms. The copies themselves are
+        # untouched — timing wraps the whole eager d2h/h2d sequence.
+        self.telemetry = None
 
     # ------------------------------------------------------------- swap out
     def swap_out(self, rid: int, table: Sequence[int], hashes: Sequence[int],
@@ -137,6 +148,8 @@ class KVOffloadEngine:
         """
         import jax.numpy as jnp
 
+        tel = self.telemetry
+        _t0 = tel.clock() if tel is not None and tel.enabled else None
         a = self.alloc
         n = len(table)
         nbytes = n * a.bytes_per_block
@@ -161,6 +174,16 @@ class KVOffloadEngine:
         for bid in table:
             a.free(bid)                   # hashed blocks land on the LRU
         a.note_swap_out(n, nbytes)
+        if _t0 is not None:
+            _t1 = tel.clock()
+            tel.registry.histogram(
+                "serving_swap_out_s",
+                "device->host KV swap-out wall time").observe(_t1 - _t0)
+            tel.registry.counter(
+                "serving_swap_out_bytes",
+                "KV bytes parked to host").inc(nbytes)
+            tel.tracer.complete(rid, "swap_out", _t0, _t1,
+                                blocks=n, bytes=nbytes)
         return SwapHandle(rid=rid, n_tokens=int(n_tokens),
                           last_token=int(last_token), n_blocks=n,
                           hashes=list(hashes), nbytes=nbytes)
@@ -183,6 +206,8 @@ class KVOffloadEngine:
         """
         import jax.numpy as jnp
 
+        tel = self.telemetry
+        _t0 = tel.clock() if tel is not None and tel.enabled else None
         a = self.alloc
         matched = a.match_hashes(handle.hashes)
         need = handle.n_blocks - len(matched)
@@ -204,6 +229,18 @@ class KVOffloadEngine:
         for i in range(len(matched), min(len(handle.hashes), len(table))):
             a.register(table[i], handle.hashes[i])
         a.note_swap_in(handle.n_blocks, handle.nbytes)
+        if _t0 is not None:
+            _t1 = tel.clock()
+            tel.registry.histogram(
+                "serving_swap_in_s",
+                "host->device KV swap-in wall time").observe(_t1 - _t0)
+            tel.registry.counter(
+                "serving_swap_in_bytes",
+                "KV bytes restored from host").inc(handle.nbytes)
+            tel.tracer.complete(handle.rid, "swap_in", _t0, _t1,
+                                blocks=handle.n_blocks,
+                                prefix_hits=len(matched),
+                                bytes=handle.nbytes)
         return table, pools
 
     def discard(self, handle: SwapHandle) -> None:

@@ -249,6 +249,10 @@ class AdapterPool:
         # stats
         self.hits = 0
         self.uploads = 0
+        # optional ServingTelemetry (inference/telemetry.py), set by the
+        # owning server: adapter uploads then feed the
+        # serving_lora_upload_s histogram
+        self.telemetry = None
 
     # ------------------------------------------------------------- validation
     def validate(self, name: str) -> Adapter:
@@ -324,7 +328,16 @@ class AdapterPool:
         # is exactly the warm-adapter cache; uid-keyed so a re-registered
         # name can never collide with its own stale page
         self.alloc.register(page, hash(("adapter", name, ad.uid)))
-        self._upload(page, ad)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            _t0 = tel.clock()
+            self._upload(page, ad)
+            tel.registry.histogram(
+                "serving_lora_upload_s",
+                "adapter factor upload wall time").observe(
+                    tel.clock() - _t0, adapter=name)
+        else:
+            self._upload(page, ad)
         self.uploads += 1
         self._resident[name] = page
         self._page_name[page] = name

@@ -118,6 +118,14 @@ class BlockAllocator:
                 "host_bytes_in_use": self.host_bytes_in_use,
                 "host_bytes_peak": self.host_bytes_peak}
 
+    def publish(self, registry) -> None:
+        """Mirror :meth:`stats` into a
+        :class:`~.telemetry.MetricsRegistry` as ``kv_pool_*`` gauges
+        (numeric fields only) — called at snapshot time, never per tick."""
+        for k, v in self.stats().items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                registry.gauge(f"kv_pool_{k}").set(float(v))
+
     # ------------------------------------------------------- swap bookkeeping
     def note_swap_out(self, nblocks: int, nbytes: int) -> None:
         """Record ``nblocks`` parked to host (``nbytes`` of host pool)."""

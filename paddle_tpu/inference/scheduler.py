@@ -133,6 +133,29 @@ class Scheduler:
         self.submitted = 0
         self.expired = 0
         self.cancelled = 0
+        # registry twins (inference/telemetry.py) — None until a server
+        # calls attach_metrics; the ints above stay authoritative for
+        # direct Scheduler users with no registry
+        self._m_submitted = None
+        self._m_expired = None
+        self._m_cancelled = None
+
+    def attach_metrics(self, registry) -> None:
+        """Mirror the intake counters into a
+        :class:`~.telemetry.MetricsRegistry` (``sched_requests_*``).
+        Pre-attach history is seeded in so registry totals always equal
+        the ints; ``submitted`` is additionally labeled by tenant."""
+        self._m_submitted = registry.counter(
+            "sched_requests_submitted", "requests admitted to the queue")
+        self._m_expired = registry.counter(
+            "sched_requests_expired", "queued requests dropped by TTL")
+        self._m_cancelled = registry.counter(
+            "sched_requests_cancelled", "queued requests cancelled")
+        for c, n in ((self._m_submitted, self.submitted),
+                     (self._m_expired, self.expired),
+                     (self._m_cancelled, self.cancelled)):
+            if n:
+                c.inc(n)
 
     # ------------------------------------------------------------------ intake
     def submit(self, req: Any, rid: int, *, priority: int = PRIORITY_NORMAL,
@@ -164,6 +187,8 @@ class Scheduler:
         self._seq += 1
         self._q.append(ent)
         self.submitted += 1
+        if self._m_submitted is not None:
+            self._m_submitted.inc(tenant=tenant)
         return ent
 
     def requeue(self, ent: SchedEntry) -> None:
@@ -207,6 +232,8 @@ class Scheduler:
             if ent.rid == rid:
                 self._q.remove(ent)
                 self.cancelled += 1
+                if self._m_cancelled is not None:
+                    self._m_cancelled.inc()
                 return ent
         return None
 
@@ -222,6 +249,8 @@ class Scheduler:
         for e in out:
             self._q.remove(e)
         self.expired += len(out)
+        if out and self._m_expired is not None:
+            self._m_expired.inc(len(out))
         return out
 
     def __len__(self) -> int:

@@ -106,7 +106,7 @@ class GenerationServer:
                  pool_bytes: Optional[int] = None,
                  policy=None,
                  host_pool_bytes: Optional[int] = None,
-                 lora=None):
+                 lora=None, telemetry=None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -161,7 +161,16 @@ class GenerationServer:
         config's static ``max_live_adapters``/``max_rank`` — so adapter
         churn (register/evict/swap) causes zero steady-state recompiles.
         Greedy output with adapter X is token-identical to the dense model
-        with X's weights merged in. See docs/serving.md."""
+        with X's weights merged in. See docs/serving.md.
+
+        ``telemetry``: observability (inference/telemetry.py). None/False
+        (default) keeps span tracing and the tick flight recorder OFF —
+        the metrics registry is still live (``sched_metrics()`` and the
+        tenant percentiles read through it; counter updates are host dict
+        writes) but the traced hot path pays only a truthiness check.
+        True enables spans + flight recording; or pass a configured
+        :class:`~.telemetry.ServingTelemetry` (injectable clock, ring
+        size). See docs/observability.md."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -246,6 +255,53 @@ class GenerationServer:
         self._next_rid = 0
         self._lora = None
 
+        from .telemetry import ServingTelemetry
+
+        if telemetry is None or telemetry is False:
+            self._tel = ServingTelemetry(enabled=False)
+        elif telemetry is True:
+            self._tel = ServingTelemetry(enabled=True)
+        elif isinstance(telemetry, ServingTelemetry):
+            self._tel = telemetry
+        else:
+            raise ValueError(
+                f"telemetry must be None, a bool, or a ServingTelemetry "
+                f"instance, got {telemetry!r}")
+        self.telemetry = self._tel
+        reg = self._tel.registry
+        self._sched.attach_metrics(reg)
+        # registry twins of the overload ints above: sched_metrics() reads
+        # THESE (single source of truth); the ints stay in lockstep for
+        # direct attribute users
+        self._c_preempt = reg.counter(
+            "serving_preemptions", "decoding slots swapped out to host")
+        self._c_aborts = reg.counter(
+            "serving_prefill_aborts",
+            "prefilling slots aborted under pool pressure (recomputable)")
+        self._c_resumes = reg.counter(
+            "serving_resumes", "swapped requests restored into a slot")
+        self._c_stalls = reg.counter(
+            "serving_stalled_reservations",
+            "block reservations that found no victim and no headroom")
+        self._c_completed = reg.counter(
+            "serving_requests_completed", "requests finished with results")
+        self._c_dropped = reg.counter(
+            "serving_requests_dropped",
+            "requests dropped before finishing (reason label)")
+        self._h_ttft = reg.histogram(
+            "serving_ttft_s",
+            "submit -> first token, completed requests (seconds)")
+        self._h_tpot = reg.histogram(
+            "serving_tpot_ms",
+            "per-token latency after the first, completed requests (ms)",
+            buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                     250.0, 500.0, 1000.0, 2500.0))
+        self._h_e2e = reg.histogram(
+            "serving_e2e_s", "submit -> done, completed requests (seconds)")
+        # program key of the last paged trip, recorded per tick by the
+        # flight recorder; the watchdog keys recompile excusal on it
+        self._last_prog = "idle"
+
         if cache == "dense":
             self.buckets = sorted(b for b in prompt_buckets if b <= max_len)
             if not self.buckets:
@@ -319,6 +375,7 @@ class GenerationServer:
 
             self._offload = KVOffloadEngine(self.alloc, self._table_width,
                                             capacity_bytes=host_pool_bytes)
+            self._offload.telemetry = self._tel
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
             # per-slot adapter page index into the LoRA pool; 0 = the
             # permanently-zero NULL page, so adapterless slots need no
@@ -328,6 +385,7 @@ class GenerationServer:
                 from .lora import AdapterPool
 
                 self._lora = AdapterPool(cfg, lora)
+                self._lora.telemetry = self._tel
             # device-side mirror of (temps, topks, topps[, kcaps]): these
             # change only when a slot activates/releases, but were being
             # re-uploaded every trip (~0.1ms eager dispatch each)
@@ -771,6 +829,11 @@ class GenerationServer:
             cost=float(len(prompt) + max_new_tokens), adapter=adapter)
         self._req_metrics[rid] = {"submit_t": self._wall(),
                                   "tenant": tenant}
+        if self._tel.enabled:
+            tr = self._tel.tracer
+            tr.set_meta(rid, tenant=tenant, priority=priority,
+                        prompt_len=len(prompt), adapter=adapter or "")
+            tr.begin(rid, "queued", priority=priority, tenant=tenant)
         return rid
 
     def _bucket_for(self, n: int) -> int:
@@ -810,6 +873,9 @@ class GenerationServer:
         m = self._req_metrics.get(req.rid)
         if m is not None:
             m.setdefault("first_token_t", self._wall())
+        if self._tel.enabled:
+            self._tel.tracer.end(req.rid, "prefill")
+            self._tel.tracer.instant(req.rid, "first_token")
 
     def _samp_arrays(self):
         """Device copies of the per-slot sampling params (+ draft caps and
@@ -829,6 +895,10 @@ class GenerationServer:
         bucket = self._bucket_for(n)
         prompt = np.zeros((1, bucket), np.int32)
         prompt[0, :n] = req.prompt
+        if self._tel.enabled:
+            self._tel.tracer.end(req.rid, "queued")
+            self._tel.tracer.begin(req.rid, "prefill", bucket=bucket,
+                                   prompt_len=n)
         # one compiled call: prefill + scatter into the slot's pool rows.
         # Rows beyond the true prompt length hold right-pad garbage, but
         # decode writes sequentially from pos=n, overwriting each such row
@@ -892,12 +962,14 @@ class GenerationServer:
         """A queued entry leaves without finishing: record why, stamp its
         metrics closed, release any parked host KV."""
         self._dropped[ent.rid] = reason
+        self._c_dropped.inc(reason=reason)
         m = self._req_metrics.get(ent.rid)
         if m is not None:
             m["done_t"] = self._wall()
         if ent.swap is not None:
             self._offload.discard(ent.swap)
             ent.swap = None
+        self._tel.tracer.close(ent.rid, reason)
 
     # ---------------------------------------------------------- paged path
     def _admit_paged(self, slot: int, req: _Request) -> None:
@@ -917,6 +989,11 @@ class GenerationServer:
         self._bt[slot, :len(req.table)] = req.table
         self._prefilling[slot] = True
         self._slots[slot] = req
+        if self._tel.enabled:
+            tr = self._tel.tracer
+            tr.end(req.rid, "queued")
+            tr.begin(req.rid, "prefill", cached_blocks=len(req.table),
+                     prompt_len=len(req.prompt))
 
     def _ensure_blocks(self, slot: int, entries: int) -> None:
         """Grow the slot's block table to >= ``entries`` real entries
@@ -985,6 +1062,9 @@ class GenerationServer:
                                 else req.draft_k)
         self._samp_dev = None
         self._resumes += 1
+        self._c_resumes.inc()
+        if self._tel.enabled:
+            self._tel.tracer.end(req.rid, "preempted", resumed=True)
         return True
 
     def _pick_victim(self, than_priority: int,
@@ -1025,6 +1105,11 @@ class GenerationServer:
             req.table = []
             req.pf_next = 0
             self._prefill_aborts += 1
+            self._c_aborts.inc()
+            if self._tel.enabled:
+                tr = self._tel.tracer
+                tr.end(req.rid, "prefill", aborted=True)
+                tr.begin(req.rid, "queued", reason="prefill_abort")
         else:
             n = int(self.pos[s])
             req.table = self.alloc.truncate(req.table, n)
@@ -1037,6 +1122,12 @@ class GenerationServer:
             req.table = []
             ent.swap = handle
             self._preemptions += 1
+            self._c_preempt.inc()
+            if self._tel.enabled:
+                # spans the time parked on host; swap_out/swap_in spans
+                # come from the offload engine itself
+                self._tel.tracer.begin(req.rid, "preempted",
+                                       blocks=handle.n_blocks)
         self._slots[s] = None
         self._bt[s, :] = 0
         self._prefilling[s] = None
@@ -1080,6 +1171,7 @@ class GenerationServer:
                 if self._preempt_slot(s):
                     return "gone"
                 self._stalls += 1
+                self._c_stalls.inc()
                 return "stall"
 
     def _reserve_active(self, active, need_fn) -> List[int]:
@@ -1122,10 +1214,15 @@ class GenerationServer:
         last_idx = (n - 1 - start) if end == n else 0
         aidx = (jnp.asarray(self.aidx[slot:slot + 1])
                 if self._lora is not None else None)
+        tel = self._tel
+        _t0 = tel.clock() if tel.enabled else 0.0
         lg, self._pools = self._chunk_prefill(
             self.params, jnp.asarray(chunk), self._pools,
             jnp.asarray(self._bt[slot]), jnp.int32(start),
             jnp.int32(last_idx), aidx, self._lora_flat())
+        if tel.enabled:
+            tel.tracer.complete(req.rid, "prefill_chunk", _t0, tel.clock(),
+                                start=start, tokens=end - start)
         # publish the prompt blocks this chunk completed for prefix reuse
         for i in range(start // bs, end // bs):
             self.alloc.register(req.table[i], req.hashes[i])
@@ -1142,13 +1239,65 @@ class GenerationServer:
         return all(float(self.temps[s]) == 0.0 for s in rows)
 
     def _step_paged(self) -> int:
+        tel = self._tel
+        if not tel.enabled:
+            return self._step_paged_inner()
+        # flight recording wraps the whole tick: counter/allocator deltas
+        # plus the backend-compile delta (recompile_guard's jax.monitoring
+        # listener) keyed by the program the tick dispatched
+        from ..analysis.recompile_guard import compile_count
+
+        a = self.alloc
+        t0 = tel.clock()
+        c0 = compile_count()
+        pre = (self._preemptions, self._prefill_aborts, self._resumes,
+               self._stalls, a.fresh_allocs, a.evictions,
+               a.swap_out_blocks, a.swap_in_blocks)
+        sp0, sa0 = ((self._spec_proposed, self._spec_accepted)
+                    if self.spec is not None else (0, 0))
+        remaining = self._step_paged_inner()
+        rec = {
+            "t_wall_s": tel.clock() - t0,
+            "prog": self._last_prog,
+            "decoding": sum(1 for s in range(self.max_batch)
+                            if self._slots[s] is not None
+                            and not self._prefilling[s]),
+            "prefilling": sum(1 for s in range(self.max_batch)
+                              if self._prefilling[s]),
+            "queue_depth": len(self._sched),
+            "blocks_in_use": a.blocks_in_use,
+            "blocks_allocated": a.fresh_allocs - pre[4],
+            "evictions": a.evictions - pre[5],
+            "preemptions": self._preemptions - pre[0],
+            "prefill_aborts": self._prefill_aborts - pre[1],
+            "resumes": self._resumes - pre[2],
+            "stalls": self._stalls - pre[3],
+            "swap_out_blocks": a.swap_out_blocks - pre[6],
+            "swap_in_blocks": a.swap_in_blocks - pre[7],
+            "swap_bytes": (a.swap_out_blocks - pre[6]
+                           + a.swap_in_blocks - pre[7]) * a.bytes_per_block,
+            "host_bytes": self._offload.host.bytes_in_use,
+            "recompiles": compile_count() - c0,
+        }
+        if self.spec is not None:
+            rec["spec_proposed"] = self._spec_proposed - sp0
+            rec["spec_accepted"] = self._spec_accepted - sa0
+        tel.flight.record(**rec)
+        return remaining
+
+    def _step_paged_inner(self) -> int:
+        tel_on = self._tel.enabled
+        if tel_on:
+            self._last_prog = "idle"
         self._service_queue()
         # chunked prefill interleaves with decode: ONE chunk per prefilling
         # slot per step, so a long prompt never blocks slots mid-decode
         # (no head-of-line blocking) and short requests keep streaming out
+        did_prefill = False
         for s in range(self.max_batch):
             if self._slots[s] is not None and self._prefilling[s]:
                 self._prefill_chunk_step(s)
+                did_prefill = True
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None and not self._prefilling[s]]
         if active:
@@ -1168,6 +1317,12 @@ class GenerationServer:
                     self._spec_tick(active)
             else:
                 self._plain_decode_trip(active)
+        if tel_on and did_prefill:
+            # prefill-bearing ticks get their own program-key suffix: the
+            # chunk program's (and first-token sampling's) one-time
+            # compiles must not read as steady-state recompiles of an
+            # already-warm decode program
+            self._last_prog += "+pf"
         occupied = sum(sl is not None for sl in self._slots)
         if occupied == 0 and len(self._sched) > 0:
             # every slot empty yet entries wait: admission must succeed
@@ -1187,10 +1342,20 @@ class GenerationServer:
         ``tick_window``) ticks in one compiled program across the listed
         slots."""
         k = self.tick_window if ticks is None else ticks
+        tel = self._tel
         active = self._reserve_active(
             active, lambda s: -(-(int(self.pos[s]) + k) // self.block_size))
         if not active:
+            if tel.enabled:
+                self._last_prog = "stalled"
             return
+        if tel.enabled:
+            # program key: tick count + greedy specialization are the
+            # static jit-cache axes of the plain decode program
+            self._last_prog = (f"plain:t{'w' if ticks is None else ticks}"
+                               f":g{int(self._all_greedy(active))}")
+            _t0 = tel.clock()
+            _rids = [self._slots[s].rid for s in active]
         # the greedy-specialized programs never read the key — skip the
         # per-step eager fold_in dispatch (~0.4ms) for it
         key = (self._base_key if self._all_greedy(active)
@@ -1208,6 +1373,13 @@ class GenerationServer:
             jnp.asarray(active_mask), key, aidx, self._lora_flat(),
             self._all_greedy(active), ticks)
         self._harvest_window(np.asarray(stack), active, active_mask)
+        if tel.enabled:
+            # retroactive: one shared device trip advanced every listed
+            # row, so each request gets the same-walled span (the host
+            # sync happened inside the harvest's np.asarray)
+            _t1 = tel.clock()
+            for rid in _rids:
+                tel.tracer.complete(rid, "decode_window", _t0, _t1, ticks=k)
 
     # ----------------------------------------------------------- speculative
     def _spec_tick(self, active) -> None:
@@ -1223,11 +1395,20 @@ class GenerationServer:
             S = self.spec.turbo_windows
         # reserve blocks for every window of the trip up front (speculative
         # append); rejected-draft tail entries are truncated back in harvest
+        tel = self._tel
         active = self._reserve_active(
             active, lambda s: -(-(int(self.pos[s]) + S * (k + 1)) //
                                 self.block_size))
         if not active:
+            if tel.enabled:
+                self._last_prog = "stalled"
             return
+        if tel.enabled:
+            self._last_prog = (f"spec:w{S}"
+                               f":g{int(self._all_greedy(active))}")
+            _t0 = tel.clock()
+            _rids = [(s, self._slots[s].rid) for s in active]
+            _kc = {s: int(self.kcaps[s]) for s in active}
         key = (self._base_key if self._all_greedy(active)
                else jax.random.fold_in(self._base_key, self._step_no))
         active_mask = np.zeros((self.max_batch,), np.int32)
@@ -1267,6 +1448,14 @@ class GenerationServer:
             outs, accs = np.asarray(out)[None], np.asarray(acc)[None]
         accs = np.asarray(accs)
         self._harvest_spec(np.asarray(outs), accs, active)
+        if tel.enabled:
+            _t1 = tel.clock()
+            for s, rid in _rids:
+                tel.tracer.complete(
+                    rid, "spec_window", _t0, _t1,
+                    windows=int(accs.shape[0]),
+                    accepted=int(accs[:, s].sum()),
+                    proposed=int(accs.shape[0]) * _kc[s])
         if self.spec.gate_cooldown:
             m = float(accs[:, active].mean())
             # below gate_low mean accepted drafts/window, drafting is a
@@ -1373,13 +1562,31 @@ class GenerationServer:
                 "gated_plain_windows": self._spec_plain_windows}
 
     def _emit_result(self, req: _Request) -> None:
-        """A request finished: publish its tokens, close its metrics."""
+        """A request finished: publish its tokens, close its metrics —
+        TTFT/TPOT are observed HERE (at completion) into the registry
+        histograms, making the tenant breakdown and the benchmark's
+        percentiles two views of the same samples."""
         self._results[req.rid] = req.prompt + req.generated[
             :req.max_new_tokens]
         m = self._req_metrics.get(req.rid)
         if m is not None:
             m["done_t"] = self._wall()
             m["n_generated"] = min(len(req.generated), req.max_new_tokens)
+            tenant = m.get("tenant", "default")
+            pr = (req.sched.priority if req.sched is not None
+                  else PRIORITY_NORMAL)
+            self._c_completed.inc(tenant=tenant)
+            if "first_token_t" in m:
+                self._h_ttft.observe(m["first_token_t"] - m["submit_t"],
+                                     tenant=tenant, priority=pr)
+                self._h_e2e.observe(m["done_t"] - m["submit_t"],
+                                    tenant=tenant)
+                n = int(m["n_generated"])
+                if n > 1:
+                    self._h_tpot.observe(
+                        (m["done_t"] - m["first_token_t"]) / (n - 1) * 1e3,
+                        tenant=tenant)
+        self._tel.tracer.close(req.rid, "complete")
 
     # ---------------------------------------------------- request lifecycle
     def cancel(self, rid: int) -> bool:
@@ -1402,9 +1609,11 @@ class GenerationServer:
                 if self.cache_mode == "paged":
                     req.table = self.alloc.truncate(req.table, 0)
                 self._dropped[rid] = "cancelled"
+                self._c_dropped.inc(reason="cancelled")
                 m = self._req_metrics.get(rid)
                 if m is not None:
                     m["done_t"] = self._wall()
+                self._tel.tracer.close(rid, "cancelled")
                 self._release_slot(s)
                 return True
         return False
@@ -1433,16 +1642,23 @@ class GenerationServer:
         """Scheduler + preemption counters (all cache modes; swap fields
         appear on the paged path only; adapter-pool fields and the
         per-tenant TTFT/TPOT breakdown when ``lora=`` is configured)."""
+        # thin view over the metrics registry: the counters below ARE the
+        # values the registry exposes via to_json()/to_prometheus() — the
+        # dict shape is the stable public contract, the registry is the
+        # store (attach_metrics seeds scheduler history, so totals always
+        # match the legacy int attributes)
+        reg = self._tel.registry
         m = {"policy": self._sched.policy,
              "queue_depth": len(self._sched),
-             "submitted": self._sched.submitted,
-             "expired": self._sched.expired,
-             "cancelled": sum(1 for v in self._dropped.values()
-                              if v == "cancelled"),
-             "preemptions": self._preemptions,
-             "prefill_aborts": self._prefill_aborts,
-             "resumes": self._resumes,
-             "stalled_reservations": self._stalls}
+             "submitted": int(reg.counter(
+                 "sched_requests_submitted").total()),
+             "expired": int(reg.counter("sched_requests_expired").total()),
+             "cancelled": int(self._c_dropped.total(
+                 where={"reason": "cancelled"})),
+             "preemptions": int(self._c_preempt.total()),
+             "prefill_aborts": int(self._c_aborts.total()),
+             "resumes": int(self._c_resumes.total()),
+             "stalled_reservations": int(self._c_stalls.total())}
         if self.cache_mode == "paged":
             m["host_bytes_in_use"] = self._offload.host.bytes_in_use
             m["host_bytes_peak"] = self._offload.host.bytes_peak
@@ -1456,27 +1672,22 @@ class GenerationServer:
     def _tenant_breakdown(self) -> Dict[str, Dict[str, float]]:
         """Per-tenant latency percentiles over COMPLETED requests: TTFT
         (submit → first token) and TPOT (per-token after the first) p50 /
-        p95 — the multi-tenant fairness view the benchmark reports."""
-        buckets: Dict[str, Dict[str, List[float]]] = {}
-        for rm in self._req_metrics.values():
-            t = rm.get("tenant")
-            if t is None or "done_t" not in rm or "first_token_t" not in rm:
-                continue
-            b = buckets.setdefault(t, {"ttft": [], "tpot": []})
-            b["ttft"].append(rm["first_token_t"] - rm["submit_t"])
-            n = int(rm.get("n_generated", 0))
-            if n > 1:
-                b["tpot"].append(
-                    (rm["done_t"] - rm["first_token_t"]) / (n - 1))
+        p95 — the multi-tenant fairness view the benchmark reports. A
+        thin view over the registry's ``serving_ttft_s`` /
+        ``serving_tpot_ms`` histograms (observed at completion in
+        ``_emit_result``), so these numbers and the exposition formats
+        can never drift apart."""
         out: Dict[str, Dict[str, float]] = {}
-        for t, b in buckets.items():
-            row = {"completed": float(len(b["ttft"]))}
-            for name, xs in b.items():
-                if xs:
-                    row[f"{name}_p50_ms"] = float(
-                        np.percentile(xs, 50) * 1e3)
-                    row[f"{name}_p95_ms"] = float(
-                        np.percentile(xs, 95) * 1e3)
+        for t in self._h_ttft.label_values("tenant"):
+            xs = self._h_ttft.samples({"tenant": t})
+            row = {"completed": float(len(xs))}
+            if xs:
+                row["ttft_p50_ms"] = float(np.percentile(xs, 50) * 1e3)
+                row["ttft_p95_ms"] = float(np.percentile(xs, 95) * 1e3)
+            tp = self._h_tpot.samples({"tenant": t})
+            if tp:
+                row["tpot_p50_ms"] = float(np.percentile(tp, 50))
+                row["tpot_p95_ms"] = float(np.percentile(tp, 95))
             out[t] = row
         return out
 
@@ -1513,6 +1724,44 @@ class GenerationServer:
         if self.cache_mode != "paged":
             return {}
         return self.alloc.stats()
+
+    # ------------------------------------------------------------ telemetry
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Sync point-in-time gauges (pool occupancy, adapter pool, spec
+        counters, queue depth) into the registry, then return the full
+        telemetry blob: registry JSON (histograms carry computed
+        p50/p95), watchdog findings over the flight ring, and the serving
+        configuration the numbers were produced under."""
+        reg = self._tel.registry
+        reg.gauge("serving_queue_depth").set(float(len(self._sched)))
+        reg.gauge("serving_slots_occupied").set(
+            float(sum(sl is not None for sl in self._slots)))
+        reg.gauge("serving_slots_total").set(float(self.max_batch))
+        if self.cache_mode == "paged":
+            self.alloc.publish(reg)
+            for k, v in self._offload.host.stats().items():
+                reg.gauge(f"serving_host_pool_{k}").set(float(v))
+        if self._lora is not None:
+            for k, v in self._lora.stats().items():
+                reg.gauge(f"serving_{k}").set(float(v))
+        for k, v in self.spec_metrics().items():
+            reg.gauge(f"serving_spec_{k}").set(float(v))
+        snap = self._tel.snapshot()
+        snap["config"] = {"cache": self.cache_mode,
+                          "max_batch": self.max_batch,
+                          "max_len": self.max_len,
+                          "tick_window": self.tick_window,
+                          "kv_quant": self.kv_quant,
+                          "policy": self._sched.policy}
+        if self.spec is not None:
+            snap["config"]["spec"] = self.spec.describe()
+        return snap
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the span tracer's chrome trace (one timeline row per
+        request — queued/prefill/decode/spec/preempt/swap spans). Open in
+        chrome://tracing or Perfetto; empty when telemetry is disabled."""
+        return self._tel.export_chrome_trace(path)
 
     # ------------------------------------------------------------- stepping
     def _harvest_window(self, nxt_host, active, active_mask) -> None:
@@ -1567,6 +1816,11 @@ class GenerationServer:
         (occupied slots + queued)."""
         if self.cache_mode == "paged":
             return self._step_paged()
+        tel = self._tel
+        if tel.enabled:
+            from ..analysis.recompile_guard import compile_count
+            _tt0 = tel.clock()
+            _c0 = compile_count()
         self._service_queue()
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None]
@@ -1576,6 +1830,9 @@ class GenerationServer:
         key = jax.random.fold_in(self._base_key, self._step_no)
         active_mask = np.zeros((self.max_batch,), np.int32)
         active_mask[active] = 1
+        if tel.enabled:
+            _t0 = tel.clock()
+            _rids = [self._slots[s].rid for s in active]
         # only occupied slots advance — idle slots must not drift their
         # write position (their garbage scatters would eventually go OOB)
         stack, self._caches = self._decode(
@@ -1584,6 +1841,15 @@ class GenerationServer:
             jnp.asarray(self.topks), jnp.asarray(self.topps),
             jnp.asarray(active_mask), key)
         self._harvest_window(np.asarray(stack), active, active_mask)
+        if tel.enabled:
+            _t1 = tel.clock()
+            for rid in _rids:
+                tel.tracer.complete(rid, "decode_window", _t0, _t1,
+                                    ticks=self.tick_window)
+            tel.flight.record(t_wall_s=_t1 - _tt0, prog="dense",
+                              decoding=len(active),
+                              queue_depth=len(self._sched),
+                              recompiles=compile_count() - _c0)
         return sum(sl is not None for sl in self._slots) + len(self._sched)
 
     def run(self) -> Dict[int, List[int]]:

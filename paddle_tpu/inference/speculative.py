@@ -44,7 +44,7 @@ program — nothing extra crosses the host boundary):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -465,6 +465,20 @@ class SpecConfig:
             raise ValueError(f"spec.turbo_windows must be an int >= 0 "
                              f"(0 disables the turbo tier), got "
                              f"{self.turbo_windows!r}")
+
+    def describe(self) -> Dict[str, Any]:
+        """Flat JSON-safe knob dict for telemetry snapshots — records the
+        speculation configuration next to the numbers it produced (an
+        acceptance rate is meaningless without k and the gate settings)."""
+        return {"k": self.k,
+                "drafter": (self.drafter if isinstance(self.drafter, str)
+                            else type(self.drafter).__name__),
+                "ngram_max": self.ngram_max, "ngram_min": self.ngram_min,
+                "sample_draft": self.sample_draft,
+                "gate_low": self.gate_low,
+                "gate_cooldown": self.gate_cooldown,
+                "gate_ticks": self.gate_ticks,
+                "turbo_windows": self.turbo_windows}
 
     def build_drafter(self, max_len: int):
         if not isinstance(self.drafter, str):

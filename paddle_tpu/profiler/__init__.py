@@ -39,14 +39,19 @@ class _HostEventRecorder:
         self._lock = threading.Lock()
         self.enabled = False
 
-    def add(self, name: str, ts: float, dur: float, cat: str = "op"):
+    def add(self, name: str, ts: float, dur: float, cat: str = "op",
+            tid: Optional[int] = None, args: Optional[dict] = None):
         if not self.enabled:
             return
+        ev = {
+            "name": name, "ph": "X", "ts": ts * 1e6, "dur": dur * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() if tid is None else tid, "cat": cat,
+        }
+        if args:
+            ev["args"] = args
         with self._lock:
-            self._events.append({
-                "name": name, "ph": "X", "ts": ts * 1e6, "dur": dur * 1e6,
-                "pid": os.getpid(), "tid": threading.get_ident(), "cat": cat,
-            })
+            self._events.append(ev)
 
     def drain(self) -> List[dict]:
         with self._lock:

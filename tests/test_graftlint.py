@@ -291,6 +291,58 @@ class TestAdapterBranchInJitGL009:
         """)
 
 
+class TestTelemetryInJitGL010:
+    def test_counter_inc_inside_jitted_fn(self):
+        assert "GL010" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def decode(x, metrics):
+                metrics.counter.inc()
+                return x * 2
+        """)
+
+    def test_span_begin_at_jit_callsite(self):
+        assert "GL010" in rule_ids("""
+            import jax
+            def step(x, tracer):
+                tracer.begin(0, "decode")
+                return x + 1
+            fast = jax.jit(step)
+        """)
+
+    def test_private_telemetry_attr_detected(self):
+        assert "GL010" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def step(self, x):
+                self._tel.registry.histogram("h").observe(1.0)
+                return x
+        """)
+
+    def test_host_side_telemetry_ok(self):
+        # recording around the compiled call is the sanctioned pattern
+        assert "GL010" not in rule_ids("""
+            def tick(self, x):
+                t0 = self.telemetry.clock()
+                out = self._decode_fn(x)
+                self.telemetry.registry.histogram("h").observe(1.0)
+                return out
+        """)
+
+    def test_unrelated_set_call_ok(self):
+        # .set() on a non-telemetry receiver (jnp .at[].set etc.) is fine
+        assert "GL010" not in rule_ids("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(pool, idx, v):
+                return pool.at[idx].set(v)
+        """)
+
+
 class TestSyntaxErrorGL000:
     def test_unparseable_module_reports_gl000(self):
         assert rule_ids("def broken(:\n    pass") == ["GL000"]
@@ -432,7 +484,7 @@ class TestRepoGate:
              "--list-rules"], capture_output=True, text=True)
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                    "GL007", "GL008", "GL009"):
+                    "GL007", "GL008", "GL009", "GL010"):
             assert rid in r.stdout
 
 

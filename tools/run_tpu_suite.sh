@@ -147,4 +147,40 @@ if ratio < 0.8:
                      "BGMV delta or adapter gather regressed")
 PY
 
+echo "== 7d. telemetry smoke (span trace + flight recorder under bursty LoRA+spec) =="
+python tools/serving_benchmark.py --paged --spec 4 --repeat-suffix \
+  --kv-quant int8 --lora-adapters 4 --lora-rank 4 --lora-live 2 \
+  --scheduler wfq --arrival-rate 400 --burst 4 --seed 5 \
+  --telemetry-out /tmp/tpu_runs/telemetry --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_telemetry.json \
+  || { echo "telemetry serving pass FAILED (crash)"; exit 1; }
+python tools/serving_benchmark.py --paged --spec 4 --repeat-suffix \
+  --kv-quant int8 --lora-adapters 4 --lora-rank 4 --lora-live 2 \
+  --scheduler wfq --arrival-rate 400 --burst 4 --seed 5 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_telemetry_off.json
+python - <<'PY'
+# telemetry gate: the chrome trace must parse non-empty, the flight
+# watchdog must report ZERO steady-state recompiles on the full stack
+# (spec + int8 KV + LoRA + WFQ under bursty arrivals), and telemetry-on
+# tok/s must hold >=95% of the telemetry-off run — the overhead contract
+# (host-side spans/ring only, nothing inside compiled programs)
+import json
+on = json.load(open("/tmp/tpu_runs/serving_telemetry.json"))
+off = json.load(open("/tmp/tpu_runs/serving_telemetry_off.json"))
+trace = json.load(open("/tmp/tpu_runs/telemetry.trace.json"))
+flight = json.load(open("/tmp/tpu_runs/telemetry.flight.json"))
+assert trace["traceEvents"], "chrome trace empty — spans never recorded"
+bad = [f for f in flight["watchdog"]
+       if f["kind"] == "steady_state_recompile"]
+assert not bad, f"steady-state recompiles under telemetry: {bad}"
+ratio = on["value"] / off["value"]
+print(f"telemetry-on/off tok/s ratio: {ratio:.3f} "
+      f"({len(trace['traceEvents'])} trace events, "
+      f"{len(flight['ticks'])} flight ticks, "
+      f"watchdog findings: {[f['kind'] for f in flight['watchdog']]})")
+if ratio < 0.95:
+    raise SystemExit("telemetry overhead above 5% — the span/ring path is "
+                     "leaking work into the measured drain")
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

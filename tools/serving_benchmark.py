@@ -161,6 +161,15 @@ def main():
     ap.add_argument("--host-pool-mb", type=float, default=None,
                     help="cap the host swap pool (default unbounded); "
                          "0 disables swapping — victims stall instead")
+    ap.add_argument("--telemetry-out", metavar="PATH", default=None,
+                    help="enable serving telemetry (span tracer + flight "
+                         "recorder) and dump PATH.metrics.json (registry "
+                         "snapshot + watchdog findings), PATH.trace.json "
+                         "(chrome trace: one timeline row per request), "
+                         "and PATH.flight.json (per-tick flight ring) "
+                         "after the drain. The TTFT/TPOT percentiles in "
+                         "the JSON line come from the same registry "
+                         "histograms either way")
     ap.add_argument("--json", action="store_true",
                     help="emit exactly one machine-readable JSON line "
                          "(bench.py style) on stdout and nothing else")
@@ -352,14 +361,15 @@ def main():
                 prefill_chunk=args.prefill_chunk, spec=spec,
                 kv_quant=args.kv_quant, pool_bytes=pool_bytes,
                 policy=args.scheduler, host_pool_bytes=host_pool,
-                lora=lora_cfg)
+                lora=lora_cfg, telemetry=bool(args.telemetry_out))
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
                                                 if args.long_prompts
                                                 else (32, 64, 128)),
                                 tick_window=args.tick_window,
-                                policy=args.scheduler)
+                                policy=args.scheduler,
+                                telemetry=bool(args.telemetry_out))
 
     # CPU smoke runs don't touch the chip — don't serialize on its lock
     lock = tpu_lock(timeout_s=900.0) if on_tpu else \
@@ -371,6 +381,10 @@ def main():
         # warmup drain: compiles the decode tick + the prefill program(s)
         burst(server, min(args.slots, 4))
         server.run()
+        # warmup boundary: drop histogram samples, spans, and flight
+        # ticks so registry percentiles (and any --telemetry-out dump)
+        # cover the measured drain only; counters keep lifetime totals
+        server.telemetry.reset()
 
         # pre-draw the whole open-loop arrival timeline from the seeded
         # rng — the trace is fixed before the clock starts, so it cannot
@@ -411,19 +425,17 @@ def main():
     p50 = lats[len(lats) // 2]
     p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))]
 
-    def pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
-
     # TTFT (submit -> first generated token, queue wait included) and
-    # per-token decode latency, from the server's per-request marks
-    rm = server.request_metrics()
-    ttft = {r: rm[r]["first_token_t"] - rm[r]["submit_t"]
-            for r in rids if "first_token_t" in rm.get(r, {})}
-    tpot_ms = [1e3 * (m["done_t"] - m["first_token_t"])
-               / (m["n_generated"] - 1)
-               for r in rids for m in [rm.get(r, {})]
-               if "done_t" in m and m.get("n_generated", 0) > 1]
+    # per-token decode latency — read from the registry histograms the
+    # server feeds in _emit_result (telemetry.MetricsRegistry is the one
+    # source of truth; the warmup reset above scoped the samples to the
+    # measured drain, so no per-rid filtering is needed here)
+    reg = server.telemetry.registry
+
+    def hpct(name, q, **where):
+        v = reg.percentile(name, q, where=where or None)
+        return v if v is not None else 0.0
+
     line = {"metric": "serving_continuous_batching_tok_s_1chip",
             "value": round(gen_tokens / dt, 1),
             "unit": f"generated tok/s ({args.requests} reqs, {args.slots} "
@@ -436,17 +448,17 @@ def main():
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
             "wall_s": round(dt, 2),
             "seed": args.seed, "scheduler": args.scheduler,
-            "ttft_p50_s": round(pct(list(ttft.values()), 0.50) or 0.0, 4),
-            "ttft_p95_s": round(pct(list(ttft.values()), 0.95) or 0.0, 4),
-            "tpot_p50_ms": round(pct(tpot_ms, 0.50) or 0.0, 3),
-            "tpot_p95_ms": round(pct(tpot_ms, 0.95) or 0.0, 3)}
+            "ttft_p50_s": round(hpct("serving_ttft_s", 50), 4),
+            "ttft_p95_s": round(hpct("serving_ttft_s", 95), 4),
+            "tpot_p50_ms": round(hpct("serving_tpot_ms", 50), 3),
+            "tpot_p95_ms": round(hpct("serving_tpot_ms", 95), 3)}
     if args.arrival_rate is not None:
         line["arrival_rate"] = args.arrival_rate
         line["burst"] = args.burst
     if args.mixed_priority:
         for cls, name in ((0, "high"), (1, "normal"), (2, "low")):
-            xs = [v for r, v in ttft.items() if prios.get(r) == cls]
-            line[f"ttft_p95_s_{name}"] = round(pct(xs, 0.95) or 0.0, 4)
+            line[f"ttft_p95_s_{name}"] = round(
+                hpct("serving_ttft_s", 95, priority=str(cls)), 4)
     sm = server.sched_metrics()
     if sm["preemptions"] or sm["prefill_aborts"] or sm["expired"] \
             or args.pool_frac is not None or args.scheduler != "fifo":
@@ -490,6 +502,18 @@ def main():
         line["acceptance_rate"] = round(sm["acceptance_rate"], 4)
         line["draft_tokens_proposed"] = sm["draft_tokens_proposed"]
         line["draft_tokens_accepted"] = sm["draft_tokens_accepted"]
+    if args.telemetry_out:
+        base = args.telemetry_out
+        d = os.path.dirname(base)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(base + ".metrics.json", "w") as f:
+            json.dump(server.telemetry_snapshot(), f, indent=1)
+        server.export_chrome_trace(base + ".trace.json")
+        with open(base + ".flight.json", "w") as f:
+            json.dump({"ticks": server.telemetry.flight.dump(),
+                       "watchdog": server.telemetry.watchdog()}, f, indent=1)
+        line["telemetry_out"] = base
     if not locked:
         line["lock_contended"] = True
     print(json.dumps(line))
