@@ -1,0 +1,99 @@
+"""Fault-injection hygiene: no injector/recovery hooks inside jitted bodies.
+
+The fault-injection contract (``inference/faults.py``) is host-only, like
+telemetry (GL010): ``fire()`` sites run BETWEEN device programs so an
+injected exception raises before compiled dispatch — donated buffers are
+still intact and the trip can be retried verbatim. A hook inside a jitted
+function is doubly wrong: it fires once at trace time (so the scripted
+plan's ordinals never advance in steady state and the fault never lands
+where scheduled), and an exception escaping a traced body after dispatch
+may have consumed donated pool buffers, turning a recoverable injected
+fault into real corruption.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+
+@register
+class FaultHookInJitRule(Rule):
+    """GL011: fault-injection or recovery hooks inside a function this
+    module jit-compiles. Injection is a host-side protocol; inside a
+    traced body the hook fires at trace time only, and a fault raised
+    mid-program lands after donation — unretryable by construction."""
+
+    id = "GL011"
+    name = "fault-hook-in-jit"
+    description = ("fault-injection/recovery hooks (fire/corrupt/"
+                   "wrap_clock) inside a jitted function run at trace "
+                   "time only and can raise after buffer donation — "
+                   "hooks belong on the host side, before compiled "
+                   "dispatch (inference/faults.py is host-only by "
+                   "contract)")
+
+    # receiver components that name an injector outright
+    _RECV_EXACT = frozenset({
+        "faults", "injector", "fault_injector", "chaos",
+    })
+    # receiver components that name one by convention
+    _RECV_SUBSTR = ("fault", "inject", "chaos")
+    # the injector API surface
+    _METHODS = frozenset({
+        "fire", "corrupt", "wrap_clock",
+    })
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.jitted_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in ctx.jitted_names:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                hit = self._fault_call(sub)
+                if hit is not None:
+                    recv, meth = hit
+                    yield self.finding(
+                        ctx, sub,
+                        f"{recv}.{meth}() inside jitted '{node.name}' — "
+                        f"fault hooks are host-only: at trace time the "
+                        f"plan's ordinals freeze, and a fault raised "
+                        f"inside the program lands after donation; hook "
+                        f"before the compiled call on the host side")
+
+    @classmethod
+    def _fault_call(cls, call: ast.Call) -> Optional[tuple]:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        meth = func.attr
+        if meth not in cls._METHODS:
+            return None
+        # walk the receiver, peeling intermediate get-or-create calls
+        # (server.faults.fire(...)); a subscript root yields no
+        # components and stays clean
+        parts = []
+        node = func.value
+        while True:
+            if isinstance(node, ast.Call):
+                node = node.func
+            elif isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            elif isinstance(node, ast.Name):
+                parts.append(node.id)
+                break
+            else:
+                break
+        for part in parts:
+            low = part.lstrip("_").lower()
+            if low in cls._RECV_EXACT or any(
+                    s in low for s in cls._RECV_SUBSTR):
+                return ".".join(reversed(parts)), meth
+        return None

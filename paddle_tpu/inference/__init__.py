@@ -252,8 +252,10 @@ def load_predictor(path: str) -> Predictor:
     return Predictor(fn, params, names, [])
 
 
+from .faults import (NULL_INJECTOR, EngineFailedError,  # noqa: E402,F401
+                     FaultInjector, FaultPlan, FaultSpec, TickFault)
 from .kv_offload import (HostKVPool, KVOffloadEngine,  # noqa: E402,F401
-                         SwapHandle)
+                         SwapHandle, payload_checksum)
 from .lora import (Adapter, AdapterPool, AdapterRegistry,  # noqa: E402,F401
                    LoRAConfig, adapter_page_bytes)
 from .paged_cache import BlockAllocator  # noqa: E402,F401
@@ -261,7 +263,7 @@ from .scheduler import (PRIORITY_HIGH, PRIORITY_LOW,  # noqa: E402,F401
                         PRIORITY_NORMAL, AdmissionError, SchedEntry,
                         Scheduler)
 from .serving import GenerationServer  # noqa: E402,F401
-from .speculative import (DraftModelDrafter, NgramDrafter,  # noqa: E402,F401
-                          SpecConfig)
+from .speculative import (DrafterFault, DraftModelDrafter,  # noqa: E402,F401
+                          NgramDrafter, SpecConfig)
 from .telemetry import (FlightRecorder, MetricsRegistry,  # noqa: E402,F401
                         ServingTelemetry, SpanTracer, watchdog)

@@ -40,12 +40,28 @@ participating in prefix sharing.
 """
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-__all__ = ["SwapHandle", "HostKVPool", "KVOffloadEngine"]
+__all__ = ["SwapHandle", "HostKVPool", "KVOffloadEngine",
+           "payload_checksum"]
+
+
+def payload_checksum(arrays: Sequence[np.ndarray]) -> int:
+    """CRC32 over a parked payload's raw bytes (order-sensitive).
+
+    Cheap enough to run on every swap boundary and strong enough to catch
+    the single-bit-flip corruption the chaos plans inject; a mismatch on
+    swap-in means the parked copy cannot be trusted and the server falls
+    back to re-prefilling the request's tokens.
+    """
+    c = 0
+    for a in arrays:
+        c = zlib.crc32(np.ascontiguousarray(a).reshape(-1).view(np.uint8), c)
+    return c
 
 
 @dataclass
@@ -61,6 +77,7 @@ class SwapHandle:
     n_blocks: int            # live table entries parked on host
     hashes: List[int] = field(default_factory=list)  # leading full-prompt-block chain hashes
     nbytes: int = 0          # logical bytes charged to the host pool
+    checksum: int = 0        # CRC32 of the parked payload (0 = unverified)
 
 
 class HostKVPool:
@@ -103,6 +120,11 @@ class HostKVPool:
         self.takes += 1
         return arrays
 
+    def peek(self, rid: int) -> List[np.ndarray]:
+        """Read a parked payload without removing it — snapshot() copies
+        already-swapped requests' KV through this."""
+        return self._store[rid]
+
     def discard(self, rid: int, nbytes: int) -> None:
         if self._store.pop(rid, None) is not None:
             self.bytes_in_use -= nbytes
@@ -135,6 +157,34 @@ class KVOffloadEngine:
         # serving_swap_{out,in}_s histograms. The copies themselves are
         # untouched — timing wraps the whole eager d2h/h2d sequence.
         self.telemetry = None
+        # optional FaultInjector (inference/faults.py): host-pool refusal
+        # and swap-payload corruption hooks for chaos plans
+        self.faults = None
+
+    # ------------------------------------------------------------ KV capture
+    def gather_payload(self, table: Sequence[int],
+                       pools: List[Any]) -> List[np.ndarray]:
+        """Non-destructive fixed-width device→host gather of a table's
+        blocks — the same one-compile program :meth:`swap_out` rides, so
+        ``GenerationServer.snapshot()`` can capture a warm server's KV
+        without compiling anything new. Blocks are pinned for the copy
+        and left exactly as they were."""
+        import jax.numpy as jnp
+
+        a = self.alloc
+        idx = np.zeros((self.table_width,), np.int32)
+        idx[:len(table)] = table
+        for bid in table:                 # freeze against LRU churn mid-copy
+            a.pin(bid)
+        try:
+            didx = jnp.asarray(idx)
+            # the d2h pull IS the point — one sync per pool tensor,
+            # outside any trace
+            arrays = [np.asarray(p[didx]) for p in pools]  # graftlint: noqa[host-sync]
+        finally:
+            for bid in table:
+                a.unpin(bid)
+        return arrays
 
     # ------------------------------------------------------------- swap out
     def swap_out(self, rid: int, table: Sequence[int], hashes: Sequence[int],
@@ -144,31 +194,20 @@ class KVOffloadEngine:
 
         ``table`` must already be truncated to exactly the blocks covering
         ``n_tokens`` (the server drops speculative reservations first).
-        Returns None — and changes nothing — when the host pool is full.
+        Returns None — and changes nothing — when the host pool is full
+        (or an injected ``host_put`` fault says it is).
         """
-        import jax.numpy as jnp
-
         tel = self.telemetry
         _t0 = tel.clock() if tel is not None and tel.enabled else None
         a = self.alloc
         n = len(table)
         nbytes = n * a.bytes_per_block
+        if self.faults is not None and self.faults.fire("host_put") is not None:
+            return None
         if not self.host.fits(nbytes):
             return None
-        # fixed-width gather: pad the index vector with the scratch block
-        # so every swap runs the same-shaped copy (see module docstring)
-        idx = np.zeros((self.table_width,), np.int32)
-        idx[:n] = table
-        for bid in table:                 # freeze against LRU churn mid-copy
-            a.pin(bid)
-        try:
-            didx = jnp.asarray(idx)
-            # the d2h pull IS the point of offload — one sync per pool
-            # tensor, outside any trace
-            arrays = [np.asarray(p[didx]) for p in pools]  # graftlint: noqa[host-sync]
-        finally:
-            for bid in table:
-                a.unpin(bid)
+        arrays = self.gather_payload(table, pools)
+        checksum = payload_checksum(arrays)
         if not self.host.put(rid, arrays, nbytes):
             return None
         for bid in table:
@@ -186,7 +225,8 @@ class KVOffloadEngine:
                                 blocks=n, bytes=nbytes)
         return SwapHandle(rid=rid, n_tokens=int(n_tokens),
                           last_token=int(last_token), n_blocks=n,
-                          hashes=list(hashes), nbytes=nbytes)
+                          hashes=list(hashes), nbytes=nbytes,
+                          checksum=checksum)
 
     # -------------------------------------------------------------- swap in
     def restore_cost(self, handle: SwapHandle) -> int:
@@ -194,15 +234,18 @@ class KVOffloadEngine:
         can only lower it) — the server's admission headroom check."""
         return handle.n_blocks
 
-    def swap_in(self, handle: SwapHandle,
-                pools: List[Any]) -> Optional[Tuple[List[int], List[Any]]]:
+    def swap_in(self, handle: SwapHandle, pools: List[Any]
+                ) -> Union[None, str, Tuple[List[int], List[Any]]]:
         """Restore a parked request: re-match still-resident prefix blocks
         by chain hash (free — no upload), allocate + upload the rest, and
         re-register restored full prompt blocks for prefix sharing.
 
-        Returns ``(table, pools)`` with the updated pool list, or None —
+        Returns ``(table, pools)`` with the updated pool list; None —
         changing nothing — if the device pool lacks headroom (the caller
-        keeps the entry queued and tries again later).
+        keeps the entry queued and tries again later); or the string
+        ``"corrupt"`` when the parked payload fails its CRC check — the
+        payload is dropped, device and host accounting are rolled back,
+        and the caller must re-prefill the request from its tokens.
         """
         import jax.numpy as jnp
 
@@ -215,9 +258,36 @@ class KVOffloadEngine:
             for bid in matched:           # roll back: nothing restored
                 a.free(bid)
             return None
-        fresh = [a.alloc() for _ in range(need)]
+        fresh: List[int] = []
+        try:
+            for _ in range(need):
+                fresh.append(a.alloc())
+        except RuntimeError:
+            # headroom said yes but alloc refused (an injected exhaustion
+            # fault, or a pin racing the estimate): roll everything back
+            for bid in fresh + matched:
+                a.free(bid)
+            return None
         table = matched + fresh
         arrays = self.host.take(handle.rid, handle.nbytes)
+        if self.faults is not None and \
+                self.faults.fire("swap_corrupt") is not None:
+            # the parked payload may be a read-only device-array view —
+            # rewrap writable before flipping the bit
+            arrays = [np.array(x) for x in arrays]
+            self.faults.corrupt(arrays)
+        if handle.checksum and payload_checksum(arrays) != handle.checksum:
+            # the parked copy is damaged: drop it, release the claimed
+            # blocks (host.take already uncharged the host pool)
+            for bid in table:
+                a.free(bid)
+            a.note_host_release(handle.nbytes)
+            if tel is not None and tel.enabled:
+                tel.registry.counter(
+                    "serving_swap_corruptions",
+                    "parked KV payloads that failed CRC verification"
+                ).inc()
+            return "corrupt"
         if fresh:
             # fixed-width scatter: matched rows and padding target the
             # scratch block (duplicate writes there are discarded noise)
@@ -247,3 +317,16 @@ class KVOffloadEngine:
         """Drop a parked copy without restoring it (cancelled request)."""
         self.host.discard(handle.rid, handle.nbytes)
         self.alloc.note_host_release(handle.nbytes)
+
+    def adopt(self, handle: SwapHandle, arrays: List[np.ndarray]) -> None:
+        """Re-park a payload captured by ``GenerationServer.snapshot()``
+        into this engine's host pool (restore / migration): the request
+        then resumes through the normal checksum-verified :meth:`swap_in`
+        path, so a corrupted migration payload degrades to re-prefill
+        instead of silently wrong tokens."""
+        if not self.host.put(handle.rid, arrays, handle.nbytes):
+            raise RuntimeError(
+                f"host pool cannot hold restored request {handle.rid} "
+                f"({handle.nbytes} bytes) — raise host_pool_bytes on the "
+                f"restoring server")
+        self.alloc.note_swap_out(handle.n_blocks, handle.nbytes)

@@ -71,6 +71,9 @@ class BlockAllocator:
         self.swap_in_blocks = 0
         self.host_bytes_in_use = 0
         self.host_bytes_peak = 0
+        # optional FaultInjector (inference/faults.py); the server wires
+        # this so chaos plans can script pool exhaustion deterministically
+        self.faults = None
 
     # ----------------------------------------------------------------- stats
     @property
@@ -94,6 +97,12 @@ class BlockAllocator:
     def evictable_cached(self) -> int:
         """Cached blocks eviction may actually reclaim (unpinned)."""
         return sum(1 for bid in self._lru if bid not in self._pinned)
+
+    def ref_counts(self) -> Dict[int, int]:
+        """Copy of the live refcount map (bid → refs) — the conservation
+        checker (``GenerationServer.assert_conserved``) compares this
+        against the multiset of block-table entries every chaos tick."""
+        return dict(self._ref)
 
     def stats(self) -> Dict[str, int]:
         looked = self.prefix_lookup_blocks
@@ -150,6 +159,12 @@ class BlockAllocator:
     def alloc(self) -> int:
         """Hand out one private block (ref=1, no hash). Prefers the free
         list; falls back to evicting the coldest cached prefix block."""
+        if self.faults is not None and self.faults.fire("alloc") is not None:
+            # same exception (and message shape) as a genuinely dry pool,
+            # so injected exhaustion exercises the real preempt/stall path
+            raise RuntimeError(
+                f"paged KV pool exhausted (injected fault): all "
+                f"{self.num_blocks - 1} usable blocks unavailable")
         bid = None
         if self._free:
             bid = self._free.pop()

@@ -123,6 +123,8 @@ class Scheduler:
             if not w > 0:
                 raise ValueError(f"tenant {t!r} weight must be > 0, got {w}")
         self._clock = clock
+        # monotonic clamp high-water mark (see now()); -inf until first read
+        self._last_now = float("-inf")
         self._q: List[SchedEntry] = []
         self._seq = 0
         # WFQ virtual time: advances to each popped entry's finish tag;
@@ -157,6 +159,23 @@ class Scheduler:
             if n:
                 c.inc(n)
 
+    # ------------------------------------------------------------------- clock
+    def now(self) -> float:
+        """Monotonically-clamped read of the injectable clock.
+
+        The clock is injectable for tests and chaos plans, which means it
+        can stall or jump backwards; an unclamped backwards jump would
+        compute negative TTL remainders and make deadlines granted after
+        the jump expire before deadlines granted before it. Clamping to
+        the high-water mark keeps every timestamp ordering monotone: a
+        stalled/backwards clock degrades to "time stands still", which
+        TTL logic tolerates (nothing new expires), instead of corrupting
+        the ordering invariants."""
+        t = self._clock()
+        if t > self._last_now:
+            self._last_now = t
+        return self._last_now
+
     # ------------------------------------------------------------------ intake
     def submit(self, req: Any, rid: int, *, priority: int = PRIORITY_NORMAL,
                tenant: str = "default", ttl_s: Optional[float] = None,
@@ -175,7 +194,7 @@ class Scheduler:
                 f"queue full ({len(self._q)}/{self.max_queue} waiting) — "
                 f"backpressure: retry later or raise max_queue")
         ttl = ttl_s if ttl_s is not None else self.default_ttl_s
-        now = self._clock()
+        now = self.now()
         w = self.weights.get(tenant, 1.0)
         tag = max(self._vnow, self._tenant_tag.get(tenant, 0.0)) \
             + float(cost) / w
@@ -224,25 +243,55 @@ class Scheduler:
             self._vnow = max(self._vnow, ent.vtag)
         return ent
 
+    # ---------------------------------------------------------------- restore
+    def restore_entry(self, ent: SchedEntry) -> None:
+        """Re-enqueue an entry rebuilt from a ``GenerationServer``
+        snapshot. Bypasses admission control (the request was admitted on
+        the captured server) and preserves its ``seq``/``vtag``/flags so
+        pop order survives the migration; the internal seq counter is
+        bumped past it so new submissions order after restored work."""
+        self._q.append(ent)
+        self._seq = max(self._seq, ent.seq + 1)
+        self.submitted += 1
+        if self._m_submitted is not None:
+            self._m_submitted.inc(tenant=ent.tenant)
+
+    def restore_state(self, vnow: float,
+                      tenant_tag: Dict[str, float]) -> None:
+        """Adopt a snapshot's WFQ virtual time so restored tenants keep
+        the fair-share debt they had accrued on the captured server."""
+        self._vnow = max(self._vnow, float(vnow))
+        for t, tag in tenant_tag.items():
+            self._tenant_tag[t] = max(self._tenant_tag.get(t, 0.0),
+                                      float(tag))
+
     # --------------------------------------------------------------- removal
-    def cancel(self, rid: int) -> Optional[SchedEntry]:
-        """Remove a waiting entry by rid; returns it (or None if the rid
-        is not queued — it may be running, finished, or unknown)."""
+    def remove(self, rid: int) -> Optional[SchedEntry]:
+        """Remove a waiting entry by rid without touching the cancelled
+        counter — the quarantine path uses this (a quarantined request is
+        ``failed``, not ``cancelled``, and the metrics must not lie)."""
         for ent in self._q:
             if ent.rid == rid:
                 self._q.remove(ent)
-                self.cancelled += 1
-                if self._m_cancelled is not None:
-                    self._m_cancelled.inc()
                 return ent
         return None
+
+    def cancel(self, rid: int) -> Optional[SchedEntry]:
+        """Remove a waiting entry by rid; returns it (or None if the rid
+        is not queued — it may be running, finished, or unknown)."""
+        ent = self.remove(rid)
+        if ent is not None:
+            self.cancelled += 1
+            if self._m_cancelled is not None:
+                self._m_cancelled.inc()
+        return ent
 
     def expire(self) -> List[SchedEntry]:
         """Drop and return every never-started entry whose deadline has
         passed. Preempted entries are exempt: their work (host-side KV,
         or a partial prefill) is already paid for — kill those with
         :meth:`cancel`, not a timer."""
-        now = self._clock()
+        now = self.now()
         out = [e for e in self._q
                if e.deadline is not None and e.deadline <= now
                and not e.started]

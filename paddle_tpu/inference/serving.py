@@ -81,6 +81,10 @@ class _Request:
     table: List[int] = field(default_factory=list)   # block ids, in order
     hashes: List[int] = field(default_factory=list)  # chain hash per full blk
     pf_next: int = 0                                 # next prefill position
+    # corruption-recovery replay: when a swap payload fails its CRC, the
+    # request re-prefills prompt+generated[:-1] (this sequence) through
+    # the token-exact chunked-prefill program instead of restoring bits
+    replay: Optional[List[int]] = None
 
 
 class GenerationServer:
@@ -106,7 +110,8 @@ class GenerationServer:
                  pool_bytes: Optional[int] = None,
                  policy=None,
                  host_pool_bytes: Optional[int] = None,
-                 lora=None, telemetry=None):
+                 lora=None, telemetry=None, faults=None,
+                 fault_retries: int = 3):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -170,7 +175,16 @@ class GenerationServer:
         writes) but the traced hot path pays only a truthiness check.
         True enables spans + flight recording; or pass a configured
         :class:`~.telemetry.ServingTelemetry` (injectable clock, ring
-        size). See docs/observability.md."""
+        size). See docs/observability.md.
+
+        ``faults``: deterministic fault injection (inference/faults.py).
+        None (default) wires the shared disabled injector — every hook
+        site is a single attribute check. Pass a
+        :class:`~.faults.FaultInjector` built from a scripted
+        :class:`~.faults.FaultPlan` to replay pool exhaustion, tick
+        faults, drafter failures, and swap corruption deterministically
+        (the chaos-soak harness). ``fault_retries``: tick-fault strikes a
+        request survives before quarantine to terminal ``failed``."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -240,7 +254,7 @@ class GenerationServer:
                 f"policy must be None, a policy name ('fifo'/'priority'/"
                 f"'wfq'), or a Scheduler instance, got {policy!r}")
         self._results: Dict[int, List[int]] = {}
-        self._dropped: Dict[int, str] = {}   # rid -> "cancelled" | "expired"
+        self._dropped: Dict[int, str] = {}   # rid -> cancelled|expired|failed
         # per-rid wall-clock marks (submit/first-token/done) — the
         # benchmark derives TTFT and per-token latency from these
         self._req_metrics: Dict[int, Dict[str, float]] = {}
@@ -254,6 +268,28 @@ class GenerationServer:
         self._idle_streak = 0
         self._next_rid = 0
         self._lora = None
+
+        from .faults import FaultInjector, NULL_INJECTOR
+
+        if faults is None:
+            self._faults = NULL_INJECTOR
+        elif isinstance(faults, FaultInjector):
+            self._faults = faults
+        else:
+            raise ValueError(
+                f"faults must be None or a FaultInjector, got {faults!r}")
+        self.faults = self._faults
+        if not isinstance(fault_retries, int) or fault_retries < 0:
+            raise ValueError(
+                f"fault_retries must be an int >= 0, got {fault_retries!r}")
+        self.fault_retries = fault_retries
+        # degradation-ladder state (all host ints; see _step_paged_inner)
+        self._failed: Optional[str] = None      # terminal-failure reason
+        self._strikes: Dict[int, int] = {}      # rid -> tick-fault strikes
+        self._backoff_ticks = 0                 # ticks left to sit out
+        self._degraded_ticks = 0                # pressure-response cooldown
+        self._tick_faults = 0
+        self._quarantined = 0
 
         from .telemetry import ServingTelemetry
 
@@ -298,6 +334,22 @@ class GenerationServer:
                      250.0, 500.0, 1000.0, 2500.0))
         self._h_e2e = reg.histogram(
             "serving_e2e_s", "submit -> done, completed requests (seconds)")
+        # fault-tolerance counters (inference/faults.py ladder)
+        self._c_faults = reg.counter(
+            "serving_faults_injected",
+            "fault-injector firings observed by the server (site label)")
+        self._c_retries = reg.counter(
+            "serving_tick_retries",
+            "decode trips retried after a recoverable tick fault")
+        self._c_failed = reg.counter(
+            "serving_requests_failed",
+            "requests quarantined to terminal failed status (reason label)")
+        self._c_corrupt = reg.counter(
+            "serving_swap_reprefills",
+            "corrupted swap payloads recovered by re-prefill")
+        self._c_degrade = reg.counter(
+            "serving_degrade_events",
+            "watchdog-driven degradation responses (kind label)")
         # program key of the last paged trip, recorded per tick by the
         # flight recorder; the watchdog keys recompile excusal on it
         self._last_prog = "idle"
@@ -376,6 +428,14 @@ class GenerationServer:
             self._offload = KVOffloadEngine(self.alloc, self._table_width,
                                             capacity_bytes=host_pool_bytes)
             self._offload.telemetry = self._tel
+            if self._faults is not NULL_INJECTOR:
+                # thread the injector through the paged components (even
+                # if currently disabled — a chaos harness arms the plan
+                # after warmup); the default NULL_INJECTOR is never
+                # wired, so the disabled path in each hook stays a plain
+                # `is None` check
+                self.alloc.faults = self._faults
+                self._offload.faults = self._faults
             self._bt = np.zeros((max_batch, self._table_width), np.int32)
             # per-slot adapter page index into the LoRA pool; 0 = the
             # permanently-zero NULL page, so adapterless slots need no
@@ -406,6 +466,9 @@ class GenerationServer:
             if self.spec is not None:
                 self.spec_k = int(self.spec.k)
                 self.drafter = self.spec.build_drafter(max_len)
+                if self._faults is not NULL_INJECTOR \
+                        and hasattr(self.drafter, "faults"):
+                    self.drafter.faults = self._faults
                 # fusible drafters (in-program drafting, e.g. the n-gram
                 # matcher) scan tick_window draft→verify→accept windows in
                 # ONE program per host trip; host-side drafters need a
@@ -743,7 +806,17 @@ class GenerationServer:
         bounded queue is full (backpressure). ``adapter`` names a
         registered LoRA adapter (requires ``lora=``) — unknown names,
         ranks past the pool's ``max_rank``, and shape-incompatible
-        adapters are rejected HERE, not at admission time."""
+        adapters are rejected HERE, not at admission time.
+
+        Raises :class:`~.faults.EngineFailedError` once the server is in
+        a terminal failed state — enqueuing would silently strand the
+        request behind an engine that will never tick again."""
+        if self._failed is not None:
+            from .faults import EngineFailedError
+
+            raise EngineFailedError(
+                f"server is in a terminal failed state ({self._failed}) — "
+                f"restore a snapshot into a fresh server or rebuild")
         prompt = list(prompt)
         if not prompt:
             raise ValueError("prompt must contain at least one token id")
@@ -982,8 +1055,12 @@ class GenerationServer:
             self.aidx[slot] = (self._lora.acquire(req.adapter)
                                if req.adapter is not None else 0)
             self._samp_dev = None
-        req.table = self.alloc.match_prefix(req.prompt)
-        req.hashes = self.alloc.chain_hashes(req.prompt)
+        # corruption recovery re-prefills prompt+generated[:-1] (the
+        # replay sequence) instead of the bare prompt — same program,
+        # same per-block machinery, different token source
+        seq = req.replay if req.replay is not None else req.prompt
+        req.table = self.alloc.match_prefix(seq)
+        req.hashes = self.alloc.chain_hashes(seq)
         req.pf_next = len(req.table) * self.block_size
         self._bt[slot, :] = 0
         self._bt[slot, :len(req.table)] = req.table
@@ -993,7 +1070,8 @@ class GenerationServer:
             tr = self._tel.tracer
             tr.end(req.rid, "queued")
             tr.begin(req.rid, "prefill", cached_blocks=len(req.table),
-                     prompt_len=len(req.prompt))
+                     prompt_len=len(seq),
+                     replay=req.replay is not None)
 
     def _ensure_blocks(self, slot: int, entries: int) -> None:
         """Grow the slot's block table to >= ``entries`` real entries
@@ -1021,10 +1099,15 @@ class GenerationServer:
         if ent.swap is not None:
             need = self._offload.restore_cost(ent.swap)
         else:
-            need = min(self._max_entries,
-                       -(-len(ent.req.prompt) // self.block_size))
+            seq = (ent.req.replay if ent.req.replay is not None
+                   else ent.req.prompt)
+            need = min(self._max_entries, -(-len(seq) // self.block_size))
         usable = self.alloc.num_blocks - 1
-        headroom = min(need + 1, usable)
+        # watchdog-driven admission tightening: while degraded, demand
+        # extra spare blocks so admissions stop feeding the pressure that
+        # tripped the finding (preemption storm / stall run)
+        spare = 3 if self._degraded_ticks > 0 else 1
+        headroom = min(need + spare, usable)
         return (self.alloc.blocks_free
                 + self.alloc.evictable_cached) >= headroom
 
@@ -1040,6 +1123,23 @@ class GenerationServer:
         res = self._offload.swap_in(ent.swap, self._pools)
         if res is None:
             return False
+        if res == "corrupt":
+            # degradation ladder, re-prefill rung: the parked payload
+            # failed its CRC and is gone, but the request's TOKENS are
+            # host-side state — rebuild its KV by replaying
+            # prompt+generated[:-1] through the chunked-prefill program
+            # (token-exact vs decode), then continue as if nothing
+            # happened. The swap handle's n_tokens is exactly the KV
+            # coverage at swap-out time.
+            handle, ent.swap = ent.swap, None
+            self._c_corrupt.inc()
+            req.replay = (req.prompt + req.generated)[:handle.n_tokens]
+            if self._tel.enabled:
+                tr = self._tel.tracer
+                tr.end(req.rid, "preempted", corrupt=True)
+                tr.begin(req.rid, "queued", reason="swap_corrupt")
+            self._admit_paged(slot, req)
+            return True
         if self._lora is not None:
             # re-acquire AFTER the KV restore committed: _admissible
             # already vouched for can_acquire, and acquiring first would
@@ -1200,9 +1300,12 @@ class GenerationServer:
 
     def _prefill_chunk_step(self, slot: int) -> None:
         """Advance one prompt chunk for a prefilling slot; on the final
-        chunk, sample the first token and flip the slot to decoding."""
+        chunk, sample the first token and flip the slot to decoding (a
+        corruption-recovery replay instead resumes at its saved
+        position — nothing new is sampled)."""
         req = self._slots[slot]
-        n = len(req.prompt)
+        seq = req.replay if req.replay is not None else req.prompt
+        n = len(seq)
         bs = self.block_size
         C = self.prefill_chunk
         start = req.pf_next
@@ -1210,7 +1313,7 @@ class GenerationServer:
         if self._reserve_or_preempt(slot, -(-end // bs)) != "ok":
             return      # aborted as its own victim, or stalled — no chunk
         chunk = np.zeros((1, C), np.int32)
-        chunk[0, :end - start] = req.prompt[start:end]
+        chunk[0, :end - start] = seq[start:end]
         last_idx = (n - 1 - start) if end == n else 0
         aidx = (jnp.asarray(self.aidx[slot:slot + 1])
                 if self._lora is not None else None)
@@ -1228,8 +1331,35 @@ class GenerationServer:
             self.alloc.register(req.table[i], req.hashes[i])
         req.pf_next = start + C
         if end == n:
-            self._activate_slot(slot, req, self._first_token(req, lg))
+            if req.replay is not None:
+                self._activate_replayed(slot, req)
+            else:
+                self._activate_slot(slot, req, self._first_token(req, lg))
             self._prefilling[slot] = None
+
+    def _activate_replayed(self, slot: int, req: _Request) -> None:
+        """Flip a corruption-recovery replay straight back to decoding.
+
+        The chunked prefill just rebuilt KV for ``prompt +
+        generated[:-1]`` (token-exact vs the decode path — the PR 1
+        guarantee), and the next decode input is the last token already
+        generated, whose KV is deliberately not written yet (decode
+        writes it) — exactly the invariant a swap-in restore lands on.
+        Nothing is sampled here; greedy continuation is token-identical
+        to the uncorrupted run."""
+        n = len(req.replay)
+        req.replay = None
+        self.pos[slot] = n
+        self.tokens[slot] = req.generated[-1]
+        self.temps[slot] = req.temperature
+        self.topks[slot] = req.top_k
+        self.topps[slot] = req.top_p
+        if self.spec is not None:
+            self.kcaps[slot] = (self.spec_k if req.draft_k is None
+                                else req.draft_k)
+        self._samp_dev = None
+        if self._tel.enabled:
+            self._tel.tracer.end(req.rid, "prefill", replayed=True)
 
     def _all_greedy(self, rows) -> bool:
         """True iff every listed slot decodes at temperature 0 — the
@@ -1283,6 +1413,22 @@ class GenerationServer:
             rec["spec_proposed"] = self._spec_proposed - sp0
             rec["spec_accepted"] = self._spec_accepted - sa0
         tel.flight.record(**rec)
+        # pressure response: every 32 recorded ticks, run the watchdog
+        # over the RECENT window; a preemption storm or stall run flips
+        # the server degraded for a cooldown — speculation forced off and
+        # admission tightened (see _dispatch_trips / _admissible) —
+        # instead of letting the pressure feed itself
+        if tel.flight.total % 32 == 0:
+            from .telemetry import watchdog as _watchdog
+
+            finds = [f for f in _watchdog(tel.flight.dump()[-64:])
+                     if f["kind"] in ("preemption_storm",
+                                      "pool_pressure_stall")]
+            if finds:
+                if self._degraded_ticks == 0:
+                    for f in finds:
+                        self._c_degrade.inc(kind=f["kind"])
+                self._degraded_ticks = 64
         return remaining
 
     def _step_paged_inner(self) -> int:
@@ -1300,23 +1446,39 @@ class GenerationServer:
                 did_prefill = True
         active = [s for s in range(self.max_batch)
                   if self._slots[s] is not None and not self._prefilling[s]]
+        if self._degraded_ticks > 0:
+            self._degraded_ticks -= 1
         if active:
             self._step_no += 1
-            if self.spec is not None:
-                # dynamic speculation gate: while recent acceptance is
-                # below spec.gate_low, drafts are a net loss (a verify
-                # window costs ~(k+1)x a decode tick but advances 1 token
-                # when all drafts miss) — run the plain decode program
-                # for spec.gate_cooldown trips, then probe again. Both
-                # programs compile during warmup; switching is free.
-                if self._spec_gate_off > 0:
-                    self._spec_gate_off -= 1
-                    self._spec_plain_windows += self.spec.gate_ticks
-                    self._plain_decode_trip(active, self.spec.gate_ticks)
-                else:
-                    self._spec_tick(active)
+            if self._backoff_ticks > 0:
+                # degradation ladder, backoff rung: a recent tick fault
+                # left state untouched (faults fire before dispatch), so
+                # sitting out a few ticks lets a transient failure domain
+                # clear before the identical trip is retried
+                self._backoff_ticks -= 1
+                if tel_on:
+                    self._last_prog = "backoff"
             else:
-                self._plain_decode_trip(active)
+                rids = [self._slots[s].rid for s in active]
+                try:
+                    self._dispatch_trips(active)
+                except Exception as e:
+                    from .faults import TickFault
+
+                    if isinstance(e, TickFault):
+                        self._on_tick_fault(rids, e)
+                    else:
+                        # an exception AFTER compiled dispatch may have
+                        # consumed donated pool buffers — no further trip
+                        # is safe; flag terminal failure (submit() now
+                        # refuses) and propagate
+                        self._failed = f"{type(e).__name__}: {e}"
+                        raise
+                else:
+                    # a clean trip clears its participants' strikes: the
+                    # fault domain that struck them was transient
+                    for r in rids:
+                        self._strikes.pop(r, None)
         if tel_on and did_prefill:
             # prefill-bearing ticks get their own program-key suffix: the
             # chunk program's (and first-token sampling's) one-time
@@ -1330,12 +1492,108 @@ class GenerationServer:
             # corruption (e.g. leaked pins) — fail loudly, don't spin
             self._idle_streak += 1
             if self._idle_streak > 64:
+                self._failed = ("scheduler wedged: 64 steps with empty "
+                                "slots and a non-empty queue")
                 raise RuntimeError(
                     "scheduler wedged: 64 steps with empty slots and a "
                     "non-empty queue — allocator headroom never recovered")
         else:
             self._idle_streak = 0
         return occupied + len(self._sched)
+
+    def _dispatch_trips(self, active) -> None:
+        """Dispatch the step's decode work for ``active`` slots — the one
+        place a tick fault can fire, and it fires BEFORE any compiled
+        call, so the caller may retry the trip verbatim (donated pools
+        are still intact). A drafter failure degrades to the always-warm
+        plain program and holds the speculation gate off."""
+        if self._faults.enabled:
+            spec = self._faults.fire("tick")
+            if spec is not None:
+                self._c_faults.inc(site="tick")
+                if spec.kind == "fatal":
+                    raise RuntimeError("injected fatal engine fault")
+                from .faults import TickFault
+
+                raise TickFault(rid=spec.rid)
+        if self.spec is not None:
+            # dynamic speculation gate: while recent acceptance is below
+            # spec.gate_low, drafts are a net loss (a verify window costs
+            # ~(k+1)x a decode tick but advances 1 token when all drafts
+            # miss) — run the plain decode program for spec.gate_cooldown
+            # trips, then probe again. Both programs compile during
+            # warmup; switching is free. A degraded server (watchdog
+            # pressure finding) forces the plain program the same way.
+            if self._spec_gate_off > 0 or self._degraded_ticks > 0:
+                if self._spec_gate_off > 0:
+                    self._spec_gate_off -= 1
+                self._spec_plain_windows += self.spec.gate_ticks
+                self._plain_decode_trip(active, self.spec.gate_ticks)
+            else:
+                from .speculative import DrafterFault
+
+                try:
+                    self._spec_tick(active)
+                except DrafterFault:
+                    # the drafter is an accelerator, not a correctness
+                    # dependency: emit this trip through the plain
+                    # program and keep speculation off for a cooldown
+                    self._c_faults.inc(site="drafter")
+                    self._spec_gate_off = max(
+                        int(self.spec.gate_cooldown) or 0, 4)
+                    self._spec_turbo = False
+                    self._spec_plain_windows += self.spec.gate_ticks
+                    self._plain_decode_trip(active, self.spec.gate_ticks)
+        else:
+            self._plain_decode_trip(active)
+
+    def _on_tick_fault(self, rids, fault) -> None:
+        """Degradation ladder, strike rung: attribute the fault (to its
+        named rid when the plan says so, else to every participant),
+        back off exponentially, and quarantine any request that has
+        exhausted its retries — one poison request must never take the
+        engine down."""
+        self._tick_faults += 1
+        self._c_retries.inc()
+        targets = rids
+        rid = getattr(fault, "rid", None)
+        if rid is not None and rid in rids:
+            targets = [rid]
+        worst = 0
+        for r in targets:
+            self._strikes[r] = self._strikes.get(r, 0) + 1
+            worst = max(worst, self._strikes[r])
+        # 1, 2, 4, 8 ticks — capped so a noisy plan can't idle the engine
+        self._backoff_ticks = min(1 << max(worst - 1, 0), 8)
+        for r in list(targets):
+            if self._strikes.get(r, 0) > self.fault_retries:
+                self._quarantine_rid(r, "tick_fault_retries_exhausted")
+
+    def _quarantine_rid(self, rid: int, reason: str) -> None:
+        """Terminal ``failed`` status for one request: release its slot,
+        blocks, and adapter ref; record why. The engine itself keeps
+        serving — that is the entire point of the quarantine rung."""
+        self._strikes.pop(rid, None)
+        self._quarantined += 1
+        self._dropped[rid] = "failed"
+        self._c_failed.inc(reason=reason)
+        self._c_dropped.inc(reason="failed")
+        m = self._req_metrics.get(rid)
+        if m is not None:
+            m["done_t"] = self._wall()
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is not None and req.rid == rid:
+                req.table = self.alloc.truncate(req.table, 0)
+                self._tel.tracer.close(rid, "failed")
+                self._release_slot(s)
+                return
+        ent = self._sched.remove(rid)
+        if ent is not None:
+            if ent.swap is not None:
+                self._offload.discard(ent.swap)
+                ent.swap = None
+            self._tel.tracer.close(rid, "failed")
 
     def _plain_decode_trip(self, active, ticks=None) -> None:
         """One plain (non-speculative) decode trip: ``ticks`` (default
@@ -1389,6 +1647,14 @@ class GenerationServer:
         compiled shapes every tick regardless of acceptance. Fusible
         drafters scan ``tick_window`` whole windows on device per host
         round trip; host-side drafters run one window per trip."""
+        if self._spec_fused and self._faults.enabled \
+                and self._faults.fire("drafter") is not None:
+            # a fused drafter proposes IN-program, so its host propose()
+            # hook never runs — the injector consults the site here,
+            # before any reservation or dispatch
+            from .speculative import DrafterFault
+
+            raise DrafterFault("injected drafter failure (fused path)")
         k = self.spec_k
         S = self._spec_windows
         if self._spec_turbo and self.spec.turbo_windows > S:
@@ -1619,8 +1885,10 @@ class GenerationServer:
         return False
 
     def status(self, rid: int) -> str:
-        """One of ``done / cancelled / expired / running / prefilling /
-        swapped / preempted / queued / unknown``."""
+        """One of ``done / cancelled / expired / failed / running /
+        prefilling / swapped / preempted / queued / unknown``
+        (``failed`` = quarantined after exhausting its fault-retry
+        budget; terminal, with a telemetry record)."""
         if rid in self._results:
             return "done"
         if rid in self._dropped:
@@ -1724,6 +1992,312 @@ class GenerationServer:
         if self.cache_mode != "paged":
             return {}
         return self.alloc.stats()
+
+    # ------------------------------------------------------ fault tolerance
+    def assert_conserved(self) -> Dict[str, int]:
+        """Pool conservation invariants — raises AssertionError on a leak.
+
+        Checked between steps (the chaos tests call this after EVERY
+        tick, so a leak surfaces at the faulting tick, not at teardown):
+
+        - block identity: ``in_use + cached + free == num_blocks - 1``
+          (block 0 is scratch) and no block is left pinned;
+        - refcount audit: the allocator's live refcounts equal the
+          multiset of block-table entries across occupied slots;
+        - host-pool audit: parked bytes equal the sum over waiting
+          swapped entries, in BOTH byte ledgers (pool and allocator);
+        - adapter-pool audit (when ``lora=``): same identity over pages,
+          and page refs equal the occupied slots holding each page.
+
+        Returns the audited numbers (handy for test output). Dense-cache
+        servers have no pools to audit and return ``{}``."""
+        if self.cache_mode != "paged":
+            return {}
+        from collections import Counter
+
+        a = self.alloc
+        errs: List[str] = []
+        usable = a.num_blocks - 1
+        if a.blocks_in_use + a.blocks_cached + a.blocks_free != usable:
+            errs.append(
+                f"block identity broken: in_use={a.blocks_in_use} + "
+                f"cached={a.blocks_cached} + free={a.blocks_free} != "
+                f"usable={usable}")
+        if a.pinned_blocks != 0:
+            errs.append(f"{a.pinned_blocks} blocks left pinned between "
+                        f"steps (pins must be copy-scoped)")
+        expect: Counter = Counter()
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is not None:
+                expect.update(req.table)
+        refs = a.ref_counts()
+        if dict(expect) != refs:
+            extra = {b: n for b, n in refs.items() if expect.get(b) != n}
+            missing = {b: n for b, n in expect.items() if refs.get(b) != n}
+            errs.append(f"refcount audit failed: allocator-only={extra} "
+                        f"tables-only={missing}")
+        swapped = [e for e in self._sched.waiting() if e.swap is not None]
+        parked = sum(e.swap.nbytes for e in swapped)
+        if self._offload.host.bytes_in_use != parked:
+            errs.append(f"host pool ledger {self._offload.host.bytes_in_use}"
+                        f" != sum of waiting swap handles {parked}")
+        if a.host_bytes_in_use != parked:
+            errs.append(f"allocator host ledger {a.host_bytes_in_use} != "
+                        f"sum of waiting swap handles {parked}")
+        if len(self._offload.host) != len(swapped):
+            errs.append(f"host pool parks {len(self._offload.host)} "
+                        f"payloads but {len(swapped)} entries are swapped")
+        if self._lora is not None:
+            la = self._lora.alloc
+            lu = la.num_blocks - 1
+            if la.blocks_in_use + la.blocks_cached + la.blocks_free != lu:
+                errs.append(
+                    f"adapter page identity broken: in_use="
+                    f"{la.blocks_in_use} + cached={la.blocks_cached} + "
+                    f"free={la.blocks_free} != usable={lu}")
+            pexp: Counter = Counter()
+            for s in range(self.max_batch):
+                if self._slots[s] is not None and int(self.aidx[s]) > 0:
+                    pexp[int(self.aidx[s])] += 1
+            if dict(pexp) != la.ref_counts():
+                errs.append(f"adapter page refs {la.ref_counts()} != "
+                            f"slot aidx multiset {dict(pexp)}")
+        if errs:
+            raise AssertionError("; ".join(errs))
+        return {"blocks_in_use": a.blocks_in_use,
+                "blocks_cached": a.blocks_cached,
+                "blocks_free": a.blocks_free,
+                "host_bytes_in_use": parked,
+                "swapped_waiting": len(swapped)}
+
+    def _snapshot_fingerprint(self) -> Dict[str, Any]:
+        """Shape-critical configuration a snapshot can only restore into:
+        these fields decide the compiled programs' shapes and the KV
+        payloads' fixed gather width."""
+        return {"cache": self.cache_mode,
+                "block_size": self.block_size,
+                "max_len": self.max_len,
+                "max_batch": self.max_batch,
+                "kv_quant": self.kv_quant,
+                "tick_window": self.tick_window,
+                "table_width": self._table_width,
+                "num_blocks": self.alloc.num_blocks,
+                "spec_k": self.spec_k if self.spec is not None else None,
+                "lora": self._lora is not None}
+
+    def _req_state(self, req: _Request) -> Dict[str, Any]:
+        return {"rid": req.rid, "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "temperature": req.temperature, "top_k": req.top_k,
+                "top_p": req.top_p, "generated": list(req.generated),
+                "draft_k": req.draft_k, "adapter": req.adapter,
+                "replay": (list(req.replay) if req.replay is not None
+                           else None),
+                "hashes": list(req.hashes)}
+
+    def _sched_state(self, ent: SchedEntry) -> Dict[str, Any]:
+        now = self._sched.now()
+        return {"priority": ent.priority, "tenant": ent.tenant,
+                "ttl_remaining": (None if ent.deadline is None
+                                  else max(ent.deadline - now, 0.0)),
+                "seq": ent.seq, "cost": ent.cost, "vtag": ent.vtag,
+                "preempted": ent.preempted, "started": ent.started}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Crash-safe capture of the full in-flight engine state — the
+        drain/migrate primitive (ROADMAP 5): every queued, prefilling,
+        decoding, and swapped request, with enough state that
+        :meth:`restore` on a FRESH server continues each one with
+        greedy-token-identical output.
+
+        Decoding slots' KV rides the offload engine's compile-once
+        fixed-width gather (non-destructive — the captured server keeps
+        serving); already-swapped entries copy their parked host arrays;
+        prefilling/queued work is recomputable and restores as queued.
+        Per-payload CRC checksums ride along, so a payload corrupted in
+        transit degrades to re-prefill on the restoring side instead of
+        wrong tokens. Host-only: zero compiled programs on a warm
+        server, zero device state mutated. Paged servers only."""
+        if self.cache_mode != "paged":
+            raise ValueError("snapshot() requires cache='paged' — the "
+                             "dense slab has no per-request KV capture")
+        from .kv_offload import payload_checksum
+
+        reqs: List[Dict[str, Any]] = []
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is None:
+                continue
+            d = self._req_state(req)
+            d["sched"] = self._sched_state(req.sched)
+            if self._prefilling[s]:
+                # prefill is recomputable (and must be: its KV covers an
+                # unfinished chunk boundary) — restore re-queues it
+                d["phase"] = "queued"
+            else:
+                arrays = self._offload.gather_payload(req.table,
+                                                      self._pools)
+                d["phase"] = "kv"
+                d["kv"] = {
+                    "arrays": arrays,
+                    "n_tokens": int(self.pos[s]),
+                    "last_token": int(self.tokens[s]),
+                    "n_blocks": len(req.table),
+                    "hashes": list(
+                        req.hashes[:min(len(req.hashes), len(req.table))]),
+                    "nbytes": len(req.table) * self.alloc.bytes_per_block,
+                    "checksum": payload_checksum(arrays)}
+            reqs.append(d)
+        for ent in self._sched.waiting():
+            d = self._req_state(ent.req)
+            d["sched"] = self._sched_state(ent)
+            if ent.swap is not None:
+                h = ent.swap
+                arrays = [np.array(a)
+                          for a in self._offload.host.peek(h.rid)]
+                d["phase"] = "kv"
+                d["kv"] = {"arrays": arrays, "n_tokens": h.n_tokens,
+                           "last_token": h.last_token,
+                           "n_blocks": h.n_blocks,
+                           "hashes": list(h.hashes), "nbytes": h.nbytes,
+                           "checksum": h.checksum}
+            else:
+                d["phase"] = "queued"
+            reqs.append(d)
+        snap: Dict[str, Any] = {
+            "format": 1,
+            "config": self._snapshot_fingerprint(),
+            "rng_key": np.asarray(self._base_key),
+            "step_no": self._step_no,
+            "next_rid": self._next_rid,
+            "sched": {"vnow": self._sched._vnow,
+                      "tenant_tag": dict(self._sched._tenant_tag)},
+            "requests": reqs,
+            "results": {r: list(t) for r, t in self._results.items()},
+            "dropped": dict(self._dropped),
+        }
+        if self.spec is not None:
+            snap["spec_state"] = {
+                "gate_off": self._spec_gate_off,
+                "plain_windows": self._spec_plain_windows,
+                "turbo": self._spec_turbo,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted}
+        return snap
+
+    def restore(self, snap: Dict[str, Any]) -> int:
+        """Rebuild a :meth:`snapshot` into THIS (idle, freshly built)
+        server; returns the number of requests restored.
+
+        Every request re-enters through the normal machinery — KV-bearing
+        requests become swapped queue entries whose payload is adopted
+        into the host pool and restored by the compile-once, CRC-verified
+        swap-in path at the next step; queued/prefilling work re-queues.
+        Greedy continuation is token-identical to the captured server's
+        because resume is the same bit-exact path preemption already
+        proves out, and the sampling key + step counter come along."""
+        if self.cache_mode != "paged":
+            raise ValueError("restore() requires cache='paged'")
+        if self._failed is not None:
+            raise ValueError(f"cannot restore into a failed server "
+                             f"({self._failed}) — build a fresh one")
+        if any(sl is not None for sl in self._slots) or len(self._sched):
+            raise ValueError("restore() needs an idle server: slots and "
+                             "queue must be empty")
+        if snap.get("format") != 1:
+            raise ValueError(f"unknown snapshot format "
+                             f"{snap.get('format')!r}")
+        want = snap["config"]
+        have = self._snapshot_fingerprint()
+        for k, hv in have.items():
+            wv = want.get(k)
+            if k == "num_blocks":
+                if hv < wv:
+                    raise ValueError(
+                        f"restoring pool has {hv} blocks but the snapshot "
+                        f"was taken with {wv} — a smaller pool cannot "
+                        f"guarantee the captured requests stay feasible")
+            elif hv != wv:
+                raise ValueError(
+                    f"snapshot/server config mismatch on {k!r}: snapshot "
+                    f"has {wv!r}, this server has {hv!r}")
+        from .kv_offload import SwapHandle
+
+        self._base_key = jnp.asarray(np.asarray(snap["rng_key"]))
+        self._step_no = int(snap["step_no"])
+        self._next_rid = max(self._next_rid, int(snap["next_rid"]))
+        self._sched.restore_state(snap["sched"]["vnow"],
+                                  snap["sched"]["tenant_tag"])
+        if self.spec is not None and "spec_state" in snap:
+            st = snap["spec_state"]
+            self._spec_gate_off = int(st["gate_off"])
+            self._spec_plain_windows = int(st["plain_windows"])
+            self._spec_turbo = bool(st["turbo"])
+            self._spec_proposed = int(st["proposed"])
+            self._spec_accepted = int(st["accepted"])
+        self._results.update(
+            {int(r): list(t) for r, t in snap["results"].items()})
+        self._dropped.update(snap["dropped"])
+        now = self._sched.now()
+        restored = 0
+        for d in sorted(snap["requests"], key=lambda d: d["sched"]["seq"]):
+            if d["adapter"] is not None:
+                if self._lora is None:
+                    raise ValueError(
+                        f"request {d['rid']} names adapter "
+                        f"{d['adapter']!r} but this server has no lora=")
+                self._lora.validate(d["adapter"])
+            req = _Request(int(d["rid"]), list(d["prompt"]),
+                           int(d["max_new_tokens"]),
+                           temperature=float(d["temperature"]),
+                           top_k=int(d["top_k"]), top_p=float(d["top_p"]),
+                           draft_k=d["draft_k"], adapter=d["adapter"])
+            req.generated = list(d["generated"])
+            req.replay = (list(d["replay"]) if d["replay"] is not None
+                          else None)
+            req.hashes = list(d["hashes"])
+            sd = d["sched"]
+            ent = SchedEntry(req=req, rid=req.rid,
+                             priority=int(sd["priority"]),
+                             tenant=sd["tenant"],
+                             deadline=(None if sd["ttl_remaining"] is None
+                                       else now + sd["ttl_remaining"]),
+                             seq=int(sd["seq"]), cost=float(sd["cost"]),
+                             vtag=float(sd["vtag"]),
+                             preempted=bool(sd["preempted"]),
+                             started=bool(sd["started"]),
+                             adapter=req.adapter)
+            req.sched = ent
+            if d["phase"] == "kv":
+                kv = d["kv"]
+                handle = SwapHandle(
+                    rid=req.rid, n_tokens=int(kv["n_tokens"]),
+                    last_token=int(kv["last_token"]),
+                    n_blocks=int(kv["n_blocks"]),
+                    hashes=list(kv["hashes"]), nbytes=int(kv["nbytes"]),
+                    checksum=int(kv["checksum"]))
+                self._offload.adopt(
+                    handle, [np.asarray(a) for a in kv["arrays"]])
+                ent.swap = handle
+            self._sched.restore_entry(ent)
+            # fresh wall-clock marks: the captured server's monotonic
+            # clock does not transfer across processes, and mixing the
+            # two would observe negative latencies
+            m: Dict[str, Any] = {"submit_t": self._wall(),
+                                 "tenant": ent.tenant}
+            if req.generated:
+                m["first_token_t"] = m["submit_t"]
+            self._req_metrics[req.rid] = m
+            if self._tel.enabled:
+                tr = self._tel.tracer
+                tr.set_meta(req.rid, tenant=ent.tenant,
+                            priority=ent.priority,
+                            prompt_len=len(req.prompt),
+                            adapter=req.adapter or "")
+                tr.begin(req.rid, "queued", restored=True)
+            restored += 1
+        return restored
 
     # ------------------------------------------------------------ telemetry
     def telemetry_snapshot(self) -> Dict[str, Any]:

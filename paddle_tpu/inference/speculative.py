@@ -49,7 +49,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 __all__ = ["SpecConfig", "NgramDrafter", "DraftModelDrafter",
-           "speculative_accept", "ngram_propose_device"]
+           "DrafterFault", "speculative_accept", "ngram_propose_device"]
+
+
+class DrafterFault(RuntimeError):
+    """A drafter failed to produce proposals (injected or real).
+
+    Recoverable by design: the serving loop catches this, runs the trip
+    through the always-warm plain decode program instead, and holds the
+    speculation gate off for a cooldown — the drafter is an accelerator,
+    never a correctness dependency."""
 
 
 # --------------------------------------------------------------------------- #
@@ -229,6 +238,8 @@ class NgramDrafter:
                 f"min_ngram={min_ngram}, max_ngram={max_ngram}")
         self.max_ngram = int(max_ngram)
         self.min_ngram = int(min_ngram)
+        # optional FaultInjector (inference/faults.py), wired by the server
+        self.faults = None
 
     def propose_one(self, ctx: Sequence[int], k: int) -> np.ndarray:
         """k proposed continuation tokens for one context (host numpy)."""
@@ -257,6 +268,9 @@ class NgramDrafter:
                 temps=None, key=None) -> Tuple[np.ndarray, None]:
         """Batch proposals: (B, k) int32, one row per slot (idle slots pass
         None and get zeros — their rows run masked into scratch)."""
+        if self.faults is not None and \
+                self.faults.fire("drafter") is not None:
+            raise DrafterFault("injected drafter failure (ngram)")
         out = np.zeros((len(contexts), k), np.int32)
         for i, ctx in enumerate(contexts):
             if ctx is not None and len(ctx):
@@ -295,6 +309,8 @@ class DraftModelDrafter:
         self.max_len = int(max_len)
         self.sample_draft = bool(sample_draft)
         self.deterministic = not self.sample_draft
+        # optional FaultInjector (inference/faults.py), wired by the server
+        self.faults = None
         from ..jit import state_values
 
         self.params = state_values(model)
@@ -352,6 +368,9 @@ class DraftModelDrafter:
         import jax
         import jax.numpy as jnp
 
+        if self.faults is not None and \
+                self.faults.fire("drafter") is not None:
+            raise DrafterFault("injected drafter failure (draft model)")
         B = len(contexts)
         buf = np.zeros((B, self.max_len), np.int32)
         pos = np.zeros((B,), np.int32)

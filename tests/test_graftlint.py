@@ -343,6 +343,57 @@ class TestTelemetryInJitGL010:
         """)
 
 
+class TestFaultHookInJitGL011:
+    def test_fire_inside_jitted_fn(self):
+        assert "GL011" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def decode(x, faults):
+                faults.fire("tick")
+                return x * 2
+        """)
+
+    def test_injector_corrupt_at_jit_callsite(self):
+        assert "GL011" in rule_ids("""
+            import jax
+            def step(x, injector):
+                injector.corrupt([x])
+                return x + 1
+            fast = jax.jit(step)
+        """)
+
+    def test_private_faults_attr_detected(self):
+        assert "GL011" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def step(self, x):
+                self._faults.fire("alloc")
+                return x
+        """)
+
+    def test_host_side_hook_ok(self):
+        # firing before compiled dispatch is the sanctioned pattern
+        assert "GL011" not in rule_ids("""
+            def tick(self, x):
+                if self._faults.fire("tick") is not None:
+                    raise RuntimeError("injected")
+                return self._decode_fn(x)
+        """)
+
+    def test_unrelated_fire_call_ok(self):
+        # .fire() on a non-injector receiver stays clean
+        assert "GL011" not in rule_ids("""
+            import jax
+
+            @jax.jit
+            def step(engine, x):
+                engine.callbacks.fire(x)
+                return x
+        """)
+
+
 class TestSyntaxErrorGL000:
     def test_unparseable_module_reports_gl000(self):
         assert rule_ids("def broken(:\n    pass") == ["GL000"]
@@ -484,7 +535,7 @@ class TestRepoGate:
              "--list-rules"], capture_output=True, text=True)
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                    "GL007", "GL008", "GL009", "GL010"):
+                    "GL007", "GL008", "GL009", "GL010", "GL011"):
             assert rid in r.stdout
 
 

@@ -40,12 +40,24 @@ priority classes/tenants, and the JSON line gains
 ``ttft_p50_s/ttft_p95_s`` (plus per-class splits), ``tpot_p50_ms/
 tpot_p95_ms``, and the preemption/swap counters.
 
+Chaos soak (docs/serving.md "Fault tolerance and degradation"):
+``--chaos`` runs the seeded traffic twice — a fault-free reference pass,
+then the measured pass with ``FaultPlan.chaos(--seed)`` injected into
+the paged substrate (allocator exhaustion, tick faults, drafter
+failures, bit-flipped swap payloads) and the scheduler clock wrapped by
+the injector. Pool conservation is asserted after every tick; the JSON
+line gains ``faults_injected/quarantined/token_mismatches/ref_tok_s``.
+``--strict`` turns telemetry on and exits non-zero on any watchdog
+finding (under ``--chaos``, on the post-plan recovery burst, which must
+come back clean).
+
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
        [--seed 0] [--arrival-rate R --burst B]
        [--scheduler fifo|priority|wfq [--mixed-priority]]
        [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
         [--host-pool-mb M] [--prefill-chunk 64]
-        [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]]
+        [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]
+        [--chaos [--strict]]]
        [--json]
 """
 from __future__ import annotations
@@ -170,10 +182,29 @@ def main():
                          "after the drain. The TTFT/TPOT percentiles in "
                          "the JSON line come from the same registry "
                          "histograms either way")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos soak (paged only): run the seeded traffic "
+                         "twice — a fault-free reference pass, then the "
+                         "measured pass with FaultPlan.chaos(--seed) "
+                         "injected (pool exhaustion, tick faults, drafter "
+                         "failures, swap corruption) and the scheduler "
+                         "clock injector-wrapped. Pool conservation is "
+                         "asserted after EVERY tick; the JSON line gains "
+                         "faults_injected / quarantined / "
+                         "token_mismatches (non-quarantined outputs vs "
+                         "the reference) / ref_tok_s")
+    ap.add_argument("--strict", action="store_true",
+                    help="enable telemetry and exit non-zero on any "
+                         "watchdog finding — over the measured drain, or "
+                         "(under --chaos) over a post-plan recovery burst, "
+                         "which must come back clean")
     ap.add_argument("--json", action="store_true",
                     help="emit exactly one machine-readable JSON line "
                          "(bench.py style) on stdout and nothing else")
     args = ap.parse_args()
+    if args.chaos and not args.paged:
+        ap.error("--chaos requires --paged (the fault sites live in the "
+                 "paged substrate)")
     if args.pool_frac is not None and not args.paged:
         ap.error("--pool-frac requires --paged")
     if args.host_pool_mb is not None and not args.paged:
@@ -306,7 +337,7 @@ def main():
     from paddle_tpu.analysis.recompile_guard import jit_cache_guard
     from paddle_tpu.utils.bench_timing import tpu_lock
 
-    def make_server():
+    def make_server(faults=None, sched=None):
         if args.paged:
             spec = None
             if args.spec:
@@ -360,8 +391,10 @@ def main():
                 block_size=args.block_size, num_blocks=num_blocks,
                 prefill_chunk=args.prefill_chunk, spec=spec,
                 kv_quant=args.kv_quant, pool_bytes=pool_bytes,
-                policy=args.scheduler, host_pool_bytes=host_pool,
-                lora=lora_cfg, telemetry=bool(args.telemetry_out))
+                policy=sched if sched is not None else args.scheduler,
+                host_pool_bytes=host_pool,
+                lora=lora_cfg, faults=faults,
+                telemetry=bool(args.telemetry_out) or args.strict)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -369,22 +402,39 @@ def main():
                                                 else (32, 64, 128)),
                                 tick_window=args.tick_window,
                                 policy=args.scheduler,
-                                telemetry=bool(args.telemetry_out))
+                                telemetry=bool(args.telemetry_out)
+                                or args.strict)
 
-    # CPU smoke runs don't touch the chip — don't serialize on its lock
-    lock = tpu_lock(timeout_s=900.0) if on_tpu else \
-        contextlib.nullcontext(True)
-    with lock as locked:
-        if args.int8:
-            model.quantize_int8()
-        server = make_server()
+    def run_pass(server, chaos_inj=None, allowed_compiles=0):
+        """Warmup + the measured drain against the seeded traffic.
+
+        The caller resets the traffic rng/counters before each pass, so
+        two passes submit identical requests in identical order (and
+        thus identical rids) — the chaos comparison relies on it (which
+        is also why every warmup decision keys off args, never off
+        which pass this is). Returns the drain's backend-compile count
+        alongside the results: the chaos pass is held to the reference
+        pass's compile budget — injected faults must not add a single
+        program beyond what the fault-free drain compiles."""
+        from paddle_tpu.analysis.recompile_guard import compile_count
+
         # warmup drain: compiles the decode tick + the prefill program(s)
         burst(server, min(args.slots, 4))
         server.run()
+        if args.pool_frac is not None and (args.chaos
+                                           or args.guard_recompiles):
+            # overload warmup wave: churn so the swap gather/scatter
+            # programs get a chance to compile BEFORE the measured
+            # window (first preemption after it still counts against
+            # the budget — hence the reference-pass allowance)
+            burst(server, args.slots * 2 + 2)
+            server.run()
         # warmup boundary: drop histogram samples, spans, and flight
         # ticks so registry percentiles (and any --telemetry-out dump)
         # cover the measured drain only; counters keep lifetime totals
         server.telemetry.reset()
+        if chaos_inj is not None:
+            chaos_inj.enabled = True   # plan ordinals start at the drain
 
         # pre-draw the whole open-loop arrival timeline from the seeded
         # rng — the trace is fixed before the clock starts, so it cannot
@@ -398,8 +448,14 @@ def main():
                 left -= n
                 t += float(rng.exponential(args.burst / args.arrival_rate))
         rids = {} if schedule else burst(server, args.requests)
-        guard = (jit_cache_guard("serving_benchmark measured drain")
-                 if args.guard_recompiles else contextlib.nullcontext())
+        if chaos_inj is not None:
+            guard = jit_cache_guard("chaos measured drain",
+                                    allowed=allowed_compiles)
+        elif args.guard_recompiles:
+            guard = jit_cache_guard("serving_benchmark measured drain")
+        else:
+            guard = contextlib.nullcontext()
+        c0 = compile_count()
         with guard:
             t0 = time.perf_counter()
             done_at = {}
@@ -409,6 +465,9 @@ def main():
                 while pending and pending[0][0] <= now:
                     rids.update(burst(server, pending.pop(0)[1]))
                 remaining = server.step()
+                if chaos_inj is not None:
+                    # soak invariant: pool conservation after EVERY tick
+                    server.assert_conserved()
                 now = time.perf_counter() - t0
                 for rid in list(server._results):
                     if rid not in done_at:
@@ -419,7 +478,40 @@ def main():
                     # open-loop lull: nothing in flight, next clump later
                     time.sleep(max(0.0, min(pending[0][0] - now, 0.01)))
             dt = time.perf_counter() - t0
-        out = server._results
+        return rids, server._results, done_at, dt, compile_count() - c0
+
+    # CPU smoke runs don't touch the chip — don't serialize on its lock
+    lock = tpu_lock(timeout_s=900.0) if on_tpu else \
+        contextlib.nullcontext(True)
+    with lock as locked:
+        if args.int8:
+            model.quantize_int8()
+        traffic_state = rng.get_state()
+        inj, ref_out, ref_tok_s, ref_compiles = None, None, None, 0
+        if args.chaos:
+            from paddle_tpu.inference.faults import FaultInjector, FaultPlan
+            from paddle_tpu.inference.scheduler import Scheduler
+
+            ref_server = make_server()
+            ref_rids, ref_out, _, ref_dt, ref_compiles = run_pass(ref_server)
+            ref_tok_s = sum(len(v) - ref_rids[r]
+                            for r, v in ref_out.items() if r in ref_rids) \
+                / ref_dt
+            del ref_server
+            # identical traffic for the measured pass: same rng state,
+            # same rid counter -> rid-for-rid comparable outputs
+            rng.set_state(traffic_state)
+            _counter[0] = 0
+            prios.clear()
+            inj = FaultInjector(FaultPlan.chaos(args.seed))
+            inj.enabled = False        # hooks wire now, plan fires later
+            sched = Scheduler(policy=args.scheduler,
+                              clock=inj.wrap_clock(time.monotonic))
+            server = make_server(faults=inj, sched=sched)
+        else:
+            server = make_server()
+        rids, out, done_at, dt, drain_compiles = run_pass(
+            server, chaos_inj=inj, allowed_compiles=ref_compiles)
     gen_tokens = sum(len(v) - rids[r] for r, v in out.items() if r in rids)
     lats = sorted(done_at[r] for r in rids if r in done_at)
     p50 = lats[len(lats) // 2]
@@ -502,6 +594,37 @@ def main():
         line["acceptance_rate"] = round(sm["acceptance_rate"], 4)
         line["draft_tokens_proposed"] = sm["draft_tokens_proposed"]
         line["draft_tokens_accepted"] = sm["draft_tokens_accepted"]
+    strict_findings = None
+    if args.chaos:
+        st = inj.stats()
+        failed = [r for r in rids if server.status(r) == "failed"]
+        mismatch = sum(1 for r in rids
+                       if r not in failed and out.get(r) != ref_out.get(r))
+        server.assert_conserved()
+        line["chaos"] = True
+        line["faults_injected"] = st["fired"]
+        line["fault_sites"] = st["fired_sites"]
+        line["tick_retries"] = server._tick_faults
+        line["quarantined"] = len(failed)
+        line["token_mismatches"] = mismatch
+        line["ref_tok_s"] = round(ref_tok_s, 1)
+        # the jit_cache_guard in run_pass already hard-failed if the
+        # chaos drain compiled MORE than the fault-free reference; the
+        # counts land in the line so the suite gate can record them
+        line["ref_drain_recompiles"] = ref_compiles
+        line["drain_recompiles"] = drain_compiles
+        if server.telemetry.enabled:
+            # recovery tail: with the plan spent, a fresh burst must run
+            # with a CLEAN watchdog — degradation is a response, not a
+            # new steady state
+            server.telemetry.reset()
+            burst(server, min(args.slots, 4))
+            server.run()
+            strict_findings = server.telemetry.watchdog()
+            line["watchdog_after_recovery"] = len(strict_findings)
+    elif args.strict:
+        strict_findings = server.telemetry.watchdog()
+        line["watchdog_findings"] = len(strict_findings)
     if args.telemetry_out:
         base = args.telemetry_out
         d = os.path.dirname(base)
@@ -517,6 +640,10 @@ def main():
     if not locked:
         line["lock_contended"] = True
     print(json.dumps(line))
+    if args.strict and strict_findings:
+        for f in strict_findings:
+            print(f"watchdog: {f}", file=sys.stderr)
+        sys.exit(1)
     if not args.json:
         mode = "paged" if args.paged else "dense"
         if args.spec:
