@@ -1,0 +1,72 @@
+"""Timing hygiene: no direct wall-clock reads inside the serving stack.
+
+Everything time-dependent in ``paddle_tpu/inference/`` — scheduler TTLs,
+telemetry spans, heartbeat liveness, fault-plan clock attacks — flows
+through an injectable ``clock`` callable precisely so a chaos run
+replays bit-identically and a snapshot restores with deterministic
+timing. One stray ``time.time()`` inside that package re-introduces
+nondeterminism the whole fault-injection contract was built to remove:
+the same seeded plan stops producing the same run, and the token-identity
+assertions the chaos tests lean on become flaky instead of load-bearing.
+
+Passing a clock *reference* (``clock=time.monotonic`` as a default) is
+the sanctioned pattern and stays clean — only direct *calls* are flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain
+
+
+@register
+class WallClockInServingRule(Rule):
+    """GL012: direct wall-clock reads inside ``paddle_tpu/inference/``.
+    The serving stack's time base is an injectable clock — scheduler,
+    telemetry, fault injector and fleet router all accept ``clock=`` —
+    so deterministic chaos replay survives. A direct read bypasses the
+    injection seam."""
+
+    id = "GL012"
+    name = "wall-clock-in-serving"
+    description = ("direct time.time()/time.monotonic()/datetime.now() "
+                   "calls inside paddle_tpu/inference/ bypass the "
+                   "injectable-clock seam (clock= parameters) that keeps "
+                   "seeded chaos runs and snapshot/restore timing "
+                   "deterministic; take a clock callable instead "
+                   "(passing a reference like clock=time.monotonic "
+                   "stays clean — only calls are flagged)")
+
+    _SCOPE = "paddle_tpu/inference/"
+
+    # the wall-clock read surface: direct calls to any of these are a
+    # hidden time dependency (references to them are fine — that's how
+    # the default clock is threaded)
+    _CLOCK_CALLS = frozenset({
+        "time.time", "time.time_ns",
+        "time.monotonic", "time.monotonic_ns",
+        "time.perf_counter", "time.perf_counter_ns",
+        "time.process_time", "time.process_time_ns",
+        "datetime.now", "datetime.utcnow", "datetime.today",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+        "datetime.datetime.today",
+        "datetime.date.today", "date.today",
+    })
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(self._SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain in self._CLOCK_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{chain}() is a direct wall-clock read inside "
+                    f"inference/ — thread the injectable clock (a "
+                    f"clock= parameter defaulting to time.monotonic) "
+                    f"instead, so seeded chaos plans and restore "
+                    f"timing replay deterministically")

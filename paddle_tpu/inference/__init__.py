@@ -254,6 +254,9 @@ def load_predictor(path: str) -> Predictor:
 
 from .faults import (NULL_INJECTOR, EngineFailedError,  # noqa: E402,F401
                      FaultInjector, FaultPlan, FaultSpec, TickFault)
+from .fleet import (REPLICA_DEAD, REPLICA_DEGRADED,  # noqa: E402,F401
+                    REPLICA_DRAINING, REPLICA_LIVE, RID_STRIDE,
+                    FleetRouter, ReplicaInfo)
 from .kv_offload import (HostKVPool, KVOffloadEngine,  # noqa: E402,F401
                          SwapHandle, payload_checksum)
 from .lora import (Adapter, AdapterPool, AdapterRegistry,  # noqa: E402,F401

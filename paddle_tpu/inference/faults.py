@@ -20,6 +20,9 @@ swap_corrupt ``KVOffloadEngine.swap_in``                       flips one bit in 
 drafter    ``GenerationServer._spec_tick`` / drafter.propose   raises ``DrafterFault`` (server falls back to the plain decode program)
 tick       ``GenerationServer._dispatch_trips``                raises ``TickFault`` *before* compiled dispatch (``kind="fatal"`` raises a plain ``RuntimeError`` instead — unrecoverable)
 clock      ``FaultInjector.wrap_clock`` wrapper                stalls the clock (``kind="stall"``) or jumps it backwards (``kind="jump_back"`` by ``magnitude`` seconds)
+replica_down ``FleetRouter.step`` health probe                 marks the probed replica dead mid-decode; the router salvages its in-flight requests onto peers (``inference/fleet.py``)
+migrate_payload ``FleetRouter`` migration transfer             flips one bit in a migrating KV payload; the receiving engine's CRC-verified swap-in degrades it to re-prefill
+route      ``FleetRouter`` routing decision                    misroutes one submission to the worst-scoring live replica (correctness unaffected — routing is a hint)
 ========== =================================================== ==========
 
 Injected faults at the ``tick`` site fire *before* the compiled call is
@@ -36,6 +39,7 @@ import numpy as np
 
 SITES = frozenset({
     "alloc", "host_put", "swap_corrupt", "drafter", "tick", "clock",
+    "replica_down", "migrate_payload", "route",
 })
 
 
@@ -117,6 +121,28 @@ class FaultPlan:
                                at=int(rng.randint(0, 2))))
         specs.append(FaultSpec("drafter",
                                at=int(rng.randint(0, max(4, horizon // 4)))))
+        return cls(specs=specs, seed=seed)
+
+    @classmethod
+    def fleet_chaos(cls, seed: int, *, replicas: int = 2,
+                    horizon: int = 24) -> "FaultPlan":
+        """A seeded fleet plan: kill one replica mid-decode, corrupt one
+        migrating payload, and misroute a couple of submissions. The
+        ``replica_down`` ordinal counts the router's per-replica health
+        probes (``replicas`` per router step), so the kill lands at a
+        deterministic (step, replica) pair within the first
+        ``horizon // replicas`` router ticks — early enough that any
+        real workload is still mid-decode when the replica dies. Same
+        seed → same plan."""
+        rng = np.random.RandomState(seed)  # graftlint: noqa[np-random]
+        kill_step = int(rng.randint(2, max(3, horizon // replicas)))
+        specs = [
+            FaultSpec("replica_down",
+                      at=kill_step * replicas + int(rng.randint(0, replicas))),
+            FaultSpec("migrate_payload", at=int(rng.randint(0, 2))),
+            FaultSpec("route", at=int(rng.randint(0, 8)),
+                      count=int(rng.randint(1, 3))),
+        ]
         return cls(specs=specs, seed=seed)
 
 

@@ -280,6 +280,26 @@ class BlockAllocator:
         self.prefix_hit_blocks += len(out)
         return out
 
+    def probe_prefix(self, tokens: Sequence[int]) -> int:
+        """Read-only routing probe: how many leading full prompt blocks of
+        ``tokens`` are currently resident (live or cached), capped by the
+        last-token rule like :meth:`match_prefix`. Takes NO references,
+        leaves the LRU order and every hit/lookup counter untouched — a
+        fleet router scores many replicas per submission, and a probe
+        that perturbed the cache would make routing observe-and-destroy.
+        Hashes are chained lazily so a miss stops the walk early."""
+        n = len(tokens)
+        limit = max((n - 1) // self.block_size, 0)
+        bs = self.block_size
+        h = hash(("kv_quant", self.kv_quant))
+        hits = 0
+        for i in range(limit):
+            h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
+            if h not in self._by_hash:
+                break
+            hits += 1
+        return hits
+
     def match_hashes(self, hashes: Sequence[int]) -> List[int]:
         """Longest still-resident prefix of an explicit chain-hash list,
         re-ref'd for the caller — the swap-in fast path: every hit is a

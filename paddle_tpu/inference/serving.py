@@ -2104,7 +2104,7 @@ class GenerationServer:
                 "seq": ent.seq, "cost": ent.cost, "vtag": ent.vtag,
                 "preempted": ent.preempted, "started": ent.started}
 
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, *, trust_kv: bool = True) -> Dict[str, Any]:
         """Crash-safe capture of the full in-flight engine state — the
         drain/migrate primitive (ROADMAP 5): every queued, prefilling,
         decoding, and swapped request, with enough state that
@@ -2118,10 +2118,24 @@ class GenerationServer:
         Per-payload CRC checksums ride along, so a payload corrupted in
         transit degrades to re-prefill on the restoring side instead of
         wrong tokens. Host-only: zero compiled programs on a warm
-        server, zero device state mutated. Paged servers only."""
+        server, zero device state mutated. Paged servers only.
+
+        ``trust_kv=False`` captures decoding slots as replay-queued work
+        (prompt + generated so far, re-prefilled token-exactly on the
+        restoring side) instead of gathering their device KV — the
+        salvage mode for an engine whose device state can no longer be
+        trusted (a failed replica): host-side request state is always
+        consistent at the last completed harvest, the device pools may
+        not be. Already-swapped entries keep their KV payloads either
+        way — those live in host RAM behind a CRC, not on the device."""
         if self.cache_mode != "paged":
             raise ValueError("snapshot() requires cache='paged' — the "
                              "dense slab has no per-request KV capture")
+        if self._failed is not None and trust_kv:
+            raise ValueError(
+                f"server failed ({self._failed}): device KV is untrusted "
+                f"after a post-dispatch failure — capture with "
+                f"snapshot(trust_kv=False) to salvage from host state")
         from .kv_offload import payload_checksum
 
         reqs: List[Dict[str, Any]] = []
@@ -2135,6 +2149,15 @@ class GenerationServer:
                 # prefill is recomputable (and must be: its KV covers an
                 # unfinished chunk boundary) — restore re-queues it
                 d["phase"] = "queued"
+            elif not trust_kv:
+                # salvage: re-enter through the corruption-recovery replay
+                # rung — re-prefill prompt+generated[:-1], resume decode at
+                # the saved position with the last generated token as the
+                # next input; token-identical by the same argument as the
+                # CRC-mismatch fallback
+                d["phase"] = "queued"
+                d["replay"] = (list(req.prompt)
+                               + list(req.generated))[:int(self.pos[s])]
             else:
                 arrays = self._offload.gather_payload(req.table,
                                                       self._pools)
@@ -2208,22 +2231,13 @@ class GenerationServer:
         if snap.get("format") != 1:
             raise ValueError(f"unknown snapshot format "
                              f"{snap.get('format')!r}")
-        want = snap["config"]
-        have = self._snapshot_fingerprint()
-        for k, hv in have.items():
-            wv = want.get(k)
-            if k == "num_blocks":
-                if hv < wv:
-                    raise ValueError(
-                        f"restoring pool has {hv} blocks but the snapshot "
-                        f"was taken with {wv} — a smaller pool cannot "
-                        f"guarantee the captured requests stay feasible")
-            elif hv != wv:
-                raise ValueError(
-                    f"snapshot/server config mismatch on {k!r}: snapshot "
-                    f"has {wv!r}, this server has {hv!r}")
-        from .kv_offload import SwapHandle
-
+        self._check_snapshot_config(snap["config"])
+        # pre-flight the per-request ladder BEFORE mutating anything: a
+        # mid-loop rejection (unknown adapter) must leave this server
+        # exactly as it was — a partial restore would be corruption, not
+        # an error
+        for d in snap["requests"]:
+            self._validate_snapshot_request(d)
         self._base_key = jnp.asarray(np.asarray(snap["rng_key"]))
         self._step_no = int(snap["step_no"])
         self._next_rid = max(self._next_rid, int(snap["next_rid"]))
@@ -2242,62 +2256,196 @@ class GenerationServer:
         now = self._sched.now()
         restored = 0
         for d in sorted(snap["requests"], key=lambda d: d["sched"]["seq"]):
-            if d["adapter"] is not None:
-                if self._lora is None:
-                    raise ValueError(
-                        f"request {d['rid']} names adapter "
-                        f"{d['adapter']!r} but this server has no lora=")
-                self._lora.validate(d["adapter"])
-            req = _Request(int(d["rid"]), list(d["prompt"]),
-                           int(d["max_new_tokens"]),
-                           temperature=float(d["temperature"]),
-                           top_k=int(d["top_k"]), top_p=float(d["top_p"]),
-                           draft_k=d["draft_k"], adapter=d["adapter"])
-            req.generated = list(d["generated"])
-            req.replay = (list(d["replay"]) if d["replay"] is not None
-                          else None)
-            req.hashes = list(d["hashes"])
-            sd = d["sched"]
-            ent = SchedEntry(req=req, rid=req.rid,
-                             priority=int(sd["priority"]),
-                             tenant=sd["tenant"],
-                             deadline=(None if sd["ttl_remaining"] is None
-                                       else now + sd["ttl_remaining"]),
-                             seq=int(sd["seq"]), cost=float(sd["cost"]),
-                             vtag=float(sd["vtag"]),
-                             preempted=bool(sd["preempted"]),
-                             started=bool(sd["started"]),
-                             adapter=req.adapter)
-            req.sched = ent
-            if d["phase"] == "kv":
-                kv = d["kv"]
-                handle = SwapHandle(
-                    rid=req.rid, n_tokens=int(kv["n_tokens"]),
-                    last_token=int(kv["last_token"]),
-                    n_blocks=int(kv["n_blocks"]),
-                    hashes=list(kv["hashes"]), nbytes=int(kv["nbytes"]),
-                    checksum=int(kv["checksum"]))
-                self._offload.adopt(
-                    handle, [np.asarray(a) for a in kv["arrays"]])
-                ent.swap = handle
-            self._sched.restore_entry(ent)
-            # fresh wall-clock marks: the captured server's monotonic
-            # clock does not transfer across processes, and mixing the
-            # two would observe negative latencies
-            m: Dict[str, Any] = {"submit_t": self._wall(),
-                                 "tenant": ent.tenant}
-            if req.generated:
-                m["first_token_t"] = m["submit_t"]
-            self._req_metrics[req.rid] = m
-            if self._tel.enabled:
-                tr = self._tel.tracer
-                tr.set_meta(req.rid, tenant=ent.tenant,
-                            priority=ent.priority,
-                            prompt_len=len(req.prompt),
-                            adapter=req.adapter or "")
-                tr.begin(req.rid, "queued", restored=True)
+            self._admit_snapshot_request(d, now)
             restored += 1
         return restored
+
+    def _check_snapshot_config(self, want: Dict[str, Any]) -> None:
+        """Validate a snapshot's config fingerprint against this server's
+        (shared by :meth:`restore` and :meth:`admit_migrated`)."""
+        have = self._snapshot_fingerprint()
+        for k, hv in have.items():
+            wv = want.get(k)
+            if k == "num_blocks":
+                if hv < wv:
+                    raise ValueError(
+                        f"restoring pool has {hv} blocks but the snapshot "
+                        f"was taken with {wv} — a smaller pool cannot "
+                        f"guarantee the captured requests stay feasible")
+            elif hv != wv:
+                raise ValueError(
+                    f"snapshot/server config mismatch on {k!r}: snapshot "
+                    f"has {wv!r}, this server has {hv!r}")
+
+    def _validate_snapshot_request(self, d: Dict[str, Any]) -> None:
+        """Reject-at-the-door checks for one snapshot request dict —
+        must run before ANY server state mutates."""
+        if d["adapter"] is not None:
+            if self._lora is None:
+                raise ValueError(
+                    f"request {d['rid']} names adapter "
+                    f"{d['adapter']!r} but this server has no lora=")
+            self._lora.validate(d["adapter"])
+
+    def _admit_snapshot_request(self, d: Dict[str, Any],
+                                now: float) -> None:
+        """Re-admit one validated snapshot request dict through the
+        normal machinery: KV payloads are adopted into the host pool and
+        re-enter via the CRC-verified swap-in path; queued/replay work
+        re-queues. The per-request half of :meth:`restore`, shared with
+        :meth:`admit_migrated`."""
+        from .kv_offload import SwapHandle
+
+        req = _Request(int(d["rid"]), list(d["prompt"]),
+                       int(d["max_new_tokens"]),
+                       temperature=float(d["temperature"]),
+                       top_k=int(d["top_k"]), top_p=float(d["top_p"]),
+                       draft_k=d["draft_k"], adapter=d["adapter"])
+        req.generated = list(d["generated"])
+        req.replay = (list(d["replay"]) if d["replay"] is not None
+                      else None)
+        req.hashes = list(d["hashes"])
+        sd = d["sched"]
+        ent = SchedEntry(req=req, rid=req.rid,
+                         priority=int(sd["priority"]),
+                         tenant=sd["tenant"],
+                         deadline=(None if sd["ttl_remaining"] is None
+                                   else now + sd["ttl_remaining"]),
+                         seq=int(sd["seq"]), cost=float(sd["cost"]),
+                         vtag=float(sd["vtag"]),
+                         preempted=bool(sd["preempted"]),
+                         started=bool(sd["started"]),
+                         adapter=req.adapter)
+        req.sched = ent
+        if d["phase"] == "kv":
+            kv = d["kv"]
+            handle = SwapHandle(
+                rid=req.rid, n_tokens=int(kv["n_tokens"]),
+                last_token=int(kv["last_token"]),
+                n_blocks=int(kv["n_blocks"]),
+                hashes=list(kv["hashes"]), nbytes=int(kv["nbytes"]),
+                checksum=int(kv["checksum"]))
+            self._offload.adopt(
+                handle, [np.asarray(a) for a in kv["arrays"]])
+            ent.swap = handle
+        self._sched.restore_entry(ent)
+        # fresh wall-clock marks: the captured server's monotonic
+        # clock does not transfer across processes, and mixing the
+        # two would observe negative latencies
+        m: Dict[str, Any] = {"submit_t": self._wall(),
+                             "tenant": ent.tenant}
+        if req.generated:
+            m["first_token_t"] = m["submit_t"]
+        self._req_metrics[req.rid] = m
+        if self._tel.enabled:
+            tr = self._tel.tracer
+            tr.set_meta(req.rid, tenant=ent.tenant,
+                        priority=ent.priority,
+                        prompt_len=len(req.prompt),
+                        adapter=req.adapter or "")
+            tr.begin(req.rid, "queued", restored=True)
+
+    def admit_migrated(self, d: Dict[str, Any], *,
+                       source_config: Optional[Dict[str, Any]] = None
+                       ) -> int:
+        """Admit ONE snapshot request dict into this — possibly busy —
+        server: the fleet migration primitive. Unlike :meth:`restore`
+        (whole-snapshot, idle target only) this re-admits a single
+        request through the same validated path while the target keeps
+        serving its own traffic; KV payloads adopt into the host pool
+        and resume via the compile-once, CRC-verified swap-in program,
+        so a payload corrupted in transit degrades to re-prefill.
+
+        ``source_config`` (the snapshot's ``config`` fingerprint) is
+        checked when given — fleet replicas are homogeneous, so the
+        router passes it once per migration. The caller guarantees rid
+        uniqueness across engines (``FleetRouter`` assigns replicas
+        disjoint rid spaces). Returns the admitted rid."""
+        if self.cache_mode != "paged":
+            raise ValueError("admit_migrated() requires cache='paged'")
+        if self._failed is not None:
+            from .faults import EngineFailedError
+
+            raise EngineFailedError(
+                f"cannot migrate into a failed server ({self._failed})")
+        if source_config is not None:
+            self._check_snapshot_config(source_config)
+        self._validate_snapshot_request(d)
+        self._admit_snapshot_request(d, self._sched.now())
+        return int(d["rid"])
+
+    def evacuate(self, *, trust_kv: bool = True) -> Dict[str, Any]:
+        """Capture a :meth:`snapshot` and then RELEASE every in-flight
+        request from this server — the drain half of a fleet migration:
+        the caller re-admits the returned snapshot's requests elsewhere,
+        and this engine ends empty (slots free, queue empty, host pool
+        drained) so :meth:`assert_conserved` holds trivially afterwards.
+        Completed results and dropped markers stay readable on this
+        server (and ride the snapshot). ``trust_kv=False`` salvages a
+        failed engine from host state only."""
+        snap = self.snapshot(trust_kv=trust_kv)
+        for s in range(self.max_batch):
+            req = self._slots[s]
+            if req is None:
+                continue
+            req.table = self.alloc.truncate(req.table, 0)
+            self._tel.tracer.close(req.rid, "migrated")
+            self._release_slot(s)
+        for ent in list(self._sched.waiting()):
+            self._sched.remove(ent.rid)
+            if ent.swap is not None:
+                self._offload.discard(ent.swap)
+            self._tel.tracer.close(ent.rid, "migrated")
+        return snap
+
+    def take_results(self) -> Dict[int, List[int]]:
+        """Pop and return every completed result accumulated so far —
+        the incremental-harvest form of :meth:`run`'s return value (the
+        fleet router collects per step instead of at drain)."""
+        out, self._results = self._results, {}
+        return out
+
+    @property
+    def steps(self) -> int:
+        """Completed engine steps — the fleet router's tick-progress
+        heartbeat signal (a replica wedged with queued work but no
+        active slot holds work without advancing this)."""
+        return self._step_no
+
+    def fail(self, reason: str) -> None:
+        """Mark this engine terminally failed (idempotent — the first
+        reason sticks). ``submit``/``restore``/``admit_migrated`` refuse
+        afterwards; the fleet router uses this to poison a replica the
+        chaos plan killed so nothing re-enters it behind the salvage."""
+        if self._failed is None:
+            self._failed = str(reason)
+
+    def load_metrics(self) -> Dict[str, int]:
+        """O(1) load signals for routing decisions — the cheap subset of
+        :meth:`sched_metrics` (which builds per-tenant percentile tables
+        and is priced for end-of-run reporting, not per-submission
+        scoring) plus the allocator's admission headroom."""
+        m = {"queue_depth": len(self._sched),
+             "slots_occupied": sum(sl is not None for sl in self._slots),
+             "slots_total": self.max_batch}
+        if self.cache_mode == "paged":
+            m["blocks_headroom"] = (self.alloc.blocks_free
+                                    + self.alloc.evictable_cached)
+        return m
+
+    def set_rid_base(self, base: int) -> None:
+        """Start this server's rid counter at ``base`` — only valid on a
+        fresh server (nothing submitted yet). The fleet router assigns
+        each replica a disjoint rid space so migrated requests can never
+        collide with a peer's own."""
+        if (self._next_rid != 0 or len(self._sched)
+                or any(sl is not None for sl in self._slots)
+                or self._results or self._dropped):
+            raise ValueError("set_rid_base() requires a fresh server — "
+                             "rids already handed out would collide")
+        if not isinstance(base, int) or isinstance(base, bool) or base < 0:
+            raise ValueError(f"rid base must be an int >= 0, got {base!r}")
+        self._next_rid = base
 
     # ------------------------------------------------------------ telemetry
     def telemetry_snapshot(self) -> Dict[str, Any]:

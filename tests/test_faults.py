@@ -367,6 +367,108 @@ def test_restore_refuses_bad_targets():
     srv.run()
 
 
+def test_restore_validation_ladder_rejects_without_corrupting_target():
+    """The negative rungs of the restore ladder — kv_quant mismatch,
+    shrunk block pool, missing adapter — each raise a clear error and
+    leave the refusing target untouched: conserved, idle, and still able
+    to serve. The same snapshot then restores cleanly into a proper
+    target, token-identical (the failed attempts corrupted nothing)."""
+    from tests.test_lora_serving import _adapter_weights
+
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    reg = AdapterRegistry()
+    reg.register("a1", _adapter_weights(cfg, 4, seed=1), rank=4, alpha=8.0)
+    lora = dict(max_live_adapters=4, max_rank=4)
+    mk = dict(max_batch=2, max_len=96, cache="paged", block_size=8,
+              prefill_chunk=16)
+    srv = GenerationServer(model, num_blocks=24,
+                           lora=LoRAConfig(reg, **lora), **mk)
+    rids = [srv.submit(p, max_new_tokens=12,
+                       adapter="a1" if i == 0 else None)
+            for i, p in enumerate(prompts)]
+    for _ in range(4):
+        srv.step()
+    snap = srv.snapshot()
+    base = srv.run()
+
+    def rejects(target, match):
+        with pytest.raises(ValueError, match=match):
+            target.restore(snap)
+        audit = target.assert_conserved()
+        assert audit["blocks_in_use"] == 0, "rejected restore leaked blocks"
+        assert audit["host_bytes_in_use"] == 0, "rejected restore leaked host"
+        assert target.load_metrics()["queue_depth"] == 0, \
+            "rejected restore left requests behind"
+        r = target.submit(prompts[2], max_new_tokens=4)   # still serves
+        assert r in target.run()
+
+    # kv_quant mismatch: the payloads' dtype/scale layout would not parse
+    rejects(GenerationServer(model, num_blocks=24, kv_quant="int8",
+                             lora=LoRAConfig(reg, **lora), **mk),
+            "kv_quant")
+    # shrunk pool: captured requests may no longer be feasible
+    rejects(GenerationServer(model, num_blocks=12,
+                             lora=LoRAConfig(reg, **lora), **mk),
+            "blocks")
+    # no LoRA stack at all: config fingerprint refuses up front
+    rejects(GenerationServer(model, num_blocks=24, **mk), "lora")
+    # LoRA stack present but the adapter is unknown: the per-request
+    # pre-flight refuses BEFORE any state mutates (a mid-loop rejection
+    # would be a partial restore — corruption, not an error)
+    rejects(GenerationServer(model, num_blocks=24,
+                             lora=LoRAConfig(AdapterRegistry(), **lora),
+                             **mk),
+            "unknown adapter")
+
+    good = GenerationServer(model, num_blocks=24,
+                            lora=LoRAConfig(reg, **lora), **mk)
+    assert good.restore(snap) == len(rids)
+    out = good.run()
+    for r in rids:
+        assert out[r] == base[r], "snapshot was damaged by failed restores"
+    good.assert_conserved()
+
+
+def test_restore_under_live_fault_injection():
+    """Chaos during drain: the receiving server restores a snapshot
+    while its own seeded fault plan is live — swap-in corruption on the
+    migrated payloads, allocator exhaustion, a tick fault. The ladder
+    and the restore path compose: every non-quarantined request finishes
+    token-identical to the captured server's own continuation, the CRC
+    rung demonstrably fired, and conservation holds after every tick."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    srv, rids = _mid_flight_server(model, cfg, prompts)
+    snap = srv.snapshot()
+    base = srv.run()
+
+    inj = FaultInjector(FaultPlan([
+        FaultSpec("swap_corrupt", at=0, count=2),
+        FaultSpec("tick", at=1, count=1),
+        FaultSpec("alloc", at=2, count=2),
+    ], seed=13))
+    fresh = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                             block_size=8, prefill_chunk=16, faults=inj)
+    assert fresh.restore(snap) == len(rids)
+    steps = 0
+    while fresh.step():
+        fresh.assert_conserved()
+        steps += 1
+        assert steps < 5000, "restore-under-chaos wedged"
+    out = fresh.run()
+    assert len(inj.fired) > 0, "plan never fired — proved nothing"
+    assert fresh.telemetry.registry.counter(
+        "serving_swap_reprefills", "").total() >= 1, \
+        "corrupted restore payload never hit the CRC re-prefill rung"
+    for r in rids:
+        if fresh.status(r) == "failed":
+            assert r not in out
+        else:
+            assert out[r] == base[r], "restored-under-chaos run diverged"
+    fresh.assert_conserved()
+
+
 # --------------------------------------------------------------------------
 # Chaos soak: a seeded plan against a bursty workload
 # --------------------------------------------------------------------------

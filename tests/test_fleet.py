@@ -1,0 +1,465 @@
+"""Fleet-scale serving (inference/fleet.py): prefix-aware routing over
+N in-process replicas, health-checked membership (heartbeat stalls →
+degraded → dead against an injectable clock), and live token-exact
+request migration — graceful drains ride the snapshot/swap-in path,
+crash salvage rides the replay rung, and both finish every
+non-quarantined request identical to an undisturbed single-engine run.
+Quick tier on CPU."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import jit_cache_guard
+from paddle_tpu.inference import AdapterRegistry, LoRAConfig
+from paddle_tpu.inference.faults import (EngineFailedError, FaultInjector,
+                                         FaultPlan, FaultSpec)
+from paddle_tpu.inference.fleet import (REPLICA_DEAD, REPLICA_DEGRADED,
+                                        REPLICA_LIVE, RID_STRIDE,
+                                        FleetRouter)
+from paddle_tpu.inference.scheduler import AdmissionError, Scheduler
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _prompts(cfg, lens=(18, 11, 7, 9)):
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, cfg.vocab_size, (n,)).tolist() for n in lens]
+
+
+def _server(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("cache", "paged")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 16)
+    return GenerationServer(model, **kw)
+
+
+def _baseline(model, prompts, max_new=12, adapters=None, **kw):
+    """Undisturbed single-engine run: the token-identity oracle."""
+    srv = _server(model, **kw)
+    akw = [{"adapter": a} for a in (adapters or [None] * len(prompts))]
+    rids = [srv.submit(p, max_new_tokens=max_new, **a)
+            for p, a in zip(prompts, akw)]
+    out = srv.run()
+    return [out[r] for r in rids]
+
+
+# --------------------------------------------------------------------------
+# Routing: read-only prefix probe, load spread, admission fallback
+# --------------------------------------------------------------------------
+
+def test_probe_prefix_is_read_only():
+    """Routing probes must not perturb the replica they score: no refs
+    taken, no LRU reordering, no hit/lookup counter movement — the same
+    walk via match_prefix (which DOES take refs) agrees on the depth."""
+    model, cfg = _model()
+    srv = _server(model)
+    p = _prompts(cfg)[0]
+    srv.submit(p, max_new_tokens=6)
+    srv.run()
+    stats = srv.alloc.stats()
+    refs = srv.alloc.ref_counts()
+    hits = srv.alloc.probe_prefix(p)
+    assert hits == len(p) // srv.block_size >= 2
+    assert srv.alloc.probe_prefix([1, 2, 3]) == 0
+    assert srv.alloc.stats() == stats, "probe moved allocator counters"
+    assert srv.alloc.ref_counts() == refs, "probe took references"
+    got = srv.alloc.match_prefix(p)
+    assert len(got) == hits, "probe disagrees with the real prefix match"
+    for bid in got:
+        srv.alloc.free(bid)
+    srv.assert_conserved()
+
+
+def test_router_validates_replicas():
+    model, cfg = _model()
+    dense = GenerationServer(model, max_batch=2, max_len=96,
+                             prompt_buckets=(32,))
+    with pytest.raises(ValueError, match="paged"):
+        FleetRouter([dense])
+    with pytest.raises(ValueError, match="homogeneous"):
+        FleetRouter([_server(model), _server(model, block_size=4)])
+    used = _server(model)
+    used.submit(_prompts(cfg)[0], max_new_tokens=2)
+    with pytest.raises(ValueError, match="fresh"):
+        FleetRouter([_server(model), used])
+    used.run()
+
+
+def test_routing_spreads_by_load_and_rids_are_disjoint():
+    """Idle-fleet submissions alternate replicas by load score, and the
+    rid itself names the home replica (disjoint rid spaces)."""
+    model, cfg = _model()
+    fleet = FleetRouter([_server(model) for _ in range(2)])
+    rng = np.random.RandomState(3)
+    rids = [fleet.submit(rng.randint(1, cfg.vocab_size, (10,)).tolist(),
+                         max_new_tokens=4) for _ in range(4)]
+    assert [r // RID_STRIDE for r in rids] == [0, 1, 0, 1]
+    out = fleet.run()
+    assert all(r in out for r in rids)
+    fleet.assert_conserved()
+
+
+def test_routing_prefers_cached_prefix():
+    """A submission sharing a cached block with replica 1 overrides the
+    idle tie (which would pick replica 0)."""
+    model, cfg = _model()
+    fleet = FleetRouter([_server(model) for _ in range(2)])
+    prompts = _prompts(cfg)
+    r0 = fleet.submit(prompts[0], max_new_tokens=4)
+    r1 = fleet.submit(prompts[1], max_new_tokens=4)
+    assert (r0 // RID_STRIDE, r1 // RID_STRIDE) == (0, 1)
+    fleet.run()
+    warm = prompts[1][:8] + _prompts(cfg, lens=(10,))[0]
+    rid = fleet.submit(warm, max_new_tokens=4)
+    assert rid // RID_STRIDE == 1, "router ignored the cached prefix"
+    assert fleet.run()[rid][:len(warm)] == warm
+
+
+def test_admission_backpressure_falls_through_to_peer():
+    """AdmissionError on the preferred replica falls through to the
+    next-best; only when EVERY eligible replica refuses does submit
+    re-raise the backpressure signal."""
+    model, cfg = _model()
+    fleet = FleetRouter(
+        [_server(model, policy=Scheduler("fifo", max_queue=1))
+         for _ in range(2)])
+    prompts = _prompts(cfg)
+    a = fleet.submit(prompts[0], max_new_tokens=4)
+    b = fleet.submit(prompts[1], max_new_tokens=4)   # falls through to 1
+    assert (a // RID_STRIDE, b // RID_STRIDE) == (0, 1)
+    with pytest.raises(AdmissionError):
+        fleet.submit(prompts[2], max_new_tokens=4)
+    out = fleet.run()
+    assert a in out and b in out
+    fleet.assert_conserved()
+
+
+# --------------------------------------------------------------------------
+# Health: heartbeat state machine against an injectable clock
+# --------------------------------------------------------------------------
+
+def test_heartbeat_wedge_degrades_then_kills_and_fails_over():
+    """A replica holding work without advancing its step counter walks
+    live → degraded → dead on the router's stall thresholds, and its
+    requests fail over to the peer token-identically."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11))
+    base = _baseline(model, prompts, max_new=8)
+
+    t = [0.0]
+    fleet = FleetRouter([_server(model) for _ in range(2)],
+                        clock=lambda: t[0], probe_every=0,
+                        stall_ticks_degraded=2, stall_ticks_dead=4)
+    rids = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    assert [r // RID_STRIDE for r in rids] == [0, 1]
+    rep0 = fleet._replicas[0]
+    rep0.server.step = lambda: 1          # wedge: holds work, no progress
+    for _ in range(2):
+        t[0] += 1.0
+        fleet.step()
+    assert rep0.state == REPLICA_DEGRADED
+    for _ in range(2):
+        t[0] += 1.0
+        fleet.step()
+    assert rep0.state == REPLICA_DEAD
+    fm = fleet.fleet_metrics()
+    assert fm["heartbeat_stalls"] == 4 and fm["deaths"] == 1
+    assert fm["degraded_events"] == 1 and fm["quarantined"] == 0
+    assert [s for _, s in rep0.history] == [
+        REPLICA_LIVE, REPLICA_DEGRADED, REPLICA_DEAD]
+    with pytest.raises(EngineFailedError):
+        rep0.server.submit(prompts[0], max_new_tokens=1)
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want, "failover diverged from the clean twin"
+    fleet.assert_conserved()
+
+
+def test_heartbeat_recovery_after_cooldown():
+    """A transient stall degrades the replica; once it progresses again
+    and the cooldown elapses it returns to live — no kill, no drops."""
+    model, cfg = _model()
+    t = [0.0]
+    fleet = FleetRouter([_server(model) for _ in range(2)],
+                        clock=lambda: t[0], probe_every=0,
+                        stall_ticks_degraded=2, stall_ticks_dead=100,
+                        degrade_cooldown_s=5.0)
+    rid = fleet.submit(_prompts(cfg)[0], max_new_tokens=8)
+    rep0 = fleet._replicas[0]
+    rep0.server.step = lambda: 1
+    for _ in range(3):
+        t[0] += 1.0
+        fleet.step()
+    assert rep0.state == REPLICA_DEGRADED
+    del rep0.server.step                  # un-wedge: class method returns
+    t[0] += 1.0
+    fleet.step()
+    assert rep0.state == REPLICA_DEGRADED, "recovered before cooldown"
+    t[0] += 10.0
+    fleet.step()
+    assert rep0.state == REPLICA_LIVE
+    assert rid in fleet.run()
+    fleet.assert_conserved()
+
+
+# --------------------------------------------------------------------------
+# Live migration: drain (trusted KV), chaos kill (salvage), corruption
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_drain_migration_token_exact(kv_quant):
+    """drain() mid-decode moves every in-flight request (KV payloads
+    included) onto peers and the fleet finishes token-identical to the
+    single-engine oracle, fp and int8 pools alike; conservation holds on
+    every engine, the drained one trivially."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    base = _baseline(model, prompts, max_new=12, kv_quant=kv_quant)
+
+    fleet = FleetRouter([_server(model, kv_quant=kv_quant)
+                         for _ in range(3)])
+    rids = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+    for _ in range(4):
+        fleet.step()
+    moved = fleet.drain(0)
+    assert moved >= 1
+    fm = fleet.fleet_metrics()
+    assert fm["states"][REPLICA_DEAD] == 1 and fm["drains"] == 1
+    assert fm["migrated_kv"] >= 1, "no KV payload rode the swap-in path"
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want, "drained run diverged from the oracle"
+    audits = fleet.assert_conserved()
+    assert audits[0]["blocks_in_use"] == 0, "drained replica kept blocks"
+
+
+def test_drain_migration_with_lora_adapters():
+    """Adapter-pinned requests migrate with their residency intact: the
+    receiving replica validates and uploads the adapter, outputs stay
+    token-identical."""
+    from tests.test_lora_serving import _adapter_weights
+
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    reg.register("a1", _adapter_weights(cfg, 4, seed=1), rank=4, alpha=8.0)
+    reg.register("a2", _adapter_weights(cfg, 2, seed=2), rank=2, alpha=2.0)
+    lora = dict(max_live_adapters=4, max_rank=4)
+    prompts = _prompts(cfg)
+    adapters = ["a1", "a2", None, "a1"]
+    base = _baseline(model, prompts, max_new=12, adapters=adapters,
+                     lora=LoRAConfig(reg, **lora))
+
+    fleet = FleetRouter([_server(model, lora=LoRAConfig(reg, **lora))
+                         for _ in range(2)])
+    rids = [fleet.submit(p, max_new_tokens=12, adapter=a)
+            for p, a in zip(prompts, adapters)]
+    for _ in range(4):
+        fleet.step()
+    assert fleet.drain(0) >= 1
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want
+    fleet.assert_conserved()
+
+
+def test_drain_migration_zero_steady_state_recompiles():
+    """Migration admits through the NORMAL swap-in path: once a replica
+    has resumed one adopted payload (and gathered one snapshot), a
+    second drain plus the full fleet drain-to-empty compiles nothing —
+    same discipline as the engine's own snapshot-resume guarantee."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11, 7, 9, 13, 15))
+    base = _baseline(model, prompts, max_new=24, max_batch=3)
+
+    fleet = FleetRouter([_server(model, max_batch=3) for _ in range(3)])
+    rids = [fleet.submit(p, max_new_tokens=24) for p in prompts]
+    assert [r // RID_STRIDE for r in rids] == [0, 1, 2, 0, 1, 2]
+    for _ in range(4):
+        fleet.step()
+    assert fleet.drain(0) >= 2            # one KV payload to each peer
+    for _ in range(8):                    # let the adopted payloads swap in
+        fleet.step()
+    s1 = fleet._replicas[1].server
+    s2 = fleet._replicas[2].server
+    assert s1.sched_metrics()["resumes"] >= 1, "peer 1 never swapped in"
+    assert s2.sched_metrics()["resumes"] >= 1, "peer 2 never swapped in"
+    s1.snapshot()                         # warm peer 1's gather program
+    with jit_cache_guard("fleet-drain") as g:
+        fleet.drain(1)
+        out = fleet.run()
+    assert g.compiles == 0, "migration paid a steady-state recompile"
+    for rid, want in zip(rids, base):
+        assert out[rid] == want
+    fleet.assert_conserved()
+
+
+def test_migrate_payload_corruption_degrades_to_reprefill():
+    """A payload bit-flipped in transit is caught by the receiver's CRC
+    check and degrades to token-exact re-prefill — migration inherits
+    the swap path's integrity ladder."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11, 7))
+    base = _baseline(model, prompts, max_new=12)
+
+    inj = FaultInjector(FaultPlan([FaultSpec("migrate_payload", at=0)],
+                                  seed=17))
+    fleet = FleetRouter([_server(model) for _ in range(2)], faults=inj)
+    rids = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+    for _ in range(4):
+        fleet.step()
+    assert fleet.drain(0) >= 1
+    assert fleet.fleet_metrics()["migrate_corruptions"] == 1
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want, "CRC-degraded migration diverged"
+    s1 = fleet._replicas[1].server
+    assert s1.telemetry.registry.counter(
+        "serving_swap_reprefills", "").total() >= 1, \
+        "receiver never exercised the re-prefill rung"
+    fleet.assert_conserved()
+
+
+def test_route_fault_is_correctness_neutral():
+    """An injected misroute (worst-scoring replica) costs prefix reuse
+    only — outputs are unchanged and the counter records it."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11))
+    base = _baseline(model, prompts, max_new=8)
+    inj = FaultInjector(FaultPlan([FaultSpec("route", at=0, count=1)]))
+    fleet = FleetRouter([_server(model) for _ in range(2)], faults=inj)
+    rids = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    assert fleet.fleet_metrics()["misroutes"] == 1
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want
+
+
+def test_no_survivor_quarantines_not_drops():
+    """Killing the last replica leaves its in-flight requests
+    quarantined ('failed'), never silently vanished; finished work
+    stays answerable from the router's ledgers."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11))
+    fleet = FleetRouter([_server(model)])
+    done = fleet.submit(prompts[0], max_new_tokens=2)
+    while fleet.status(done) != "done":
+        fleet.step()
+    doomed = fleet.submit(prompts[1], max_new_tokens=8)
+    fleet.step()
+    fleet.kill(0)
+    assert fleet.status(done) == "done"
+    assert fleet.status(doomed) == "failed"
+    assert fleet.fleet_metrics()["quarantined"] == 1
+    with pytest.raises(EngineFailedError):
+        fleet.submit(prompts[0], max_new_tokens=1)
+    assert fleet.step() == 0
+    fleet.assert_conserved()
+
+
+# --------------------------------------------------------------------------
+# Chaos acceptance: seeded kill mid-decode, zero token mismatches
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_quant,use_lora", [
+    ("none", False), ("int8", False), ("none", True)])
+def test_chaos_replica_down_failover_token_exact(kv_quant, use_lora):
+    """The acceptance bar: a seeded FaultPlan kills 1 of 2 replicas
+    mid-decode; the router salvages its requests from host state and
+    every non-quarantined request completes token-identical to the
+    fault-free single-engine run — fp and int8, with and without LoRA —
+    while the survivor's continuation compiles nothing and conservation
+    holds on every engine."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    adapters = None
+    mk_lora = lambda: None                           # noqa: E731
+    if use_lora:
+        from tests.test_lora_serving import _adapter_weights
+
+        reg = AdapterRegistry()
+        reg.register("a1", _adapter_weights(cfg, 4, seed=1), rank=4,
+                     alpha=8.0)
+        reg.register("a2", _adapter_weights(cfg, 2, seed=2), rank=2,
+                     alpha=2.0)
+        adapters = ["a1", "a2", None, "a1"]
+        mk_lora = lambda: LoRAConfig(reg, max_live_adapters=4,  # noqa: E731
+                                     max_rank=4)
+
+    base = _baseline(model, prompts, max_new=12, adapters=adapters,
+                     kv_quant=kv_quant, lora=mk_lora())
+
+    plan = FaultPlan.fleet_chaos(3, replicas=2)
+    inj = FaultInjector(plan)
+    fleet = FleetRouter(
+        [_server(model, kv_quant=kv_quant, lora=mk_lora())
+         for _ in range(2)], faults=inj)
+    akw = [{"adapter": a} for a in (adapters or [None] * len(prompts))]
+    rids = [fleet.submit(p, max_new_tokens=12, **a)
+            for p, a in zip(prompts, akw)]
+
+    ticks = 0
+    while REPLICA_DEAD not in fleet.replica_states():
+        remaining = fleet.step()
+        ticks += 1
+        assert ticks < 500, "chaos fleet wedged"
+        if remaining == 0:
+            pytest.fail("plan finished the run without killing a replica")
+    assert any(site == "replica_down" for site, _ in inj.fired)
+    fm = fleet.fleet_metrics()
+    assert fm["deaths"] == 1 and fm["quarantined"] == 0
+    assert fm["migrated_requests"] >= 1, "kill landed after the decode"
+    audits = fleet.assert_conserved()     # dead replica: trivially empty
+    dead_idx = fleet.replica_states().index(REPLICA_DEAD)
+    assert audits[dead_idx]["blocks_in_use"] == 0
+
+    with jit_cache_guard("fleet-failover") as g:
+        out = fleet.run()
+    assert g.compiles == 0, "survivor paid a steady-state recompile"
+    for rid, want in zip(rids, base):
+        assert out[rid] == want, "failover output diverged from the twin"
+    fleet.assert_conserved()
+
+
+def test_fleet_chaos_plan_is_deterministic():
+    pa, pb = FaultPlan.fleet_chaos(5), FaultPlan.fleet_chaos(5)
+    assert pa.specs == pb.specs
+    assert FaultPlan.fleet_chaos(6).specs != pa.specs
+    assert {s.site for s in pa.specs} == {"replica_down", "migrate_payload",
+                                          "route"}
+
+
+def test_fleet_metrics_rows_and_registry_sync():
+    """fleet_metrics() is the benchmark table contract: one well-formed
+    row per replica and the fleet_* gauges synced into the registry."""
+    model, cfg = _model()
+    fleet = FleetRouter([_server(model) for _ in range(2)])
+    rids = [fleet.submit(p, max_new_tokens=6) for p in _prompts(cfg)[:2]]
+    for _ in range(3):
+        fleet.step()
+    fleet.drain(0)
+    fleet.run()
+    fm = fleet.fleet_metrics()
+    assert len(fm["replicas"]) == 2
+    for row in fm["replicas"]:
+        for key in ("replica", "state", "steps", "queue_depth",
+                    "slots_occupied", "blocks_headroom", "prefix_hit_rate",
+                    "routed", "stall_ticks", "transitions"):
+            assert key in row
+    assert fm["states"][REPLICA_DEAD] == 1
+    assert fm["routed"] == len(rids)
+    reg = fleet.registry
+    assert reg.gauge("fleet_replicas_dead", "").value() == 1.0
+    assert reg.gauge("fleet_replica_up", "").value(replica="0") == 0.0
+    assert reg.gauge("fleet_replica_up", "").value(replica="1") == 1.0
+    assert reg.counter("fleet_drains", "").total() == 1

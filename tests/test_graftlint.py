@@ -394,6 +394,49 @@ class TestFaultHookInJitGL011:
         """)
 
 
+class TestWallClockGL012:
+    SERVING = "paddle_tpu/inference/mod.py"
+
+    def test_direct_clock_calls_in_inference(self):
+        ids = [f.rule_id for f in lint("""
+            import time
+            import datetime
+
+            def tick(self):
+                t0 = time.time()
+                t1 = time.monotonic()
+                t2 = time.perf_counter()
+                stamp = datetime.datetime.now()
+                return t0, t1, t2, stamp
+        """, path=self.SERVING)]
+        assert ids.count("GL012") == 4
+
+    def test_clock_reference_default_is_sanctioned(self):
+        # passing the callable (the injectable-clock seam) is THE pattern
+        assert "GL012" not in rule_ids("""
+            import time
+
+            class Router:
+                def __init__(self, clock=time.monotonic):
+                    self._clock = clock
+
+                def now(self):
+                    return self._clock()
+        """, path=self.SERVING)
+
+    def test_outside_inference_package_is_out_of_scope(self):
+        # benchmarks/tools time themselves freely; only serving is held
+        # to the injectable-clock contract
+        assert "GL012" not in rule_ids("""
+            import time
+
+            def bench(f):
+                t0 = time.perf_counter()
+                f()
+                return time.perf_counter() - t0
+        """, path="paddle_tpu/benchmarks/timer.py")
+
+
 class TestSyntaxErrorGL000:
     def test_unparseable_module_reports_gl000(self):
         assert rule_ids("def broken(:\n    pass") == ["GL000"]
@@ -535,7 +578,7 @@ class TestRepoGate:
              "--list-rules"], capture_output=True, text=True)
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                    "GL007", "GL008", "GL009", "GL010", "GL011"):
+                    "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"):
             assert rid in r.stdout
 
 

@@ -216,4 +216,44 @@ if r["value"] < 0.9 * r["ref_tok_s"]:
                      "retry/backoff ladder costs too much steady-state")
 PY
 
+echo "== 7f. fleet failover gate (2 replicas, seeded kill mid-decode vs undisturbed twin) =="
+python tools/serving_benchmark.py --paged --fleet 2 --chaos --strict \
+  --requests 24 --slots 4 --max-new 48 --tick-window 4 \
+  --seed 3 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_fleet.json \
+  || { echo "fleet gate FAILED (failover drain above the twin's compile"\
+       "budget, or dirty watchdog after recovery)"; exit 1; }
+python - <<'PY'
+# fleet gate: the seeded plan must kill exactly one of the two replicas
+# mid-decode; every non-quarantined request must finish token-identical
+# to the UNDISTURBED single-engine twin; the failover drain must stay
+# within the twin's compile budget (enforced in-process by the jit
+# guard; re-checked from the line); recovery on the survivor must leave
+# a clean watchdog; and the line must carry the schema/fingerprint
+# contract downstream tooling keys on
+import json
+r = json.load(open("/tmp/tpu_runs/serving_fleet.json"))
+print(f"deaths {r['fleet_deaths']} (states {r['fleet_states']}), "
+      f"salvaged {r['fleet_migrated_requests']} "
+      f"(kv {r['fleet_migrated_kv']}), quarantined {r['quarantined']}, "
+      f"mismatches {r['token_mismatches']}, recompiles "
+      f"{r['drain_recompiles']}/{r['ref_drain_recompiles']} (fleet/ref), "
+      f"tok/s {r['value']} vs twin {r['ref_tok_s']}")
+assert r.get("schema_version") == 2, "benchmark schema drifted"
+assert r.get("config_fingerprint"), "missing config fingerprint"
+assert r["fleet_deaths"] == 1, "seeded kill never landed — gate vacuous"
+assert r["fleet_states"]["dead"] == 1 and r["fleet_states"]["live"] == 1
+assert r["fleet_migrated_requests"] >= 1, \
+    "kill landed after the decode finished — nothing was salvaged"
+assert r["token_mismatches"] == 0, \
+    "non-quarantined request diverged from the undisturbed twin"
+assert r["quarantined"] == 0, \
+    "requests quarantined with a live survivor available"
+assert r["drain_recompiles"] <= r["ref_drain_recompiles"], \
+    "failover migration compiled beyond the twin's drain budget"
+assert r["watchdog_after_recovery"] == 0, \
+    "survivor watchdog dirty after the plan was spent"
+assert len(r["replicas"]) == 2, "per-replica rows missing"
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

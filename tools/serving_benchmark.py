@@ -51,24 +51,58 @@ line gains ``faults_injected/quarantined/token_mismatches/ref_tok_s``.
 finding (under ``--chaos``, on the post-plan recovery burst, which must
 come back clean).
 
+Fleet (docs/serving.md "Fleet: routing, failover, migration"):
+``--fleet N`` routes the same seeded traffic through a ``FleetRouter``
+of N replica engines (prefix-aware routing, health-checked membership)
+and the JSON line gains one row per replica (``fleet_metrics()``).
+Under ``--chaos`` the reference pass becomes an UNDISTURBED single
+engine and the measured fleet runs ``FaultPlan.fleet_chaos(--seed)``:
+one replica is killed mid-decode and the line reports
+``fleet_deaths / token_mismatches / quarantined`` plus
+``ref_drain_recompiles / drain_recompiles`` — the failover drain is
+held to the twin's compile budget by the same jit-cache guard.
+
+Every JSON line carries ``schema_version`` plus ``config_fingerprint``
+(a stable hash of the resolved workload/config knobs, reporting-only
+flags excluded) so downstream tooling can both detect schema drift and
+refuse to diff lines that measured different configurations.
+
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
        [--seed 0] [--arrival-rate R --burst B]
        [--scheduler fifo|priority|wfq [--mixed-priority]]
        [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
         [--host-pool-mb M] [--prefill-chunk 64]
         [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]
-        [--chaos [--strict]]]
+        [--fleet N] [--chaos [--strict]]]
        [--json]
 """
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+#: Bump when the JSON line's keys change meaning or go away (adding keys
+#: is compatible and does NOT bump): 2 = schema_version/config_fingerprint
+#: introduced alongside the --fleet rows.
+SCHEMA_VERSION = 2
+
+
+def config_fingerprint(args) -> str:
+    """Stable hash of every resolved knob that defines the measured
+    configuration (reporting-only flags excluded). Two JSON lines with
+    the same fingerprint measured the same setup — the suite gate and
+    regression tooling refuse to diff lines whose fingerprints differ."""
+    skip = {"json", "telemetry_out", "strict"}
+    src = {k: v for k, v in sorted(vars(args).items()) if k not in skip}
+    return hashlib.sha256(
+        json.dumps(src, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]
 
 
 def main():
@@ -182,6 +216,17 @@ def main():
                          "after the drain. The TTFT/TPOT percentiles in "
                          "the JSON line come from the same registry "
                          "histograms either way")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="route the traffic through a FleetRouter of N "
+                         "replica engines (paged only, N >= 2): "
+                         "prefix-aware routing + health-checked "
+                         "membership; the JSON line gains per-replica "
+                         "rows. With --chaos the reference is an "
+                         "UNDISTURBED single engine and the fleet runs "
+                         "FaultPlan.fleet_chaos(--seed) — one replica "
+                         "dies mid-decode; the line reports fleet_deaths"
+                         "/token_mismatches/quarantined and the failover "
+                         "drain is held to the twin's compile budget")
     ap.add_argument("--chaos", action="store_true",
                     help="chaos soak (paged only): run the seeded traffic "
                          "twice — a fault-free reference pass, then the "
@@ -205,6 +250,20 @@ def main():
     if args.chaos and not args.paged:
         ap.error("--chaos requires --paged (the fault sites live in the "
                  "paged substrate)")
+    if args.fleet:
+        if not args.paged:
+            ap.error("--fleet requires --paged (migration rides the "
+                     "per-request KV capture)")
+        if args.fleet < 2:
+            ap.error("--fleet needs N >= 2 — failover has to have "
+                     "somewhere to go")
+        if args.spec:
+            ap.error("--fleet is incompatible with --spec (one knob at "
+                     "a time; spec state does migrate, but the fleet "
+                     "benchmark measures routing/failover)")
+        if args.arrival_rate is not None:
+            ap.error("--fleet uses the closed-loop burst (seeded bursty "
+                     "traffic); --arrival-rate is not modeled for it")
     if args.pool_frac is not None and not args.paged:
         ap.error("--pool-frac requires --paged")
     if args.host_pool_mb is not None and not args.paged:
@@ -480,12 +539,168 @@ def main():
             dt = time.perf_counter() - t0
         return rids, server._results, done_at, dt, compile_count() - c0
 
+    def fleet_pass():
+        """--fleet N: the seeded burst through a FleetRouter. Under
+        --chaos the reference is an UNDISTURBED single engine over the
+        identical traffic (rng state + request counter reset before each
+        measured burst, so the trace matches request-for-request) and the
+        fleet's failover drain is guarded at the twin's compile budget.
+        Returns (json line, watchdog findings or None)."""
+        from paddle_tpu.analysis.recompile_guard import compile_count
+        from paddle_tpu.inference.fleet import FleetRouter
+
+        traffic_state = rng.get_state()
+
+        def reset_traffic():
+            rng.set_state(traffic_state)
+            _counter[0] = 0
+            prios.clear()
+
+        # reference twin: warm, then the measured drain
+        ref_server = make_server()
+        burst(ref_server, min(args.slots, 4))
+        ref_server.run()
+        reset_traffic()
+        ref_rids = burst(ref_server, args.requests)
+        c0 = compile_count()
+        t0 = time.perf_counter()
+        ref_out = ref_server.run()
+        ref_dt = time.perf_counter() - t0
+        ref_compiles = compile_count() - c0
+        ref_order = list(ref_rids)
+        del ref_server
+
+        inj = None
+        if args.chaos:
+            from paddle_tpu.inference.faults import FaultInjector, FaultPlan
+
+            inj = FaultInjector(
+                FaultPlan.fleet_chaos(args.seed, replicas=args.fleet))
+            inj.enabled = False    # hooks wire now, plan fires at the drain
+        fleet = FleetRouter([make_server() for _ in range(args.fleet)],
+                            faults=inj)
+        # warm EVERY replica's prefill/decode (routing spreads the warmup
+        # burst by load), then replay the identical measured traffic
+        burst(fleet, args.fleet * min(args.slots, 4))
+        fleet.run()
+        for rep in fleet._replicas:
+            rep.server.telemetry.reset()
+        reset_traffic()
+        if inj is not None:
+            inj.enabled = True
+        rids = burst(fleet, args.requests)
+        done_at = {}
+        guard = (jit_cache_guard("fleet measured drain",
+                                 allowed=ref_compiles)
+                 if (args.chaos or args.guard_recompiles)
+                 else contextlib.nullcontext())
+        c0 = compile_count()
+        with guard:
+            t0 = time.perf_counter()
+            while True:
+                remaining = fleet.step()
+                if args.chaos:
+                    # soak invariant, fleet-wide: every engine conserves
+                    fleet.assert_conserved()
+                now = time.perf_counter() - t0
+                for rid in list(fleet._results):
+                    if rid not in done_at:
+                        done_at[rid] = now
+                if remaining == 0:
+                    break
+            dt = time.perf_counter() - t0
+        drain_compiles = compile_count() - c0
+        out = fleet.run()
+        fm = fleet.fleet_metrics()
+
+        gen_tokens = sum(len(v) - rids[r]
+                         for r, v in out.items() if r in rids)
+        lats = sorted(done_at[r] for r in rids if r in done_at)
+        line = {"metric": "serving_fleet_tok_s_1chip",
+                "value": round(gen_tokens / dt, 1),
+                "unit": f"generated tok/s ({args.requests} reqs, "
+                        f"{args.fleet} replicas x {args.slots} slots, "
+                        f"max_new={args.max_new}, "
+                        f"params={n_params/1e6:.0f}M)",
+                "kv_cache": "paged", "fleet": args.fleet,
+                "p50_s": round(lats[len(lats) // 2], 3) if lats else 0.0,
+                "p95_s": round(lats[min(len(lats) - 1,
+                                        int(len(lats) * 0.95))], 3)
+                if lats else 0.0,
+                "wall_s": round(dt, 2),
+                "seed": args.seed, "scheduler": args.scheduler,
+                "kv_quant": args.kv_quant,
+                "fleet_states": fm["states"],
+                "fleet_routed": fm["routed"],
+                "fleet_misroutes": fm["misroutes"],
+                "fleet_migrations": fm["migrations"],
+                "fleet_migrated_requests": fm["migrated_requests"],
+                "fleet_migrated_kv": fm["migrated_kv"],
+                "fleet_deaths": fm["deaths"],
+                "fleet_heartbeat_stalls": fm["heartbeat_stalls"],
+                "quarantined": fm["quarantined"],
+                "replicas": fm["replicas"]}
+        strict = None
+        if args.chaos:
+            failed = [r for r in rids if fleet.status(r) == "failed"]
+            mismatch = sum(
+                1 for a, b in zip(ref_order, list(rids))
+                if b not in failed and out.get(b) != ref_out.get(a))
+            ref_gen = sum(len(v) - ref_rids[r]
+                          for r, v in ref_out.items() if r in ref_rids)
+            line["chaos"] = True
+            st = inj.stats()
+            line["faults_injected"] = st["fired"]
+            line["fault_sites"] = st["fired_sites"]
+            line["token_mismatches"] = mismatch
+            line["ref_tok_s"] = round(ref_gen / ref_dt, 1)
+            line["ref_drain_recompiles"] = ref_compiles
+            line["drain_recompiles"] = drain_compiles
+            if args.strict or args.telemetry_out:
+                # recovery tail on the survivors: a fresh burst with the
+                # plan spent must come back watchdog-clean
+                for rep in fleet._replicas:
+                    rep.server.telemetry.reset()
+                burst(fleet, min(args.slots, 4))
+                fleet.run()
+                strict = []
+                for rep in fleet._replicas:
+                    if rep.state in ("live", "degraded"):
+                        strict.extend(rep.server.telemetry.watchdog())
+                line["watchdog_after_recovery"] = len(strict)
+        elif args.strict:
+            strict = []
+            for rep in fleet._replicas:
+                if rep.state in ("live", "degraded"):
+                    strict.extend(rep.server.telemetry.watchdog())
+            line["watchdog_findings"] = len(strict)
+        return line, strict
+
     # CPU smoke runs don't touch the chip — don't serialize on its lock
     lock = tpu_lock(timeout_s=900.0) if on_tpu else \
         contextlib.nullcontext(True)
     with lock as locked:
         if args.int8:
             model.quantize_int8()
+        if args.fleet:
+            line, strict_findings = fleet_pass()
+            line["schema_version"] = SCHEMA_VERSION
+            line["config_fingerprint"] = config_fingerprint(args)
+            if not locked:
+                line["lock_contended"] = True
+            print(json.dumps(line))
+            if args.strict and strict_findings:
+                for f in strict_findings:
+                    print(f"watchdog: {f}", file=sys.stderr)
+                sys.exit(1)
+            if not args.json:
+                print(f"[fleet x{args.fleet}] {line['value']} tok/s, "
+                      f"p50 {line['p50_s']}s, p95 {line['p95_s']}s over "
+                      f"{line['wall_s']}s, states {line['fleet_states']}"
+                      + (f", mismatches {line['token_mismatches']}"
+                         if args.chaos else ""),
+                      file=sys.stderr)
+            return
         traffic_state = rng.get_state()
         inj, ref_out, ref_tok_s, ref_compiles = None, None, None, 0
         if args.chaos:
@@ -637,6 +852,8 @@ def main():
             json.dump({"ticks": server.telemetry.flight.dump(),
                        "watchdog": server.telemetry.watchdog()}, f, indent=1)
         line["telemetry_out"] = base
+    line["schema_version"] = SCHEMA_VERSION
+    line["config_fingerprint"] = config_fingerprint(args)
     if not locked:
         line["lock_contended"] = True
     print(json.dumps(line))
