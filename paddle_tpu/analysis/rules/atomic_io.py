@@ -1,0 +1,133 @@
+"""Atomic-write hygiene: no bare write-mode ``open()`` under checkpoint
+paths.
+
+The crash-safety contract of the checkpoint stack
+(``distributed/checkpoint.py``, ``distributed/train_checkpoint.py``,
+``incubate/checkpoint/``) is stage → manifest → ``os.replace``: a file
+written in place can be torn by a kill at any byte boundary, and a torn
+file that keeps its final name is the one failure mode the CRC32
+manifest cannot always catch (the manifest itself, or a file written
+after it, may be the torn one). Every durable write must therefore land
+in a staging location and be renamed into place — the rename is the
+commit point the whole degradation ladder (and the ``ckpt_write`` fault
+site that tests it) is built around.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain
+
+# any of these in the enclosing scope marks the write as staged-then-
+# committed (or explicitly torn on purpose by the fault injector's
+# truncate path, which still lives inside a committing function)
+_COMMIT_CALLS = frozenset({
+    "os.replace", "os.rename", "replace_dir", "write_manifest",
+})
+
+_WRITE_MODE_CHARS = ("w", "a", "x", "+")
+
+
+@register
+class NonAtomicCheckpointWriteRule(Rule):
+    """GL013: write-mode ``open()`` in a checkpoint module with no
+    rename-commit in the enclosing scope. A kill mid-write leaves a torn
+    file under its FINAL name — exactly the corruption the manifest +
+    ``os.replace`` protocol exists to make impossible."""
+
+    id = "GL013"
+    name = "non-atomic-ckpt-write"
+    description = ("bare open(..., 'wb')-style writes under checkpoint "
+                   "paths tear on kill; stage the file and commit it "
+                   "with os.replace/os.rename (or route through "
+                   "replace_dir/write_manifest) so the rename is the "
+                   "atomic commit point — a write-mode open whose "
+                   "enclosing function never renames is a torn-file "
+                   "hazard")
+
+    _SCOPE_PART = "checkpoint"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if self._SCOPE_PART not in ctx.path:
+            return
+        yield from self._scan(ctx, ctx.tree, scope_commits=False)
+
+    def _scan(self, ctx: ModuleContext, scope: ast.AST,
+              scope_commits: bool) -> Iterable[Finding]:
+        """Walk one scope (module or function body). Nested functions
+        recurse with their OWN commit verdict — an os.replace in an outer
+        function doesn't bless a torn write in a closure that may run on
+        another thread or never reach the rename."""
+        commits = scope_commits or self._has_commit_call(scope)
+        for node in self._scope_body(scope):
+            for sub in self._walk_scope(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan(ctx, sub, scope_commits=False)
+                    continue
+                if not commits and isinstance(sub, ast.Call) and \
+                        self._write_mode(sub) is not None:
+                    yield self.finding(
+                        ctx, sub,
+                        f"open(..., {self._write_mode(sub)!r}) in a "
+                        f"checkpoint module without os.replace/os.rename "
+                        f"in the enclosing scope — a kill mid-write "
+                        f"leaves a torn file under its final name; "
+                        f"stage and rename (the commit point), or route "
+                        f"through replace_dir/write_manifest")
+
+    @staticmethod
+    def _scope_body(scope: ast.AST) -> List[ast.AST]:
+        return list(getattr(scope, "body", []))
+
+    @classmethod
+    def _walk_scope(cls, node: ast.AST):
+        """Yield nodes of this scope only; nested function defs are
+        yielded (for recursion) but not descended into here."""
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from cls._walk_scope(child)
+
+    @classmethod
+    def _has_commit_call(cls, scope: ast.AST) -> bool:
+        for node in cls._iter_scope_nodes(scope):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is None:
+                    continue
+                if chain in _COMMIT_CALLS or \
+                        chain.rsplit(".", 1)[-1] in _COMMIT_CALLS:
+                    return True
+        return False
+
+    @classmethod
+    def _iter_scope_nodes(cls, scope: ast.AST):
+        for node in getattr(scope, "body", []):
+            yield from cls._walk_scope(node)
+
+    @classmethod
+    def _write_mode(cls, call: ast.Call) -> Optional[str]:
+        """The literal write mode of an ``open()``/``io.open()`` call, or
+        None for reads / non-open calls / non-literal modes (can't
+        tell statically — stay quiet rather than cry wolf)."""
+        chain = attr_chain(call.func)
+        if chain not in ("open", "io.open"):
+            return None
+        mode_node: Optional[ast.AST] = None
+        if len(call.args) >= 2:
+            mode_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+        if mode_node is None:
+            return None  # default "r"
+        if not (isinstance(mode_node, ast.Constant)
+                and isinstance(mode_node.value, str)):
+            return None
+        mode = mode_node.value
+        if any(c in mode for c in _WRITE_MODE_CHARS):
+            return mode
+        return None

@@ -19,6 +19,7 @@ from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh
 from .parallel import DataParallel
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import train_checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401

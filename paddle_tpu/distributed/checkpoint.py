@@ -3,22 +3,66 @@ converter.py reshard-on-load; auto_checkpoint.py periodic snapshots).
 
 TPU-native: orbax-backed. Arrays are saved with their shardings; on load,
 orbax reshards to the target sharding (= converter.py capability natively).
+
+Crash-safety primitives shared with :mod:`.train_checkpoint`:
+
+- :func:`write_manifest` / :func:`verify_manifest` — a per-file CRC32 +
+  size manifest (``MANIFEST.json``) over a checkpoint directory, written
+  last so its presence certifies a complete write; verification rereads
+  every file so on-disk bit rot (or an injected ``ckpt_read`` fault) is
+  detected before any state is trusted.
+- :func:`replace_dir` — atomic write-then-rename commit: snapshots are
+  staged under a dot-prefixed temp dir in the same parent (same
+  filesystem, so the final ``os.replace`` is atomic) and only renamed
+  into place once the manifest is down. A kill at any point leaves
+  either the previous generation or an ignorable ``.tmp-*`` husk —
+  never a torn directory that looks like a checkpoint.
+
+``AutoCheckpoint`` routes its periodic snapshots through this commit
+path, and ``latest()`` only returns manifest-valid generations.
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ..framework.core import Tensor
 
+MANIFEST_NAME = "MANIFEST.json"
+_TMP_PREFIX = ".tmp-"
+
 
 def _to_arrays(tree):
     return jax.tree_util.tree_map(
         lambda x: x.value if isinstance(x, Tensor) else x, tree,
         is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def tree_path_key(path) -> str:
+    """Canonical string for a jax tree path: dict keys / sequence indices
+    / attr names joined with ``/`` (``("model", "weight")`` →
+    ``"model/weight"``). This is the key :func:`load_state_dict` expects
+    in its ``shardings`` dict — stable across tree transforms, unlike the
+    ``id()``-keyed scheme it replaces (leaf identity changes under any
+    ``tree_map``, silently dropping every sharding)."""
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        else:  # pragma: no cover - exotic key types
+            parts.append(str(p))
+    return "/".join(parts)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = False):
@@ -38,17 +82,26 @@ def save_state_dict(state_dict: Dict[str, Any], path: str, async_save: bool = Fa
 
 def load_state_dict(path: str, target: Optional[Dict[str, Any]] = None,
                     shardings: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Restore a state tree; with ``target``, orbax reshards every array
+    onto the requested sharding on load (GSPMD reshard-on-load).
+
+    ``shardings`` maps :func:`tree_path_key` strings of the *target*
+    tree (e.g. ``"model/weight"``, or ``"weight"`` for a flat dict) to
+    ``jax.sharding.Sharding`` objects; leaves without an entry load
+    unsharded."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     if target is not None:
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                tuple(x.shape), x.dtype,
-                sharding=shardings.get(id(x)) if shardings else None)
-            if isinstance(x, (Tensor, jax.Array, np.ndarray)) else x,
-            _to_arrays(target))
+        def abstract_leaf(tree_path, x):
+            if not isinstance(x, (Tensor, jax.Array, np.ndarray)):
+                return x
+            sh = shardings.get(tree_path_key(tree_path)) if shardings else None
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype, sharding=sh)
+
+        abstract = jax.tree_util.tree_map_with_path(
+            abstract_leaf, _to_arrays(target))
         restored = ckptr.restore(path, abstract)
     else:
         restored = ckptr.restore(path)
@@ -56,9 +109,133 @@ def load_state_dict(path: str, target: Optional[Dict[str, Any]] = None,
         x, (jax.Array, np.ndarray)) else x, restored)
 
 
+# --------------------------------------------------------------------------- #
+# Integrity manifest + atomic directory commit
+# --------------------------------------------------------------------------- #
+
+
+def _iter_files(root: str):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root)
+            if rel == MANIFEST_NAME or not os.path.isfile(full):
+                continue
+            yield full, rel.replace(os.sep, "/")
+
+
+def _file_crc32(path: str) -> Tuple[int, int]:
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def write_manifest(path: str, step: Optional[int] = None,
+                   fingerprint: Optional[str] = None,
+                   extra: Optional[dict] = None) -> dict:
+    """CRC32+size every regular file under ``path`` into
+    ``MANIFEST.json`` (itself written tmp+rename). Call LAST: the
+    manifest certifies the directory is complete and untampered."""
+    files = {rel: {"crc32": crc, "size": size}
+             for full, rel in _iter_files(path)
+             for crc, size in [_file_crc32(full)]}
+    manifest = {"format": 1, "step": step, "fingerprint": fingerprint,
+                "files": files, **({"extra": extra} if extra else {})}
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=0, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    return manifest
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    mf = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(mf):
+        return None
+    try:
+        with open(mf) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_manifest(path: str) -> List[str]:
+    """Re-read every manifest-listed file and CRC-check it. Returns a
+    list of problems (empty == the generation is valid): a missing or
+    unparseable manifest, missing shards, size or CRC mismatches."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return ["missing or unreadable MANIFEST.json"]
+    problems: List[str] = []
+    for rel, meta in sorted(manifest.get("files", {}).items()):
+        full = os.path.join(path, rel)
+        if not os.path.isfile(full):
+            problems.append(f"missing shard {rel}")
+            continue
+        crc, size = _file_crc32(full)
+        if size != meta["size"]:
+            problems.append(
+                f"size mismatch {rel}: {size} != {meta['size']}")
+        elif crc != meta["crc32"]:
+            problems.append(
+                f"crc mismatch {rel}: {crc:#010x} != {meta['crc32']:#010x}")
+    return problems
+
+
+def staging_path(final_path: str) -> str:
+    """Dot-prefixed sibling staging dir (same parent → same filesystem →
+    the commit rename is atomic); generation listers skip dot entries."""
+    head, tail = os.path.split(os.path.abspath(final_path))
+    return os.path.join(head, _TMP_PREFIX + tail)
+
+
+def replace_dir(tmp_path: str, final_path: str) -> str:
+    """Atomically promote a fully-written staging dir to its final name.
+    An existing destination (re-save of the same step) is parked aside
+    first so readers never observe a partially-replaced generation."""
+    tmp_path, final_path = os.path.abspath(tmp_path), os.path.abspath(final_path)
+    trash = None
+    if os.path.exists(final_path):
+        trash = staging_path(final_path) + ".old"
+        shutil.rmtree(trash, ignore_errors=True)
+        os.replace(final_path, trash)
+    os.replace(tmp_path, final_path)
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+    return final_path
+
+
+def sweep_stale_staging(save_dir: str) -> int:
+    """Remove ``.tmp-*`` husks a killed writer left behind. Safe any
+    time: live stagings exist only inside an in-flight save on this
+    host, and a fresh process has none."""
+    n = 0
+    if not os.path.isdir(save_dir):
+        return 0
+    for d in os.listdir(save_dir):
+        if d.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(save_dir, d), ignore_errors=True)
+            n += 1
+    return n
+
+
 class AutoCheckpoint:
     """Periodic train-loop snapshots with exactly-once epoch bookkeeping
-    (ref fluid/incubate/checkpoint/auto_checkpoint.py)."""
+    (ref fluid/incubate/checkpoint/auto_checkpoint.py).
+
+    Every snapshot goes through the stage → manifest → rename commit, so
+    a kill mid-save can no longer leave a torn ``step_*`` directory that
+    ``resume`` would trust; ``latest()`` additionally CRC-verifies
+    candidates newest-first and falls back past corrupt generations."""
 
     def __init__(self, save_dir: str, every_n_steps: int = 1000, keep_last: int = 3,
                  async_save: bool = False):
@@ -68,6 +245,15 @@ class AutoCheckpoint:
         self.async_save = async_save
         self._step = 0
         self._saved = []
+        self._inflight: Optional[threading.Thread] = None
+        sweep_stale_staging(save_dir)
+
+    def _commit(self, state: dict, tag: str):
+        tmp = staging_path(tag)
+        shutil.rmtree(tmp, ignore_errors=True)
+        save_state_dict(state, tmp)
+        write_manifest(tmp, step=self._step)
+        replace_dir(tmp, tag)
 
     def step(self, model=None, optimizer=None, extra: Optional[dict] = None):
         from .fleet.elastic import pulse_heartbeat
@@ -76,6 +262,7 @@ class AutoCheckpoint:
         self._step += 1
         if self._step % self.every_n_steps != 0:
             return None
+        self.wait()
         tag = os.path.join(self.save_dir, f"step_{self._step}")
         state = {}
         if model is not None:
@@ -83,17 +270,25 @@ class AutoCheckpoint:
         if optimizer is not None:
             state["optimizer"] = optimizer.state_dict()
         state["meta"] = {"step": np.asarray(self._step), **(extra or {})}
-        save_state_dict(state, tag, async_save=self.async_save)
+        if self.async_save:
+            # the full commit (orbax write + manifest + rename) rides a
+            # worker thread; the step loop never blocks on the filesystem
+            self._inflight = threading.Thread(
+                target=self._commit, args=(state, tag), daemon=True)
+            self._inflight.start()
+        else:
+            self._commit(state, tag)
         self._saved.append(tag)
         while len(self._saved) > self.keep_last:
             old = self._saved.pop(0)
-            try:
-                import shutil
-
-                shutil.rmtree(old, ignore_errors=True)
-            except OSError:
-                pass
+            shutil.rmtree(old, ignore_errors=True)
         return tag
+
+    def wait(self):
+        """Block until any in-flight async snapshot has committed."""
+        if self._inflight is not None:
+            self._inflight.join()
+            self._inflight = None
 
     def latest(self) -> Optional[str]:
         if not os.path.isdir(self.save_dir):
@@ -105,9 +300,13 @@ class AutoCheckpoint:
                     steps.append((int(d.split("_")[1]), os.path.join(self.save_dir, d)))
                 except ValueError:
                     pass
-        return max(steps)[1] if steps else None
+        for _step, path in sorted(steps, reverse=True):
+            if not verify_manifest(path):
+                return path
+        return None
 
     def resume(self, model=None, optimizer=None) -> int:
+        self.wait()
         path = self.latest()
         if path is None:
             return 0

@@ -1,0 +1,179 @@
+"""Elastic-restart chaos harness: seeded kill → rendezvous →
+restore-latest-valid → continue.
+
+The training twin of the serving fleet's chaos drill
+(``inference/fleet.py`` + ``FaultPlan.fleet_chaos``): a scripted
+:class:`~paddle_tpu.faults.FaultPlan` (``FaultPlan.train_chaos``) drives
+an in-process incarnation loop through the full crash-recovery cycle the
+launch CLI's ``--max_restart`` path performs across processes:
+
+1. a ``kill`` fault raises :class:`SimulatedKill` in the step loop — the
+   in-process SIGKILL: the incarnation's heartbeat stops cold;
+2. a monitor :class:`ElasticManager` observes the lease expire
+   (``health_check() → RESTART``) — failure *detection*, not assumption;
+3. a fresh incarnation is built from scratch (the process-restart
+   analogue), rendezvouses (``wait_for_np`` + ``update_endpoints``), and
+   restores the latest *manifest-valid* checkpoint generation — torn
+   writes and bit-flipped reads injected by the same plan have already
+   been absorbed by the :class:`TrainCheckpointer` degradation ladder;
+4. the step loop continues from the restored step.
+
+Because every fault is scripted from one seed and the checkpoint carries
+complete state (params, moments, scaler, LR, data cursor, RNG), the
+post-restart trajectory must be **bit-exact** against an unkilled twin —
+``tests/test_train_checkpoint.py`` pins that, and suite stage 8 gates it.
+
+The harness is domain-agnostic: it owns membership, kill/restart
+bookkeeping and transient-fault retries, while the caller's ``build``
+factory returns a run object exposing ``restore() -> int``,
+``step(i) -> float``, ``save(i)`` and optionally ``close()``.
+"""
+from __future__ import annotations
+
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ...faults import (DataFeedFault, FaultInjector, FaultPlan,
+                       SimulatedKill, StepFault)
+from ..launch.rendezvous import KVServer
+from .elastic import ElasticManager, ElasticStatus
+
+__all__ = ["ChaosReport", "ElasticChaosHarness", "free_port"]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class ChaosReport:
+    """What a chaos run actually did — the evidence the gate asserts on."""
+
+    restarts: int = 0
+    detected_kills: int = 0
+    steps_run: int = 0
+    transient_retries: int = 0
+    losses: Dict[int, float] = field(default_factory=dict)
+    fault_stats: Dict[str, Any] = field(default_factory=dict)
+    completed: bool = False
+
+
+class ElasticChaosHarness:
+    """Run ``build(injector)`` incarnations under a scripted fault plan
+    until ``total_steps`` complete or ``max_restarts`` is exhausted.
+
+    ``build`` is called once per incarnation (fresh process analogue) and
+    must return an object with:
+
+    - ``restore() -> int`` — load the latest valid checkpoint into the
+      fresh state, returning the first step index still to run (0 for a
+      fresh start);
+    - ``step(i) -> float`` — run train step ``i``, returning the host
+      loss (may raise :class:`StepFault` / :class:`DataFeedFault` from
+      injected sites — the harness retries those in place);
+    - ``save(i)`` — checkpoint after step ``i`` (the run object decides
+      cadence internally if it prefers; the harness calls it every step
+      and expects it to be cheap when it declines);
+    - ``close()`` — optional teardown.
+
+    The ``kill`` site fires once per completed step, *before* ``save``:
+    a kill therefore always loses the tail since the last committed
+    generation, which is exactly the replay the bit-exact guarantee
+    covers.
+    """
+
+    def __init__(self, build: Callable[[FaultInjector], Any], *,
+                 total_steps: int, plan: Optional[FaultPlan] = None,
+                 injector: Optional[FaultInjector] = None,
+                 max_restarts: int = 4, job_id: str = "chaos",
+                 heartbeat_interval: float = 0.1, lease_ttl: float = 0.5,
+                 step_retries: int = 3, detect_timeout: float = 10.0):
+        self.build = build
+        self.total_steps = int(total_steps)
+        self.injector = injector or FaultInjector(plan)
+        self.max_restarts = int(max_restarts)
+        self.job_id = job_id
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.step_retries = int(step_retries)
+        self.detect_timeout = detect_timeout
+
+    def _manager(self, endpoint: str) -> ElasticManager:
+        return ElasticManager(endpoint, job_id=self.job_id, np=1,
+                              heartbeat_interval=self.heartbeat_interval,
+                              lease_ttl=self.lease_ttl, is_master=False)
+
+    def _await_detection(self, monitor: ElasticManager) -> bool:
+        """Block until the dead incarnation's lease expires and the
+        monitor votes RESTART — the harness may not assume the kill, it
+        must observe it the way a real launcher watcher would."""
+        t0 = time.time()
+        while time.time() - t0 < self.detect_timeout:
+            if monitor.health_check() == ElasticStatus.RESTART:
+                return True
+            time.sleep(self.heartbeat_interval / 2)
+        return False
+
+    def run(self) -> ChaosReport:
+        report = ChaosReport()
+        port = free_port()
+        endpoint = f"127.0.0.1:{port}"
+        server = KVServer(port)
+        monitor = self._manager(endpoint)
+        try:
+            while report.restarts <= self.max_restarts:
+                mgr = self._manager(endpoint)
+                mgr.my_host = f"incarnation-{report.restarts}"
+                mgr.start_heartbeat()
+                if not mgr.wait_for_np(timeout=self.detect_timeout):
+                    raise RuntimeError("chaos rendezvous never reached np")
+                mgr.update_endpoints()
+                run = self.build(self.injector)
+                try:
+                    start = int(run.restore())
+                    step = start
+                    while step < self.total_steps:
+                        loss = self._step_with_retry(run, step, report)
+                        report.losses[step] = float(loss)
+                        report.steps_run += 1
+                        spec = self.injector.fire("kill")
+                        if spec is not None:
+                            raise SimulatedKill(f"injected kill after step {step}")
+                        run.save(step)
+                        step += 1
+                    report.completed = True
+                    return report
+                except SimulatedKill:
+                    report.detected_kills += 1
+                    mgr.stop()  # heartbeat dies with the incarnation
+                    if not self._await_detection(monitor):
+                        raise RuntimeError(
+                            "kill was never detected by the elastic monitor")
+                    report.restarts += 1
+                finally:
+                    if hasattr(run, "close"):
+                        run.close()
+                    if not mgr._stop.is_set():
+                        mgr.stop()
+            raise RuntimeError(
+                f"chaos run exhausted max_restarts={self.max_restarts}")
+        finally:
+            report.fault_stats = self.injector.stats()
+            monitor.stop()
+            server.stop()
+
+    def _step_with_retry(self, run, step: int, report: ChaosReport) -> float:
+        for attempt in range(self.step_retries + 1):
+            try:
+                return run.step(step)
+            except (StepFault, DataFeedFault):
+                # injected BEFORE dispatch / cursor advance by contract,
+                # so a verbatim retry is deterministic
+                if attempt == self.step_retries:
+                    raise
+                report.transient_retries += 1
+        raise AssertionError("unreachable")

@@ -1,0 +1,450 @@
+"""Crash-safe training checkpoints: complete state capture, CRC32
+manifests, atomic commit, and a never-crash-the-step-loop degradation
+ladder.
+
+The serving stack earned its crash-safety story in PR 8 (seeded fault
+injection + snapshot/restore, token-exact resume). This module is the
+training half of the same contract, built on
+:mod:`paddle_tpu.distributed.checkpoint` (orbax arrays + manifest/commit
+primitives) and :mod:`paddle_tpu.faults` (scripted ``ckpt_write`` /
+``ckpt_read`` / ``data_feed`` sites):
+
+- **Complete state.** One :meth:`TrainCheckpointer.save` captures
+  params + optimizer moments (via ``ParallelEngine.engine_state_dict``
+  or eager ``model``/``optimizer`` state_dicts), the AMP loss-scaler
+  (scale, growth/backoff counters), the LR-schedule state, the
+  data-iterator cursor, the per-host RNG key, the step counter, and a
+  config fingerprint. Anything less and "resume" silently forks the
+  run; with all of it, a run killed at step k replays k+1..n with
+  losses and final params **bit-exact** vs an unkilled twin.
+- **Atomic commit.** Arrays and host state are staged under a dot
+  directory, CRC32-manifested, then ``os.replace``d into place — a kill
+  leaves the previous generation intact, never a torn dir.
+- **Degradation ladder.** A failed write (torn file, full disk, or an
+  injected ``ckpt_write`` fault) retries with backoff; past
+  ``save_retries`` the save is dropped, counted, and the step loop
+  continues against the last manifest-valid generation. A corrupt read
+  (CRC mismatch, e.g. an injected on-disk bit flip at ``ckpt_read``) is
+  detected before any state is trusted and restore falls back to the
+  previous generation.
+- **Async save.** The commit (orbax write + manifest + rename) rides a
+  worker thread off the step path; capture (device→host gather) stays
+  synchronous so the snapshot is a consistent step boundary.
+- **Reshard-on-load.** SPMD engines restore through orbax with
+  path-keyed target shardings (GSPMD reshards on load), so a checkpoint
+  written on one mesh layout restores onto another.
+
+Observability lands in a :class:`~paddle_tpu.inference.telemetry.MetricsRegistry`
+(``train_checkpoint_*`` counters, save-lag / last-step gauges) — the
+same registry substrate serving uses.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..faults import NULL_INJECTOR, DataFeedFault, FaultInjector
+from .checkpoint import (load_state_dict, read_manifest, replace_dir,
+                         save_state_dict, staging_path, sweep_stale_staging,
+                         tree_path_key, verify_manifest, write_manifest)
+
+__all__ = [
+    "CheckpointCorruptError", "CheckpointableDataFeed", "TrainCheckpointer",
+    "config_fingerprint",
+]
+
+_HOST_STATE = "host_state.pkl"
+_ARRAYS_DIR = "arrays"
+
+
+def _set_engine_step(engine, step: int) -> None:
+    # mirrors ParallelEngine.set_engine_state's step placement: a host
+    # int32 under multi-process (broadcast by the next dispatch), a
+    # device scalar single-process
+    import jax
+    import jax.numpy as jnp
+
+    engine._step_count = (np.asarray(step, np.int32)
+                          if jax.process_count() > 1
+                          else jnp.asarray(step, jnp.int32))
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Every on-disk generation failed manifest verification — there is
+    no valid state to resume from (distinct from "no checkpoint yet",
+    which restores to a fresh start)."""
+
+
+def config_fingerprint(config: Any) -> str:
+    """Stable fingerprint of a run configuration (any json-able tree).
+    Stored in every manifest; ``TrainCheckpointer(fingerprint=...)``
+    refuses to restore state written under a different one — resuming a
+    run with silently-changed hyperparameters is a fork, not a resume."""
+    import json
+    import zlib
+
+    blob = json.dumps(config, sort_keys=True, default=str).encode()
+    return f"{zlib.crc32(blob) & 0xFFFFFFFF:08x}"
+
+
+class CheckpointableDataFeed:
+    """Deterministic host data feed with an explicit cursor.
+
+    ``make_batch(cursor)`` must be a pure function of its cursor (seeded
+    synthesis, an index into a shuffled epoch permutation, a file
+    offset...), which makes the cursor THE iterator state: checkpoint it
+    and the resumed run re-reads the identical sample stream. The
+    ``data_feed`` fault site fires before the cursor advances, so an
+    injected feed hiccup is retried with no stream divergence.
+    """
+
+    def __init__(self, make_batch: Callable[[int], Any], *, cursor: int = 0,
+                 injector: FaultInjector = NULL_INJECTOR):
+        self.make_batch = make_batch
+        self.cursor = int(cursor)
+        self.injector = injector
+
+    def next_batch(self) -> Any:
+        spec = self.injector.fire("data_feed")
+        if spec is not None:
+            raise DataFeedFault(
+                f"injected data-feed fault at cursor {self.cursor}")
+        batch = self.make_batch(self.cursor)
+        self.cursor += 1
+        return batch
+
+    def state(self) -> Dict[str, int]:
+        return {"cursor": self.cursor}
+
+    def load_state(self, state: Dict[str, int]) -> None:
+        self.cursor = int(state["cursor"])
+
+
+class TrainCheckpointer:
+    """Complete-state training checkpoints with atomic commit, CRC32
+    verification, bounded-retry save, generation fallback on corrupt
+    read, and optional async commit. See the module docstring for the
+    contract; ``tests/test_train_checkpoint.py`` pins bit-exact resume.
+    """
+
+    def __init__(self, save_dir: str, *, keep_last: int = 3,
+                 async_save: bool = False,
+                 injector: FaultInjector = NULL_INJECTOR,
+                 metrics=None, clock: Callable[[], float] = time.monotonic,
+                 save_retries: int = 2, backoff_s: float = 0.02,
+                 fingerprint: Optional[str] = None):
+        self.save_dir = save_dir
+        self.keep_last = int(keep_last)
+        self.async_save = async_save
+        self.injector = injector
+        self.save_retries = int(save_retries)
+        self.backoff_s = float(backoff_s)
+        self.fingerprint = fingerprint
+        self._clock = clock
+        self._registry = metrics
+        self._inflight: Optional[threading.Thread] = None
+        self.last_error: Optional[str] = None
+        os.makedirs(save_dir, exist_ok=True)
+        sweep_stale_staging(save_dir)
+
+    # ------------------------------------------------------------- metrics
+    @property
+    def metrics(self):
+        if self._registry is None:
+            # lazy: telemetry is a leaf module (numpy/json only), shared
+            # with serving so dashboards read one substrate
+            from ..inference.telemetry import MetricsRegistry
+
+            self._registry = MetricsRegistry(clock=self._clock)
+        return self._registry
+
+    def _count(self, name: str, help: str, n: float = 1.0) -> None:
+        self.metrics.counter("train_checkpoint_" + name, help).inc(n)
+
+    def _gauge(self, name: str, help: str, v: float) -> None:
+        self.metrics.gauge("train_checkpoint_" + name, help).set(v)
+
+    # ------------------------------------------------------------- capture
+    def _capture(self, step, engine, model, optimizer, scaler, data_feed,
+                 extra) -> Tuple[dict, dict]:
+        """Host snapshot of the full training state at a step boundary.
+        Synchronous on purpose: capture must see a consistent state even
+        when the commit itself rides the async thread."""
+        from ..framework.random import get_rng_state
+        from ..optimizer.lr import LRScheduler
+
+        arrays: Dict[str, Any] = {}
+        host: Dict[str, Any] = {
+            "step": int(step),
+            "fingerprint": self.fingerprint,
+            "extra": extra or {},
+            "rng": np.asarray(get_rng_state()),
+        }
+        opt = optimizer
+        if engine is not None:
+            eng_state = engine.engine_state_dict()
+            arrays["params"] = eng_state["params"]
+            arrays["opt_state"] = eng_state["opt_state"]
+            host["engine_step"] = int(eng_state["step"])
+            opt = opt or engine.optimizer
+        elif model is not None:
+            arrays["model"] = {k: v for k, v in model.state_dict().items()}
+        if opt is not None and engine is None:
+            osd = opt.state_dict()
+            host["opt_host"] = {
+                "global_step": int(osd.pop("global_step", 0))}
+            host["lr_sched"] = osd.pop("LR_Scheduler", None)
+            arrays["opt_state"] = osd
+        elif opt is not None:
+            lr = getattr(opt, "_learning_rate", None)
+            if isinstance(lr, LRScheduler):
+                host["lr_sched"] = lr.state_dict()
+        if scaler is not None:
+            host["scaler"] = scaler.state_dict()
+        if data_feed is not None:
+            host["data_feed"] = data_feed.state()
+        return arrays, host
+
+    # -------------------------------------------------------------- commit
+    def _write_generation(self, arrays: dict, host: dict, final: str,
+                          step: int) -> None:
+        """One staged write attempt: arrays (orbax) + host pickle +
+        manifest, then the atomic rename. The ``ckpt_write`` fault fires
+        after the payload is staged but before the manifest — exactly
+        where a real kill tears a write."""
+        tmp = staging_path(final)
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        if arrays:
+            save_state_dict(arrays, os.path.join(tmp, _ARRAYS_DIR))
+        blob = pickle.dumps(host, protocol=4)
+        with open(os.path.join(tmp, _HOST_STATE), "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        spec = self.injector.fire("ckpt_write")
+        if spec is not None:
+            # torn write: truncate one staged file mid-payload, then die
+            # before the manifest — the ladder must retry or fall back,
+            # and no reader may ever trust this staging dir
+            victim = os.path.join(tmp, _HOST_STATE)
+            size = os.path.getsize(victim)
+            with open(victim, "r+b") as f:
+                f.truncate(max(0, size // 2))
+            raise OSError(f"injected torn checkpoint write ({spec.kind or 'torn'})")
+        write_manifest(tmp, step=step, fingerprint=self.fingerprint)
+        replace_dir(tmp, final)
+
+    def _commit(self, arrays: dict, host: dict, final: str, step: int,
+                t_request: float) -> bool:
+        """Degradation ladder, rung 1: bounded retry with backoff. A save
+        that still fails is DROPPED (counted, never raised) — the step
+        loop must not crash because the filesystem hiccuped; the last
+        manifest-valid generation stays the resume point."""
+        for attempt in range(self.save_retries + 1):
+            try:
+                self._write_generation(arrays, host, final, step)
+                break
+            except (OSError, ValueError) as e:
+                self.last_error = f"{type(e).__name__}: {e}"
+                if attempt == self.save_retries:
+                    shutil.rmtree(staging_path(final), ignore_errors=True)
+                    self._count("save_failures",
+                                "saves dropped after exhausting retries")
+                    return False
+                self._count("save_retries", "torn-write retry attempts")
+                time.sleep(self.backoff_s * (2 ** attempt))
+        self._count("saves", "generations committed")
+        self._gauge("last_step", "step of the newest committed generation",
+                    step)
+        self._gauge("save_lag_s",
+                    "request-to-durable latency of the last commit",
+                    self._clock() - t_request)
+        self._prune()
+        return True
+
+    def _prune(self) -> None:
+        gens = self.generations()
+        for _step, path in gens[:-self.keep_last] if self.keep_last else []:
+            shutil.rmtree(path, ignore_errors=True)
+        self._gauge("generations", "committed generations on disk",
+                    len(self.generations()))
+
+    # ---------------------------------------------------------------- save
+    def save(self, step: int, *, engine=None, model=None, optimizer=None,
+             scaler=None, data_feed=None, extra: Optional[dict] = None
+             ) -> Optional[str]:
+        """Capture + commit one generation for ``step``. Returns the
+        final path (the commit may still be in flight with
+        ``async_save=True`` — ``wait()`` joins it), or ``None`` if a
+        synchronous commit was dropped by the ladder."""
+        t_request = self._clock()
+        self.wait()
+        arrays, host = self._capture(step, engine, model, optimizer, scaler,
+                                     data_feed, extra)
+        final = os.path.join(self.save_dir, f"step_{int(step):08d}")
+        if self.async_save:
+            self._inflight = threading.Thread(
+                target=self._commit,
+                args=(arrays, host, final, int(step), t_request),
+                daemon=True)
+            self._inflight.start()
+            return final
+        ok = self._commit(arrays, host, final, int(step), t_request)
+        return final if ok else None
+
+    def wait(self) -> None:
+        """Join any in-flight async commit."""
+        if self._inflight is not None:
+            self._inflight.join()
+            self._inflight = None
+
+    # ------------------------------------------------------------- listing
+    def generations(self) -> List[Tuple[int, str]]:
+        """Committed generations, oldest first (no validity check)."""
+        out = []
+        if not os.path.isdir(self.save_dir):
+            return out
+        for d in os.listdir(self.save_dir):
+            if d.startswith("step_") and not d.startswith("."):
+                try:
+                    out.append((int(d.split("_")[1]),
+                                os.path.join(self.save_dir, d)))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_valid(self) -> Optional[Tuple[int, str]]:
+        """Newest generation that passes CRC verification; corrupt ones
+        are counted and skipped (degradation ladder, rung 2)."""
+        for step, path in reversed(self.generations()):
+            spec = self.injector.fire("ckpt_read")
+            if spec is not None:
+                self._corrupt_on_disk(path)
+            problems = verify_manifest(path)
+            if not problems:
+                return step, path
+            self.last_error = f"{path}: {problems[0]}"
+            self._count("corrupt_reads",
+                        "generations failing CRC verification")
+            self._count("generation_fallbacks",
+                        "restores skipping past a corrupt generation")
+        return None
+
+    def _corrupt_on_disk(self, path: str) -> None:
+        """Apply an injected ``ckpt_read`` fault: flip one seeded bit of
+        the first manifest-listed shard, in place — the manifest must
+        catch it."""
+        manifest = read_manifest(path)
+        if not manifest:
+            return
+        for rel in sorted(manifest.get("files", {})):
+            full = os.path.join(path, rel)
+            if os.path.isfile(full) and os.path.getsize(full) > 0:
+                self.injector.corrupt_file(full)
+                return
+
+    # ------------------------------------------------------------- restore
+    def restore(self, *, engine=None, model=None, optimizer=None,
+                scaler=None, data_feed=None) -> Optional[Dict[str, Any]]:
+        """Restore the newest valid generation into the given consumers.
+
+        Walks generations newest→oldest past corrupt ones; returns the
+        restored host-state dict (``["step"]`` is the resume step), or
+        ``None`` when no generation exists (fresh start). Raises
+        :class:`CheckpointCorruptError` if generations exist but none
+        verifies, and ``ValueError`` on a config-fingerprint mismatch.
+        """
+        from ..framework.random import set_rng_state
+        from ..optimizer.lr import LRScheduler
+
+        self.wait()
+        had_any = bool(self.generations())
+        found = self.latest_valid()
+        if found is None:
+            if had_any:
+                raise CheckpointCorruptError(
+                    f"no manifest-valid generation under {self.save_dir} "
+                    f"(last error: {self.last_error})")
+            return None
+        step, path = found
+        manifest = read_manifest(path) or {}
+        if self.fingerprint is not None and \
+                manifest.get("fingerprint") not in (None, self.fingerprint):
+            raise ValueError(
+                f"config fingerprint mismatch: checkpoint {path} was "
+                f"written under {manifest.get('fingerprint')!r}, this run "
+                f"is {self.fingerprint!r} — refusing to resume a forked "
+                f"config")
+        with open(os.path.join(path, _HOST_STATE), "rb") as f:
+            host = pickle.load(f)
+        arrays_path = os.path.join(path, _ARRAYS_DIR)
+        has_arrays = os.path.isdir(arrays_path)
+        opt = optimizer
+        if engine is not None:
+            opt = opt or engine.optimizer
+        if engine is not None and has_arrays:
+            if engine._spmd:
+                # GSPMD reshard-on-load: path-keyed shardings from THIS
+                # engine's layout — the checkpoint may have been written
+                # on a different mesh; orbax reshards each array on load
+                target = {"params": dict(engine.params),
+                          "opt_state": engine.opt_state}
+                shardings = {}
+                for n, v in engine.params.items():
+                    shardings[f"params/{n}"] = v.sharding
+                for n, slots in engine.opt_state.items():
+                    for k, v in slots.items():
+                        shardings[f"opt_state/{n}/{k}"] = v.sharding
+                restored = load_state_dict(arrays_path, target=target,
+                                           shardings=shardings)
+                unwrap = lambda t: t.value if hasattr(t, "value") else t
+                engine.params = {n: unwrap(v)
+                                 for n, v in restored["params"].items()}
+                engine.opt_state = {
+                    n: {k: unwrap(v) for k, v in slots.items()}
+                    for n, slots in restored["opt_state"].items()}
+                _set_engine_step(engine,
+                                 host.get("engine_step", host["step"]))
+            else:
+                restored = load_state_dict(arrays_path)
+                # restore IS the deliberate host boundary: set_engine_state
+                # re-places host values against this engine's layout
+                unwrap = lambda t: np.asarray(  # graftlint: noqa[host-sync]
+                    t.value if hasattr(t, "value") else t)
+                engine.set_engine_state({
+                    "params": {n: unwrap(v)
+                               for n, v in restored["params"].items()},
+                    "opt_state": {
+                        n: {k: unwrap(v) for k, v in slots.items()}
+                        for n, slots in restored["opt_state"].items()},
+                    "step": host.get("engine_step", host["step"])})
+        elif model is not None and has_arrays:
+            restored = load_state_dict(arrays_path)
+            if "model" in restored:
+                model.set_state_dict(restored["model"])
+            if opt is not None and "opt_state" in restored:
+                sd = dict(restored["opt_state"])
+                sd["global_step"] = host.get("opt_host", {}).get(
+                    "global_step", 0)
+                if host.get("lr_sched") is not None:
+                    sd["LR_Scheduler"] = host["lr_sched"]
+                opt.set_state_dict(sd)
+        if opt is not None and host.get("lr_sched") is not None:
+            lr = getattr(opt, "_learning_rate", None)
+            if isinstance(lr, LRScheduler):
+                lr.set_state_dict(host["lr_sched"])
+        if scaler is not None and host.get("scaler") is not None:
+            scaler.load_state_dict(host["scaler"])
+        if data_feed is not None and host.get("data_feed") is not None:
+            data_feed.load_state(host["data_feed"])
+        if host.get("rng") is not None:
+            set_rng_state(host["rng"])
+        self._count("restores", "successful restores")
+        return host

@@ -1,229 +1,22 @@
 """Deterministic fault injection for the serving stack.
 
-Everything here is host-only by contract (graftlint GL011): injector
-hooks fire between compiled programs, never inside them, and the
-disabled path is a single attribute check so production servers pay
-nothing. Faults are *scripted*, not random-at-runtime: a ``FaultPlan``
-names the hook site, the call ordinal at which to fire, and how many
-consecutive calls to hit, so a chaos run replays bit-identically from
-its seed — the property every token-identity assertion in the chaos
-tests leans on.
-
-Hook sites threaded through the stack:
-
-========== =================================================== ==========
-site       fires inside                                        effect
-========== =================================================== ==========
-alloc      ``BlockAllocator.alloc``                            raises the same pool-exhausted ``RuntimeError`` as a genuinely dry pool
-host_put   ``KVOffloadEngine.swap_out``                        host pool refuses the payload (swap-out returns ``None`` → stall path)
-swap_corrupt ``KVOffloadEngine.swap_in``                       flips one bit in the parked payload before checksum verification
-drafter    ``GenerationServer._spec_tick`` / drafter.propose   raises ``DrafterFault`` (server falls back to the plain decode program)
-tick       ``GenerationServer._dispatch_trips``                raises ``TickFault`` *before* compiled dispatch (``kind="fatal"`` raises a plain ``RuntimeError`` instead — unrecoverable)
-clock      ``FaultInjector.wrap_clock`` wrapper                stalls the clock (``kind="stall"``) or jumps it backwards (``kind="jump_back"`` by ``magnitude`` seconds)
-replica_down ``FleetRouter.step`` health probe                 marks the probed replica dead mid-decode; the router salvages its in-flight requests onto peers (``inference/fleet.py``)
-migrate_payload ``FleetRouter`` migration transfer             flips one bit in a migrating KV payload; the receiving engine's CRC-verified swap-in degrades it to re-prefill
-route      ``FleetRouter`` routing decision                    misroutes one submission to the worst-scoring live replica (correctness unaffected — routing is a hint)
-========== =================================================== ==========
-
-Injected faults at the ``tick`` site fire *before* the compiled call is
-dispatched, so donated pool buffers are still intact and the trip can be
-retried verbatim — that ordering is what makes the degradation ladder's
-retry rung safe.
+The substrate (``FaultInjector``/``FaultPlan``/``FaultSpec``, the
+scripted-site contract, and the shared exception types) now lives in
+:mod:`paddle_tpu.faults`, where the training stack
+(``parallel/engine.py``, ``distributed/train_checkpoint.py``, the
+elastic chaos harness) shares it. This module re-exports the serving
+surface so every existing import path keeps working — the hook-site
+table, the host-only contract (graftlint GL011), and the
+fire-before-dispatch ordering rule are documented there.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from ..faults import (NULL_INJECTOR, SITES, DataFeedFault,  # noqa: F401
+                      EngineFailedError, FaultInjector, FaultPlan,
+                      FaultSpec, SimulatedKill, StepFault, TickFault)
 
-import numpy as np
-
-SITES = frozenset({
-    "alloc", "host_put", "swap_corrupt", "drafter", "tick", "clock",
-    "replica_down", "migrate_payload", "route",
-})
-
-
-class TickFault(RuntimeError):
-    """A decode/prefill trip failed before compiled dispatch.
-
-    Recoverable by construction: nothing was donated, nothing moved, so
-    the server retries the trip after a backoff. ``rid`` (optional)
-    attributes the fault to one request for poison quarantine.
-    """
-
-    def __init__(self, msg: str = "injected tick fault",
-                 rid: Optional[int] = None):
-        super().__init__(msg)
-        self.rid = rid
-
-
-class EngineFailedError(RuntimeError):
-    """The server hit an unrecoverable error and refuses further work.
-
-    Raised by ``submit()`` once the engine is in a terminal failed state
-    (an exception escaped *after* compiled dispatch may have consumed
-    donated buffers, so no further trip is safe). Restore a snapshot
-    into a fresh server instead.
-    """
-
-
-@dataclass
-class FaultSpec:
-    """One scripted fault: fire at site-call ordinal ``at`` (0-based),
-    for ``count`` consecutive calls. ``kind`` selects a site-specific
-    variant, ``rid`` attributes tick faults to a request, ``magnitude``
-    parameterises clock jumps."""
-
-    site: str
-    at: int = 0
-    count: int = 1
-    kind: str = ""
-    rid: Optional[int] = None
-    magnitude: float = 0.0
-
-    def __post_init__(self):
-        if self.site not in SITES:
-            raise ValueError(
-                f"unknown fault site {self.site!r}; expected one of "
-                f"{sorted(SITES)}")
-        if self.at < 0 or self.count < 1:
-            raise ValueError("FaultSpec needs at >= 0 and count >= 1")
-
-
-@dataclass
-class FaultPlan:
-    """An ordered script of :class:`FaultSpec` plus the seed that makes
-    payload corruption deterministic."""
-
-    specs: List[FaultSpec] = field(default_factory=list)
-    seed: int = 0
-
-    @classmethod
-    def chaos(cls, seed: int, *, intensity: int = 2,
-              horizon: int = 240) -> "FaultPlan":
-        """A seeded mixed plan for soak runs: allocator-exhaustion
-        bursts, host-pool refusals, swap corruption, drafter failures,
-        and sub-quarantine tick faults spread over ``horizon`` site
-        calls. Same seed → same plan → same run."""
-        # explicit-seed generator ON PURPOSE: a fault plan must replay
-        # bit-identically across processes (capture vs. restore vs. CI),
-        # independent of whatever paddle.seed the host program set
-        rng = np.random.RandomState(seed)  # graftlint: noqa[np-random]
-        specs: List[FaultSpec] = []
-        for _ in range(intensity):
-            specs.append(FaultSpec("alloc", at=int(rng.randint(8, horizon)),
-                                   count=int(rng.randint(1, 4))))
-            specs.append(FaultSpec("tick", at=int(rng.randint(4, horizon)),
-                                   count=1))
-        specs.append(FaultSpec("host_put",
-                               at=int(rng.randint(0, max(4, horizon // 8)))))
-        specs.append(FaultSpec("swap_corrupt",
-                               at=int(rng.randint(0, 2))))
-        specs.append(FaultSpec("drafter",
-                               at=int(rng.randint(0, max(4, horizon // 4)))))
-        return cls(specs=specs, seed=seed)
-
-    @classmethod
-    def fleet_chaos(cls, seed: int, *, replicas: int = 2,
-                    horizon: int = 24) -> "FaultPlan":
-        """A seeded fleet plan: kill one replica mid-decode, corrupt one
-        migrating payload, and misroute a couple of submissions. The
-        ``replica_down`` ordinal counts the router's per-replica health
-        probes (``replicas`` per router step), so the kill lands at a
-        deterministic (step, replica) pair within the first
-        ``horizon // replicas`` router ticks — early enough that any
-        real workload is still mid-decode when the replica dies. Same
-        seed → same plan."""
-        rng = np.random.RandomState(seed)  # graftlint: noqa[np-random]
-        kill_step = int(rng.randint(2, max(3, horizon // replicas)))
-        specs = [
-            FaultSpec("replica_down",
-                      at=kill_step * replicas + int(rng.randint(0, replicas))),
-            FaultSpec("migrate_payload", at=int(rng.randint(0, 2))),
-            FaultSpec("route", at=int(rng.randint(0, 8)),
-                      count=int(rng.randint(1, 3))),
-        ]
-        return cls(specs=specs, seed=seed)
-
-
-class FaultInjector:
-    """Consults a :class:`FaultPlan` at named hook sites.
-
-    Each ``fire(site)`` call increments that site's ordinal counter and
-    returns the matching :class:`FaultSpec` (or ``None``). With no plan
-    the injector is permanently disabled — hooks check ``enabled`` first
-    so the production path is one attribute read.
-    """
-
-    def __init__(self, plan: Optional[FaultPlan] = None):
-        self.plan = plan
-        self.enabled = plan is not None and bool(plan.specs)
-        self._by_site: Dict[str, List[FaultSpec]] = {}
-        if plan is not None:
-            for spec in plan.specs:
-                self._by_site.setdefault(spec.site, []).append(spec)
-        self._counts: Dict[str, int] = {}
-        # same rationale as FaultPlan.chaos: plan-seeded, paddle-independent
-        self._rng = np.random.RandomState(  # graftlint: noqa[np-random]
-            plan.seed if plan else 0)
-        self.fired: List[Tuple[str, int]] = []
-
-    def fire(self, site: str) -> Optional[FaultSpec]:
-        """Host-only hook. Returns the spec to apply, or ``None``."""
-        if not self.enabled:
-            return None
-        n = self._counts.get(site, 0)
-        self._counts[site] = n + 1
-        for spec in self._by_site.get(site, ()):
-            if spec.at <= n < spec.at + spec.count:
-                self.fired.append((site, n))
-                return spec
-        return None
-
-    def corrupt(self, arrays: Sequence[np.ndarray]) -> None:
-        """Flip one seeded-deterministic bit in-place across ``arrays``
-        (a parked swap payload) — the checksum verifier must catch it."""
-        sizes = [a.nbytes for a in arrays]
-        total = int(sum(sizes))
-        if total == 0:
-            return
-        off = int(self._rng.randint(0, total))
-        bit = int(self._rng.randint(0, 8))
-        for a, sz in zip(arrays, sizes):
-            if off < sz:
-                flat = a.reshape(-1).view(np.uint8)
-                flat[off] ^= np.uint8(1 << bit)
-                return
-            off -= sz
-
-    def wrap_clock(self, clock: Callable[[], float]) -> Callable[[], float]:
-        """Wrap an injectable clock with scripted stalls and backwards
-        jumps (site ``clock``). The scheduler's monotonic clamp is the
-        defense this exercises."""
-        state: Dict[str, Any] = {"last": None}
-
-        def faulty_clock() -> float:
-            t = clock()
-            spec = self.fire("clock")
-            if spec is not None:
-                if spec.kind == "stall" and state["last"] is not None:
-                    return state["last"]
-                if spec.kind == "jump_back":
-                    t = t - (spec.magnitude or 10.0)
-            state["last"] = t
-            return t
-
-        return faulty_clock
-
-    def stats(self) -> Dict[str, Any]:
-        """Site-call ordinals seen and faults actually fired."""
-        return {
-            "calls": dict(self._counts),
-            "fired": len(self.fired),
-            "fired_sites": sorted({s for s, _ in self.fired}),
-        }
-
-
-#: Shared disabled injector — hook sites default to this so the hot path
-#: is a single ``enabled`` attribute check.
-NULL_INJECTOR = FaultInjector()
+__all__ = [
+    "SITES", "TickFault", "StepFault", "DataFeedFault", "SimulatedKill",
+    "EngineFailedError", "FaultSpec", "FaultPlan", "FaultInjector",
+    "NULL_INJECTOR",
+]

@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..faults import NULL_INJECTOR, StepFault
 from ..framework.core import Parameter, Tensor, no_grad_ctx
 from ..jit import functional_call, state_values
 from .api import _filter_spec, auto_shard_spec, mesh_context
@@ -64,7 +65,8 @@ class ParallelEngine:
                  donate: bool = True, abstract: bool = False,
                  offload_opt_state: bool = False,
                  alias_model_params: bool = False,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 injector=NULL_INJECTOR):
         """abstract=True keeps params/opt-state as ShapeDtypeStructs — the
         step can be .lower()ed (AOT partitioning validation at any scale)
         but not executed.
@@ -107,6 +109,7 @@ class ParallelEngine:
         # models, at the cost that the eager model is INVALID until
         # sync_to_model (donation consumes the shared buffers)
         self._alias_params = alias_model_params
+        self.injector = injector or NULL_INJECTOR
         if offload_opt_state and self.mesh.size > 1:
             raise NotImplementedError(
                 "offload_opt_state is single-device; multi-chip runs shard "
@@ -298,6 +301,11 @@ class ParallelEngine:
         error here — the data never exists in one place to replicate —
         so pad to the bucket (io.LengthBucketBatchSampler) instead."""
         def spec_of(i):
+            # PartitionSpec subclasses tuple: a bare P("data") must apply
+            # whole to every batch element, not be indexed into per-element
+            # axis names (which _filter_spec would then iterate char-wise)
+            if isinstance(self.batch_spec, P):
+                return self.batch_spec
             return (self.batch_spec[i]
                     if isinstance(self.batch_spec, (list, tuple))
                     else self.batch_spec)
@@ -563,6 +571,15 @@ class ParallelEngine:
                     raise ValueError(
                         f"grad_accum={self.grad_accum} needs the leading "
                         f"batch dim to divide evenly, got {b.shape}")
+        spec = self.injector.fire("train_step")
+        if spec is not None:
+            # fire BEFORE the compiled dispatch: params/opt_state have not
+            # been donated yet, so the caller may retry this step verbatim
+            if spec.kind == "fatal":
+                raise RuntimeError("injected fatal train-step fault")
+            raise StepFault(
+                f"injected train-step fault at step "
+                f"{int(np.asarray(self._step_count))}")
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
         from ..framework.monitor import monitor_add

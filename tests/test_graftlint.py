@@ -437,6 +437,87 @@ class TestWallClockGL012:
         """, path="paddle_tpu/benchmarks/timer.py")
 
 
+class TestNonAtomicCkptWriteGL013:
+    CKPT = "paddle_tpu/distributed/checkpoint_util.py"
+
+    def test_bare_write_in_checkpoint_module(self):
+        ids = rule_ids("""
+            def save(path, blob):
+                with open(path, "wb") as f:
+                    f.write(blob)
+        """, path=self.CKPT)
+        assert ids.count("GL013") == 1
+
+    def test_write_then_rename_is_the_sanctioned_pattern(self):
+        assert "GL013" not in rule_ids("""
+            import os
+
+            def save(path, blob):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        """, path=self.CKPT)
+
+    def test_replace_dir_commit_blesses_staged_writes(self):
+        assert "GL013" not in rule_ids("""
+            def commit(tmp, final, blob):
+                with open(tmp + "/host_state.pkl", "wb") as f:
+                    f.write(blob)
+                replace_dir(tmp, final)
+        """, path=self.CKPT)
+
+    def test_read_mode_and_default_mode_are_clean(self):
+        assert "GL013" not in rule_ids("""
+            def load(path):
+                with open(path, "rb") as f:
+                    body = f.read()
+                with open(path) as f:
+                    return f.read(), body
+        """, path=self.CKPT)
+
+    def test_mode_keyword_and_append_flagged(self):
+        ids = rule_ids("""
+            def log_append(path, line):
+                with open(path, mode="a") as f:
+                    f.write(line)
+        """, path=self.CKPT)
+        assert "GL013" in ids
+
+    def test_outer_rename_does_not_bless_nested_function(self):
+        # the closure may run on another thread (async save) or never
+        # reach the outer rename — it needs its own commit
+        ids = rule_ids("""
+            import os
+
+            def save(path, blob):
+                def worker():
+                    with open(path, "wb") as f:
+                        f.write(blob)
+                os.replace(path + ".tmp", path)
+                return worker
+        """, path=self.CKPT)
+        assert "GL013" in ids
+
+    def test_outside_checkpoint_paths_out_of_scope(self):
+        assert "GL013" not in rule_ids("""
+            def dump(path, blob):
+                with open(path, "wb") as f:
+                    f.write(blob)
+        """, path="paddle_tpu/vision/image_io.py")
+
+    def test_shipped_checkpoint_modules_are_clean(self):
+        # the real checkpoint stack must satisfy its own rule
+        for rel in ("paddle_tpu/distributed/checkpoint.py",
+                    "paddle_tpu/distributed/train_checkpoint.py",
+                    "paddle_tpu/incubate/checkpoint/auto_checkpoint.py"):
+            findings, _ = analyze_source((REPO / rel).read_text(), rel,
+                                         all_rules())
+            assert not [f for f in findings if f.rule_id == "GL013"], rel
+
+
 class TestSyntaxErrorGL000:
     def test_unparseable_module_reports_gl000(self):
         assert rule_ids("def broken(:\n    pass") == ["GL000"]
@@ -578,7 +659,8 @@ class TestRepoGate:
              "--list-rules"], capture_output=True, text=True)
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                    "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"):
+                    "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+                    "GL013"):
             assert rid in r.stdout
 
 

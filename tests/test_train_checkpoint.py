@@ -1,0 +1,636 @@
+"""Crash-safe training: complete-state checkpoints, bit-exact resume,
+degradation ladder, and the elastic-restart chaos harness.
+
+The training analogue of ``test_serving.py``'s snapshot/chaos tier:
+
+- manifest + atomic-commit primitives (CRC detection of bit rot and
+  truncation, stale-staging sweep, ``AutoCheckpoint`` torn-dir immunity);
+- ``load_state_dict`` shardings keyed by TREE PATH (the ``id()``-keyed
+  scheme this replaces dropped every sharding under tree transforms);
+- ``TrainCheckpointer``: the headline **bit-exact resume** guarantee —
+  kill at step k, restore, steps k+1..n produce losses and final
+  params/opt-state identical to an unkilled twin — for the fp32 engine
+  path and the eager AMP path (live loss-scaler mid-backoff), plus
+  GSPMD reshard-on-load onto a different n=8 mesh layout;
+- the degradation ladder: torn write → retry → (exhausted) drop-and-
+  continue; corrupt read → CRC detection → previous-generation fallback
+  → ``CheckpointCorruptError`` only when nothing valid remains;
+- the elastic chaos harness + ``tools/train_chaos.py`` twin gate.
+"""
+import importlib.util
+import os
+import pickle
+import sys
+import tempfile
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import (AutoCheckpoint, load_state_dict,
+                                               read_manifest, replace_dir,
+                                               save_state_dict, staging_path,
+                                               sweep_stale_staging,
+                                               tree_path_key, verify_manifest,
+                                               write_manifest)
+from paddle_tpu.distributed.train_checkpoint import (CheckpointableDataFeed,
+                                                     CheckpointCorruptError,
+                                                     TrainCheckpointer,
+                                                     config_fingerprint)
+from paddle_tpu.faults import (NULL_INJECTOR, DataFeedFault, FaultInjector,
+                               FaultPlan, FaultSpec, StepFault)
+from paddle_tpu.parallel.engine import ParallelEngine
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def npt(t):
+    return np.asarray(t.value)
+
+
+def make_batch(cursor):
+    rng = np.random.RandomState(100 + cursor)
+    return (rng.randn(8, 4).astype("float32"),
+            rng.randn(8, 2).astype("float32"))
+
+
+def make_engine(injector=None, mesh=None, fsdp=False, seed=5):
+    paddle.seed(seed)
+    m = nn.Linear(4, 2)
+    o = optimizer.AdamW(learning_rate=0.05, parameters=m.parameters())
+    return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss, donate=False,
+                          mesh=mesh, fsdp=fsdp,
+                          injector=injector or NULL_INJECTOR)
+
+
+# --------------------------------------------------------------------------- #
+# Manifest + atomic commit primitives
+# --------------------------------------------------------------------------- #
+
+
+class TestManifest:
+    def _write_gen(self, d):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "shard_0.bin"), "wb") as f:
+            f.write(b"\x01\x02" * 512)
+        with open(os.path.join(d, "meta.json"), "wb") as f:
+            f.write(b'{"step": 1}')
+        return write_manifest(d, step=1, fingerprint="fp")
+
+    def test_roundtrip_and_verify_clean(self, tmp_path):
+        d = str(tmp_path / "gen")
+        mf = self._write_gen(d)
+        assert set(mf["files"]) == {"shard_0.bin", "meta.json"}
+        assert read_manifest(d)["fingerprint"] == "fp"
+        assert verify_manifest(d) == []
+
+    def test_bit_flip_detected(self, tmp_path):
+        d = str(tmp_path / "gen")
+        self._write_gen(d)
+        with open(os.path.join(d, "shard_0.bin"), "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0x10]))
+        problems = verify_manifest(d)
+        assert problems and "crc mismatch" in problems[0]
+
+    def test_truncation_and_missing_shard_detected(self, tmp_path):
+        d = str(tmp_path / "gen")
+        self._write_gen(d)
+        with open(os.path.join(d, "shard_0.bin"), "r+b") as f:
+            f.truncate(17)
+        assert any("size mismatch" in p for p in verify_manifest(d))
+        os.remove(os.path.join(d, "shard_0.bin"))
+        assert any("missing shard" in p for p in verify_manifest(d))
+
+    def test_injector_corrupt_file_is_caught(self, tmp_path):
+        d = str(tmp_path / "gen")
+        self._write_gen(d)
+        inj = FaultInjector(FaultPlan(specs=[FaultSpec("ckpt_read")], seed=9))
+        off = inj.corrupt_file(os.path.join(d, "shard_0.bin"))
+        assert off >= 0
+        assert verify_manifest(d) != []
+
+    def test_replace_dir_commit_and_resave(self, tmp_path):
+        final = str(tmp_path / "step_1")
+        for token in (b"old", b"new"):
+            tmp = staging_path(final)
+            os.makedirs(tmp)
+            with open(os.path.join(tmp, "payload"), "wb") as f:
+                f.write(token)
+            replace_dir(tmp, final)
+        with open(os.path.join(final, "payload"), "rb") as f:
+            assert f.read() == b"new"
+        assert not os.path.exists(staging_path(final))
+        assert not os.path.exists(staging_path(final) + ".old")
+
+    def test_sweep_stale_staging(self, tmp_path):
+        os.makedirs(tmp_path / ".tmp-step_7")
+        os.makedirs(tmp_path / "step_1")
+        assert sweep_stale_staging(str(tmp_path)) == 1
+        assert (tmp_path / "step_1").exists()
+        assert not (tmp_path / ".tmp-step_7").exists()
+
+
+# --------------------------------------------------------------------------- #
+# Satellite 1: shardings keyed by tree path
+# --------------------------------------------------------------------------- #
+
+
+class TestPathKeyedShardings:
+    def test_tree_path_key_forms(self):
+        tree = {"model": {"weight": np.zeros(2)}, "seq": [np.zeros(2)]}
+        keys = []
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: keys.append(tree_path_key(p)), tree)
+        assert sorted(keys) == ["model/weight", "seq/0"]
+
+    def test_load_with_path_keyed_shardings(self, tmp_path):
+        devs = np.array(jax.devices()[:8])
+        mesh = Mesh(devs.reshape(8), ("data",))
+        state = {"model": {"w": np.arange(16, dtype=np.float32),
+                           "b": np.ones(4, np.float32)}}
+        save_state_dict(state, str(tmp_path / "ckpt"))
+        sh = NamedSharding(mesh, P("data"))
+        out = load_state_dict(str(tmp_path / "ckpt"), target=state,
+                              shardings={"model/w": sh})
+        w = out["model"]["w"].value
+        assert w.sharding == sh  # the path-keyed entry landed
+        np.testing.assert_array_equal(np.asarray(w), state["model"]["w"])
+        # leaves without an entry still restore (unsharded)
+        np.testing.assert_array_equal(np.asarray(out["model"]["b"].value),
+                                      state["model"]["b"])
+
+
+# --------------------------------------------------------------------------- #
+# Satellite 2: AutoCheckpoint atomic commit
+# --------------------------------------------------------------------------- #
+
+
+class TestAutoCheckpointAtomic:
+    def test_commit_is_manifested_and_staging_free(self, tmp_path):
+        paddle.seed(3)
+        m = nn.Linear(4, 2)
+        ac = AutoCheckpoint(str(tmp_path), every_n_steps=1)
+        tag = ac.step(model=m)
+        assert verify_manifest(tag) == []
+        assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp-")]
+
+    def test_torn_dir_is_skipped_by_latest(self, tmp_path):
+        paddle.seed(3)
+        m = nn.Linear(4, 2)
+        ac = AutoCheckpoint(str(tmp_path), every_n_steps=1)
+        good = ac.step(model=m)
+        # a kill mid-save leaves a higher-step dir with no manifest —
+        # latest() must fall back to the verified generation
+        torn = tmp_path / "step_99"
+        os.makedirs(torn)
+        (torn / "garbage").write_bytes(b"\x00" * 64)
+        assert ac.latest() == good
+
+    def test_stale_staging_swept_on_init(self, tmp_path):
+        os.makedirs(tmp_path / ".tmp-step_5")
+        AutoCheckpoint(str(tmp_path))
+        assert not (tmp_path / ".tmp-step_5").exists()
+
+    def test_resume_roundtrip(self, tmp_path):
+        paddle.seed(3)
+        m = nn.Linear(4, 2)
+        ac = AutoCheckpoint(str(tmp_path), every_n_steps=2)
+        ac.step(model=m)
+        assert ac.step(model=m) is not None
+        m2 = nn.Linear(4, 2)
+        ac2 = AutoCheckpoint(str(tmp_path), every_n_steps=2)
+        assert ac2.resume(model=m2) == 2
+        np.testing.assert_array_equal(npt(m.weight), npt(m2.weight))
+
+
+# --------------------------------------------------------------------------- #
+# TrainCheckpointer: complete state + bit-exact resume
+# --------------------------------------------------------------------------- #
+
+
+def run_engine(ckpt_dir, *, n=6, kill_at=None, save_every=2, injector=None,
+               metrics=None):
+    """One (possibly killed) incarnation against a shared checkpoint dir."""
+    eng = make_engine(injector)
+    feed = CheckpointableDataFeed(make_batch,
+                                  injector=injector or NULL_INJECTOR)
+    ck = TrainCheckpointer(ckpt_dir, injector=injector or NULL_INJECTOR,
+                           metrics=metrics, save_retries=2, backoff_s=0.005)
+    losses = {}
+    host = ck.restore(engine=eng, data_feed=feed)
+    start = (host["step"] + 1) if host else 0
+    for i in range(start, n):
+        X, y = feed.next_batch()
+        losses[i] = float(np.asarray(eng.train_batch(
+            paddle.to_tensor(X), paddle.to_tensor(y)).value))
+        if kill_at is not None and i == kill_at:
+            return losses, None, ck
+        if (i + 1) % save_every == 0:
+            ck.save(i, engine=eng, data_feed=feed)
+    return losses, eng.engine_state_dict(), ck
+
+
+class TestBitExactResume:
+    def test_fp32_kill_restore_twin(self, tmp_path):
+        twin_losses, twin_state, _ = run_engine(str(tmp_path / "twin"))
+        pre, _, _ = run_engine(str(tmp_path / "run"), kill_at=3)
+        post, state, _ = run_engine(str(tmp_path / "run"))
+        # replayed + continued steps are bit-identical to the unkilled twin
+        assert pre[3] == twin_losses[3]
+        for i, v in post.items():
+            assert v == twin_losses[i], (i, v, twin_losses[i])
+        for nm in twin_state["params"]:
+            np.testing.assert_array_equal(twin_state["params"][nm],
+                                          state["params"][nm])
+        for nm in twin_state["opt_state"]:
+            for k in twin_state["opt_state"][nm]:
+                np.testing.assert_array_equal(twin_state["opt_state"][nm][k],
+                                              state["opt_state"][nm][k])
+        assert state["step"] == twin_state["step"]
+
+    def test_save_does_not_perturb_training(self, tmp_path):
+        # the twin above never checkpoints; a run that checkpoints every
+        # step must produce the identical trajectory (capture is read-only)
+        a, sa, _ = run_engine(str(tmp_path / "a"), save_every=1)
+        b, sb, _ = run_engine(str(tmp_path / "b"), save_every=10**6)
+        assert a == b
+        for nm in sa["params"]:
+            np.testing.assert_array_equal(sa["params"][nm], sb["params"][nm])
+
+    def test_rng_and_feed_cursor_roundtrip(self, tmp_path):
+        eng = make_engine()
+        feed = CheckpointableDataFeed(make_batch, cursor=7)
+        paddle.seed(77)
+        key_before = np.asarray(paddle.framework.random.get_rng_state())
+        ck = TrainCheckpointer(str(tmp_path))
+        ck.save(7, engine=eng, data_feed=feed, extra={"note": "x"})
+        paddle.seed(1)  # clobber
+        eng2 = make_engine(seed=6)
+        feed2 = CheckpointableDataFeed(make_batch)
+        host = ck.restore(engine=eng2, data_feed=feed2)
+        assert host["step"] == 7 and host["extra"] == {"note": "x"}
+        assert feed2.cursor == 7
+        np.testing.assert_array_equal(
+            np.asarray(paddle.framework.random.get_rng_state()), key_before)
+
+    def test_fingerprint_mismatch_refuses_resume(self, tmp_path):
+        fp = config_fingerprint({"lr": 0.05, "width": 4})
+        ck = TrainCheckpointer(str(tmp_path), fingerprint=fp)
+        ck.save(0, engine=make_engine())
+        ck2 = TrainCheckpointer(
+            str(tmp_path), fingerprint=config_fingerprint({"lr": 0.1}))
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ck2.restore(engine=make_engine())
+
+    def test_async_save_commits_off_step_path(self, tmp_path):
+        ck = TrainCheckpointer(str(tmp_path), async_save=True)
+        eng = make_engine()
+        path = ck.save(3, engine=eng)
+        ck.wait()
+        assert verify_manifest(path) == []
+        assert ck.latest_valid()[0] == 3
+
+    def test_keep_last_prunes_old_generations(self, tmp_path):
+        ck = TrainCheckpointer(str(tmp_path), keep_last=2)
+        eng = make_engine()
+        for i in range(4):
+            ck.save(i, engine=eng)
+        assert [s for s, _ in ck.generations()] == [2, 3]
+
+
+class TestAMPResume:
+    """Satellite 3: kill/restore with a live loss-scaler mid-backoff."""
+
+    N = 6
+    INF_STEPS = {2, 3}  # scripted overflow steps (via the data stream)
+
+    @classmethod
+    def _amp_batch(cls, cursor):
+        X, y = make_batch(cursor)
+        if cursor in cls.INF_STEPS:
+            X = X.copy()
+            X[0, 0] = 1e30  # mse squares it → inf loss → inf grads
+        return X, y
+
+    def _run(self, ckpt_dir, *, kill_at=None):
+        paddle.seed(11)
+        m = nn.Linear(4, 2)
+        sched = optimizer.lr.StepDecay(learning_rate=0.05, step_size=2)
+        o = optimizer.AdamW(learning_rate=sched, parameters=m.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=32.0,
+                                       incr_every_n_steps=3,
+                                       decr_every_n_nan_or_inf=2)
+        feed = CheckpointableDataFeed(self._amp_batch)
+        ck = TrainCheckpointer(ckpt_dir)
+        host = ck.restore(model=m, optimizer=o, scaler=scaler,
+                          data_feed=feed)
+        start = (host["step"] + 1) if host else 0
+        losses = {}
+        for i in range(start, self.N):
+            X, y = feed.next_batch()
+            loss = nn.functional.mse_loss(m(paddle.to_tensor(X)),
+                                          paddle.to_tensor(y))
+            scaler.scale(loss).backward()
+            scaler.step(o)
+            scaler.update()
+            o.clear_grad()
+            sched.step()
+            losses[i] = float(np.asarray(loss.value))
+            if kill_at is not None and i == kill_at:
+                return losses, m, scaler, sched, None
+            ck.save(i, model=m, optimizer=o, scaler=scaler, data_feed=feed)
+        return losses, m, scaler, sched, ck
+
+    def test_scaler_mid_backoff_roundtrips_bit_exactly(self, tmp_path):
+        twin_losses, twin_m, twin_scaler, twin_sched, _ = self._run(
+            str(tmp_path / "twin"))
+        pre, _, scaler_at_kill, _, _ = self._run(str(tmp_path / "run"),
+                                                 kill_at=2)
+        # the kill lands mid-backoff: one bad step seen, scale not yet cut
+        assert scaler_at_kill.state_dict()["decr_count"] == 1
+        assert scaler_at_kill.state_dict()["scale"] == 32.0
+        post, m2, scaler2, sched2, _ = self._run(str(tmp_path / "run"))
+        # scaler state (scale + growth/backoff counters) is bit-exact at
+        # every comparison point, through the second bad step's scale cut
+        assert scaler2.state_dict() == twin_scaler.state_dict()
+        assert twin_scaler.state_dict()["scale"] == 16.0  # 2 bad → halved
+        assert sched2.state_dict() == twin_sched.state_dict()
+        for i, v in post.items():
+            assert v == twin_losses[i] or (
+                np.isinf(v) and np.isinf(twin_losses[i])), (i, v)
+        for (n1, p1), (n2, p2) in zip(twin_m.named_parameters(),
+                                      m2.named_parameters()):
+            assert n1 == n2
+            np.testing.assert_array_equal(npt(p1), npt(p2))
+
+
+class TestReshardOnLoad:
+    """n=8 dryrun: checkpoint written on one mesh layout restores onto a
+    different one via GSPMD reshard-on-load (orbax target shardings)."""
+
+    WIDTH = 64  # big enough that the fsdp auto-shard policy shards it
+
+    @staticmethod
+    def _wide_batch(cursor):
+        rng = np.random.RandomState(100 + cursor)
+        return (rng.randn(8, TestReshardOnLoad.WIDTH).astype("float32"),
+                rng.randn(8, TestReshardOnLoad.WIDTH).astype("float32"))
+
+    def _spmd_engine(self, layout):
+        devs = np.array(jax.devices()[:8])
+        paddle.seed(5)
+        m = nn.Linear(self.WIDTH, self.WIDTH)
+        o = optimizer.AdamW(learning_rate=0.05, parameters=m.parameters())
+        if layout == "dp8":
+            mesh = Mesh(devs.reshape(8), ("data",))
+            return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss,
+                                  donate=False, mesh=mesh)
+        mesh = Mesh(devs.reshape(2, 4), ("data", "sharding"))
+        return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss,
+                              donate=False, mesh=mesh, fsdp=True)
+
+    def test_cross_mesh_restore_is_state_exact_and_trains(self, tmp_path):
+        eng_a = self._spmd_engine("dp8")
+        feed = CheckpointableDataFeed(self._wide_batch)
+        for i in range(2):
+            X, y = feed.next_batch()
+            eng_a.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+        ck = TrainCheckpointer(str(tmp_path))
+        ck.save(1, engine=eng_a, data_feed=feed)
+        saved = eng_a.engine_state_dict()
+
+        eng_b = self._spmd_engine("fsdp2x4")
+        feed_b = CheckpointableDataFeed(self._wide_batch)
+        host = ck.restore(engine=eng_b, data_feed=feed_b)
+        assert host["step"] == 1 and feed_b.cursor == 2
+        restored = eng_b.engine_state_dict()
+        # resharded, not altered: gathered state is byte-identical
+        for nm in saved["params"]:
+            np.testing.assert_array_equal(saved["params"][nm],
+                                          restored["params"][nm])
+        for nm in saved["opt_state"]:
+            for k in saved["opt_state"][nm]:
+                np.testing.assert_array_equal(saved["opt_state"][nm][k],
+                                              restored["opt_state"][nm][k])
+        assert restored["step"] == saved["step"]
+        # the params actually landed sharded over the new mesh axis
+        w = eng_b.params["weight"]
+        assert "sharding" in str(w.sharding.spec)
+        # and training continues on the new layout
+        X, y = feed_b.next_batch()
+        loss = eng_b.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+        assert np.isfinite(float(np.asarray(loss.value)))
+
+
+# --------------------------------------------------------------------------- #
+# Degradation ladder
+# --------------------------------------------------------------------------- #
+
+
+class TestDegradationLadder:
+    def test_torn_write_retry_succeeds(self, tmp_path):
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("ckpt_write", at=0, kind="torn")], seed=1))
+        ck = TrainCheckpointer(str(tmp_path), injector=inj, save_retries=2,
+                               backoff_s=0.005)
+        path = ck.save(0, engine=make_engine())
+        assert path is not None and verify_manifest(path) == []
+        m = ck.metrics
+        assert m.counter("train_checkpoint_save_retries", "").total() == 1
+        assert m.counter("train_checkpoint_save_failures", "").total() == 0
+
+    def test_torn_write_exhausted_drops_save_never_raises(self, tmp_path):
+        eng = make_engine()
+        good = TrainCheckpointer(str(tmp_path))
+        good.save(0, engine=eng)
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("ckpt_write", at=0, count=2, kind="torn")],
+            seed=1))
+        ck = TrainCheckpointer(str(tmp_path), injector=inj, save_retries=1,
+                               backoff_s=0.005)
+        assert ck.save(1, engine=eng) is None  # dropped, not raised
+        assert ck.metrics.counter(
+            "train_checkpoint_save_failures", "").total() == 1
+        # the step loop continues against the last valid generation
+        assert ck.latest_valid()[0] == 0
+        # no staging husk leaks from the failed attempts
+        assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp-")]
+        # and the NEXT save (plan spent) commits normally
+        assert ck.save(2, engine=eng) is not None
+        assert ck.latest_valid()[0] == 2
+
+    def test_corrupt_read_falls_back_a_generation(self, tmp_path):
+        eng = make_engine()
+        writer = TrainCheckpointer(str(tmp_path))
+        writer.save(0, engine=eng)
+        eng.train_batch(*map(paddle.to_tensor, make_batch(0)))
+        writer.save(1, engine=eng)
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("ckpt_read", at=0)], seed=4))
+        ck = TrainCheckpointer(str(tmp_path), injector=inj)
+        host = ck.restore(engine=make_engine(seed=6))
+        assert host["step"] == 0  # newest gen corrupted on read → fell back
+        m = ck.metrics
+        assert m.counter("train_checkpoint_corrupt_reads", "").total() == 1
+        assert m.counter(
+            "train_checkpoint_generation_fallbacks", "").total() == 1
+
+    def test_all_generations_corrupt_raises(self, tmp_path):
+        eng = make_engine()
+        ck = TrainCheckpointer(str(tmp_path))
+        for i in range(2):
+            ck.save(i, engine=eng)
+        for _step, path in ck.generations():
+            mf = read_manifest(path)
+            rel = sorted(mf["files"])[0]
+            with open(os.path.join(path, rel), "r+b") as f:
+                f.seek(0)
+                b = f.read(1)
+                f.seek(0)
+                f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CheckpointCorruptError):
+            ck.restore(engine=make_engine(seed=6))
+
+    def test_empty_dir_restores_fresh(self, tmp_path):
+        ck = TrainCheckpointer(str(tmp_path))
+        assert ck.restore(engine=make_engine()) is None
+
+
+# --------------------------------------------------------------------------- #
+# Training fault sites
+# --------------------------------------------------------------------------- #
+
+
+class TestTrainingFaultSites:
+    def test_train_step_fault_fires_before_dispatch_and_retries(self):
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("train_step", at=1)], seed=1))
+        eng = make_engine(injector=inj)
+        clean = make_engine()
+        feed = CheckpointableDataFeed(make_batch)
+        losses, ref = [], []
+        for i in range(3):
+            X, y = feed.next_batch()
+            for attempt in range(3):
+                try:
+                    losses.append(float(np.asarray(eng.train_batch(
+                        paddle.to_tensor(X), paddle.to_tensor(y)).value)))
+                    break
+                except StepFault:
+                    assert attempt < 2
+            ref.append(float(np.asarray(clean.train_batch(
+                paddle.to_tensor(X), paddle.to_tensor(y)).value)))
+        # state untouched by the fault: the retried run tracks the clean twin
+        assert losses == ref
+        assert ("train_step", 1) in inj.fired
+
+    def test_fatal_train_step_fault_is_plain_runtime_error(self):
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("train_step", at=0, kind="fatal")], seed=1))
+        eng = make_engine(injector=inj)
+        X, y = make_batch(0)
+        with pytest.raises(RuntimeError, match="fatal"):
+            eng.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+
+    def test_data_feed_fault_does_not_advance_cursor(self):
+        inj = FaultInjector(FaultPlan(
+            specs=[FaultSpec("data_feed", at=1)], seed=1))
+        feed = CheckpointableDataFeed(make_batch, injector=inj)
+        clean = CheckpointableDataFeed(make_batch)
+        out = []
+        for _ in range(3):
+            while True:
+                try:
+                    out.append(feed.next_batch())
+                    break
+                except DataFeedFault:
+                    pass
+            ref = clean.next_batch()
+            np.testing.assert_array_equal(out[-1][0], ref[0])
+        assert feed.cursor == clean.cursor == 3
+
+    def test_serving_reexports_are_the_shared_substrate(self):
+        import paddle_tpu.faults as shared
+        from paddle_tpu.inference import faults as serving
+
+        assert serving.FaultInjector is shared.FaultInjector
+        assert serving.FaultPlan is shared.FaultPlan
+        assert serving.NULL_INJECTOR is shared.NULL_INJECTOR
+
+    def test_train_chaos_plan_is_seeded_and_covers_sites(self):
+        p1 = FaultPlan.train_chaos(3, horizon=12, kills=2)
+        p2 = FaultPlan.train_chaos(3, horizon=12, kills=2)
+        assert p1 == p2  # same seed → same plan
+        sites = {s.site for s in p1.specs}
+        assert sites == {"train_step", "data_feed", "ckpt_write",
+                         "ckpt_read", "kill"}
+        kill_ats = [s.at for s in p1.specs if s.site == "kill"]
+        assert len(set(kill_ats)) == 2  # distinct ordinals, both fire
+
+
+# --------------------------------------------------------------------------- #
+# Elastic chaos harness + the stage-8 gate
+# --------------------------------------------------------------------------- #
+
+
+class TestElasticChaos:
+    def test_harness_kill_detect_restore_continue(self, tmp_path):
+        from paddle_tpu.distributed.fleet.chaos import ElasticChaosHarness
+
+        twin_losses, twin_state, _ = run_engine(str(tmp_path / "twin"), n=6)
+
+        plan = FaultPlan(specs=[FaultSpec("kill", at=3)], seed=3)
+        injector = FaultInjector(plan)
+        state = {}
+
+        class Run:
+            def __init__(self, inj):
+                self.eng = make_engine(injector=inj)
+                self.feed = CheckpointableDataFeed(make_batch, injector=inj)
+                self.ck = TrainCheckpointer(str(tmp_path / "chaos"),
+                                            injector=inj)
+                state["engine"] = self.eng
+
+            def restore(self):
+                host = self.ck.restore(engine=self.eng, data_feed=self.feed)
+                return (host["step"] + 1) if host else 0
+
+            def step(self, i):
+                X, y = self.feed.next_batch()
+                return float(np.asarray(self.eng.train_batch(
+                    paddle.to_tensor(X), paddle.to_tensor(y)).value))
+
+            def save(self, i):
+                self.ck.save(i, engine=self.eng, data_feed=self.feed)
+
+        harness = ElasticChaosHarness(
+            Run, total_steps=6, injector=injector, max_restarts=2,
+            heartbeat_interval=0.05, lease_ttl=0.3)
+        report = harness.run()
+        assert report.completed and report.restarts == 1
+        assert report.detected_kills == 1  # observed via lease expiry
+        for i, v in report.losses.items():
+            assert v == twin_losses[i], (i, v)
+        final = state["engine"].engine_state_dict()
+        for nm in twin_state["params"]:
+            np.testing.assert_array_equal(twin_state["params"][nm],
+                                          final["params"][nm])
+
+    @pytest.mark.slow
+    def test_train_chaos_tool_gate(self):
+        """The stage-8 gate end to end, in-process (small config)."""
+        spec = importlib.util.spec_from_file_location(
+            "train_chaos_tool", REPO / "tools" / "train_chaos.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(["--steps", "10", "--kills", "2", "--seed", "3",
+                       "--json"])
+        assert rc == 0

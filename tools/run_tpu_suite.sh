@@ -256,4 +256,42 @@ assert r["watchdog_after_recovery"] == 0, \
 assert len(r["replicas"]) == 2, "per-replica rows missing"
 PY
 
+echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="
+python tools/train_chaos.py --steps 12 --kills 2 --seed 3 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/train_chaos.json \
+  || { echo "training chaos gate FAILED (resume diverged from the twin,"\
+       "a corruption went undetected, or a kill was never recovered)"; exit 1; }
+python - <<'PY'
+# training chaos gate: every scripted kill must be DETECTED by the
+# elastic monitor (lease expiry -> RESTART) and recovered via
+# restore-latest-valid; every replayed + continued step loss and the
+# final params/opt-state must be bit-exact against the unkilled
+# fault-free twin; every injected on-disk corruption must be caught by
+# the CRC32 manifest and absorbed by generation fallback (zero
+# undetected corruptions); torn writes must be absorbed by the retry
+# rung without a single dropped save
+import json
+r = json.load(open("/tmp/tpu_runs/train_chaos.json"))
+print(f"faults {r['faults_injected']} at {r['fault_sites']}, "
+      f"kills {r['detected_kills']}/{r['restarts']} restarts, "
+      f"mismatches {r['loss_mismatches']}, bitexact {r['params_bitexact']}, "
+      f"corrupt reads {r['corrupt_reads_detected']}/{r['ckpt_read_fired']}, "
+      f"torn-write retries {r['save_retries']} "
+      f"(dropped {r['save_failures']})")
+assert r["faults_injected"] > 0, "fault plan never fired — gate vacuous"
+assert r["completed"], "chaos run never reached the final step"
+assert r["detected_kills"] == r["restarts"] >= 1, \
+    "a kill was missed by the elastic monitor or never injected"
+assert r["loss_mismatches"] == 0, \
+    "resumed trajectory diverged from the unkilled twin"
+assert r["params_bitexact"], \
+    "final params/opt-state differ from the unkilled twin"
+assert r["corrupt_reads_detected"] >= r["ckpt_read_fired"], \
+    "an injected on-disk corruption went UNDETECTED by the manifest"
+assert r["ckpt_read_fired"] >= 1 and r["generation_fallbacks"] >= 1, \
+    "corrupt-read rung never exercised — gate vacuous"
+assert r["save_failures"] == 0, \
+    "a torn write exhausted its retries and dropped the generation"
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Training chaos-twin gate: seeded kills, torn writes and bit-flipped
+reads against an unkilled fault-free twin.
+
+Drives the elastic chaos harness
+(``paddle_tpu/distributed/fleet/chaos.py``) through a
+``FaultPlan.train_chaos`` script over a small-but-real
+``ParallelEngine`` run with a complete-state ``TrainCheckpointer``, then
+replays the same trajectory with no faults and compares:
+
+- every step loss recorded by the chaos run (including replayed steps
+  after each restart) must equal the twin's loss at that step bit-for-bit;
+- the final params/opt-state must be byte-identical to the twin's;
+- every injected on-disk corruption must have been DETECTED by the CRC32
+  manifest (``train_checkpoint_corrupt_reads`` >= ``ckpt_read`` firings)
+  and absorbed by generation fallback — zero undetected corruptions;
+- every torn write must have been absorbed by the retry rung
+  (``save_retries``/``save_failures`` accounting).
+
+Suite stage 8 (``tools/run_tpu_suite.sh``) runs this with ``--json`` and
+asserts on the emitted line; it is CPU-runnable too (the same command
+under ``JAX_PLATFORMS=cpu``) so the gate also rides the quick tier via
+``tests/test_train_checkpoint.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_factories(args):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.parallel.engine import ParallelEngine
+
+    def make_model():
+        paddle.seed(args.model_seed)
+        m = nn.Sequential(nn.Linear(args.width, args.width),
+                          nn.ReLU(), nn.Linear(args.width, 4))
+        o = optimizer.AdamW(learning_rate=0.01, parameters=m.parameters())
+        return m, o
+
+    def make_batch(cursor):
+        rng = np.random.RandomState(args.data_seed + cursor)
+        return (rng.randn(args.batch, args.width).astype("float32"),
+                rng.randn(args.batch, 4).astype("float32"))
+
+    def make_engine(injector=None):
+        m, o = make_model()
+        return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss,
+                              donate=False, injector=injector)
+
+    return make_engine, make_batch
+
+
+class ChaosTrainRun:
+    """One incarnation: fresh engine + feed + shared-dir checkpointer.
+
+    ``step`` owns the train_step retry (same batch — the feed cursor
+    must NOT re-advance on a dispatch-side fault, or the resumed stream
+    diverges); the harness owns the data_feed retry (fires before the
+    cursor moves, so a re-fetch is identical).
+    """
+
+    def __init__(self, injector, ckpt_dir, metrics, make_engine, make_batch,
+                 save_every=1):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.train_checkpoint import (
+            CheckpointableDataFeed, TrainCheckpointer)
+
+        self._paddle = paddle
+        self.eng = make_engine(injector)
+        self.feed = CheckpointableDataFeed(make_batch, injector=injector)
+        self.ck = TrainCheckpointer(ckpt_dir, injector=injector,
+                                    metrics=metrics, save_retries=2,
+                                    backoff_s=0.01)
+        self.save_every = save_every
+
+    def restore(self) -> int:
+        host = self.ck.restore(engine=self.eng, data_feed=self.feed)
+        return (host["step"] + 1) if host else 0
+
+    def step(self, i: int) -> float:
+        from paddle_tpu.faults import StepFault
+
+        X, y = self.feed.next_batch()
+        for attempt in range(4):
+            try:
+                loss = self.eng.train_batch(self._paddle.to_tensor(X),
+                                            self._paddle.to_tensor(y))
+                return float(np.asarray(loss.value))
+            except StepFault:
+                if attempt == 3:
+                    raise
+        raise AssertionError("unreachable")
+
+    def save(self, i: int) -> None:
+        if (i + 1) % self.save_every == 0:
+            self.ck.save(i, engine=self.eng, data_feed=self.feed)
+
+
+def run_twin(args, make_engine, make_batch):
+    """The unkilled fault-free reference trajectory."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.train_checkpoint import CheckpointableDataFeed
+
+    eng = make_engine()
+    feed = CheckpointableDataFeed(make_batch)
+    losses = {}
+    for i in range(args.steps):
+        X, y = feed.next_batch()
+        losses[i] = float(np.asarray(eng.train_batch(
+            paddle.to_tensor(X), paddle.to_tensor(y)).value))
+    return losses, eng.engine_state_dict()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--seed", type=int, default=3, help="fault-plan seed")
+    p.add_argument("--kills", type=int, default=2)
+    p.add_argument("--width", type=int, default=8)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--model-seed", type=int, default=5)
+    p.add_argument("--data-seed", type=int, default=100)
+    p.add_argument("--max-restarts", type=int, default=6)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.distributed.fleet.chaos import ElasticChaosHarness
+    from paddle_tpu.faults import FaultInjector, FaultPlan
+    from paddle_tpu.inference.telemetry import MetricsRegistry
+
+    make_engine, make_batch = build_factories(args)
+    twin_losses, twin_state = run_twin(args, make_engine, make_batch)
+
+    plan = FaultPlan.train_chaos(args.seed, horizon=args.steps,
+                                 kills=args.kills)
+    injector = FaultInjector(plan)
+    metrics = MetricsRegistry()
+    final_state = {}
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        def build(inj):
+            run = ChaosTrainRun(inj, ckpt_dir, metrics, make_engine,
+                                make_batch)
+            final_state["engine"] = run.eng
+            return run
+
+        harness = ElasticChaosHarness(
+            build, total_steps=args.steps, injector=injector,
+            max_restarts=args.max_restarts)
+        report = harness.run()
+        chaos_state = final_state["engine"].engine_state_dict()
+
+    loss_mismatches = sum(
+        1 for i, v in report.losses.items() if v != twin_losses[i])
+    params_bitexact = all(
+        np.array_equal(twin_state["params"][n], chaos_state["params"][n])
+        for n in twin_state["params"]) and all(
+        np.array_equal(twin_state["opt_state"][n][k],
+                       chaos_state["opt_state"][n][k])
+        for n in twin_state["opt_state"]
+        for k in twin_state["opt_state"][n])
+
+    fired = injector.stats()
+    ckpt_read_fired = sum(1 for s, _ in injector.fired if s == "ckpt_read")
+    ckpt_write_fired = sum(1 for s, _ in injector.fired if s == "ckpt_write")
+    ctr = lambda n: metrics.counter("train_checkpoint_" + n, "").total()
+    result = {
+        "bench": "train_chaos",
+        "schema_version": 1,
+        "steps": args.steps,
+        "plan_seed": args.seed,
+        "completed": report.completed,
+        "restarts": report.restarts,
+        "detected_kills": report.detected_kills,
+        "steps_run": report.steps_run,
+        "transient_retries": report.transient_retries,
+        "faults_injected": fired["fired"],
+        "fault_sites": fired["fired_sites"],
+        "loss_mismatches": loss_mismatches,
+        "params_bitexact": bool(params_bitexact),
+        "ckpt_read_fired": ckpt_read_fired,
+        "ckpt_write_fired": ckpt_write_fired,
+        "corrupt_reads_detected": ctr("corrupt_reads"),
+        "generation_fallbacks": ctr("generation_fallbacks"),
+        "save_retries": ctr("save_retries"),
+        "save_failures": ctr("save_failures"),
+        "saves": ctr("saves"),
+        "restores": ctr("restores"),
+    }
+    print(json.dumps(result) if args.as_json else
+          f"train_chaos: completed={result['completed']} "
+          f"restarts={result['restarts']} faults={result['faults_injected']} "
+          f"at {result['fault_sites']} mismatches={result['loss_mismatches']} "
+          f"bitexact={result['params_bitexact']} "
+          f"corrupt_reads={result['corrupt_reads_detected']}/"
+          f"{result['ckpt_read_fired']}")
+    ok = (result["completed"] and result["loss_mismatches"] == 0
+          and result["params_bitexact"]
+          and result["corrupt_reads_detected"] >= result["ckpt_read_fired"]
+          and result["detected_kills"] == result["restarts"]
+          and result["faults_injected"] > 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
