@@ -111,7 +111,7 @@ class GenerationServer:
                  policy=None,
                  host_pool_bytes: Optional[int] = None,
                  lora=None, telemetry=None, faults=None,
-                 fault_retries: int = 3):
+                 fault_retries: int = 3, kernels: str = "auto"):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -184,7 +184,19 @@ class GenerationServer:
         :class:`~.faults.FaultPlan` to replay pool exhaustion, tick
         faults, drafter failures, and swap corruption deterministically
         (the chaos-soak harness). ``fault_retries``: tick-fault strikes a
-        request survives before quarantine to terminal ``failed``."""
+        request survives before quarantine to terminal ``failed``.
+
+        ``kernels``: attention/projection kernel dispatch for the compiled
+        serving programs — ``"auto"`` (default) picks the Pallas kernels on
+        a TPU backend and the jnp reference elsewhere, ``"pallas"`` forces
+        the kernels (interpret mode off-TPU — CPU parity testing),
+        ``"reference"`` pins the jnp compositions. Process-wide
+        (``ops.set_kernel_mode``) and read at trace time, so it must agree
+        across servers compiling in one process; ``"auto"`` leaves the
+        current mode untouched. Recorded in the snapshot fingerprint —
+        restore refuses a snapshot taken under a different mode (greedy
+        tokens are kernel-identical, but sampling paths need not be
+        bit-equal across kernels)."""
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -208,6 +220,14 @@ class GenerationServer:
             raise ValueError("lora= (multi-adapter serving) requires "
                              "cache='paged' — the adapter pool shares the "
                              "paged slot/eviction machinery")
+        from ..ops import KERNEL_MODES, set_kernel_mode
+
+        if kernels not in KERNEL_MODES:
+            raise ValueError(
+                f"kernels must be one of {KERNEL_MODES}, got {kernels!r}")
+        if kernels != "auto":
+            set_kernel_mode(kernels)
+        self.kernels = kernels
         self.kv_quant = kv_quant
         self.spec = None
         if spec is not None:
@@ -2084,7 +2104,8 @@ class GenerationServer:
                 "table_width": self._table_width,
                 "num_blocks": self.alloc.num_blocks,
                 "spec_k": self.spec_k if self.spec is not None else None,
-                "lora": self._lora is not None}
+                "lora": self._lora is not None,
+                "kernels": self.kernels}
 
     def _req_state(self, req: _Request) -> Dict[str, Any]:
         return {"rid": req.rid, "prompt": list(req.prompt),

@@ -362,9 +362,17 @@ class LlamaAttention(Layer):
             q, k, v = apply_op(qkv8, x, self.qkv_fused.weight_q,
                                self.qkv_fused.weight_scale,
                                op_name="w8_qkv")
+        elif lora is not None:
+            # base matmul + gathered delta fused into ONE op per projection
+            # (a single Pallas program per row under use_pallas())
+            from ..nn.lora import lora_matmul
+
+            q = lora_matmul(x, self.q_proj.weight, lora.get("q"))
+            k = lora_matmul(x, self.k_proj.weight, lora.get("k"))
+            v = lora_matmul(x, self.v_proj.weight, lora.get("v"))
         else:
             q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
-        if lora is not None:
+        if lora is not None and getattr(self, "_w8_split", None):
             from ..nn.lora import bgmv
 
             if "q" in lora:
@@ -378,13 +386,12 @@ class LlamaAttention(Layer):
                 reshape(v, [B, S, self.num_kv_heads, self.head_dim]))
 
     def _o_lora(self, out, lora):
-        """Output projection plus the gathered per-row "o" delta."""
-        proj = self.o_proj(out)
-        if lora is not None and "o" in lora:
-            from ..nn.lora import bgmv
+        """Output projection plus the gathered per-row "o" delta, fused."""
+        if lora is not None:
+            from ..nn.lora import lora_matmul
 
-            proj = proj + bgmv(out, lora["o"])
-        return proj
+            return lora_matmul(out, self.o_proj.weight, lora.get("o"))
+        return self.o_proj(out)
 
     def forward(self, x, cos, sin, cache=None, pos_offset=0):
         B, S = x.shape[0], x.shape[1]
@@ -718,26 +725,17 @@ class LlamaMLP(Layer):
                            self.down_proj.weight_q, self.down_proj.weight_scale,
                            op_name="w8_mlp")
         elif lora is not None and any(k in lora for k in ("gate", "up", "down")):
-            # decomposed SwiGLU so the gathered per-row deltas land on the
-            # same activations the fused lambda would see — XLA re-fuses the
-            # chain inside the jitted serving program
-            from ..nn.lora import bgmv
+            # decomposed SwiGLU with each base matmul + gathered per-row
+            # delta fused into one op (one Pallas program per row under
+            # use_pallas()); XLA re-fuses the chain inside the jitted
+            # serving program
+            from ..nn.lora import lora_matmul
 
-            g = apply_op(lambda v, w: jnp.matmul(v, w), x,
-                         self.gate_proj.weight, op_name="linear")
-            if "gate" in lora:
-                g = g + bgmv(x, lora["gate"])
-            u = apply_op(lambda v, w: jnp.matmul(v, w), x,
-                         self.up_proj.weight, op_name="linear")
-            if "up" in lora:
-                u = u + bgmv(x, lora["up"])
+            g = lora_matmul(x, self.gate_proj.weight, lora.get("gate"))
+            u = lora_matmul(x, self.up_proj.weight, lora.get("up"))
             h = apply_op(lambda a, b: jax.nn.silu(a) * b, g, u,
                          op_name="swiglu")
-            out = apply_op(
-                lambda v, w: checkpoint_name(jnp.matmul(v, w), "mlp_out"),
-                h, self.down_proj.weight, op_name="linear")
-            if "down" in lora:
-                out = out + bgmv(h, lora["down"])
+            out = lora_matmul(h, self.down_proj.weight, lora.get("down"))
         elif not isinstance(self.gate_proj, Linear):
             # training-side LoRALinear wrap (attach_lora): go through the
             # layer calls so each projection applies its own A/B residual

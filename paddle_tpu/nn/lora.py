@@ -32,7 +32,7 @@ from .layer_base import Layer
 
 __all__ = ["LoRALinear", "attach_lora", "merge_lora", "lora_parameters",
            "lora_state", "load_lora_state", "export_adapter", "load_adapter",
-           "bgmv"]
+           "bgmv", "lora_matmul"]
 
 
 class LoRALinear(Layer):
@@ -231,3 +231,35 @@ def bgmv(x: Tensor, ab: Optional[Tuple]) -> Optional[Tensor]:
         return d.astype(v.dtype)
 
     return apply_op(f, x, Tensor(A), Tensor(B), Tensor(s), op_name="lora_bgmv")
+
+
+def lora_matmul(x: Tensor, w: Tensor, ab: Optional[Tuple]) -> Tensor:
+    """Base projection + gathered LoRA delta in ONE op:
+    ``x @ w + ((x32 @ A) @ B) * scale`` with ``ab = (A, B, scale)`` as in
+    :func:`bgmv` (None means plain matmul). Under the shared kernel
+    dispatch (``ops.use_pallas()``) the whole expression runs as one Pallas
+    program per batch row (``ops.paged_attention_pallas.fused_lora_matmul``)
+    so multi-tenant decode stops paying a separate gather+matmul pass; the
+    jnp composition is bit-identical to the Linear-then-:func:`bgmv`
+    sequence it replaces (same primitives, same order)."""
+    if ab is None:
+        return apply_op(lambda v, wv: jnp.matmul(v, wv), x, w,
+                        op_name="linear")
+    A, B, s = ab
+
+    def f(v, wv, a, b, sc):
+        from ..ops import use_pallas
+
+        if use_pallas():
+            try:
+                from ..ops.paged_attention_pallas import fused_lora_matmul
+                return fused_lora_matmul(v, wv, a, b, sc)
+            except NotImplementedError:
+                pass
+        y = jnp.matmul(v, wv)
+        d = jnp.einsum("bsh,bhr->bsr", v.astype(jnp.float32), a)
+        d = jnp.einsum("bsr,bro->bso", d, b) * sc[:, None, None]
+        return y + d.astype(v.dtype)
+
+    return apply_op(f, x, w, Tensor(A), Tensor(B), Tensor(s),
+                    op_name="lora_linear")

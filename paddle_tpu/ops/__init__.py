@@ -1,7 +1,63 @@
 """Pallas TPU kernel library — the replacement for the reference's fused CUDA
 ops (ref paddle/fluid/operators/fused/: fused_attention_op.cu,
 fused_multi_transformer_op.cu, fmha_ref.h) and hand-written PHI GPU kernels.
+
+Kernel dispatch contract (shared by flash_attention, paged_attention, and the
+fused LoRA projections):
+
+* ``use_pallas()`` — True when the Pallas code path should run: on a real TPU
+  backend, when ``PT_FLASH_INTERPRET=1`` (interpret mode on CPU), or when the
+  process-wide mode was pinned to ``"pallas"`` via :func:`set_kernel_mode`.
+  ``"reference"`` pins the jnp compositions regardless of backend.
+* ``pallas_interpret()`` — True when ``pl.pallas_call`` must run interpreted
+  (no Mosaic compiler available), i.e. Pallas was requested on a non-TPU
+  backend.
+
+Both are read at TRACE time, so flipping the mode between compiled program
+invocations has no effect — set it before the first trace (GenerationServer
+does this in its constructor via ``kernels=``).
 """
+import os as _os
+
+import jax as _jax
+
+KERNEL_MODES = ("auto", "pallas", "reference")
+
+_KERNEL_MODE = "auto"
+
+
+def set_kernel_mode(mode: str) -> None:
+    """Pin the process-wide kernel dispatch: ``"pallas"`` forces the Pallas
+    kernels (interpret mode off-TPU), ``"reference"`` forces the jnp
+    compositions, ``"auto"`` restores backend-based dispatch."""
+    global _KERNEL_MODE
+    if mode not in KERNEL_MODES:
+        raise ValueError(
+            f"kernel mode must be one of {KERNEL_MODES}, got {mode!r}")
+    _KERNEL_MODE = mode
+
+
+def kernel_mode() -> str:
+    return _KERNEL_MODE
+
+
+def use_pallas() -> bool:
+    if _KERNEL_MODE == "reference":
+        return False
+    if _KERNEL_MODE == "pallas":
+        return True
+    return (_jax.default_backend() in ("tpu", "axon")
+            or _os.environ.get("PT_FLASH_INTERPRET") == "1")
+
+
+def pallas_interpret() -> bool:
+    """Interpret mode: the Pallas path was requested on a non-TPU backend."""
+    if _jax.default_backend() in ("tpu", "axon"):
+        return False
+    return (_os.environ.get("PT_FLASH_INTERPRET") == "1"
+            or _KERNEL_MODE == "pallas")
+
+
 from .flash_attention import flash_attention, flash_attention_bshd
 from .fused_norm import fused_rms_norm, fused_layer_norm
 from .paged_attention import (gather_block_kv, gather_block_scales,
@@ -15,7 +71,9 @@ from .paged_attention import (gather_block_kv, gather_block_scales,
 
 __all__ = ["flash_attention", "flash_attention_bshd", "fused_rms_norm",
            "fused_layer_norm", "gather_block_kv", "gather_block_scales",
-           "paged_decode_attention", "paged_decode_attention_q",
-           "paged_prefill_attention", "paged_prefill_attention_q",
-           "quantize_block_kv", "write_chunk_kv", "write_chunk_kv_q",
-           "write_decode_kv", "write_decode_kv_q"]
+           "kernel_mode", "paged_decode_attention",
+           "paged_decode_attention_q", "paged_prefill_attention",
+           "paged_prefill_attention_q", "pallas_interpret",
+           "quantize_block_kv", "set_kernel_mode", "use_pallas",
+           "write_chunk_kv", "write_chunk_kv_q", "write_decode_kv",
+           "write_decode_kv_q"]

@@ -32,16 +32,18 @@ NEG_INF = -1e30
 
 
 def _use_pallas() -> bool:
-    import os
+    # Delegates to the shared dispatch helper in ops/__init__ (one env-flag
+    # contract for flash, paged, and LoRA kernels). Kept under its old name:
+    # fused_adamw and the TPU suite import it from here.
+    from . import use_pallas
 
-    return (jax.default_backend() in ("tpu", "axon")
-            or os.environ.get("PT_FLASH_INTERPRET") == "1")
+    return use_pallas()
 
 
 def _interpret() -> bool:
-    import os
+    from . import pallas_interpret
 
-    return os.environ.get("PT_FLASH_INTERPRET") == "1"
+    return pallas_interpret()
 
 
 def _vma_of(*arrays):

@@ -19,8 +19,16 @@ Layout is chosen Pallas-ready, mirroring the flash kernels in
   BlockSpec ``index_map`` (the kernel grid walks ``table[i]`` instead of
   ``i``, which is the whole trick of paged attention);
 - the decode gather and the chunk scatter below are the pure-jnp
-  REFERENCE path: CPU tier-1 runs it, and a future Pallas kernel must
-  match it bit-for-bit on the masked region.
+  REFERENCE path: CPU tier-1 runs it bit-for-bit.
+
+The real kernels live in ``paged_attention_pallas.py``: one flash-style
+online-softmax kernel covering decode (W=1), speculative verify
+(W=tick_window) and chunked prefill (B=1), fp and int8-fused-dequant. The
+public attention functions below dispatch to them under the shared
+``ops.use_pallas()`` contract (TPU backend, ``PT_FLASH_INTERPRET=1``, or
+``ops.set_kernel_mode("pallas")``) and otherwise run the jnp reference
+via one parameterized ``_attention_core`` — a single seam instead of six
+twins.
 
 All masks/softmax run in fp32 with the same ``-1e30`` fill as the dense
 decode path (``models/llama.py LlamaAttention.decode``) so greedy outputs
@@ -98,6 +106,60 @@ def write_chunk_kv(k_pool, v_pool, k, v, block_table, start):
     return k_pool, v_pool
 
 
+def _attention_core(q, ck, cv, qpos, ksl=None, vsl=None):
+    """The ONE parameterized jnp attention skeleton behind all six public
+    attention entry points — grouped GQA einsum, fp32 scores, positional
+    causal mask, fp32 softmax. ``qpos`` is the (B, S) absolute position of
+    every query row/token; fused int8 dequant engages when the per-token
+    ``ksl``/``vsl`` scale views (B, L, KV) are given — k's scale multiplies
+    the fp32 QK accumulator ((q·k_q)·s == q·(k_q·s), per-kv-head scales
+    commute with the D-contraction), v's scale folds into p before the V
+    accumulation (p·(v_q·s) == (p·s)·v_q), so a dequantized pool is never
+    materialized. Bit-identical to the pre-dedupe twins."""
+    B, S, H, D = q.shape
+    KV = ck.shape[2]
+    rep = H // KV
+    L = ck.shape[1]
+    qg = q.reshape(B, S, KV, rep, D)
+    quantized = ksl is not None
+    ckc = ck.astype(q.dtype) if quantized else ck
+    cvc = cv.astype(q.dtype) if quantized else cv
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ckc).astype(jnp.float32)
+    if quantized:
+        scores = scores * jnp.transpose(ksl, (0, 2, 1))[:, :, None, None, :] \
+            / math.sqrt(D)
+    else:
+        scores = scores / math.sqrt(D)
+    mask = (jnp.arange(L)[None, None, :] <=
+            qpos[:, :, None])[:, None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, -1).astype(q.dtype)
+    if quantized:
+        p = p * jnp.transpose(vsl, (0, 2, 1))[:, :, None, None, :].astype(
+            p.dtype)
+    out = jnp.einsum("bgrst,btgd->bsgrd", p, cvc)
+    return out.reshape(B, S, H, D)
+
+
+def _try_pallas(q, k_pool, v_pool, tables, pos, ks=None, vs=None):
+    """Trace-time kernel dispatch: returns the Pallas result when the
+    shared ``use_pallas()`` contract says so and the shapes compile, else
+    None (caller runs the jnp reference). NotImplementedError is the
+    kernels' unaligned-shape signal."""
+    from . import use_pallas
+
+    if not use_pallas():
+        return None
+    from . import paged_attention_pallas as pk
+
+    try:
+        if ks is None:
+            return pk.paged_attention(q, k_pool, v_pool, tables, pos)
+        return pk.paged_attention_q(q, k_pool, ks, v_pool, vs, tables, pos)
+    except NotImplementedError:
+        return None
+
+
 def paged_verify_attention(q, k_pool, v_pool, block_tables, pos):
     """Multi-token verify attention through block tables (GQA-native) —
     the decode window generalized from 1 to W positions.
@@ -109,29 +171,21 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables, pos):
     query j attends context positions ``<= pos[b] + j`` — earlier window
     tokens are visible, later ones (and any stale rejected K/V beyond the
     window) are not. W = 1 reduces exactly to single-token decode.
-    Pure-jnp reference, block-major and Pallas-ready (the block table is
-    the scalar-prefetch arg of a future kernel); scratch-block-0 masking
-    is preserved — zeroed table rows write and read only scratch. Same
-    grouped einsum / fp32-softmax as the dense ``LlamaAttention.decode``
-    vector-pos path so greedy speculative output is token-exact vs the
-    dense server.
+    Dispatches to the Pallas kernel (``use_pallas()``); the jnp reference
+    keeps scratch-block-0 masking — zeroed table rows write and read only
+    scratch — and the same grouped einsum / fp32-softmax as the dense
+    ``LlamaAttention.decode`` vector-pos path so greedy speculative output
+    is token-exact vs the dense server.
     """
-    B, W, H, D = q.shape
-    KV = k_pool.shape[2]
-    rep = H // KV
-    ck = gather_block_kv(k_pool, block_tables)    # (B, L, KV, D)
-    cv = gather_block_kv(v_pool, block_tables)
-    L = ck.shape[1]
-    qg = q.reshape(B, W, KV, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck).astype(
-        jnp.float32) / math.sqrt(D)
+    W = q.shape[1]
+    bt = block_tables if block_tables.ndim == 2 else block_tables[None]
+    out = _try_pallas(q, k_pool, v_pool, bt, pos)
+    if out is not None:
+        return out
+    ck = gather_block_kv(k_pool, bt)              # (B, L, KV, D)
+    cv = gather_block_kv(v_pool, bt)
     qpos = pos[:, None] + jnp.arange(W)[None, :]  # (B, W)
-    mask = (jnp.arange(L)[None, None, :] <=
-            qpos[:, :, None])[:, None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
-    p = jax.nn.softmax(scores, -1).astype(q.dtype)
-    out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
-    return out.reshape(B, W, H, D)
+    return _attention_core(q, ck, cv, qpos)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, pos):
@@ -151,22 +205,18 @@ def paged_prefill_attention(q, k_pool, v_pool, block_table, start):
     scattered into the pool (``write_chunk_kv``). Key positions beyond a
     query's position are masked, so right-pad garbage in the final chunk
     and unallocated (scratch) table entries never reach a real query.
+    Prefill is the verify kernel at B=1, W=C, pos=[start].
     """
-    B, C, H, D = q.shape
-    KV = k_pool.shape[2]
-    rep = H // KV
-    ck = gather_block_kv(k_pool, block_table)     # (1, L, KV, D)
-    cv = gather_block_kv(v_pool, block_table)
-    L = ck.shape[1]
-    qg = q.reshape(B, C, KV, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, ck).astype(
-        jnp.float32) / math.sqrt(D)
-    qpos = start + jnp.arange(C)                  # (C,)
-    mask = (jnp.arange(L)[None, :] <= qpos[:, None])[None, None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
-    p = jax.nn.softmax(scores, -1).astype(q.dtype)
-    out = jnp.einsum("bgrst,btgd->bsgrd", p, cv)
-    return out.reshape(B, C, H, D)
+    C = q.shape[1]
+    bt = block_table if block_table.ndim == 2 else block_table[None]
+    start_v = jnp.full((1,), start, jnp.int32)
+    out = _try_pallas(q, k_pool, v_pool, bt, start_v)
+    if out is not None:
+        return out
+    ck = gather_block_kv(k_pool, bt)              # (1, L, KV, D)
+    cv = gather_block_kv(v_pool, bt)
+    qpos = (start + jnp.arange(C))[None, :]       # (1, C)
+    return _attention_core(q, ck, cv, qpos)
 
 
 # --------------------------------------------------------------------------- #
@@ -274,35 +324,21 @@ def gather_block_scales(scales, block_tables, block_size):
 def paged_verify_attention_q(q, kq, ks, vq, vs, block_tables, pos):
     """Fused-dequant twin of :func:`paged_verify_attention`: attention
     reads int8 K/V codes and applies the per-block-per-head scales INSIDE
-    the program — k's scale multiplies the fp32 QK accumulator
-    ((q·k_q)·s == q·(k_q·s), scales are per kv head so they commute with
-    the D-contraction), v's scale folds into p before the V accumulation
-    (p·(v_q·s) == (p·s)·v_q, per-head scales commute with the
-    L-contraction) — never materializing a dequantized pool. Masking /
-    softmax semantics are identical to the fp twin."""
-    B, W, H, D = q.shape
-    KV = kq.shape[2]
+    the program (see :func:`_attention_core`) — never materializing a
+    dequantized pool. Masking / softmax semantics are identical to the fp
+    twin. The Pallas kernel applies the same scales on the VMEM tile."""
+    W = q.shape[1]
     bs = kq.shape[1]
-    rep = H // KV
-    ckq = gather_block_kv(kq, block_tables)       # (B, L, KV, D) int8
-    cvq = gather_block_kv(vq, block_tables)
-    ksl = gather_block_scales(ks, block_tables, bs)   # (B, L, KV) f32
-    vsl = gather_block_scales(vs, block_tables, bs)
-    L = ckq.shape[1]
-    qg = q.reshape(B, W, KV, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg,
-                        ckq.astype(q.dtype)).astype(jnp.float32)
-    scores = scores * jnp.transpose(ksl, (0, 2, 1))[:, :, None, None, :] \
-        / math.sqrt(D)
+    bt = block_tables if block_tables.ndim == 2 else block_tables[None]
+    out = _try_pallas(q, kq, vq, bt, pos, ks=ks, vs=vs)
+    if out is not None:
+        return out
+    ckq = gather_block_kv(kq, bt)                 # (B, L, KV, D) int8
+    cvq = gather_block_kv(vq, bt)
+    ksl = gather_block_scales(ks, bt, bs)         # (B, L, KV) f32
+    vsl = gather_block_scales(vs, bt, bs)
     qpos = pos[:, None] + jnp.arange(W)[None, :]  # (B, W)
-    mask = (jnp.arange(L)[None, None, :] <=
-            qpos[:, :, None])[:, None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
-    p = jax.nn.softmax(scores, -1).astype(q.dtype)
-    pv = p * jnp.transpose(vsl, (0, 2, 1))[:, :, None, None, :].astype(
-        p.dtype)
-    out = jnp.einsum("bgrst,btgd->bsgrd", pv, cvq.astype(q.dtype))
-    return out.reshape(B, W, H, D)
+    return _attention_core(q, ckq, cvq, qpos, ksl=ksl, vsl=vsl)
 
 
 def paged_decode_attention_q(q, kq, ks, vq, vs, block_tables, pos):
@@ -313,26 +349,18 @@ def paged_decode_attention_q(q, kq, ks, vq, vs, block_tables, pos):
 
 def paged_prefill_attention_q(q, kq, ks, vq, vs, block_table, start):
     """Fused-dequant twin of :func:`paged_prefill_attention` — one prefill
-    chunk of queries against the quantized paged context."""
-    B, C, H, D = q.shape
-    KV = kq.shape[2]
+    chunk of queries against the quantized paged context (the verify
+    kernel at B=1, W=C, pos=[start])."""
+    C = q.shape[1]
     bs = kq.shape[1]
-    rep = H // KV
-    ckq = gather_block_kv(kq, block_table)        # (1, L, KV, D) int8
-    cvq = gather_block_kv(vq, block_table)
-    ksl = gather_block_scales(ks, block_table, bs)    # (1, L, KV) f32
-    vsl = gather_block_scales(vs, block_table, bs)
-    L = ckq.shape[1]
-    qg = q.reshape(B, C, KV, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg,
-                        ckq.astype(q.dtype)).astype(jnp.float32)
-    scores = scores * jnp.transpose(ksl, (0, 2, 1))[:, :, None, None, :] \
-        / math.sqrt(D)
-    qpos = start + jnp.arange(C)                  # (C,)
-    mask = (jnp.arange(L)[None, :] <= qpos[:, None])[None, None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
-    p = jax.nn.softmax(scores, -1).astype(q.dtype)
-    pv = p * jnp.transpose(vsl, (0, 2, 1))[:, :, None, None, :].astype(
-        p.dtype)
-    out = jnp.einsum("bgrst,btgd->bsgrd", pv, cvq.astype(q.dtype))
-    return out.reshape(B, C, H, D)
+    bt = block_table if block_table.ndim == 2 else block_table[None]
+    start_v = jnp.full((1,), start, jnp.int32)
+    out = _try_pallas(q, kq, vq, bt, start_v, ks=ks, vs=vs)
+    if out is not None:
+        return out
+    ckq = gather_block_kv(kq, bt)                 # (1, L, KV, D) int8
+    cvq = gather_block_kv(vq, bt)
+    ksl = gather_block_scales(ks, bt, bs)         # (1, L, KV) f32
+    vsl = gather_block_scales(vs, bt, bs)
+    qpos = (start + jnp.arange(C))[None, :]       # (1, C)
+    return _attention_core(q, ckq, cvq, qpos, ksl=ksl, vsl=vsl)

@@ -1,0 +1,231 @@
+"""Pallas TPU kernels for the paged serving hot path.
+
+One flash-style online-softmax kernel serves decode (W=1), speculative
+verify (W=tick_window), and chunked prefill (B=1, W=chunk): the grid is
+(batch, kv_head, kv_block) and the K/V ``BlockSpec`` index_map reads the
+block table through ``PrefetchScalarGridSpec`` scalar-prefetch —
+``tbl[b, m]`` picks the pool block to stream into VMEM, so the dense
+``gather_block_kv`` copy of the context never materializes in HBM. Running
+max/sum/accumulator live in VMEM scratch across the block axis;
+``pl.when`` skips blocks past each row's causal frontier, which also
+covers the all-zero scratch-block entries of short sequences. The int8
+twin streams the code pool directly and applies the per-(block, kv-head)
+scales on the VMEM tile — k-scale on the fp32 QK accumulator, v-scale
+folded into the probabilities before PV — so a dequantized pool is never
+built. ``fused_lora_matmul`` fuses the per-slot BGMV adapter delta
+(gathered A/B/scale factors) into the base projection matmul, one program
+per batch row.
+
+The jnp compositions in ``ops/paged_attention.py`` remain the bit-exact
+references; dispatch between them and these kernels follows the shared
+``ops.use_pallas()`` / ``ops.pallas_interpret()`` contract (TPU backend,
+``PT_FLASH_INTERPRET=1``, or ``set_kernel_mode``). The online softmax is
+numerically equivalent but not bit-identical to the reference's two-pass
+softmax (~1e-6 relative); greedy decode tokens are identical, which is
+what the serving tests pin.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+
+def _interpret() -> bool:
+    from . import pallas_interpret
+
+    return pallas_interpret()
+
+
+def _lanes(x):
+    """Broadcast a (rows,) vector across the 128-lane minor dim so the
+    running max/sum scratch keeps a TPU-native (rows, 128) layout."""
+    return jnp.broadcast_to(x[:, None], (x.shape[0], 128))
+
+
+def _check_tpu_shapes(bs: int, D: int) -> None:
+    """Alignment the Mosaic compiler needs on real hardware; interpret mode
+    takes any shape. Callers catch and fall back to the jnp reference."""
+    if _interpret():
+        return
+    if D % 128 != 0:
+        raise NotImplementedError(f"head_dim {D} not lane-aligned (128)")
+    if bs % 8 != 0:
+        raise NotImplementedError(f"block_size {bs} not sublane-aligned (8)")
+
+
+# ------------------------------------------------------------------ attention
+def _attn_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, W, rep, M,
+                 quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    m = pl.program_id(2)
+
+    @pl.when(m == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Skip blocks entirely past the last query row's causal frontier — this
+    # also covers block-table tail entries that still point at scratch
+    # block 0.
+    needed = m * bs <= pos_ref[b] + (W - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]                       # (W*rep, D)
+        k = k_ref[0, :, 0, :]                 # (bs, D)
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if quantized:
+            # reference order: scores * k_scale, then / sqrt(D)
+            s = s * ks_ref[0, 0]
+        s = s / jnp.float32(math.sqrt(q.shape[-1]))
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qpos = pos_ref[b] + rows // rep       # row -> absolute query position
+        s = jnp.where(m * bs + cols <= qpos, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = _lanes(l_prev * alpha + jnp.sum(p, axis=-1))
+        if quantized:
+            p = p * vs_ref[0, 0]              # fold v scale into probs
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = _lanes(m_new)
+
+    @pl.when(m == M - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _paged_attention_call(q, k_pool, v_pool, tables, pos, k_scales=None,
+                          v_scales=None):
+    B, W, H, D = q.shape
+    N, bs, KV, _ = k_pool.shape
+    rep = H // KV
+    M = tables.shape[1]
+    Wr = W * rep
+    _check_tpu_shapes(bs, D)
+    quantized = k_scales is not None
+    # GQA: group query heads with their shared kv head so one kernel
+    # instance covers the whole group — (B, KV, W*rep, D).
+    qt = q.reshape(B, W, KV, rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, KV, Wr, D)
+    kv_spec = pl.BlockSpec((1, bs, 1, D),
+                           lambda b, g, m, tbl, ps: (tbl[b, m], 0, g, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, Wr, D), lambda b, g, m, tbl, ps: (b, g, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    args = [tables.astype(jnp.int32), pos.astype(jnp.int32), qt, k_pool,
+            v_pool]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, 1), lambda b, g, m, tbl, ps: (tbl[b, m], g))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Wr, D),
+                               lambda b, g, m, tbl, ps: (b, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Wr, 128), jnp.float32),   # running max
+            pltpu.VMEM((Wr, 128), jnp.float32),   # running sum
+            pltpu.VMEM((Wr, D), jnp.float32),     # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, bs=bs, W=W, rep=rep, M=M,
+                          quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Wr, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return out.reshape(B, KV, W, rep, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, W, H, D)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, pos):
+    """Fused paged decode/verify attention over an fp block pool.
+
+    q: (B, W, H, D) — W=1 decode, W=tick_window verify, W=chunk prefill.
+    pos: (B,) int — absolute position of each row's FIRST query token.
+    """
+    return _paged_attention_call(q, k_pool, v_pool, block_tables, pos)
+
+
+def paged_attention_q(q, kq_pool, k_scales, vq_pool, v_scales, block_tables,
+                      pos):
+    """Int8 twin: streams the code pool and dequantizes on the VMEM tile."""
+    return _paged_attention_call(q, kq_pool, vq_pool, block_tables, pos,
+                                 k_scales=k_scales, v_scales=v_scales)
+
+
+# ----------------------------------------------------------------- LoRA BGMV
+def _lora_kernel(x_ref, w_ref, a_ref, b_ref, s_ref, o_ref):
+    x = x_ref[0]                               # (S, in)
+    y = jax.lax.dot_general(x, w_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    xa = jax.lax.dot_general(x.astype(jnp.float32), a_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    d = jax.lax.dot_general(xa, b_ref[0], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = (y + d * s_ref[0, 0]).astype(o_ref.dtype)
+
+
+def fused_lora_matmul(x, w, a, b, s):
+    """Base projection + per-row LoRA delta in one program per batch row:
+    ``x @ w + ((x32 @ a[i]) @ b[i]) * s[i]``. The factors are the per-slot
+    gathers from AdapterPool.gather_rows — a (B, in, R), b (B, R, out),
+    s (B,); null adapters arrive as zero factors with s=0, making the delta
+    exactly zero (bit-identical to the plain matmul)."""
+    B, S, IN = x.shape
+    OUT = w.shape[1]
+    R = a.shape[2]
+    if not _interpret() and (IN % 128 or OUT % 128):
+        raise NotImplementedError("projection dims not lane-aligned")
+    return pl.pallas_call(
+        _lora_kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, S, IN), lambda i: (i, 0, 0)),
+            pl.BlockSpec((IN, OUT), lambda i: (0, 0)),
+            pl.BlockSpec((1, IN, R), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, R, OUT), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, OUT), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, OUT), x.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_interpret(),
+    )(x, w, a, b, s.reshape(B, 1).astype(jnp.float32))

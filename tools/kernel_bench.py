@@ -1,0 +1,193 @@
+"""Per-op microbenchmark: reference jnp paged attention vs the Pallas
+kernels (ops/paged_attention_pallas.py), decode / verify / prefill, fp and
+int8, across (B, M, bs) shapes.
+
+Each combo times BOTH dispatch paths on identical inputs, checks parity
+(max abs diff — the online softmax is ~1e-6 off the two-pass reference),
+and reports tokens/s plus the speedup. On a TPU backend the Pallas numbers
+are the real Mosaic kernels; elsewhere they run in interpret mode (slower
+than the reference — the point there is parity and plumbing, not speed,
+which is why the suite's perf gate only reads the speedup on hardware).
+
+Usage:
+    python tools/kernel_bench.py [--json] [--iters 10]
+        [--shapes 2,4,8;4,8,16] [--window 4] [--heads 8] [--kv-heads 2]
+        [--head-dim 128] [--ops decode,verify,prefill] [--quant fp,int8]
+
+One JSON line per (op, quant, B, M, bs) combo under --json (bench.py
+style); a human table otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_shapes(spec):
+    out = []
+    for part in spec.split(";"):
+        b, m, bs = (int(x) for x in part.split(","))
+        out.append((b, m, bs))
+    return out
+
+
+def make_inputs(rng, jnp, B, M, bs, H, KV, D, W, quant):
+    """Block pools + tables + pos with realistic structure: partial final
+    blocks (pos mid-block), scratch block 0 on table tails."""
+    import numpy as np
+
+    N = max(B * M + 1, 2)
+    pos = np.minimum(M * bs - W, np.maximum(
+        0, rng.randint(bs // 2, M * bs - W + 1, (B,)))).astype(np.int32)
+    tables = np.zeros((B, M), np.int32)
+    free = rng.permutation(np.arange(1, N))
+    took = 0
+    for b in range(B):
+        nblk = (pos[b] + W - 1) // bs + 1
+        tables[b, :nblk] = free[took:took + nblk]
+        took += nblk
+    q = jnp.asarray(rng.randn(B, W, H, D).astype(np.float32))
+    kv = rng.randn(2, N, bs, KV, D).astype(np.float32)
+    tables = jnp.asarray(tables)
+    pos = jnp.asarray(pos)
+    if quant == "int8":
+        from paddle_tpu.ops.paged_attention import quantize_block_kv
+
+        kq, ks = quantize_block_kv(jnp.asarray(kv[0]))
+        vq, vs = quantize_block_kv(jnp.asarray(kv[1]))
+        return q, (kq, ks, vq, vs), tables, pos
+    return q, (jnp.asarray(kv[0]), jnp.asarray(kv[1])), tables, pos
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="2,4,8;4,8,16;8,16,16",
+                    help="semicolon list of B,M,bs (batch, table width, "
+                         "block size)")
+    ap.add_argument("--window", type=int, default=4,
+                    help="verify window W (decode is W=1; prefill chunk is "
+                         "2 blocks)")
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--ops", default="decode,verify,prefill")
+    ap.add_argument("--quant", default="fp,int8")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import ops
+    from paddle_tpu.ops import paged_attention as pa
+    from paddle_tpu.utils.bench_timing import tpu_lock
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+
+    def timed(fn, fn_args):
+        # fresh lambda: jax's tracing cache is keyed on function identity,
+        # so re-jitting `fn` itself after a kernel-mode flip would silently
+        # reuse the other mode's jaxpr
+        jf = jax.jit(lambda *a: fn(*a))
+        out = jf(*fn_args)
+        out.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = jf(*fn_args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / args.iters, out
+
+    rows = []
+    with tpu_lock(timeout_s=900.0) as locked:
+        for B, M, bs in parse_shapes(args.shapes):
+            for quant in args.quant.split(","):
+                rng = np.random.RandomState(0)
+                for op in args.ops.split(","):
+                    W = {"decode": 1, "verify": args.window,
+                         "prefill": 2 * bs}[op]
+                    if op == "prefill":
+                        # prefill is the verify kernel at B=1, W=chunk
+                        q, pools, tables, pos = make_inputs(
+                            rng, jnp, 1, M, bs, args.heads, args.kv_heads,
+                            args.head_dim, W, quant)
+                        tbl, start = tables[0], int(pos[0]) // bs * bs
+                        if quant == "int8":
+                            fn = lambda qq, kq, ks, vq, vs, t: \
+                                pa.paged_prefill_attention_q(
+                                    qq, kq, ks, vq, vs, t, start)
+                        else:
+                            fn = lambda qq, kp, vp, t: \
+                                pa.paged_prefill_attention(
+                                    qq, kp, vp, t, start)
+                        fn_args = (q, *pools, tbl)
+                        tok = W
+                    else:
+                        q, pools, tables, pos = make_inputs(
+                            rng, jnp, B, M, bs, args.heads, args.kv_heads,
+                            args.head_dim, W, quant)
+                        fn = (pa.paged_verify_attention_q if quant == "int8"
+                              else pa.paged_verify_attention)
+                        fn_args = (q, *pools, tables, pos)
+                        tok = B * W
+                    mode = ops.kernel_mode()
+                    try:
+                        ops.set_kernel_mode("reference")
+                        ref_s, ref_out = timed(fn, fn_args)
+                        ops.set_kernel_mode("pallas")
+                        pal_s, pal_out = timed(fn, fn_args)
+                    finally:
+                        ops.set_kernel_mode(mode)
+                    diff = float(jnp.max(jnp.abs(
+                        ref_out.astype(jnp.float32) -
+                        pal_out.astype(jnp.float32))))
+                    rows.append({
+                        "metric": f"paged_{op}_kernel_tok_s",
+                        "op": op, "quant": quant,
+                        "B": B, "M": M, "bs": bs, "W": W,
+                        "heads": args.heads, "kv_heads": args.kv_heads,
+                        "head_dim": args.head_dim,
+                        "backend": backend,
+                        "pallas_mode": "mosaic" if on_tpu else "interpret",
+                        "ref_tok_s": round(tok / ref_s, 1),
+                        "pallas_tok_s": round(tok / pal_s, 1),
+                        "speedup": round(ref_s / pal_s, 3),
+                        "max_abs_diff": diff,
+                        "parity": diff < 2e-5,
+                    })
+        if not locked:
+            for r in rows:
+                r["lock_contended"] = True
+
+    ok = all(r["parity"] for r in rows)
+    if args.json:
+        for r in rows:
+            print(json.dumps(r))
+    else:
+        hdr = (f"{'op':8} {'quant':5} {'B':>3} {'M':>3} {'bs':>3} "
+               f"{'ref tok/s':>12} {'pallas tok/s':>13} {'speedup':>8} "
+               f"{'max|diff|':>10}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(f"{r['op']:8} {r['quant']:5} {r['B']:>3} {r['M']:>3} "
+                  f"{r['bs']:>3} {r['ref_tok_s']:>12} "
+                  f"{r['pallas_tok_s']:>13} {r['speedup']:>8} "
+                  f"{r['max_abs_diff']:>10.2e}")
+        print(f"\nbackend={backend} "
+              f"({'mosaic' if on_tpu else 'interpret'} pallas), "
+              f"parity={'OK' if ok else 'FAIL'}")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

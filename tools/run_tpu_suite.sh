@@ -256,6 +256,42 @@ assert r["watchdog_after_recovery"] == 0, \
 assert len(r["replicas"]) == 2, "per-replica rows missing"
 PY
 
+echo "== 7g. Pallas serving-kernel gate (parity + mega-kernel tok/s vs jnp reference) =="
+# interpret-mode parity first: same kernels the TPU runs, executed on the
+# host interpreter — catches masking/dequant/LoRA-fusion bugs cheaply
+JAX_PLATFORMS=cpu python -m pytest tests/test_paged_pallas.py -q \
+  || { echo "kernel parity suite FAILED (Pallas diverged from the jnp"\
+       "reference in interpret mode)"; exit 1; }
+python tools/kernel_bench.py --json | tee /tmp/tpu_runs/kernel_bench.json \
+  || { echo "kernel bench FAILED (per-op parity above tolerance)"; exit 1; }
+python tools/serving_benchmark.py --paged --kv-quant int8 --kernels pallas \
+  --guard-recompiles --requests 16 --slots 4 --max-new 32 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_pallas.json \
+  || { echo "pallas serving gate FAILED (recompile budget or tick"\
+       "divergence with kernels=pallas)"; exit 1; }
+python - <<'PY'
+# kernel gate: every (op, quant, shape) combo must hold parity with the
+# jnp reference; on real hardware the Mosaic kernels must also beat the
+# gather-based reference per op AND end-to-end (kernel_tok_s from the
+# serving line) — in interpret mode the speedup clause is skipped, the
+# kernels run thousands of times slower by design
+import json
+rows = [json.loads(l) for l in open("/tmp/tpu_runs/kernel_bench.json")]
+srv = json.load(open("/tmp/tpu_runs/serving_pallas.json"))
+on_tpu = rows[0]["backend"] in ("tpu", "axon")
+assert rows and all(r["parity"] for r in rows), "kernel parity FAILED"
+assert srv.get("kernels") == "pallas" and "kernel_tok_s" in srv, srv
+print(f"{len(rows)} kernel combos parity-clean "
+      f"({rows[0]['pallas_mode']} mode); serving kernel "
+      f"{srv['kernel_tok_s']} vs ref {srv['kernel_ref_tok_s']} tok/s, "
+      f"dispatch {srv['kernel_dispatch_us']}us")
+if on_tpu:
+    slow = [r for r in rows if r["speedup"] < 1.0]
+    assert not slow, f"Mosaic kernels slower than reference: {slow}"
+    assert srv["kernel_tok_s"] >= srv["kernel_ref_tok_s"], \
+        "fused decode attention lost to the gather reference on TPU"
+PY
+
 echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="
 python tools/train_chaos.py --steps 12 --kills 2 --seed 3 --json 2>/dev/null \
   | tee /tmp/tpu_runs/train_chaos.json \

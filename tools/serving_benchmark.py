@@ -105,6 +105,66 @@ def config_fingerprint(args) -> str:
     ).hexdigest()[:12]
 
 
+def kernel_microbench(server, cfg, args, iters: int = 10):
+    """Per-op dispatch timing of the paged decode-attention program at the
+    SERVER'S shapes (slots, table width, block size, kv heads) — the
+    kernel-level tok/s figure behind the end-to-end line. Times the active
+    dispatch (``kernel_tok_s`` / ``kernel_dispatch_us``) and the pinned jnp
+    reference (``kernel_ref_tok_s``) so the win is measured, not asserted.
+    Runs AFTER the measured drain — it jits two fresh closures and must not
+    count against the steady-state recompile guard."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import ops
+    from paddle_tpu.framework.dtype import convert_dtype
+    from paddle_tpu.ops import paged_attention as pa
+
+    B = args.slots
+    bs = args.block_size
+    H = cfg.num_attention_heads
+    KV = cfg.num_key_value_heads
+    D = cfg.hidden_size // H
+    M = server._table_width
+    N = server.alloc.num_blocks
+    dt = convert_dtype(cfg.dtype)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, 1, H, D), dt)
+    tables = jnp.asarray(
+        rng.randint(1, max(N, 2), (B, M)).astype(np.int32))
+    pos = jnp.full((B,), min(args.max_len - 1, M * bs - 1), jnp.int32)
+    if args.kv_quant == "int8":
+        kq = jnp.asarray(rng.randint(-127, 128, (N, bs, KV, D)), jnp.int8)
+        ks = jnp.asarray(np.abs(rng.randn(N, KV)).astype(np.float32))
+        op_args = (q, kq, ks, kq, ks, tables, pos)
+        op = pa.paged_decode_attention_q
+    else:
+        kp = jnp.asarray(rng.randn(N, bs, KV, D), dt)
+        op_args = (q, kp, kp, tables, pos)
+        op = pa.paged_decode_attention
+
+    def timed(fn):
+        jf = jax.jit(fn)
+        jf(*op_args)[0].block_until_ready()        # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jf(*op_args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    mode = ops.kernel_mode()
+    try:
+        active_s = timed(lambda *a: op(*a))
+        ops.set_kernel_mode("reference")
+        ref_s = timed(lambda *a: op(*a))
+    finally:
+        ops.set_kernel_mode(mode)
+    return {"kernel_tok_s": round(B / active_s, 1),
+            "kernel_ref_tok_s": round(B / ref_s, 1),
+            "kernel_dispatch_us": round(active_s * 1e6, 1)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=48)
@@ -162,6 +222,13 @@ def main():
                     help="adapter-pool pages = max concurrently-resident "
                          "adapters (default min(N, slots)); N > M forces "
                          "LRU eviction + re-upload churn")
+    ap.add_argument("--kernels", choices=("auto", "pallas", "reference"),
+                    default="auto",
+                    help="attention/projection kernel dispatch for the "
+                         "compiled serving programs: auto = Pallas on TPU / "
+                         "jnp reference elsewhere, pallas = force the "
+                         "Pallas kernels (interpret mode off-TPU), "
+                         "reference = pin the jnp compositions")
     ap.add_argument("--guard-recompiles", action="store_true",
                     help="wrap the measured drain in jit_cache_guard: any "
                          "steady-state recompile after warmup fails the "
@@ -453,7 +520,8 @@ def main():
                 policy=sched if sched is not None else args.scheduler,
                 host_pool_bytes=host_pool,
                 lora=lora_cfg, faults=faults,
-                telemetry=bool(args.telemetry_out) or args.strict)
+                telemetry=bool(args.telemetry_out) or args.strict,
+                kernels=args.kernels)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -462,7 +530,8 @@ def main():
                                 tick_window=args.tick_window,
                                 policy=args.scheduler,
                                 telemetry=bool(args.telemetry_out)
-                                or args.strict)
+                                or args.strict,
+                                kernels=args.kernels)
 
     def run_pass(server, chaos_inj=None, allowed_compiles=0):
         """Warmup + the measured drain against the seeded traffic.
@@ -792,6 +861,8 @@ def main():
         line["kv_bytes_per_token"] = round(
             stats["bytes_per_block"] / stats["block_size"], 2)
         line["kv_pool_bytes"] = stats["bytes_per_block"] * stats["num_blocks"]
+        line["kernels"] = args.kernels
+        line.update(kernel_microbench(server, cfg, args))
     if args.lora_adapters:
         am = server.sched_metrics()
         line["lora_adapters"] = args.lora_adapters
