@@ -70,4 +70,4 @@ def contains_value_attr(node: ast.AST) -> bool:
 
 # registration side effects
 from . import (atomic_io, control_flow, faults, host_sync,  # noqa: E402,F401
-               jit_hygiene, purity, telemetry, timing)
+               jit_hygiene, purity, telemetry, timing, transfers)

@@ -1,0 +1,64 @@
+"""Transfer hygiene: no bare device transfers inside the serving stack.
+
+The multi-chip serving engine keeps device placement in exactly three
+sanctioned seams: construction-time sharding (``parallel/serving_mesh.py``
+places params/pools/LoRA pages with mesh-aware ``NamedSharding``), the
+fixed-width host-gather path (``kv_offload.py``'s pinned payload capture),
+and the CRC-verified migration admit. A bare ``jax.device_put`` inside
+``paddle_tpu/inference/`` silently REPLACES a tensor's sharding with
+single-device placement — on a tp mesh that un-shards a pool (tripling
+HBM and breaking the per-shard capacity math) without any error; a bare
+``jax.device_get`` is an unaccounted full-width D2H sync that dodges the
+offload engine's pinning/byte accounting. Both belong behind the seams,
+not inline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, ModuleContext, Rule, register
+from . import attr_chain
+
+
+@register
+class BareTransferInServingRule(Rule):
+    """GL014: bare ``jax.device_put``/``jax.device_get`` inside
+    ``paddle_tpu/inference/``. Placement belongs to the mesh-aware
+    helpers in ``parallel/serving_mesh.py`` (which carry the tp
+    ``NamedSharding``) and host transfers to the offload engine's
+    accounted gather path; an inline transfer un-shards pools or dodges
+    byte accounting silently."""
+
+    id = "GL014"
+    name = "bare-transfer-in-serving"
+    description = ("bare jax.device_put()/jax.device_get() calls inside "
+                   "paddle_tpu/inference/ bypass the mesh-aware placement "
+                   "seam (parallel/serving_mesh.py) and the offload "
+                   "engine's accounted host-gather path; on a tp mesh a "
+                   "bare device_put silently un-shards the tensor it "
+                   "places — route transfers through the sanctioned "
+                   "helpers instead")
+
+    _SCOPE = "paddle_tpu/inference/"
+
+    _TRANSFER_CALLS = frozenset({
+        "jax.device_put", "jax.device_get",
+        "jax.device_put_sharded", "jax.device_put_replicated",
+    })
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(self._SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain in self._TRANSFER_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{chain}() is a bare device transfer inside "
+                    f"inference/ — place through the mesh-aware helpers "
+                    f"in parallel/serving_mesh.py (sharding-preserving) "
+                    f"or the kv_offload gather path (byte-accounted) "
+                    f"instead")

@@ -188,6 +188,28 @@ class FaultPlan:
         return cls(specs=specs, seed=seed)
 
     @classmethod
+    def disagg_chaos(cls, seed: int, *, replicas: int = 2,
+                     prefill: int = 1, horizon: int = 24) -> "FaultPlan":
+        """A seeded plan for DISAGGREGATED fleets: kill one
+        PREFILL-class replica mid-chunk and corrupt one handoff payload.
+        The caller orders its replicas prefill-first, so a
+        ``replica_down`` ordinal of ``step * replicas + idx`` with
+        ``idx < prefill`` is guaranteed to land on the prefill class —
+        the generic :meth:`fleet_chaos` draw could hit a decode replica
+        instead, which tests a different (and for a 1+1 fleet,
+        unrecoverable-by-class) failure. Same seed → same plan."""
+        if not 1 <= prefill < replicas:
+            raise ValueError("disagg_chaos needs 1 <= prefill < replicas")
+        rng = np.random.RandomState(seed)  # graftlint: noqa[np-random]
+        kill_step = int(rng.randint(2, max(3, horizon // replicas)))
+        specs = [
+            FaultSpec("replica_down",
+                      at=kill_step * replicas + int(rng.randint(0, prefill))),
+            FaultSpec("migrate_payload", at=int(rng.randint(0, 2))),
+        ]
+        return cls(specs=specs, seed=seed)
+
+    @classmethod
     def train_chaos(cls, seed: int, *, horizon: int = 32,
                     intensity: int = 1, kills: int = 1) -> "FaultPlan":
         """A seeded training plan for the elastic chaos harness:

@@ -1,0 +1,340 @@
+"""Paged serving executor — the device half of the engine/executor split.
+
+``GenerationServer`` (serving.py) is the ENGINE: request lifecycle,
+scheduling, slot bookkeeping, preemption policy, harvest — all host-side
+numpy state. :class:`PagedExecutor` is everything that touches the
+accelerator: the KV block pools, the compiled programs (chunked prefill,
+decode window, both speculative verify paths), and — new in this layer —
+their placement onto a multi-chip ``tp`` mesh.
+
+The split is the roadmap's TP unlock: the engine's host loop is mesh-
+oblivious (block tables, positions, sampling params are tiny replicated
+arrays), so multi-chip serving is PURELY an executor concern. With
+``tp > 1`` the executor places params, KV pools, int8 scale rows, and the
+LoRA page pool onto a 1-D ``tp`` mesh (parallel/serving_mesh.py) and jits
+the very same program bodies — GSPMD slices the attention heads and MLP
+hidden dim and inserts the collectives, keeping each trip ONE compiled
+program (the XLA fusion argument from PAPERS.md). Per-shard pools share
+the engine's single host-side block table: every shard holds its kv-head
+slice of every block, so block ids, prefix hashes, swap payloads, and
+snapshots stay tp-agnostic.
+
+Compile discipline is unchanged: programs are keyed on shapes + the two
+static args (greedy, trip length); pool donation rotates buffers in
+place. The executor additionally guarantees donation never silently
+drops the tp layout (:meth:`shard_audit`, wired into
+``GenerationServer.assert_conserved``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..jit import functional_call
+
+__all__ = ["PagedExecutor"]
+
+
+class PagedExecutor:
+    """Owns the paged device state + compiled programs for one engine.
+
+    ``engine`` is the owning :class:`~.serving.GenerationServer`; the
+    executor reads its construction-time configuration (model, spec/LoRA
+    wiring, tick window) and nothing else — all mutable scheduling state
+    stays on the engine side. ``tp=1`` (or None) is the single-chip
+    executor, byte-for-byte the pre-split behavior.
+    """
+
+    def __init__(self, engine, num_blocks: int, tp: Optional[int] = None):
+        from ..framework.dtype import convert_dtype
+
+        self.engine = engine
+        cfg = engine.cfg
+        kv = cfg.num_key_value_heads
+        d = cfg.hidden_size // cfg.num_attention_heads
+        cdtype = convert_dtype(cfg.dtype)
+        bs = engine.block_size
+        kv_quant = engine.kv_quant
+        if kv_quant == "int8":
+            # per layer: K codes, K scales, V codes, V scales — the
+            # scale rows ride in the flat pool list so donation and
+            # in-place updates cover them too
+            self.pools: List[Any] = []
+            for _ in range(cfg.num_hidden_layers):
+                for _kv in range(2):
+                    self.pools.append(jnp.zeros(
+                        (int(num_blocks), bs, kv, d), jnp.int8))
+                    self.pools.append(jnp.zeros(
+                        (int(num_blocks), kv), jnp.float32))
+        else:
+            self.pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
+                          for _ in range(2 * cfg.num_hidden_layers)]
+        # tensors per layer entry in the flat pool list: fp (K, V) = 2;
+        # int8 (Kq, Kscale, Vq, Vscale) = 4
+        self.pool_stride = 4 if kv_quant == "int8" else 2
+
+        self.mesh = None
+        self.tp = 1
+        if tp is not None and int(tp) > 1:
+            from ..parallel import serving_mesh as sm
+
+            tp = int(tp)
+            sm.validate_tp(cfg, tp)
+            self.mesh = sm.build_serving_mesh(tp)
+            self.tp = tp
+            # construction-time placement is the ONLY transfer the tp
+            # path adds: params + pools commit to the mesh once, then
+            # every program's outputs inherit the layout via donation
+            engine.params = sm.place_params(engine.model, engine.params,
+                                            self.mesh)
+            self.pools = sm.place_pools(self.pools, self.mesh)
+            if engine._lora is not None:
+                lp = engine._lora
+                lp.place_device_tensors(
+                    lambda flat: sm.place_lora_flat(lp.targets, flat,
+                                                    self.mesh))
+
+        # ``greedy`` (the trailing static arg) specializes the program
+        # for all-temp-0 ticks: XLA folds the whole sampling pipeline
+        # (top-k/top-p filtering = per-row sorts over the vocab) down
+        # to one argmax — measured ~2.3ms/window at CPU bench shapes.
+        # At most two variants ever compile (greedy / mixed).
+        self.decode_paged = jax.jit(self._decode_paged_fn,
+                                    donate_argnums=(2,),
+                                    static_argnums=(12, 13))
+        self.chunk_prefill = jax.jit(self._chunk_prefill_fn,
+                                     donate_argnums=(2,))
+        self.spec_scan = None
+        self.spec_verify = None
+        if engine.spec is not None:
+            if engine._spec_fused:
+                self.spec_scan = jax.jit(self._spec_scan_fn,
+                                         donate_argnums=(2,),
+                                         static_argnums=(13, 14))
+            else:
+                self.spec_verify = jax.jit(self._spec_verify_fn,
+                                           donate_argnums=(3,),
+                                           static_argnums=(14,))
+
+    # ----------------------------------------------------------- mesh state
+    @property
+    def mesh_fingerprint(self) -> str:
+        from ..parallel import serving_mesh as sm
+
+        return sm.mesh_fingerprint(self.mesh)
+
+    def shard_audit(self) -> Dict[str, int]:
+        """Verify the pools still carry their tp layout (donation must
+        rotate buffers, never reshard them) — {} on a single-chip
+        executor. Raises AssertionError on a lost sharding."""
+        if self.mesh is None:
+            return {}
+        from ..parallel import serving_mesh as sm
+
+        return sm.audit_pool_shardings(self.pools, self.mesh)
+
+    # ------------------------------------------------------------ pool views
+    def _pool_views(self, flat_p):
+        """Group the flat per-layer pool list back into per-layer tuples:
+        fp → (K, V); int8 → (Kq, Kscale, Vq, Vscale). The model's paged
+        methods branch on the tuple arity, so the same compiled-fn bodies
+        serve both pool formats."""
+        st = self.pool_stride
+        return [tuple(Tensor(flat_p[st * i + j]) for j in range(st))
+                for i in range(self.engine.cfg.num_hidden_layers)]
+
+    @staticmethod
+    def _flat_pools(new):
+        return [t.value for entry in new for t in entry]
+
+    def _gather_lora(self, lora_flat, aidx):
+        """Gather each row's adapter factors from the paged LoRA pool —
+        one batched take per stacked tensor, inside the compiled program.
+        ``lora_flat`` is empty when LoRA is off → None (the model's paged
+        methods skip the delta entirely)."""
+        if not lora_flat:
+            return None
+        return self.engine._lora.gather_rows(list(lora_flat), aidx)
+
+    # ------------------------------------------------------------- programs
+    def _decode_paged_fn(self, params, tokens, flat_pools, tables, pos,
+                         temps, topks, topps, active, key, aidx=None,
+                         lora_flat=(), greedy=False, ticks=None):
+        """Paged decode window: K/V reads/writes go through per-slot
+        block tables into the shared pool. ``tables``: int32
+        (B, table_width) — the engine zeroes rows of idle/prefilling slots
+        so their masked ticks write only the scratch block. ``greedy`` is
+        STATIC (jit cache key): True promises every active row has temp 0
+        and compiles sampling down to argmax. ``ticks`` (STATIC) overrides
+        ``tick_window`` — the speculative server's gated plain trips run
+        longer windows than its verify trips (SpecConfig.gate_ticks).
+        ``aidx``/``lora_flat``: per-slot adapter page indices + the LoRA
+        pool's stacked factor tensors — gathered ONCE per trip (rows are
+        loop-invariant across ticks) and applied in-program (BGMV)."""
+        engine = self.engine
+        model = engine.model
+        lora = self._gather_lora(lora_flat, aidx)
+
+        def one_tick(carry, k):
+            toks, flat_p, p = carry
+            pools = self._pool_views(flat_p)
+
+            def call():
+                h, new = model.model.paged_decode_step(Tensor(toks[:, None]),
+                                                       pools, tables, p,
+                                                       lora=lora)
+                return engine._head(h), new
+
+            logits, new = functional_call(model, params, call_fn=call)
+            flat = self._flat_pools(new)
+            lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
+            if greedy:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            else:
+                from ..models.generation import sample_token_rows
+
+                nxt = sample_token_rows(lg, jax.random.fold_in(key, k),
+                                        temps, topks, topps)
+            return (nxt, flat, p + active), nxt
+
+        n = engine.tick_window if ticks is None else ticks
+        if n == 1:
+            (_, flat, _), stack = one_tick((tokens, flat_pools, pos), 0)
+            return stack[None], flat
+        (_, flat, _), stack = jax.lax.scan(
+            one_tick, (tokens, flat_pools, pos), jnp.arange(n))
+        return stack, flat
+
+    def _chunk_prefill_fn(self, params, chunk, flat_pools, table, start,
+                          last_idx, aidx=None, lora_flat=()):
+        """ONE compiled program for every prefill chunk of every prompt
+        length: chunk (1, C) right-padded; K/V scatter into the slot's
+        block table at block-aligned ``start``; returns fp32 logits at
+        local index ``last_idx`` (the last real prompt token on the final
+        chunk; ignored on earlier chunks) + updated pools. ``aidx`` is the
+        prefilling slot's adapter page index, shape (1,) — prompt tokens
+        must see the same adapter delta the decode ticks will."""
+        engine = self.engine
+        model = engine.model
+        pools = self._pool_views(flat_pools)
+        lora = self._gather_lora(lora_flat, aidx)
+
+        def call():
+            h, new = model.model.paged_prefill_chunk(Tensor(chunk), pools,
+                                                     table, start,
+                                                     lora=lora)
+            last = jax.lax.dynamic_slice_in_dim(h.value, last_idx, 1, 1)
+            return engine._head(Tensor(last)), new
+
+        logits, new = functional_call(model, params, call_fn=call)
+        return logits.value[:, 0].astype(jnp.float32), self._flat_pools(new)
+
+    def _spec_verify_fn(self, params, tokens, proposals, flat_pools, tables,
+                        pos, temps, topks, topps, kcaps, key, qprobs,
+                        aidx=None, lora_flat=(), greedy=False):
+        """ONE fused speculative tick: target-score the whole window
+        [current token, k drafts] through the paged verify path, then run
+        exact accept/reject — all on device, so the host sees only the
+        (B, W) emitted-token block and the (B,) accepted counts (one sync
+        per tick, same as plain decode). ``qprobs`` is None for
+        deterministic drafters (one-hot q synthesized inside the program);
+        per-row ``kcaps`` force-stop lets requests run mixed draft_k (and
+        masks idle slots at kcap 0) without changing compiled shapes."""
+        engine = self.engine
+        model = engine.model
+        pools = self._pool_views(flat_pools)
+        lora = self._gather_lora(lora_flat, aidx)
+        window = jnp.concatenate([tokens[:, None], proposals], axis=1)
+
+        def call():
+            h, new = model.model.paged_verify_step(Tensor(window), pools,
+                                                   tables, pos, lora=lora)
+            return engine._head(h), new
+
+        logits, new = functional_call(model, params, call_fn=call)
+        flat = self._flat_pools(new)
+        from .speculative import speculative_accept
+
+        out, acc = speculative_accept(
+            logits.value.astype(jnp.float32), proposals, temps, topks,
+            topps, kcaps, key, qprobs, greedy=greedy)
+        return out, acc, flat
+
+    def _spec_scan_fn(self, params, ctx, flat_pools, tables, pos, temps,
+                      topks, topps, kcaps, active, key, aidx=None,
+                      lora_flat=(), greedy=False, windows=None):
+        """``tick_window`` speculative windows as ONE compiled program —
+        the drafter runs IN-PROGRAM (``drafter.propose_device``, e.g. the
+        jnp prompt-lookup matcher), so draft → multi-token verify → exact
+        accept → context/position update runs on device and the host pays
+        one round trip per ``tick_window·(k+1)`` potential tokens.
+        ``ctx``: int32 (B, max_len), row b's prompt+generated tokens
+        valid through index ``pos[b]`` — accepted tokens are appended to
+        it after each window so the next window drafts from them.
+        Emitted-token surplus past eos/max-new is discarded by the host
+        harvest, exactly like the plain ``tick_window`` decode scan.
+        ``windows`` (STATIC) overrides the per-trip window count — the
+        turbo tier of the speculation gate (SpecConfig.turbo_windows)
+        runs long trips while the whole batch is accepting near-k."""
+        engine = self.engine
+        model = engine.model
+        k = engine.spec_k
+        W = k + 1
+        B, L = ctx.shape
+        S = engine._spec_windows if windows is None else windows
+        rows = jnp.arange(B)
+        lora = self._gather_lora(lora_flat, aidx)
+        from .speculative import speculative_accept
+
+        def one_window(carry, w):
+            c, flat_p, p = carry
+            pools = self._pool_views(flat_p)
+            cur = jnp.take_along_axis(c, p[:, None], axis=1)      # (B, 1)
+            proposals = engine.drafter.propose_device(c, p, k)
+            window = jnp.concatenate([cur, proposals], axis=1)
+
+            def call():
+                h, new = model.model.paged_verify_step(Tensor(window),
+                                                       pools, tables, p,
+                                                       lora=lora)
+                return engine._head(h), new
+
+            logits, new = functional_call(model, params, call_fn=call)
+            flat = self._flat_pools(new)
+            out, acc = speculative_accept(
+                logits.value.astype(jnp.float32), proposals, temps, topks,
+                topps, kcaps, jax.random.fold_in(key, w), None,
+                greedy=greedy)
+            # append the emitted tokens (accepted drafts + correction) to
+            # the context so the next window drafts from them; clamped
+            # writes past L-1 only touch rows the harvest will release
+            widx = jnp.minimum(p[:, None] + 1 + jnp.arange(W)[None, :],
+                               L - 1)
+            keep = ((jnp.arange(W)[None, :] <= acc[:, None])
+                    & (active > 0)[:, None])
+            vals = jnp.where(keep, out, jnp.take_along_axis(c, widx, axis=1))
+            c = c.at[rows[:, None], widx].set(vals)
+            # clamp: only surplus windows past max_len (discarded by the
+            # harvest) ever hit L-1 — without it the ``cur`` gather goes
+            # out of bounds (fill-mode -> garbage token id -> NaN
+            # embedding) and the NaN K/V written to scratch poisons every
+            # row whose table padding points there (0 * NaN in p @ V)
+            p = jnp.minimum(p + (acc + 1) * active, L - 1)
+            return (c, flat, p), (out, acc)
+
+        # UNROLLED, not lax.scan/while_loop: on CPU the loop constructs
+        # copy the multi-MB KV pools through the carry every trip (~ms of
+        # pure memcpy); straight-line code lets XLA alias the pool
+        # buffers through all S windows for free. S is small and static,
+        # so program size stays modest and the jit cache sees one shape.
+        carry = (ctx, flat_pools, pos)
+        outs, accs = [], []
+        for w in range(S):
+            carry, (out, acc) = one_window(carry, w)
+            outs.append(out)
+            accs.append(acc)
+        _, flat, _ = carry
+        return jnp.stack(outs), jnp.stack(accs), flat

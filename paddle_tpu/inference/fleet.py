@@ -37,6 +37,20 @@ Three mechanisms, all host-side:
 Replicas get disjoint rid spaces (``set_rid_base``) so a migrated
 request can never collide with a peer's own; the router's rid IS the
 replica rid, so results map back without translation.
+
+**Disaggregated prefill/decode fleets** (ROADMAP: multi-chip serving):
+replicas constructed with ``role="prefill"`` / ``role="decode"`` split
+the fleet into two classes. Fresh submissions route only among the
+prefill-capable class; a prefill replica runs chunked prefill, samples
+the first token, parks the request (``handoff_ready``), and the
+router's per-tick sweep moves it to the decode class over the SAME
+CRC-verified ``evacuate(trust_kv=True, rids=...)``/``admit_migrated``
+path every other migration uses — no bespoke handoff channel. Decode
+replicas refuse nothing (they can re-prefill a salvaged prompt), but a
+prefill replica refuses decode-phase admits at the door, so a misroute
+fails loudly instead of wedging. When a prefill replica dies
+mid-chunk, its requests salvage onto the decode class through the
+usual host-state replay rung — token output stays identical.
 """
 from __future__ import annotations
 
@@ -72,6 +86,11 @@ class ReplicaInfo:
     idx: int
     server: Any
     state: str = REPLICA_LIVE
+    # replica class ("any" | "prefill" | "decode") — copied from the
+    # engine's role at construction and NEVER mutated by health
+    # transitions: a degraded prefill replica recovers as a prefill
+    # replica
+    role: str = "any"
     # heartbeat state (router clock / engine step counter)
     last_progress_t: float = 0.0
     last_steps: int = 0
@@ -139,8 +158,22 @@ class FleetRouter:
                     f"replicas must be homogeneous so any replica can "
                     f"receive any migration ({fp!r} vs {want!r})")
             srv.set_rid_base(i * RID_STRIDE)
+        roles = [getattr(srv, "role", "any") for srv in servers]
+        #: True when any replica declared a class — the fleet then runs
+        #: disaggregated: submissions route to the prefill class, the
+        #: per-tick handoff sweep moves finished prefills to decode.
+        self.disagg = any(r != "any" for r in roles)
+        if self.disagg:
+            if not any(r in ("prefill", "any") for r in roles):
+                raise ValueError(
+                    "disaggregated fleet has no prefill-capable replica "
+                    "— nothing could ever accept a submission")
+            if not any(r in ("decode", "any") for r in roles):
+                raise ValueError(
+                    "disaggregated fleet has no decode-capable replica "
+                    "— finished prefills would park forever")
         now = self._clock()
-        self._replicas = [ReplicaInfo(idx=i, server=srv,
+        self._replicas = [ReplicaInfo(idx=i, server=srv, role=roles[i],
                                       last_progress_t=now,
                                       history=[(now, REPLICA_LIVE)])
                           for i, srv in enumerate(servers)]
@@ -157,6 +190,12 @@ class FleetRouter:
         self._home: Dict[int, int] = {}        # rid -> replica idx
         self._results: Dict[int, List[int]] = {}
         self._dropped: Dict[int, str] = {}
+        # per-request migration latency samples (seconds on the injected
+        # clock), covering evacuate→absorb→admit for handoffs, drains and
+        # failovers alike; bounded so a long-lived router can't grow it
+        self._migration_lat: List[float] = []
+        self._migration_lat_cap = 4096
+        self._handoff_requests = 0
         if registry is None:
             from .telemetry import MetricsRegistry
 
@@ -192,11 +231,22 @@ class FleetRouter:
         self._c_quarantined = registry.counter(
             "fleet_quarantined_requests",
             "requests with no surviving migration target (terminal)")
+        self._c_handoffs = registry.counter(
+            "fleet_prefill_handoffs",
+            "prefill→decode handoff sweeps performed (replica label)")
 
     # ---------------------------------------------------------------- routing
     def _eligible(self) -> List[ReplicaInfo]:
         return [r for r in self._replicas
                 if r.state in (REPLICA_LIVE, REPLICA_DEGRADED)]
+
+    @staticmethod
+    def _prefill_capable(rep: ReplicaInfo) -> bool:
+        return rep.role in ("prefill", "any")
+
+    @staticmethod
+    def _decode_capable(rep: ReplicaInfo) -> bool:
+        return rep.role in ("decode", "any")
 
     def _score(self, rep: ReplicaInfo, prompt: Sequence[int]) -> float:
         """Routing score: cached-prefix tokens minus load, minus a large
@@ -215,12 +265,21 @@ class FleetRouter:
 
     def _route(self, prompt: Sequence[int]) -> List[ReplicaInfo]:
         """Eligible replicas in routing-preference order (best first).
-        An injected ``route`` fault reverses the preference — a misroute
-        must only cost prefix reuse, never correctness."""
+        In a disaggregated fleet only the prefill class is scored — a
+        fresh submission always starts with chunked prefill, so scoring
+        decode-class peers would just misroute it into a replica whose
+        output must immediately hand off right back. If the whole
+        prefill class is down, submissions fall through to the decode
+        class (which re-prefills — the degradation ladder, not a new
+        path). An injected ``route`` fault reverses the preference — a
+        misroute must only cost prefix reuse, never correctness."""
         reps = self._eligible()
         if not reps:
             raise EngineFailedError(
                 "no live replicas — the fleet is fully dead or draining")
+        if self.disagg:
+            pre = [r for r in reps if self._prefill_capable(r)]
+            reps = pre or [r for r in reps if self._decode_capable(r)]
         reps = sorted(reps, key=lambda r: (-self._score(r, prompt), r.idx))
         if self._faults.fire("route") is not None:
             self._c_misroutes.inc()
@@ -266,9 +325,11 @@ class FleetRouter:
         rep.server.fail(f"fleet: {reason}")
         self._set_state(rep, REPLICA_DEAD)
         self._c_deaths.inc(reason=reason.split(":")[0])
+        t0 = self._clock()
         snap = rep.server.evacuate(trust_kv=False)
         self._absorb(snap)
-        self._migrate(snap, exclude=rep.idx, reason="failover")
+        moved = self._migrate(snap, exclude=rep.idx, reason="failover")
+        self._record_migration_latency(self._clock() - t0, moved)
 
     def _heartbeat(self, rep: ReplicaInfo, remaining: int) -> None:
         """Tick-progress liveness: a replica holding work must advance
@@ -328,6 +389,12 @@ class FleetRouter:
         for rep in self._replicas:
             if rep.state in (REPLICA_DEAD, REPLICA_DRAINING):
                 continue
+            # sweep BEFORE stepping: requests parked last tick leave
+            # before this step runs, so a prefill replica whose only
+            # work is parked never reads as "holding work without
+            # progressing" to the heartbeat below
+            if rep.role == "prefill":
+                self._sweep_handoff(rep)
             if self._faults.fire("replica_down") is not None:
                 self._kill(rep, "injected replica_down")
                 continue
@@ -364,6 +431,33 @@ class FleetRouter:
         return out
 
     # -------------------------------------------------------------- migration
+    def _sweep_handoff(self, rep: ReplicaInfo) -> int:
+        """Move every request this prefill replica has parked
+        (``handoff_ready``) to the decode class: a partial
+        ``evacuate(trust_kv=True, rids=...)`` captures ONLY the parked
+        requests — the replica keeps streaming its other prompts — and
+        ``_migrate`` re-admits each KV payload on the best decode peer
+        through the standard CRC-verified path. Returns requests moved."""
+        rids = rep.server.handoff_ready()
+        if not rids:
+            return 0
+        t0 = self._clock()
+        snap = rep.server.evacuate(trust_kv=True, rids=rids)
+        self._absorb(snap)
+        moved = self._migrate(snap, exclude=rep.idx, reason="handoff")
+        self._record_migration_latency(self._clock() - t0, moved)
+        self._handoff_requests += moved
+        self._c_handoffs.inc(replica=str(rep.idx))
+        return moved
+
+    def _record_migration_latency(self, dt: float, moved: int) -> None:
+        if moved <= 0:
+            return
+        lat = self._migration_lat
+        lat.extend([dt] * moved)
+        if len(lat) > self._migration_lat_cap:
+            del lat[:len(lat) - self._migration_lat_cap]
+
     def _absorb(self, snap: Dict[str, Any]) -> None:
         """Fold an evacuated replica's finished work into the router's
         ledgers so ``status``/``run`` keep answering for it."""
@@ -383,6 +477,23 @@ class FleetRouter:
         moved = 0
         for d in sorted(snap["requests"], key=lambda d: d["sched"]["seq"]):
             targets = [r for r in self._eligible() if r.idx != exclude]
+            if self.disagg:
+                # class-aware targeting: decode-phase payloads (a KV
+                # handoff, or anything that already generated tokens)
+                # MUST land on the decode class — a prefill replica
+                # refuses them at the door; pure-prompt payloads prefer
+                # the prefill class but fall back to decode, which
+                # re-prefills (the chaos-kill salvage path)
+                decode_phase = (d["phase"] == "kv"
+                                or bool(d.get("generated")))
+                if decode_phase:
+                    targets = [r for r in targets
+                               if self._decode_capable(r)]
+                else:
+                    pre = [r for r in targets
+                           if self._prefill_capable(r)]
+                    targets = pre or [r for r in targets
+                                      if self._decode_capable(r)]
             if not targets:
                 self._dropped[int(d["rid"])] = "failed"
                 self._c_quarantined.inc()
@@ -414,9 +525,11 @@ class FleetRouter:
         if rep.state == REPLICA_DEAD:
             raise ValueError(f"replica {idx} is already dead")
         self._set_state(rep, REPLICA_DRAINING)
+        t0 = self._clock()
         snap = rep.server.evacuate(trust_kv=True)
         self._absorb(snap)
         moved = self._migrate(snap, exclude=idx, reason="drain")
+        self._record_migration_latency(self._clock() - t0, moved)
         self._set_state(rep, REPLICA_DEAD)
         self._c_drains.inc()
         return moved
@@ -475,6 +588,7 @@ class FleetRouter:
             lm = srv.load_metrics()
             ks = srv.kv_stats()
             row = {"replica": rep.idx, "state": rep.state,
+                   "role": rep.role,
                    "steps": srv.steps,
                    "queue_depth": lm["queue_depth"],
                    "slots_occupied": lm["slots_occupied"],
@@ -498,7 +612,24 @@ class FleetRouter:
         for s, n in census.items():
             reg.gauge(f"fleet_replicas_{s}",
                       f"replicas in state {s}").set(float(n))
+        up = [r for r in self._replicas
+              if r.state in (REPLICA_LIVE, REPLICA_DEGRADED)]
+        lat = sorted(self._migration_lat)
+
+        def _pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
         return {"replicas": rows, "states": census,
+                "disagg": self.disagg,
+                "prefill_replicas": sum(r.role == "prefill" for r in up),
+                "decode_replicas": sum(r.role == "decode" for r in up),
+                "handoffs": int(self._c_handoffs.total()),
+                "handoff_requests": self._handoff_requests,
+                "migration_latency_p50_s": _pct(0.50),
+                "migration_latency_p95_s": _pct(0.95),
+                "migration_latency_samples": len(lat),
                 "ticks": self._ticks,
                 "routed": int(self._c_routed.total()),
                 "misroutes": int(self._c_misroutes.total()),

@@ -374,6 +374,20 @@ class AdapterPool:
         """The flat pool list for a compiled-program call."""
         return list(self._flat)
 
+    def place_device_tensors(self, place_fn) -> None:
+        """Re-place the stacked pool tensors (the tp executor shards A/B
+        pages onto its serving mesh at construction —
+        parallel/serving_mesh.py). ``place_fn(flat) -> flat`` must keep
+        every shape/dtype; later page uploads are functional ``.at[]``
+        updates, which preserve whatever placement lives here."""
+        new = list(place_fn(list(self._flat)))
+        if len(new) != len(self._flat) or any(
+                a.shape != b.shape or a.dtype != b.dtype
+                for a, b in zip(new, self._flat)):
+            raise ValueError("place_fn must preserve the pool's tensor "
+                             "shapes and dtypes")
+        self._flat = new
+
     def _upload(self, page: int, ad: Adapter) -> None:
         """Write one adapter's rank-padded factors into ``page`` via
         functional updates — pool shapes never change, so uploads are

@@ -37,14 +37,23 @@ class BlockAllocator:
     """Refcounted fixed-size KV block allocator with prefix caching."""
 
     def __init__(self, num_blocks: int, block_size: int,
-                 kv_quant: str = "none", bytes_per_block: int = 0):
+                 kv_quant: str = "none", bytes_per_block: int = 0,
+                 shards: int = 1):
         if num_blocks < 2:
             raise ValueError(f"num_blocks must be >= 2 (one scratch + one "
                              f"usable), got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # TP serving shards the kv-head axis of every pool tensor, so each
+        # of `shards` devices holds a 1/shards slice of EVERY block: one
+        # allocator (one free list, one block table) spans all shards, and
+        # bytes_per_block stays the FULL-width block footprint while
+        # bytes_per_block_shard below is what each device actually pays
+        self.shards = int(shards)
         # the quant mode seeds the hash chain: int8 and fp pools store
         # different bits for the same tokens, so their prefix blocks must
         # never alias even if allocator state ever crossed server instances
@@ -121,6 +130,11 @@ class BlockAllocator:
                 "kv_quant": self.kv_quant,
                 "bytes_per_block": self.bytes_per_block,
                 "bytes_in_use": self.bytes_per_block * self.blocks_in_use,
+                "shards": self.shards,
+                "bytes_per_block_shard": self.bytes_per_block // self.shards,
+                "bytes_in_use_shard":
+                    (self.bytes_per_block // self.shards)
+                    * self.blocks_in_use,
                 "pinned_blocks": self.pinned_blocks,
                 "swap_out_blocks": self.swap_out_blocks,
                 "swap_in_blocks": self.swap_in_blocks,

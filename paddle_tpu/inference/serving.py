@@ -111,7 +111,8 @@ class GenerationServer:
                  policy=None,
                  host_pool_bytes: Optional[int] = None,
                  lora=None, telemetry=None, faults=None,
-                 fault_retries: int = 3, kernels: str = "auto"):
+                 fault_retries: int = 3, kernels: str = "auto",
+                 mesh=None, role: str = "any"):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -186,6 +187,24 @@ class GenerationServer:
         (the chaos-soak harness). ``fault_retries``: tick-fault strikes a
         request survives before quarantine to terminal ``failed``.
 
+        ``mesh`` (paged only): tensor-parallel serving — ``"tp=N"`` (or
+        the int N) shards the executor's compiled programs over an N-way
+        ``tp`` mesh: attention/kv heads, MLP hidden dim, the KV block
+        pool (+ its int8 scale rows), and the LoRA page pool all split on
+        the same axis (parallel/serving_mesh.py), while block tables,
+        scheduling, snapshots, and swap payloads stay tp-agnostic host
+        state. Greedy output is token-identical to the single-chip
+        engine; every sharded dim must divide N. None/1 = single chip.
+
+        ``role`` (paged only): replica class for disaggregated fleets —
+        ``"any"`` (default) serves the full lifecycle; ``"prefill"``
+        runs chunked prefill only, parking each request once its first
+        token is sampled for ``FleetRouter`` to hand off (see
+        :meth:`handoff_ready`/:meth:`evacuate`) and refusing decode-phase
+        admits; ``"decode"`` marks the replica as a handoff target
+        (routing sends it no fresh prompts, but it can still re-prefill
+        salvaged replay work).
+
         ``kernels``: attention/projection kernel dispatch for the compiled
         serving programs — ``"auto"`` (default) picks the Pallas kernels on
         a TPU backend and the jnp reference elsewhere, ``"pallas"`` forces
@@ -220,6 +239,35 @@ class GenerationServer:
             raise ValueError("lora= (multi-adapter serving) requires "
                              "cache='paged' — the adapter pool shares the "
                              "paged slot/eviction machinery")
+        if role not in ("any", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'any', 'prefill', or 'decode', got {role!r}")
+        if role != "any" and cache != "paged":
+            raise ValueError("role= (disaggregated replica classes) "
+                             "requires cache='paged' — handoff rides the "
+                             "paged snapshot/migration path")
+        self.role = role
+        if mesh is None:
+            tp = 1
+        elif isinstance(mesh, int):
+            tp = mesh
+        elif isinstance(mesh, str) and mesh.startswith("tp="):
+            try:
+                tp = int(mesh[3:])
+            except ValueError:
+                raise ValueError(f"mesh= must be 'tp=N', got {mesh!r}") \
+                    from None
+        else:
+            raise ValueError(
+                f"mesh must be None, an int tp degree, or 'tp=N', "
+                f"got {mesh!r}")
+        if tp < 1:
+            raise ValueError(f"mesh tp degree must be >= 1, got {tp}")
+        if tp > 1 and cache != "paged":
+            raise ValueError("mesh= (TP-sharded serving) requires "
+                             "cache='paged' — only the paged executor "
+                             "places its programs on a mesh")
+        self._tp = tp
         from ..ops import KERNEL_MODES, set_kernel_mode
 
         if kernels not in KERNEL_MODES:
@@ -425,24 +473,8 @@ class GenerationServer:
                     num_blocks = max_batch * entries + 1  # dense parity
             self.alloc = BlockAllocator(int(num_blocks), bs,
                                         kv_quant=kv_quant,
-                                        bytes_per_block=per_block)
-            if kv_quant == "int8":
-                # per layer: K codes, K scales, V codes, V scales — the
-                # scale rows ride in the flat pool list so donation and
-                # in-place updates cover them too
-                self._pools = []
-                for _ in range(cfg.num_hidden_layers):
-                    for _kv in range(2):
-                        self._pools.append(jnp.zeros(
-                            (int(num_blocks), bs, kv, d), jnp.int8))
-                        self._pools.append(jnp.zeros(
-                            (int(num_blocks), kv), jnp.float32))
-            else:
-                self._pools = [jnp.zeros((int(num_blocks), bs, kv, d), cdtype)
-                               for _ in range(2 * cfg.num_hidden_layers)]
-            # tensors per layer entry in the flat pool list: fp (K, V) = 2;
-            # int8 (Kq, Kscale, Vq, Vscale) = 4
-            self._pool_stride = 4 if kv_quant == "int8" else 2
+                                        bytes_per_block=per_block,
+                                        shards=self._tp)
             from .kv_offload import KVOffloadEngine
 
             self._offload = KVOffloadEngine(self.alloc, self._table_width,
@@ -473,16 +505,10 @@ class GenerationServer:
             # True while the slot is streaming prompt chunks; None once the
             # slot decodes (or is empty)
             self._prefilling: List[Optional[bool]] = [None] * max_batch
-            # ``greedy`` (the trailing static arg) specializes the program
-            # for all-temp-0 ticks: XLA folds the whole sampling pipeline
-            # (top-k/top-p filtering = per-row sorts over the vocab) down
-            # to one argmax — measured ~2.3ms/window at CPU bench shapes.
-            # At most two variants ever compile (greedy / mixed).
-            self._decode_paged = jax.jit(self._decode_paged_fn,
-                                         donate_argnums=(2,),
-                                         static_argnums=(12, 13))
-            self._chunk_prefill = jax.jit(self._chunk_prefill_fn,
-                                          donate_argnums=(2,))
+            # rids a prefill-class replica has finished prefilling (first
+            # token sampled) and parked for the fleet router to hand off
+            # to the decode class via evacuate(rids=)/admit_migrated
+            self._handoff: set = set()
             if self.spec is not None:
                 self.spec_k = int(self.spec.k)
                 self.drafter = self.spec.build_drafter(max_len)
@@ -516,37 +542,38 @@ class GenerationServer:
                 self._spec_gate_off = 0
                 self._spec_plain_windows = 0
                 self._spec_turbo = False
+            # engine/executor split: everything device-side — the KV
+            # block pools, the compiled programs, and their (optional)
+            # tp-mesh placement — lives in the executor; this engine
+            # keeps only host scheduling state and dispatches through
+            # the aliases below (inference/executor.py)
+            from .executor import PagedExecutor
+
+            self._exec = PagedExecutor(self, num_blocks=int(num_blocks),
+                                       tp=self._tp)
+            self._decode_paged = self._exec.decode_paged
+            self._chunk_prefill = self._exec.chunk_prefill
+            if self.spec is not None:
                 if self._spec_fused:
-                    self._spec_scan = jax.jit(self._spec_scan_fn,
-                                              donate_argnums=(2,),
-                                              static_argnums=(13, 14))
+                    self._spec_scan = self._exec.spec_scan
                 else:
-                    self._spec_verify = jax.jit(self._spec_verify_fn,
-                                                donate_argnums=(3,),
-                                                static_argnums=(14,))
+                    self._spec_verify = self._exec.spec_verify
 
     # ------------------------------------------------------------ compiled fns
-    def _pool_views(self, flat_p):
-        """Group the flat per-layer pool list back into per-layer tuples:
-        fp → (K, V); int8 → (Kq, Kscale, Vq, Vscale). The model's paged
-        methods branch on the tuple arity, so the same compiled-fn bodies
-        serve both pool formats."""
-        st = self._pool_stride
-        return [tuple(Tensor(flat_p[st * i + j]) for j in range(st))
-                for i in range(self.cfg.num_hidden_layers)]
+    @property
+    def _pools(self):
+        """The executor's flat KV pool list — engine code reads/rotates
+        it through this alias so the donation-rotation call sites are
+        unchanged by the engine/executor split."""
+        return self._exec.pools
 
-    @staticmethod
-    def _flat_pools(new):
-        return [t.value for entry in new for t in entry]
+    @_pools.setter
+    def _pools(self, value):
+        self._exec.pools = value
 
-    def _gather_lora(self, lora_flat, aidx):
-        """Gather each row's adapter factors from the paged LoRA pool —
-        one batched take per stacked tensor, inside the compiled program.
-        ``lora_flat`` is empty when LoRA is off → None (the model's paged
-        methods skip the delta entirely)."""
-        if not lora_flat:
-            return None
-        return self._lora.gather_rows(list(lora_flat), aidx)
+    @property
+    def _pool_stride(self) -> int:
+        return self._exec.pool_stride
 
     def _lora_flat(self):
         """Current adapter-pool tensors for a compiled-program call — ()
@@ -601,181 +628,6 @@ class GenerationServer:
             one_tick, (tokens, flat_caches, pos),
             jnp.arange(self.tick_window))
         return stack, flat
-
-    def _decode_paged_fn(self, params, tokens, flat_pools, tables, pos,
-                         temps, topks, topps, active, key, aidx=None,
-                         lora_flat=(), greedy=False, ticks=None):
-        """Paged twin of :meth:`_decode_fn`: K/V reads/writes go through
-        per-slot block tables into the shared pool. ``tables``: int32
-        (B, table_width) — the server zeroes rows of idle/prefilling slots
-        so their masked ticks write only the scratch block. ``greedy`` is
-        STATIC (jit cache key): True promises every active row has temp 0
-        and compiles sampling down to argmax. ``ticks`` (STATIC) overrides
-        ``tick_window`` — the speculative server's gated plain trips run
-        longer windows than its verify trips (SpecConfig.gate_ticks).
-        ``aidx``/``lora_flat``: per-slot adapter page indices + the LoRA
-        pool's stacked factor tensors — gathered ONCE per trip (rows are
-        loop-invariant across ticks) and applied in-program (BGMV)."""
-        model = self.model
-        lora = self._gather_lora(lora_flat, aidx)
-
-        def one_tick(carry, k):
-            toks, flat_p, p = carry
-            pools = self._pool_views(flat_p)
-
-            def call():
-                h, new = model.model.paged_decode_step(Tensor(toks[:, None]),
-                                                       pools, tables, p,
-                                                       lora=lora)
-                return self._head(h), new
-
-            logits, new = functional_call(model, params, call_fn=call)
-            flat = self._flat_pools(new)
-            lg = logits.value[:, 0].astype(jnp.float32)   # (B, V)
-            if greedy:
-                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            else:
-                from ..models.generation import sample_token_rows
-
-                nxt = sample_token_rows(lg, jax.random.fold_in(key, k),
-                                        temps, topks, topps)
-            return (nxt, flat, p + active), nxt
-
-        n = self.tick_window if ticks is None else ticks
-        if n == 1:
-            (_, flat, _), stack = one_tick((tokens, flat_pools, pos), 0)
-            return stack[None], flat
-        (_, flat, _), stack = jax.lax.scan(
-            one_tick, (tokens, flat_pools, pos), jnp.arange(n))
-        return stack, flat
-
-    def _chunk_prefill_fn(self, params, chunk, flat_pools, table, start,
-                          last_idx, aidx=None, lora_flat=()):
-        """ONE compiled program for every prefill chunk of every prompt
-        length: chunk (1, C) right-padded; K/V scatter into the slot's
-        block table at block-aligned ``start``; returns fp32 logits at
-        local index ``last_idx`` (the last real prompt token on the final
-        chunk; ignored on earlier chunks) + updated pools. ``aidx`` is the
-        prefilling slot's adapter page index, shape (1,) — prompt tokens
-        must see the same adapter delta the decode ticks will."""
-        model = self.model
-        pools = self._pool_views(flat_pools)
-        lora = self._gather_lora(lora_flat, aidx)
-
-        def call():
-            h, new = model.model.paged_prefill_chunk(Tensor(chunk), pools,
-                                                     table, start,
-                                                     lora=lora)
-            last = jax.lax.dynamic_slice_in_dim(h.value, last_idx, 1, 1)
-            return self._head(Tensor(last)), new
-
-        logits, new = functional_call(model, params, call_fn=call)
-        return logits.value[:, 0].astype(jnp.float32), self._flat_pools(new)
-
-    def _spec_verify_fn(self, params, tokens, proposals, flat_pools, tables,
-                        pos, temps, topks, topps, kcaps, key, qprobs,
-                        aidx=None, lora_flat=(), greedy=False):
-        """ONE fused speculative tick: target-score the whole window
-        [current token, k drafts] through the paged verify path, then run
-        exact accept/reject — all on device, so the host sees only the
-        (B, W) emitted-token block and the (B,) accepted counts (one sync
-        per tick, same as plain decode). ``qprobs`` is None for
-        deterministic drafters (one-hot q synthesized inside the program);
-        per-row ``kcaps`` force-stop lets requests run mixed draft_k (and
-        masks idle slots at kcap 0) without changing compiled shapes."""
-        model = self.model
-        pools = self._pool_views(flat_pools)
-        lora = self._gather_lora(lora_flat, aidx)
-        window = jnp.concatenate([tokens[:, None], proposals], axis=1)
-
-        def call():
-            h, new = model.model.paged_verify_step(Tensor(window), pools,
-                                                   tables, pos, lora=lora)
-            return self._head(h), new
-
-        logits, new = functional_call(model, params, call_fn=call)
-        flat = self._flat_pools(new)
-        from .speculative import speculative_accept
-
-        out, acc = speculative_accept(
-            logits.value.astype(jnp.float32), proposals, temps, topks,
-            topps, kcaps, key, qprobs, greedy=greedy)
-        return out, acc, flat
-
-    def _spec_scan_fn(self, params, ctx, flat_pools, tables, pos, temps,
-                      topks, topps, kcaps, active, key, aidx=None,
-                      lora_flat=(), greedy=False, windows=None):
-        """``tick_window`` speculative windows as ONE compiled program —
-        the drafter runs IN-PROGRAM (``drafter.propose_device``, e.g. the
-        jnp prompt-lookup matcher), so draft → multi-token verify → exact
-        accept → context/position update runs on device and the host pays
-        one round trip per ``tick_window·(k+1)`` potential tokens.
-        ``ctx``: int32 (B, max_len), row b's prompt+generated tokens
-        valid through index ``pos[b]`` — accepted tokens are appended to
-        it after each window so the next window drafts from them.
-        Emitted-token surplus past eos/max-new is discarded by the host
-        harvest, exactly like the plain ``tick_window`` decode scan.
-        ``windows`` (STATIC) overrides the per-trip window count — the
-        turbo tier of the speculation gate (SpecConfig.turbo_windows)
-        runs long trips while the whole batch is accepting near-k."""
-        model = self.model
-        k = self.spec_k
-        W = k + 1
-        B, L = ctx.shape
-        S = self._spec_windows if windows is None else windows
-        rows = jnp.arange(B)
-        lora = self._gather_lora(lora_flat, aidx)
-        from .speculative import speculative_accept
-
-        def one_window(carry, w):
-            c, flat_p, p = carry
-            pools = self._pool_views(flat_p)
-            cur = jnp.take_along_axis(c, p[:, None], axis=1)      # (B, 1)
-            proposals = self.drafter.propose_device(c, p, k)
-            window = jnp.concatenate([cur, proposals], axis=1)
-
-            def call():
-                h, new = model.model.paged_verify_step(Tensor(window),
-                                                       pools, tables, p,
-                                                       lora=lora)
-                return self._head(h), new
-
-            logits, new = functional_call(model, params, call_fn=call)
-            flat = self._flat_pools(new)
-            out, acc = speculative_accept(
-                logits.value.astype(jnp.float32), proposals, temps, topks,
-                topps, kcaps, jax.random.fold_in(key, w), None,
-                greedy=greedy)
-            # append the emitted tokens (accepted drafts + correction) to
-            # the context so the next window drafts from them; clamped
-            # writes past L-1 only touch rows the harvest will release
-            widx = jnp.minimum(p[:, None] + 1 + jnp.arange(W)[None, :],
-                               L - 1)
-            keep = ((jnp.arange(W)[None, :] <= acc[:, None])
-                    & (active > 0)[:, None])
-            vals = jnp.where(keep, out, jnp.take_along_axis(c, widx, axis=1))
-            c = c.at[rows[:, None], widx].set(vals)
-            # clamp: only surplus windows past max_len (discarded by the
-            # harvest) ever hit L-1 — without it the ``cur`` gather goes
-            # out of bounds (fill-mode -> garbage token id -> NaN
-            # embedding) and the NaN K/V written to scratch poisons every
-            # row whose table padding points there (0 * NaN in p @ V)
-            p = jnp.minimum(p + (acc + 1) * active, L - 1)
-            return (c, flat, p), (out, acc)
-
-        # UNROLLED, not lax.scan/while_loop: on CPU the loop constructs
-        # copy the multi-MB KV pools through the carry every trip (~ms of
-        # pure memcpy); straight-line code lets XLA alias the pool
-        # buffers through all S windows for free. S is small and static,
-        # so program size stays modest and the jit cache sees one shape.
-        carry = (ctx, flat_pools, pos)
-        outs, accs = [], []
-        for w in range(S):
-            carry, (out, acc) = one_window(carry, w)
-            outs.append(out)
-            accs.append(acc)
-        _, flat, _ = carry
-        return jnp.stack(outs), jnp.stack(accs), flat
 
     def _prefill(self, bucket: int):
         """Dense-path prefill + slot scatter as ONE jitted call (donated
@@ -1356,6 +1208,13 @@ class GenerationServer:
             else:
                 self._activate_slot(slot, req, self._first_token(req, lg))
             self._prefilling[slot] = None
+            if self.role == "prefill" and self._slots[slot] is req:
+                # prefill-class replica: the request now holds exactly
+                # the KV + first token a decode replica resumes from —
+                # park it for the router's evacuate(rids=)/admit_migrated
+                # handoff instead of decoding here (replays park too:
+                # their decode phase belongs to the decode class)
+                self._handoff.add(req.rid)
 
     def _activate_replayed(self, slot: int, req: _Request) -> None:
         """Flip a corruption-recovery replay straight back to decoding.
@@ -1465,7 +1324,8 @@ class GenerationServer:
                 self._prefill_chunk_step(s)
                 did_prefill = True
         active = [s for s in range(self.max_batch)
-                  if self._slots[s] is not None and not self._prefilling[s]]
+                  if self._slots[s] is not None and not self._prefilling[s]
+                  and self._slots[s].rid not in self._handoff]
         if self._degraded_ticks > 0:
             self._degraded_ticks -= 1
         if active:
@@ -2085,11 +1945,16 @@ class GenerationServer:
                             f"slot aidx multiset {dict(pexp)}")
         if errs:
             raise AssertionError("; ".join(errs))
-        return {"blocks_in_use": a.blocks_in_use,
-                "blocks_cached": a.blocks_cached,
-                "blocks_free": a.blocks_free,
-                "host_bytes_in_use": parked,
-                "swapped_waiting": len(swapped)}
+        out = {"blocks_in_use": a.blocks_in_use,
+               "blocks_cached": a.blocks_cached,
+               "blocks_free": a.blocks_free,
+               "host_bytes_in_use": parked,
+               "swapped_waiting": len(swapped)}
+        # per-shard pool audit (tp executors): donation must rotate the
+        # pool buffers without ever resharding them — raises on a lost
+        # tp layout, and reports the per-shard accounting alongside
+        out.update(self._exec.shard_audit())
+        return out
 
     def _snapshot_fingerprint(self) -> Dict[str, Any]:
         """Shape-critical configuration a snapshot can only restore into:
@@ -2105,7 +1970,8 @@ class GenerationServer:
                 "num_blocks": self.alloc.num_blocks,
                 "spec_k": self.spec_k if self.spec is not None else None,
                 "lora": self._lora is not None,
-                "kernels": self.kernels}
+                "kernels": self.kernels,
+                "mesh": self._exec.mesh_fingerprint}
 
     def _req_state(self, req: _Request) -> Dict[str, Any]:
         return {"rid": req.rid, "prompt": list(req.prompt),
@@ -2287,6 +2153,12 @@ class GenerationServer:
         have = self._snapshot_fingerprint()
         for k, hv in have.items():
             wv = want.get(k)
+            if k == "mesh":
+                # provenance stamp, not a gate: snapshot KV payloads are
+                # full-width host gathers, so any tp restores into any tp
+                # (fleet homogeneity still compares it — replicas must
+                # agree — but restore/migration across layouts is legal)
+                continue
             if k == "num_blocks":
                 if hv < wv:
                     raise ValueError(
@@ -2301,6 +2173,17 @@ class GenerationServer:
     def _validate_snapshot_request(self, d: Dict[str, Any]) -> None:
         """Reject-at-the-door checks for one snapshot request dict —
         must run before ANY server state mutates."""
+        if self.role == "prefill" and (d.get("phase") == "kv"
+                                       or d.get("generated")):
+            # the prefill class runs chunked prefill ONLY: decode-phase
+            # work (a KV payload, or any request that already generated
+            # tokens and would resume decoding) belongs to the decode
+            # class — admitting it here would wedge it parked forever
+            raise ValueError(
+                f"prefill-class replica cannot admit decode-phase "
+                f"request {d['rid']} (phase={d.get('phase')!r}, "
+                f"{len(d.get('generated') or ())} generated tokens) — "
+                f"route it to the decode class")
         if d["adapter"] is not None:
             if self._lora is None:
                 raise ValueError(
@@ -2395,7 +2278,8 @@ class GenerationServer:
         self._admit_snapshot_request(d, self._sched.now())
         return int(d["rid"])
 
-    def evacuate(self, *, trust_kv: bool = True) -> Dict[str, Any]:
+    def evacuate(self, *, trust_kv: bool = True,
+                 rids: Optional[Sequence[int]] = None) -> Dict[str, Any]:
         """Capture a :meth:`snapshot` and then RELEASE every in-flight
         request from this server — the drain half of a fleet migration:
         the caller re-admits the returned snapshot's requests elsewhere,
@@ -2403,21 +2287,52 @@ class GenerationServer:
         drained) so :meth:`assert_conserved` holds trivially afterwards.
         Completed results and dropped markers stay readable on this
         server (and ride the snapshot). ``trust_kv=False`` salvages a
-        failed engine from host state only."""
+        failed engine from host state only.
+
+        ``rids=``: evacuate ONLY the listed requests (the snapshot's
+        ``requests`` list is filtered to them and only they release) —
+        the disaggregated prefill→decode handoff primitive: a
+        prefill-class replica keeps streaming its other prompts while
+        its finished ones (:meth:`handoff_ready`) move to the decode
+        class over this same CRC-verified snapshot path."""
         snap = self.snapshot(trust_kv=trust_kv)
+        if rids is not None:
+            keep = set(int(r) for r in rids)
+            snap["requests"] = [d for d in snap["requests"]
+                                if d["rid"] in keep]
+        else:
+            keep = None
         for s in range(self.max_batch):
             req = self._slots[s]
-            if req is None:
+            if req is None or (keep is not None and req.rid not in keep):
                 continue
+            self._handoff.discard(req.rid)
             req.table = self.alloc.truncate(req.table, 0)
             self._tel.tracer.close(req.rid, "migrated")
             self._release_slot(s)
         for ent in list(self._sched.waiting()):
+            if keep is not None and ent.rid not in keep:
+                continue
+            self._handoff.discard(ent.rid)
             self._sched.remove(ent.rid)
             if ent.swap is not None:
                 self._offload.discard(ent.swap)
             self._tel.tracer.close(ent.rid, "migrated")
+        if keep is None:
+            self._handoff.clear()
         return snap
+
+    def handoff_ready(self) -> List[int]:
+        """Rids a prefill-class replica has finished prefilling and
+        parked for the decode class — the fleet router's per-step
+        handoff sweep passes them straight to
+        ``evacuate(trust_kv=True, rids=...)``. Pruned lazily against the
+        live request set (a parked request can still be cancelled or
+        quarantined out from under the set)."""
+        live = {r.rid for r in self._slots if r is not None}
+        live.update(e.rid for e in self._sched.waiting())
+        self._handoff &= live
+        return sorted(self._handoff)
 
     def take_results(self) -> Dict[int, List[int]]:
         """Pop and return every completed result accumulated so far —

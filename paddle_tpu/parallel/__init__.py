@@ -9,7 +9,9 @@ from .api import (current_mesh, mesh_context, shard_constraint, shard_tensor, ps
 from .engine import ParallelEngine, parallelize, make_train_step
 from .pipeline_engine import (PipelineEngine, gpt_pipeline_engine,
                               llama_pipeline_engine)
+from .serving_mesh import build_serving_mesh, mesh_fingerprint
 
 __all__ = ["current_mesh", "mesh_context", "shard_constraint", "shard_tensor", "psum",
            "all_gather_axis", "axis_index", "axis_size", "ParallelEngine", "parallelize",
-           "make_train_step", "PipelineEngine", "llama_pipeline_engine", "gpt_pipeline_engine"]
+           "make_train_step", "PipelineEngine", "llama_pipeline_engine", "gpt_pipeline_engine",
+           "build_serving_mesh", "mesh_fingerprint"]

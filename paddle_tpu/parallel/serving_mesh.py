@@ -1,0 +1,192 @@
+"""TP placement for the paged serving executor.
+
+The serving tick goes multi-chip the GSPMD way (PAPERS.md): the paged
+programs — chunked prefill, decode window, both speculative verify paths —
+are NOT rewritten per shard. Instead this module places the executor's
+device state onto a 1-D ``tp`` mesh and lets the partitioner slice the
+compiled programs and insert the collectives:
+
+- model params reuse the layer-declared training ``pspec`` annotations
+  (``models/llama.py`` marks q/k/v/up/gate column-parallel and o/down
+  row-parallel over the ``"tensor"`` axis); serving renames that axis to
+  ``tp`` so a serving mesh never collides with a training mesh living in
+  the same process;
+- KV block pools ``(num_blocks, block_size, kv_heads, head_dim)`` shard
+  the kv-head axis, so every shard holds its head-slice of EVERY block
+  and the single host-side block table indexes all shards at once;
+- per-(block, kv-head) int8 scales ``(num_blocks, kv_heads)`` shard with
+  their heads;
+- LoRA pool pages shard on the same axis as the base weight they touch:
+  column-parallel targets (q/k/v/gate/up) shard the B-factor output dim,
+  row-parallel targets (o/down) shard the A-factor input dim, so the
+  batched BGMV delta stays inside the partitioned program with no
+  per-adapter gather.
+
+Why placement-only works bit-for-bit at the token level: the paged
+programs index pools by block id and head — both sharding-invariant — and
+the only cross-shard reductions GSPMD introduces (o_proj/down_proj psum)
+reorder float accumulation without changing the greedy argmax on any
+tested shape. Logits may differ in ulps from the single-chip program;
+emitted tokens must not (tests/test_tp_serving.py gates this).
+
+``jax.device_put`` lives HERE and not in ``paddle_tpu/inference/`` on
+purpose: graftlint GL014 bans bare transfers inside the serving engine so
+every cross-mesh byte moves through either these construction-time
+placements or the offload/migration paths (kv_offload.py / fleet.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "SERVING_TP_AXIS", "build_serving_mesh", "validate_tp",
+    "mesh_fingerprint", "serving_param_specs", "place_params",
+    "pool_spec", "place_pools", "lora_pool_specs", "place_lora_flat",
+    "place_replicated", "audit_pool_shardings",
+]
+
+SERVING_TP_AXIS = "tp"
+
+# training axis name whose layer pspecs carry the column/row-parallel
+# layout serving reuses (see parallel/engine.py param_specs)
+_TRAIN_TENSOR_AXIS = "tensor"
+
+
+def build_serving_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D ``tp`` mesh over the first ``tp`` addressable devices."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < tp:
+        raise ValueError(
+            f"mesh tp={tp} needs {tp} devices but only {len(devs)} are "
+            f"addressable — on CPU dryruns set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    return Mesh(np.array(devs[:tp]), (SERVING_TP_AXIS,))
+
+
+def validate_tp(cfg, tp: int) -> None:
+    """Every dimension the serving layout shards must split evenly —
+    uneven splits would silently pad pool blocks and break the
+    block-table addressing, so refuse at construction."""
+    bad = []
+    for dim, n in (("num_key_value_heads", cfg.num_key_value_heads),
+                   ("num_attention_heads", cfg.num_attention_heads),
+                   ("intermediate_size", cfg.intermediate_size),
+                   ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            bad.append(f"{dim}={n}")
+    if bad:
+        raise ValueError(
+            f"mesh tp={tp} does not divide {', '.join(bad)} — every "
+            f"sharded dimension must split evenly across the tp axis")
+
+
+def mesh_fingerprint(mesh: Optional[Mesh]) -> str:
+    """Snapshot-stamp for the serving layout: ``tp1`` is the single-chip
+    engine, ``tpN`` an N-way sharded one. Snapshot payloads are
+    full-width host gathers, so any tp restores into any tp — the stamp
+    records provenance, it is not a compatibility gate."""
+    if mesh is None:
+        return "tp1"
+    return f"tp{mesh.shape[SERVING_TP_AXIS]}"
+
+
+def serving_param_specs(model, mesh: Mesh) -> Dict[str, P]:
+    """Layer pspecs with the training ``tensor`` axis renamed to ``tp``;
+    params without a pspec (norms, rope tables) replicate."""
+    specs: Dict[str, P] = {}
+    for name, p in model.named_parameters():
+        spec = getattr(p, "pspec", None)
+        if spec is None:
+            specs[name] = P()
+        else:
+            specs[name] = P(*[
+                SERVING_TP_AXIS if a == _TRAIN_TENSOR_AXIS else None
+                for a in spec])
+    for name, b in model.named_buffers():
+        specs.setdefault(name, P())
+    return specs
+
+
+def place_params(model, params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    specs = serving_param_specs(model, mesh)
+    return {name: jax.device_put(v, NamedSharding(mesh, specs.get(name, P())))
+            for name, v in params.items()}
+
+
+def pool_spec(ndim: int) -> P:
+    """KV pool tensors shard the kv-head axis: codes/fp rows are
+    (num_blocks, block_size, kv_heads, head_dim), int8 scales are
+    (num_blocks, kv_heads)."""
+    if ndim == 4:
+        return P(None, None, SERVING_TP_AXIS, None)
+    if ndim == 2:
+        return P(None, SERVING_TP_AXIS)
+    raise ValueError(f"unexpected pool tensor rank {ndim}")
+
+
+def place_pools(pools: Sequence[Any], mesh: Mesh) -> List[Any]:
+    return [jax.device_put(p, NamedSharding(mesh, pool_spec(p.ndim)))
+            for p in pools]
+
+
+# LoRA targets whose base weight is row-parallel (input dim sharded):
+# their A factor shards its input dim; everything else is column-parallel
+# and shards the B factor's output dim.
+_ROW_PARALLEL_TARGETS = ("o", "down")
+
+
+def lora_pool_specs(targets: Sequence[str]) -> List[P]:
+    """Specs for the AdapterPool flat list [A_t0, B_t0, ..., scale]:
+    A is (pages, layers, in, rank), B is (pages, layers, rank, out)."""
+    specs: List[P] = []
+    for t in targets:
+        if t in _ROW_PARALLEL_TARGETS:
+            specs.append(P(None, None, SERVING_TP_AXIS, None))   # A: in dim
+            specs.append(P())                                    # B replicated
+        else:
+            specs.append(P())                                    # A replicated
+            specs.append(P(None, None, None, SERVING_TP_AXIS))   # B: out dim
+    specs.append(P())                                            # scale vector
+    return specs
+
+
+def place_lora_flat(targets: Sequence[str], flat: Sequence[Any],
+                    mesh: Mesh) -> List[Any]:
+    specs = lora_pool_specs(targets)
+    if len(specs) != len(flat):
+        raise ValueError(
+            f"LoRA flat list has {len(flat)} tensors, expected "
+            f"{len(specs)} for targets {tuple(targets)}")
+    return [jax.device_put(a, NamedSharding(mesh, s))
+            for a, s in zip(flat, specs)]
+
+
+def place_replicated(x: Any, mesh: Mesh) -> Any:
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def audit_pool_shardings(pools: Sequence[Any], mesh: Mesh) -> Dict[str, int]:
+    """Conservation audit for the sharded pools: donation rotates pool
+    buffers every trip, so verify each tensor still carries the layout it
+    was placed with (a silent reshard to replicated would triple HBM and
+    break the per-shard capacity math). Returns per-shard accounting for
+    ``GenerationServer.assert_conserved()``."""
+    tp = mesh.shape[SERVING_TP_AXIS]
+    shard_bytes = 0
+    for p in pools:
+        want = NamedSharding(mesh, pool_spec(p.ndim))
+        got = getattr(p, "sharding", None)
+        if got is None or not got.is_equivalent_to(want, p.ndim):
+            raise AssertionError(
+                f"pool tensor {p.shape} lost its tp sharding: have {got}, "
+                f"expected {want}")
+        shard_bytes += p.nbytes // tp
+    return {"tp": tp, "pool_tensors": len(pools),
+            "pool_bytes_per_shard": shard_bytes}

@@ -1,0 +1,204 @@
+"""Disaggregated prefill/decode fleets (inference/fleet.py +
+serving.py role=): replicas split into a prefill class (chunked prefill
+only — finished requests park and hand off) and a decode class; the
+handoff rides the SAME CRC-verified evacuate(rids=)/admit_migrated path
+as every other migration. Token output must be identical to an
+undisturbed single-engine run — including under a seeded prefill-replica
+kill mid-chunk (salvage onto the decode class via replay re-prefill) and
+a corrupted handoff payload (CRC catch → re-prefill). Quick tier on
+CPU."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.faults import FaultInjector, FaultPlan, FaultSpec
+from paddle_tpu.inference.fleet import (REPLICA_DEGRADED, REPLICA_LIVE,
+                                        FleetRouter)
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _prompts(cfg, lens=(18, 11, 7, 9)):
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, cfg.vocab_size, (n,)).tolist() for n in lens]
+
+
+def _server(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("cache", "paged")
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 16)
+    return GenerationServer(model, **kw)
+
+
+def _baseline(model, prompts, max_new=12):
+    srv = _server(model)
+    rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    out = srv.run()
+    return [out[r] for r in rids]
+
+
+def test_disagg_handoff_token_identical():
+    """1 prefill + 1 decode replica: every request prefills on the
+    prefill class, hands off over evacuate(rids=)/admit_migrated, and
+    decodes on the decode class — tokens identical to a single engine,
+    with the handoff visible in fleet metrics and conservation holding
+    on both replicas afterwards."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    base = _baseline(model, prompts)
+    fleet = FleetRouter([_server(model, role="prefill"),
+                         _server(model, role="decode")])
+    assert fleet.disagg
+    rids = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+    # every fresh submission routed to the prefill replica (idx 0)
+    assert all(fleet._home[r] == 0 for r in rids)
+    out = fleet.run()
+    assert [out[r] for r in rids] == base
+    fm = fleet.fleet_metrics()
+    assert fm["disagg"] is True
+    assert fm["prefill_replicas"] == 1 and fm["decode_replicas"] == 1
+    assert fm["handoff_requests"] == len(prompts)
+    assert fm["handoffs"] >= 1
+    assert fm["migration_latency_samples"] == len(prompts)
+    assert fm["migration_latency_p95_s"] >= fm["migration_latency_p50_s"] >= 0
+    # requests finished on the decode replica
+    assert all(fleet._home[r] == 1 for r in rids)
+    fleet.assert_conserved()
+
+
+def test_prefill_class_refuses_decode_phase_admits():
+    """A prefill-class replica must reject decode-phase payloads at the
+    door — both a KV handoff and a replayed request that already
+    generated tokens — without mutating any state."""
+    model, cfg = _model()
+    donor = _server(model)
+    rid = donor.submit(_prompts(cfg)[0], max_new_tokens=12)
+    for _ in range(8):   # past prefill, into decode
+        donor.step()
+    snap = donor.evacuate(trust_kv=True)
+    (d,) = snap["requests"]
+    assert d["phase"] == "kv"
+
+    pre = _server(model, role="prefill")
+    with pytest.raises(ValueError, match="decode-phase"):
+        pre.admit_migrated(d, source_config=snap["config"])
+    # replay form (no KV payload, but generated tokens) is refused too
+    replay = dict(d, phase="queued", kv=None,
+                  replay=list(d["prompt"]) + [5], generated=[5])
+    with pytest.raises(ValueError, match="decode-phase"):
+        pre.admit_migrated(replay, source_config=snap["config"])
+    assert pre.load_metrics()["queue_depth"] == 0
+    assert pre.load_metrics()["slots_occupied"] == 0
+    pre.assert_conserved()
+    # a decode-class replica accepts the same payload and finishes it
+    dec = _server(model, role="decode")
+    dec.admit_migrated(d, source_config=snap["config"])
+    out = dec.run()
+    assert rid in out
+
+
+def test_route_scores_only_same_class_peers():
+    """route() must consider only prefill-capable peers for fresh
+    submissions; with the whole prefill class down it degrades to the
+    decode class (re-prefill) instead of refusing."""
+    model, cfg = _model()
+    fleet = FleetRouter([_server(model, role="prefill"),
+                         _server(model, role="prefill"),
+                         _server(model, role="decode")])
+    p = _prompts(cfg)[0]
+    assert [r.idx for r in fleet._route(p)] == [0, 1]
+    fleet.kill(0)
+    assert [r.idx for r in fleet._route(p)] == [1]
+    fleet.kill(1)
+    assert [r.idx for r in fleet._route(p)] == [2]   # degraded fallback
+    rid = fleet.submit(p, max_new_tokens=6)
+    out = fleet.run()
+    assert out[rid] == _baseline(model, [p], max_new=6)[0]
+
+
+def test_class_membership_survives_degrade_recover():
+    """A degraded prefill replica recovers as a PREFILL replica: the
+    health ladder moves state, never class."""
+    clk = {"t": 0.0}
+    model, cfg = _model()
+    fleet = FleetRouter([_server(model, role="prefill"),
+                         _server(model, role="decode")],
+                        clock=lambda: clk["t"], degrade_cooldown_s=5.0)
+    rep = fleet._replicas[0]
+    fleet._degrade(rep, "test")
+    assert rep.state == REPLICA_DEGRADED and rep.role == "prefill"
+    # degraded prefill replica is still the only prefill-capable peer
+    assert [r.idx for r in fleet._route(_prompts(cfg)[0])] == [0]
+    # cooldown not yet elapsed: a progressing tick keeps it degraded
+    clk["t"] = 2.0
+    fleet.step()
+    assert rep.state == REPLICA_DEGRADED
+    clk["t"] = 7.0
+    fleet.step()
+    assert rep.state == REPLICA_LIVE and rep.role == "prefill"
+    fm = fleet.fleet_metrics()
+    assert fm["prefill_replicas"] == 1 and fm["decode_replicas"] == 1
+
+
+def test_seeded_prefill_kill_salvages_onto_decode_class():
+    """replica_down on the prefill replica mid-chunk: its in-flight
+    prompts salvage onto the decode class through host-state replay
+    re-prefill — zero token mismatches, zero lost requests."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    base = _baseline(model, prompts)
+    # ordinal 2 = the prefill replica (idx 0) on router tick 2 —
+    # mid-chunk for the 18-token prompt with prefill_chunk=16
+    inj = FaultInjector(FaultPlan(specs=[FaultSpec("replica_down", at=2)],
+                                  seed=5))
+    fleet = FleetRouter([_server(model, role="prefill"),
+                         _server(model, role="decode")], faults=inj)
+    rids = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+    out = fleet.run()
+    assert fleet.replica_states() == ["dead", "live"]
+    assert [out[r] for r in rids] == base
+    fm = fleet.fleet_metrics()
+    assert fm["deaths"] == 1
+    assert fm["prefill_replicas"] == 0 and fm["decode_replicas"] == 1
+    fleet.assert_conserved()
+
+
+def test_corrupted_handoff_payload_degrades_to_reprefill():
+    """A handoff payload corrupted in transit must be caught by the
+    decode replica's CRC check and re-prefilled — token-exact."""
+    model, cfg = _model()
+    prompts = _prompts(cfg)
+    base = _baseline(model, prompts)
+    inj = FaultInjector(FaultPlan(
+        specs=[FaultSpec("migrate_payload", at=0, count=2)], seed=9))
+    fleet = FleetRouter([_server(model, role="prefill"),
+                         _server(model, role="decode")], faults=inj)
+    rids = [fleet.submit(p, max_new_tokens=12) for p in prompts]
+    out = fleet.run()
+    assert [out[r] for r in rids] == base
+    fm = fleet.fleet_metrics()
+    assert fm["migrate_corruptions"] == 2
+    assert fm["handoff_requests"] == len(prompts)
+    fleet.assert_conserved()
+
+
+def test_disagg_router_validation():
+    model, _ = _model()
+    with pytest.raises(ValueError, match="decode-capable"):
+        FleetRouter([_server(model, role="prefill")])
+    with pytest.raises(ValueError, match="prefill-capable"):
+        FleetRouter([_server(model, role="decode")])
+    # an "any" replica satisfies both classes
+    fleet = FleetRouter([_server(model, role="prefill"), _server(model)])
+    assert fleet.disagg

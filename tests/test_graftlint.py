@@ -437,6 +437,41 @@ class TestWallClockGL012:
         """, path="paddle_tpu/benchmarks/timer.py")
 
 
+class TestBareTransferGL014:
+    SERVING = "paddle_tpu/inference/mod.py"
+
+    def test_bare_transfers_in_inference(self):
+        ids = [f.rule_id for f in lint("""
+            import jax
+
+            def place(self, pools, arr):
+                pools = [jax.device_put(p) for p in pools]
+                host = jax.device_get(arr)
+                return pools, host
+        """, path=self.SERVING)]
+        assert ids.count("GL014") == 2
+
+    def test_mesh_helper_seam_is_sanctioned(self):
+        # routing placement through parallel/serving_mesh.py (which
+        # carries the tp NamedSharding) is THE pattern
+        assert "GL014" not in rule_ids("""
+            from ..parallel import serving_mesh as sm
+
+            def shard(self, pools, mesh):
+                return sm.place_pools(pools, mesh)
+        """, path=self.SERVING)
+
+    def test_outside_inference_package_is_out_of_scope(self):
+        # tools/benchmarks and the mesh helpers themselves transfer
+        # freely; only the serving engine is held to the seam contract
+        assert "GL014" not in rule_ids("""
+            import jax
+
+            def place(params, shardings):
+                return jax.device_put(params, shardings)
+        """, path="paddle_tpu/parallel/serving_mesh.py")
+
+
 class TestNonAtomicCkptWriteGL013:
     CKPT = "paddle_tpu/distributed/checkpoint_util.py"
 
@@ -660,7 +695,7 @@ class TestRepoGate:
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                     "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                    "GL013"):
+                    "GL013", "GL014"):
             assert rid in r.stdout
 
 

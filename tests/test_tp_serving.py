@@ -1,0 +1,183 @@
+"""TP-sharded paged serving (mesh="tp=N"): the executor places params,
+KV pools, int8 scales, and LoRA pages onto a 1-D ``tp`` mesh and runs the
+UNMODIFIED compiled programs under GSPMD — so every mode (fp, int8,
+±LoRA, ±spec) must emit TOKEN-IDENTICAL output to the single-chip
+engine, keep its pools sharded through donation rotations, and hold the
+zero-steady-state-recompile contract under request/adapter churn.
+Quick tier on an n=2 (and n=4) CPU dryrun mesh — conftest forces 8 host
+devices via XLA_FLAGS."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _model(kv_heads=2):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=kv_heads,
+                      max_position_embeddings=160,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _prompts(cfg, lens=(18, 11, 7)):
+    rng = np.random.RandomState(11)
+    return [rng.randint(1, cfg.vocab_size, (n,)).tolist() for n in lens]
+
+
+def _run(mesh, *, kv_heads=2, kv_quant="none", lora=False, spec=False,
+         max_new=10):
+    """Build a server (sharded iff mesh), drain a canonical workload,
+    return ({rid_order: tokens}, server)."""
+    model, cfg = _model(kv_heads)
+    kw = {}
+    if lora:
+        from test_lora_serving import _adapter_weights
+
+        from paddle_tpu.inference.lora import AdapterRegistry, LoRAConfig
+        reg = AdapterRegistry()
+        reg.register("a1", _adapter_weights(cfg, 4, seed=1), rank=4,
+                     alpha=8.0)
+        kw["lora"] = LoRAConfig(reg, max_live_adapters=2, max_rank=4)
+    if spec:
+        from paddle_tpu.inference.speculative import SpecConfig
+        kw["spec"] = SpecConfig(k=3)
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16,
+                           kv_quant=kv_quant, mesh=mesh, **kw)
+    rids = [srv.submit(p, max_new_tokens=max_new, temperature=0.0,
+                       adapter=("a1" if (lora and i % 2 == 0) else None))
+            for i, p in enumerate(_prompts(cfg))]
+    out = srv.run()
+    return [out[r] for r in rids], srv
+
+
+def test_tp2_fp_token_identical_and_pools_stay_sharded():
+    """Greedy fp decode at tp=2 must equal the single-chip engine token
+    for token, and the donated pool buffers must still carry their tp
+    sharding afterwards (assert_conserved audits it)."""
+    base, _ = _run(None)
+    tp, srv = _run("tp=2")
+    assert tp == base
+    audit = srv.assert_conserved()
+    assert audit["tp"] == 2
+    assert audit["pool_tensors"] == 2 * srv.model.cfg.num_hidden_layers
+    assert audit["pool_bytes_per_shard"] > 0
+    st = srv.alloc.stats()
+    assert st["shards"] == 2
+    assert st["bytes_per_block_shard"] * 2 == st["bytes_per_block"]
+
+
+def test_tp2_int8_lora_token_identical():
+    """int8 KV (per-(block, kv-head) scales shard with their heads) and
+    LoRA pages (A/B factors shard with their base weight) together at
+    tp=2 — token-identical to single-chip."""
+    base, _ = _run(None, kv_quant="int8", lora=True)
+    tp, srv = _run("tp=2", kv_quant="int8", lora=True)
+    assert tp == base
+    # int8 pools: Kq/Kscale/Vq/Vscale per layer, all audited sharded
+    assert srv.assert_conserved()["pool_tensors"] == \
+        4 * srv.model.cfg.num_hidden_layers
+
+
+def test_tp2_spec_token_identical():
+    """Fused speculative scan (draft→verify→accept in-program) under
+    GSPMD at tp=2 — acceptance decisions and emitted tokens identical."""
+    base, _ = _run(None, spec=True)
+    tp, _ = _run("tp=2", spec=True)
+    assert tp == base
+
+
+@pytest.mark.slow
+def test_tp4_token_identical():
+    """n=4 mesh (needs 4 KV heads for even head sharding)."""
+    base, _ = _run(None, kv_heads=4, max_new=6)
+    tp, srv = _run("tp=4", kv_heads=4, max_new=6)
+    assert tp == base
+    assert srv.assert_conserved()["tp"] == 4
+
+
+def test_mesh_fingerprint_stamped_not_gated():
+    """Snapshots stamp the mesh fingerprint for provenance, but payloads
+    are full-width host gathers — a tp=2 snapshot must restore into a
+    single-chip server (and finish with identical tokens)."""
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, mesh="tp=2")
+    rids = [srv.submit(p, max_new_tokens=8, temperature=0.0)
+            for p in _prompts(cfg)]
+    for _ in range(6):
+        srv.step()
+    snap = srv.snapshot()
+    assert snap["config"]["mesh"] == "tp2"
+    done = srv.run()
+
+    model2, _ = _model()
+    dst = GenerationServer(model2, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16)
+    assert dst._exec.mesh_fingerprint == "tp1"
+    dst.restore(snap)
+    out = dst.run()
+    out.update(dst.take_results())
+    for r in rids:
+        assert out[r] == done[r]
+
+
+@pytest.mark.graftlint
+def test_tp2_steady_state_zero_recompiles_under_churn():
+    """The partitioned programs must hit the jit cache exactly like the
+    single-chip ones: after warmup (±adapter), a second wave with new
+    lengths, slot churn, and adapter swaps compiles NOTHING."""
+    from test_lora_serving import _adapter_weights
+
+    from paddle_tpu.analysis import jit_cache_guard
+    from paddle_tpu.inference.lora import AdapterRegistry, LoRAConfig
+
+    model, cfg = _model()
+    reg = AdapterRegistry()
+    for i in range(3):
+        reg.register(f"a{i}", _adapter_weights(cfg, 2, seed=10 + i),
+                     rank=2, alpha=4.0)
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16, mesh="tp=2",
+                           lora=LoRAConfig(reg, max_live_adapters=2,
+                                           max_rank=2))
+    rng = np.random.RandomState(3)
+    for i in range(2):
+        srv.submit(rng.randint(1, cfg.vocab_size, (6,)).tolist(),
+                   max_new_tokens=6, adapter=f"a{i}")
+    srv.run()
+
+    rids = []
+    with jit_cache_guard("tp serving steady state") as g:
+        for i, name in enumerate(("a2", None, "a0", "a1")):
+            rids.append(srv.submit(
+                rng.randint(1, cfg.vocab_size, (4 + 3 * i,)).tolist(),
+                max_new_tokens=6, adapter=name))
+        out = srv.run()
+    assert g.compiles == 0
+    assert all(len(out[r]) >= 7 for r in rids)
+    srv.assert_conserved()  # pools still sharded after the churn
+
+
+def test_tp_validation():
+    """Construction-time refusals: uneven shard dims, dense cache, bad
+    mesh spec, bad role, role without paged."""
+    model, cfg = _model()   # kv_heads=2: tp=3 divides nothing evenly
+    with pytest.raises(ValueError, match="does not divide"):
+        GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                         block_size=8, mesh="tp=3")
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(model, max_batch=2, max_len=96, mesh="tp=2")
+    with pytest.raises(ValueError, match="mesh"):
+        GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                         block_size=8, mesh="dp=2")
+    with pytest.raises(ValueError, match="role"):
+        GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                         block_size=8, role="verifier")
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(model, max_batch=2, max_len=96, role="prefill")

@@ -9,10 +9,22 @@ are the real Mosaic kernels; elsewhere they run in interpret mode (slower
 than the reference — the point there is parity and plumbing, not speed,
 which is why the suite's perf gate only reads the speedup on hardware).
 
+``--tp N`` additionally runs every combo under ``jax.jit`` +
+``shard_map`` over an N-way "tp" mesh with the SERVING shard layout
+(q/KV pools split on the head axis, int8 scales with their heads,
+tables/pos replicated — parallel/serving_mesh.py's pool_spec): each
+shard executes the same Pallas kernel on its head slice, exactly what
+the multi-chip serving tick lowers to. The row gains ``tp_tok_s`` /
+``tp_max_abs_diff`` / ``tp_parity``, and the parity gate covers the
+sharded output against the unsharded reference too (attention has no
+cross-head reduction, so sharding must not move the result). On CPU
+(JAX_PLATFORMS=cpu) the tool forces N XLA host devices for the dryrun.
+
 Usage:
     python tools/kernel_bench.py [--json] [--iters 10]
         [--shapes 2,4,8;4,8,16] [--window 4] [--heads 8] [--kv-heads 2]
         [--head-dim 128] [--ops decode,verify,prefill] [--quant fp,int8]
+        [--tp N]
 
 One JSON line per (op, quant, B, M, bs) combo under --json (bench.py
 style); a human table otherwise.
@@ -79,8 +91,25 @@ def main():
     ap.add_argument("--ops", default="decode,verify,prefill")
     ap.add_argument("--quant", default="fp,int8")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="also run every combo sharded over an N-way "
+                         "'tp' mesh (shard_map, serving shard layout) "
+                         "and gate parity vs the unsharded reference")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
+    if args.tp > 1:
+        if args.heads % args.tp or args.kv_heads % args.tp:
+            ap.error("--tp must divide --heads and --kv-heads (the mesh "
+                     "shards the head axis)")
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                and "xla_force_host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            # CPU dryrun mesh needs tp host devices; only effective
+            # before the jax import below
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count"
+                  f"={args.tp}").strip()
 
     import numpy as np
     import jax
@@ -92,6 +121,32 @@ def main():
 
     backend = jax.default_backend()
     on_tpu = backend in ("tpu", "axon")
+
+    mesh = None
+    if args.tp > 1:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if len(jax.devices()) < args.tp:
+            sys.exit(f"--tp {args.tp} needs {args.tp} devices, have "
+                     f"{len(jax.devices())}")
+        mesh = Mesh(np.array(jax.devices()[:args.tp]), ("tp",))
+        # the serving shard layout (parallel/serving_mesh.pool_spec):
+        # 4-D pool/q tensors split on the kv-/q-head axis, 2-D int8
+        # scale tensors with their heads, block tables and positions
+        # replicated
+        _HEADS = P(None, None, "tp", None)
+        _SCALES = P(None, "tp")
+
+        def tp_specs(op, quant):
+            if quant == "int8":
+                pool = (_HEADS, _SCALES, _HEADS, _SCALES)
+            else:
+                pool = (_HEADS, _HEADS)
+            if op == "prefill":                  # (q, *pools, table)
+                return (_HEADS, *pool, P())
+            return (_HEADS, *pool, P(), P())     # (q, *pools, tables, pos)
 
     def timed(fn, fn_args):
         # fresh lambda: jax's tracing cache is keyed on function identity,
@@ -139,11 +194,27 @@ def main():
                         fn_args = (q, *pools, tables, pos)
                         tok = B * W
                     mode = ops.kernel_mode()
+                    tp_s, tp_out = None, None
                     try:
                         ops.set_kernel_mode("reference")
                         ref_s, ref_out = timed(fn, fn_args)
                         ops.set_kernel_mode("pallas")
                         pal_s, pal_out = timed(fn, fn_args)
+                        if mesh is not None:
+                            # same kernel, per-shard head slices: jit a
+                            # fresh shard_map lambda (cache is keyed on
+                            # function identity — see timed) over
+                            # explicitly sharded inputs so the GSPMD
+                            # lowering is what gets measured
+                            specs = tp_specs(op, quant)
+                            sfn = shard_map(fn, mesh=mesh,
+                                            in_specs=specs,
+                                            out_specs=_HEADS,
+                                            check_rep=False)
+                            sargs = tuple(
+                                jax.device_put(a, NamedSharding(mesh, s))
+                                for a, s in zip(fn_args, specs))
+                            tp_s, tp_out = timed(sfn, sargs)
                     finally:
                         ops.set_kernel_mode(mode)
                     diff = float(jnp.max(jnp.abs(
@@ -163,11 +234,21 @@ def main():
                         "max_abs_diff": diff,
                         "parity": diff < 2e-5,
                     })
+                    if tp_out is not None:
+                        tp_diff = float(jnp.max(jnp.abs(
+                            ref_out.astype(jnp.float32) -
+                            tp_out.astype(jnp.float32))))
+                        rows[-1].update({
+                            "tp": args.tp,
+                            "tp_tok_s": round(tok / tp_s, 1),
+                            "tp_max_abs_diff": tp_diff,
+                            "tp_parity": tp_diff < 2e-5,
+                        })
         if not locked:
             for r in rows:
                 r["lock_contended"] = True
 
-    ok = all(r["parity"] for r in rows)
+    ok = all(r["parity"] and r.get("tp_parity", True) for r in rows)
     if args.json:
         for r in rows:
             print(json.dumps(r))

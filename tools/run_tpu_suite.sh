@@ -239,7 +239,7 @@ print(f"deaths {r['fleet_deaths']} (states {r['fleet_states']}), "
       f"mismatches {r['token_mismatches']}, recompiles "
       f"{r['drain_recompiles']}/{r['ref_drain_recompiles']} (fleet/ref), "
       f"tok/s {r['value']} vs twin {r['ref_tok_s']}")
-assert r.get("schema_version") == 2, "benchmark schema drifted"
+assert r.get("schema_version") == 3, "benchmark schema drifted"
 assert r.get("config_fingerprint"), "missing config fingerprint"
 assert r["fleet_deaths"] == 1, "seeded kill never landed — gate vacuous"
 assert r["fleet_states"]["dead"] == 1 and r["fleet_states"]["live"] == 1
@@ -290,6 +290,70 @@ if on_tpu:
     assert not slow, f"Mosaic kernels slower than reference: {slow}"
     assert srv["kernel_tok_s"] >= srv["kernel_ref_tok_s"], \
         "fused decode attention lost to the gather reference on TPU"
+PY
+
+echo "== 7h. multi-chip serving gate (tp=2 dryrun token-equal to single-chip; disaggregated 1+1 fleet with seeded prefill kill) =="
+# CPU dryrun mesh ON PURPOSE (JAX_PLATFORMS=cpu + forced host devices):
+# the TP claim being gated is TOKEN equality + zero steady-state
+# recompiles under GSPMD sharding, which the host backend proves without
+# burning chip time; on-chip tp throughput is a pod-slice measurement,
+# not a single-chip suite stage
+JAX_PLATFORMS=cpu python -m pytest tests/test_tp_serving.py tests/test_fleet_disagg.py -q \
+  || { echo "multi-chip serving suite FAILED (TP token divergence or"\
+       "disagg handoff regression)"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_tp1_dryrun.json \
+  || { echo "tp=1 dryrun FAILED"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --mesh tp=2 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_tp2_dryrun.json \
+  || { echo "tp=2 dryrun FAILED (recompile guard tripped or the mesh"\
+       "path crashed)"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --fleet 2 \
+  --disagg --chaos --strict --requests 24 --slots 4 --max-new 48 \
+  --tick-window 4 --seed 3 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_disagg.json \
+  || { echo "disaggregated fleet gate FAILED (prefill-kill salvage drain"\
+       "above the twin's compile budget, or dirty watchdog)"; exit 1; }
+JAX_PLATFORMS=cpu python tools/kernel_bench.py --tp 2 --shapes 2,4,8 \
+  --iters 2 --json | tee /tmp/tpu_runs/kernel_bench_tp.json \
+  || { echo "sharded kernel parity FAILED (shard_map head-slice output"\
+       "diverged from the unsharded reference)"; exit 1; }
+python - <<'PY'
+# multi-chip gate: the tp=2 line must be TOKEN-IDENTICAL to the tp=1
+# line (same seed, same traffic — the fingerprint hashes every output
+# sequence), carry the per-chip normalization, and hold the v3 schema;
+# the disaggregated run must kill exactly the prefill replica, salvage
+# every in-flight request onto the decode class token-exact, and come
+# back watchdog-clean
+import json
+t1 = json.load(open("/tmp/tpu_runs/serving_tp1_dryrun.json"))
+t2 = json.load(open("/tmp/tpu_runs/serving_tp2_dryrun.json"))
+dg = json.load(open("/tmp/tpu_runs/serving_disagg.json"))
+print(f"tp1 {t1['value']} tok/s vs tp2 {t2['value']} "
+      f"({t2['tok_s_per_chip']}/chip), fingerprints "
+      f"{t1['tokens_fingerprint']}/{t2['tokens_fingerprint']}; disagg "
+      f"deaths {dg['fleet_deaths']} (states {dg['fleet_states']}), "
+      f"handoffs {dg['handoffs']}, salvage lat p95 "
+      f"{dg['migration_latency_p95_s']}s, mismatches "
+      f"{dg['token_mismatches']}")
+assert t1.get("schema_version") == t2.get("schema_version") == 3
+assert t1["tp"] == 1 and t2["tp"] == 2 and t2["mesh"] == "tp2"
+assert t1["tokens_fingerprint"] == t2["tokens_fingerprint"], \
+    "tp=2 serving diverged from single-chip tokens"
+assert abs(t2["tok_s_per_chip"] - t2["value"] / 2) < 0.1
+assert dg["disagg"] is True and dg["fleet_deaths"] == 1
+assert dg["fleet_states"]["dead"] == 1 and dg["fleet_states"]["live"] == 1
+assert dg["prefill_replicas"] == 0, \
+    "the seeded kill missed the prefill class"
+assert dg["decode_replicas"] == 1
+assert dg["token_mismatches"] == 0 and dg["quarantined"] == 0, \
+    "prefill-kill salvage lost or diverged a request"
+assert dg["migration_latency_samples"] >= 1
+assert dg["migration_latency_p95_s"] >= dg["migration_latency_p50_s"] >= 0
+assert dg["watchdog_after_recovery"] == 0, \
+    "decode-class survivor dirty after recovery"
 PY
 
 echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="

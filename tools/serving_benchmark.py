@@ -62,6 +62,25 @@ one replica is killed mid-decode and the line reports
 ``ref_drain_recompiles / drain_recompiles`` — the failover drain is
 held to the twin's compile budget by the same jit-cache guard.
 
+Multi-chip (docs/serving.md "Multi-chip serving"): ``--mesh tp=N``
+serves the same workload with params, KV block pool, int8 scales, and
+LoRA pages sharded over an N-way tensor-parallel mesh (placement-only
+GSPMD sharding — the compiled programs are unchanged and tokens are
+bit-identical to tp=1). The JSON line gains ``tp`` / ``mesh`` /
+``tok_s_per_chip`` (= value / (tp x replicas)) and a
+``tokens_fingerprint`` hash of every output sequence, so a suite gate
+can assert token-equality across mesh widths from the lines alone. On
+CPU (JAX_PLATFORMS=cpu) the tool forces enough XLA host devices for the
+dryrun mesh. ``--disagg`` (with ``--fleet N``) specializes the replicas
+into floor(N/2) prefill-class + the rest decode-class engines: fresh
+prompts route to the prefill class, finished prefills hand off over the
+CRC-verified migration path, and the line gains ``prefill_replicas`` /
+``decode_replicas`` / ``handoffs`` / ``handoff_requests`` plus
+migration-latency percentiles. Under ``--chaos`` the disaggregated
+fleet runs a seeded PREFILL-replica kill (``FaultPlan.disagg_chaos``)
+instead of the generic fleet plan, so the salvage-onto-decode-class
+path is what the twin comparison exercises.
+
 Every JSON line carries ``schema_version`` plus ``config_fingerprint``
 (a stable hash of the resolved workload/config knobs, reporting-only
 flags excluded) so downstream tooling can both detect schema drift and
@@ -73,7 +92,7 @@ Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
        [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
         [--host-pool-mb M] [--prefill-chunk 64]
         [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]
-        [--fleet N] [--chaos [--strict]]]
+        [--mesh tp=N] [--fleet N [--disagg]] [--chaos [--strict]]]
        [--json]
 """
 from __future__ import annotations
@@ -89,8 +108,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 #: Bump when the JSON line's keys change meaning or go away (adding keys
 #: is compatible and does NOT bump): 2 = schema_version/config_fingerprint
-#: introduced alongside the --fleet rows.
-SCHEMA_VERSION = 2
+#: introduced alongside the --fleet rows; 3 = ``value`` is still FLEET-WIDE
+#: tok/s but the normalized figure moved to the new ``tok_s_per_chip``
+#: (value / (tp x replicas)) — readers that treated the fleet ``value`` as
+#: a per-chip number must switch keys. Every v2 key is still present.
+SCHEMA_VERSION = 3
 
 
 def config_fingerprint(args) -> str:
@@ -283,6 +305,26 @@ def main():
                          "after the drain. The TTFT/TPOT percentiles in "
                          "the JSON line come from the same registry "
                          "histograms either way")
+    ap.add_argument("--mesh", default=None, metavar="tp=N",
+                    help="serve over an N-way tensor-parallel device mesh "
+                         "(paged only): params, KV block pool, int8 "
+                         "scales, and LoRA pages shard over the tp axis; "
+                         "tokens stay bit-identical to tp=1 (the line's "
+                         "tokens_fingerprint proves it) and the line "
+                         "gains tp/tok_s_per_chip. Accepts 'tp=N' or a "
+                         "bare int. On CPU the tool forces N XLA host "
+                         "devices for the dryrun")
+    ap.add_argument("--disagg", action="store_true",
+                    help="with --fleet N: specialize the replicas into "
+                         "floor(N/2) prefill-class + the rest "
+                         "decode-class engines — fresh prompts route to "
+                         "the prefill class, finished prefills hand off "
+                         "to the decode class over the CRC-verified "
+                         "migration path; the line gains "
+                         "prefill_replicas/decode_replicas/handoffs + "
+                         "migration-latency percentiles. With --chaos "
+                         "the seeded plan kills a PREFILL replica so "
+                         "the decode-class salvage path is exercised")
     ap.add_argument("--fleet", type=int, default=0, metavar="N",
                     help="route the traffic through a FleetRouter of N "
                          "replica engines (paged only, N >= 2): "
@@ -331,6 +373,30 @@ def main():
         if args.arrival_rate is not None:
             ap.error("--fleet uses the closed-loop burst (seeded bursty "
                      "traffic); --arrival-rate is not modeled for it")
+    if args.disagg and not args.fleet:
+        ap.error("--disagg requires --fleet N (N >= 2): prefill and "
+                 "decode classes need separate replicas")
+    tp = 1
+    if args.mesh is not None:
+        if not args.paged:
+            ap.error("--mesh requires --paged (the sharded pools ARE the "
+                     "paged substrate)")
+        m = str(args.mesh)
+        try:
+            tp = int(m.split("=", 1)[1]) if "=" in m else int(m)
+        except ValueError:
+            ap.error("--mesh must be an int tp degree or 'tp=N'")
+        if tp < 1:
+            ap.error("--mesh tp degree must be >= 1")
+        if tp > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                and "xla_force_host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            # CPU dryrun: the mesh needs tp host devices, and the flag
+            # only takes effect if set BEFORE jax is imported (which is
+            # why the jax imports below sit under main())
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={tp}").strip()
     if args.pool_frac is not None and not args.paged:
         ap.error("--pool-frac requires --paged")
     if args.host_pool_mb is not None and not args.paged:
@@ -463,7 +529,7 @@ def main():
     from paddle_tpu.analysis.recompile_guard import jit_cache_guard
     from paddle_tpu.utils.bench_timing import tpu_lock
 
-    def make_server(faults=None, sched=None):
+    def make_server(faults=None, sched=None, role="any"):
         if args.paged:
             spec = None
             if args.spec:
@@ -521,7 +587,8 @@ def main():
                 host_pool_bytes=host_pool,
                 lora=lora_cfg, faults=faults,
                 telemetry=bool(args.telemetry_out) or args.strict,
-                kernels=args.kernels)
+                kernels=args.kernels, role=role,
+                mesh=(tp if args.mesh is not None else None))
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -639,18 +706,37 @@ def main():
         ref_order = list(ref_rids)
         del ref_server
 
+        # --disagg: floor(N/2) prefill-class replicas first, the rest
+        # decode-class — index order matters, the chaos plan below aims
+        # its seeded kill at a prefill index
+        n_prefill = args.fleet // 2 if args.disagg else 0
+        roles = (["prefill"] * n_prefill
+                 + ["decode"] * (args.fleet - n_prefill)
+                 if args.disagg else ["any"] * args.fleet)
         inj = None
         if args.chaos:
             from paddle_tpu.inference.faults import FaultInjector, FaultPlan
 
-            inj = FaultInjector(
-                FaultPlan.fleet_chaos(args.seed, replicas=args.fleet))
+            plan = (FaultPlan.disagg_chaos(args.seed, replicas=args.fleet,
+                                           prefill=n_prefill)
+                    if args.disagg
+                    else FaultPlan.fleet_chaos(args.seed,
+                                               replicas=args.fleet))
+            inj = FaultInjector(plan)
             inj.enabled = False    # hooks wire now, plan fires at the drain
-        fleet = FleetRouter([make_server() for _ in range(args.fleet)],
+        fleet = FleetRouter([make_server(role=r) for r in roles],
                             faults=inj)
         # warm EVERY replica's prefill/decode (routing spreads the warmup
         # burst by load), then replay the identical measured traffic
         burst(fleet, args.fleet * min(args.slots, 4))
+        if args.disagg:
+            # the router only hands decode replicas KV payloads, so their
+            # chunk-prefill programs never compile through routed warmup
+            # — submit to them directly so the post-kill re-prefill
+            # salvage path compiles nothing new inside the guarded drain
+            for rep in fleet._replicas:
+                if rep.role == "decode":
+                    burst(rep.server, min(args.slots, 4))
         fleet.run()
         for rep in fleet._replicas:
             rep.server.telemetry.reset()
@@ -685,13 +771,33 @@ def main():
         gen_tokens = sum(len(v) - rids[r]
                          for r, v in out.items() if r in rids)
         lats = sorted(done_at[r] for r in rids if r in done_at)
+        roles_note = (f" ({n_prefill} prefill + "
+                      f"{args.fleet - n_prefill} decode)"
+                      if args.disagg else "")
         line = {"metric": "serving_fleet_tok_s_1chip",
                 "value": round(gen_tokens / dt, 1),
                 "unit": f"generated tok/s ({args.requests} reqs, "
-                        f"{args.fleet} replicas x {args.slots} slots, "
-                        f"max_new={args.max_new}, "
+                        f"{args.fleet} replicas{roles_note} x "
+                        f"{args.slots} slots, max_new={args.max_new}, "
                         f"params={n_params/1e6:.0f}M)",
                 "kv_cache": "paged", "fleet": args.fleet,
+                "tp": tp, "mesh": f"tp{tp}",
+                "tok_s_per_chip": round(
+                    gen_tokens / dt / (tp * args.fleet), 1),
+                "tokens_fingerprint": hashlib.sha256(json.dumps(
+                    {str(r): out[r] for r in sorted(rids)
+                     if r in out}).encode()).hexdigest()[:16],
+                "disagg": bool(args.disagg),
+                "prefill_replicas": fm["prefill_replicas"],
+                "decode_replicas": fm["decode_replicas"],
+                "handoffs": fm["handoffs"],
+                "handoff_requests": fm["handoff_requests"],
+                "migration_latency_p50_s": round(
+                    fm["migration_latency_p50_s"], 6),
+                "migration_latency_p95_s": round(
+                    fm["migration_latency_p95_s"], 6),
+                "migration_latency_samples":
+                    fm["migration_latency_samples"],
                 "p50_s": round(lats[len(lats) // 2], 3) if lats else 0.0,
                 "p95_s": round(lats[min(len(lats) - 1,
                                         int(len(lats) * 0.95))], 3)
@@ -821,6 +927,11 @@ def main():
                     f"{'int8' if args.int8 else 'bf16'} weights, "
                     f"params={n_params/1e6:.0f}M)",
             "kv_cache": "paged" if args.paged else "dense",
+            "tp": tp, "mesh": f"tp{tp}",
+            "tok_s_per_chip": round(gen_tokens / dt / tp, 1),
+            "tokens_fingerprint": hashlib.sha256(json.dumps(
+                {str(r): out[r] for r in sorted(rids)
+                 if r in out}).encode()).hexdigest()[:16],
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
             "wall_s": round(dt, 2),
             "seed": args.seed, "scheduler": args.scheduler,
