@@ -1,12 +1,15 @@
 """Telemetry hygiene: no metric/span/flight calls inside jitted bodies.
 
-The serving telemetry contract (``inference/telemetry.py``) is host-only:
-registry counters, span tracers, and the flight recorder run BETWEEN
-device programs, never inside them. A telemetry call inside a jitted
-function is doubly wrong — it executes once at trace time (so the metric
-records the trace, not the steady state) and it tempts a ``.item()``/
-host sync to read the value being recorded, breaking the async dispatch
-pipeline the serving loop depends on.
+The telemetry contract (``paddle_tpu/telemetry.py``, shared by serving
+AND training) is host-only: registry counters, span tracers, and the
+flight recorders run BETWEEN device programs, never inside them. A
+telemetry call inside a jitted function is doubly wrong — it executes
+once at trace time (so the metric records the trace, not the steady
+state) and it tempts a ``.item()``/host sync to read the value being
+recorded, breaking the async dispatch pipeline both the serving loop
+and the train step depend on. The rule is path-unscoped on purpose: a
+traced ``train_step`` in ``parallel/`` is held to the same contract as
+a serving decode body — the engine records AROUND its compiled call.
 """
 from __future__ import annotations
 
@@ -28,7 +31,8 @@ class TelemetryInJitRule(Rule):
     description = ("counter/histogram/span/flight-recorder calls inside a "
                    "jitted function run at trace time only — record around "
                    "the compiled call on the host side "
-                   "(inference/telemetry.py is host-only by contract)")
+                   "(paddle_tpu/telemetry.py is host-only by contract, "
+                   "serving and training alike)")
 
     # receiver components that name a telemetry object outright
     _RECV_EXACT = frozenset({

@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ...faults import (DataFeedFault, FaultInjector, FaultPlan,
                        SimulatedKill, StepFault)
+from ...telemetry import TRAIN_RID as _TRAIN_RID
 from ..launch.rendezvous import KVServer
 from .elastic import ElasticManager, ElasticStatus
 
@@ -91,7 +92,8 @@ class ElasticChaosHarness:
                  injector: Optional[FaultInjector] = None,
                  max_restarts: int = 4, job_id: str = "chaos",
                  heartbeat_interval: float = 0.1, lease_ttl: float = 0.5,
-                 step_retries: int = 3, detect_timeout: float = 10.0):
+                 step_retries: int = 3, detect_timeout: float = 10.0,
+                 telemetry=None):
         self.build = build
         self.total_steps = int(total_steps)
         self.injector = injector or FaultInjector(plan)
@@ -101,6 +103,11 @@ class ElasticChaosHarness:
         self.lease_ttl = lease_ttl
         self.step_retries = int(step_retries)
         self.detect_timeout = detect_timeout
+        # optional TrainTelemetry shared with the run's engine: the
+        # harness attributes each kill→detection→rendezvous→restore
+        # segment to the goodput ledger as recovery (non-productive)
+        # wall, which is what pushes train_goodput_ratio below 1.0
+        self.telemetry = telemetry
 
     def _manager(self, endpoint: str) -> ElasticManager:
         return ElasticManager(endpoint, job_id=self.job_id, np=1,
@@ -120,6 +127,8 @@ class ElasticChaosHarness:
 
     def run(self) -> ChaosReport:
         report = ChaosReport()
+        tel = self.telemetry
+        t_recovery: Optional[float] = None
         port = free_port()
         endpoint = f"127.0.0.1:{port}"
         server = KVServer(port)
@@ -135,6 +144,14 @@ class ElasticChaosHarness:
                 run = self.build(self.injector)
                 try:
                     start = int(run.restore())
+                    if tel is not None and t_recovery is not None:
+                        # lost work (replayed steps) books itself when the
+                        # engine re-records the rolled-back step indices;
+                        # this segment is the rest of the outage
+                        tel.record_recovery(t_recovery, tel.clock(),
+                                            restart=report.restarts,
+                                            resume_step=start)
+                        t_recovery = None
                     step = start
                     while step < self.total_steps:
                         loss = self._step_with_retry(run, step, report)
@@ -148,11 +165,17 @@ class ElasticChaosHarness:
                     report.completed = True
                     return report
                 except SimulatedKill:
+                    if tel is not None:
+                        t_recovery = tel.clock()
                     report.detected_kills += 1
                     mgr.stop()  # heartbeat dies with the incarnation
                     if not self._await_detection(monitor):
                         raise RuntimeError(
                             "kill was never detected by the elastic monitor")
+                    if tel is not None:
+                        tel.tracer.instant(
+                            _TRAIN_RID, "kill_detected",
+                            restart=report.restarts + 1)
                     report.restarts += 1
                 finally:
                     if hasattr(run, "close"):

@@ -34,9 +34,14 @@ primitives) and :mod:`paddle_tpu.faults` (scripted ``ckpt_write`` /
   path-keyed target shardings (GSPMD reshards on load), so a checkpoint
   written on one mesh layout restores onto another.
 
-Observability lands in a :class:`~paddle_tpu.inference.telemetry.MetricsRegistry`
+Observability lands in a :class:`~paddle_tpu.telemetry.MetricsRegistry`
 (``train_checkpoint_*`` counters, save-lag / last-step gauges) — the
-same registry substrate serving uses.
+same registry substrate serving uses. Passing a
+:class:`~paddle_tpu.telemetry.TrainTelemetry` as ``telemetry=`` (to the
+checkpointer AND the data feed) additionally lands ``ckpt_save`` /
+``ckpt_restore`` / ``data_feed`` spans on the training timeline row and
+feeds retry backoffs to the train watchdog's ``ckpt_backoff_storm``
+detector.
 """
 from __future__ import annotations
 
@@ -105,17 +110,25 @@ class CheckpointableDataFeed:
     """
 
     def __init__(self, make_batch: Callable[[int], Any], *, cursor: int = 0,
-                 injector: FaultInjector = NULL_INJECTOR):
+                 injector: FaultInjector = NULL_INJECTOR,
+                 telemetry=None):
         self.make_batch = make_batch
         self.cursor = int(cursor)
         self.injector = injector
+        self.telemetry = telemetry
 
     def next_batch(self) -> Any:
         spec = self.injector.fire("data_feed")
         if spec is not None:
             raise DataFeedFault(
                 f"injected data-feed fault at cursor {self.cursor}")
-        batch = self.make_batch(self.cursor)
+        tel = self.telemetry
+        if tel is None:
+            batch = self.make_batch(self.cursor)
+        else:
+            t0 = tel.clock()
+            batch = self.make_batch(self.cursor)
+            tel.record_data_feed(t0, tel.clock(), cursor=self.cursor)
         self.cursor += 1
         return batch
 
@@ -138,7 +151,8 @@ class TrainCheckpointer:
                  injector: FaultInjector = NULL_INJECTOR,
                  metrics=None, clock: Callable[[], float] = time.monotonic,
                  save_retries: int = 2, backoff_s: float = 0.02,
-                 fingerprint: Optional[str] = None):
+                 fingerprint: Optional[str] = None,
+                 telemetry=None):
         self.save_dir = save_dir
         self.keep_last = int(keep_last)
         self.async_save = async_save
@@ -147,6 +161,9 @@ class TrainCheckpointer:
         self.backoff_s = float(backoff_s)
         self.fingerprint = fingerprint
         self._clock = clock
+        self.telemetry = telemetry
+        if metrics is None and telemetry is not None:
+            metrics = telemetry.registry
         self._registry = metrics
         self._inflight: Optional[threading.Thread] = None
         self.last_error: Optional[str] = None
@@ -159,7 +176,7 @@ class TrainCheckpointer:
         if self._registry is None:
             # lazy: telemetry is a leaf module (numpy/json only), shared
             # with serving so dashboards read one substrate
-            from ..inference.telemetry import MetricsRegistry
+            from ..telemetry import MetricsRegistry
 
             self._registry = MetricsRegistry(clock=self._clock)
         return self._registry
@@ -259,6 +276,8 @@ class TrainCheckpointer:
                                 "saves dropped after exhausting retries")
                     return False
                 self._count("save_retries", "torn-write retry attempts")
+                if self.telemetry is not None:
+                    self.telemetry.note_ckpt_backoff(step=step)
                 time.sleep(self.backoff_s * (2 ** attempt))
         self._count("saves", "generations committed")
         self._gauge("last_step", "step of the newest committed generation",
@@ -284,6 +303,8 @@ class TrainCheckpointer:
         final path (the commit may still be in flight with
         ``async_save=True`` — ``wait()`` joins it), or ``None`` if a
         synchronous commit was dropped by the ladder."""
+        tel = self.telemetry
+        t_span = tel.clock() if tel is not None else 0.0
         t_request = self._clock()
         self.wait()
         arrays, host = self._capture(step, engine, model, optimizer, scaler,
@@ -295,8 +316,16 @@ class TrainCheckpointer:
                 args=(arrays, host, final, int(step), t_request),
                 daemon=True)
             self._inflight.start()
+            if tel is not None:
+                # the span covers the step-path cost only: capture +
+                # thread handoff; the commit rides the worker thread
+                tel.record_ckpt("ckpt_save", t_span, tel.clock(),
+                                step=int(step), mode="async")
             return final
         ok = self._commit(arrays, host, final, int(step), t_request)
+        if tel is not None:
+            tel.record_ckpt("ckpt_save", t_span, tel.clock(),
+                            step=int(step), dropped=not ok)
         return final if ok else None
 
     def wait(self) -> None:
@@ -364,6 +393,8 @@ class TrainCheckpointer:
         from ..framework.random import set_rng_state
         from ..optimizer.lr import LRScheduler
 
+        tel = self.telemetry
+        t_span = tel.clock() if tel is not None else 0.0
         self.wait()
         had_any = bool(self.generations())
         found = self.latest_valid()
@@ -372,6 +403,9 @@ class TrainCheckpointer:
                 raise CheckpointCorruptError(
                     f"no manifest-valid generation under {self.save_dir} "
                     f"(last error: {self.last_error})")
+            if tel is not None:
+                tel.record_ckpt("ckpt_restore", t_span, tel.clock(),
+                                outcome="fresh_start")
             return None
         step, path = found
         manifest = read_manifest(path) or {}
@@ -447,4 +481,7 @@ class TrainCheckpointer:
         if host.get("rng") is not None:
             set_rng_state(host["rng"])
         self._count("restores", "successful restores")
+        if tel is not None:
+            tel.record_ckpt("ckpt_restore", t_span, tel.clock(),
+                            step=int(host["step"]))
         return host

@@ -102,6 +102,17 @@ class ReplicaInfo:
     history: List[Tuple[float, str]] = field(default_factory=list)
 
 
+#: default per-tenant latency objectives for the SLO roll-up. A request
+#: "attains" when its TTFT / TPOT lands at or under the objective;
+#: ``target`` is the attainment goal, so the error budget is
+#: ``1 - target`` and ``burn_rate = violating_fraction / (1 - target)``
+#: — 1.0 means the tenant is consuming its budget exactly, > 1.0 means
+#: the budget will exhaust before the window rolls over. ``window`` is
+#: the rolling per-tenant sample count the roll-up looks back over.
+DEFAULT_SLO = {"ttft_s": 1.0, "tpot_ms": 200.0, "target": 0.95,
+               "window": 256}
+
+
 class FleetRouter:
     """Prefix-aware, health-checked router over in-process replicas.
 
@@ -130,7 +141,8 @@ class FleetRouter:
                  stall_ticks_degraded: int = 8,
                  stall_ticks_dead: int = 64,
                  heartbeat_timeout_s: Optional[float] = None,
-                 degrade_cooldown_s: float = 0.0):
+                 degrade_cooldown_s: float = 0.0,
+                 slos: Optional[Dict[str, Dict[str, float]]] = None):
         if not servers:
             raise ValueError("FleetRouter needs at least one server")
         if faults is None:
@@ -234,6 +246,15 @@ class FleetRouter:
         self._c_handoffs = registry.counter(
             "fleet_prefill_handoffs",
             "prefill→decode handoff sweeps performed (replica label)")
+        # per-tenant SLO objectives: ``slos`` maps tenant → overrides of
+        # DEFAULT_SLO; the "default" entry re-bases every other tenant
+        base_slo = dict(DEFAULT_SLO)
+        if slos and "default" in slos:
+            base_slo.update(slos["default"])
+        self._slo_default = base_slo
+        self._slo_overrides = {t: dict(base_slo, **ov)
+                               for t, ov in (slos or {}).items()
+                               if t != "default"}
 
     # ---------------------------------------------------------------- routing
     def _eligible(self) -> List[ReplicaInfo]:
@@ -573,11 +594,80 @@ class FleetRouter:
         return {r.idx: r.server.assert_conserved()
                 for r in self._replicas}
 
+    def _slo_for(self, tenant: str) -> Dict[str, float]:
+        return self._slo_overrides.get(tenant, self._slo_default)
+
+    def slo_rollup(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant TTFT/TPOT SLO attainment and burn rate, rolled up
+        across every replica's registry.
+
+        Reads the tenant-labeled ``serving_ttft_s`` / ``serving_tpot_ms``
+        histograms each replica's server already records (dead replicas
+        included — their completed requests still count against the
+        tenant's budget), keeps the last ``window`` samples per tenant,
+        and computes ``attainment`` (fraction at or under the objective)
+        and ``burn_rate`` (violating fraction / error budget). The
+        ``fleet_slo_{ttft,tpot}_{attainment,burn_rate}{tenant=...}``
+        gauges land in ``self.registry`` — so the Prometheus exposition
+        carries them — and the same rows come back as the ``slo`` key of
+        :meth:`fleet_metrics`, which is what a canary-promotion gate
+        polls."""
+        reg = self.registry
+        gathered: Dict[str, Dict[str, List[float]]] = {}
+        for rep in self._replicas:
+            tel = getattr(rep.server, "telemetry", None)
+            if tel is None:
+                continue
+            for hname, key in (("serving_ttft_s", "ttft"),
+                               ("serving_tpot_ms", "tpot")):
+                h = tel.registry.get(hname)
+                if h is None:
+                    continue
+                for tenant in h.label_values("tenant"):
+                    w = int(self._slo_for(tenant)["window"])
+                    gathered.setdefault(
+                        tenant, {"ttft": [], "tpot": []})[key].extend(
+                        h.samples({"tenant": tenant})[-w:])
+        out: Dict[str, Dict[str, Any]] = {}
+        for tenant in sorted(gathered):
+            slo = self._slo_for(tenant)
+            budget = max(1e-9, 1.0 - float(slo["target"]))
+            row: Dict[str, Any] = {"target": float(slo["target"]),
+                                   "window": int(slo["window"])}
+            for key, obj_key in (("ttft", "ttft_s"), ("tpot", "tpot_ms")):
+                objective = float(slo[obj_key])
+                samples = gathered[tenant][key][-int(slo["window"]):]
+                viol = (sum(1 for v in samples if v > objective)
+                        / len(samples)) if samples else 0.0
+                attain = 1.0 - viol
+                burn = viol / budget
+                row[key] = {"objective": objective,
+                            "samples": len(samples),
+                            "attainment": attain, "burn_rate": burn}
+                reg.gauge(
+                    f"fleet_slo_{key}_attainment",
+                    f"fraction of the rolling window at or under the "
+                    f"{key} objective (tenant label)").set(
+                    attain, tenant=tenant)
+                reg.gauge(
+                    f"fleet_slo_{key}_burn_rate",
+                    f"{key} violating fraction / error budget; > 1 "
+                    f"exhausts the budget (tenant label)").set(
+                    burn, tenant=tenant)
+                reg.gauge(
+                    f"fleet_slo_{key}_objective",
+                    f"configured {key} objective "
+                    f"({'seconds' if key == 'ttft' else 'ms'}; "
+                    f"tenant label)").set(objective, tenant=tenant)
+            out[tenant] = row
+        return out
+
     def fleet_metrics(self) -> Dict[str, Any]:
         """Sync the ``fleet_*`` gauges and return the fleet view: state
-        census, router counters, and one row per replica (state, load,
-        prefix-cache effectiveness, routed share) — the
-        ``serving_benchmark --fleet N`` table."""
+        census, router counters, per-tenant SLO roll-up (``slo`` key),
+        and one row per replica (state, load, prefix-cache
+        effectiveness, routed share) — the ``serving_benchmark
+        --fleet N`` table."""
         reg = self.registry
         census = {s: 0 for s in (REPLICA_LIVE, REPLICA_DEGRADED,
                                  REPLICA_DRAINING, REPLICA_DEAD)}
@@ -622,6 +712,7 @@ class FleetRouter:
             return lat[min(len(lat) - 1, int(p * len(lat)))]
 
         return {"replicas": rows, "states": census,
+                "slo": self.slo_rollup(),
                 "disagg": self.disagg,
                 "prefill_replicas": sum(r.role == "prefill" for r in up),
                 "decode_replicas": sum(r.role == "decode" for r in up),

@@ -66,7 +66,8 @@ class ParallelEngine:
                  offload_opt_state: bool = False,
                  alias_model_params: bool = False,
                  grad_accum: int = 1,
-                 injector=NULL_INJECTOR):
+                 injector=NULL_INJECTOR,
+                 telemetry=None):
         """abstract=True keeps params/opt-state as ShapeDtypeStructs — the
         step can be .lower()ed (AOT partitioning validation at any scale)
         but not executed.
@@ -110,6 +111,10 @@ class ParallelEngine:
         # sync_to_model (donation consumes the shared buffers)
         self._alias_params = alias_model_params
         self.injector = injector or NULL_INJECTOR
+        # optional TrainTelemetry (paddle_tpu/telemetry.py). None (the
+        # default) keeps train_batch free of timestamp reads and of the
+        # per-step block_until_ready the device_wait span needs.
+        self.telemetry = telemetry
         if offload_opt_state and self.mesh.size > 1:
             raise NotImplementedError(
                 "offload_opt_state is single-device; multi-chip runs shard "
@@ -560,11 +565,20 @@ class ParallelEngine:
         return new_train, new_state
 
     def train_batch(self, *batch):
-        """Run one compiled, sharded train step; returns host loss."""
+        """Run one compiled, sharded train step; returns host loss.
+
+        With ``telemetry`` attached, phase timestamps (host→device
+        assemble, compiled dispatch, device wait) are recorded AROUND the
+        compiled call — never inside it (graftlint GL010) — and the step
+        blocks on the loss so ``device_wait`` measures real device time.
+        """
+        tel = self.telemetry
         if self._train_step is None:
             self.build_train_step()
         lr = self.optimizer.get_lr()
+        t0 = tel.clock() if tel is not None else 0.0
         batch_vals = self._assemble_batch(batch)
+        t_h2d = tel.clock() if tel is not None else 0.0
         if self.grad_accum > 1:
             for b in batch_vals:
                 if b.shape[0] % self.grad_accum:
@@ -580,8 +594,30 @@ class ParallelEngine:
             raise StepFault(
                 f"injected train-step fault at step "
                 f"{int(np.asarray(self._step_count))}")
+        if tel is not None:
+            from ..analysis.recompile_guard import compile_count
+
+            c0 = compile_count()
         self.params, self.opt_state, self._step_count, loss = self._train_step(
             self.params, self.opt_state, self._step_count, lr, batch_vals)
+        if tel is not None:
+            t_dispatch = tel.clock()
+            jax.block_until_ready(loss)
+            t_wait = tel.clock()
+            if not tel.model_params:
+                tel.model_params = sum(
+                    int(np.prod(v.shape)) for n, v in self.params.items()
+                    if n in self._trainable)
+            first = batch_vals[0] if batch_vals else None
+            tokens = 0 if first is None else \
+                int(np.prod(first.shape[:2])) if first.ndim >= 2 \
+                else int(first.shape[0])
+            prog = "train:" + ";".join(
+                "x".join(map(str, b.shape)) for b in batch_vals)
+            tel.record_step(
+                step=int(np.asarray(self._step_count)) - 1, prog=prog,
+                tokens=tokens, t0=t0, t_h2d=t_h2d, t_dispatch=t_dispatch,
+                t_wait=t_wait, compiles=compile_count() - c0)
         from ..framework.monitor import monitor_add
 
         monitor_add("engine_train_steps")

@@ -463,3 +463,38 @@ def test_fleet_metrics_rows_and_registry_sync():
     assert reg.gauge("fleet_replica_up", "").value(replica="0") == 0.0
     assert reg.gauge("fleet_replica_up", "").value(replica="1") == 1.0
     assert reg.counter("fleet_drains", "").total() == 1
+
+
+def test_slo_rollup_per_tenant_burn_rate_and_gauges():
+    """Per-tenant SLO roll-up across replica registries: objectives come
+    from the slos= map (with "default" re-basing), attainment/burn-rate
+    reflect the rolling TTFT/TPOT windows, and the rows land both in
+    fleet_metrics()["slo"] and as router-registry Prometheus gauges."""
+    model, cfg = _model()
+    fleet = FleetRouter(
+        [_server(model, telemetry=True) for _ in range(2)],
+        slos={"default": {"ttft_s": 1e9},          # everything attains
+              "batch": {"ttft_s": 1e-12, "target": 0.9}})  # nothing does
+    rng = np.random.RandomState(3)
+    for i in range(4):
+        fleet.submit(rng.randint(1, cfg.vocab_size, (9 + i,)).tolist(),
+                     max_new_tokens=6, tenant="batch" if i % 2 else "gold")
+    fleet.run()
+    slo = fleet.fleet_metrics()["slo"]
+    assert sorted(slo) == ["batch", "gold"]
+    # "gold" inherits the re-based default objective: full attainment
+    assert slo["gold"]["ttft"]["objective"] == 1e9
+    assert slo["gold"]["ttft"]["attainment"] == 1.0
+    assert slo["gold"]["ttft"]["burn_rate"] == 0.0
+    assert slo["gold"]["target"] == 0.95
+    # "batch" overrides to an unattainable objective: burn = 1/(1-0.9)
+    assert slo["batch"]["ttft"]["attainment"] == 0.0
+    assert slo["batch"]["ttft"]["burn_rate"] == pytest.approx(10.0)
+    assert slo["batch"]["target"] == 0.9
+    # samples were gathered across BOTH replicas' registries
+    assert sum(slo[t]["ttft"]["samples"] for t in slo) == 4
+    # the roll-up is scrapeable from the router registry
+    prom = fleet.registry.to_prometheus()
+    assert 'fleet_slo_ttft_burn_rate{tenant="batch"} 10.0' in prom
+    assert 'fleet_slo_ttft_attainment{tenant="gold"} 1.0' in prom
+    assert 'fleet_slo_ttft_objective{tenant="batch"} 1e-12' in prom

@@ -342,6 +342,39 @@ class TestTelemetryInJitGL010:
                 return pool.at[idx].set(v)
         """)
 
+    def test_traced_train_step_flagged_outside_inference(self):
+        # the rule is package-wide: a traced train_step in parallel/ is
+        # held to the same host-only contract as a serving decode body
+        assert "GL010" in rule_ids("""
+            import jax
+
+            @jax.jit
+            def train_step(params, batch, tel):
+                tel.registry.counter("train_steps").inc()
+                return params
+        """, path="paddle_tpu/parallel/mod.py")
+
+    def test_train_step_recorded_around_dispatch_ok(self):
+        # the engine's sanctioned pattern: timestamps captured around the
+        # compiled call, record_step on the host after block_until_ready
+        assert "GL010" not in rule_ids("""
+            import jax
+
+            def train_step(params, batch):
+                return params
+
+            class Engine:
+                def train_batch(self, batch):
+                    fast = jax.jit(train_step)
+                    t0 = self.telemetry.clock()
+                    out = fast(self.params, batch)
+                    jax.block_until_ready(out)
+                    self.telemetry.registry.histogram(
+                        "train_step_time_s").observe(
+                        self.telemetry.clock() - t0)
+                    return out
+        """, path="paddle_tpu/parallel/mod.py")
+
 
 class TestFaultHookInJitGL011:
     def test_fire_inside_jitted_fn(self):

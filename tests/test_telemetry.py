@@ -482,3 +482,102 @@ def test_disabled_server_records_nothing_but_counts():
     # TTFT histograms still feed the benchmark percentiles when disabled
     assert srv.telemetry.registry.percentile("serving_ttft_s", 50) \
         is not None
+
+
+# --------------------------------------------------------------------------
+# Registry edge cases (exposition hardening)
+# --------------------------------------------------------------------------
+
+class TestMetricsEdgeCases:
+    def test_empty_histogram_percentile_is_none(self):
+        reg = MetricsRegistry()
+        reg.histogram("lat_s")               # registered, zero observations
+        assert reg.percentile("lat_s", 50) is None
+        assert reg.percentile("never_registered", 95) is None
+        # labeled miss on a histogram that HAS other-label data
+        reg.histogram("lat_s").observe(0.2, tenant="a")
+        assert reg.percentile("lat_s", 50, where={"tenant": "ghost"}) is None
+
+    def test_bucket_boundary_value_counts_in_its_le_bucket(self):
+        # Prometheus le buckets are INCLUSIVE upper bounds: an observation
+        # exactly on an edge belongs to that edge's bucket (searchsorted
+        # side="left"), not the next one up
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_s", buckets=(0.1, 1.0))
+        h.observe(0.1)                       # exactly the first edge
+        h.observe(1.0)                       # exactly the last finite edge
+        h.observe(0.1 + 1e-9)                # just past the edge
+        text = reg.to_prometheus()
+        assert 'lat_s_bucket{le="0.1"} 1' in text
+        assert 'lat_s_bucket{le="1.0"} 3' in text
+        assert 'lat_s_bucket{le="+Inf"} 3' in text
+
+    def test_prometheus_escapes_hostile_tenant_names(self):
+        # scrape-format hardening: a tenant string is attacker-ish input;
+        # quotes/backslashes/newlines must come out escaped, one line per
+        # series, instead of corrupting the exposition
+        reg = MetricsRegistry()
+        c = reg.counter("req_total")
+        c.inc(tenant='evil"name')
+        c.inc(tenant="back\\slash")
+        c.inc(tenant="two\nlines")
+        text = reg.to_prometheus()
+        assert 'req_total{tenant="evil\\"name"} 1.0' in text
+        assert 'req_total{tenant="back\\\\slash"} 1.0' in text
+        assert 'req_total{tenant="two\\nlines"} 1.0' in text
+        # every series stayed on one physical line
+        assert sum(1 for ln in text.splitlines()
+                   if ln.startswith("req_total{")) == 3
+
+
+# --------------------------------------------------------------------------
+# Warm-program fold across the warmup-boundary reset
+# --------------------------------------------------------------------------
+
+class TestWarmProgramFold:
+    def test_reset_fold_warm_carries_prog_keys(self):
+        fr = FlightRecorder(size=8)
+        fr.record(prog="decode")
+        fr.record(prog="prefill:16")
+        fr.record(prog=None)                 # progless tick folds nothing
+        fr.reset(fold_warm=True)
+        assert fr.dump() == [] and fr.total == 0
+        assert fr.warm_progs == {"decode", "prefill:16"}
+        # a second boundary ACCUMULATES (warmup then measured-region reset)
+        fr.record(prog="spec:w4")
+        fr.reset(fold_warm=True)
+        assert fr.warm_progs == {"decode", "prefill:16", "spec:w4"}
+
+    def test_plain_reset_does_not_fold(self):
+        fr = FlightRecorder(size=4)
+        fr.record(prog="decode")
+        fr.reset()
+        assert fr.warm_progs == set()
+
+    def test_warm_prog_recompile_flagged_inside_warmup_window(self):
+        # "decode" compiled before the boundary; a post-boundary compile of
+        # it is a finding even at measured tick 0 — the warmup_ticks
+        # excusal must not mask it
+        recs = _ticks(6)
+        recs[0]["recompiles"] = 1
+        (f,) = watchdog(recs, warm_progs={"decode"})
+        assert f["kind"] == "steady_state_recompile" and f["seq"] == 0
+
+    def test_new_program_still_excused_with_warm_set(self):
+        # warm_progs must not revoke the first-appearance excusal for a
+        # genuinely new program key
+        recs = _ticks(64)
+        recs[40]["prog"] = "spec:w4"
+        recs[40]["recompiles"] = 1
+        assert watchdog(recs, warm_progs={"decode"}) == []
+
+    def test_serving_reset_folds_and_watchdog_uses_it(self):
+        tel = ServingTelemetry()
+        tel.flight.record(prog="decode", recompiles=1,
+                          preemptions=0, stalls=0)
+        tel.reset()                          # the warmup boundary
+        assert "decode" in tel.flight.warm_progs
+        tel.flight.record(prog="decode", recompiles=1,
+                          preemptions=0, stalls=0)
+        kinds = [f["kind"] for f in tel.watchdog()]
+        assert kinds == ["steady_state_recompile"]

@@ -1,0 +1,321 @@
+"""Training telemetry (paddle_tpu/telemetry.py training tier + engine/
+checkpointer/chaos wiring): per-step spans AROUND the compiled dispatch,
+flight-ring step records, goodput accounting (exactly 1.0 fault-free,
+< 1.0 under seeded kills), train_watchdog findings, and the one-timeline
+acceptance — training spans and serving request spans on one shared
+chrome trace. Quick tier on CPU."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.parallel.engine import ParallelEngine
+from paddle_tpu.telemetry import (TRAIN_RID, GoodputLedger, ServingTelemetry,
+                                  SpanTracer, TrainTelemetry, train_watchdog)
+
+
+def make_batch(cursor):
+    rng = np.random.RandomState(100 + cursor)
+    return (rng.randn(8, 4).astype("float32"),
+            rng.randn(8, 2).astype("float32"))
+
+
+def make_engine(injector=None, telemetry=None, seed=5):
+    paddle.seed(seed)
+    m = nn.Linear(4, 2)
+    o = optimizer.AdamW(learning_rate=0.05, parameters=m.parameters())
+    return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss, donate=False,
+                          injector=injector, telemetry=telemetry)
+
+
+def run_steps(eng, n, start=0):
+    for i in range(start, start + n):
+        X, y = make_batch(i)
+        eng.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+
+
+# --------------------------------------------------------------------------
+# Engine wiring
+# --------------------------------------------------------------------------
+
+class TestEngineInstrumentation:
+    def test_spans_flight_gauges_and_unit_goodput(self):
+        tel = TrainTelemetry()
+        eng = make_engine(telemetry=tel)
+        run_steps(eng, 5)
+        names = sorted({s["name"] for s in tel.tracer.spans(TRAIN_RID)})
+        assert names == ["device_wait", "dispatch", "host_to_device",
+                         "train_step"]
+        assert len([s for s in tel.tracer.spans(TRAIN_RID)
+                    if s["name"] == "train_step"]) == 5
+        ticks = tel.flight.dump()
+        assert [t["step"] for t in ticks] == list(range(5))
+        assert ticks[0]["prog"] == "train:8x4;8x2"
+        # first step compiles; steady state must not
+        assert ticks[0]["recompiles"] >= 1
+        assert all(t["recompiles"] == 0 for t in ticks[1:])
+        reg = tel.registry
+        assert reg.counter("train_steps").total() == 5
+        assert reg.counter("train_tokens_total").total() == 5 * 8 * 4
+        assert reg.gauge("train_tokens_per_s").value() > 0
+        assert reg.histogram("train_step_time_s").count() == 5
+        assert tel.goodput.ratio() == 1.0
+        assert reg.gauge("train_goodput_ratio").value() == 1.0
+        assert tel.watchdog() == []
+        assert tel.model_params == 4 * 2 + 2
+
+    def test_mfu_gauge_needs_peak_flops(self):
+        tel = TrainTelemetry()                       # PT_PEAK_TFLOPS unset
+        eng = make_engine(telemetry=tel)
+        run_steps(eng, 2)
+        assert tel.registry.get("train_mfu") is None or \
+            tel.registry.gauge("train_mfu").value() == 0
+        tel2 = TrainTelemetry(peak_flops=1e12)
+        eng2 = make_engine(telemetry=tel2)
+        run_steps(eng2, 2)
+        assert tel2.registry.gauge("train_mfu").value() > 0
+
+    def test_no_telemetry_records_nothing(self):
+        eng = make_engine(telemetry=None)
+        run_steps(eng, 3)
+        assert eng.telemetry is None
+
+    def test_snapshot_is_json_serializable(self):
+        tel = TrainTelemetry()
+        eng = make_engine(telemetry=tel)
+        run_steps(eng, 3)
+        blob = tel.snapshot()
+        json.dumps(blob)
+        assert blob["goodput"]["ratio"] == 1.0
+        assert blob["flight_ticks"] == 3
+
+
+class TestFeedAndCheckpointSpans:
+    def test_data_feed_and_ckpt_spans_share_the_train_row(self, tmp_path):
+        from paddle_tpu.distributed.train_checkpoint import (
+            CheckpointableDataFeed, TrainCheckpointer)
+
+        tel = TrainTelemetry()
+        eng = make_engine(telemetry=tel)
+        feed = CheckpointableDataFeed(make_batch, telemetry=tel)
+        ck = TrainCheckpointer(str(tmp_path / "ck"), telemetry=tel)
+        for i in range(3):
+            X, y = feed.next_batch()
+            eng.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+            ck.save(i, engine=eng, data_feed=feed)
+        names = [s["name"] for s in tel.tracer.spans(TRAIN_RID)]
+        assert names.count("data_feed") == 3
+        assert names.count("ckpt_save") == 3
+        assert tel.registry.histogram("train_data_feed_s").count() == 3
+        assert tel.registry.histogram("train_ckpt_save_s").count() == 3
+        # the feed wall also folds into the NEXT step's flight record
+        assert all(t["data_feed_s"] > 0 for t in tel.flight.dump())
+
+        # restore emits its span too
+        eng2 = make_engine(telemetry=tel, seed=6)
+        feed2 = CheckpointableDataFeed(make_batch, telemetry=tel)
+        ck2 = TrainCheckpointer(str(tmp_path / "ck"), telemetry=tel)
+        host = ck2.restore(engine=eng2, data_feed=feed2)
+        assert host["step"] == 2
+        assert [s["name"] for s in tel.tracer.spans(TRAIN_RID)
+                ].count("ckpt_restore") == 1
+        assert tel.registry.histogram("train_ckpt_restore_s").count() == 1
+
+
+# --------------------------------------------------------------------------
+# Goodput ledger
+# --------------------------------------------------------------------------
+
+class TestGoodputLedger:
+    def test_fault_free_is_exactly_one(self):
+        g = GoodputLedger()
+        for i in range(50):
+            g.step(i, 0.001 * (i + 1))
+        assert g.ratio() == 1.0                     # no float residue
+        assert g.snapshot()["lost_steps"] == 0
+
+    def test_replayed_index_books_lost_work(self):
+        g = GoodputLedger()
+        g.step(0, 2.0)
+        g.step(1, 3.0)
+        g.step(1, 5.0)                              # replay after rollback
+        s = g.snapshot()
+        assert s["lost_s"] == 3.0 and s["lost_steps"] == 1
+        assert s["total_s"] == 10.0 and s["productive_s"] == 7.0
+        assert g.ratio() == pytest.approx(0.7)
+
+    def test_recovery_books_outage_wall(self):
+        g = GoodputLedger()
+        g.step(0, 6.0)
+        g.recovery(2.0)
+        s = g.snapshot()
+        assert s["recoveries"] == 1 and s["recovery_s"] == 2.0
+        assert g.ratio() == pytest.approx(6.0 / 8.0)
+
+
+# --------------------------------------------------------------------------
+# train_watchdog
+# --------------------------------------------------------------------------
+
+def _steps(n, wall=0.01, **extra):
+    return [dict({"step": i, "seq": i, "prog": "train:8x4;8x2",
+                  "t_wall_s": wall, "data_feed_s": 0.0, "recompiles": 0,
+                  "ckpt_backoffs": 0}, **extra) for i in range(n)]
+
+
+class TestTrainWatchdog:
+    def test_quiet_run(self):
+        recs = _steps(40)
+        recs[0]["recompiles"] = 1                   # the warmup compile
+        assert train_watchdog(recs) == []
+
+    def test_steady_state_recompile(self):
+        recs = _steps(40)
+        recs[20]["recompiles"] = 1
+        (f,) = train_watchdog(recs)
+        assert f["kind"] == "steady_state_recompile" and f["seq"] == 20
+
+    def test_warm_prog_recompile_flagged_at_step_zero(self):
+        recs = _steps(6)
+        recs[0]["recompiles"] = 1
+        (f,) = train_watchdog(recs, warm_progs={"train:8x4;8x2"})
+        assert f["kind"] == "steady_state_recompile" and f["seq"] == 0
+
+    def test_step_time_regression(self):
+        recs = _steps(30)
+        for r in recs[-8:]:
+            r["t_wall_s"] = 0.05                    # 5x the 0.01 baseline
+        (f,) = train_watchdog(recs)
+        assert f["kind"] == "step_time_regression"
+        assert f["factor"] == pytest.approx(5.0)
+
+    def test_data_feed_stall(self):
+        recs = _steps(32)
+        for r in recs[8:24]:
+            r["data_feed_s"] = 0.02                 # feed > step wall
+        kinds = [f["kind"] for f in train_watchdog(recs)]
+        assert kinds == ["data_feed_stall"]
+
+    def test_ckpt_backoff_storm(self):
+        recs = _steps(40)
+        for i in (10, 12, 14, 16):
+            recs[i]["ckpt_backoffs"] = 1
+        kinds = [f["kind"] for f in train_watchdog(recs)]
+        assert kinds == ["ckpt_backoff_storm"]
+
+
+# --------------------------------------------------------------------------
+# Chaos-harness goodput attribution
+# --------------------------------------------------------------------------
+
+class TestChaosGoodput:
+    def test_kill_dips_goodput_below_one(self, tmp_path):
+        from paddle_tpu.distributed.fleet.chaos import ElasticChaosHarness
+        from paddle_tpu.distributed.train_checkpoint import (
+            CheckpointableDataFeed, TrainCheckpointer)
+        from paddle_tpu.faults import FaultInjector, FaultPlan, FaultSpec
+
+        tel = TrainTelemetry()
+        plan = FaultPlan(specs=[FaultSpec("kill", at=3)], seed=3)
+        injector = FaultInjector(plan)
+
+        class Run:
+            def __init__(self, inj):
+                self.eng = make_engine(injector=inj, telemetry=tel)
+                self.feed = CheckpointableDataFeed(make_batch, injector=inj,
+                                                   telemetry=tel)
+                self.ck = TrainCheckpointer(str(tmp_path / "chaos"),
+                                            injector=inj, telemetry=tel)
+
+            def restore(self):
+                host = self.ck.restore(engine=self.eng, data_feed=self.feed)
+                return (host["step"] + 1) if host else 0
+
+            def step(self, i):
+                X, y = self.feed.next_batch()
+                return float(np.asarray(self.eng.train_batch(
+                    paddle.to_tensor(X), paddle.to_tensor(y)).value))
+
+            def save(self, i):
+                self.ck.save(i, engine=self.eng, data_feed=self.feed)
+
+        harness = ElasticChaosHarness(
+            Run, total_steps=6, injector=injector, max_restarts=2,
+            heartbeat_interval=0.05, lease_ttl=0.3, telemetry=tel)
+        report = harness.run()
+        assert report.completed and report.restarts == 1
+
+        g = tel.goodput.snapshot()
+        assert tel.goodput.ratio() < 1.0
+        assert g["recoveries"] == 1 and g["recovery_s"] > 0
+        # the kill at step 3 rolled back to the step-2 save -> step 3 ran
+        # twice; its first run is the lost work
+        assert g["lost_steps"] >= 1
+        assert tel.registry.gauge("train_goodput_ratio").value() == \
+            tel.goodput.ratio()
+        assert tel.registry.counter("train_recoveries").total() == 1
+        names = [s["name"] for s in tel.tracer.spans(TRAIN_RID)]
+        assert names.count("recovery") == 1
+        # fresh incarnation recompiled the same prog: the watchdog must
+        # SAY so — chaos runs are exactly what the finding is for
+        kinds = [f["kind"] for f in tel.watchdog()]
+        assert "steady_state_recompile" in kinds
+
+    def test_fault_free_twin_stays_at_one(self):
+        tel = TrainTelemetry()
+        eng = make_engine(telemetry=tel)
+        run_steps(eng, 6)
+        assert tel.goodput.ratio() == 1.0
+        assert tel.goodput.snapshot()["recoveries"] == 0
+
+
+# --------------------------------------------------------------------------
+# One timeline: train spans + serving request spans in one chrome trace
+# --------------------------------------------------------------------------
+
+def test_train_and_serving_spans_share_one_timeline(tmp_path):
+    from paddle_tpu.inference.serving import GenerationServer
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    tracer = SpanTracer()
+    train_tel = TrainTelemetry(tracer=tracer)
+    serve_tel = ServingTelemetry(tracer=tracer)
+
+    eng = make_engine(telemetry=train_tel)
+    run_steps(eng, 4)
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=160,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    srv = GenerationServer(model, max_batch=2, max_len=96, cache="paged",
+                           block_size=8, prefill_chunk=16,
+                           telemetry=serve_tel)
+    rng = np.random.RandomState(0)
+    rids = [srv.submit(rng.randint(1, 127, size=n).tolist(),
+                       max_new_tokens=6) for n in (9, 14)]
+    srv.run()
+
+    path = str(tmp_path / "whole_stack.trace.json")
+    tracer.export_chrome_trace(path)
+    ev = json.load(open(path))
+    ev = ev["traceEvents"] if isinstance(ev, dict) else ev
+    by_tid = {}
+    for e in ev:
+        if e.get("ph") == "X":
+            by_tid.setdefault(e["tid"], set()).add(e["name"])
+    # the reserved train row carries the step phases...
+    assert {"train_step", "device_wait"} <= by_tid[TRAIN_RID]
+    # ...and request rows carry serving lifecycles on the SAME timeline
+    req_rows = [tid for tid in by_tid if tid != TRAIN_RID]
+    assert len(req_rows) >= len(rids)
+    assert any("decode" in n or "prefill" in n
+               for tid in req_rows for n in by_tid[tid])
+    # the train row is labeled for humans
+    labels = {e["args"]["name"] for e in ev
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert "train loop" in labels

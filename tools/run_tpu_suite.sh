@@ -392,6 +392,55 @@ assert r["ckpt_read_fired"] >= 1 and r["generation_fallbacks"] >= 1, \
     "corrupt-read rung never exercised — gate vacuous"
 assert r["save_failures"] == 0, \
     "a torn write exhausted its retries and dropped the generation"
+# goodput accounting riding the same artifact: the unkilled twin books
+# every step productive (exactly 1.0 — integer step indices, no float
+# residue) while the chaos run must dip below 1.0 IFF a kill forced
+# replayed steps + a recovery segment
+assert r["twin_goodput_ratio"] == 1.0, \
+    "fault-free twin booked lost work — goodput ledger is broken"
+assert (r["train_goodput_ratio"] < 1.0) == (r["detected_kills"] >= 1), \
+    "goodput ratio disagrees with the kill count"
 PY
+
+echo "== 8b. train-telemetry overhead gate (instrumented vs bare step time; fault-free goodput + clean watchdog) =="
+JAX_PLATFORMS=cpu python tools/train_telemetry_bench.py --json \
+  --out /tmp/tpu_runs/train_telemetry 2>/dev/null \
+  | tee /tmp/tpu_runs/train_telemetry.json \
+  || { echo "train telemetry bench FAILED (missing spans or non-unit"\
+       "fault-free goodput)"; exit 1; }
+python - <<'PY'
+# overhead gate: recording AROUND the compiled step (GL010) must cost
+# at most ~5% even on a model small enough that the hooks are maximally
+# visible; the instrumented fault-free run must leave a full train
+# timeline (one train_step span per step), a clean watchdog and a
+# goodput ledger of exactly 1.0
+import json
+r = json.load(open("/tmp/tpu_runs/train_telemetry.json"))
+print(f"overhead ratio {r['overhead_ratio']:.3f} "
+      f"(bare {r['median_step_bare_s'] * 1e3:.2f}ms vs instrumented "
+      f"{r['median_step_instrumented_s'] * 1e3:.2f}ms), "
+      f"{r['train_step_spans']} train_step spans, "
+      f"{r['watchdog_findings']} watchdog findings, "
+      f"goodput {r['train_goodput_ratio']}")
+assert r["overhead_ratio"] >= 0.95, \
+    f"train telemetry overhead above 5%: ratio {r['overhead_ratio']:.3f}"
+assert r["train_step_spans"] == r["steps"] > 0, \
+    "train timeline is missing steps — spans were dropped or never cut"
+assert r["flight_ticks"] == r["steps"], "flight ring missed steps"
+assert r["watchdog_findings"] == 0, \
+    f"fault-free run tripped the watchdog: {r['watchdog']}"
+assert r["train_goodput_ratio"] == 1.0, \
+    "fault-free goodput is not exactly 1.0 — phantom lost work"
+ev = json.load(open("/tmp/tpu_runs/train_telemetry.trace.json"))
+ev = ev["traceEvents"] if isinstance(ev, dict) else ev
+kinds = {e["name"] for e in ev if e.get("ph") == "X"}
+assert {"train_step", "host_to_device", "dispatch",
+        "device_wait"} <= kinds, f"train trace missing phases: {kinds}"
+PY
+# artifact tooling smoke: the dump CLI must render both artifact kinds
+python tools/telemetry_dump.py /tmp/tpu_runs/train_telemetry.metrics.json \
+  > /dev/null || { echo "telemetry_dump FAILED on metrics artifact"; exit 1; }
+python tools/telemetry_dump.py /tmp/tpu_runs/train_telemetry.flight.json \
+  > /dev/null || { echo "telemetry_dump FAILED on flight artifact"; exit 1; }
 
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

@@ -626,7 +626,11 @@ def main():
             server.run()
         # warmup boundary: drop histogram samples, spans, and flight
         # ticks so registry percentiles (and any --telemetry-out dump)
-        # cover the measured drain only; counters keep lifetime totals
+        # cover the measured drain only; counters keep lifetime totals.
+        # The reset folds warmup program keys into flight.warm_progs, so
+        # the post-drain watchdog neither resurfaces a warmup compile as
+        # a steady_state_recompile finding nor blanket-excuses a warm
+        # program recompiling inside the first measured ticks
         server.telemetry.reset()
         if chaos_inj is not None:
             chaos_inj.enabled = True   # plan ordinals start at the drain
@@ -1032,6 +1036,8 @@ def main():
         server.export_chrome_trace(base + ".trace.json")
         with open(base + ".flight.json", "w") as f:
             json.dump({"ticks": server.telemetry.flight.dump(),
+                       "warm_progs": sorted(
+                           server.telemetry.flight.warm_progs),
                        "watchdog": server.telemetry.watchdog()}, f, indent=1)
         line["telemetry_out"] = base
     line["schema_version"] = SCHEMA_VERSION

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Pretty-print and diff telemetry artifacts.
+
+Reads the ``.metrics.json`` / ``.flight.json`` blobs that
+``serving_benchmark --telemetry-out`` and ``train_telemetry_bench
+--out`` write, and renders them as tables a human can scan: counters,
+gauges, histogram p50/p95, flight-ring census (ticks, program keys,
+warm programs) and watchdog findings.
+
+With two files of the same kind, prints a diff instead: counter/gauge
+deltas and histogram percentile shifts — the quick answer to "what
+changed between these two runs" the suite gates and autotuner debugging
+need::
+
+    python tools/telemetry_dump.py run.metrics.json
+    python tools/telemetry_dump.py a.metrics.json b.metrics.json
+    python tools/telemetry_dump.py run.flight.json
+
+Stdlib + the repo only; no display dependencies.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _labels(d: Dict[str, Any]) -> str:
+    if not d:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(d.items())) + "}"
+
+
+def _metrics_tree(blob: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept either a raw ``MetricsRegistry.to_json()`` tree or a
+    ``snapshot()`` wrapper that nests it under ``metrics``."""
+    return blob.get("metrics", blob) if "counters" not in blob else blob
+
+
+def _scalar_series(tree: Dict[str, Any], kind: str) \
+        -> Dict[Tuple[str, str], float]:
+    out: Dict[Tuple[str, str], float] = {}
+    for name, entry in tree.get(kind, {}).items():
+        for row in entry.get("series", []):
+            out[(name, _labels(row.get("labels", {})))] = row["value"]
+    return out
+
+
+def _hist_rows(tree: Dict[str, Any]) \
+        -> Dict[Tuple[str, str], Dict[str, Any]]:
+    out: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for name, entry in tree.get("histograms", {}).items():
+        for row in entry.get("series", []):
+            out[(name, _labels(row.get("labels", {})))] = row
+    return out
+
+
+def _print_table(title: str, rows: List[Tuple[str, ...]],
+                 header: Tuple[str, ...]) -> None:
+    if not rows:
+        return
+    print(f"\n== {title}")
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    for r in [header] + rows:
+        print("  " + "  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+
+
+def dump_metrics(blob: Dict[str, Any]) -> None:
+    tree = _metrics_tree(blob)
+    rows = [(f"{n}{lb}", _fmt(v))
+            for (n, lb), v in sorted(_scalar_series(tree, "counters").items())]
+    _print_table("counters", rows, ("counter", "value"))
+    rows = [(f"{n}{lb}", _fmt(v))
+            for (n, lb), v in sorted(_scalar_series(tree, "gauges").items())]
+    _print_table("gauges", rows, ("gauge", "value"))
+    rows = [(f"{n}{lb}", str(r["count"]), _fmt(r.get("p50", "")),
+             _fmt(r.get("p95", "")), _fmt(r["sum"]))
+            for (n, lb), r in sorted(_hist_rows(tree).items())]
+    _print_table("histograms", rows, ("histogram", "count", "p50", "p95",
+                                     "sum"))
+    for key in ("watchdog", "goodput"):
+        if blob.get(key):
+            print(f"\n== {key}")
+            print(json.dumps(blob[key], indent=1))
+
+
+def diff_metrics(a: Dict[str, Any], b: Dict[str, Any]) -> None:
+    ta, tb = _metrics_tree(a), _metrics_tree(b)
+    for kind in ("counters", "gauges"):
+        sa, sb = _scalar_series(ta, kind), _scalar_series(tb, kind)
+        rows = []
+        for key in sorted(set(sa) | set(sb)):
+            va, vb = sa.get(key), sb.get(key)
+            if va == vb:
+                continue
+            delta = "" if None in (va, vb) else _fmt(vb - va)
+            rows.append((f"{key[0]}{key[1]}",
+                         _fmt(va) if va is not None else "-",
+                         _fmt(vb) if vb is not None else "-", delta))
+        _print_table(f"{kind} (changed)", rows, (kind[:-1], "a", "b", "Δ"))
+    ha, hb = _hist_rows(ta), _hist_rows(tb)
+    rows = []
+    for key in sorted(set(ha) | set(hb)):
+        ra, rb = ha.get(key), hb.get(key)
+        if ra == rb:
+            continue
+        fmt_p = lambda r, p: _fmt(r.get(p, "")) if r else "-"
+        rows.append((f"{key[0]}{key[1]}",
+                     str(ra["count"]) if ra else "-",
+                     str(rb["count"]) if rb else "-",
+                     fmt_p(ra, "p50"), fmt_p(rb, "p50"),
+                     fmt_p(ra, "p95"), fmt_p(rb, "p95")))
+    _print_table("histograms (changed)", rows,
+                 ("histogram", "n:a", "n:b", "p50:a", "p50:b",
+                  "p95:a", "p95:b"))
+
+
+def dump_flight(blob: Dict[str, Any]) -> None:
+    ticks = blob.get("ticks", [])
+    print(f"flight: {len(ticks)} tick(s)")
+    census: Dict[str, int] = {}
+    compiles = 0
+    for t in ticks:
+        prog = t.get("prog")
+        if prog is not None:
+            census[prog] = census.get(prog, 0) + 1
+        compiles += int(t.get("recompiles", 0))
+    _print_table("program census", sorted(census.items()),
+                 ("prog", "ticks"))
+    print(f"\nbackend compiles across ring: {compiles}")
+    if blob.get("warm_progs"):
+        print(f"warm programs (pre-boundary): {blob['warm_progs']}")
+    findings = blob.get("watchdog", [])
+    print(f"watchdog findings: {len(findings)}")
+    for f in findings:
+        print(f"  [{f.get('kind')}] {f.get('detail')}")
+
+
+def diff_flight(a: Dict[str, Any], b: Dict[str, Any]) -> None:
+    for label, blob in (("a", a), ("b", b)):
+        print(f"--- {label} ---")
+        dump_flight(blob)
+        print()
+
+
+def _kind(blob: Dict[str, Any]) -> str:
+    return "flight" if "ticks" in blob else "metrics"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+",
+                   help="one artifact to pretty-print, or two of the "
+                        "same kind to diff")
+    args = p.parse_args(argv)
+    if len(args.paths) > 2:
+        p.error("pass one file to dump or two to diff")
+    blobs = []
+    for path in args.paths:
+        try:
+            with open(path) as f:
+                blobs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    if len(blobs) == 1:
+        (dump_flight if _kind(blobs[0]) == "flight"
+         else dump_metrics)(blobs[0])
+        return 0
+    if _kind(blobs[0]) != _kind(blobs[1]):
+        print("error: cannot diff a metrics artifact against a flight "
+              "artifact", file=sys.stderr)
+        return 2
+    (diff_flight if _kind(blobs[0]) == "flight" else diff_metrics)(*blobs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

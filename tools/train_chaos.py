@@ -54,10 +54,11 @@ def build_factories(args):
         return (rng.randn(args.batch, args.width).astype("float32"),
                 rng.randn(args.batch, 4).astype("float32"))
 
-    def make_engine(injector=None):
+    def make_engine(injector=None, telemetry=None):
         m, o = make_model()
         return ParallelEngine(m, o, loss_fn=nn.functional.mse_loss,
-                              donate=False, injector=injector)
+                              donate=False, injector=injector,
+                              telemetry=telemetry)
 
     return make_engine, make_batch
 
@@ -72,17 +73,18 @@ class ChaosTrainRun:
     """
 
     def __init__(self, injector, ckpt_dir, metrics, make_engine, make_batch,
-                 save_every=1):
+                 save_every=1, telemetry=None):
         import paddle_tpu as paddle
         from paddle_tpu.distributed.train_checkpoint import (
             CheckpointableDataFeed, TrainCheckpointer)
 
         self._paddle = paddle
-        self.eng = make_engine(injector)
-        self.feed = CheckpointableDataFeed(make_batch, injector=injector)
+        self.eng = make_engine(injector, telemetry=telemetry)
+        self.feed = CheckpointableDataFeed(make_batch, injector=injector,
+                                           telemetry=telemetry)
         self.ck = TrainCheckpointer(ckpt_dir, injector=injector,
                                     metrics=metrics, save_retries=2,
-                                    backoff_s=0.01)
+                                    backoff_s=0.01, telemetry=telemetry)
         self.save_every = save_every
 
     def restore(self) -> int:
@@ -108,13 +110,13 @@ class ChaosTrainRun:
             self.ck.save(i, engine=self.eng, data_feed=self.feed)
 
 
-def run_twin(args, make_engine, make_batch):
+def run_twin(args, make_engine, make_batch, telemetry=None):
     """The unkilled fault-free reference trajectory."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed.train_checkpoint import CheckpointableDataFeed
 
-    eng = make_engine()
-    feed = CheckpointableDataFeed(make_batch)
+    eng = make_engine(telemetry=telemetry)
+    feed = CheckpointableDataFeed(make_batch, telemetry=telemetry)
     losses = {}
     for i in range(args.steps):
         X, y = feed.next_batch()
@@ -138,27 +140,33 @@ def main(argv=None) -> int:
 
     from paddle_tpu.distributed.fleet.chaos import ElasticChaosHarness
     from paddle_tpu.faults import FaultInjector, FaultPlan
-    from paddle_tpu.inference.telemetry import MetricsRegistry
+    from paddle_tpu.telemetry import MetricsRegistry, TrainTelemetry
 
     make_engine, make_batch = build_factories(args)
-    twin_losses, twin_state = run_twin(args, make_engine, make_batch)
+    # the twin's goodput ledger must come out EXACTLY 1.0 — no replayed
+    # step indices, no recovery segments — which is half of what the
+    # goodput gate pins (the chaos run's < 1.0 is the other half)
+    twin_tel = TrainTelemetry()
+    twin_losses, twin_state = run_twin(args, make_engine, make_batch,
+                                       telemetry=twin_tel)
 
     plan = FaultPlan.train_chaos(args.seed, horizon=args.steps,
                                  kills=args.kills)
     injector = FaultInjector(plan)
     metrics = MetricsRegistry()
+    tel = TrainTelemetry(registry=metrics)
     final_state = {}
 
     with tempfile.TemporaryDirectory() as ckpt_dir:
         def build(inj):
             run = ChaosTrainRun(inj, ckpt_dir, metrics, make_engine,
-                                make_batch)
+                                make_batch, telemetry=tel)
             final_state["engine"] = run.eng
             return run
 
         harness = ElasticChaosHarness(
             build, total_steps=args.steps, injector=injector,
-            max_restarts=args.max_restarts)
+            max_restarts=args.max_restarts, telemetry=tel)
         report = harness.run()
         chaos_state = final_state["engine"].engine_state_dict()
 
@@ -198,6 +206,10 @@ def main(argv=None) -> int:
         "save_failures": ctr("save_failures"),
         "saves": ctr("saves"),
         "restores": ctr("restores"),
+        "train_goodput_ratio": tel.goodput.ratio(),
+        "twin_goodput_ratio": twin_tel.goodput.ratio(),
+        "goodput": tel.goodput.snapshot(),
+        "train_watchdog": tel.watchdog(),
     }
     print(json.dumps(result) if args.as_json else
           f"train_chaos: completed={result['completed']} "
@@ -206,11 +218,16 @@ def main(argv=None) -> int:
           f"bitexact={result['params_bitexact']} "
           f"corrupt_reads={result['corrupt_reads_detected']}/"
           f"{result['ckpt_read_fired']}")
+    kills = result["detected_kills"]
     ok = (result["completed"] and result["loss_mismatches"] == 0
           and result["params_bitexact"]
           and result["corrupt_reads_detected"] >= result["ckpt_read_fired"]
           and result["detected_kills"] == result["restarts"]
-          and result["faults_injected"] > 0)
+          and result["faults_injected"] > 0
+          # goodput accounting: the fault-free twin is exactly 1.0; the
+          # chaos run dips below 1.0 exactly when a kill forced replay
+          and result["twin_goodput_ratio"] == 1.0
+          and ((result["train_goodput_ratio"] < 1.0) == (kills > 0)))
     return 0 if ok else 1
 
 

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Train-telemetry overhead gate: instrumented vs bare step time.
+
+Runs the same tiny-but-real ``ParallelEngine`` trajectory twice — once
+with ``telemetry=None`` (the default: no timestamps, no per-step
+``block_until_ready``) and once with a live :class:`TrainTelemetry` —
+and reports the median post-warmup step-time ratio (median, not mean —
+one scheduler hiccup on a shared host would otherwise swing the gate).
+Suite stage 8b (``tools/run_tpu_suite.sh``) asserts:
+
+- ``overhead_ratio`` (bare median / instrumented median) >= 0.95, i.e. the
+  host-side recording costs at most ~5% of a step even on a model small
+  enough that hooks are maximally visible;
+- the instrumented run produced a non-empty train timeline (chrome
+  trace has ``train_step`` spans on the reserved train row);
+- the fault-free watchdog is clean and ``train_goodput_ratio == 1.0``.
+
+Both arms force the loss to host (``float(np.asarray(...))``) so the
+bare arm cannot win by leaving work queued on the device — the
+comparison is step wall, not dispatch wall.
+
+``--out PREFIX`` writes ``PREFIX.metrics.json`` / ``PREFIX.trace.json``
+/ ``PREFIX.flight.json`` — the artifacts ``tools/telemetry_dump.py``
+pretty-prints. CPU-runnable: ``JAX_PLATFORMS=cpu python
+tools/train_telemetry_bench.py --steps 24 --json``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def run_arms(args, telemetry):
+    """Run the bare and instrumented engines INTERLEAVED — step i of one
+    arm right after step i of the other — so load drift on a shared
+    host hits both arms alike instead of biasing whichever ran second.
+    Returns (bare_times, instrumented_times) in seconds."""
+    import paddle_tpu as paddle
+    from tools.train_chaos import build_factories
+
+    make_engine, make_batch = build_factories(args)
+    eng_bare = make_engine(telemetry=None)
+    eng_inst = make_engine(telemetry=telemetry)
+
+    def timed_step(eng, i):
+        X, y = make_batch(i)
+        t0 = time.perf_counter()
+        loss = eng.train_batch(paddle.to_tensor(X), paddle.to_tensor(y))
+        float(np.asarray(loss.value))  # force to host in BOTH arms
+        return time.perf_counter() - t0
+
+    bare, inst = [], []
+    for i in range(args.steps):
+        bare.append(timed_step(eng_bare, i))
+        inst.append(timed_step(eng_inst, i))
+    return bare, inst
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=32)
+    p.add_argument("--warmup", type=int, default=4,
+                   help="leading steps excluded from the medians "
+                        "(covers the compile)")
+    # defaults sized so one step is ~10ms: small enough to run in
+    # seconds anywhere, big enough that the fixed per-step cost of the
+    # instrumented arm (span timestamps + the block_until_ready the
+    # device_wait span needs) amortizes to ~1-2% instead of dominating
+    # a sub-millisecond step the way a toy width would
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--model-seed", type=int, default=5)
+    p.add_argument("--data-seed", type=int, default=100)
+    p.add_argument("--out", default=None,
+                   help="artifact prefix; writes PREFIX.metrics.json, "
+                        "PREFIX.trace.json, PREFIX.flight.json")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if args.steps <= args.warmup + 1:
+        p.error("--steps must exceed --warmup + 1")
+
+    from paddle_tpu.telemetry import TRAIN_RID, TrainTelemetry
+
+    tel = TrainTelemetry()
+    bare, instrumented = run_arms(args, tel)
+
+    med = lambda xs: float(np.median(xs))
+    med_bare = med(bare[args.warmup:])
+    med_inst = med(instrumented[args.warmup:])
+    # paired per-step ratios: step i of each arm ran back-to-back, so a
+    # load spike inflates both and cancels in the quotient; the median
+    # of the quotients is far stabler than the quotient of the medians
+    overhead_ratio = med([b / t for b, t in
+                          zip(bare[args.warmup:], instrumented[args.warmup:])
+                          if t > 0])
+
+    train_spans = [s for s in tel.tracer.spans(TRAIN_RID)
+                   if s["name"] == "train_step"]
+    findings = tel.watchdog()
+    result = {
+        "bench": "train_telemetry",
+        "schema_version": 1,
+        "steps": args.steps,
+        "warmup": args.warmup,
+        "median_step_bare_s": med_bare,
+        "median_step_instrumented_s": med_inst,
+        "overhead_ratio": overhead_ratio,
+        "train_step_spans": len(train_spans),
+        "flight_ticks": tel.flight.total,
+        "watchdog_findings": len(findings),
+        "watchdog": findings,
+        "train_goodput_ratio": tel.goodput.ratio(),
+    }
+
+    if args.out:
+        with open(args.out + ".metrics.json", "w") as f:
+            json.dump(tel.snapshot(), f, indent=1)
+        tel.export_chrome_trace(args.out + ".trace.json")
+        with open(args.out + ".flight.json", "w") as f:
+            json.dump({"ticks": tel.flight.dump(),
+                       "warm_progs": sorted(tel.flight.warm_progs),
+                       "watchdog": findings}, f, indent=1)
+        result["artifacts"] = [args.out + ext for ext in
+                               (".metrics.json", ".trace.json",
+                                ".flight.json")]
+
+    print(json.dumps(result) if args.as_json else
+          f"train_telemetry_bench: ratio={overhead_ratio:.3f} "
+          f"(bare={med_bare * 1e3:.3f}ms inst={med_inst * 1e3:.3f}ms) "
+          f"spans={len(train_spans)} findings={len(findings)} "
+          f"goodput={result['train_goodput_ratio']}")
+    # the hard gate lives in run_tpu_suite.sh stage 8b; here only sanity
+    ok = (len(train_spans) == args.steps
+          and result["train_goodput_ratio"] == 1.0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
