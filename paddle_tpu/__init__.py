@@ -66,6 +66,7 @@ from . import distribution  # noqa: F401
 from . import audio  # noqa: F401
 from . import geometric  # noqa: F401
 from . import inference  # noqa: F401
+from . import autotune  # noqa: F401
 from . import quantization  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401

@@ -9,6 +9,13 @@ nondeterminism the whole fault-injection contract was built to remove:
 the same seeded plan stops producing the same run, and the token-identity
 assertions the chaos tests lean on become flaky instead of load-bearing.
 
+``paddle_tpu/autotune/`` is in scope for the same reason with a harder
+payoff: the tuner's determinism contract is byte-equality of the whole
+winning profile per seed (tests byte-compare two independent runs), so
+even a timestamp stamped mid-search breaks the artifact — profiles take
+their timestamp from the CALLER (``TunedProfile.save(now=...)``), and
+trial measurement threads the same injectable clock the servers use.
+
 Passing a clock *reference* (``clock=time.monotonic`` as a default) is
 the sanctioned pattern and stays clean — only direct *calls* are flagged.
 """
@@ -32,14 +39,16 @@ class WallClockInServingRule(Rule):
     id = "GL012"
     name = "wall-clock-in-serving"
     description = ("direct time.time()/time.monotonic()/datetime.now() "
-                   "calls inside paddle_tpu/inference/ bypass the "
-                   "injectable-clock seam (clock= parameters) that keeps "
-                   "seeded chaos runs and snapshot/restore timing "
-                   "deterministic; take a clock callable instead "
-                   "(passing a reference like clock=time.monotonic "
-                   "stays clean — only calls are flagged)")
+                   "calls inside paddle_tpu/inference/ or "
+                   "paddle_tpu/autotune/ bypass the injectable-clock "
+                   "seam (clock= parameters) that keeps seeded chaos "
+                   "runs, snapshot/restore timing, and per-seed "
+                   "byte-identical tuned profiles deterministic; take a "
+                   "clock callable instead (passing a reference like "
+                   "clock=time.monotonic stays clean — only calls are "
+                   "flagged)")
 
-    _SCOPE = "paddle_tpu/inference/"
+    _SCOPE = ("paddle_tpu/inference/", "paddle_tpu/autotune/")
 
     # the wall-clock read surface: direct calls to any of these are a
     # hidden time dependency (references to them are fine — that's how
@@ -65,8 +74,9 @@ class WallClockInServingRule(Rule):
             if chain in self._CLOCK_CALLS:
                 yield self.finding(
                     ctx, node,
-                    f"{chain}() is a direct wall-clock read inside "
-                    f"inference/ — thread the injectable clock (a "
-                    f"clock= parameter defaulting to time.monotonic) "
-                    f"instead, so seeded chaos plans and restore "
-                    f"timing replay deterministically")
+                    f"{chain}() is a direct wall-clock read inside a "
+                    f"clock-injected package (inference/, autotune/) — "
+                    f"thread the injectable clock (a clock= parameter "
+                    f"defaulting to time.monotonic) instead, so seeded "
+                    f"chaos plans, restore timing, and tuned-profile "
+                    f"byte-determinism replay exactly")

@@ -1,0 +1,34 @@
+"""Cost-model-driven serving autotuner (ROADMAP item 2, TVM mold).
+
+Layers::
+
+    workload.py   seeded traffic, decoupled from the serving config
+    space.py      typed ConfigSpace over every serving knob
+    features.py   telemetry snapshot -> flat FeatureVector per trial
+    cost.py       analytic paged-tick predictor, calibrated online
+    search.py     seeded search: warmup -> prune -> halving -> gates
+    profile.py    tuned-profile JSON; GenerationServer(profile=...)
+
+Entry points: ``tools/autotune.py`` (CLI), ``serving_benchmark --tune /
+--profile``, and :func:`search.autotune` for library use. Everything
+here is host-side and deterministic per seed; jax is only touched
+through ``GenerationServer`` inside a trial.
+"""
+from .cost import ServingCostModel
+from .features import FeatureVector, extract
+from .profile import (PROFILE_SCHEMA_VERSION, TunedProfile,
+                      config_server_kwargs, resolve_profile)
+from .search import TrialResult, TrialRunner, autotune, tokens_fingerprint
+from .space import (ALL_KNOBS, ConfigSpace, ENGINE_KNOBS, FLEET_KNOBS,
+                    Knob, engine_space)
+from .workload import (Traffic, TrafficRequest, WorkloadSpec, draw_traffic,
+                       submit_traffic, warmup_traffic)
+
+__all__ = [
+    "ALL_KNOBS", "ConfigSpace", "ENGINE_KNOBS", "FLEET_KNOBS",
+    "FeatureVector", "Knob", "PROFILE_SCHEMA_VERSION", "ServingCostModel",
+    "Traffic", "TrafficRequest", "TrialResult", "TrialRunner",
+    "TunedProfile", "WorkloadSpec", "autotune", "config_server_kwargs",
+    "draw_traffic", "engine_space", "extract", "resolve_profile",
+    "submit_traffic", "tokens_fingerprint", "warmup_traffic",
+]

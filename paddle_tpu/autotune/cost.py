@@ -1,0 +1,184 @@
+"""Config + workload -> predicted throughput, calibrated from trials.
+
+:class:`ServingCostModel` is the search's pruning oracle. It maps a
+candidate serving config (``space.py`` dict) and a workload
+(``workload.WorkloadSpec``) onto the analytic
+:class:`~paddle_tpu.cost_model.PagedTickCostModel` features — how many
+host trips, fused ticks, FLOPs and HBM bytes the run will take — and
+predicts end-to-end seconds and tok/s. Measured trials feed
+:meth:`observe`; :meth:`recalibrate` ridge-fits the four tick
+coefficients to them, so ranking sharpens as the search spends budget.
+
+The prediction is a *ranking* device, not a stopwatch: every term is
+chosen to move in the right direction under each knob (bigger pools
+fewer swaps, wider tick windows fewer trips, speculation paying only
+above break-even acceptance) rather than to be absolutely accurate.
+Hard accept/reject decisions always come from measurement
+(``search.py``), never from here.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..cost_model import PagedTickCostModel, REF_BLOCK_BYTES, TickShape
+from .workload import WorkloadSpec
+
+#: prior per-draft match probability for the n-gram drafter — repeated
+#: suffixes lock the drafter on (PR 3 showcase); random-token prompts
+#: rarely match. Calibration via measured acceptance replaces this.
+ACCEPT_P_REPEAT = 0.85
+ACCEPT_P_RANDOM = 0.25
+
+
+def expected_acceptance(k: int, p: float) -> float:
+    """E[accepted drafts per verify window] under a geometric match
+    model: draft i lands only if all i drafts before it did."""
+    return sum(p ** i for i in range(1, k + 1))
+
+
+def count_params(cfg) -> int:
+    """Parameter count of a Llama-shaped config (embeddings + untied
+    head + per-layer attention/MLP/norms) — the flop feature's scale."""
+    h = cfg.hidden_size
+    d = h // cfg.num_attention_heads
+    kv = cfg.num_key_value_heads
+    attn = h * h + 2 * h * kv * d + h * h        # q, k, v, o projections
+    mlp = 3 * h * cfg.intermediate_size          # gate, up, down
+    per_layer = attn + mlp + 2 * h               # + the two norms
+    return (2 * cfg.vocab_size * h               # embed + lm head
+            + cfg.num_hidden_layers * per_layer + h)
+
+
+def _block_bytes(cfg, block_size: int, kv_quant: str) -> int:
+    if cfg is not None:
+        from ..inference.serving import kv_block_bytes
+        return kv_block_bytes(cfg, block_size, kv_quant)
+    scale = 0.25 if kv_quant == "int8" else 1.0
+    return int(REF_BLOCK_BYTES * (block_size / 16.0) * scale)
+
+
+class ServingCostModel:
+    """Analytic throughput predictor over (config, workload), online-
+    calibrated from measured trials."""
+
+    def __init__(self, model_cfg=None, *, max_batch: int = 8,
+                 n_params: Optional[int] = None,
+                 tick_model: Optional[PagedTickCostModel] = None):
+        self.model_cfg = model_cfg
+        self.max_batch = int(max_batch)
+        self.n_params = int(n_params) if n_params is not None else (
+            count_params(model_cfg) if model_cfg is not None
+            else TickShape.__dataclass_fields__["n_params"].default)
+        self.tick_model = tick_model or PagedTickCostModel()
+        self._trials: List[Dict[str, float]] = []
+        #: measured acceptance per window, once any spec trial ran —
+        #: replaces the ACCEPT_P_* prior for subsequent predictions
+        self.measured_acceptance: Optional[float] = None
+
+    # ------------------------------------------------------------ features
+    def aggregates(self, config: Mapping[str, Any],
+                   workload: WorkloadSpec) -> Dict[str, float]:
+        """Trial totals (trips, ticks, flops, bytes) for one full run of
+        ``workload`` under ``config`` — the calibration feature row."""
+        bs = int(config.get("block_size", 16))
+        tw = int(config.get("tick_window", 16))
+        k = int(config.get("draft_k", 0))
+        pool_frac = float(config.get("pool_frac", 1.0))
+        block_bytes = _block_bytes(self.model_cfg, bs,
+                                   str(config.get("kv_quant", "none")))
+        decoding = float(min(self.max_batch, workload.requests))
+        mean_prompt = (sum(workload.prompt_ladder)
+                       / len(workload.prompt_ladder))
+        # mean resident context midway through a request's decode
+        ctx_tokens = mean_prompt + workload.max_new / 2.0
+        ctx_blocks = max(1.0, ctx_tokens / bs)
+
+        total_new = float(workload.requests * workload.max_new)
+        if k > 0:
+            p = (self.measured_acceptance / k
+                 if self.measured_acceptance is not None
+                 else (ACCEPT_P_REPEAT if workload.repeat_suffix
+                       else ACCEPT_P_RANDOM))
+            p = min(max(p, 0.0), 0.99)
+            gain = 1.0 + expected_acceptance(k, p)   # tokens per window
+            width = k + 1
+        else:
+            gain, width = 1.0, 1
+        ticks = max(1.0, total_new / (decoding * gain))
+
+        shape = TickShape(decoding=int(decoding), width=width,
+                          n_params=self.n_params, ctx_blocks=ctx_blocks,
+                          block_bytes=block_bytes)
+        tick_flops = shape.flops()
+        tick_bytes = shape.hbm_bytes()
+        if pool_frac < 1.0:
+            # overflow fraction of the working set swaps through the
+            # host pool every tick-ish — a deliberate overestimate that
+            # ranks starved pools below parity ones
+            tick_bytes += (1.0 - pool_frac) * decoding \
+                * ctx_blocks * block_bytes
+
+        # chunked prefill: one program dispatch per chunk, batched into
+        # the same trips as decode
+        chunk = int(config.get("prefill_chunk", 64))
+        total_prompt = float(workload.requests) * mean_prompt
+        pf_ticks = max(1.0, total_prompt / chunk)
+        pf_flops = 2.0 * self.n_params * total_prompt
+        pf_bytes = pf_ticks * 4.0 * self.n_params
+
+        trips = max(1.0, ticks / tw) + pf_ticks
+        return {
+            "trips": trips,
+            "ticks": ticks + pf_ticks,
+            "flops": ticks * tick_flops + pf_flops,
+            "bytes": ticks * tick_bytes + pf_bytes,
+        }
+
+    # ------------------------------------------------------------- predict
+    def predict_seconds(self, config: Mapping[str, Any],
+                        workload: WorkloadSpec) -> float:
+        a = self.aggregates(config, workload)
+        return self.tick_model.predict(a["trips"], a["ticks"],
+                                       a["flops"], a["bytes"])
+
+    def predict_tok_s(self, config: Mapping[str, Any],
+                      workload: WorkloadSpec) -> float:
+        total_new = workload.requests * workload.max_new
+        sec = self.predict_seconds(config, workload)
+        return total_new / sec if sec > 0 else 0.0
+
+    # ----------------------------------------------------------- calibrate
+    def observe(self, config: Mapping[str, Any], workload: WorkloadSpec,
+                seconds: float,
+                acceptance: Optional[float] = None) -> None:
+        """Record one measured trial (analytic features, measured
+        seconds). ``acceptance`` is the trial's measured accepted-drafts
+        per verify window, if it ran speculation."""
+        row = dict(self.aggregates(config, workload))
+        row["seconds"] = float(seconds)
+        self._trials.append(row)
+        if acceptance is not None:
+            self.measured_acceptance = float(acceptance)
+
+    def recalibrate(self, ridge: float = 1e-3) -> None:
+        """Refit the tick coefficients to every observed trial."""
+        if self._trials:
+            self.tick_model = self.tick_model.calibrate(self._trials,
+                                                        ridge=ridge)
+
+    def spec_break_even(self, k: int,
+                        workload: WorkloadSpec,
+                        config: Optional[Mapping[str, Any]] = None) -> float:
+        """Accepted drafts per window where draft_k=k starts paying, at
+        this workload's shapes (compare to SpecConfig.gate_low)."""
+        cfg = dict(config or {})
+        bs = int(cfg.get("block_size", 16))
+        mean_prompt = (sum(workload.prompt_ladder)
+                       / len(workload.prompt_ladder))
+        shape = TickShape(
+            decoding=int(min(self.max_batch, workload.requests)),
+            n_params=self.n_params,
+            ctx_blocks=max(1.0, (mean_prompt + workload.max_new / 2.0) / bs),
+            block_bytes=_block_bytes(self.model_cfg, bs,
+                                     str(cfg.get("kv_quant", "none"))))
+        return self.tick_model.spec_break_even(k, shape)

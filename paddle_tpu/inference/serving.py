@@ -112,7 +112,8 @@ class GenerationServer:
                  host_pool_bytes: Optional[int] = None,
                  lora=None, telemetry=None, faults=None,
                  fault_retries: int = 3, kernels: str = "auto",
-                 mesh=None, role: str = "any"):
+                 mesh=None, role: str = "any", profile=None,
+                 clock=None):
         """``tick_window``: decode ticks per host round trip. 1 = exact
         per-token semantics. k>1 runs k ticks as ONE compiled lax.scan
         before the host sees the tokens — eos detection and slot refill lag
@@ -215,7 +216,51 @@ class GenerationServer:
         current mode untouched. Recorded in the snapshot fingerprint —
         restore refuses a snapshot taken under a different mode (greedy
         tokens are kernel-identical, but sampling paths need not be
-        bit-equal across kernels)."""
+        bit-equal across kernels).
+
+        ``profile``: a tuned profile from the autotuner
+        (``paddle_tpu/autotune/``) — a path to the profile JSON, a
+        parsed dict, or a :class:`~paddle_tpu.autotune.TunedProfile`.
+        Applies the tuned serving knobs (cache geometry, tick window,
+        speculation, kv_quant, pool sizing, policy) wherever the caller
+        left the ctor argument at its declared default; an explicitly
+        passed non-default argument wins over the profile. The loaded
+        profile re-verifies its config fingerprint, so a hand-edited
+        config fails here, loudly.
+
+        ``clock``: injectable time source (``() -> float``) for request
+        wall metrics, the default scheduler, and default-constructed
+        telemetry — the autotuner injects a counting clock to make
+        measured trials (and therefore tuned profiles) deterministic.
+        None = ``time.monotonic``. A ``telemetry=``/``policy=`` instance
+        you construct yourself keeps its own clock."""
+        self.profile = None
+        if profile is not None:
+            from ..autotune.profile import resolve_profile
+
+            self.profile = resolve_profile(profile)
+            _pkw = self.profile.server_kwargs(
+                model.cfg, max_batch=max_batch, max_len=max_len)
+            # tuned knobs fill ctor args still at their declared
+            # defaults; explicit caller choices always win
+            if cache == "dense":
+                cache = _pkw["cache"]
+            if block_size == 16:
+                block_size = _pkw["block_size"]
+            if tick_window == 1:
+                tick_window = _pkw["tick_window"]
+            if prefill_chunk == 32:
+                prefill_chunk = _pkw["prefill_chunk"]
+            if spec is None:
+                spec = _pkw.get("spec")
+            if kv_quant == "none":
+                kv_quant = _pkw["kv_quant"]
+            if policy is None:
+                policy = _pkw["policy"]
+            if pool_bytes is None and num_blocks is None:
+                pool_bytes = _pkw.get("pool_bytes")
+            if host_pool_bytes is None:
+                host_pool_bytes = _pkw.get("host_pool_bytes")
         cfg = model.cfg
         assert max_len <= cfg.max_position_embeddings
         if cache not in ("dense", "paged"):
@@ -312,11 +357,13 @@ class GenerationServer:
         self._base_key = jax.random.PRNGKey(seed)
         self._slots: List[Optional[_Request]] = [None] * max_batch
         if policy is None:
-            self._sched = Scheduler()
+            self._sched = Scheduler() if clock is None \
+                else Scheduler(clock=clock)
         elif isinstance(policy, Scheduler):
             self._sched = policy
         elif isinstance(policy, str):
-            self._sched = Scheduler(policy=policy)
+            self._sched = Scheduler(policy=policy) if clock is None \
+                else Scheduler(policy=policy, clock=clock)
         else:
             raise ValueError(
                 f"policy must be None, a policy name ('fifo'/'priority'/"
@@ -326,7 +373,7 @@ class GenerationServer:
         # per-rid wall-clock marks (submit/first-token/done) — the
         # benchmark derives TTFT and per-token latency from these
         self._req_metrics: Dict[int, Dict[str, float]] = {}
-        self._wall = time.monotonic
+        self._wall = clock if clock is not None else time.monotonic
         # preemption / overload counters (read via sched_metrics)
         self._preemptions = 0
         self._prefill_aborts = 0
@@ -362,9 +409,11 @@ class GenerationServer:
         from .telemetry import ServingTelemetry
 
         if telemetry is None or telemetry is False:
-            self._tel = ServingTelemetry(enabled=False)
+            self._tel = ServingTelemetry(enabled=False) if clock is None \
+                else ServingTelemetry(enabled=False, clock=clock)
         elif telemetry is True:
-            self._tel = ServingTelemetry(enabled=True)
+            self._tel = ServingTelemetry(enabled=True) if clock is None \
+                else ServingTelemetry(enabled=True, clock=clock)
         elif isinstance(telemetry, ServingTelemetry):
             self._tel = telemetry
         else:

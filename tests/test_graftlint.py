@@ -469,6 +469,30 @@ class TestWallClockGL012:
                 return time.perf_counter() - t0
         """, path="paddle_tpu/benchmarks/timer.py")
 
+    def test_autotune_package_is_in_scope(self):
+        # the tuner's contract is byte-identical profiles per seed — a
+        # stray wall-clock read mid-search breaks the artifact
+        assert "GL012" in rule_ids("""
+            import time
+
+            def measure(runner, config):
+                t0 = time.perf_counter()
+                runner.run(config)
+                return time.perf_counter() - t0
+        """, path="paddle_tpu/autotune/search.py")
+
+    def test_autotune_clock_reference_is_sanctioned(self):
+        # TrialRunner threads an injectable clock; the reference default
+        # is the seam, same as inference/
+        assert "GL012" not in rule_ids("""
+            import time
+
+            class TrialRunner:
+                def __init__(self, clock=None):
+                    self.clock = clock if clock is not None \\
+                        else time.perf_counter
+        """, path="paddle_tpu/autotune/search.py")
+
 
 class TestBareTransferGL014:
     SERVING = "paddle_tpu/inference/mod.py"

@@ -386,14 +386,17 @@ def test_swap_preemption_steady_state_zero_recompiles():
 def test_serving_benchmark_overload_smoke():
     """The overload benchmark mode end to end: open-loop bursty arrivals,
     priority scheduling, pool < demand — one JSON line with TTFT/TPOT
-    percentiles, nonzero swap counters, and per-class TTFT splits."""
+    percentiles, nonzero swap counters, and per-class TTFT splits.
+    pool-frac 0.25 starves the pool hard enough that swaps are forced
+    regardless of host timing (0.35 was marginal for this seed's draws —
+    a loaded host could drain between bursts and never pressure it)."""
     proc = subprocess.run(
         [sys.executable, "tools/serving_benchmark.py", "--paged", "--json",
          "--requests", "10", "--slots", "3", "--max-new", "12",
          "--tick-window", "2", "--block-size", "8", "--prefill-chunk", "16",
-         "--pool-frac", "0.35", "--scheduler", "priority",
+         "--pool-frac", "0.25", "--scheduler", "priority",
          "--mixed-priority", "--arrival-rate", "400", "--burst", "4",
-         "--seed", "3"],
+         "--seed", "5"],
         capture_output=True, text=True, timeout=600,
         cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]),
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
@@ -403,6 +406,6 @@ def test_serving_benchmark_overload_smoke():
                 "ttft_p95_s_high", "preemptions", "swap_out_blocks",
                 "swap_in_blocks"):
         assert key in line, key
-    assert line["seed"] == 3 and line["scheduler"] == "priority"
+    assert line["seed"] == 5 and line["scheduler"] == "priority"
     assert line["swap_out_blocks"] > 0        # overload actually overloaded
     assert line["ttft_p95_s"] >= line["ttft_p50_s"] >= 0.0

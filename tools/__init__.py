@@ -1,0 +1,3 @@
+"""Benchmark/analysis drivers, runnable as scripts or ``python -m
+tools.<name>`` (the package form keeps repo-root imports working from
+any cwd)."""

@@ -443,4 +443,57 @@ python tools/telemetry_dump.py /tmp/tpu_runs/train_telemetry.metrics.json \
 python tools/telemetry_dump.py /tmp/tpu_runs/train_telemetry.flight.json \
   > /dev/null || { echo "telemetry_dump FAILED on flight artifact"; exit 1; }
 
+echo "== 9. serving autotune gate (short-budget search; tuned profile must hold the default's throughput on identical traffic, recompile-clean) =="
+python tools/serving_benchmark.py --paged --repeat-suffix --requests 16 \
+  --slots 4 --max-new 24 --seed 7 --tune 8 \
+  --profile /tmp/tpu_runs/tuned_profile.json --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_tune.json \
+  || { echo "autotune search FAILED (trial crash or profile save)"; exit 1; }
+python tools/serving_benchmark.py --paged --repeat-suffix --requests 16 \
+  --slots 4 --max-new 24 --seed 7 --guard-recompiles --strict --json \
+  2>/dev/null | tee /tmp/tpu_runs/serving_default_replay.json \
+  || { echo "default replay FAILED (recompile guard or watchdog)"; exit 1; }
+python tools/serving_benchmark.py --paged --repeat-suffix --requests 16 \
+  --slots 4 --max-new 24 --seed 7 --guard-recompiles --strict --json \
+  --profile /tmp/tpu_runs/tuned_profile.json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_tuned_replay.json \
+  || { echo "tuned replay FAILED (steady-state recompile or watchdog"\
+       "finding under the tuned config)"; exit 1; }
+python - <<'PY'
+# autotune gate: the search line must record a real multi-trial search
+# whose winner beat its own measured baseline; the tuned replay must see
+# BYTE-IDENTICAL traffic to the default replay (the decoupling contract)
+# and produce IDENTICAL tokens (greedy serving is config-invariant);
+# --strict/--guard-recompiles above already enforce clean watchdog +
+# zero steady-state recompiles; and tuned throughput must hold the
+# default's within the chip's drift margin (the search already proved
+# winner >= default on its own measured traffic)
+import json
+tune = json.load(open("/tmp/tpu_runs/serving_tune.json"))
+dft = json.load(open("/tmp/tpu_runs/serving_default_replay.json"))
+tuned = json.load(open("/tmp/tpu_runs/serving_tuned_replay.json"))
+prof = json.load(open("/tmp/tpu_runs/tuned_profile.json"))
+ratio = tuned["value"] / dft["value"]
+print(f"tuned {tuned['value']} vs default {dft['value']} tok/s "
+      f"(ratio {ratio:.2f}); search: {tune['tune_trials']} trials, "
+      f"winner cfg {tune['profile_fingerprint']} "
+      f"{prof['metrics']['tok_s']:.1f} vs baseline "
+      f"{tune['tune_baseline_tok_s']} tok/s, "
+      f"{len(prof['search']['rejected'])} rejected")
+assert tune["tuned"] is True and tune["tune_budget"] == 8, tune
+assert tune["tune_trials"] >= 4, "search never ran its trial plan"
+assert tuned["profile_fingerprint"] == prof["config_fingerprint"]
+assert tuned["profile_workload_match"] is True, \
+    "replay workload drifted from the one the profile was tuned on"
+assert tuned["traffic_fingerprint"] == dft["traffic_fingerprint"], \
+    "tuned replay saw different traffic — config leaked into the draw"
+assert tuned["tokens_fingerprint"] == dft["tokens_fingerprint"], \
+    "tuned config changed the tokens — a reject gate is leaking"
+assert prof["metrics"]["tok_s"] >= prof["baseline"]["tok_s"], \
+    "search crowned a winner below its own measured baseline"
+if ratio < 0.95:
+    raise SystemExit("tuned profile below 95% of the default replay — "
+                     "tuning regressed throughput beyond drift margin")
+PY
+
 echo "== done: paste the JSON lines + sweep winners into BASELINE.md =="

@@ -86,13 +86,33 @@ Every JSON line carries ``schema_version`` plus ``config_fingerprint``
 flags excluded) so downstream tooling can both detect schema drift and
 refuse to diff lines that measured different configurations.
 
+Traffic is decoupled from the serving config: the measured requests
+(and the open-loop schedule) come from one ``RandomState(--seed)``
+stream, while the config-scaled warmup bursts draw from a DISJOINT
+xor-seeded stream with their own request counter — so any two configs
+at the same ``--seed`` serve byte-identical traffic. The line's
+``traffic_fingerprint`` hashes the measured submit timeline directly
+(and token-exact serving then makes ``tokens_fingerprint`` match
+across configs too — the autotuner's correctness gate rides on this).
+
+Autotuning (docs/autotuning.md): ``--profile PATH`` replays a tuned
+profile (``paddle_tpu.autotune`` JSON) — the server is built via
+``GenerationServer(profile=...)`` and the profile's knobs override the
+per-knob flags; the line gains ``profile_fingerprint`` /
+``profile_workload_match``. ``--tune BUDGET`` runs the cost-model
+search over THIS benchmark's seeded workload first, replays the
+winning config as the measured run, and (with ``--profile PATH``)
+saves the winner there; the line gains ``tuned`` / ``tune_budget`` /
+``tune_baseline_tok_s`` / ``tune_trials``.
+
 Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
        [--seed 0] [--arrival-rate R --burst B]
        [--scheduler fifo|priority|wfq [--mixed-priority]]
        [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
         [--host-pool-mb M] [--prefill-chunk 64]
         [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]
-        [--mesh tp=N] [--fleet N [--disagg]] [--chaos [--strict]]]
+        [--mesh tp=N] [--fleet N [--disagg]] [--chaos [--strict]]
+        [--profile PATH | --tune BUDGET [--profile OUT]]]
        [--json]
 """
 from __future__ import annotations
@@ -347,6 +367,20 @@ def main():
                          "faults_injected / quarantined / "
                          "token_mismatches (non-quarantined outputs vs "
                          "the reference) / ref_tok_s")
+    ap.add_argument("--profile", metavar="PATH", default=None,
+                    help="apply a tuned serving profile (paddle_tpu."
+                         "autotune JSON): the server is built via "
+                         "GenerationServer(profile=...) and the profile's "
+                         "knobs OVERRIDE the per-knob flags (--block-size/"
+                         "--tick-window/--kv-quant/--scheduler/...). With "
+                         "--tune, PATH is where the freshly tuned profile "
+                         "is written before the measured replay")
+    ap.add_argument("--tune", type=int, default=None, metavar="BUDGET",
+                    help="run the cost-model autotuner (paddle_tpu."
+                         "autotune) over this benchmark's seeded workload "
+                         "with BUDGET measured candidate trials, then "
+                         "replay the WINNING config as the measured run; "
+                         "--profile PATH saves the winner")
     ap.add_argument("--strict", action="store_true",
                     help="enable telemetry and exit non-zero on any "
                          "watchdog finding — over the measured drain, or "
@@ -376,6 +410,20 @@ def main():
     if args.disagg and not args.fleet:
         ap.error("--disagg requires --fleet N (N >= 2): prefill and "
                  "decode classes need separate replicas")
+    if args.profile is not None or args.tune is not None:
+        if not args.paged:
+            ap.error("--profile/--tune require --paged (every tuned "
+                     "config serves from the paged substrate)")
+        if args.fleet or args.chaos:
+            ap.error("--profile/--tune are incompatible with --fleet/"
+                     "--chaos (tune the single-engine config; fleet "
+                     "knobs ride the profile's fleet_* entries)")
+        if args.tune is not None and args.tune < 1:
+            ap.error("--tune BUDGET must be >= 1")
+        if args.tune is not None and args.lora_adapters:
+            ap.error("--tune does not model the adapter pool yet — "
+                     "tune the base-engine knobs without --lora-adapters, "
+                     "then replay the profile WITH them")
     tp = 1
     if args.mesh is not None:
         if not args.paged:
@@ -461,10 +509,67 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     # --seed governs TRAFFIC only: same weights, different load trace
     rng = np.random.RandomState(args.seed)
+    # warmup draws come from a DISJOINT stream (xor'd seed, own counter):
+    # warmup sizing scales with --slots/--pool-frac, and if it shared the
+    # measured stream the measured traffic would shift whenever a serving
+    # knob changed — the autotuner's cross-config token-fingerprint gate
+    # (and any two-line diff at one seed) needs byte-identical traffic
+    wrng = np.random.RandomState((args.seed ^ 0x5EED) & 0x7FFFFFFF)
+    _warm_state = wrng.get_state()
 
     motif = rng.randint(1, cfg.vocab_size, 8).tolist()
     _counter = [0]
+    _wcounter = [0]
     prios = {}
+    # measured-pass submit timeline (prompts, priorities, tenants,
+    # adapters + the pre-drawn open-loop schedule) — hashed into the
+    # line's traffic_fingerprint so traffic/config decoupling is
+    # checkable from the JSON alone
+    _trace = []
+    _sched_trace = []
+
+    tuned_profile, wspec = None, None
+    if args.tune is not None or args.profile is not None:
+        from paddle_tpu.autotune import (TrialRunner, TunedProfile,
+                                         WorkloadSpec)
+        from paddle_tpu.autotune import autotune as run_autotune
+        from paddle_tpu.autotune.workload import (LONG_PROMPT_LADDER,
+                                                  SHORT_PROMPT_LADDER)
+
+        wspec = WorkloadSpec(
+            requests=args.requests, max_new=args.max_new,
+            prompt_ladder=(LONG_PROMPT_LADDER if args.long_prompts
+                           else SHORT_PROMPT_LADDER),
+            vocab_size=cfg.vocab_size, repeat_suffix=args.repeat_suffix,
+            mixed_priority=args.mixed_priority,
+            lora_adapters=args.lora_adapters,
+            arrival_rate=args.arrival_rate, burst=args.burst,
+            seed=args.seed)
+        if args.tune is not None:
+            runner = TrialRunner(model, wspec, max_batch=args.slots,
+                                 max_len=args.max_len)
+            tlog = (None if args.json
+                    else (lambda s: print(f"[tune] {s}", file=sys.stderr)))
+            tuned_profile, _trials = run_autotune(
+                runner, budget=args.tune, seed=args.seed, log=tlog)
+            if args.profile:
+                tuned_profile.save(args.profile, now=time.time())
+        else:
+            tuned_profile = TunedProfile.load(args.profile)
+        # sync the reporting knobs (unit string, kernel-microbench
+        # shapes, config_fingerprint) to what the profile actually pins
+        _pc = tuned_profile.config
+        args.block_size = int(_pc["block_size"])
+        args.tick_window = int(_pc["tick_window"])
+        args.prefill_chunk = int(_pc["prefill_chunk"])
+        args.kv_quant = str(_pc["kv_quant"])
+        args.scheduler = str(_pc["policy"])
+        args.spec = int(_pc.get("draft_k", 0))
+        args.spec_drafter = "ngram"
+        _pf = float(_pc.get("pool_frac", 1.0))
+        args.pool_frac = _pf if _pf < 1.0 else None
+        args.host_pool_mb = _pc.get("host_pool_mb")
+        args.num_blocks = None
 
     lora_cfg, lora_live = None, 0
     if args.lora_adapters:
@@ -492,11 +597,15 @@ def main():
         lora_cfg = LoRAConfig(reg, max_live_adapters=lora_live,
                               max_rank=args.lora_rank)
 
-    def burst(server, n):
+    def burst(server, n, warm=False):
         """Mixed prompt lengths across the bucket ladder; round-robin
-        priority classes + tenants under --mixed-priority."""
-        lens = rng.choice([64, 128, 256, 400, 512] if args.long_prompts
-                          else [16, 30, 64, 100, 128], size=n)
+        priority classes + tenants under --mixed-priority. ``warm``
+        bursts draw from the disjoint warmup stream (own counter) so
+        config-scaled warmup never perturbs the measured traffic."""
+        r = wrng if warm else rng
+        ctr = _wcounter if warm else _counter
+        lens = r.choice([64, 128, 256, 400, 512] if args.long_prompts
+                        else [16, 30, 64, 100, 128], size=n)
         rids = {}
         for ln in lens:
             if args.repeat_suffix:
@@ -505,9 +614,9 @@ def main():
                 # the shared prefix exercises the prefix cache
                 prompt = (motif * (int(ln) // len(motif) + 1))[:int(ln)]
             else:
-                prompt = rng.randint(1, cfg.vocab_size, int(ln)).tolist()
-            i = _counter[0]
-            _counter[0] += 1
+                prompt = r.randint(1, cfg.vocab_size, int(ln)).tolist()
+            i = ctr[0]
+            ctr[0] += 1
             prio, tenant, adapter = 1, "default", None
             if args.mixed_priority:
                 prio = (0, 1, 2)[i % 3]
@@ -522,6 +631,9 @@ def main():
                                 adapter=adapter)
             rids[rid] = int(ln)
             prios[rid] = prio
+            if not warm:
+                _trace.append([prompt, int(args.max_new), prio, tenant,
+                               adapter or ""])
         return rids
 
     import contextlib
@@ -530,6 +642,16 @@ def main():
     from paddle_tpu.utils.bench_timing import tpu_lock
 
     def make_server(faults=None, sched=None, role="any"):
+        if tuned_profile is not None:
+            # tuned path: the profile pins every engine knob through
+            # GenerationServer(profile=...); only workload inputs
+            # (model/slots/max_len) and reporting plumbing stay on args
+            return GenerationServer(
+                model, max_batch=args.slots, max_len=args.max_len,
+                profile=tuned_profile, lora=lora_cfg, faults=faults,
+                telemetry=bool(args.telemetry_out) or args.strict,
+                kernels=args.kernels, role=role,
+                mesh=(tp if args.mesh is not None else None))
         if args.paged:
             spec = None
             if args.spec:
@@ -614,7 +736,7 @@ def main():
         from paddle_tpu.analysis.recompile_guard import compile_count
 
         # warmup drain: compiles the decode tick + the prefill program(s)
-        burst(server, min(args.slots, 4))
+        burst(server, min(args.slots, 4), warm=True)
         server.run()
         if args.pool_frac is not None and (args.chaos
                                            or args.guard_recompiles):
@@ -622,7 +744,7 @@ def main():
             # programs get a chance to compile BEFORE the measured
             # window (first preemption after it still counts against
             # the budget — hence the reference-pass allowance)
-            burst(server, args.slots * 2 + 2)
+            burst(server, args.slots * 2 + 2, warm=True)
             server.run()
         # warmup boundary: drop histogram samples, spans, and flight
         # ticks so registry percentiles (and any --telemetry-out dump)
@@ -646,6 +768,7 @@ def main():
                 schedule.append((t, n))
                 left -= n
                 t += float(rng.exponential(args.burst / args.arrival_rate))
+        _sched_trace[:] = [[t, n] for t, n in schedule]
         rids = {} if schedule else burst(server, args.requests)
         if chaos_inj is not None:
             guard = jit_cache_guard("chaos measured drain",
@@ -693,12 +816,16 @@ def main():
 
         def reset_traffic():
             rng.set_state(traffic_state)
+            wrng.set_state(_warm_state)
             _counter[0] = 0
+            _wcounter[0] = 0
             prios.clear()
+            del _trace[:]
+            del _sched_trace[:]
 
         # reference twin: warm, then the measured drain
         ref_server = make_server()
-        burst(ref_server, min(args.slots, 4))
+        burst(ref_server, min(args.slots, 4), warm=True)
         ref_server.run()
         reset_traffic()
         ref_rids = burst(ref_server, args.requests)
@@ -732,7 +859,7 @@ def main():
                             faults=inj)
         # warm EVERY replica's prefill/decode (routing spreads the warmup
         # burst by load), then replay the identical measured traffic
-        burst(fleet, args.fleet * min(args.slots, 4))
+        burst(fleet, args.fleet * min(args.slots, 4), warm=True)
         if args.disagg:
             # the router only hands decode replicas KV payloads, so their
             # chunk-prefill programs never compile through routed warmup
@@ -740,7 +867,7 @@ def main():
             # salvage path compiles nothing new inside the guarded drain
             for rep in fleet._replicas:
                 if rep.role == "decode":
-                    burst(rep.server, min(args.slots, 4))
+                    burst(rep.server, min(args.slots, 4), warm=True)
         fleet.run()
         for rep in fleet._replicas:
             rep.server.telemetry.reset()
@@ -789,8 +916,11 @@ def main():
                 "tok_s_per_chip": round(
                     gen_tokens / dt / (tp * args.fleet), 1),
                 "tokens_fingerprint": hashlib.sha256(json.dumps(
-                    {str(r): out[r] for r in sorted(rids)
-                     if r in out}).encode()).hexdigest()[:16],
+                    [out[r] for r in sorted(rids)
+                     if r in out]).encode()).hexdigest()[:16],
+                "traffic_fingerprint": hashlib.sha256(json.dumps(
+                    {"schedule": _sched_trace,
+                     "requests": _trace}).encode()).hexdigest()[:16],
                 "disagg": bool(args.disagg),
                 "prefill_replicas": fm["prefill_replicas"],
                 "decode_replicas": fm["decode_replicas"],
@@ -840,7 +970,7 @@ def main():
                 # plan spent must come back watchdog-clean
                 for rep in fleet._replicas:
                     rep.server.telemetry.reset()
-                burst(fleet, min(args.slots, 4))
+                burst(fleet, min(args.slots, 4), warm=True)
                 fleet.run()
                 strict = []
                 for rep in fleet._replicas:
@@ -895,8 +1025,12 @@ def main():
             # identical traffic for the measured pass: same rng state,
             # same rid counter -> rid-for-rid comparable outputs
             rng.set_state(traffic_state)
+            wrng.set_state(_warm_state)
             _counter[0] = 0
+            _wcounter[0] = 0
             prios.clear()
+            del _trace[:]
+            del _sched_trace[:]
             inj = FaultInjector(FaultPlan.chaos(args.seed))
             inj.enabled = False        # hooks wire now, plan fires later
             sched = Scheduler(policy=args.scheduler,
@@ -934,8 +1068,11 @@ def main():
             "tp": tp, "mesh": f"tp{tp}",
             "tok_s_per_chip": round(gen_tokens / dt / tp, 1),
             "tokens_fingerprint": hashlib.sha256(json.dumps(
-                {str(r): out[r] for r in sorted(rids)
-                 if r in out}).encode()).hexdigest()[:16],
+                [out[r] for r in sorted(rids)
+                 if r in out]).encode()).hexdigest()[:16],
+            "traffic_fingerprint": hashlib.sha256(json.dumps(
+                {"schedule": _sched_trace,
+                 "requests": _trace}).encode()).hexdigest()[:16],
             "p50_s": round(p50, 3), "p95_s": round(p95, 3),
             "wall_s": round(dt, 2),
             "seed": args.seed, "scheduler": args.scheduler,
@@ -995,6 +1132,16 @@ def main():
         line["acceptance_rate"] = round(sm["acceptance_rate"], 4)
         line["draft_tokens_proposed"] = sm["draft_tokens_proposed"]
         line["draft_tokens_accepted"] = sm["draft_tokens_accepted"]
+    if tuned_profile is not None:
+        line["profile_fingerprint"] = tuned_profile.config_fingerprint
+        line["profile_workload_match"] = bool(
+            tuned_profile.workload == wspec.to_dict())
+        if args.tune is not None:
+            line["tuned"] = True
+            line["tune_budget"] = args.tune
+            line["tune_trials"] = tuned_profile.search["trials"]
+            line["tune_baseline_tok_s"] = round(
+                float(tuned_profile.baseline["tok_s"]), 1)
     strict_findings = None
     if args.chaos:
         st = inj.stats()
@@ -1019,7 +1166,7 @@ def main():
             # with a CLEAN watchdog — degradation is a response, not a
             # new steady state
             server.telemetry.reset()
-            burst(server, min(args.slots, 4))
+            burst(server, min(args.slots, 4), warm=True)
             server.run()
             strict_findings = server.telemetry.watchdog()
             line["watchdog_after_recovery"] = len(strict_findings)

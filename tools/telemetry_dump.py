@@ -16,6 +16,14 @@ need::
     python tools/telemetry_dump.py a.metrics.json b.metrics.json
     python tools/telemetry_dump.py run.flight.json
 
+Autotune trial artifacts (``"kind": "autotune_trial"``, written by
+``tools/autotune.py --trials-out``) get a comparison table instead: any
+number of them at once, one row per trial (config fingerprint ->
+tok/s, TTFT/TPOT p95, acceptance, predicted tok/s), sorted by the
+search objective with rejected trials sunk to the bottom::
+
+    python tools/telemetry_dump.py trials/trial_*.json
+
 Stdlib + the repo only; no display dependencies.
 """
 from __future__ import annotations
@@ -151,7 +159,40 @@ def diff_flight(a: Dict[str, Any], b: Dict[str, Any]) -> None:
         print()
 
 
+def dump_trials(blobs: List[Dict[str, Any]]) -> None:
+    """N autotune trials side by side, best objective (tok/s) first,
+    rejects at the bottom — the "why did THIS config win" table."""
+
+    def _f(v: Any, nd: int = 1) -> str:
+        return "-" if v is None else f"{float(v):.{nd}f}"
+
+    def _key(b: Dict[str, Any]):
+        feats = b.get("features", {})
+        return (0 if b.get("accepted") else 1,
+                -(feats.get("tok_s") or 0.0),
+                b.get("index", 0))
+
+    rows = []
+    for b in sorted(blobs, key=_key):
+        feats = b.get("features", {})
+        status = "ok" if b.get("accepted") else \
+            f"REJECT {(b.get('reject_reason') or '?').split(':')[0]}"
+        rows.append((str(b.get("index", "?")), b.get("rung", "?"),
+                     b.get("fingerprint", "?"),
+                     _f(feats.get("tok_s")),
+                     _f(feats.get("ttft_p95_s"), 4),
+                     _f(feats.get("tpot_p95_ms"), 3),
+                     _f(feats.get("acceptance"), 3),
+                     _f(b.get("predicted_tok_s")),
+                     status))
+    _print_table(f"autotune trials ({len(rows)})", rows,
+                 ("trial", "rung", "config", "tok/s", "ttft_p95_s",
+                  "tpot_p95_ms", "accept", "predicted", "status"))
+
+
 def _kind(blob: Dict[str, Any]) -> str:
+    if blob.get("kind") == "autotune_trial":
+        return "trial"
     return "flight" if "ticks" in blob else "metrics"
 
 
@@ -161,8 +202,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="one artifact to pretty-print, or two of the "
                         "same kind to diff")
     args = p.parse_args(argv)
-    if len(args.paths) > 2:
-        p.error("pass one file to dump or two to diff")
     blobs = []
     for path in args.paths:
         try:
@@ -171,6 +210,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, ValueError) as e:
             print(f"error: cannot read {path}: {e}", file=sys.stderr)
             return 2
+    if all(_kind(b) == "trial" for b in blobs):
+        dump_trials(blobs)
+        return 0
+    if any(_kind(b) == "trial" for b in blobs):
+        print("error: cannot mix autotune trials with other artifact "
+              "kinds", file=sys.stderr)
+        return 2
+    if len(blobs) > 2:
+        p.error("pass one file to dump or two to diff (any number of "
+                "autotune trials)")
     if len(blobs) == 1:
         (dump_flight if _kind(blobs[0]) == "flight"
          else dump_metrics)(blobs[0])
