@@ -132,6 +132,13 @@ def config_server_kwargs(config: Mapping[str, Any], model_cfg, *,
     k = int(cfg.get("draft_k", 0))
     if k > 0:
         kw["spec"] = SpecConfig(k=k, gate_low=float(cfg["spec_gate_low"]))
+    cp = int(cfg.get("cp", 1))
+    if cp > 1:
+        kw["mesh"] = f"cp={cp}"
+    lo = cfg.get("tier_demote_low", None)
+    if lo is not None:
+        kw["tier_demote_low"] = float(lo)
+        kw["tier_demote_high"] = float(cfg["tier_demote_high"])
     pool_frac = float(cfg.get("pool_frac", 1.0))
     if pool_frac < 1.0:
         entries = -(-max_len // bs)

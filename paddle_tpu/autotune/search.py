@@ -179,7 +179,13 @@ def autotune(runner: TrialRunner, *, budget: int = 8, seed: int = 0,
     default-config reference trial is extra) and return the tuned
     profile plus every trial record (accepted and rejected)."""
     emit = log or (lambda s: None)
-    space = space or engine_space(max_len=runner.max_len)
+    if space is None:
+        import jax
+
+        # bound the cp axis to meshes THIS host can build — a sampled
+        # cp=4 on a 1-device box must be invalid, not a trial crash
+        space = engine_space(max_len=runner.max_len,
+                             devices=len(jax.devices()))
     cost = cost or ServingCostModel(runner.model.cfg,
                                     max_batch=runner.max_batch)
     rng = np.random.RandomState(seed)  # graftlint: noqa[np-random]

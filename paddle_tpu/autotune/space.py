@@ -25,6 +25,12 @@ Knobs interact, so validity is first-class:
 - the fleet knobs (``prefix_weight``/``load_weight``/``probe_every``/
   ``degrade_cooldown_s``) are dead at ``fleet_replicas == 1`` and
   canonicalize to their defaults.
+- ``cp > 1`` (context-parallel prefill) requires a mesh the host can
+  actually build (the space's ``devices`` bound) and must divide
+  ``prefill_chunk`` — the chunk shards evenly by construction.
+- the tier watermarks are one ladder: ``tier_demote_low`` without
+  ``tier_demote_high`` (or an unordered pair) is invalid, and the high
+  watermark canonicalizes to None when the low trigger is off.
 
 Sampling and mutation take an explicit ``numpy.random.RandomState`` and
 are fully deterministic per seed — the search's trial sequence replays
@@ -79,6 +85,15 @@ ENGINE_KNOBS: Tuple[Knob, ...] = (
          "host swap-pool cap in MB; None = unbounded, 0 = no swapping"),
     Knob("policy", ("fifo", "priority", "wfq"), "fifo",
          "request scheduler (inference/scheduler.py)"),
+    Knob("cp", (1, 2, 4), 1,
+         "context-parallel mesh axis sharding the chunked prefill's "
+         "sequence dimension (long-context prefill scaling); 1 = off"),
+    Knob("tier_demote_low", (None, 0.1, 0.2), None,
+         "free-block fraction that TRIGGERS hot->warm KV demotion; "
+         "None = watermark-driven demotion off"),
+    Knob("tier_demote_high", (None, 0.3, 0.5), None,
+         "free-block fraction demotion restores before it stops; dead "
+         "(canonicalized to None) when tier_demote_low is None"),
 )
 
 FLEET_KNOBS: Tuple[Knob, ...] = (
@@ -104,12 +119,15 @@ class ConfigSpace:
     ``pins`` freezes knobs to a single value (the engine-tier search
     pins the fleet knobs to their defaults); ``max_len`` bounds
     ``block_size`` choices so one block never exceeds the serving
-    horizon.
+    horizon; ``devices`` bounds the ``cp`` mesh axis — a cp degree the
+    host cannot build a mesh for is invalid, not a runtime crash.
     """
 
     def __init__(self, knobs: Sequence[Knob] = ALL_KNOBS, *,
                  pins: Optional[Dict[str, Any]] = None,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 devices: Optional[int] = None):
+        self.devices = devices
         self.knobs: Tuple[Knob, ...] = tuple(knobs)
         names = [k.name for k in self.knobs]
         if len(set(names)) != len(names):
@@ -186,6 +204,28 @@ class ConfigSpace:
                 "spans tick_window windows of width k+1, so wide windows "
                 "explode program size (multi-minute compiles) and surplus "
                 "verify work — cap the window at 8 when speculating")
+        cp = int(config.get("cp", 1))
+        if cp > 1:
+            if self.devices is not None and cp > self.devices:
+                errs.append(
+                    f"cp={cp} needs a {cp}-device mesh but the space was "
+                    f"built for {self.devices} device(s)")
+            pc = int(config.get("prefill_chunk", 64))
+            if pc % cp:
+                errs.append(
+                    f"cp={cp} must divide prefill_chunk={pc} — the chunk "
+                    f"shards evenly over the cp axis by construction")
+        lo = config.get("tier_demote_low", None)
+        hi = config.get("tier_demote_high", None)
+        if lo is not None:
+            if hi is None:
+                errs.append(
+                    "tier_demote_low set without tier_demote_high — the "
+                    "watermarks are one ladder, set both or neither")
+            elif not (0.0 < lo < hi <= 1.0):
+                errs.append(
+                    f"tier watermarks must satisfy 0 < low < high <= 1, "
+                    f"got low={lo} high={hi}")
         return errs
 
     def is_valid(self, config: Dict[str, Any]) -> bool:
@@ -199,6 +239,13 @@ class ConfigSpace:
         cfg = dict(config)
         if cfg.get("draft_k", 0) == 0 and "spec_gate_low" in self._by_name:
             cfg["spec_gate_low"] = self._by_name["spec_gate_low"].default
+        if cfg.get("tier_demote_low", None) is None \
+                and "tier_demote_high" in self._by_name:
+            # the high watermark is dead without the low trigger (cp=1
+            # analogously needs no collapse: the cp axis carries no
+            # satellite knobs, 1 IS its canonical off value)
+            cfg["tier_demote_high"] = \
+                self._by_name["tier_demote_high"].default
         if cfg.get("pool_frac", 1.0) >= 1.0 \
                 and "host_pool_mb" in self._by_name:
             cfg["host_pool_mb"] = self._by_name["host_pool_mb"].default
@@ -271,10 +318,12 @@ class ConfigSpace:
 
 
 def engine_space(max_len: Optional[int] = None,
-                 pins: Optional[Dict[str, Any]] = None) -> ConfigSpace:
+                 pins: Optional[Dict[str, Any]] = None,
+                 devices: Optional[int] = None) -> ConfigSpace:
     """The single-engine search space: full knob surface declared, fleet
     tier pinned to its defaults (fleet_replicas=1 collapses the routing
-    knobs too). This is what ``tools/autotune.py`` searches."""
+    knobs too). ``devices`` bounds the cp axis to meshes the host can
+    build. This is what ``tools/autotune.py`` searches."""
     p = {k.name: k.default for k in FLEET_KNOBS}
     p.update(pins or {})
-    return ConfigSpace(ALL_KNOBS, pins=p, max_len=max_len)
+    return ConfigSpace(ALL_KNOBS, pins=p, max_len=max_len, devices=devices)

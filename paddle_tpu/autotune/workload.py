@@ -32,6 +32,9 @@ import numpy as np
 #: prompt-length ladders (tokens) — mirror serving_benchmark's buckets
 SHORT_PROMPT_LADDER: Tuple[int, ...] = (16, 30, 64, 100, 128)
 LONG_PROMPT_LADDER: Tuple[int, ...] = (64, 128, 256, 400, 512)
+#: log-spaced long-context rungs (serving_benchmark --long-context);
+#: CPU-scale workloads pass an explicit smaller ladder instead
+LONG_CONTEXT_LADDER: Tuple[int, ...] = (8192, 16384, 32768, 65536, 131072)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,9 +54,23 @@ class WorkloadSpec:
     #: None = closed-loop (everything submitted up front)
     arrival_rate: Optional[float] = None
     burst: int = 4
+    #: long-context axis: with the default ladder, swaps in the
+    #: log-spaced 8k-128k LONG_CONTEXT_LADDER (an explicit ladder — a
+    #: CPU-scaled one — always wins)
+    long_context: bool = False
+    #: fraction [0,1] of every prompt replaced by ONE shared per-seed
+    #: token prefix — the cross-request prefix-cache / warm-tier workload
+    shared_prefix_frac: float = 0.0
     seed: int = 0
 
     def __post_init__(self):
+        if self.long_context \
+                and tuple(self.prompt_ladder) == SHORT_PROMPT_LADDER:
+            object.__setattr__(self, "prompt_ladder", LONG_CONTEXT_LADDER)
+        if not (0.0 <= self.shared_prefix_frac <= 1.0):
+            raise ValueError(
+                f"shared_prefix_frac must be in [0, 1], got "
+                f"{self.shared_prefix_frac}")
         if self.requests < 1:
             raise ValueError(f"requests must be >= 1, got {self.requests}")
         if self.max_new < 1:
@@ -121,7 +138,8 @@ class Traffic:
 
 
 def _draw_request(rng: np.random.RandomState, spec: WorkloadSpec,  # graftlint: noqa[np-random]
-                  index: int, motif: Sequence[int]) -> TrafficRequest:
+                  index: int, motif: Sequence[int],
+                  shared: Sequence[int] = ()) -> TrafficRequest:
     ln = int(rng.choice(spec.prompt_ladder))
     if spec.repeat_suffix:
         # tile one shared motif: greedy decoding locks onto the
@@ -131,6 +149,11 @@ def _draw_request(rng: np.random.RandomState, spec: WorkloadSpec,  # graftlint: 
     else:
         prompt = tuple(int(t) for t in
                        rng.randint(1, spec.vocab_size, ln))
+    if spec.shared_prefix_frac > 0.0:
+        # overlay the per-seed shared prefix (prompt lengths still come
+        # from the ladder draw above, so the stream stays order-stable)
+        k = int(ln * spec.shared_prefix_frac)
+        prompt = tuple(shared[:k]) + prompt[k:]
     prio, tenant, adapter = 1, "default", None
     if spec.mixed_priority:
         prio = (0, 1, 2)[index % 3]
@@ -149,7 +172,15 @@ def draw_traffic(spec: WorkloadSpec) -> Traffic:
     rng = np.random.RandomState(spec.seed)  # graftlint: noqa[np-random]
     motif = tuple(int(t) for t in
                   rng.randint(1, spec.vocab_size, 8))
-    reqs = tuple(_draw_request(rng, spec, i, motif)
+    shared: Tuple[int, ...] = ()
+    if spec.shared_prefix_frac > 0.0:
+        # drawn only when the knob is on, from its own xor-seeded
+        # stream — enabling it must not shift the per-request draws,
+        # and specs without it keep their historical signatures
+        srng = np.random.RandomState((spec.seed + 0x5AFE) & 0x7FFFFFFF)  # graftlint: noqa[np-random]
+        shared = tuple(int(t) for t in srng.randint(
+            1, spec.vocab_size, max(spec.prompt_ladder)))
+    reqs = tuple(_draw_request(rng, spec, i, motif, shared)
                  for i in range(spec.requests))
     schedule: List[Tuple[float, int]] = []
     if spec.arrival_rate is not None:
@@ -168,7 +199,15 @@ def warmup_traffic(spec: WorkloadSpec, n: int) -> Tuple[TrafficRequest, ...]:
     measured traffic above is already fully drawn and untouched."""
     rng = np.random.RandomState((spec.seed ^ 0x5EED) & 0x7FFFFFFF)  # graftlint: noqa[np-random]
     motif = tuple(int(t) for t in rng.randint(1, spec.vocab_size, 8))
-    return tuple(_draw_request(rng, spec, i, motif) for i in range(n))
+    shared: Tuple[int, ...] = ()
+    if spec.shared_prefix_frac > 0.0:
+        # the SAME shared prefix as the measured trace — warmup re-hits
+        # are the point of the knob (prefix cache + warm tier warm)
+        srng = np.random.RandomState((spec.seed + 0x5AFE) & 0x7FFFFFFF)  # graftlint: noqa[np-random]
+        shared = tuple(int(t) for t in srng.randint(
+            1, spec.vocab_size, max(spec.prompt_ladder)))
+    return tuple(_draw_request(rng, spec, i, motif, shared)
+                 for i in range(n))
 
 
 def submit_traffic(server, requests: Sequence[TrafficRequest]) \

@@ -48,7 +48,8 @@ class PagedExecutor:
     executor, byte-for-byte the pre-split behavior.
     """
 
-    def __init__(self, engine, num_blocks: int, tp: Optional[int] = None):
+    def __init__(self, engine, num_blocks: int, tp: Optional[int] = None,
+                 cp: Optional[int] = None):
         from ..framework.dtype import convert_dtype
 
         self.engine = engine
@@ -78,13 +79,18 @@ class PagedExecutor:
 
         self.mesh = None
         self.tp = 1
-        if tp is not None and int(tp) > 1:
+        self.cp = 1
+        tp = 1 if tp is None else int(tp)
+        cp = 1 if cp is None else int(cp)
+        if tp > 1 or cp > 1:
             from ..parallel import serving_mesh as sm
 
-            tp = int(tp)
-            sm.validate_tp(cfg, tp)
-            self.mesh = sm.build_serving_mesh(tp)
+            if tp > 1:
+                sm.validate_tp(cfg, tp)
+            sm.validate_cp(cp, engine.prefill_chunk)
+            self.mesh = sm.build_serving_mesh(tp, cp)
             self.tp = tp
+            self.cp = cp
             # construction-time placement is the ONLY transfer the tp
             # path adds: params + pools commit to the mesh once, then
             # every program's outputs inherit the layout via donation
@@ -216,7 +222,26 @@ class PagedExecutor:
         local index ``last_idx`` (the last real prompt token on the final
         chunk; ignored on earlier chunks) + updated pools. ``aidx`` is the
         prefilling slot's adapter page index, shape (1,) — prompt tokens
-        must see the same adapter delta the decode ticks will."""
+        must see the same adapter delta the decode ticks will.
+
+        Context parallelism is a one-line steer: at ``cp > 1`` the chunk
+        is constrained to shard its sequence dim over the ``cp`` axis.
+        Params and pools name only ``tp``, so GSPMD partitions the
+        per-token work (embedding, projections, rope) across the cp
+        group, all-gathers the chunk's K/V where the replicated pool
+        scatter needs the full chunk, and leaves every reduction's order
+        unchanged — each shard attends over the full prefix, so tokens
+        are bit-identical to cp=1. The constraint lives INSIDE the
+        traced body: one compile covers every chunk, zero steady-state
+        recompiles."""
+        if self.cp > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.serving_mesh import SERVING_CP_AXIS
+
+            chunk = jax.lax.with_sharding_constraint(
+                chunk, NamedSharding(self.mesh, P(None, SERVING_CP_AXIS)))
         engine = self.engine
         model = engine.model
         pools = self._pool_views(flat_pools)

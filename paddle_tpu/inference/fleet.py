@@ -246,6 +246,10 @@ class FleetRouter:
         self._c_handoffs = registry.counter(
             "fleet_prefill_handoffs",
             "prefill→decode handoff sweeps performed (replica label)")
+        self._c_warm_migrated = registry.counter(
+            "fleet_migrated_warm_blocks",
+            "warm-tier prefix blocks adopted by a peer during migration "
+            "(a shared prompt prefilled once survives its replica)")
         # per-tenant SLO objectives: ``slos`` maps tenant → overrides of
         # DEFAULT_SLO; the "default" entry re-bases every other tenant
         base_slo = dict(DEFAULT_SLO)
@@ -535,6 +539,23 @@ class FleetRouter:
             self._home[int(d["rid"])] = target.idx
             self._c_migrated.inc(phase=d["phase"])
             moved += 1
+        warm = snap.get("warm_tier") or []
+        if warm:
+            # offer the dead/draining replica's warm prefix blocks to ONE
+            # surviving peer (prefill-capable preferred — promotion
+            # happens at admission), least loaded first; adopt_warm CRC-
+            # verifies per entry, so a corrupted payload just misses
+            targets = [r for r in self._eligible() if r.idx != exclude]
+            if self.disagg:
+                pre = [r for r in targets if self._prefill_capable(r)]
+                targets = pre or targets
+            if targets:
+                def _load(r):
+                    lm = r.server.load_metrics()
+                    return (lm["queue_depth"] + lm["slots_occupied"], r.idx)
+                adopted = min(targets, key=_load).server.adopt_warm(warm)
+                if adopted:
+                    self._c_warm_migrated.inc(adopted)
         return moved
 
     def drain(self, idx: int) -> int:
@@ -728,6 +749,7 @@ class FleetRouter:
                 "migrated_requests": int(self._c_migrated.total()),
                 "migrated_kv": int(self._c_migrated.total(
                     where={"phase": "kv"})),
+                "migrated_warm_blocks": int(self._c_warm_migrated.total()),
                 "migrate_corruptions": int(self._c_corrupt.total()),
                 "deaths": int(self._c_deaths.total()),
                 "drains": int(self._c_drains.total()),

@@ -41,12 +41,13 @@ participating in prefix sharing.
 from __future__ import annotations
 
 import zlib
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-__all__ = ["SwapHandle", "HostKVPool", "KVOffloadEngine",
+__all__ = ["SwapHandle", "HostKVPool", "WarmTier", "KVOffloadEngine",
            "payload_checksum"]
 
 
@@ -98,6 +99,11 @@ class HostKVPool:
         self.bytes_peak = 0
         self.puts = 0
         self.takes = 0
+        # refusals are a capacity signal, not a silent drop: the server's
+        # telemetry snapshot exports every stats() field as a
+        # serving_host_pool_* gauge, so rejects reaching stats() is what
+        # makes "the host pool is too small" observable
+        self.rejects = 0
 
     def fits(self, nbytes: int) -> bool:
         return (self.capacity_bytes is None
@@ -107,6 +113,7 @@ class HostKVPool:
         if rid in self._store:
             raise KeyError(f"request {rid} already has a parked KV copy")
         if not self.fits(nbytes):
+            self.rejects += 1
             return False
         self._store[rid] = arrays
         self.bytes_in_use += nbytes
@@ -133,10 +140,114 @@ class HostKVPool:
         return {"bytes_in_use": self.bytes_in_use,
                 "bytes_peak": self.bytes_peak,
                 "puts": self.puts, "takes": self.takes,
+                "rejects": self.rejects,
                 "parked": len(self._store)}
 
     def __len__(self) -> int:
         return len(self._store)
+
+
+class WarmTier:
+    """Hash-keyed warm tier: per-block host copies of DEMOTED prefix
+    blocks, addressable by the same chain hash the allocator's hot-tier
+    prefix cache uses.
+
+    Where :class:`HostKVPool` parks whole per-request block stacks under
+    a rid (swap preemption), the warm tier holds individual shareable
+    prompt blocks under their content hash — the second rung of the
+    hot (HBM) → warm (host) → cold (re-prefill) ladder. A block demoted
+    here left HBM entirely; a later prefix match promotes it back
+    through the compile-once fixed-width scatter, CRC-verified, and a
+    failed check simply breaks the chain walk (the request re-prefills
+    those tokens — the cold rung, never wrong tokens).
+
+    LRU over chain hashes; a bounded tier evicts its coldest entries to
+    make room (eviction = the block falls to the cold tier). Bytes are
+    ledgered separately from the swap pool so the server's conservation
+    audit can hold each ledger to its own invariant.
+    """
+
+    def __init__(self, capacity_bytes: Optional[int] = None):
+        if capacity_bytes is not None and capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes must be >= 0 or None, got {capacity_bytes}")
+        self.capacity_bytes = capacity_bytes
+        # chain_hash -> (per-pool block arrays, nbytes, checksum)
+        self._store: "OrderedDict[int, Tuple[List[np.ndarray], int, int]]" \
+            = OrderedDict()
+        self.bytes_in_use = 0
+        self.bytes_peak = 0
+        self.demoted_blocks = 0
+        self.promoted_blocks = 0
+        self.hit_blocks = 0
+        self.rejects = 0
+        self.evictions = 0
+        self.corruptions = 0
+
+    def __contains__(self, chain_hash: int) -> bool:
+        return chain_hash in self._store
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def put(self, chain_hash: int, arrays: List[np.ndarray],
+            nbytes: int, checksum: int) -> bool:
+        """Admit one demoted block; evicts coldest entries until it
+        fits. False (and ``rejects`` ticks) when it can never fit."""
+        if chain_hash in self._store:
+            return True                   # already warm — nothing to copy
+        if self.capacity_bytes is not None:
+            if nbytes > self.capacity_bytes:
+                self.rejects += 1
+                return False
+            while self.bytes_in_use + nbytes > self.capacity_bytes:
+                _, (_, old_bytes, _) = self._store.popitem(last=False)
+                self.bytes_in_use -= old_bytes
+                self.evictions += 1
+        self._store[chain_hash] = (arrays, int(nbytes), int(checksum))
+        self.bytes_in_use += nbytes
+        self.bytes_peak = max(self.bytes_peak, self.bytes_in_use)
+        self.demoted_blocks += 1
+        return True
+
+    def peek(self, chain_hash: int) -> Tuple[List[np.ndarray], int, int]:
+        """Read an entry without removing it (refreshes LRU position)."""
+        self._store.move_to_end(chain_hash)
+        return self._store[chain_hash]
+
+    def take(self, chain_hash: int) -> Tuple[List[np.ndarray], int, int]:
+        """Remove an entry — promotion back to HBM (the bytes move
+        tiers) or a corruption drop."""
+        entry = self._store.pop(chain_hash)
+        self.bytes_in_use -= entry[1]
+        return entry
+
+    def drop_corrupt(self, chain_hash: int) -> None:
+        self.take(chain_hash)
+        self.corruptions += 1
+
+    def entries(self) -> List[Tuple[int, List[np.ndarray], int, int]]:
+        """(hash, arrays, nbytes, checksum) rows in LRU order — the
+        fleet migration capture (``GenerationServer.evacuate``)."""
+        return [(h, arrs, nb, crc)
+                for h, (arrs, nb, crc) in self._store.items()]
+
+    def clear(self) -> None:
+        """Drop every entry (a full evacuate — the snapshot carries the
+        copies). Counters keep their history; only occupancy resets."""
+        self._store.clear()
+        self.bytes_in_use = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {"blocks": len(self._store),
+                "bytes_in_use": self.bytes_in_use,
+                "bytes_peak": self.bytes_peak,
+                "demoted_blocks": self.demoted_blocks,
+                "promoted_blocks": self.promoted_blocks,
+                "hit_blocks": self.hit_blocks,
+                "rejects": self.rejects,
+                "evictions": self.evictions,
+                "corruptions": self.corruptions}
 
 
 class KVOffloadEngine:
@@ -148,10 +259,17 @@ class KVOffloadEngine:
     """
 
     def __init__(self, alloc, table_width: int,
-                 capacity_bytes: Optional[int] = None):
+                 capacity_bytes: Optional[int] = None,
+                 warm_capacity_bytes: Optional[int] = None):
         self.alloc = alloc
         self.table_width = int(table_width)
         self.host = HostKVPool(capacity_bytes)
+        # hash-addressed warm tier for demoted prefix blocks; the
+        # allocator's read-only probe consults it through warm_probe so
+        # fleet routing scores warm residency without any side effect
+        self.warm = WarmTier(warm_capacity_bytes)
+        if hasattr(alloc, "warm_probe"):
+            alloc.warm_probe = self.warm.__contains__
         # optional ServingTelemetry (inference/telemetry.py): the owning
         # server sets this so swap copies emit per-request spans + the
         # serving_swap_{out,in}_s histograms. The copies themselves are
@@ -185,6 +303,160 @@ class KVOffloadEngine:
             for bid in table:
                 a.unpin(bid)
         return arrays
+
+    # ----------------------------------------------------------- tier ladder
+    def demote(self, victims: Sequence[Tuple[int, int]],
+               pools: List[Any]) -> int:
+        """Move cached (ref==0) prefix blocks HBM → warm tier.
+
+        ``victims`` is ``[(bid, chain_hash), ...]`` straight from
+        ``BlockAllocator.coldest_cached``. One fixed-width gather — the
+        SAME compiled shape ``gather_payload``/``swap_out`` already use,
+        so pressure-driven demotion adds zero steady-state compiles —
+        pulls every victim at once; each block is then sliced out,
+        CRC-stamped, and admitted to the warm tier individually, and
+        only blocks the tier accepted are evicted from HBM. Returns the
+        number of blocks demoted."""
+        if not victims:
+            return 0
+        tel = self.telemetry
+        _t0 = tel.clock() if tel is not None and tel.enabled else None
+        a = self.alloc
+        bids = [bid for bid, _ in victims]
+        arrays = self.gather_payload(bids, pools)
+        moved = 0
+        for i, (bid, h) in enumerate(victims):
+            block = [np.asarray(p[i]) for p in arrays]
+            if not self.warm.put(h, block, a.bytes_per_block,
+                                 payload_checksum(block)):
+                break                     # tier can never hold it — stay hot
+            a.evict_cached(bid)
+            moved += 1
+        if _t0 is not None and moved:
+            _t1 = tel.clock()
+            tel.registry.histogram(
+                "serving_tier_demote_s",
+                "HBM->warm tier demotion wall time (batched)"
+            ).observe(_t1 - _t0)
+            tel.registry.counter(
+                "serving_tier_demoted_bytes",
+                "KV bytes demoted to the warm tier"
+            ).inc(moved * a.bytes_per_block)
+        return moved
+
+    def match_prefix_tiered(self, tokens: Sequence[int], pools: List[Any]
+                            ) -> Tuple[List[int], List[Any], Dict[str, int]]:
+        """Cross-tier prefix match: the warm-aware twin of
+        ``BlockAllocator.match_prefix``.
+
+        Walks the chain hashes of ``tokens`` (last-token rule applies):
+        a hot hit re-refs the resident block as before; a warm hit
+        allocates a fresh device block, CRC-verifies the parked copy and
+        promotes it back through ONE batched fixed-width scatter — the
+        same compiled shape ``swap_in`` uses — then re-registers it
+        under its hash so the promotion is shareable. The first miss
+        (or a failed CRC, or a dry device pool) stops the walk; tokens
+        past it re-prefill normally, which IS the cold tier.
+
+        Returns ``(table, pools, {"hot": n, "warm": n})`` — every block
+        in ``table`` is ref'd for the caller, ``pools`` reflects the
+        promotion scatter (unchanged when nothing was promoted)."""
+        import jax.numpy as jnp
+
+        a = self.alloc
+        n = len(tokens)
+        limit = max((n - 1) // a.block_size, 0)
+        hashes = a.chain_hashes(tokens)[:limit]
+        table: List[int] = []
+        warm_bids: List[int] = []
+        warm_hashes: List[int] = []
+        warm_blocks: List[List[np.ndarray]] = []
+        hot = 0
+        for h in hashes:
+            bid = a.ref_hash(h)
+            if bid is not None:
+                table.append(bid)
+                hot += 1
+                continue
+            if h not in self.warm:
+                break
+            arrs, nbytes, checksum = self.warm.peek(h)
+            if self.faults is not None and \
+                    self.faults.fire("warm_corrupt") is not None:
+                arrs = [np.array(x) for x in arrs]
+                self.faults.corrupt(arrs)
+            if checksum and payload_checksum(arrs) != checksum:
+                # damaged parked block: drop it (cold tier from here on)
+                self.warm.drop_corrupt(h)
+                tel = self.telemetry
+                if tel is not None and tel.enabled:
+                    tel.registry.counter(
+                        "serving_tier_corruptions",
+                        "warm-tier blocks that failed CRC verification"
+                    ).inc()
+                break
+            if a.blocks_free + a.evictable_cached < 1:
+                break                     # no headroom to promote into
+            try:
+                bid = a.alloc()
+            except RuntimeError:
+                break
+            table.append(bid)
+            warm_bids.append(bid)
+            warm_hashes.append(h)
+            warm_blocks.append(arrs)
+        a.prefix_lookup_blocks += len(hashes)
+        a.prefix_hit_blocks += hot
+        if warm_bids:
+            tel = self.telemetry
+            _t0 = tel.clock() if tel is not None and tel.enabled else None
+            # batched fixed-width promotion scatter: rows past the warm
+            # hits target the scratch block, exactly like swap_in
+            idx = np.zeros((self.table_width,), np.int32)
+            idx[:len(warm_bids)] = warm_bids
+            didx = jnp.asarray(idx)
+            new_pools = []
+            for j, p in enumerate(pools):
+                stack = np.zeros((self.table_width,)
+                                 + warm_blocks[0][j].shape,
+                                 dtype=warm_blocks[0][j].dtype)
+                for i, blk in enumerate(warm_blocks):
+                    stack[i] = blk[j]
+                new_pools.append(
+                    p.at[didx].set(jnp.asarray(stack).astype(p.dtype)))
+            pools = new_pools
+            for bid, h in zip(warm_bids, warm_hashes):
+                a.register(bid, h)
+                self.warm.take(h)         # bytes move tiers with the block
+            self.warm.promoted_blocks += len(warm_bids)
+            self.warm.hit_blocks += len(warm_bids)
+            a.note_promote(len(warm_bids))
+            if _t0 is not None:
+                _t1 = tel.clock()
+                tel.registry.histogram(
+                    "serving_tier_promote_s",
+                    "warm->HBM tier promotion wall time (batched)"
+                ).observe(_t1 - _t0)
+                tel.registry.counter(
+                    "serving_tier_promoted_bytes",
+                    "KV bytes promoted back from the warm tier"
+                ).inc(len(warm_bids) * a.bytes_per_block)
+        return table, pools, {"hot": hot, "warm": len(warm_bids)}
+
+    def forget_warm(self, chain_hash: int) -> None:
+        """A hash just (re)registered in the hot prefix cache supersedes
+        any warm copy — same chain hash means bit-identical KV by
+        construction, so keeping both only wastes host RAM (and would
+        trip the conservation audit's cross-tier exclusivity check).
+        Call after every ``BlockAllocator.register`` that can re-create
+        a previously demoted block."""
+        if chain_hash in self.warm:
+            self.warm.take(chain_hash)
+
+    def tier_stats(self) -> Dict[str, int]:
+        """Warm-tier occupancy/traffic, ``warm_``-prefixed for merging
+        into ``GenerationServer.kv_stats()``."""
+        return {f"warm_{k}": v for k, v in self.warm.stats().items()}
 
     # ------------------------------------------------------------- swap out
     def swap_out(self, rid: int, table: Sequence[int], hashes: Sequence[int],
@@ -230,9 +502,17 @@ class KVOffloadEngine:
 
     # -------------------------------------------------------------- swap in
     def restore_cost(self, handle: SwapHandle) -> int:
-        """Upper bound on fresh device blocks a resume needs (hash matches
-        can only lower it) — the server's admission headroom check."""
-        return handle.n_blocks
+        """Upper bound on fresh device blocks a resume needs — the
+        server's admission headroom check. Resident-hash-aware: leading
+        chain hashes still hot in the allocator restore for free
+        (``match_hashes`` will re-ref them), so only the remainder costs
+        fresh blocks. Read-only."""
+        resident = 0
+        for h in handle.hashes:
+            if not self.alloc.contains_hash(h):
+                break
+            resident += 1
+        return max(handle.n_blocks - resident, 0)
 
     def swap_in(self, handle: SwapHandle, pools: List[Any]
                 ) -> Union[None, str, Tuple[List[int], List[Any]]]:
@@ -298,6 +578,7 @@ class KVOffloadEngine:
                      for p, arr in zip(pools, arrays)]
         for i in range(len(matched), min(len(handle.hashes), len(table))):
             a.register(table[i], handle.hashes[i])
+            self.forget_warm(handle.hashes[i])
         a.note_swap_in(handle.n_blocks, handle.nbytes)
         if _t0 is not None:
             _t1 = tel.clock()

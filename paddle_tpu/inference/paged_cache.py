@@ -28,7 +28,7 @@ Design (vLLM's block manager, trimmed to what the TPU server needs):
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 SCRATCH_BLOCK = 0
 
@@ -80,6 +80,16 @@ class BlockAllocator:
         self.swap_in_blocks = 0
         self.host_bytes_in_use = 0
         self.host_bytes_peak = 0
+        # tier bookkeeping: blocks demoted to the warm tier under LRU
+        # pressure and promoted back on a cross-tier prefix hit
+        # (inference/kv_offload.py drives both)
+        self.demoted_blocks = 0
+        self.promoted_blocks = 0
+        # optional read-only membership probe into the warm tier
+        # (chain_hash -> bool): KVOffloadEngine wires its WarmTier here so
+        # probe_prefix — and through it the fleet router's prefix scoring —
+        # sees warm-resident blocks without any side effect
+        self.warm_probe = None
         # optional FaultInjector (inference/faults.py); the server wires
         # this so chaos plans can script pool exhaustion deterministically
         self.faults = None
@@ -139,7 +149,9 @@ class BlockAllocator:
                 "swap_out_blocks": self.swap_out_blocks,
                 "swap_in_blocks": self.swap_in_blocks,
                 "host_bytes_in_use": self.host_bytes_in_use,
-                "host_bytes_peak": self.host_bytes_peak}
+                "host_bytes_peak": self.host_bytes_peak,
+                "demoted_blocks": self.demoted_blocks,
+                "promoted_blocks": self.promoted_blocks}
 
     def publish(self, registry) -> None:
         """Mirror :meth:`stats` into a
@@ -165,6 +177,10 @@ class BlockAllocator:
     def note_host_release(self, nbytes: int) -> None:
         """Record a parked copy discarded without restore (cancel)."""
         self.host_bytes_in_use -= nbytes
+
+    def note_promote(self, nblocks: int) -> None:
+        """Record ``nblocks`` promoted back from the warm tier."""
+        self.promoted_blocks += nblocks
 
     def _note_use(self):
         self.peak_in_use = max(self.peak_in_use, self.blocks_in_use)
@@ -246,6 +262,51 @@ class BlockAllocator:
         paths can unpin unconditionally)."""
         self._pinned.discard(bid)
 
+    def coldest_cached(self, n: int) -> List[Tuple[int, int]]:
+        """Up to ``n`` demotion candidates ``[(bid, chain_hash), ...]`` in
+        LRU order (coldest first): cached ref==0 blocks that carry a
+        prefix hash and are not pinned. Read-only — the tier driver
+        copies them to host first and only then calls
+        :meth:`evict_cached` on each."""
+        out: List[Tuple[int, int]] = []
+        for bid in self._lru:
+            if len(out) >= n:
+                break
+            if bid in self._pinned:
+                continue
+            out.append((bid, self._hash_of[bid]))
+        return out
+
+    def evict_cached(self, bid: int) -> None:
+        """Remove one cached (ref==0) block from the prefix cache and
+        return it to the free list — the demotion commit. Counted as
+        ``demoted_blocks``, NOT ``evictions``: the contents survive in
+        the warm tier, they are not lost."""
+        if bid not in self._lru:
+            raise KeyError(f"block {bid} is not cached")
+        if bid in self._pinned:
+            raise KeyError(f"block {bid} is pinned — cannot demote")
+        del self._lru[bid]
+        h = self._hash_of.pop(bid)
+        self._by_hash.pop(h, None)
+        self._free.append(bid)
+        self.demoted_blocks += 1
+
+    def contains_hash(self, chain_hash: int) -> bool:
+        """Read-only: is this chain hash hot-resident (live or cached)?"""
+        return chain_hash in self._by_hash
+
+    def ref_hash(self, chain_hash: int) -> Optional[int]:
+        """Re-ref the hot-resident block carrying ``chain_hash`` and
+        return its id, or None on a miss — the per-hash twin of
+        :meth:`match_prefix` that the cross-tier walk interleaves with
+        warm-tier promotion."""
+        bid = self._by_hash.get(chain_hash)
+        if bid is None:
+            return None
+        self.ref(bid)
+        return bid
+
     def touch(self, bid: int) -> None:
         """Refresh a CACHED block's LRU position (most-recently-used) so
         eviction reaches it last. Live or unknown blocks are a no-op —
@@ -294,22 +355,33 @@ class BlockAllocator:
         self.prefix_hit_blocks += len(out)
         return out
 
-    def probe_prefix(self, tokens: Sequence[int]) -> int:
+    def probe_prefix(self, tokens: Sequence[int],
+                     hot_only: bool = False) -> int:
         """Read-only routing probe: how many leading full prompt blocks of
-        ``tokens`` are currently resident (live or cached), capped by the
-        last-token rule like :meth:`match_prefix`. Takes NO references,
-        leaves the LRU order and every hit/lookup counter untouched — a
-        fleet router scores many replicas per submission, and a probe
-        that perturbed the cache would make routing observe-and-destroy.
-        Hashes are chained lazily so a miss stops the walk early."""
+        ``tokens`` are currently resident, capped by the last-token rule
+        like :meth:`match_prefix`. Takes NO references, triggers NO
+        swap-ins, leaves the LRU order and every hit/lookup counter
+        untouched — a fleet router scores many replicas per submission,
+        and a probe that perturbed the cache would make routing
+        observe-and-destroy. Hashes are chained lazily so a miss stops
+        the walk early.
+
+        "Resident" is tier-aware: hot (live or cached in HBM) OR warm
+        (demoted to host, via the ``warm_probe`` membership hook) — a
+        replica holding a prompt's prefix warm is still a far better
+        routing target than one that must re-prefill it. ``hot_only``
+        restricts the walk to HBM residency; the admission path uses it
+        because warm hits still cost fresh device blocks to promote."""
         n = len(tokens)
         limit = max((n - 1) // self.block_size, 0)
         bs = self.block_size
+        warm = None if hot_only else self.warm_probe
         h = hash(("kv_quant", self.kv_quant))
         hits = 0
         for i in range(limit):
             h = hash((h, tuple(tokens[i * bs:(i + 1) * bs])))
-            if h not in self._by_hash:
+            if h not in self._by_hash and not (warm is not None
+                                               and warm(h)):
                 break
             hits += 1
         return hits

@@ -92,6 +92,12 @@ class SchedEntry:
     started: bool = False               # was admitted at least once
     swap: Any = None                    # kv_offload.SwapHandle when swapped out
     adapter: Optional[str] = None       # LoRA adapter name (None = base model)
+    # estimated FRESH device blocks the entry's first allocation burst
+    # needs, annotated by the server's admission gate each time it runs —
+    # tier-aware: hot prefix hits are subtracted (they re-ref resident
+    # blocks), warm-tier hits still count (promotion fills a fresh
+    # block). None until the gate has looked at the entry.
+    kv_need: Optional[int] = None
 
 
 class Scheduler:
@@ -308,6 +314,15 @@ class Scheduler:
     def waiting(self) -> List[SchedEntry]:
         """Current queue in pop order (for introspection/tests)."""
         return sorted(self._q, key=self._key)
+
+    def kv_demand(self) -> int:
+        """Aggregate fresh-block demand of the waiting queue — the sum of
+        every annotated ``SchedEntry.kv_need``. The admission gate
+        refreshes annotations as it scans, so this tracks the tier-aware
+        cost of draining the backlog (fleet routing reads it through
+        ``GenerationServer.load_metrics`` as ``queued_kv_demand``);
+        entries the gate has not seen yet contribute 0."""
+        return sum(e.kv_need for e in self._q if e.kv_need is not None)
 
     def adapter_demand(self) -> List[str]:
         """Distinct adapter names the queue wants, in pop-priority order —

@@ -110,6 +110,9 @@ class GenerationServer:
                  pool_bytes: Optional[int] = None,
                  policy=None,
                  host_pool_bytes: Optional[int] = None,
+                 warm_pool_bytes: Optional[int] = None,
+                 tier_demote_low: Optional[float] = None,
+                 tier_demote_high: Optional[float] = None,
                  lora=None, telemetry=None, faults=None,
                  fault_retries: int = 3, kernels: str = "auto",
                  mesh=None, role: str = "any", profile=None,
@@ -159,6 +162,20 @@ class GenerationServer:
         (host DRAM dwarfs HBM); 0 disables swapping entirely — under
         pressure victims then stall instead of parking.
 
+        ``warm_pool_bytes`` / ``tier_demote_low`` / ``tier_demote_high``
+        (paged only): the tiered hot→warm→cold KV ladder
+        (docs/serving.md, "Long-context serving"). When both watermarks
+        are set (``0 < low < high <= 1``, fractions of usable blocks
+        FREE), each paged tick that finds the free fraction below
+        ``low`` demotes LRU prefix-cached blocks to the warm tier (a
+        hash-keyed, CRC-guarded host store capped at
+        ``warm_pool_bytes``; None = unbounded, 0 disables demotion)
+        until the free fraction reaches ``high``. Warm blocks promoted
+        back on a prefix hit skip their chunked-prefill work; blocks
+        that fall off the warm tier re-prefill from replay (cold). Both
+        watermarks unset (the default) keeps demotion off — the
+        pre-tier behavior.
+
         ``lora=LoRAConfig(registry, ...)`` (paged only): multi-tenant LoRA
         serving. Each request may name an adapter (``submit(adapter=...)``)
         whose low-rank factors live in a paged device pool
@@ -188,14 +205,19 @@ class GenerationServer:
         (the chaos-soak harness). ``fault_retries``: tick-fault strikes a
         request survives before quarantine to terminal ``failed``.
 
-        ``mesh`` (paged only): tensor-parallel serving — ``"tp=N"`` (or
-        the int N) shards the executor's compiled programs over an N-way
+        ``mesh`` (paged only): multi-chip serving — ``"tp=N"`` (or the
+        int N) shards the executor's compiled programs over an N-way
         ``tp`` mesh: attention/kv heads, MLP hidden dim, the KV block
         pool (+ its int8 scale rows), and the LoRA page pool all split on
         the same axis (parallel/serving_mesh.py), while block tables,
         scheduling, snapshots, and swap payloads stay tp-agnostic host
-        state. Greedy output is token-identical to the single-chip
-        engine; every sharded dim must divide N. None/1 = single chip.
+        state. ``"cp=M"`` / ``"tp=NxCp=M"`` adds a context-parallel axis
+        that shards ONLY the chunked-prefill sequence dimension (params
+        and pools replicate over cp; GSPMD all-gathers the chunk K/V
+        before the pool scatter), multiplying prefill tok/s for long
+        prompts. Greedy output is token-identical to the single-chip
+        engine either way; every tp-sharded dim must divide N and
+        ``prefill_chunk`` must divide by M. None/1 = single chip.
 
         ``role`` (paged only): replica class for disaggregated fleets —
         ``"any"`` (default) serves the full lifecycle; ``"prefill"``
@@ -292,27 +314,33 @@ class GenerationServer:
                              "requires cache='paged' — handoff rides the "
                              "paged snapshot/migration path")
         self.role = role
-        if mesh is None:
-            tp = 1
-        elif isinstance(mesh, int):
-            tp = mesh
-        elif isinstance(mesh, str) and mesh.startswith("tp="):
-            try:
-                tp = int(mesh[3:])
-            except ValueError:
-                raise ValueError(f"mesh= must be 'tp=N', got {mesh!r}") \
-                    from None
-        else:
-            raise ValueError(
-                f"mesh must be None, an int tp degree, or 'tp=N', "
-                f"got {mesh!r}")
-        if tp < 1:
-            raise ValueError(f"mesh tp degree must be >= 1, got {tp}")
-        if tp > 1 and cache != "paged":
-            raise ValueError("mesh= (TP-sharded serving) requires "
+        from ..parallel.serving_mesh import parse_mesh
+
+        tp, cp = parse_mesh(mesh)
+        if (tp > 1 or cp > 1) and cache != "paged":
+            raise ValueError("mesh= (multi-chip serving) requires "
                              "cache='paged' — only the paged executor "
                              "places its programs on a mesh")
         self._tp = tp
+        self._cp = cp
+        if (tier_demote_low is None) != (tier_demote_high is None):
+            raise ValueError(
+                "tier_demote_low/tier_demote_high come as a pair — set "
+                "both watermarks (or neither to keep demotion off)")
+        if tier_demote_low is not None:
+            if cache != "paged":
+                raise ValueError("tier_demote_low/high (tiered KV) "
+                                 "require cache='paged'")
+            low, high = float(tier_demote_low), float(tier_demote_high)
+            if not (0.0 < low < high <= 1.0):
+                raise ValueError(
+                    f"tier watermarks must satisfy 0 < low < high <= 1, "
+                    f"got low={tier_demote_low} high={tier_demote_high}")
+            tier_demote_low, tier_demote_high = low, high
+        if warm_pool_bytes is not None and cache != "paged":
+            raise ValueError("warm_pool_bytes= requires cache='paged'")
+        self.tier_demote_low = tier_demote_low
+        self.tier_demote_high = tier_demote_high
         from ..ops import KERNEL_MODES, set_kernel_mode
 
         if kernels not in KERNEL_MODES:
@@ -526,9 +554,18 @@ class GenerationServer:
                                         shards=self._tp)
             from .kv_offload import KVOffloadEngine
 
-            self._offload = KVOffloadEngine(self.alloc, self._table_width,
-                                            capacity_bytes=host_pool_bytes)
+            self._offload = KVOffloadEngine(
+                self.alloc, self._table_width,
+                capacity_bytes=host_pool_bytes,
+                warm_capacity_bytes=warm_pool_bytes)
             self._offload.telemetry = self._tel
+            # cold-tier counter: prefix chains that fell off the warm
+            # tier (or arrived with no cached ancestry at all) and paid
+            # a fresh chunked prefill — the denominator's third leg in
+            # the benchmark's tier_hit_rate
+            self._cold_refills = 0
+            self._prefill_tokens = 0
+            self._prefill_wall_s = 0.0
             if self._faults is not NULL_INJECTOR:
                 # thread the injector through the paged components (even
                 # if currently disabled — a chaos harness arms the plan
@@ -599,7 +636,7 @@ class GenerationServer:
             from .executor import PagedExecutor
 
             self._exec = PagedExecutor(self, num_blocks=int(num_blocks),
-                                       tp=self._tp)
+                                       tp=self._tp, cp=self._cp)
             self._decode_paged = self._exec.decode_paged
             self._chunk_prefill = self._exec.chunk_prefill
             if self.spec is not None:
@@ -980,9 +1017,19 @@ class GenerationServer:
         # replay sequence) instead of the bare prompt — same program,
         # same per-block machinery, different token source
         seq = req.replay if req.replay is not None else req.prompt
-        req.table = self.alloc.match_prefix(seq)
+        # tier-aware prefix match: hot chain blocks ref as before, warm
+        # chain blocks swap in through the compile-once promotion
+        # scatter (kv_offload.match_prefix_tiered) — either way the
+        # matched span skips its chunked prefill
+        req.table, self._pools, tiers = self._offload.match_prefix_tiered(
+            seq, self._pools)
         req.hashes = self.alloc.chain_hashes(seq)
         req.pf_next = len(req.table) * self.block_size
+        if req.pf_next < len(seq) and self._offload.warm.demoted_blocks:
+            # the chain ran out of cached ancestry while a warm tier is
+            # live: the remaining span re-prefills cold (replay rung or
+            # plain chunked prefill — either way a cold-tier service)
+            self._cold_refills += 1
         self._bt[slot, :] = 0
         self._bt[slot, :len(req.table)] = req.table
         self._prefilling[slot] = True
@@ -991,6 +1038,7 @@ class GenerationServer:
             tr = self._tel.tracer
             tr.end(req.rid, "queued")
             tr.begin(req.rid, "prefill", cached_blocks=len(req.table),
+                     warm_blocks=tiers["warm"],
                      prompt_len=len(seq),
                      replay=req.replay is not None)
 
@@ -1023,6 +1071,13 @@ class GenerationServer:
             seq = (ent.req.replay if ent.req.replay is not None
                    else ent.req.prompt)
             need = min(self._max_entries, -(-len(seq) // self.block_size))
+            # hot prefix hits ref existing blocks instead of allocating
+            # fresh ones — shrink the burst by them (hot_only: a WARM
+            # hit still promotes into a freshly allocated device block,
+            # so it must keep counting against headroom)
+            need = max(need - self.alloc.probe_prefix(seq, hot_only=True),
+                       1)
+        ent.kv_need = need          # scheduler's queued-demand aggregate
         usable = self.alloc.num_blocks - 1
         # watchdog-driven admission tightening: while degraded, demand
         # extra spare blocks so admissions stop feeding the pressure that
@@ -1031,6 +1086,29 @@ class GenerationServer:
         headroom = min(need + spare, usable)
         return (self.alloc.blocks_free
                 + self.alloc.evictable_cached) >= headroom
+
+    def _maybe_demote(self) -> None:
+        """Watermark-driven hot→warm demotion (the tier ladder's
+        pressure rung): when the free fraction of usable blocks drops
+        below ``tier_demote_low``, move LRU prefix-cached blocks to the
+        warm tier until it reaches ``tier_demote_high`` — so long-prompt
+        admission finds FREE blocks instead of silently cannibalizing
+        the prefix cache (eviction loses the bytes; demotion keeps them
+        promotable). Runs before admission each paged tick; a no-op
+        without watermarks or without cached blocks to demote."""
+        low = self.tier_demote_low
+        if low is None:
+            return
+        a = self.alloc
+        usable = a.num_blocks - 1
+        if usable <= 0 or a.blocks_free / usable >= low:
+            return
+        want = int(self.tier_demote_high * usable) - a.blocks_free
+        if want <= 0:
+            return
+        victims = a.coldest_cached(want)
+        if victims:
+            self._offload.demote(victims, self._pools)
 
     def _resume_swapped(self, slot: int, ent: SchedEntry) -> bool:
         """Restore a swapped-out request into ``slot`` exactly where it
@@ -1240,16 +1318,23 @@ class GenerationServer:
                 if self._lora is not None else None)
         tel = self._tel
         _t0 = tel.clock() if tel.enabled else 0.0
+        _w0 = self._wall()
         lg, self._pools = self._chunk_prefill(
             self.params, jnp.asarray(chunk), self._pools,
             jnp.asarray(self._bt[slot]), jnp.int32(start),
             jnp.int32(last_idx), aidx, self._lora_flat())
+        # per-chip prefill throughput ledger (tools/serving_benchmark.py
+        # divides by tp*cp): real prompt tokens only, not chunk padding
+        self._prefill_tokens += end - start
+        self._prefill_wall_s += self._wall() - _w0
         if tel.enabled:
             tel.tracer.complete(req.rid, "prefill_chunk", _t0, tel.clock(),
                                 start=start, tokens=end - start)
         # publish the prompt blocks this chunk completed for prefix reuse
+        # (a freshly prefilled hash supersedes any stale warm copy)
         for i in range(start // bs, end // bs):
             self.alloc.register(req.table[i], req.hashes[i])
+            self._offload.forget_warm(req.hashes[i])
         req.pf_next = start + C
         if end == n:
             if req.replay is not None:
@@ -1310,7 +1395,8 @@ class GenerationServer:
         c0 = compile_count()
         pre = (self._preemptions, self._prefill_aborts, self._resumes,
                self._stalls, a.fresh_allocs, a.evictions,
-               a.swap_out_blocks, a.swap_in_blocks)
+               a.swap_out_blocks, a.swap_in_blocks,
+               a.demoted_blocks, a.promoted_blocks)
         sp0, sa0 = ((self._spec_proposed, self._spec_accepted)
                     if self.spec is not None else (0, 0))
         remaining = self._step_paged_inner()
@@ -1335,6 +1421,9 @@ class GenerationServer:
             "swap_bytes": (a.swap_out_blocks - pre[6]
                            + a.swap_in_blocks - pre[7]) * a.bytes_per_block,
             "host_bytes": self._offload.host.bytes_in_use,
+            "demotions": a.demoted_blocks - pre[8],
+            "promotions": a.promoted_blocks - pre[9],
+            "warm_bytes": self._offload.warm.bytes_in_use,
             "recompiles": compile_count() - c0,
         }
         if self.spec is not None:
@@ -1363,6 +1452,9 @@ class GenerationServer:
         tel_on = self._tel.enabled
         if tel_on:
             self._last_prog = "idle"
+        # demote BEFORE admission: freed blocks feed _service_queue's
+        # headroom gate this same tick
+        self._maybe_demote()
         self._service_queue()
         # chunked prefill interleaves with decode: ONE chunk per prefilling
         # slot per step, so a long prompt never blocks slots mid-decode
@@ -1917,10 +2009,15 @@ class GenerationServer:
             self._samp_dev = None
 
     def kv_stats(self) -> Dict[str, int]:
-        """Paged-pool occupancy/prefix-cache counters (empty for dense)."""
+        """Paged-pool occupancy/prefix-cache counters, merged with the
+        warm-tier ledger (``warm_*`` keys) and the cold-refill count
+        (empty for dense)."""
         if self.cache_mode != "paged":
             return {}
-        return self.alloc.stats()
+        out = self.alloc.stats()
+        out.update(self._offload.tier_stats())
+        out["cold_refills"] = self._cold_refills
+        return out
 
     # ------------------------------------------------------ fault tolerance
     def assert_conserved(self) -> Dict[str, int]:
@@ -1977,6 +2074,19 @@ class GenerationServer:
         if len(self._offload.host) != len(swapped):
             errs.append(f"host pool parks {len(self._offload.host)} "
                         f"payloads but {len(swapped)} entries are swapped")
+        warm = self._offload.warm
+        warm_bytes = sum(nb for _, _, nb, _ in warm.entries())
+        if warm_bytes != warm.bytes_in_use:
+            errs.append(f"warm tier ledger {warm.bytes_in_use} != sum of "
+                        f"parked entries {warm_bytes}")
+        dual = [h for h, _, _, _ in warm.entries()
+                if a.contains_hash(h)]
+        if dual:
+            # promotion takes the warm copy and demotion unregisters the
+            # hot block — a hash resident in BOTH tiers means one of
+            # those handoffs half-finished
+            errs.append(f"{len(dual)} chain hashes resident in both the "
+                        f"hot prefix cache and the warm tier")
         if self._lora is not None:
             la = self._lora.alloc
             lu = la.num_blocks - 1
@@ -1998,6 +2108,8 @@ class GenerationServer:
                "blocks_cached": a.blocks_cached,
                "blocks_free": a.blocks_free,
                "host_bytes_in_use": parked,
+               "warm_blocks": len(warm),
+               "warm_bytes_in_use": warm.bytes_in_use,
                "swapped_waiting": len(swapped)}
         # per-shard pool audit (tp executors): donation must rotate the
         # pool buffers without ever resharding them — raises on a lost
@@ -2135,6 +2247,14 @@ class GenerationServer:
             "requests": reqs,
             "results": {r: list(t) for r, t in self._results.items()},
             "dropped": dict(self._dropped),
+            # the warm tier rides along in BOTH modes: its payloads are
+            # host RAM behind per-block CRCs (like swapped entries), so
+            # an untrusted device never taints them; the restoring side
+            # adopts them via adopt_warm (CRC-verified, best-effort)
+            "warm_tier": [
+                {"hash": h, "arrays": [np.array(x) for x in arrs],
+                 "nbytes": nb, "checksum": crc}
+                for h, arrs, nb, crc in self._offload.warm.entries()],
         }
         if self.spec is not None:
             snap["spec_state"] = {
@@ -2194,6 +2314,7 @@ class GenerationServer:
         for d in sorted(snap["requests"], key=lambda d: d["sched"]["seq"]):
             self._admit_snapshot_request(d, now)
             restored += 1
+        self.adopt_warm(snap.get("warm_tier", ()))
         return restored
 
     def _check_snapshot_config(self, want: Dict[str, Any]) -> None:
@@ -2327,6 +2448,33 @@ class GenerationServer:
         self._admit_snapshot_request(d, self._sched.now())
         return int(d["rid"])
 
+    def adopt_warm(self, entries: Sequence[Dict[str, Any]]) -> int:
+        """Adopt a peer's warm-tier entries (a snapshot's ``warm_tier``
+        list) into this server's warm tier — the fleet-wide prefix-cache
+        half of a migration: a shared prompt prefilled once on the dying
+        replica stays promotable on the survivor. Best-effort and
+        CRC-verified per entry: a corrupt payload is dropped (a cache
+        may always miss), a hash already hot here is skipped (cross-tier
+        exclusivity), and the warm pool's own capacity/LRU rules apply.
+        Returns the number of entries adopted."""
+        if self.cache_mode != "paged":
+            raise ValueError("adopt_warm() requires cache='paged'")
+        from .kv_offload import payload_checksum
+
+        adopted = 0
+        for d in entries:
+            h = int(d["hash"])
+            if self.alloc.contains_hash(h) or h in self._offload.warm:
+                continue
+            arrays = [np.asarray(a) for a in d["arrays"]]
+            if payload_checksum(arrays) != int(d["checksum"]):
+                self._c_corrupt.inc()
+                continue
+            if self._offload.warm.put(h, arrays, int(d["nbytes"]),
+                                      int(d["checksum"])):
+                adopted += 1
+        return adopted
+
     def evacuate(self, *, trust_kv: bool = True,
                  rids: Optional[Sequence[int]] = None) -> Dict[str, Any]:
         """Capture a :meth:`snapshot` and then RELEASE every in-flight
@@ -2369,6 +2517,10 @@ class GenerationServer:
             self._tel.tracer.close(ent.rid, "migrated")
         if keep is None:
             self._handoff.clear()
+            # full drain: the warm entries moved with the snapshot (the
+            # router offers them to a survivor via adopt_warm) — drop
+            # the local copies so this engine truly ends empty
+            self._offload.warm.clear()
         return snap
 
     def handoff_ready(self) -> List[int]:
@@ -2416,6 +2568,7 @@ class GenerationServer:
         if self.cache_mode == "paged":
             m["blocks_headroom"] = (self.alloc.blocks_free
                                     + self.alloc.evictable_cached)
+            m["queued_kv_demand"] = self._sched.kv_demand()
         return m
 
     def set_rid_base(self, base: int) -> None:
@@ -2448,6 +2601,10 @@ class GenerationServer:
             self.alloc.publish(reg)
             for k, v in self._offload.host.stats().items():
                 reg.gauge(f"serving_host_pool_{k}").set(float(v))
+            for k, v in self._offload.tier_stats().items():
+                reg.gauge(f"serving_tier_{k}").set(float(v))
+            reg.gauge("serving_tier_cold_refills").set(
+                float(self._cold_refills))
         if self._lora is not None:
             for k, v in self._lora.stats().items():
                 reg.gauge(f"serving_{k}").set(float(v))

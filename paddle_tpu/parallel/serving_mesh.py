@@ -44,7 +44,8 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 __all__ = [
-    "SERVING_TP_AXIS", "build_serving_mesh", "validate_tp",
+    "SERVING_TP_AXIS", "SERVING_CP_AXIS", "parse_mesh",
+    "build_serving_mesh", "validate_tp", "validate_cp",
     "mesh_fingerprint", "serving_param_specs", "place_params",
     "pool_spec", "place_pools", "lora_pool_specs", "place_lora_flat",
     "place_replicated", "audit_pool_shardings",
@@ -52,22 +53,86 @@ __all__ = [
 
 SERVING_TP_AXIS = "tp"
 
+# context-parallel axis for chunked prefill: the (1, C) prefill chunk is
+# constrained to shard its sequence dim over ``cp`` while params and KV
+# pools name only ``tp`` (replicated across cp), so GSPMD partitions the
+# per-token work — embedding, q/k/v projections, rope — across the cp
+# group and all-gathers the chunk's K/V before the pool scatter. Each
+# shard then attends over the FULL written prefix, which is why cp>1 is
+# bit-identical to cp=1: no reduction changes order, only batch-of-token
+# work moves.
+SERVING_CP_AXIS = "cp"
+
 # training axis name whose layer pspecs carry the column/row-parallel
 # layout serving reuses (see parallel/engine.py param_specs)
 _TRAIN_TENSOR_AXIS = "tensor"
 
 
-def build_serving_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
-    """1-D ``tp`` mesh over the first ``tp`` addressable devices."""
+def parse_mesh(spec) -> Tuple[int, int]:
+    """Normalize a ``GenerationServer(mesh=...)`` value to ``(tp, cp)``.
+
+    Accepts None (single chip), a bare int (tp for backward compat),
+    ``"tp=N"``, ``"cp=M"``, or the combined ``"tp=NxCp=M"`` (the ``x``
+    separator is case-insensitive, as is each axis name)."""
+    if spec is None:
+        return 1, 1
+    if isinstance(spec, int):
+        if spec < 1:
+            raise ValueError(f"mesh tp must be >= 1, got {spec}")
+        return spec, 1
+    if not isinstance(spec, str):
+        raise ValueError(
+            f"mesh must be None, an int, or 'tp=N'/'cp=M'/'tp=NxCp=M', "
+            f"got {spec!r}")
+    tp, cp = 1, 1
+    seen = set()
+    for part in spec.lower().split("x"):
+        part = part.strip()
+        if "=" not in part:
+            raise ValueError(
+                f"unrecognized mesh spec {spec!r} — expected "
+                f"'tp=N', 'cp=M', or 'tp=NxCp=M'")
+        axis, _, val = part.partition("=")
+        axis = axis.strip()
+        if axis not in ("tp", "cp") or axis in seen:
+            raise ValueError(
+                f"unrecognized mesh spec {spec!r} — expected "
+                f"'tp=N', 'cp=M', or 'tp=NxCp=M'")
+        seen.add(axis)
+        try:
+            n = int(val)
+        except ValueError:
+            raise ValueError(
+                f"mesh axis {axis!r} needs an integer size, got {val!r}")
+        if n < 1:
+            raise ValueError(f"mesh {axis} must be >= 1, got {n}")
+        if axis == "tp":
+            tp = n
+        else:
+            cp = n
+    return tp, cp
+
+
+def build_serving_mesh(tp: int, cp: int = 1,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """``tp`` mesh over the first ``tp*cp`` addressable devices; stays
+    1-D at ``cp=1`` (byte-identical to the pre-cp layout) and becomes a
+    2-D ``(tp, cp)`` mesh otherwise — every existing spec that names
+    only ``tp`` keeps its meaning (replicated over cp)."""
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
+    if cp < 1:
+        raise ValueError(f"cp must be >= 1, got {cp}")
     devs = list(devices) if devices is not None else jax.devices()
-    if len(devs) < tp:
+    if len(devs) < tp * cp:
         raise ValueError(
-            f"mesh tp={tp} needs {tp} devices but only {len(devs)} are "
-            f"addressable — on CPU dryruns set "
+            f"mesh tp={tp} cp={cp} needs {tp * cp} devices but only "
+            f"{len(devs)} are addressable — on CPU dryruns set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N")
-    return Mesh(np.array(devs[:tp]), (SERVING_TP_AXIS,))
+    if cp == 1:
+        return Mesh(np.array(devs[:tp]), (SERVING_TP_AXIS,))
+    return Mesh(np.array(devs[:tp * cp]).reshape(tp, cp),
+                (SERVING_TP_AXIS, SERVING_CP_AXIS))
 
 
 def validate_tp(cfg, tp: int) -> None:
@@ -87,14 +152,29 @@ def validate_tp(cfg, tp: int) -> None:
             f"sharded dimension must split evenly across the tp axis")
 
 
+def validate_cp(cp: int, prefill_chunk: int) -> None:
+    """The prefill chunk's sequence dim is the ONLY thing cp shards, so
+    the (block-rounded) chunk length must split evenly — an uneven split
+    would make GSPMD pad the chunk and the scatter's padded rows would
+    land outside the scratch-masked region."""
+    if cp > 1 and prefill_chunk % cp:
+        raise ValueError(
+            f"mesh cp={cp} does not divide prefill_chunk="
+            f"{prefill_chunk} — the chunked-prefill sequence dim must "
+            f"split evenly across the cp axis")
+
+
 def mesh_fingerprint(mesh: Optional[Mesh]) -> str:
     """Snapshot-stamp for the serving layout: ``tp1`` is the single-chip
-    engine, ``tpN`` an N-way sharded one. Snapshot payloads are
-    full-width host gathers, so any tp restores into any tp — the stamp
-    records provenance, it is not a compatibility gate."""
+    engine, ``tpN`` an N-way sharded one, ``tpNcpM`` a context-parallel
+    one. Snapshot payloads are full-width host gathers, so any layout
+    restores into any other — the stamp records provenance, it is not a
+    compatibility gate."""
     if mesh is None:
         return "tp1"
-    return f"tp{mesh.shape[SERVING_TP_AXIS]}"
+    cp = mesh.shape.get(SERVING_CP_AXIS, 1)
+    tp = mesh.shape[SERVING_TP_AXIS]
+    return f"tp{tp}" if cp == 1 else f"tp{tp}cp{cp}"
 
 
 def serving_param_specs(model, mesh: Mesh) -> Dict[str, P]:

@@ -608,6 +608,7 @@ def _sliding_worst(recs: List[Dict[str, Any]], field: str, window: int,
 def watchdog(records: Iterable[Dict[str, Any]], *,
              preempt_window: int = 32, preempt_storm: int = 8,
              stall_window: int = 32, stall_frac: float = 0.5,
+             thrash_window: int = 32, thrash_blocks: int = 16,
              warmup_ticks: int = 8,
              warm_progs: Optional[Iterable[str]] = None) \
         -> List[Dict[str, Any]]:
@@ -618,6 +619,13 @@ def watchdog(records: Iterable[Dict[str, Any]], *,
     - ``pool_pressure_stall``: ≥ ``stall_frac`` of some
       ``stall_window``-tick window stalled on block reservation — the
       pool is undersized for the workload (or the host pool refused).
+    - ``tier_thrash``: ≥ ``thrash_blocks`` demotions AND ≥
+      ``thrash_blocks`` promotions inside the same
+      ``thrash_window``-tick window — blocks ping-ponging across the
+      HBM↔warm boundary, paying both copies without netting capacity
+      (demotion alone is healthy pressure relief; promotion alone is
+      healthy cache reuse; BOTH at volume means the watermarks sit on
+      top of the working set).
     - ``steady_state_recompile``: a backend compile on a tick whose
       program key was ALREADY seen on an earlier tick (and past
       ``warmup_ticks``) — first use of a new program (gate flip, turbo
@@ -655,6 +663,19 @@ def watchdog(records: Iterable[Dict[str, Any]], *,
             "seq": recs[at]["seq"],
             "detail": f"{worst}/{window} ticks stalled on block "
                       f"reservation — pool (or host pool) undersized"})
+
+    worst_d, at_d = _sliding_worst(recs, "demotions", thrash_window)
+    worst_p, at_p = _sliding_worst(recs, "promotions", thrash_window)
+    if worst_d >= thrash_blocks and worst_p >= thrash_blocks:
+        at = max(at_d, at_p)
+        findings.append({
+            "kind": "tier_thrash",
+            "demotions": worst_d, "promotions": worst_p,
+            "window": thrash_window, "seq": recs[at]["seq"],
+            "detail": f"{worst_d} demotions and {worst_p} promotions in "
+                      f"{thrash_window} ticks — the warm tier is churning "
+                      f"the working set; widen the watermark band "
+                      f"(tier_demote_low/high) or raise the pool budget"})
 
     warm = set(warm_progs) if warm_progs else set()
     seen_progs: set = set(warm)

@@ -1,0 +1,356 @@
+"""Tiered hot/warm/cold KV + context-parallel prefill (PR 15).
+
+Contracts under test, all quick-tier on CPU:
+
+- ``parse_mesh`` accepts every documented spelling and rejects garbage;
+  a ``cp=2`` server's greedy tokens are bit-identical to the default
+  single-chip server (context parallelism is placement, not math).
+- Watermark-driven demotion (``tier_demote_low/high``) is token-exact
+  vs an unpressured oracle and conserves the pool at every tick —
+  including when blocks are demoted mid-decode.
+- ``probe_prefix`` agrees with ``match_prefix_tiered`` when the matched
+  chain spans warm-tier blocks, and the probe is strictly read-only:
+  no swap-ins, no counter movement, no LRU promotion to HBM.
+- ``HostKVPool.put`` refuses over-budget payloads, counts the refusal,
+  and the server exports it as the ``serving_host_pool_rejects`` gauge.
+- The ``tier_thrash`` watchdog fires only when demotions AND promotions
+  both reach volume inside one window.
+- The autotuner's cp / tier-watermark knobs validate and canonicalize;
+  ``WorkloadSpec``'s long-context + shared-prefix axes draw stable,
+  order-stable traffic.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autotune.space import engine_space
+from paddle_tpu.autotune.workload import (LONG_CONTEXT_LADDER, WorkloadSpec,
+                                          draw_traffic, warmup_traffic)
+from paddle_tpu.inference.kv_offload import HostKVPool
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.parallel.serving_mesh import parse_mesh
+from paddle_tpu.telemetry import watchdog
+
+
+def _model(max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, cfg.vocab_size, n).tolist() for n in lens]
+
+
+# --------------------------------------------------------------- mesh / cp
+def test_parse_mesh_spellings_and_rejects():
+    assert parse_mesh(None) == (1, 1)
+    assert parse_mesh(2) == (2, 1)
+    assert parse_mesh("tp=4") == (4, 1)
+    assert parse_mesh("cp=2") == (1, 2)
+    assert parse_mesh("tp=2xcp=2") == (2, 2)
+    assert parse_mesh("TP=2xCP=4") == (2, 4)     # case-insensitive
+    for bad in (0, -1, "tp=0", "cp=-2", "dp=2", "tp=2ycp=2", "tp=", "2x2"):
+        with pytest.raises(ValueError):
+            parse_mesh(bad)
+
+
+def test_cp2_prefill_tokens_match_single_chip():
+    """mesh='cp=2' shards the prefill chunk over the cp axis — placement
+    only, so greedy tokens must be bit-identical to the default server,
+    multi-chunk prompts included (prompt 20 > chunk 8)."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, (5, 12, 20, 9), seed=3)
+
+    def run(mesh):
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8, mesh=mesh)
+        rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    assert run(None) == run("cp=2")
+
+
+def test_cp_mesh_requires_paged_and_even_chunk():
+    model, cfg = _model()
+    with pytest.raises(ValueError):
+        GenerationServer(model, max_batch=2, max_len=64, mesh="cp=2")
+    with pytest.raises(ValueError):
+        # chunk is block-rounded to 8, which cp=3 cannot split evenly
+        GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                         block_size=4, prefill_chunk=8, mesh="cp=3")
+
+
+# --------------------------------------------------- watermark tier ladder
+def test_watermark_demotion_token_exact_and_conserved_every_tick():
+    """A block-starved server with demotion watermarks must produce the
+    exact tokens of an unpressured oracle, demote real blocks under
+    pressure, and hold the conservation audit at EVERY tick."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, (17, 13, 21, 9, 15), seed=4)
+
+    oracle = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                              block_size=4, prefill_chunk=8)
+    ro = [oracle.submit(p, max_new_tokens=8) for p in prompts]
+    ref = oracle.run()
+
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, num_blocks=20,
+                           tier_demote_low=0.3, tier_demote_high=0.7)
+    rs = [srv.submit(p, max_new_tokens=8) for p in prompts]
+    while srv.step():
+        srv.assert_conserved()
+    out, srv._results = srv._results, {}
+    for a, b in zip(ro, rs):
+        assert out[b] == ref[a]
+    st = srv.kv_stats()
+    assert st["warm_demoted_blocks"] > 0        # pressure actually fired
+    srv.assert_conserved()
+
+
+def test_mid_decode_demotion_conserved_and_promotable():
+    """Demoting cached blocks while another request is mid-decode must
+    keep the pool conserved, leave the in-flight tokens untouched, and
+    the demoted chain must come back via warm promotion (no re-prefill,
+    same tokens)."""
+    model, cfg = _model()
+    pa, pb = _prompts(cfg, (19, 14), seed=5)
+
+    oracle = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                              block_size=4, prefill_chunk=8)
+    ra = oracle.submit(pa, max_new_tokens=8)
+    rb = oracle.submit(pb, max_new_tokens=8)
+    ref = oracle.run()
+
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8)
+    r1 = srv.submit(pa, max_new_tokens=8)
+    out1 = srv.run()
+    assert out1[r1] == ref[ra]                  # pa's prefix is now cached
+
+    r2 = srv.submit(pb, max_new_tokens=8)
+    srv.step()
+    srv.step()                                   # pb mid-decode
+    victims = srv.alloc.coldest_cached(8)
+    assert victims                               # pa's cached prefix blocks
+    moved = srv._offload.demote(victims, srv._pools)
+    assert moved == len(victims)
+    srv.assert_conserved()                       # cross-tier ledgers hold
+    while srv.step():
+        srv.assert_conserved()
+    out2, srv._results = srv._results, {}
+    assert out2[r2] == ref[rb]                   # in-flight decode untouched
+
+    before = srv.kv_stats()
+    r3 = srv.submit(pa, max_new_tokens=8)
+    out3 = srv.run()
+    after = srv.kv_stats()
+    assert out3[r3] == ref[ra]                   # warm round trip is exact
+    assert after["warm_promoted_blocks"] > before["warm_promoted_blocks"]
+    # only the partial tail block re-prefilled (the cold rung by
+    # definition) — every demoted FULL block came back via promotion
+    assert after["cold_refills"] == before["cold_refills"] + 1
+    srv.assert_conserved()
+
+
+# -------------------------------------------- cross-tier prefix cache probe
+def test_probe_prefix_agrees_with_tiered_match_and_is_read_only():
+    """After demoting a cached chain to the warm tier: the routing probe
+    must still count those blocks resident (hot+warm), must equal what
+    ``match_prefix_tiered`` actually delivers, and must move NOTHING —
+    no swap-ins, no promotion, no hit/lookup counters, no free-list
+    movement."""
+    model, cfg = _model()
+    prompt = _prompts(cfg, (21,), seed=6)[0]     # 5 full blocks at bs=4
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8)
+    srv.submit(prompt, max_new_tokens=6)
+    srv.run()
+    a = srv.alloc
+    full_blocks = (len(prompt) - 1) // 4
+
+    # demote PART of the chain so the probe walk genuinely spans tiers
+    victims = a.coldest_cached(2)
+    assert srv._offload.demote(victims, srv._pools) == 2
+    assert len(srv._offload.warm) == 2
+
+    pre_warm = dict(srv._offload.warm.stats())
+    pre_free = a.blocks_free
+    pre_cnt = (a.prefix_lookup_blocks, a.prefix_hit_blocks)
+    hits = a.probe_prefix(prompt)
+    assert hits == full_blocks                   # hot remainder + warm pair
+    assert a.probe_prefix(prompt, hot_only=True) < full_blocks
+    # strictly read-only: warm tier, free list, and counters untouched
+    assert dict(srv._offload.warm.stats()) == pre_warm
+    assert a.blocks_free == pre_free
+    assert (a.prefix_lookup_blocks, a.prefix_hit_blocks) == pre_cnt
+
+    table, pools, st = srv._offload.match_prefix_tiered(prompt, srv._pools)
+    srv._pools = pools
+    assert len(table) == hits                    # probe == delivered blocks
+    assert st["warm"] == 2 and st["hot"] == hits - 2
+    assert len(srv._offload.warm) == 0           # promotion moved the bytes
+    for bid in table:
+        a.free(bid)
+    srv.assert_conserved()
+
+
+# ----------------------------------------------------- host pool + gauges
+def test_host_pool_rejects_counter_and_server_gauge():
+    """An over-budget ``put`` must refuse (caller keeps the victim hot),
+    tick ``rejects``, and surface through ``telemetry_snapshot`` as the
+    ``serving_host_pool_rejects`` gauge."""
+    pool = HostKVPool(capacity_bytes=64)
+    ok = pool.put(1, [np.zeros(8, np.float32)], 32)
+    assert ok and pool.bytes_in_use == 32
+    assert not pool.put(2, [np.zeros(64, np.float32)], 256)
+    assert pool.rejects == 1
+    assert pool.stats()["rejects"] == 1
+    assert pool.stats()["parked"] == 1           # the refusal parked nothing
+    assert pool.bytes_in_use == 32               # ledger untouched by refusal
+
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, telemetry=True)
+    srv._offload.host = HostKVPool(capacity_bytes=8)
+    assert not srv._offload.host.put(7, [np.zeros(16, np.float32)], 64)
+    srv.telemetry_snapshot()
+    reg = srv._tel.registry
+    assert reg.gauge("serving_host_pool_rejects").value() == 1.0
+    assert reg.gauge("serving_host_pool_bytes_in_use").value() == 0.0
+
+
+def test_server_exports_tier_gauges():
+    model, cfg = _model()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8, telemetry=True)
+    srv.submit(_prompts(cfg, (17,), seed=8)[0], max_new_tokens=4)
+    srv.run()
+    assert srv._offload.demote(srv.alloc.coldest_cached(2), srv._pools) == 2
+    srv.telemetry_snapshot()
+    reg = srv._tel.registry
+    assert reg.gauge("serving_tier_warm_blocks").value() == 2.0
+    assert reg.gauge("serving_tier_warm_demoted_blocks").value() == 2.0
+    assert reg.gauge("serving_tier_warm_bytes_in_use").value() > 0.0
+    assert reg.gauge("serving_tier_cold_refills").value() == 0.0
+
+
+# ------------------------------------------------------ tier_thrash watchdog
+def test_watchdog_tier_thrash_needs_both_directions():
+    def recs(demote, promote, n=32):
+        return [{"seq": i, "demotions": demote, "promotions": promote,
+                 "preemptions": 0, "stalled": 0, "recompiles": 0}
+                for i in range(n)]
+
+    # demotion alone is pressure relief, promotion alone is cache reuse
+    assert not [f for f in watchdog(recs(2, 0))
+                if f["kind"] == "tier_thrash"]
+    assert not [f for f in watchdog(recs(0, 2))
+                if f["kind"] == "tier_thrash"]
+    # both at volume inside one window = ping-pong
+    hits = [f for f in watchdog(recs(1, 1)) if f["kind"] == "tier_thrash"]
+    assert len(hits) == 1
+    assert hits[0]["demotions"] >= 16 and hits[0]["promotions"] >= 16
+    # below the block threshold: quiet
+    assert not [f for f in watchdog(recs(1, 1, n=8))
+                if f["kind"] == "tier_thrash"]
+
+
+# -------------------------------------------------- autotune space/workload
+def test_config_space_cp_and_watermark_constraints():
+    space = engine_space(devices=2)
+    cfg = space.default()
+    assert cfg["cp"] == 1 and cfg["tier_demote_low"] is None
+    assert space.is_valid(cfg)
+
+    bad = dict(cfg, cp=4)                        # no 4-device mesh here
+    assert any("cp=4" in e for e in space.errors(bad))
+    bad = dict(cfg, cp=2, prefill_chunk=2)       # off-menu chunk is caught
+    assert space.errors(bad)
+    ok = dict(cfg, cp=2, prefill_chunk=64)
+    assert space.is_valid(ok)
+
+    bad = dict(cfg, tier_demote_low=0.2, tier_demote_high=None)
+    assert any("both or neither" in e for e in space.errors(bad))
+    bad = dict(cfg, tier_demote_low=0.2, tier_demote_high=0.1)
+    assert space.errors(bad)                     # unordered pair
+    assert space.is_valid(dict(cfg, tier_demote_low=0.2,
+                               tier_demote_high=0.5))
+
+    # dead high watermark collapses: the pair shares one fingerprint
+    a = dict(cfg, tier_demote_low=None, tier_demote_high=0.5)
+    b = dict(cfg, tier_demote_low=None, tier_demote_high=None)
+    assert space.canonicalize(a)["tier_demote_high"] is None
+    assert space.fingerprint(a) == space.fingerprint(b)
+
+    # seeded sampling still lands only on valid configs with the new knobs
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        assert space.is_valid(space.sample(rng))
+
+
+def test_workload_long_context_and_shared_prefix():
+    # default ladder swaps to the log-spaced long-context rungs; an
+    # explicit (CPU-scaled) ladder always wins
+    assert WorkloadSpec(long_context=True).prompt_ladder \
+        == LONG_CONTEXT_LADDER
+    spec = WorkloadSpec(requests=6, max_new=4, long_context=True,
+                        prompt_ladder=(32, 48), shared_prefix_frac=0.5,
+                        vocab_size=64, seed=9)
+    assert spec.prompt_ladder == (32, 48)
+    with pytest.raises(ValueError):
+        WorkloadSpec(shared_prefix_frac=1.5)
+
+    t = draw_traffic(spec)
+    assert t.signature() == draw_traffic(spec).signature()  # stable draw
+    # every request shares the same per-seed prefix for half its length,
+    # and warmup traffic (disjoint rng stream) re-hits the SAME prefix
+    shared = max((r.prompt for r in t.requests), key=len)[:24]
+    for r in t.requests:
+        k = len(r.prompt) // 2
+        assert r.prompt[:k] == shared[:k]
+    for r in warmup_traffic(spec, 3):
+        k = len(r.prompt) // 2
+        assert r.prompt[:k] == shared[:k]
+    # enabling the overlay must not shift the per-request length draws
+    plain = draw_traffic(WorkloadSpec(requests=6, max_new=4,
+                                      prompt_ladder=(32, 48),
+                                      vocab_size=64, seed=9))
+    assert [len(r.prompt) for r in t.requests] \
+        == [len(r.prompt) for r in plain.requests]
+    # round trip through the profile dict form
+    assert WorkloadSpec.from_dict(spec.to_dict()) == spec
+
+
+# ------------------------------------------------------- warm-tier migration
+def test_adopt_warm_carries_demoted_prefix_across_servers():
+    """A snapshot's warm_tier entries adopted by a fresh server must be
+    promotable there: same tokens, promotion (not re-prefill), and a
+    hash already hot on the adopter is skipped."""
+    model, cfg = _model()
+    prompt = _prompts(cfg, (21,), seed=10)[0]
+    a = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                         block_size=4, prefill_chunk=8)
+    r1 = a.submit(prompt, max_new_tokens=6)
+    ref = a.run()[r1]
+    assert a._offload.demote(a.alloc.coldest_cached(8), a._pools) > 0
+    entries = a.snapshot()["warm_tier"]
+    assert entries
+
+    b = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                         block_size=4, prefill_chunk=8)
+    assert b.adopt_warm(entries) == len(entries)
+    assert b.adopt_warm(entries) == 0            # already warm -> skipped
+    r2 = b.submit(prompt, max_new_tokens=6)
+    out = b.run()[r2]
+    st = b.kv_stats()
+    assert out == ref
+    assert st["warm_promoted_blocks"] == len(entries)
+    assert st["cold_refills"] == 1               # the partial tail block only
+    b.assert_conserved()

@@ -239,7 +239,7 @@ print(f"deaths {r['fleet_deaths']} (states {r['fleet_states']}), "
       f"mismatches {r['token_mismatches']}, recompiles "
       f"{r['drain_recompiles']}/{r['ref_drain_recompiles']} (fleet/ref), "
       f"tok/s {r['value']} vs twin {r['ref_tok_s']}")
-assert r.get("schema_version") == 3, "benchmark schema drifted"
+assert r.get("schema_version") == 4, "benchmark schema drifted"
 assert r.get("config_fingerprint"), "missing config fingerprint"
 assert r["fleet_deaths"] == 1, "seeded kill never landed — gate vacuous"
 assert r["fleet_states"]["dead"] == 1 and r["fleet_states"]["live"] == 1
@@ -323,7 +323,7 @@ JAX_PLATFORMS=cpu python tools/kernel_bench.py --tp 2 --shapes 2,4,8 \
 python - <<'PY'
 # multi-chip gate: the tp=2 line must be TOKEN-IDENTICAL to the tp=1
 # line (same seed, same traffic — the fingerprint hashes every output
-# sequence), carry the per-chip normalization, and hold the v3 schema;
+# sequence), carry the per-chip normalization, and hold the v4 schema;
 # the disaggregated run must kill exactly the prefill replica, salvage
 # every in-flight request onto the decode class token-exact, and come
 # back watchdog-clean
@@ -338,7 +338,7 @@ print(f"tp1 {t1['value']} tok/s vs tp2 {t2['value']} "
       f"handoffs {dg['handoffs']}, salvage lat p95 "
       f"{dg['migration_latency_p95_s']}s, mismatches "
       f"{dg['token_mismatches']}")
-assert t1.get("schema_version") == t2.get("schema_version") == 3
+assert t1.get("schema_version") == t2.get("schema_version") == 4
 assert t1["tp"] == 1 and t2["tp"] == 2 and t2["mesh"] == "tp2"
 assert t1["tokens_fingerprint"] == t2["tokens_fingerprint"], \
     "tp=2 serving diverged from single-chip tokens"
@@ -354,6 +354,85 @@ assert dg["migration_latency_samples"] >= 1
 assert dg["migration_latency_p95_s"] >= dg["migration_latency_p50_s"] >= 0
 assert dg["watchdog_after_recovery"] == 0, \
     "decode-class survivor dirty after recovery"
+PY
+
+echo "== 7i. long-context serving gate (cp=2 prefill token-equal to cp=1; tiered hot/warm/cold KV token-exact under forced demotion) =="
+# CPU dryrun ON PURPOSE (same rationale as 7h): the claims gated here
+# are token equality + zero steady-state recompiles under the cp mesh
+# and the tier ladder, which the host backend proves without chip time
+JAX_PLATFORMS=cpu python -m pytest tests/test_tiered_kv.py -q \
+  || { echo "tiered-KV / context-parallel suite FAILED"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --long-context --lc-min 128 --lc-max 512 \
+  --shared-prefix 0.5 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_cp1_dryrun.json \
+  || { echo "cp=1 long-context dryrun FAILED"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --long-context --lc-min 128 --lc-max 512 \
+  --shared-prefix 0.5 --mesh cp=2 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_cp2_dryrun.json \
+  || { echo "cp=2 long-context dryrun FAILED (recompile guard tripped or"\
+       "the cp mesh path crashed)"; exit 1; }
+# int8 KV + LoRA over the cp axis: sharded chunked prefill must stay
+# token-exact when fused dequant + adapter deltas ride the same program
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 8 \
+  --slots 4 --max-new 16 --kv-quant int8 --lora-adapters 2 --lora-rank 4 \
+  --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_cp1_int8lora.json \
+  || { echo "cp=1 int8+LoRA dryrun FAILED"; exit 1; }
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 8 \
+  --slots 4 --max-new 16 --kv-quant int8 --lora-adapters 2 --lora-rank 4 \
+  --mesh cp=2 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_cp2_int8lora.json \
+  || { echo "cp=2 int8+LoRA dryrun FAILED"; exit 1; }
+# forced-demotion pass: a pool too small for the shared-prefix workload
+# must spill through the warm tier (and cold re-prefill) yet finish
+# token-identical to the big-pool cp=1 twin above, recompile-clean
+# (--guard-recompiles) and watchdog-clean (--strict)
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --long-context --lc-min 128 --lc-max 512 \
+  --shared-prefix 0.5 --num-blocks 48 --tier-demote 0.2:0.45 \
+  --guard-recompiles --strict --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_tiered_dryrun.json \
+  || { echo "tiered-KV dryrun FAILED (steady-state recompile, watchdog"\
+       "finding, or crash under forced demotion)"; exit 1; }
+python - <<'PY'
+# long-context gate: the cp=2 lines must be TOKEN-IDENTICAL to their
+# cp=1 twins (fp, and int8+LoRA — the fingerprint hashes every output
+# sequence) and carry the cp-aware mesh/per-chip normalization; the
+# starved-pool run must actually exercise the tier ladder (demotions,
+# warm-tier prefix hits, cold re-prefills all > 0) and still match the
+# big-pool twin token-for-token — the hierarchy is a capacity ladder,
+# never a semantics change
+import json
+c1 = json.load(open("/tmp/tpu_runs/serving_cp1_dryrun.json"))
+c2 = json.load(open("/tmp/tpu_runs/serving_cp2_dryrun.json"))
+q1 = json.load(open("/tmp/tpu_runs/serving_cp1_int8lora.json"))
+q2 = json.load(open("/tmp/tpu_runs/serving_cp2_int8lora.json"))
+td = json.load(open("/tmp/tpu_runs/serving_tiered_dryrun.json"))
+print(f"cp1 {c1['value']} tok/s vs cp2 {c2['value']} "
+      f"(prefill {c2['prefill_tok_s_per_chip']}/chip), fingerprints "
+      f"{c1['tokens_fingerprint']}/{c2['tokens_fingerprint']}; tiered "
+      f"dem {td['tier_demotions']} pro {td['tier_promotions']}, "
+      f"hit rates {td['tier_hit_rate']}")
+assert all(x.get("schema_version") == 4 for x in (c1, c2, q1, q2, td)), \
+    "benchmark schema drifted"
+assert c1["cp"] == 1 and c2["cp"] == 2 and c2["mesh"] == "tp1cp2"
+assert c1["tokens_fingerprint"] == c2["tokens_fingerprint"], \
+    "cp=2 chunked prefill diverged from single-chip tokens"
+assert q1["tokens_fingerprint"] == q2["tokens_fingerprint"], \
+    "cp=2 int8+LoRA serving diverged from single-chip tokens"
+assert c2["prefill_tok_s_per_chip"] > 0
+assert abs(c2["tok_s_per_chip"] - c2["value"] / 2) < 0.1
+assert td["tokens_fingerprint"] == c1["tokens_fingerprint"], \
+    "tier ladder changed tokens vs the all-HBM big-pool twin"
+assert td["tier_demotions"] > 0, \
+    "starved pool never demoted — tier gate vacuous"
+assert td["tier_hit_rate"]["warm"] > 0, \
+    "shared prefix never re-hit the warm tier"
+assert td["tier_hit_rate"]["cold"] > 0, \
+    "no cold re-prefill exercised — shrink the pool or the warm budget"
+assert td["tier_promotions"] > 0, "warm hits never promoted back to HBM"
 PY
 
 echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="

@@ -81,6 +81,18 @@ fleet runs a seeded PREFILL-replica kill (``FaultPlan.disagg_chaos``)
 instead of the generic fleet plan, so the salvage-onto-decode-class
 path is what the twin comparison exercises.
 
+Long-context (docs/serving.md "Long-context serving"):
+``--long-context`` draws prompts from a log-spaced 8k-128k ladder
+(``--lc-min/--lc-max`` rescale it for CPU dryruns), ``--shared-prefix F``
+overlays one shared per-seed prefix on every prompt (the cross-request
+prefix-cache workload), and ``--mesh tp=NxCp=M`` adds a context-parallel
+axis that shards the chunked prefill's sequence dimension — tokens stay
+bit-identical to cp=1. ``--tier-demote LOW:HIGH`` turns on
+watermark-driven hot->warm KV demotion (``--warm-pool-mb`` caps the warm
+tier; over budget, demotions fall to cold re-prefill). The paged JSON
+line then reports ``prefill_tok_s_per_chip`` and ``tier_hit_rate``
+{hot, warm, cold} alongside the demotion/promotion counters.
+
 Every JSON line carries ``schema_version`` plus ``config_fingerprint``
 (a stable hash of the resolved workload/config knobs, reporting-only
 flags excluded) so downstream tooling can both detect schema drift and
@@ -111,7 +123,9 @@ Usage: python tools/serving_benchmark.py [--requests 48] [--slots 8]
        [--paged [--block-size 16] [--num-blocks N] [--pool-frac F]
         [--host-pool-mb M] [--prefill-chunk 64]
         [--spec 4 [--spec-drafter ngram|model] [--repeat-suffix]]
-        [--mesh tp=N] [--fleet N [--disagg]] [--chaos [--strict]]
+        [--long-context [--lc-min A --lc-max B] [--shared-prefix F]]
+        [--tier-demote L:H [--warm-pool-mb M]]
+        [--mesh tp=N[xcp=M]] [--fleet N [--disagg]] [--chaos [--strict]]
         [--profile PATH | --tune BUDGET [--profile OUT]]]
        [--json]
 """
@@ -132,7 +146,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 #: tok/s but the normalized figure moved to the new ``tok_s_per_chip``
 #: (value / (tp x replicas)) — readers that treated the fleet ``value`` as
 #: a per-chip number must switch keys. Every v2 key is still present.
-SCHEMA_VERSION = 3
+#: 4 = long-context serving: ``mesh`` strings may now carry a cp axis
+#: (``tpNcpM``) and per-chip figures divide by tp x cp; paged lines gain
+#: ``prefill_tok_s_per_chip`` and ``tier_hit_rate`` {hot, warm, cold}.
+#: Every v3 key is still present with its v3 meaning at cp=1.
+SCHEMA_VERSION = 4
 
 
 def config_fingerprint(args) -> str:
@@ -231,6 +249,33 @@ def main():
     ap.add_argument("--long-prompts", action="store_true",
                     help="mixed prompts 64-512 over buckets (64,128,256,"
                          "512); raises max-len to 768 unless given")
+    ap.add_argument("--long-context", action="store_true",
+                    help="long-context preset (paged only): prompt "
+                         "lengths drawn from a log-spaced ladder "
+                         "--lc-min..--lc-max (5 rungs, rounded to block "
+                         "multiples); raises max-len to lc-max + max-new "
+                         "unless given. Combine with --shared-prefix / "
+                         "--tier-demote to exercise the hot/warm/cold "
+                         "KV ladder (docs/serving.md)")
+    ap.add_argument("--lc-min", type=int, default=8192,
+                    help="shortest long-context prompt rung (default 8k; "
+                         "shrink for CPU dryruns)")
+    ap.add_argument("--lc-max", type=int, default=131072,
+                    help="longest long-context prompt rung (default 128k)")
+    ap.add_argument("--shared-prefix", type=float, default=0.0, metavar="F",
+                    help="fraction [0,1] of every prompt replaced by ONE "
+                         "shared token prefix (drawn once per seed) — the "
+                         "cross-request prefix-cache / warm-tier workload")
+    ap.add_argument("--tier-demote", default=None, metavar="LOW:HIGH",
+                    help="enable watermark-driven hot->warm KV demotion "
+                         "(paged only): when the free-block fraction "
+                         "falls below LOW, cached blocks demote to the "
+                         "host warm tier until HIGH is free again "
+                         "(e.g. 0.1:0.3)")
+    ap.add_argument("--warm-pool-mb", type=float, default=None,
+                    help="cap the warm-tier byte budget (default "
+                         "unbounded); over-budget demotions fall to the "
+                         "cold tier (re-prefill from replay)")
     ap.add_argument("--paged", action="store_true",
                     help="paged KV cache: block-table pool + chunked "
                          "prefill + prefix caching (cache='paged')")
@@ -325,14 +370,19 @@ def main():
                          "after the drain. The TTFT/TPOT percentiles in "
                          "the JSON line come from the same registry "
                          "histograms either way")
-    ap.add_argument("--mesh", default=None, metavar="tp=N",
-                    help="serve over an N-way tensor-parallel device mesh "
-                         "(paged only): params, KV block pool, int8 "
-                         "scales, and LoRA pages shard over the tp axis; "
-                         "tokens stay bit-identical to tp=1 (the line's "
-                         "tokens_fingerprint proves it) and the line "
-                         "gains tp/tok_s_per_chip. Accepts 'tp=N' or a "
-                         "bare int. On CPU the tool forces N XLA host "
+    ap.add_argument("--mesh", default=None, metavar="tp=N[xcp=M]",
+                    help="serve over a device mesh (paged only): 'tp=N' "
+                         "shards params, KV block pool, int8 scales, and "
+                         "LoRA pages over an N-way tensor-parallel axis; "
+                         "'cp=M' / 'tp=NxCp=M' adds an M-way "
+                         "context-parallel axis that shards the chunked "
+                         "prefill's sequence dimension (long-context "
+                         "prefill scaling). Tokens stay bit-identical to "
+                         "tp=1/cp=1 (the line's tokens_fingerprint "
+                         "proves it) and the line gains "
+                         "tp/cp/tok_s_per_chip/prefill_tok_s_per_chip. "
+                         "Accepts 'tp=N', 'cp=M', 'tp=NxCp=M', or a bare "
+                         "int (tp). On CPU the tool forces NxM XLA host "
                          "devices for the dryrun")
     ap.add_argument("--disagg", action="store_true",
                     help="with --fleet N: specialize the replicas into "
@@ -424,39 +474,82 @@ def main():
             ap.error("--tune does not model the adapter pool yet — "
                      "tune the base-engine knobs without --lora-adapters, "
                      "then replay the profile WITH them")
-    tp = 1
+    tp, cp = 1, 1
     if args.mesh is not None:
         if not args.paged:
             ap.error("--mesh requires --paged (the sharded pools ARE the "
                      "paged substrate)")
-        m = str(args.mesh)
+        # mirrors paddle_tpu.parallel.serving_mesh.parse_mesh, but WITHOUT
+        # importing it: the XLA host-device-count flag below only takes
+        # effect if set before the first jax import
+        m = str(args.mesh).strip().lower()
         try:
-            tp = int(m.split("=", 1)[1]) if "=" in m else int(m)
+            if "=" not in m:
+                tp = int(m)
+            else:
+                for part in m.split("x"):
+                    k, _, v = part.partition("=")
+                    if k.strip() == "tp":
+                        tp = int(v)
+                    elif k.strip() == "cp":
+                        cp = int(v)
+                    else:
+                        raise ValueError(part)
         except ValueError:
-            ap.error("--mesh must be an int tp degree or 'tp=N'")
-        if tp < 1:
-            ap.error("--mesh tp degree must be >= 1")
-        if tp > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+            ap.error("--mesh must be an int tp degree, 'tp=N', 'cp=M', "
+                     "or 'tp=NxCp=M'")
+        if tp < 1 or cp < 1:
+            ap.error("--mesh axis degrees must be >= 1")
+        if tp * cp > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu" \
                 and "xla_force_host_platform_device_count" \
                 not in os.environ.get("XLA_FLAGS", ""):
-            # CPU dryrun: the mesh needs tp host devices, and the flag
+            # CPU dryrun: the mesh needs tp*cp host devices, and the flag
             # only takes effect if set BEFORE jax is imported (which is
             # why the jax imports below sit under main())
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={tp}").strip()
+                + " --xla_force_host_platform_device_count"
+                + f"={tp * cp}").strip()
     if args.pool_frac is not None and not args.paged:
         ap.error("--pool-frac requires --paged")
     if args.host_pool_mb is not None and not args.paged:
         ap.error("--host-pool-mb requires --paged")
+    if args.warm_pool_mb is not None and not args.paged:
+        ap.error("--warm-pool-mb requires --paged (the warm tier parks "
+                 "paged KV blocks)")
+    tier_low = tier_high = None
+    if args.tier_demote is not None:
+        if not args.paged:
+            ap.error("--tier-demote requires --paged (only block-pool KV "
+                     "demotes)")
+        try:
+            lo, _, hi = args.tier_demote.partition(":")
+            tier_low, tier_high = float(lo), float(hi)
+        except ValueError:
+            ap.error("--tier-demote must be LOW:HIGH (two floats, e.g. "
+                     "0.1:0.3)")
+    if args.long_context:
+        if not args.paged:
+            ap.error("--long-context requires --paged (chunked prefill + "
+                     "the block pool are the long-context substrate)")
+        if args.long_prompts or args.repeat_suffix:
+            ap.error("--long-context replaces the prompt ladder; drop "
+                     "--long-prompts/--repeat-suffix")
+        if not (0 < args.lc_min <= args.lc_max):
+            ap.error("--lc-min/--lc-max must satisfy 0 < min <= max")
+    if not (0.0 <= args.shared_prefix <= 1.0):
+        ap.error("--shared-prefix must be a fraction in [0, 1]")
     if args.burst < 1:
         ap.error("--burst must be >= 1")
     if args.max_new is None:
         args.max_new = 128 if args.repeat_suffix else 64
     if args.max_len is None:
-        args.max_len = 768 if args.long_prompts else 256
-        if args.repeat_suffix:
-            args.max_len = max(args.max_len, 128 + args.max_new)
+        if args.long_context:
+            args.max_len = args.lc_max + args.max_new
+        else:
+            args.max_len = 768 if args.long_prompts else 256
+            if args.repeat_suffix:
+                args.max_len = max(args.max_len, 128 + args.max_new)
     if args.kv_quant != "none" and not args.paged:
         ap.error("--kv-quant requires --paged (the int8 pool is the "
                  "block pool)")
@@ -518,6 +611,21 @@ def main():
     _warm_state = wrng.get_state()
 
     motif = rng.randint(1, cfg.vocab_size, 8).tolist()
+    lc_lens = None
+    if args.long_context:
+        # log-spaced rungs, rounded DOWN to block multiples so tier
+        # demotion/promotion always moves whole blocks (the last-token
+        # rule then leaves exactly the final block uncacheable)
+        raw = np.geomspace(args.lc_min, args.lc_max, 5)
+        lc_lens = sorted({max(args.block_size,
+                              int(v) // args.block_size * args.block_size)
+                          for v in raw})
+    shared_tokens = None
+    if args.shared_prefix > 0.0:
+        # one shared prefix per seed, from its OWN stream — enabling the
+        # knob must not shift the measured traffic draws
+        srng = np.random.RandomState((args.seed + 0x5AFE) & 0x7FFFFFFF)
+        shared_tokens = srng.randint(1, cfg.vocab_size, args.max_len)
     _counter = [0]
     _wcounter = [0]
     prios = {}
@@ -538,12 +646,15 @@ def main():
 
         wspec = WorkloadSpec(
             requests=args.requests, max_new=args.max_new,
-            prompt_ladder=(LONG_PROMPT_LADDER if args.long_prompts
+            prompt_ladder=(tuple(lc_lens) if args.long_context
+                           else LONG_PROMPT_LADDER if args.long_prompts
                            else SHORT_PROMPT_LADDER),
             vocab_size=cfg.vocab_size, repeat_suffix=args.repeat_suffix,
             mixed_priority=args.mixed_priority,
             lora_adapters=args.lora_adapters,
             arrival_rate=args.arrival_rate, burst=args.burst,
+            long_context=args.long_context,
+            shared_prefix_frac=args.shared_prefix,
             seed=args.seed)
         if args.tune is not None:
             runner = TrialRunner(model, wspec, max_batch=args.slots,
@@ -604,8 +715,11 @@ def main():
         config-scaled warmup never perturbs the measured traffic."""
         r = wrng if warm else rng
         ctr = _wcounter if warm else _counter
-        lens = r.choice([64, 128, 256, 400, 512] if args.long_prompts
-                        else [16, 30, 64, 100, 128], size=n)
+        if args.long_context:
+            lens = r.choice(lc_lens, size=n)
+        else:
+            lens = r.choice([64, 128, 256, 400, 512] if args.long_prompts
+                            else [16, 30, 64, 100, 128], size=n)
         rids = {}
         for ln in lens:
             if args.repeat_suffix:
@@ -615,6 +729,11 @@ def main():
                 prompt = (motif * (int(ln) // len(motif) + 1))[:int(ln)]
             else:
                 prompt = r.randint(1, cfg.vocab_size, int(ln)).tolist()
+            if shared_tokens is not None:
+                # overlay the seed's shared prefix — the cross-request
+                # prefix-cache (and warm-tier re-hit) workload
+                k = int(int(ln) * args.shared_prefix)
+                prompt[:k] = shared_tokens[:k].tolist()
             i = ctr[0]
             ctr[0] += 1
             prio, tenant, adapter = 1, "default", None
@@ -650,8 +769,7 @@ def main():
                 model, max_batch=args.slots, max_len=args.max_len,
                 profile=tuned_profile, lora=lora_cfg, faults=faults,
                 telemetry=bool(args.telemetry_out) or args.strict,
-                kernels=args.kernels, role=role,
-                mesh=(tp if args.mesh is not None else None))
+                kernels=args.kernels, role=role, mesh=args.mesh)
         if args.paged:
             spec = None
             if args.spec:
@@ -707,10 +825,12 @@ def main():
                 kv_quant=args.kv_quant, pool_bytes=pool_bytes,
                 policy=sched if sched is not None else args.scheduler,
                 host_pool_bytes=host_pool,
+                warm_pool_bytes=(None if args.warm_pool_mb is None
+                                 else int(args.warm_pool_mb * 1e6)),
+                tier_demote_low=tier_low, tier_demote_high=tier_high,
                 lora=lora_cfg, faults=faults,
                 telemetry=bool(args.telemetry_out) or args.strict,
-                kernels=args.kernels, role=role,
-                mesh=(tp if args.mesh is not None else None))
+                kernels=args.kernels, role=role, mesh=args.mesh)
         return GenerationServer(model, max_batch=args.slots,
                                 max_len=args.max_len,
                                 prompt_buckets=((64, 128, 256, 512)
@@ -738,12 +858,14 @@ def main():
         # warmup drain: compiles the decode tick + the prefill program(s)
         burst(server, min(args.slots, 4), warm=True)
         server.run()
-        if args.pool_frac is not None and (args.chaos
-                                           or args.guard_recompiles):
+        if (args.pool_frac is not None or tier_low is not None) \
+                and (args.chaos or args.guard_recompiles):
             # overload warmup wave: churn so the swap gather/scatter
-            # programs get a chance to compile BEFORE the measured
-            # window (first preemption after it still counts against
-            # the budget — hence the reference-pass allowance)
+            # programs — which the tier ladder's demotion gather and
+            # promotion scatter share shapes with — get a chance to
+            # compile BEFORE the measured window (first preemption
+            # after it still counts against the budget — hence the
+            # reference-pass allowance)
             burst(server, args.slots * 2 + 2, warm=True)
             server.run()
         # warmup boundary: drop histogram samples, spans, and flight
@@ -754,6 +876,12 @@ def main():
         # a steady_state_recompile finding nor blanket-excuses a warm
         # program recompiling inside the first measured ticks
         server.telemetry.reset()
+        if args.paged:
+            # scope the prefill-throughput and cold-refill figures to
+            # the measured drain (warmup churn demotes too)
+            server._prefill_tokens = 0
+            server._prefill_wall_s = 0.0
+            server._cold_refills = 0
         if chaos_inj is not None:
             chaos_inj.enabled = True   # plan ordinals start at the drain
 
@@ -912,9 +1040,10 @@ def main():
                         f"{args.slots} slots, max_new={args.max_new}, "
                         f"params={n_params/1e6:.0f}M)",
                 "kv_cache": "paged", "fleet": args.fleet,
-                "tp": tp, "mesh": f"tp{tp}",
+                "tp": tp, "cp": cp,
+                "mesh": f"tp{tp}" if cp == 1 else f"tp{tp}cp{cp}",
                 "tok_s_per_chip": round(
-                    gen_tokens / dt / (tp * args.fleet), 1),
+                    gen_tokens / dt / (tp * cp * args.fleet), 1),
                 "tokens_fingerprint": hashlib.sha256(json.dumps(
                     [out[r] for r in sorted(rids)
                      if r in out]).encode()).hexdigest()[:16],
@@ -1065,8 +1194,9 @@ def main():
                     f"{'int8' if args.int8 else 'bf16'} weights, "
                     f"params={n_params/1e6:.0f}M)",
             "kv_cache": "paged" if args.paged else "dense",
-            "tp": tp, "mesh": f"tp{tp}",
-            "tok_s_per_chip": round(gen_tokens / dt / tp, 1),
+            "tp": tp, "cp": cp,
+            "mesh": f"tp{tp}" if cp == 1 else f"tp{tp}cp{cp}",
+            "tok_s_per_chip": round(gen_tokens / dt / (tp * cp), 1),
             "tokens_fingerprint": hashlib.sha256(json.dumps(
                 [out[r] for r in sorted(rids)
                  if r in out]).encode()).hexdigest()[:16],
@@ -1113,6 +1243,28 @@ def main():
         line["kv_bytes_per_token"] = round(
             stats["bytes_per_block"] / stats["block_size"], 2)
         line["kv_pool_bytes"] = stats["bytes_per_block"] * stats["num_blocks"]
+        # chunked-prefill throughput over the measured drain, normalized
+        # per chip (tp x cp) — the figure the cp axis is meant to scale
+        line["prefill_tok_s_per_chip"] = round(
+            server._prefill_tokens
+            / max(server._prefill_wall_s, 1e-9) / (tp * cp), 1)
+        # hot/warm rates are block-level fractions of prefix-cache
+        # lookups; cold is re-prefill-over-demoted-content events per
+        # measured request (the re-prefill IS the cold tier, so a
+        # preempted-and-resumed request can legitimately count twice)
+        looked = max(stats["prefix_lookup_blocks"], 1)
+        line["tier_hit_rate"] = {
+            "hot": round(stats["prefix_hit_blocks"] / looked, 4),
+            "warm": round(stats["warm_hit_blocks"] / looked, 4),
+            "cold": round(stats["cold_refills"] / max(len(rids), 1), 4)}
+        line["tier_demotions"] = stats["warm_demoted_blocks"]
+        line["tier_promotions"] = stats["warm_promoted_blocks"]
+        line["warm_bytes_peak"] = stats["warm_bytes_peak"]
+        if args.long_context:
+            line["long_context"] = True
+            line["lc_lens"] = lc_lens
+        if args.shared_prefix:
+            line["shared_prefix"] = args.shared_prefix
         line["kernels"] = args.kernels
         line.update(kernel_microbench(server, cfg, args))
     if args.lora_adapters:
