@@ -21,7 +21,10 @@ from typing import Any, Dict, Mapping, Optional
 from .space import ALL_KNOBS, ConfigSpace
 from .workload import WorkloadSpec
 
-PROFILE_SCHEMA_VERSION = 1
+# v2: the config gained the kernel tier (kernels + mk_* megakernel
+# geometry knobs) — v1 profiles are missing knobs under the new space
+# and must retune rather than guess
+PROFILE_SCHEMA_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -147,6 +150,16 @@ def config_server_kwargs(config: Mapping[str, Any], model_cfg, *,
         kw["pool_bytes"] = max(1, int(parity_bytes * pool_frac))
         mb = cfg.get("host_pool_mb", None)
         kw["host_pool_bytes"] = None if mb is None else int(mb) << 20
+    kernels = str(cfg.get("kernels", "auto"))
+    if kernels != "auto":
+        kw["kernels"] = kernels
+    if kernels == "megakernel":
+        from ..ops.decode_megakernel import MegakernelGeometry
+
+        kw["mk_geometry"] = MegakernelGeometry(
+            ffn_tile=int(cfg.get("mk_ffn_tile", 0)),
+            prefetch_depth=int(cfg.get("mk_prefetch_depth", 2)),
+            dequant=str(cfg.get("mk_dequant", "scores")))
     return kw
 
 

@@ -5,31 +5,40 @@ fused_multi_transformer_op.cu, fmha_ref.h) and hand-written PHI GPU kernels.
 Kernel dispatch contract (shared by flash_attention, paged_attention, and the
 fused LoRA projections):
 
-* ``use_pallas()`` — True when the Pallas code path should run: on a real TPU
+* ``use_megakernel()`` — True when the whole-tick decode megakernel
+  (ops/decode_megakernel.py) is the requested top rung: the process-wide mode
+  was pinned to ``"megakernel"`` via :func:`set_kernel_mode`. The megakernel's
+  shape guards fall back to the per-layer Pallas kernels (``use_pallas()``
+  stays True under megakernel mode), which themselves fall back to the jnp
+  reference — the three-rung dispatch ladder.
+* ``use_pallas()`` — True when a Pallas code path should run: on a real TPU
   backend, when ``PT_FLASH_INTERPRET=1`` (interpret mode on CPU), or when the
-  process-wide mode was pinned to ``"pallas"`` via :func:`set_kernel_mode`.
-  ``"reference"`` pins the jnp compositions regardless of backend.
+  process-wide mode was pinned to ``"pallas"`` or ``"megakernel"`` via
+  :func:`set_kernel_mode`. ``"reference"`` pins the jnp compositions
+  regardless of backend.
 * ``pallas_interpret()`` — True when ``pl.pallas_call`` must run interpreted
   (no Mosaic compiler available), i.e. Pallas was requested on a non-TPU
   backend.
 
-Both are read at TRACE time, so flipping the mode between compiled program
-invocations has no effect — set it before the first trace (GenerationServer
-does this in its constructor via ``kernels=``).
+All three are read at TRACE time, so flipping the mode between compiled
+program invocations has no effect — set it before the first trace
+(GenerationServer does this in its constructor via ``kernels=``).
 """
 import os as _os
 
 import jax as _jax
 
-KERNEL_MODES = ("auto", "pallas", "reference")
+KERNEL_MODES = ("auto", "pallas", "megakernel", "reference")
 
 _KERNEL_MODE = "auto"
 
 
 def set_kernel_mode(mode: str) -> None:
-    """Pin the process-wide kernel dispatch: ``"pallas"`` forces the Pallas
-    kernels (interpret mode off-TPU), ``"reference"`` forces the jnp
-    compositions, ``"auto"`` restores backend-based dispatch."""
+    """Pin the process-wide kernel dispatch: ``"megakernel"`` requests the
+    whole-tick persistent kernel (falling back per the ladder),
+    ``"pallas"`` forces the per-layer Pallas kernels (interpret mode
+    off-TPU), ``"reference"`` forces the jnp compositions, ``"auto"``
+    restores backend-based dispatch."""
     global _KERNEL_MODE
     if mode not in KERNEL_MODES:
         raise ValueError(
@@ -41,10 +50,17 @@ def kernel_mode() -> str:
     return _KERNEL_MODE
 
 
+def use_megakernel() -> bool:
+    """Top rung of the ladder: only an explicit ``kernels="megakernel"``
+    opts in (never ``"auto"`` — the tick-level fusion changes program
+    structure, so it is a deliberate serving configuration)."""
+    return _KERNEL_MODE == "megakernel"
+
+
 def use_pallas() -> bool:
     if _KERNEL_MODE == "reference":
         return False
-    if _KERNEL_MODE == "pallas":
+    if _KERNEL_MODE in ("pallas", "megakernel"):
         return True
     return (_jax.default_backend() in ("tpu", "axon")
             or _os.environ.get("PT_FLASH_INTERPRET") == "1")
@@ -55,7 +71,7 @@ def pallas_interpret() -> bool:
     if _jax.default_backend() in ("tpu", "axon"):
         return False
     return (_os.environ.get("PT_FLASH_INTERPRET") == "1"
-            or _KERNEL_MODE == "pallas")
+            or _KERNEL_MODE in ("pallas", "megakernel"))
 
 
 from .flash_attention import flash_attention, flash_attention_bshd
@@ -74,6 +90,7 @@ __all__ = ["flash_attention", "flash_attention_bshd", "fused_rms_norm",
            "kernel_mode", "paged_decode_attention",
            "paged_decode_attention_q", "paged_prefill_attention",
            "paged_prefill_attention_q", "pallas_interpret",
-           "quantize_block_kv", "set_kernel_mode", "use_pallas",
+           "quantize_block_kv", "set_kernel_mode", "use_megakernel",
+           "use_pallas",
            "write_chunk_kv", "write_chunk_kv_q", "write_decode_kv",
            "write_decode_kv_q"]

@@ -1,0 +1,332 @@
+"""Whole-tick decode megakernel (ops/decode_megakernel.py), interpret
+mode on CPU: tick-level parity vs the model's own per-layer loop (1/2/4
+layers, fp + int8 KV, W=1 and W=4 windows, ±LoRA), the acceptance
+criterion — greedy serving output token-identical between the megakernel
+and reference paths for fp, int8, ±LoRA, ±spec with zero steady-state
+recompiles under adapter churn — plus the dispatch ladder: the eager
+guard's fall-to-per-layer-pallas rung (spy-asserted), snapshot
+fingerprint refusal across kernel modes and geometries, and the
+geometry/VMEM arithmetic the autotuner's validity checks ride on.
+Quick tier."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.ops import decode_megakernel as mk
+from paddle_tpu.ops.paged_attention import quantize_block_kv
+
+
+@pytest.fixture(autouse=True)
+def _restore_kernel_mode():
+    yield
+    ops.set_kernel_mode("auto")
+
+
+def _tiny_model(layers=2, max_pos=160):
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=layers, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=max_pos,
+                      dtype="float32", use_flash_attention=False)
+    paddle.seed(7)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _tick_case(cfg, W, quant, lora_on, seed=0, B=2, bs=8):
+    """Pools + tables + tokens with the usual edges: scratch block 0,
+    positions mid-block and at a block boundary."""
+    rng = np.random.RandomState(seed)
+    L = cfg.num_hidden_layers
+    KV = cfg.num_key_value_heads
+    D = cfg.hidden_size // cfg.num_attention_heads
+    pos = np.array([10, 16], np.int32)[:B]
+    M = int(max(pos) + W - 1) // bs + 2
+    N = B * M + 2
+    tables = np.zeros((B, M), np.int32)
+    free = rng.permutation(np.arange(1, N))
+    took = 0
+    for b in range(B):
+        nblk = (pos[b] + W - 1) // bs + 1
+        tables[b, :nblk] = free[took:took + nblk]
+        took += nblk
+    flat = []
+    for _ in range(L):
+        for _kv in range(2):
+            p = rng.randn(N, bs, KV, D).astype(np.float32) * 0.5
+            p[0] = 0.0
+            if quant == "int8":
+                pq, ps = quantize_block_kv(jnp.asarray(p))
+                flat += [pq, ps]
+            else:
+                flat.append(jnp.asarray(p))
+    tokens = rng.randint(1, cfg.vocab_size, (B, W)).astype(np.int32)
+    lora = None
+    if lora_on:
+        Hd, KVD, I = (cfg.hidden_size, KV * D, cfg.intermediate_size)
+        dims = {"q": (Hd, Hd), "k": (Hd, KVD), "v": (Hd, KVD),
+                "o": (Hd, Hd), "gate": (Hd, I), "up": (Hd, I),
+                "down": (I, Hd)}
+        # one row scaled, one null-adapter row — scale 0 must be exact
+        scale = jnp.asarray([0.5, 0.0][:B], jnp.float32)
+        lora = []
+        for _ in range(L):
+            lora.append({t: (
+                jnp.asarray(rng.normal(0, 0.05, (B, fi, 4)), jnp.float32),
+                jnp.asarray(rng.normal(0, 0.05, (B, 4, fo)), jnp.float32),
+                scale) for t, (fi, fo) in dims.items()})
+    return (jnp.asarray(tokens), flat, jnp.asarray(tables),
+            jnp.asarray(pos), lora)
+
+
+def _tick_both_ways(model, cfg, W, quant, lora_on, bs=8):
+    """(reference activations+pools, megakernel activations+pools) for
+    one whole tick — the per-layer loop IS the reference."""
+    m = model.model
+    tokens, flat, tables, pos, lora = _tick_case(cfg, W, quant, lora_on,
+                                                 bs=bs)
+    st = 4 if quant == "int8" else 2
+    x = m.embed_tokens(Tensor(tokens))
+    ref_flat = []
+    for i, layer in enumerate(m.layers):
+        pool = tuple(Tensor(flat[st * i + j]) for j in range(st))
+        x, pool = layer.paged_verify(
+            x, m._cos, m._sin, pool, tables, pos,
+            lora=None if lora is None else lora[i])
+        ref_flat += [t.value for t in pool]
+    ops.set_kernel_mode("megakernel")
+    cosr, sinr = mk.gather_rope_rows(m._cos, m._sin, pos, W)
+    xe = m.embed_tokens(Tensor(tokens)).value
+    xo, new_flat = mk.decode_tick(
+        xe, [jnp.copy(p) for p in flat], tables, pos,
+        mk.stack_layer_weights(model), cosr, sinr, block_size=bs,
+        eps=cfg.rms_norm_eps, lora=mk.stack_lora(lora))
+    ops.set_kernel_mode("auto")
+    return np.asarray(x.value), ref_flat, np.asarray(xo), new_flat
+
+
+class TestTickParity:
+    # interpret-mode ticks cost ~10-30s each and the quick tier runs on a
+    # fully loaded wall-clock budget, so every parity/identity tick test
+    # lives in the slow shard — suite stage 7j runs this file unfiltered
+    @pytest.mark.slow
+    @pytest.mark.parametrize("quant", ["fp", "int8"])
+    @pytest.mark.parametrize("layers", [1, 2, 4])
+    def test_whole_tick_matches_layer_loop(self, layers, quant):
+        """One persistent program == L per-layer programs, W=1 and W=4,
+        activations AND written-back KV pools."""
+        model, cfg = _tiny_model(layers=layers)
+        for W in (1, 4):
+            ref_x, ref_flat, out_x, out_flat = _tick_both_ways(
+                model, cfg, W, quant, lora_on=False)
+            np.testing.assert_allclose(ref_x, out_x, rtol=2e-5, atol=2e-5)
+            for a, b in zip(ref_flat, out_flat):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float32), np.asarray(b, np.float32),
+                    rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("quant", ["fp", "int8"])
+    def test_whole_tick_with_fused_lora(self, quant):
+        """The in-kernel BGMV path, incl. the scale-0 null-adapter row."""
+        model, cfg = _tiny_model()
+        ref_x, ref_flat, out_x, out_flat = _tick_both_ways(
+            model, cfg, 4, quant, lora_on=True)
+        np.testing.assert_allclose(ref_x, out_x, rtol=2e-5, atol=2e-5)
+        for a, b in zip(ref_flat, out_flat):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------- dispatch
+class TestDispatchContract:
+    def test_megakernel_is_explicit_only(self):
+        """'auto' never escalates to the megakernel — it is a deliberate
+        configuration, not a heuristic; but megakernel mode keeps the
+        per-layer pallas rung live underneath for the fallback ladder."""
+        ops.set_kernel_mode("auto")
+        assert not ops.use_megakernel()
+        ops.set_kernel_mode("pallas")
+        assert not ops.use_megakernel()
+        ops.set_kernel_mode("megakernel")
+        assert ops.use_megakernel()
+        assert ops.use_pallas()
+        assert ops.pallas_interpret()
+        ops.set_kernel_mode("reference")
+        assert not ops.use_megakernel()
+        assert not ops.use_pallas()
+
+    def test_server_validates_megakernel_config(self):
+        model, _ = _tiny_model()
+        with pytest.raises(ValueError, match="paged"):
+            GenerationServer(model, max_len=64, kernels="megakernel")
+        with pytest.raises(ValueError, match="mk_geometry"):
+            GenerationServer(model, max_len=64, cache="paged", block_size=4,
+                             kernels="pallas",
+                             mk_geometry=mk.MegakernelGeometry())
+
+    def test_guard_fallback_reaches_per_layer_pallas(self, monkeypatch):
+        """A guard-rejected geometry (ffn_tile 13 does not divide 128)
+        must fall to the per-layer Pallas programs — spy-asserted, with
+        the reason recorded, not an error."""
+        import paddle_tpu.ops.paged_attention_pallas as pk
+
+        calls = {"n": 0}
+        real = pk.paged_attention
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(pk, "paged_attention", spy)
+        model, cfg = _tiny_model()
+        srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                               block_size=4, prefill_chunk=8,
+                               kernels="megakernel",
+                               mk_geometry=mk.MegakernelGeometry(ffn_tile=13))
+        assert srv._exec.megakernel is False
+        assert "ffn_tile" in srv._exec.megakernel_reason
+        srv.submit([1, 2, 3, 4, 5], max_new_tokens=4)
+        out = srv.run()
+        assert calls["n"] > 0
+        assert all(len(v) == 9 for v in out.values())
+
+    def test_geometry_validation_and_vmem_model(self):
+        with pytest.raises(ValueError, match="prefetch_depth"):
+            mk.MegakernelGeometry(prefetch_depth=0).validate()
+        with pytest.raises(ValueError, match="dequant"):
+            mk.MegakernelGeometry(dequant="magic").validate()
+        geo = mk.MegakernelGeometry()
+        shape = dict(hidden=64, heads=4, kv_heads=2, head_dim=16,
+                     intermediate=128, layers=2, batch=2, window=1,
+                     block_size=8)
+        small = geo.vmem_bytes(**shape)
+        deeper = mk.MegakernelGeometry(prefetch_depth=4).vmem_bytes(**shape)
+        assert deeper > small            # deeper prefetch buys more VMEM
+        assert geo.vmem_bytes(**dict(shape, window=4)) > small
+
+
+# ------------------------------------------------------------------ serving
+def _lora_setup(cfg, rank=4, alpha=8.0, adapters=("a1",)):
+    from paddle_tpu.inference import AdapterRegistry, LoRAConfig
+    from paddle_tpu.inference.lora import LORA_TARGETS, target_dims
+
+    rng = np.random.RandomState(3)
+    dims = target_dims(cfg)
+    reg = AdapterRegistry()
+    for name in adapters:
+        w = {}
+        for layer in range(cfg.num_hidden_layers):
+            for t in LORA_TARGETS:
+                fi, fo = dims[t]
+                w[(layer, t)] = (
+                    rng.normal(0, 0.02, (fi, rank)).astype(np.float32),
+                    rng.normal(0, 0.05, (rank, fo)).astype(np.float32))
+        reg.register(name, w, rank=rank, alpha=alpha)
+    return LoRAConfig(reg, max_live_adapters=2, max_rank=rank)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["fp", "int8", "lora", "spec"])
+def test_greedy_token_identity_megakernel_vs_reference(scenario):
+    """THE acceptance criterion: greedy serving output must be
+    token-identical between the megakernel (interpret) and reference
+    paths — fp, int8 KV, +LoRA, +speculative — under multi-chunk prefill
+    and partial final blocks, with the megakernel ACTUALLY engaged."""
+    model, cfg = _tiny_model()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 12, 7, 3)]
+
+    kw = dict(max_batch=2, max_len=64, cache="paged", block_size=4,
+              prefill_chunk=8)
+    if scenario == "int8":
+        kw["kv_quant"] = "int8"
+    elif scenario == "spec":
+        from paddle_tpu.inference.speculative import SpecConfig
+        kw["spec"] = SpecConfig(k=3, drafter="ngram")
+
+    def run(kernels):
+        k = dict(kw)
+        if scenario == "lora":
+            k["lora"] = _lora_setup(cfg)
+        srv = GenerationServer(model, kernels=kernels, **k)
+        if kernels == "megakernel":
+            assert srv._exec.megakernel, srv._exec.megakernel_reason
+        rids = []
+        for i, p in enumerate(prompts):
+            adapter = "a1" if scenario == "lora" and i % 2 == 0 else None
+            rids.append(srv.submit(p, max_new_tokens=8, adapter=adapter))
+        out = srv.run()
+        return [out[r] for r in rids]
+
+    ref = run("reference")
+    out = run("megakernel")
+    assert out == ref, f"{scenario}: megakernel diverged from reference"
+    for toks, p in zip(out, prompts):
+        assert len(toks) == len(p) + 8
+
+
+@pytest.mark.slow
+def test_megakernel_zero_recompiles_under_adapter_churn():
+    """Steady state must stay compile-free while adapters swap in and
+    out — the stacked LoRA streams are data, not program shape."""
+    from paddle_tpu.analysis import jit_cache_guard
+
+    model, cfg = _tiny_model()
+    srv = GenerationServer(model, max_batch=2, max_len=64, cache="paged",
+                           block_size=4, prefill_chunk=8,
+                           lora=_lora_setup(cfg, adapters=("a1", "a2")),
+                           kernels="megakernel")
+    assert srv._exec.megakernel, srv._exec.megakernel_reason
+    rng = np.random.RandomState(5)
+    for p, a in [((5,), "a1"), ((12,), None)]:
+        srv.submit(rng.randint(1, cfg.vocab_size, p).tolist(),
+                   max_new_tokens=6, adapter=a)
+    srv.run()                       # warm: prefill + megakernel programs
+
+    rids = [srv.submit(rng.randint(1, cfg.vocab_size, (n,)).tolist(),
+                       max_new_tokens=6, adapter=a)
+            for n, a in ((7, "a2"), (3, "a1"), (9, None))]
+    with jit_cache_guard("megakernel steady state, adapter churn") as g:
+        out = srv.run()
+    assert g.compiles == 0
+    assert all(len(out[r]) > 0 for r in rids)
+
+
+@pytest.mark.slow
+def test_snapshot_refuses_cross_kernel_and_cross_geometry():
+    """kernels and mk_geometry are shape-critical: a snapshot restores
+    only into a server compiled the same way."""
+    model, cfg = _tiny_model()
+    a = GenerationServer(model, max_len=64, cache="paged", block_size=4,
+                         kernels="reference")
+    a.submit([1, 2, 3], max_new_tokens=4)
+    a.run()
+    snap = a.snapshot()
+    b = GenerationServer(model, max_len=64, cache="paged", block_size=4,
+                         kernels="megakernel")
+    with pytest.raises(ValueError, match="kernels"):
+        b.restore(snap)
+
+    c = GenerationServer(model, max_len=64, cache="paged", block_size=4,
+                         kernels="megakernel",
+                         mk_geometry=mk.MegakernelGeometry(prefetch_depth=4))
+    c.submit([1, 2, 3], max_new_tokens=4)
+    c.run()
+    snap_c = c.snapshot()
+    d = GenerationServer(model, max_len=64, cache="paged", block_size=4,
+                         kernels="megakernel",
+                         mk_geometry=mk.MegakernelGeometry(prefetch_depth=2))
+    with pytest.raises(ValueError, match="mk_geometry"):
+        d.restore(snap_c)
+    assert (GenerationServer(
+        model, max_len=64, cache="paged", block_size=4,
+        kernels="megakernel",
+        mk_geometry=mk.MegakernelGeometry(prefetch_depth=4)).restore(snap_c)
+        == 0)
