@@ -2,33 +2,51 @@
 
 Layers::
 
-    workload.py   seeded traffic, decoupled from the serving config
-    space.py      typed ConfigSpace over every serving knob
-    features.py   telemetry snapshot -> flat FeatureVector per trial
-    cost.py       analytic paged-tick predictor, calibrated online
-    search.py     seeded search: warmup -> prune -> halving -> gates
-    profile.py    tuned-profile JSON; GenerationServer(profile=...)
+    workload.py          seeded traffic, decoupled from the serving config
+    space.py             typed ConfigSpace over every serving knob
+    features.py          telemetry snapshot -> flat FeatureVector per trial
+    cost.py              analytic paged-tick predictor, calibrated online
+    search.py            seeded search: warmup -> prune -> halving -> gates
+    profile.py           tuned-profile JSON; GenerationServer(profile=...)
+    kernel_geometry.py   per-layer kernel schedules + the per-(op, dtype,
+                         shape, chip) winner cache (the per-op tier)
 
 Entry points: ``tools/autotune.py`` (CLI), ``serving_benchmark --tune /
---profile``, and :func:`search.autotune` for library use. Everything
-here is host-side and deterministic per seed; jax is only touched
-through ``GenerationServer`` inside a trial.
+--profile``, ``kernel_bench.py --sweep-geometry`` (per-op tier), and
+:func:`search.autotune` for library use. Everything here is host-side
+and deterministic per seed; jax is only touched through
+``GenerationServer`` inside a trial.
 """
-from .cost import ServingCostModel
+from .cost import ServingCostModel, geometry_cost_proxy
 from .features import FeatureVector, extract
+from .kernel_geometry import (CEGeometry, FlashAttentionGeometry,
+                              GeometryCache, LoRAGeometry, NormGeometry,
+                              OP_FAMILIES, PagedAttentionGeometry,
+                              default_geometry, geometry_candidates,
+                              geometry_from_dict, install_geometry_cache,
+                              local_device_kind, resolve_geometry,
+                              resolve_server_geometries)
 from .profile import (PROFILE_SCHEMA_VERSION, TunedProfile,
                       config_server_kwargs, resolve_profile)
-from .search import TrialResult, TrialRunner, autotune, tokens_fingerprint
+from .search import (GeometrySweepResult, GeometryTrial, TrialResult,
+                     TrialRunner, autotune, sweep_kernel_geometry,
+                     tokens_fingerprint)
 from .space import (ALL_KNOBS, ConfigSpace, ENGINE_KNOBS, FLEET_KNOBS,
                     Knob, engine_space)
 from .workload import (Traffic, TrafficRequest, WorkloadSpec, draw_traffic,
                        submit_traffic, warmup_traffic)
 
 __all__ = [
-    "ALL_KNOBS", "ConfigSpace", "ENGINE_KNOBS", "FLEET_KNOBS",
-    "FeatureVector", "Knob", "PROFILE_SCHEMA_VERSION", "ServingCostModel",
+    "ALL_KNOBS", "CEGeometry", "ConfigSpace", "ENGINE_KNOBS",
+    "FLEET_KNOBS", "FeatureVector", "FlashAttentionGeometry",
+    "GeometryCache", "GeometrySweepResult", "GeometryTrial", "Knob",
+    "LoRAGeometry", "NormGeometry", "OP_FAMILIES",
+    "PROFILE_SCHEMA_VERSION", "PagedAttentionGeometry", "ServingCostModel",
     "Traffic", "TrafficRequest", "TrialResult", "TrialRunner",
     "TunedProfile", "WorkloadSpec", "autotune", "config_server_kwargs",
-    "draw_traffic", "engine_space", "extract", "resolve_profile",
-    "submit_traffic", "tokens_fingerprint", "warmup_traffic",
+    "default_geometry", "draw_traffic", "engine_space", "extract",
+    "geometry_candidates", "geometry_cost_proxy", "geometry_from_dict",
+    "install_geometry_cache", "local_device_kind", "resolve_geometry",
+    "resolve_profile", "resolve_server_geometries", "submit_traffic",
+    "sweep_kernel_geometry", "tokens_fingerprint", "warmup_traffic",
 ]

@@ -182,3 +182,53 @@ class ServingCostModel:
             block_bytes=_block_bytes(self.model_cfg, bs,
                                      str(cfg.get("kv_quant", "none"))))
         return self.tick_model.spec_break_even(k, shape)
+
+
+def geometry_cost_proxy(op: str, geometry, **shape) -> float:
+    """Analytic rank proxy for one kernel-geometry candidate — the
+    per-op analogue of the tick model, used only to ORDER sweep rungs
+    deterministically (measure promising schedules first so a truncated
+    sweep still lands near the winner); the measured clock always
+    decides. Lower is better. The terms are the obvious first-order
+    costs: grid-step count (launch/bookkeeping overhead amortized by
+    deeper streaming / larger tiles) plus a VMEM-pressure penalty once
+    the occupancy model nears the per-core budget."""
+    from .kernel_geometry import (CEGeometry, FlashAttentionGeometry,
+                                  LoRAGeometry, NormGeometry,
+                                  PagedAttentionGeometry)
+    from .space import MK_VMEM_LIMIT_BYTES
+
+    if isinstance(geometry, PagedAttentionGeometry):
+        blocks = float(shape.get("blocks", 64))
+        steps = blocks / geometry.kv_block_depth
+        vmem = geometry.vmem_bytes(
+            head_dim=shape.get("head_dim", 128),
+            block_size=shape.get("block_size", 16),
+            window=shape.get("window", 4), rep=shape.get("rep", 4),
+            quantized=shape.get("quantized", False))
+    elif isinstance(geometry, LoRAGeometry):
+        rank = int(shape.get("rank", 8))
+        rp = geometry.padded_rank(rank)
+        # padding trades wasted MACs for MXU alignment; charge the waste
+        steps = 1.0 + 0.1 * (rp - rank) / max(rank, 1)
+        vmem = geometry.vmem_bytes(
+            seq=shape.get("seq", 1), in_dim=shape.get("in_dim", 1024),
+            out_dim=shape.get("out_dim", 1024), rank=rank)
+    elif isinstance(geometry, FlashAttentionGeometry):
+        seq = float(shape.get("seq_q", 2048))
+        steps = seq / float(geometry.block_q or 512)
+        vmem = geometry.vmem_bytes(head_dim=shape.get("head_dim", 128),
+                                   seq_k=shape.get("seq_k", 2048))
+    elif isinstance(geometry, (NormGeometry, CEGeometry)):
+        rows_total = float(shape.get("rows_total", 2048))
+        tile = float(geometry.rows or min(512, rows_total))
+        steps = rows_total / max(tile, 1.0)
+        width = shape.get("vocab" if isinstance(geometry, CEGeometry)
+                          else "width", 4096)
+        vmem = geometry.vmem_bytes(**(
+            {"hidden": shape.get("hidden", 1024), "vocab": width}
+            if isinstance(geometry, CEGeometry) else {"width": width}))
+    else:
+        raise ValueError(f"no cost proxy for {type(geometry).__name__}")
+    pressure = max(0.0, vmem / MK_VMEM_LIMIT_BYTES - 0.5)
+    return float(steps * (1.0 + 4.0 * pressure * pressure))

@@ -24,7 +24,12 @@ from .workload import WorkloadSpec
 # v2: the config gained the kernel tier (kernels + mk_* megakernel
 # geometry knobs) — v1 profiles are missing knobs under the new space
 # and must retune rather than guess
-PROFILE_SCHEMA_VERSION = 2
+# v3: profiles carry the per-layer kernel-geometry winner cache
+# (``kernel_geometry``, a GeometryCache dict keyed by (op, dtype,
+# shape, chip)) — v2 profiles lack the per-op tier entirely, and a
+# default-geometry guess would silently discard the sweep, so they
+# must retune rather than guess, same rule as v1->v2
+PROFILE_SCHEMA_VERSION = 3
 
 
 @dataclasses.dataclass
@@ -39,6 +44,9 @@ class TunedProfile:
     cost_model: Dict[str, float]            # calibrated tick coefficients
     schema: int = PROFILE_SCHEMA_VERSION
     created_unix: Optional[float] = None
+    # per-layer kernel-geometry winner cache (GeometryCache.to_dict();
+    # None = no per-op sweep ran — servers keep default geometry)
+    kernel_geometry: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- (de)ser
     def to_dict(self) -> Dict[str, Any]:
@@ -61,7 +69,22 @@ class TunedProfile:
                     f"profile config fingerprint mismatch: recorded "
                     f"{prof.config_fingerprint!r}, recomputed {fp!r} — "
                     f"the config was edited after tuning")
+            if prof.kernel_geometry is not None:
+                from .kernel_geometry import GeometryCache
+
+                # recomputes the cache's own fingerprint — a tampered
+                # geometry entry fails here, same contract as the config
+                GeometryCache.from_dict(prof.kernel_geometry)
         return prof
+
+    def geometry_cache(self):
+        """The per-layer winner cache this profile carries, parsed
+        (verified on access), or None when no per-op sweep ran."""
+        if self.kernel_geometry is None:
+            return None
+        from .kernel_geometry import GeometryCache
+
+        return GeometryCache.from_dict(self.kernel_geometry)
 
     def canonical_json(self) -> str:
         """Deterministic serialization (timestamp stripped) — what the

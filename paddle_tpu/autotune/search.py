@@ -159,6 +159,128 @@ class TrialRunner:
         return fv, fp, findings
 
 
+@dataclasses.dataclass
+class GeometryTrial:
+    index: int
+    geometry: Dict[str, Any]
+    seconds: float
+    exact: bool                     # bitwise-equal to the default's output
+    accepted: bool
+    reject_reason: Optional[str] = None
+    proxy_cost: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["kind"] = "geometry_trial"
+        return d
+
+
+@dataclasses.dataclass
+class GeometrySweepResult:
+    op: str
+    dtype: str
+    key: int
+    device_kind: str
+    trials: List[GeometryTrial]
+    winner: Dict[str, Any]
+    winner_index: int
+    speedup: float                  # default seconds / winner seconds
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["trials"] = [t.to_dict() for t in self.trials]
+        return d
+
+
+def sweep_kernel_geometry(measure: Callable[[Any], Tuple[Any, float]],
+                          op: str, *, dtype: str, key: int,
+                          device_kind: Optional[str] = None,
+                          candidates: Optional[List[Any]] = None,
+                          quantized: bool = False,
+                          shape: Optional[Dict[str, Any]] = None,
+                          max_candidates: Optional[int] = None,
+                          cache=None,
+                          log: Optional[Callable[[str], None]] = None) \
+        -> GeometrySweepResult:
+    """The per-op kernel-geometry tier: measure every candidate schedule
+    for one ``(op, dtype, key, chip)`` cell and cache the winner.
+
+    ``measure(geometry) -> (output, seconds)`` runs the kernel under one
+    candidate — kernel_bench supplies it with a fresh-jitted closure and
+    the injectable clock, so with a counting clock the whole sweep is
+    deterministic. Candidate index 0 is ALWAYS the default geometry; its
+    output is the parity reference and every other candidate is
+    HARD-REJECTED unless bitwise equal (np.array_equal — a schedule that
+    regroups floating-point math can never become a cached winner). Ties
+    on the clock resolve toward the earlier index, i.e. toward the
+    default. ``max_candidates`` truncates the rung by the analytic
+    ``geometry_cost_proxy`` rank (default always kept) so a short sweep
+    still measures the promising schedules first."""
+    from .cost import geometry_cost_proxy
+    from .kernel_geometry import geometry_candidates, local_device_kind
+
+    emit = log or (lambda s: None)
+    if device_kind is None:
+        device_kind = local_device_kind()
+    shape = dict(shape or {})
+    if candidates is None:
+        candidates = geometry_candidates(op, quantized=quantized,
+                                         **{k: v for k, v in shape.items()
+                                            if k != "quantized"})
+    proxies = []
+    for g in candidates:
+        try:
+            proxies.append(geometry_cost_proxy(op, g, quantized=quantized,
+                                               **shape))
+        except Exception:
+            proxies.append(None)
+    if max_candidates is not None and len(candidates) > max_candidates:
+        ranked = sorted(range(1, len(candidates)),
+                        key=lambda i: (proxies[i] if proxies[i] is not None
+                                       else float("inf"), i))
+        keep = [0] + sorted(ranked[:max(0, max_candidates - 1)])
+        emit(f"{op}: proxy rank truncated "
+             f"{len(candidates) - len(keep)}/{len(candidates)} candidates")
+        candidates = [candidates[i] for i in keep]
+        proxies = [proxies[i] for i in keep]
+
+    ref_out = None
+    trials: List[GeometryTrial] = []
+    best: Optional[Tuple[float, int]] = None
+    for i, geom in enumerate(candidates):
+        out, secs = measure(geom)
+        out = np.asarray(out)
+        if i == 0:
+            ref_out = out
+            exact = True
+        else:
+            exact = (out.shape == ref_out.shape
+                     and out.dtype == ref_out.dtype
+                     and bool(np.array_equal(out, ref_out)))
+        reason = None if exact else "parity_mismatch_vs_default"
+        trials.append(GeometryTrial(
+            index=i, geometry=geom.asdict(), seconds=float(secs),
+            exact=exact, accepted=exact, reject_reason=reason,
+            proxy_cost=proxies[i]))
+        emit(f"{op} geom {i:2d} {json.dumps(geom.asdict(), sort_keys=True)} "
+             f"{secs * 1e3:8.3f} ms "
+             f"{'ok' if exact else 'REJECT parity'}")
+        if exact and (best is None or secs < best[0]):
+            best = (secs, i)
+    wi = best[1]
+    winner = candidates[wi]
+    speedup = trials[0].seconds / max(trials[wi].seconds, 1e-30)
+    if cache is not None:
+        cache.put(op, str(dtype), int(key), device_kind, winner)
+    emit(f"{op} winner: geom {wi} "
+         f"{json.dumps(winner.asdict(), sort_keys=True)} "
+         f"speedup x{speedup:.2f} vs default")
+    return GeometrySweepResult(op=op, dtype=str(dtype), key=int(key),
+                               device_kind=device_kind, trials=trials,
+                               winner=winner.asdict(), winner_index=wi,
+                               speedup=float(speedup))
+
+
 def _plan(budget: int) -> Tuple[int, int, int]:
     """Split a trial budget into (warmup, short-rung, full-rung)."""
     budget = max(1, int(budget))
@@ -173,11 +295,18 @@ def _plan(budget: int) -> Tuple[int, int, int]:
 def autotune(runner: TrialRunner, *, budget: int = 8, seed: int = 0,
              space: Optional[ConfigSpace] = None,
              cost: Optional[ServingCostModel] = None,
+             geometry_cache=None,
              log: Optional[Callable[[str], None]] = None) \
         -> Tuple[TunedProfile, List[TrialResult]]:
     """Search ``space`` with ``budget`` measured candidate trials (the
     default-config reference trial is extra) and return the tuned
-    profile plus every trial record (accepted and rejected)."""
+    profile plus every trial record (accepted and rejected).
+
+    ``geometry_cache`` (a :class:`~paddle_tpu.autotune.kernel_geometry
+    .GeometryCache` from ``sweep_kernel_geometry`` /
+    ``kernel_bench.py --sweep-geometry``) is stamped into the profile's
+    per-op tier so ``GenerationServer(profile=)`` resolves per-layer
+    kernel geometry the same way it resolves ``mk_geometry``."""
     emit = log or (lambda s: None)
     if space is None:
         import jax
@@ -310,5 +439,7 @@ def autotune(runner: TrialRunner, *, budget: int = 8, seed: int = 0,
                 for t in trials if not t.accepted],
         },
         cost_model=cost.tick_model.to_dict(),
+        kernel_geometry=(None if geometry_cache is None
+                         else geometry_cache.to_dict()),
     )
     return profile, trials

@@ -112,6 +112,12 @@ class PagedExecutor:
         self.megakernel_reason: Optional[str] = None
         self._mk_geometry = None
         self._mk_weights = None
+        # per-layer kernel geometry, resolved by the engine ctor from
+        # the installed winner cache (autotune/kernel_geometry.py) —
+        # recorded here like _mk_geometry so the executor's compiled
+        # programs are attributable to the schedules they traced under
+        self.kernel_geometry = dict(getattr(engine, "kernel_geometry",
+                                            None) or {})
         from .. import ops
 
         if ops.use_megakernel():

@@ -374,6 +374,29 @@ class GenerationServer:
             set_kernel_mode(kernels)
         self.kernels = kernels
         self.kv_quant = kv_quant
+        # per-layer kernel geometry (autotune/kernel_geometry.py): a
+        # profile carrying a winner cache installs it process-wide
+        # BEFORE anything traces — the op seams read it at trace time,
+        # same contract as set_kernel_mode above. Without a profile
+        # cache, an already-installed swept cache (install_geometry_
+        # cache from a sweep artifact) stays in effect. The resolved
+        # per-op (geometry, source) map feeds the snapshot fingerprint
+        # and the serving_kernel_geometry telemetry gauge.
+        from ..autotune.kernel_geometry import (install_geometry_cache,
+                                                resolve_server_geometries)
+        from ..framework.dtype import convert_dtype as _cvt
+
+        if self.profile is not None \
+                and self.profile.kernel_geometry is not None:
+            install_geometry_cache(self.profile.geometry_cache(),
+                                   source="profile")
+        self.kernel_geometry = resolve_server_geometries(
+            head_dim=cfg.hidden_size // cfg.num_attention_heads,
+            hidden=cfg.hidden_size,
+            dtype=str(jnp.zeros((), _cvt(cfg.dtype)).dtype),
+            kv_quant=kv_quant,
+            lora_rank=(int(lora.max_rank) if lora is not None
+                       and hasattr(lora, "max_rank") else None))
         self.spec = None
         if spec is not None:
             if cache != "paged":
@@ -2158,6 +2181,13 @@ class GenerationServer:
                 "kernels": self.kernels,
                 "mk_geometry": (self.mk_geometry.asdict()
                                 if self.mk_geometry is not None else None),
+                # resolved per-layer kernel geometry (non-default ops
+                # only; None when everything runs the default schedule,
+                # which keeps pre-geometry snapshots restorable)
+                "kernel_geometry": ({op: g.asdict()
+                                     for op, (g, src)
+                                     in self.kernel_geometry.items()
+                                     if src != "default"} or None),
                 "mesh": self._exec.mesh_fingerprint}
 
     def _req_state(self, req: _Request) -> Dict[str, Any]:
@@ -2636,6 +2666,10 @@ class GenerationServer:
                 reg.gauge(f"serving_{k}").set(float(v))
         for k, v in self.spec_metrics().items():
             reg.gauge(f"serving_spec_{k}").set(float(v))
+        # info gauge: which per-layer kernel schedule actually ran —
+        # value 1.0, identity in the labels (op + default/profile/swept)
+        for op, (_, src) in self.kernel_geometry.items():
+            reg.gauge("serving_kernel_geometry").set(1.0, op=op, source=src)
         snap = self._tel.snapshot()
         snap["config"] = {"cache": self.cache_mode,
                           "max_batch": self.max_batch,

@@ -743,7 +743,34 @@ def _block_defaults(seq_len: int = 0, kind: str = "fwd", mha: bool = False):
     return regimes[max(regimes)]
 
 
+def _geometry_blocks(q):
+    """Profile-resolved FlashAttentionGeometry override, consulted at
+    trace time when the caller left block_q/block_k unset. Precedence:
+    explicit args > PT_FLASH_BLOCK_Q/K and PT_FLASH_BLOCKS env overrides
+    > the winner cache > the measured regime tables. Forward only — a
+    fwd-swept winner must not undo the measured bwd defaults (same rule
+    the env vars follow). Zero fields mean "no opinion" and fall through
+    to the tables; ``_pick_block`` still clamps onto the shape."""
+    import os
+
+    if (os.environ.get("PT_FLASH_BLOCK_Q")
+            or os.environ.get("PT_FLASH_BLOCK_K")
+            or os.environ.get("PT_FLASH_BLOCKS")):
+        return None, None
+    from ..autotune.kernel_geometry import (active_geometry_cache,
+                                            resolve_geometry)
+
+    if active_geometry_cache() is None:
+        return None, None
+    geom, src = resolve_geometry("flash_attention", str(q.dtype), q.shape[3])
+    if src == "default":
+        return None, None
+    return geom.block_q or None, geom.block_kv or None
+
+
 def _flash_fwd_bhsd(q, k, v, causal, scale, block_q=None, block_k=None):
+    if block_q is None and block_k is None:
+        block_q, block_k = _geometry_blocks(q)
     dq, dk = _block_defaults(k.shape[2], mha=k.shape[1] == q.shape[1])
     block_q, block_k = block_q or dq, block_k or dk
     if k.shape[2] <= _FULL_K_MAX:

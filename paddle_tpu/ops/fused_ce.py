@@ -53,24 +53,46 @@ def _chunk(h2, labels, chunk_size, ignore_index):
     return h2.reshape(nchunk, c, h2.shape[-1]), labels.reshape(nchunk, c), pad
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flce(h2, w, labels, ignore_index, chunk_size):
-    (loss_sum, cnt), _ = _flce_scan(h2, w, labels, ignore_index, chunk_size)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flce(h2, w, labels, ignore_index, chunk_size, rows=0):
+    (loss_sum, cnt), _ = _flce_scan(h2, w, labels, ignore_index, chunk_size,
+                                    rows)
     return loss_sum / jnp.maximum(cnt.astype(jnp.float32), 1.0)
 
 
-def _flce_scan(h2, w, labels, ignore_index, chunk_size):
+def _flce_scan(h2, w, labels, ignore_index, chunk_size, rows=0):
     hc, lc, _ = _chunk(h2, labels, chunk_size, ignore_index)
+    c = hc.shape[1]
+    # CEGeometry row sub-tile (forward only): compute the row-local
+    # quantities — logits row, logsumexp, label gather — in r-row
+    # sub-tiles so the f32 [c, V] transient shrinks to [r, V]. Each
+    # output row's contraction and reduction is untouched and the loss
+    # sum below stays at whole-chunk granularity, so any sub-tile is
+    # bit-exact vs the default (rows=0 keeps today's whole-chunk path,
+    # byte-identical jaxpr).
+    r = c if rows <= 0 else _largest_divisor_ce(c, rows)
 
-    def body(carry, xs):
-        s_loss, s_cnt = carry
-        hk, lk = xs
+    def row_local(hk, lk):
         logits = jnp.dot(hk, w, preferred_element_type=jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         li = jnp.clip(lk, 0, logits.shape[-1] - 1).astype(jnp.int32)
         gold = jnp.take_along_axis(logits, li[:, None], axis=-1)[:, 0]
         valid = lk != ignore_index
         loss = jnp.where(valid, lse - gold, 0.0)
+        return loss, lse, valid
+
+    def body(carry, xs):
+        s_loss, s_cnt = carry
+        hk, lk = xs
+        if r < c:
+            loss, lse, valid = jax.lax.map(
+                lambda t: row_local(*t),
+                (hk.reshape(c // r, r, hk.shape[-1]),
+                 lk.reshape(c // r, r)))
+            loss, lse, valid = (loss.reshape(c), lse.reshape(c),
+                                valid.reshape(c))
+        else:
+            loss, lse, valid = row_local(hk, lk)
         return (s_loss + loss.sum().astype(jnp.float32),
                 s_cnt + valid.sum().astype(jnp.int32)), lse
 
@@ -79,13 +101,22 @@ def _flce_scan(h2, w, labels, ignore_index, chunk_size):
     return lax.scan(body, (z_loss, z_cnt), (hc, lc))
 
 
-def _flce_fwd(h2, w, labels, ignore_index, chunk_size):
-    (loss_sum, cnt), lses = _flce_scan(h2, w, labels, ignore_index, chunk_size)
+def _largest_divisor_ce(n: int, want: int) -> int:
+    from ..autotune.kernel_geometry import _largest_divisor
+
+    return _largest_divisor(n, want)
+
+
+def _flce_fwd(h2, w, labels, ignore_index, chunk_size, rows=0):
+    (loss_sum, cnt), lses = _flce_scan(h2, w, labels, ignore_index,
+                                       chunk_size, rows)
     mean = loss_sum / jnp.maximum(cnt.astype(jnp.float32), 1.0)
     return mean, (h2, w, labels, lses, cnt)
 
 
-def _flce_bwd(ignore_index, chunk_size, res, g):
+def _flce_bwd(ignore_index, chunk_size, rows, res, g):
+    # the CEGeometry row sub-tile is forward-only; backward recomputes
+    # at whole-chunk granularity regardless (rows is unused)
     h2, w, labels, lses, cnt = res
     hc, lc, _ = _chunk(h2, labels, chunk_size, ignore_index)
     scale = g / jnp.maximum(cnt.astype(jnp.float32), 1.0)
@@ -127,12 +158,15 @@ def capped_chunk_size(chunk_size: int, seq_len: int) -> int:
 
 def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
                                chunk_size: int = 1024,
-                               transpose_weight: bool = False):
+                               transpose_weight: bool = False,
+                               geometry=None):
     """Mean next-token CE of ``softmax(hidden @ weight)`` vs integer ``labels``
     without materializing the full logits tensor.
 
     hidden: [..., H]; weight: [H, V] ([V, H] with transpose_weight, for tied
     embeddings); labels: integer [...] matching hidden's leading dims.
+    ``geometry`` (:class:`CEGeometry`): forward row sub-tile; None consults
+    the process-wide winner cache at trace time (key: the hidden width).
     """
     import os
 
@@ -158,4 +192,16 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index: int = -100,
         weight = weight.T
     h2 = hidden.reshape(-1, hidden.shape[-1])
     l1 = labels.reshape(-1)
-    return _flce(h2, weight, l1, ignore_index, chunk_size)
+    if geometry is None:
+        from ..autotune.kernel_geometry import resolve_geometry
+
+        geometry = resolve_geometry("fused_ce", str(hidden.dtype),
+                                    hidden.shape[-1])[0]
+    else:
+        from ..autotune.kernel_geometry import CEGeometry
+
+        if not isinstance(geometry, CEGeometry):
+            raise ValueError(f"fused CE wants a CEGeometry, got "
+                             f"{type(geometry).__name__}")
+        geometry.validate()
+    return _flce(h2, weight, l1, ignore_index, chunk_size, geometry.rows)

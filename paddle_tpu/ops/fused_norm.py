@@ -5,6 +5,11 @@ phi/kernels/gpu/layer_norm_kernel.cu).
 Single-pass row kernels: mean/var computed in VMEM, scaled output written
 once. Fall back to jnp on non-TPU. Backward via recompute (jnp composition),
 same policy as flash_attention.
+
+The row tile is a :class:`~paddle_tpu.autotune.kernel_geometry.NormGeometry`
+schedule knob resolved at trace time from the process-wide winner cache;
+every row computes its own statistics, so any tile is bit-exact and the
+default (rows=0) reproduces today's ``max(min(512, rows), 8)`` formula.
 """
 from __future__ import annotations
 
@@ -47,26 +52,52 @@ def _on_tpu(x):
     return jax.default_backend() in ("tpu", "axon")
 
 
+def _block_rows(x, rows, geometry):
+    """Row tile: geometry's opinion (clamped to a divisor) when it has
+    one, else today's formula — which may not divide ``rows``; callers
+    keep the divisibility guard, so the default fallback behavior is
+    unchanged."""
+    from ..autotune.kernel_geometry import NormGeometry, _largest_divisor, \
+        resolve_geometry
+
+    if geometry is None:
+        geometry = resolve_geometry("fused_norm", str(x.dtype),
+                                    x.shape[-1])[0]
+    if not isinstance(geometry, NormGeometry):
+        raise ValueError(f"fused norm wants a NormGeometry, got "
+                         f"{type(geometry).__name__}")
+    geometry.validate()
+    if geometry.rows > 0:
+        return _largest_divisor(rows, geometry.rows)
+    return max(min(512, rows), 8)
+
+
+def _rms_pallas(x, weight, eps, geometry=None, interpret=False):
+    from jax.experimental import pallas as pl
+
+    D = x.shape[-1]
+    flat = x.reshape(-1, D)
+    rows = flat.shape[0]
+    block_rows = _block_rows(x, rows, geometry)
+    if rows % block_rows:
+        raise NotImplementedError(f"{rows} rows not tileable by {block_rows}")
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                  pl.BlockSpec((D,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
+        interpret=interpret,
+    )(flat, weight)
+    return out.reshape(x.shape)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def fused_rms_norm(x, weight, eps=1e-6):
     if _on_tpu(x):
-        from jax.experimental import pallas as pl
-
         try:
-            D = x.shape[-1]
-            flat = x.reshape(-1, D)
-            rows = flat.shape[0]
-            block_rows = max(min(512, rows), 8)
-            if rows % block_rows == 0:
-                out = pl.pallas_call(
-                    functools.partial(_rms_kernel, eps=eps),
-                    grid=(rows // block_rows,),
-                    in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-                              pl.BlockSpec((D,), lambda i: (0,))],
-                    out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-                    out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
-                )(flat, weight)
-                return out.reshape(x.shape)
+            return _rms_pallas(x, weight, eps)
         except Exception:
             pass
     return _rms_ref(x, weight, eps)
@@ -85,27 +116,33 @@ def _rms_bwd(eps, res, g):
 fused_rms_norm.defvjp(_rms_fwd, _rms_bwd)
 
 
+def _ln_pallas(x, weight, bias, eps, geometry=None, interpret=False):
+    from jax.experimental import pallas as pl
+
+    D = x.shape[-1]
+    flat = x.reshape(-1, D)
+    rows = flat.shape[0]
+    block_rows = _block_rows(x, rows, geometry)
+    if rows % block_rows:
+        raise NotImplementedError(f"{rows} rows not tileable by {block_rows}")
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+                  pl.BlockSpec((D,), lambda i: (0,)),
+                  pl.BlockSpec((D,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
+        interpret=interpret,
+    )(flat, weight, bias)
+    return out.reshape(x.shape)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_layer_norm(x, weight, bias, eps=1e-5):
     if _on_tpu(x):
-        from jax.experimental import pallas as pl
-
         try:
-            D = x.shape[-1]
-            flat = x.reshape(-1, D)
-            rows = flat.shape[0]
-            block_rows = max(min(512, rows), 8)
-            if rows % block_rows == 0:
-                out = pl.pallas_call(
-                    functools.partial(_ln_kernel, eps=eps),
-                    grid=(rows // block_rows,),
-                    in_specs=[pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-                              pl.BlockSpec((D,), lambda i: (0,)),
-                              pl.BlockSpec((D,), lambda i: (0,))],
-                    out_specs=pl.BlockSpec((block_rows, D), lambda i: (i, 0)),
-                    out_shape=jax.ShapeDtypeStruct(flat.shape, x.dtype),
-                )(flat, weight, bias)
-                return out.reshape(x.shape)
+            return _ln_pallas(x, weight, bias, eps)
         except Exception:
             pass
     return _ln_ref(x, weight, bias, eps)

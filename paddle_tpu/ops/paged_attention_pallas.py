@@ -16,6 +16,20 @@ built. ``fused_lora_matmul`` fuses the per-slot BGMV adapter delta
 (gathered A/B/scale factors) into the base projection matmul, one program
 per batch row.
 
+The kernel's SCHEDULE is parameterized by
+:class:`~paddle_tpu.autotune.kernel_geometry.PagedAttentionGeometry`
+(and the LoRA kernel's by :class:`~paddle_tpu.autotune.kernel_geometry
+.LoRAGeometry`): KV streaming depth (blocks fetched per grid step),
+q-row tiling (extra parallel axis over the W*rep GQA rows), grid
+iteration order, and int8 cast placement. All geometry axes are
+schedule-only — the per-block online-softmax update runs in the same
+order on the same values, so every geometry is bit-exact against the
+default, and the default geometry lowers to exactly the pre-geometry
+kernel (one block per step, full row group, bgm order). ``geometry=``
+is a trace-time parameter; when omitted, the process-wide winner cache
+(``autotune.kernel_geometry.install_geometry_cache``) is consulted at
+trace time, same contract as ``ops.set_kernel_mode``.
+
 The jnp compositions in ``ops/paged_attention.py`` remain the bit-exact
 references; dispatch between them and these kernels follows the shared
 ``ops.use_pallas()`` / ``ops.pallas_interpret()`` contract (TPU backend,
@@ -64,16 +78,31 @@ def _check_tpu_shapes(bs: int, D: int) -> None:
         raise NotImplementedError(f"block_size {bs} not sublane-aligned (8)")
 
 
+def _resolve(op: str, dtype: str, key: int):
+    from ..autotune.kernel_geometry import resolve_geometry
+
+    return resolve_geometry(op, dtype, key)[0]
+
+
 # ------------------------------------------------------------------ attention
-def _attn_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, W, rep, M,
-                 quantized):
+def _attn_kernel(tbl_ref, pos_ref, q_ref, *rest, bs, W, rep, Mp, depth, R,
+                 quantized, early, ib, ig, iq, im):
+    d = depth
+    k_refs = rest[:d]
+    v_refs = rest[d:2 * d]
+    n = 2 * d
     if quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        ks_refs = rest[n:n + d]
+        vs_refs = rest[n + d:n + 2 * d]
+        n += 2 * d
     else:
-        ks_ref = vs_ref = None
-        o_ref, m_ref, l_ref, acc_ref = rest
-    b = pl.program_id(0)
-    m = pl.program_id(2)
+        ks_refs = vs_refs = None
+    o_ref, m_ref, l_ref, acc_ref = rest[n:]
+    b = pl.program_id(ib)
+    m = pl.program_id(im)
+    # first global q row of this program's tile (0 unless q_rows tiles
+    # the W*rep group across its own grid axis)
+    row0 = pl.program_id(iq) * R if iq is not None else 0
 
     @pl.when(m == 0)
     def _init():
@@ -81,50 +110,70 @@ def _attn_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, W, rep, M,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # Skip blocks entirely past the last query row's causal frontier — this
-    # also covers block-table tail entries that still point at scratch
-    # block 0.
-    needed = m * bs <= pos_ref[b] + (W - 1)
+    def _step(j):
+        blk = m * d + j
+        # Skip blocks entirely past the last query row's causal frontier
+        # — this also covers block-table tail entries that still point at
+        # scratch block 0. The frontier test is per batch row (not per
+        # q tile) so the skip schedule is geometry-independent.
+        needed = blk * bs <= pos_ref[b] + (W - 1)
+        if quantized and early:
+            # "early" dequant placement: the int8->fp cast is exact, so
+            # hoisting it out of the skip branch changes the schedule
+            # (branchless stream) but never the math
+            k_pre = k_refs[j][0, :, 0, :].astype(q_ref.dtype)
+            v_pre = v_refs[j][0, :, 0, :].astype(q_ref.dtype)
 
-    @pl.when(needed)
-    def _compute():
-        q = q_ref[0, 0]                       # (W*rep, D)
-        k = k_ref[0, :, 0, :]                 # (bs, D)
-        v = v_ref[0, :, 0, :]
-        if quantized:
-            k = k.astype(q.dtype)
-            v = v.astype(q.dtype)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if quantized:
-            # reference order: scores * k_scale, then / sqrt(D)
-            s = s * ks_ref[0, 0]
-        s = s / jnp.float32(math.sqrt(q.shape[-1]))
-        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        qpos = pos_ref[b] + rows // rep       # row -> absolute query position
-        s = jnp.where(m * bs + cols <= qpos, s, NEG_INF)
-        m_prev = m_ref[:, 0]
-        l_prev = l_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[...] = _lanes(l_prev * alpha + jnp.sum(p, axis=-1))
-        if quantized:
-            p = p * vs_ref[0, 0]              # fold v scale into probs
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = _lanes(m_new)
+        @pl.when(needed)
+        def _compute():
+            q = q_ref[0, 0]                       # (R, D)
+            if quantized and early:
+                k, v = k_pre, v_pre
+            else:
+                k = k_refs[j][0, :, 0, :]         # (bs, D)
+                v = v_refs[j][0, :, 0, :]
+                if quantized:
+                    k = k.astype(q.dtype)
+                    v = v.astype(q.dtype)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if quantized:
+                # reference order: scores * k_scale, then / sqrt(D)
+                s = s * ks_refs[j][0, 0]
+            s = s / jnp.float32(math.sqrt(q.shape[-1]))
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            # row -> absolute query position
+            qpos = pos_ref[b] + (row0 + rows) // rep
+            s = jnp.where(blk * bs + cols <= qpos, s, NEG_INF)
+            m_prev = m_ref[:, 0]
+            l_prev = l_ref[:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[...] = _lanes(l_prev * alpha + jnp.sum(p, axis=-1))
+            if quantized:
+                p = p * vs_refs[j][0, 0]          # fold v scale into probs
+            acc_ref[...] = acc_ref[...] * alpha[:, None] + \
+                jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_ref[...] = _lanes(m_new)
 
-    @pl.when(m == M - 1)
+    for j in range(d):
+        _step(j)
+
+    @pl.when(m == Mp - 1)
     def _finish():
         l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 
 def _paged_attention_call(q, k_pool, v_pool, tables, pos, k_scales=None,
-                          v_scales=None):
+                          v_scales=None, geometry=None):
+    from ..autotune.kernel_geometry import (PagedAttentionGeometry,
+                                            _largest_divisor)
+
     B, W, H, D = q.shape
     N, bs, KV, _ = k_pool.shape
     rep = H // KV
@@ -132,89 +181,168 @@ def _paged_attention_call(q, k_pool, v_pool, tables, pos, k_scales=None,
     Wr = W * rep
     _check_tpu_shapes(bs, D)
     quantized = k_scales is not None
+    if geometry is None:
+        geometry = _resolve("paged_attention",
+                            "int8" if quantized else str(q.dtype), D)
+    if not isinstance(geometry, PagedAttentionGeometry):
+        raise ValueError(f"paged attention wants a PagedAttentionGeometry, "
+                         f"got {type(geometry).__name__}")
+    geometry.validate()
+    # geometry values quantize onto this shape deterministically
+    depth = _largest_divisor(M, geometry.kv_block_depth)
+    R = Wr if geometry.q_rows == 0 else _largest_divisor(Wr, geometry.q_rows)
+    NQ = Wr // R
+    Mp = M // depth
+    early = quantized and geometry.dequant == "early"
     # GQA: group query heads with their shared kv head so one kernel
     # instance covers the whole group — (B, KV, W*rep, D).
     qt = q.reshape(B, W, KV, rep, D).transpose(0, 2, 1, 3, 4).reshape(
         B, KV, Wr, D)
-    kv_spec = pl.BlockSpec((1, bs, 1, D),
-                           lambda b, g, m, tbl, ps: (tbl[b, m], 0, g, 0))
-    in_specs = [
-        pl.BlockSpec((1, 1, Wr, D), lambda b, g, m, tbl, ps: (b, g, 0, 0)),
-        kv_spec, kv_spec,
-    ]
-    args = [tables.astype(jnp.int32), pos.astype(jnp.int32), qt, k_pool,
-            v_pool]
+    # grid axes: the two parallel axes in the geometry's order, the
+    # optional q-row tile axis, then the sequential kv-block axis; the
+    # default (depth=1, full rows, "bgm") is exactly the pre-geometry
+    # (B, KV, M) lowering
+    axes = (["b", "g"] if geometry.grid_order == "bgm" else ["g", "b"])
+    if NQ > 1:
+        axes.append("q")
+    axes.append("m")
+    sizes = {"b": B, "g": KV, "q": NQ, "m": Mp}
+    grid = tuple(sizes[a] for a in axes)
+    ib, ig, im = axes.index("b"), axes.index("g"), axes.index("m")
+    iq = axes.index("q") if NQ > 1 else None
+
+    def q_map(*a):
+        ids = a[:-2]
+        return (ids[ib], ids[ig], ids[iq] if iq is not None else 0, 0)
+
+    def kv_map(j):
+        def f(*a):
+            ids, tbl = a[:-2], a[-2]
+            return (tbl[ids[ib], ids[im] * depth + j], 0, ids[ig], 0)
+        return f
+
+    def sc_map(j):
+        def f(*a):
+            ids, tbl = a[:-2], a[-2]
+            return (tbl[ids[ib], ids[im] * depth + j], ids[ig])
+        return f
+
+    in_specs = [pl.BlockSpec((1, 1, R, D), q_map)]
+    in_specs += [pl.BlockSpec((1, bs, 1, D), kv_map(j))
+                 for j in range(depth)]
+    in_specs += [pl.BlockSpec((1, bs, 1, D), kv_map(j))
+                 for j in range(depth)]
+    args = [tables.astype(jnp.int32), pos.astype(jnp.int32), qt]
+    args += [k_pool] * depth + [v_pool] * depth
     if quantized:
-        sc_spec = pl.BlockSpec((1, 1), lambda b, g, m, tbl, ps: (tbl[b, m], g))
-        in_specs += [sc_spec, sc_spec]
-        args += [k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)]
+        in_specs += [pl.BlockSpec((1, 1), sc_map(j)) for j in range(depth)]
+        in_specs += [pl.BlockSpec((1, 1), sc_map(j)) for j in range(depth)]
+        args += [k_scales.astype(jnp.float32)] * depth
+        args += [v_scales.astype(jnp.float32)] * depth
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, KV, M),
+        grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, Wr, D),
-                               lambda b, g, m, tbl, ps: (b, g, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, R, D), q_map),
         scratch_shapes=[
-            pltpu.VMEM((Wr, 128), jnp.float32),   # running max
-            pltpu.VMEM((Wr, 128), jnp.float32),   # running sum
-            pltpu.VMEM((Wr, D), jnp.float32),     # output accumulator
+            pltpu.VMEM((R, 128), jnp.float32),   # running max
+            pltpu.VMEM((R, 128), jnp.float32),   # running sum
+            pltpu.VMEM((R, D), jnp.float32),     # output accumulator
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_attn_kernel, bs=bs, W=W, rep=rep, M=M,
-                          quantized=quantized),
+        functools.partial(_attn_kernel, bs=bs, W=W, rep=rep, Mp=Mp,
+                          depth=depth, R=R, quantized=quantized,
+                          early=early, ib=ib, ig=ig, iq=iq, im=im),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KV, Wr, D), q.dtype),
         compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=tuple(
+                "arbitrary" if a == "m" else "parallel" for a in axes)),
         interpret=_interpret(),
     )(*args)
     return out.reshape(B, KV, W, rep, D).transpose(0, 2, 1, 3, 4).reshape(
         B, W, H, D)
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, pos):
+def paged_attention(q, k_pool, v_pool, block_tables, pos, geometry=None):
     """Fused paged decode/verify attention over an fp block pool.
 
     q: (B, W, H, D) — W=1 decode, W=tick_window verify, W=chunk prefill.
     pos: (B,) int — absolute position of each row's FIRST query token.
+    geometry: trace-time :class:`PagedAttentionGeometry` (None = the
+    process-wide winner cache, falling back to the default schedule).
     """
-    return _paged_attention_call(q, k_pool, v_pool, block_tables, pos)
+    return _paged_attention_call(q, k_pool, v_pool, block_tables, pos,
+                                 geometry=geometry)
 
 
 def paged_attention_q(q, kq_pool, k_scales, vq_pool, v_scales, block_tables,
-                      pos):
+                      pos, geometry=None):
     """Int8 twin: streams the code pool and dequantizes on the VMEM tile."""
     return _paged_attention_call(q, kq_pool, vq_pool, block_tables, pos,
-                                 k_scales=k_scales, v_scales=v_scales)
+                                 k_scales=k_scales, v_scales=v_scales,
+                                 geometry=geometry)
 
 
 # ----------------------------------------------------------------- LoRA BGMV
-def _lora_kernel(x_ref, w_ref, a_ref, b_ref, s_ref, o_ref):
+def _lora_kernel(x_ref, w_ref, a_ref, b_ref, s_ref, o_ref, *,
+                 delta_first=False):
     x = x_ref[0]                               # (S, in)
-    y = jax.lax.dot_general(x, w_ref[...], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    xa = jax.lax.dot_general(x.astype(jnp.float32), a_ref[0],
-                             (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    d = jax.lax.dot_general(xa, b_ref[0], (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+
+    def base():
+        return jax.lax.dot_general(x, w_ref[...], (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    def delta():
+        xa = jax.lax.dot_general(x.astype(jnp.float32), a_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return jax.lax.dot_general(xa, b_ref[0], (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    # accumulation layout: which chain issues first — the final combine
+    # is the same expression either way (bit-exact)
+    if delta_first:
+        d = delta()
+        y = base()
+    else:
+        y = base()
+        d = delta()
     o_ref[0] = (y + d * s_ref[0, 0]).astype(o_ref.dtype)
 
 
-def fused_lora_matmul(x, w, a, b, s):
+def fused_lora_matmul(x, w, a, b, s, geometry=None):
     """Base projection + per-row LoRA delta in one program per batch row:
     ``x @ w + ((x32 @ a[i]) @ b[i]) * s[i]``. The factors are the per-slot
     gathers from AdapterPool.gather_rows — a (B, in, R), b (B, R, out),
     s (B,); null adapters arrive as zero factors with s=0, making the delta
-    exactly zero (bit-identical to the plain matmul)."""
+    exactly zero (bit-identical to the plain matmul).
+
+    ``geometry`` (:class:`LoRAGeometry`): rank padding (zero columns/rows
+    contribute exact zeros — bit-exact, MXU-aligned contraction) and the
+    matmul issue order."""
+    from ..autotune.kernel_geometry import LoRAGeometry
+
     B, S, IN = x.shape
     OUT = w.shape[1]
     R = a.shape[2]
+    if geometry is None:
+        geometry = _resolve("fused_lora", str(x.dtype), R)
+    if not isinstance(geometry, LoRAGeometry):
+        raise ValueError(f"fused LoRA wants a LoRAGeometry, got "
+                         f"{type(geometry).__name__}")
+    geometry.validate()
     if not _interpret() and (IN % 128 or OUT % 128):
         raise NotImplementedError("projection dims not lane-aligned")
+    rp = geometry.padded_rank(R)
+    if rp != R:
+        a = jnp.pad(a, ((0, 0), (0, 0), (0, rp - R)))
+        b = jnp.pad(b, ((0, 0), (0, rp - R), (0, 0)))
+        R = rp
     return pl.pallas_call(
-        _lora_kernel,
+        functools.partial(_lora_kernel,
+                          delta_first=geometry.accum == "delta_first"),
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, S, IN), lambda i: (i, 0, 0)),

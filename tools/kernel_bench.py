@@ -113,8 +113,29 @@ def main():
                     help="also run every combo sharded over an N-way "
                          "'tp' mesh (shard_map, serving shard layout) "
                          "and gate parity vs the unsharded reference")
+    ap.add_argument("--sweep-geometry", action="store_true",
+                    help="per-op kernel-geometry tier: sweep the "
+                         "bit-exact schedule candidates on every paged "
+                         "row (plus one rung per fused-op family), "
+                         "hard-reject parity mismatches, report each "
+                         "row's winner + speedup vs default, and collect "
+                         "winners into a GeometryCache (--emit-cache)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="input rng seed (sweeps under --clock counting "
+                         "are byte-reproducible per seed)")
+    ap.add_argument("--clock", default="real",
+                    choices=("real", "counting"),
+                    help="counting = deterministic injectable clock "
+                         "(autotuner discipline): timings count calls, "
+                         "so two runs at the same seed are byte-identical")
+    ap.add_argument("--emit-cache", default=None, metavar="PATH",
+                    help="write the swept GeometryCache JSON (the "
+                         "artifact TunedProfile v3 / serving_benchmark "
+                         "--geometry-cache consume)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
+    if args.emit_cache and not args.sweep_geometry:
+        ap.error("--emit-cache requires --sweep-geometry")
     if args.tp > 1:
         if args.heads % args.tp or args.kv_heads % args.tp:
             ap.error("--tp must divide --heads and --kv-heads (the mesh "
@@ -134,6 +155,7 @@ def main():
     import jax.numpy as jnp
 
     from paddle_tpu import ops
+    from paddle_tpu.autotune.kernel_geometry import resolve_geometry
     from paddle_tpu.ops import paged_attention as pa
     from paddle_tpu.utils.bench_timing import tpu_lock
 
@@ -166,19 +188,34 @@ def main():
                 return (_HEADS, *pool, P())
             return (_HEADS, *pool, P(), P())     # (q, *pools, tables, pos)
 
+    if args.clock == "counting":
+        # injectable counting clock (GL012 discipline, same as the
+        # autotuner's TrialRunner): every read advances by one, so a
+        # "duration" is a call count — two sweeps at one seed produce
+        # byte-identical rows and winner tables
+        _count = [0.0]
+
+        def clk():
+            _count[0] += 1.0
+            return _count[0]
+    else:
+        clk = time.perf_counter
+
     def timed(fn, fn_args):
         # fresh lambda: jax's tracing cache is keyed on function identity,
         # so re-jitting `fn` itself after a kernel-mode flip (any rung of
-        # the auto/pallas/megakernel/reference enum) would silently reuse
-        # the other mode's jaxpr
+        # the auto/pallas/megakernel/reference enum) OR a kernel-geometry
+        # re-bind (installing a different winner cache is invisible to the
+        # cache key, exactly like the mode flag) would silently reuse the
+        # other configuration's jaxpr
         jf = jax.jit(lambda *a: fn(*a))
         out = jf(*fn_args)
         out.block_until_ready()
-        t0 = time.perf_counter()
+        t0 = clk()
         for _ in range(args.iters):
             out = jf(*fn_args)
         out.block_until_ready()
-        return (time.perf_counter() - t0) / args.iters, out
+        return (clk() - t0) / args.iters, out
 
     def timed_tick(fn, fn_args):
         # like timed(), but also splits out the host-side ISSUE time of
@@ -188,14 +225,161 @@ def main():
         out = jf(*fn_args)
         out.block_until_ready()
         disp = 0.0
-        t0 = time.perf_counter()
+        t0 = clk()
         for _ in range(args.iters):
-            t1 = time.perf_counter()
+            t1 = clk()
             out = jf(*fn_args)
-            disp += time.perf_counter() - t1
+            disp += clk() - t1
             out.block_until_ready()
-        total = time.perf_counter() - t0
+        total = clk() - t0
         return total / args.iters, disp / args.iters, out
+
+    # ---------------------------------------------- kernel-geometry tier
+    sweep_cache = None
+    if args.sweep_geometry:
+        from paddle_tpu.autotune import GeometryCache
+        from paddle_tpu.autotune.kernel_geometry import local_device_kind
+
+        sweep_cache = GeometryCache()
+        device_kind = local_device_kind()
+
+    def run_sweep(measure, op, dtype, key, **kw):
+        """One deterministic sweep rung: measure every candidate under a
+        fresh jit (geometry re-binds MUST re-trace — see timed), bitwise
+        parity-gate vs the default's output, cache the winner."""
+        from paddle_tpu.autotune import sweep_kernel_geometry
+
+        return sweep_kernel_geometry(measure, op, dtype=dtype, key=key,
+                                     device_kind=device_kind,
+                                     cache=sweep_cache, **kw)
+
+    def installed_measure(fn, fn_args, op, dtype, key):
+        """measure() for ops whose geometry rides the process-wide seam
+        (paged attention, flash): install a one-entry cache, fresh-jit,
+        restore. The restore matters — the sweep must not leak its last
+        candidate into the next row's timing."""
+        from paddle_tpu.autotune import GeometryCache, install_geometry_cache
+        from paddle_tpu.autotune.kernel_geometry import (
+            active_geometry_cache, active_geometry_source)
+
+        def measure(geom):
+            prev, prev_src = active_geometry_cache(), \
+                active_geometry_source()
+            c = GeometryCache()
+            c.put(op, dtype, key, device_kind, geom)
+            install_geometry_cache(c, "swept")
+            try:
+                secs, out = timed(fn, fn_args)
+            finally:
+                install_geometry_cache(
+                    prev, prev_src if prev is not None else "swept")
+            return np.asarray(out), secs
+        return measure
+
+    def sweep_summary(res):
+        return {
+            "winner_geometry": res.winner,
+            "geometry_speedup": round(res.speedup, 3),
+            "geometry_candidates": len(res.trials),
+            "geometry_parity_rejects": sum(
+                1 for t in res.trials if not t.accepted),
+        }
+
+    def family_sweep_rows():
+        """One sweep rung per fused-op family (fp, fixed microbench
+        shapes) — the per-op tier beyond the paged rows. The LoRA/norm/
+        CE candidates are bit-exact by design, so a parity reject there
+        fails the run like a paged parity failure would; flash block_q
+        is row-independent but its BITWISE equality is backend-dependent
+        (host BLAS may regroup the contraction by tile shape), so flash
+        rejects are a graceful result — the reject count is reported and
+        the rejected schedule simply never wins the cell."""
+        from paddle_tpu.autotune.kernel_geometry import geometry_candidates
+        from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+        from paddle_tpu.ops.fused_norm import _rms_pallas
+        from paddle_tpu.ops.paged_attention_pallas import fused_lora_matmul
+        from paddle_tpu.ops.flash_attention import flash_attention
+
+        rng = np.random.RandomState(args.seed)
+        out_rows = []
+
+        def add_row(fam, key, res):
+            strict = fam != "flash_attention"   # see docstring above
+            out_rows.append({
+                "metric": "geometry_sweep", "op": fam, "quant": "fp",
+                "dtype": "float32", "key": key, "backend": backend,
+                "pallas_mode": "mosaic" if on_tpu else "interpret",
+                "parity": (all(t.accepted for t in res.trials) if strict
+                           else res.trials[res.winner_index].exact),
+                **sweep_summary(res)})
+
+        mode = ops.kernel_mode()
+        try:
+            ops.set_kernel_mode("pallas")
+            # fused LoRA: geometry is a direct trace-time argument
+            B, S, IN, OUT, R = 2, 8, 256, 256, 8
+            x = jnp.asarray(rng.randn(B, S, IN).astype(np.float32))
+            w = jnp.asarray(rng.randn(IN, OUT).astype(np.float32) * 0.05)
+            a = jnp.asarray(rng.randn(B, IN, R).astype(np.float32) * 0.05)
+            b = jnp.asarray(rng.randn(B, R, OUT).astype(np.float32) * 0.05)
+            s = jnp.asarray(np.array([0.5, 0.0], np.float32))
+
+            def lora_measure(geom):
+                secs, out = timed(
+                    lambda *t: fused_lora_matmul(*t, geometry=geom),
+                    (x, w, a, b, s))
+                return np.asarray(out), secs
+
+            add_row("fused_lora", R, run_sweep(
+                lora_measure, "fused_lora", "float32", R,
+                shape={"seq": S, "in_dim": IN, "out_dim": OUT, "rank": R}))
+
+            # fused norm: direct geometry, interpret off-TPU
+            xr = jnp.asarray(rng.randn(256, 512).astype(np.float32))
+            wr = jnp.asarray(rng.randn(512).astype(np.float32))
+
+            def norm_measure(geom):
+                secs, out = timed(
+                    lambda *t: _rms_pallas(*t, 1e-6, geometry=geom,
+                                           interpret=not on_tpu),
+                    (xr, wr))
+                return np.asarray(out), secs
+
+            add_row("fused_norm", 512, run_sweep(
+                norm_measure, "fused_norm", "float32", 512,
+                shape={"rows_total": 256, "width": 512}))
+
+            # fused CE: jnp composition, geometry sub-tiles the forward
+            h = jnp.asarray(rng.randn(64, 128).astype(np.float32))
+            wv = jnp.asarray(rng.randn(128, 512).astype(np.float32) * 0.1)
+            lab = jnp.asarray(rng.randint(0, 512, (64,)).astype(np.int32))
+
+            def ce_measure(geom):
+                secs, out = timed(
+                    lambda *t: fused_linear_cross_entropy(
+                        *t, chunk_size=32, geometry=geom),
+                    (h, wv, lab))
+                return np.asarray(out), secs
+
+            add_row("fused_ce", 128, run_sweep(
+                ce_measure, "fused_ce", "float32", 128,
+                shape={"rows_total": 64, "hidden": 128, "vocab": 512}))
+
+            # flash attention: rides the seam like paged attention;
+            # block_kv stays excluded from candidates (not parity-exact)
+            D = args.head_dim
+            qf = jnp.asarray(rng.randn(1, 2, 256, D).astype(np.float32))
+            kf = jnp.asarray(rng.randn(1, 2, 256, D).astype(np.float32))
+            vf = jnp.asarray(rng.randn(1, 2, 256, D).astype(np.float32))
+            add_row("flash_attention", D, run_sweep(
+                installed_measure(
+                    lambda *t: flash_attention(*t, causal=True),
+                    (qf, kf, vf), "flash_attention", "float32", D),
+                "flash_attention", "float32", D,
+                shape={"head_dim": D, "seq_q": 256, "seq_k": 256}))
+        finally:
+            ops.set_kernel_mode(mode)
+        return out_rows
 
     def bench_tick(B, M, bs, quant, lora_on):
         """Whole decode trip (W=1): embed + all layers, three rungs."""
@@ -214,7 +398,7 @@ def main():
         model = LlamaForCausalLM(cfg)
         m = model.model
         W = 1
-        rng = np.random.RandomState(0)
+        rng = np.random.RandomState(args.seed)
         _, _, tables, pos = make_inputs(rng, jnp, B, M, bs, H, KV, D, W,
                                         "fp")
         N = max(B * M + 1, 2)
@@ -331,7 +515,7 @@ def main():
     with tpu_lock(timeout_s=900.0) as locked:
         for B, M, bs in parse_shapes(args.shapes):
             for quant in args.quant.split(","):
-                rng = np.random.RandomState(0)
+                rng = np.random.RandomState(args.seed)
                 for op in args.ops.split(","):
                     if op == "tick":
                         for lora_on in (False, True):
@@ -366,11 +550,26 @@ def main():
                         tok = B * W
                     mode = ops.kernel_mode()
                     tp_s, tp_out = None, None
+                    sweep_res = None
                     try:
                         ops.set_kernel_mode("reference")
                         ref_s, ref_out = timed(fn, fn_args)
                         ops.set_kernel_mode("pallas")
                         pal_s, pal_out = timed(fn, fn_args)
+                        if args.sweep_geometry:
+                            pa_dtype = ("int8" if quant == "int8"
+                                        else "float32")
+                            sweep_res = run_sweep(
+                                installed_measure(
+                                    fn, fn_args, "paged_attention",
+                                    pa_dtype, args.head_dim),
+                                "paged_attention", pa_dtype,
+                                args.head_dim,
+                                quantized=quant == "int8",
+                                shape={"head_dim": args.head_dim,
+                                       "block_size": bs, "window": W,
+                                       "rep": args.heads // args.kv_heads,
+                                       "blocks": M})
                         if mesh is not None:
                             # same kernel, per-shard head slices: jit a
                             # fresh shard_map lambda (cache is keyed on
@@ -405,6 +604,19 @@ def main():
                         "max_abs_diff": diff,
                         "parity": diff < 2e-5,
                     })
+                    # which schedule the pallas timing above actually
+                    # ran (the trace-time resolution, not a guess)
+                    g_act, g_src = resolve_geometry(
+                        "paged_attention",
+                        "int8" if quant == "int8" else "float32",
+                        args.head_dim)
+                    rows[-1]["geometry"] = g_act.asdict()
+                    rows[-1]["geometry_source"] = g_src
+                    if sweep_res is not None:
+                        rows[-1]["parity"] = bool(
+                            rows[-1]["parity"] and all(
+                                t.accepted for t in sweep_res.trials))
+                        rows[-1].update(sweep_summary(sweep_res))
                     if tp_out is not None:
                         tp_diff = float(jnp.max(jnp.abs(
                             ref_out.astype(jnp.float32) -
@@ -415,6 +627,8 @@ def main():
                             "tp_max_abs_diff": tp_diff,
                             "tp_parity": tp_diff < 2e-5,
                         })
+        if args.sweep_geometry:
+            rows += family_sweep_rows()
         if not locked:
             for r in rows:
                 r["lock_contended"] = True
@@ -430,13 +644,32 @@ def main():
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
-            print(f"{r['op']:8} {r['quant']:5} {r['B']:>3} {r['M']:>3} "
-                  f"{r['bs']:>3} {r['ref_tok_s']:>12} "
-                  f"{r['pallas_tok_s']:>13} {r['speedup']:>8} "
-                  f"{r['max_abs_diff']:>10.2e}")
+            if r["metric"] == "geometry_sweep":
+                print(f"{r['op']:8} sweep  winner="
+                      f"{json.dumps(r['winner_geometry'], sort_keys=True)} "
+                      f"x{r['geometry_speedup']} "
+                      f"({r['geometry_candidates']} candidates, "
+                      f"{r['geometry_parity_rejects']} parity rejects)")
+                continue
+            line = (f"{r['op']:8} {r['quant']:5} {r['B']:>3} {r['M']:>3} "
+                    f"{r['bs']:>3} {r['ref_tok_s']:>12} "
+                    f"{r['pallas_tok_s']:>13} {r['speedup']:>8} "
+                    f"{r['max_abs_diff']:>10.2e}")
+            if "winner_geometry" in r:
+                line += (f"  winner="
+                         f"{json.dumps(r['winner_geometry'], sort_keys=True)}"
+                         f" x{r['geometry_speedup']}")
+            print(line)
         print(f"\nbackend={backend} "
               f"({'mosaic' if on_tpu else 'interpret'} pallas), "
               f"parity={'OK' if ok else 'FAIL'}")
+    if args.emit_cache:
+        with open(args.emit_cache, "w") as f:
+            json.dump(sweep_cache.to_dict(), f, sort_keys=True, indent=2)
+            f.write("\n")
+        if not args.json:
+            print(f"geometry cache ({len(sweep_cache)} entries) -> "
+                  f"{args.emit_cache}")
     if not ok:
         sys.exit(1)
 

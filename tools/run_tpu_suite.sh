@@ -488,6 +488,113 @@ if on_tpu:
         "whole-tick program lost to the gather reference on TPU"
 PY
 
+echo "== 7k. kernel-geometry gate (per-op schedule sweep: bit-exact candidates, deterministic winners, swept serving token-equal to default) =="
+# interpret-mode parity first (same rationale as 7g): every supported
+# geometry must be BIT-exact vs the default schedule, fp and int8
+JAX_PLATFORMS=cpu python -m pytest tests/test_kernel_geometry.py -q \
+  || { echo "kernel-geometry parity suite FAILED (a schedule candidate"\
+       "diverged bitwise from the default kernel)"; exit 1; }
+# determinism: two sweeps at one seed under the injectable counting
+# clock must be byte-identical — rows AND the emitted winner cache
+python tools/kernel_bench.py --shapes 2,4,8 --ops decode --quant fp,int8 \
+  --iters 2 --sweep-geometry --seed 11 --clock counting --json \
+  --emit-cache /tmp/tpu_runs/geometry_cache_a.json \
+  | tee /tmp/tpu_runs/kernel_bench_sweep_a.json \
+  || { echo "geometry sweep FAILED (candidate crashed or parity reject"\
+       "took the winner slot)"; exit 1; }
+python tools/kernel_bench.py --shapes 2,4,8 --ops decode --quant fp,int8 \
+  --iters 2 --sweep-geometry --seed 11 --clock counting --json \
+  --emit-cache /tmp/tpu_runs/geometry_cache_b.json \
+  > /tmp/tpu_runs/kernel_bench_sweep_b.json \
+  || { echo "geometry sweep rerun FAILED"; exit 1; }
+cmp /tmp/tpu_runs/kernel_bench_sweep_a.json \
+    /tmp/tpu_runs/kernel_bench_sweep_b.json \
+  || { echo "geometry sweep NONDETERMINISTIC (two runs at one seed under"\
+       "the counting clock differ)"; exit 1; }
+cmp /tmp/tpu_runs/geometry_cache_a.json /tmp/tpu_runs/geometry_cache_b.json \
+  || { echo "geometry winner cache NONDETERMINISTIC across reruns"; exit 1; }
+# real-clock sweep: the row the speed clauses read (winner + speedup
+# vs default; Mosaic clauses gated on_tpu below, same rationale as 7g)
+python tools/kernel_bench.py --shapes 2,4,8 --ops decode --quant fp,int8 \
+  --iters 3 --sweep-geometry --seed 11 --json \
+  | tee /tmp/tpu_runs/kernel_bench_sweep.json \
+  || { echo "real-clock geometry sweep FAILED"; exit 1; }
+# serving twin: same seed + traffic, default geometry vs a swept cache
+# installed before the server builds — tokens must be IDENTICAL (the
+# whole point: geometry moves the schedule, never the math). CPU dryrun
+# on purpose (token equality is backend-independent, 7h rationale), so
+# the cache is keyed to the CPU stand-in model dims.
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --guard-recompiles --json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_geo_ref.json \
+  || { echo "default-geometry twin FAILED"; exit 1; }
+JAX_PLATFORMS=cpu python - <<'PY'
+# non-default winners for the CPU stand-in serving model (hidden 128,
+# 4 heads -> head_dim 32, float32): exercises the swept source end to
+# end without depending on what the real sweep above happened to pick
+import json
+from paddle_tpu.autotune.kernel_geometry import (CEGeometry, GeometryCache,
+                                                 NormGeometry,
+                                                 PagedAttentionGeometry,
+                                                 local_device_kind)
+c = GeometryCache()
+kind = local_device_kind()
+c.put("paged_attention", "float32", 32, kind,
+      PagedAttentionGeometry(kv_block_depth=2, grid_order="gbm"))
+c.put("fused_norm", "float32", 128, kind, NormGeometry(rows=8))
+c.put("fused_ce", "float32", 128, kind, CEGeometry(rows=64))
+with open("/tmp/tpu_runs/serving_geometry_cache.json", "w") as f:
+    json.dump(c.to_dict(), f)
+PY
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --paged --requests 12 \
+  --slots 4 --max-new 24 --guard-recompiles --json \
+  --geometry-cache /tmp/tpu_runs/serving_geometry_cache.json 2>/dev/null \
+  | tee /tmp/tpu_runs/serving_geo.json \
+  || { echo "swept-geometry serving FAILED (recompile budget tripped or"\
+       "a non-default schedule crashed the tick)"; exit 1; }
+python - <<'PY'
+# geometry gate: every sweep row must be parity-clean, with ZERO
+# rejected candidates for the families whose schedules are bit-exact
+# by design (paged attention, LoRA, norm, CE) and >= 3 candidates (a
+# 1-candidate sweep is vacuous). Flash block_q is row-independent but
+# its bitwise equality is backend-dependent (host BLAS may regroup the
+# contraction by tile shape), so flash rejects are legal — the bitwise
+# gate rejecting them IS the mechanism, and the winner stays exact.
+# The swept serving line must actually engage the cache (source
+# 'swept') and be TOKEN-IDENTICAL to the default twin; on real
+# hardware the winner must not lose to the default it was picked over
+import json
+rows = [json.loads(l)
+        for l in open("/tmp/tpu_runs/kernel_bench_sweep.json")]
+ref = json.load(open("/tmp/tpu_runs/serving_geo_ref.json"))
+srv = json.load(open("/tmp/tpu_runs/serving_geo.json"))
+on_tpu = rows[0]["backend"] in ("tpu", "axon")
+swept = [r for r in rows if "winner_geometry" in r]
+assert swept, "no sweep rows emitted — gate vacuous"
+assert all(r["parity"] for r in rows), "geometry sweep parity FAILED"
+strict = [r for r in swept if r.get("op") != "flash_attention"]
+assert all(r["geometry_parity_rejects"] == 0 for r in strict), \
+    "a bit-exact-by-design geometry candidate diverged from default"
+assert all(r["geometry_candidates"] >= 3 for r in swept), \
+    "sweep ran with fewer than 3 candidates — gate vacuous"
+fams = {r["op"] for r in rows if r.get("metric") == "geometry_sweep"}
+assert {"fused_lora", "fused_norm", "fused_ce",
+        "flash_attention"} <= fams, f"family rungs missing: {fams}"
+src = srv.get("kernel_geometry_source") or {}
+assert any(s == "swept" for s in src.values()), \
+    "swept cache never engaged in serving — twin vacuous"
+assert srv["tokens_fingerprint"] == ref["tokens_fingerprint"], \
+    "swept geometry CHANGED serving tokens (schedule leaked into math)"
+print(f"{len(swept)} sweep rows parity-clean "
+      f"({rows[0]['pallas_mode']} mode), "
+      f"{sum(r['geometry_candidates'] for r in swept)} candidates, "
+      f"0 parity rejects; swept serving token-equal to default twin "
+      f"(sources {src})")
+if on_tpu:
+    slow = [r for r in swept if r["geometry_speedup"] < 1.0]
+    assert not slow, f"geometry winner slower than default on TPU: {slow}"
+PY
+
 echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="
 python tools/train_chaos.py --steps 12 --kills 2 --seed 3 --json 2>/dev/null \
   | tee /tmp/tpu_runs/train_chaos.json \

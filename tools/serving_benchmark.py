@@ -483,6 +483,13 @@ def main():
                          "--tick-window/--kv-quant/--scheduler/...). With "
                          "--tune, PATH is where the freshly tuned profile "
                          "is written before the measured replay")
+    ap.add_argument("--geometry-cache", metavar="PATH", default=None,
+                    help="install a swept kernel-geometry winner cache "
+                         "(kernel_bench.py --sweep-geometry --emit-cache "
+                         "JSON) before the server is built: every kernel "
+                         "trace resolves its schedule from the cache "
+                         "(source 'swept'); a --profile with its own "
+                         "kernel_geometry takes precedence")
     ap.add_argument("--tune", type=int, default=None, metavar="BUDGET",
                     help="run the cost-model autotuner (paddle_tpu."
                          "autotune) over this benchmark's seeded workload "
@@ -739,6 +746,17 @@ def main():
         args.pool_frac = _pf if _pf < 1.0 else None
         args.host_pool_mb = _pc.get("host_pool_mb")
         args.num_blocks = None
+
+    if args.geometry_cache is not None:
+        # installed BEFORE any server build so every kernel trace sees
+        # it; a profile carrying its own kernel_geometry re-installs
+        # with source "profile" inside the GenerationServer ctor
+        from paddle_tpu.autotune.kernel_geometry import (GeometryCache,
+                                                         install_geometry_cache)
+
+        with open(args.geometry_cache) as f:
+            install_geometry_cache(GeometryCache.from_dict(json.load(f)),
+                                   source="swept")
 
     lora_cfg, lora_live = None, 0
     if args.lora_adapters:
@@ -1342,6 +1360,13 @@ def main():
         line["acceptance_rate"] = round(sm["acceptance_rate"], 4)
         line["draft_tokens_proposed"] = sm["draft_tokens_proposed"]
         line["draft_tokens_accepted"] = sm["draft_tokens_accepted"]
+    kg = getattr(server, "kernel_geometry", None)
+    if kg and any(src != "default" for _, src in kg.values()):
+        line["kernel_geometry_source"] = {op: src
+                                          for op, (_, src) in kg.items()}
+        line["kernel_geometry"] = {op: g.asdict()
+                                   for op, (g, src) in kg.items()
+                                   if src != "default"}
     if tuned_profile is not None:
         line["profile_fingerprint"] = tuned_profile.config_fingerprint
         line["profile_workload_match"] = bool(
