@@ -80,3 +80,58 @@ class WallClockInServingRule(Rule):
                     f"defaulting to time.monotonic) instead, so seeded "
                     f"chaos plans, restore timing, and tuned-profile "
                     f"byte-determinism replay exactly")
+
+
+@register
+class BlockingWallTimeInFleetsimRule(Rule):
+    """GL015: blocking waits and wall-clock reads inside the fast-time
+    simulation surface. ``paddle_tpu/fleetsim/`` runs a simulated day in
+    CI minutes by advancing a virtual clock; ``inference/transport.py``
+    and ``replica_worker.py`` synchronize on socket frames, never on
+    sleeps. One ``time.sleep()`` turns virtual seconds back into wall
+    seconds — a million-session day stops fitting in CI — and one
+    wall-clock read couples the byte-identical report to the machine it
+    ran on."""
+
+    id = "GL015"
+    name = "blocking-wall-time-in-fleetsim"
+    description = ("time.sleep() or wall-clock reads inside "
+                   "paddle_tpu/fleetsim/ or the replica transport "
+                   "(inference/transport.py, inference/replica_worker.py) "
+                   "re-couple fast-time simulation to wall time: the "
+                   "discrete-event loop owns ALL time via the virtual "
+                   "clock, and transport blocking is bounded by socket "
+                   "timeouts, not sleeps — a single sleep makes a "
+                   "simulated day take a real day and breaks "
+                   "byte-identical seeded reports")
+
+    #: fleetsim is wholly in scope; the transport pair is listed
+    #: file-by-file because the rest of inference/ may legitimately
+    #: sleep in user-facing CLIs layered above it
+    _SCOPE = ("paddle_tpu/fleetsim/",
+              "paddle_tpu/inference/transport.py",
+              "paddle_tpu/inference/replica_worker.py")
+
+    #: sleep in every spelling, plus the GL012 wall-clock read surface —
+    #: fleetsim has no sanctioned wall-time at all (GL012 already covers
+    #: the transport files for reads; sleep is the new ban there)
+    _BLOCKING_CALLS = frozenset(
+        {"time.sleep", "sleep", "asyncio.sleep"}
+        | WallClockInServingRule._CLOCK_CALLS)
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.path.startswith(self._SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain in self._BLOCKING_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{chain}() blocks on wall time inside the fast-time "
+                    f"simulation surface — fleetsim time belongs to the "
+                    f"virtual clock (advance_to/advance) and transport "
+                    f"waits are socket-timeout-bounded; a sleep or "
+                    f"wall-clock read here makes the simulated day run "
+                    f"at wall speed and breaks byte-identical reports")

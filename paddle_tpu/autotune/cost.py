@@ -18,6 +18,7 @@ Hard accept/reject decisions always come from measurement
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Mapping, Optional
 
 from ..cost_model import PagedTickCostModel, REF_BLOCK_BYTES, TickShape
@@ -165,6 +166,33 @@ class ServingCostModel:
         if self._trials:
             self.tick_model = self.tick_model.calibrate(self._trials,
                                                         ridge=ridge)
+
+    # ------------------------------------------------------------ capacity
+    def capacity_tok_s(self, config: Mapping[str, Any],
+                       workload: WorkloadSpec) -> float:
+        """Predicted steady-state serving capacity of ONE replica under
+        this (config, workload) — new tokens per second, end to end.
+        The fleet autoscaler's sizing oracle: like every prediction
+        here it is a *ranking/sizing* device that sharpens as measured
+        trials feed :meth:`observe`, not a stopwatch."""
+        return self.predict_tok_s(config, workload)
+
+    def replicas_for(self, demand_tok_s: float,
+                     config: Mapping[str, Any],
+                     workload: WorkloadSpec, *,
+                     utilization: float = 1.0) -> int:
+        """Replicas needed to serve ``demand_tok_s`` with each replica
+        loaded to at most ``utilization`` of its predicted capacity —
+        the capacity-planning half of elastic autoscaling (the burn-rate
+        gauges are the reactive half). Always at least 1: a fleet with
+        zero replicas can serve nothing and drain nothing."""
+        if not 0.0 < utilization <= 1.0:
+            raise ValueError(
+                f"utilization must be in (0, 1], got {utilization!r}")
+        cap = self.capacity_tok_s(config, workload) * utilization
+        if cap <= 0.0 or demand_tok_s <= 0.0:
+            return 1
+        return max(1, int(math.ceil(demand_tok_s / cap)))
 
     def spec_break_even(self, k: int,
                         workload: WorkloadSpec,

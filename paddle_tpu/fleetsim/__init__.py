@@ -1,0 +1,30 @@
+"""fleetsim: seeded discrete-event traffic simulation for the fleet.
+
+A "day" of traffic from millions of synthetic users, compressed into
+CI wall-time: :mod:`traffic` draws the whole day's session arrivals in
+one vectorized, seeded pass (diurnal arrival curve, tenant Zipf,
+shared-prefix populations, long-tail context lengths — the
+``autotune/workload.py`` distributions at fleet scale);
+:mod:`sim` replays them against an analytic replica service model
+derived from the PR 14 cost model under the virtual clock in
+:mod:`clock`, driving the PR 20 elastic autoscaler exactly as a live
+control loop would; and :func:`~paddle_tpu.fleetsim.sim.replay_slice`
+materializes a slice of the same trace into real prompts and pushes
+them through a real :class:`~paddle_tpu.inference.fleet.FleetRouter`
+(in-process or subprocess replicas) so the simulator's claims stay
+anchored to token-exact execution.
+
+Everything in this package is deterministic at a seed and runs in
+*virtual* seconds — no ``time.sleep``, no wall-clock reads (graftlint
+GL015 enforces this): two runs at one seed produce byte-identical JSON.
+"""
+from .clock import VirtualClock
+from .sim import FleetSimulation, ReplicaServiceModel, replay_slice
+from .traffic import (DayTrafficSpec, SessionTrace, draw_day,
+                      expected_session_rate, materialize_session)
+
+__all__ = [
+    "DayTrafficSpec", "FleetSimulation", "ReplicaServiceModel",
+    "SessionTrace", "VirtualClock", "draw_day", "expected_session_rate",
+    "materialize_session", "replay_slice",
+]

@@ -252,6 +252,8 @@ def load_predictor(path: str) -> Predictor:
     return Predictor(fn, params, names, [])
 
 
+from .autoscale import (AutoscalePolicy, ElasticAutoscaler,  # noqa: E402,F401
+                        FleetAutoscaler, ScaleDecision, verify_replay)
 from .faults import (NULL_INJECTOR, EngineFailedError,  # noqa: E402,F401
                      FaultInjector, FaultPlan, FaultSpec, TickFault)
 from .fleet import (REPLICA_DEAD, REPLICA_DEGRADED,  # noqa: E402,F401
@@ -270,3 +272,6 @@ from .speculative import (DrafterFault, DraftModelDrafter,  # noqa: E402,F401
                           NgramDrafter, SpecConfig)
 from .telemetry import (FlightRecorder, MetricsRegistry,  # noqa: E402,F401
                         ServingTelemetry, SpanTracer, watchdog)
+from .transport import (InProcessReplica, RemoteReplicaError,  # noqa: E402,F401
+                        ReplicaHandle, ReplicaTransportError,
+                        SubprocessReplica)

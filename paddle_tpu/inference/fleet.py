@@ -1,7 +1,9 @@
-"""Fleet-scale serving: N in-process engines behind one router.
+"""Fleet-scale serving: N engines behind one router.
 
 A :class:`FleetRouter` composes N unmodified
-:class:`~.serving.GenerationServer` replicas into a *service* that
+:class:`~.serving.GenerationServer` replicas — bare in-process servers
+or :mod:`~.transport` handles fronting other OS processes; the router
+only speaks the shared duck-typed surface — into a *service* that
 survives replica loss (ROADMAP 5) — the GSPMD argument applied to
 serving: scale by composing the same program, not by writing a new one.
 Three mechanisms, all host-side:
@@ -95,6 +97,10 @@ class ReplicaInfo:
     last_progress_t: float = 0.0
     last_steps: int = 0
     last_remaining: int = 0
+    # last observation freshness marker from a transport-aware handle
+    # (``progress_seq``); -1 = never observed, so the first sample is
+    # always treated as fresh
+    last_seq: int = -1
     stall_ticks: int = 0
     degraded_t: float = 0.0
     last_findings: int = 0
@@ -170,6 +176,9 @@ class FleetRouter:
                     f"replicas must be homogeneous so any replica can "
                     f"receive any migration ({fp!r} vs {want!r})")
             srv.set_rid_base(i * RID_STRIDE)
+        #: the fleet's homogeneity fingerprint (num_blocks excluded) —
+        #: every later ``add_replica`` must match it exactly
+        self._fp_want = want
         roles = [getattr(srv, "role", "any") for srv in servers]
         #: True when any replica declared a class — the fleet then runs
         #: disaggregated: submissions route to the prefill class, the
@@ -277,7 +286,7 @@ class FleetRouter:
         """Routing score: cached-prefix tokens minus load, minus a large
         penalty for degraded replicas. Read-only on the replica."""
         srv = rep.server
-        hits = srv.alloc.probe_prefix(prompt)
+        hits = srv.probe_prefix(prompt)
         lm = srv.load_metrics()
         score = (self.prefix_weight * hits * srv.block_size
                  - self.load_weight * (lm["queue_depth"]
@@ -363,6 +372,18 @@ class FleetRouter:
         rides the same injectable clock."""
         steps = rep.server.steps
         now = self._clock()
+        # transport-aware handles stamp every observation with a
+        # monotone ``progress_seq``; when no FRESH sample has crossed
+        # the boundary since the last heartbeat, a repeated step count
+        # is *staleness*, not a stall — charging it would let ordinary
+        # transport round-trip latency degrade a healthy remote
+        # replica. In-process servers have no such attribute and keep
+        # the original always-fresh accounting.
+        seq = getattr(rep.server, "progress_seq", None)
+        if seq is not None:
+            if seq == rep.last_seq:
+                return
+            rep.last_seq = seq
         progressed = (steps != rep.last_steps
                       or remaining < rep.last_remaining)
         if remaining and not progressed:
@@ -392,7 +413,7 @@ class FleetRouter:
         pool-pressure stall, steady-state recompile) flips the replica
         degraded so routing sheds load off it while it recovers."""
         try:
-            findings = rep.server.telemetry.watchdog()
+            findings = rep.server.watchdog_findings()
         except Exception:
             return
         # degrade on NEW findings only: the flight dump is cumulative
@@ -451,6 +472,11 @@ class FleetRouter:
         while self.step():
             pass
         for rep in self._replicas:
+            if rep.state == REPLICA_DEAD:
+                # evacuated at death — finished work already folded into
+                # the router's ledgers, and a dead PROCESS has no socket
+                # left to ask
+                continue
             self._results.update(rep.server.take_results())
         out, self._results = self._results, {}
         return out
@@ -585,6 +611,44 @@ class FleetRouter:
             raise ValueError(f"replica {idx} is already dead")
         self._kill(rep, reason)
 
+    # ------------------------------------------------------------- elasticity
+    def add_replica(self, server: Any) -> int:
+        """Grow the fleet by one FRESH replica mid-flight — the
+        autoscaler's scale-up primitive. The newcomer passes the same
+        gate the constructor applies (paged, fingerprint-homogeneous,
+        nothing submitted) and gets the next disjoint rid space; it is
+        immediately live and routable, and every in-flight rid keeps
+        its meaning. Returns the new replica index."""
+        if server.cache_mode != "paged":
+            raise ValueError(
+                f"new replica has cache={server.cache_mode!r} — fleet "
+                f"migration needs the paged per-request KV capture")
+        fp = dict(server._snapshot_fingerprint())
+        fp.pop("num_blocks")
+        if fp != self._fp_want:
+            raise ValueError(
+                f"new replica config differs from the fleet — replicas "
+                f"must stay homogeneous so any replica can receive any "
+                f"migration ({fp!r} vs {self._fp_want!r})")
+        idx = len(self._replicas)
+        server.set_rid_base(idx * RID_STRIDE)
+        now = self._clock()
+        rep = ReplicaInfo(idx=idx, server=server,
+                          role=getattr(server, "role", "any"),
+                          last_progress_t=now,
+                          history=[(now, REPLICA_LIVE)])
+        self._replicas.append(rep)
+        # adding a classed replica can flip the fleet disaggregated;
+        # the constructor's capability invariants can only get easier
+        self.disagg = any(r.role != "any" for r in self._replicas)
+        return idx
+
+    def live_indices(self) -> List[int]:
+        """Indices currently accepting work (live or degraded) — the
+        autoscaler's census of drainable/routable capacity."""
+        return [r.idx for r in self._replicas
+                if r.state in (REPLICA_LIVE, REPLICA_DEGRADED)]
+
     # ------------------------------------------------------------ observation
     def status(self, rid: int) -> str:
         """Fleet-wide request status — the router's ledgers first (they
@@ -636,19 +700,19 @@ class FleetRouter:
         reg = self.registry
         gathered: Dict[str, Dict[str, List[float]]] = {}
         for rep in self._replicas:
-            tel = getattr(rep.server, "telemetry", None)
-            if tel is None:
+            try:
+                obs = rep.server.slo_observations()
+            except Exception:
+                # a replica whose PROCESS is gone can't ship samples —
+                # its completed requests were already harvested; an
+                # in-process dead replica still answers from host state
                 continue
-            for hname, key in (("serving_ttft_s", "ttft"),
-                               ("serving_tpot_ms", "tpot")):
-                h = tel.registry.get(hname)
-                if h is None:
-                    continue
-                for tenant in h.label_values("tenant"):
+            for key in ("ttft", "tpot"):
+                for tenant, samples in sorted((obs.get(key) or {}).items()):
                     w = int(self._slo_for(tenant)["window"])
                     gathered.setdefault(
                         tenant, {"ttft": [], "tpot": []})[key].extend(
-                        h.samples({"tenant": tenant})[-w:])
+                        list(samples)[-w:])
         out: Dict[str, Dict[str, Any]] = {}
         for tenant in sorted(gathered):
             slo = self._slo_for(tenant)
@@ -696,8 +760,12 @@ class FleetRouter:
         for rep in self._replicas:
             census[rep.state] += 1
             srv = rep.server
-            lm = srv.load_metrics()
-            ks = srv.kv_stats()
+            try:
+                lm = srv.load_metrics()
+                ks = srv.kv_stats()
+            except Exception:
+                # a dead process answers nothing; report its row empty
+                lm, ks = {"queue_depth": 0, "slots_occupied": 0}, {}
             row = {"replica": rep.idx, "state": rep.state,
                    "role": rep.role,
                    "steps": srv.steps,

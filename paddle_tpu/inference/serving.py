@@ -2641,6 +2641,40 @@ class GenerationServer:
             raise ValueError(f"rid base must be an int >= 0, got {base!r}")
         self._next_rid = base
 
+    # ------------------------------------------------------- router surface
+    # Everything the fleet router needs, as methods rather than attribute
+    # walks (``srv.alloc...``, ``srv.telemetry.registry...``), so a remote
+    # ReplicaHandle can answer the same questions over one RPC each.
+    def probe_prefix(self, prompt: Sequence[int]) -> int:
+        """Cached-prefix blocks this server could reuse for ``prompt`` —
+        the router's routing-affinity signal. Read-only (takes no refs);
+        0 on the dense path, which has no content-addressed cache."""
+        if self.cache_mode != "paged":
+            return 0
+        return self.alloc.probe_prefix(list(prompt))
+
+    def watchdog_findings(self) -> List[Dict[str, Any]]:
+        """The flight-recorder watchdog's cumulative findings — the
+        router's periodic health probe (see
+        :meth:`~paddle_tpu.telemetry.ServingTelemetry.watchdog`)."""
+        return self._tel.watchdog()
+
+    def slo_observations(self) -> Dict[str, Dict[str, List[float]]]:
+        """Per-tenant latency samples for the fleet SLO roll-up:
+        ``{"ttft": {tenant: [seconds...]}, "tpot": {tenant: [ms...]}}``
+        read from this server's tenant-labeled histograms. The router
+        merges these across replicas instead of reaching into each
+        replica's registry — the one shape a remote handle can ship."""
+        out: Dict[str, Dict[str, List[float]]] = {"ttft": {}, "tpot": {}}
+        for hname, key in (("serving_ttft_s", "ttft"),
+                           ("serving_tpot_ms", "tpot")):
+            h = self._tel.registry.get(hname)
+            if h is None:
+                continue
+            for tenant in h.label_values("tenant"):
+                out[key][tenant] = list(h.samples({"tenant": tenant}))
+        return out
+
     # ------------------------------------------------------------ telemetry
     def telemetry_snapshot(self) -> Dict[str, Any]:
         """Sync point-in-time gauges (pool occupancy, adapter pool, spec

@@ -1,0 +1,186 @@
+"""Elastic autoscaler decision engine in isolation.
+
+Every test drives :class:`ElasticAutoscaler.decide` with explicit
+observation rows — no fleet, no engine — because the engine's contract
+is exactly that: a pure function of (observations, policy, decision
+history). The counting-clock test is the determinism keystone the
+fleet simulator's byte-identical reports stand on.
+"""
+import pytest
+
+from paddle_tpu.inference.autoscale import (AutoscalePolicy,
+                                            ElasticAutoscaler,
+                                            verify_replay)
+from paddle_tpu.inference.transport import CountingClock
+
+CAP = 1000.0  # tokens/s per replica
+
+
+def _engine(**kw):
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("target_utilization", 0.8)
+    kw.setdefault("up_cooldown_s", 0.0)
+    kw.setdefault("down_cooldown_s", 0.0)
+    return ElasticAutoscaler(CAP, policy=AutoscalePolicy(**kw))
+
+
+class TestSizing:
+    def test_desired_covers_demand_at_target_utilization(self):
+        eng = _engine()
+        # 2000 tok/s over 800 effective tok/s per replica -> 3
+        assert eng.desired_replicas(2000.0) == 3
+
+    def test_desired_takes_max_of_demand_and_forecast(self):
+        eng = _engine()
+        assert eng.desired_replicas(100.0, forecast_tok_s=4000.0) == 5
+
+    def test_desired_clamps_to_policy_bounds(self):
+        eng = _engine(min_replicas=2, max_replicas=4)
+        assert eng.desired_replicas(0.0) == 2
+        assert eng.desired_replicas(1e9) == 4
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=4, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(target_utilization=1.5)
+        with pytest.raises(ValueError):
+            ElasticAutoscaler(0.0)
+
+
+class TestDecisions:
+    def test_burn_above_threshold_forces_reactive_up(self):
+        # sizing says live is plenty — but a tenant is burning budget,
+        # so the SLO overrides the model
+        eng = _engine(burn_up=1.0)
+        d = eng.decide(0.0, live=2, demand_tok_s=100.0, burn_rate=1.5)
+        assert d.action == "up" and d.count == 1
+        assert d.reason == "burn_rate"
+
+    def test_burn_below_threshold_defers_to_sizing(self):
+        eng = _engine(burn_up=1.0)
+        d = eng.decide(0.0, live=2, demand_tok_s=100.0, burn_rate=0.5)
+        assert d.action == "hold"
+
+    def test_forecast_leads_the_arrival_curve(self):
+        # observed demand fits one replica; the diurnal forecast says
+        # the peak is coming — capacity must arrive BEFORE the load
+        eng = _engine()
+        d = eng.decide(0.0, live=1, demand_tok_s=500.0,
+                       forecast_tok_s=3000.0)
+        assert d.action == "up" and d.reason == "forecast"
+        assert d.desired == 4
+
+    def test_scale_up_respects_max_step(self):
+        eng = _engine(max_step_up=2)
+        d = eng.decide(0.0, live=1, demand_tok_s=6000.0)
+        assert d.action == "up" and d.count == 2
+
+    def test_scale_up_blocked_by_cooldown(self):
+        eng = _engine(up_cooldown_s=60.0)
+        assert eng.decide(0.0, live=1, demand_tok_s=3000.0).action == "up"
+        d = eng.decide(10.0, live=2, demand_tok_s=6000.0)
+        assert d.action == "hold" and d.reason == "up_cooldown"
+        assert eng.decide(70.0, live=2,
+                          demand_tok_s=6000.0).action == "up"
+
+    def test_refuses_to_drain_last_live_replica(self):
+        # demand collapses to zero: the arithmetic wants zero replicas,
+        # the engine journals the refusal instead of complying
+        eng = _engine()
+        d = eng.decide(0.0, live=1, demand_tok_s=0.0)
+        assert d.action == "hold" and d.reason == "last_replica"
+
+    def test_scale_down_blocked_while_burning(self):
+        eng = _engine(burn_down=0.25)
+        d = eng.decide(0.0, live=3, demand_tok_s=100.0, burn_rate=0.5)
+        assert d.action == "hold" and d.reason == "burn_gate"
+
+    def test_scale_down_one_at_a_time_when_clear(self):
+        eng = _engine(burn_down=0.25)
+        d = eng.decide(0.0, live=3, demand_tok_s=100.0, burn_rate=0.0)
+        assert d.action == "down" and d.count == 1
+
+    def test_scale_down_blocked_by_cooldown(self):
+        eng = _engine(down_cooldown_s=600.0)
+        assert eng.decide(0.0, live=4,
+                          demand_tok_s=100.0).action == "down"
+        d = eng.decide(60.0, live=3, demand_tok_s=100.0)
+        assert d.action == "hold" and d.reason == "down_cooldown"
+
+    def test_ceiling_blocks_and_is_journaled(self):
+        eng = _engine(max_replicas=2)
+        d = eng.decide(0.0, live=2, demand_tok_s=1e6)
+        assert d.action == "hold" and d.reason == "ceiling"
+
+
+class TestDeterminism:
+    def _drive(self, clock):
+        eng = _engine(up_cooldown_s=2.0, down_cooldown_s=5.0)
+        rows = [(1, 3000.0, 0.0, 0.0), (2, 3000.0, 0.0, 0.0),
+                (3, 6000.0, 0.0, 1.4), (4, 100.0, 0.0, 0.5),
+                (5, 100.0, 0.0, 0.0), (6, 100.0, 0.0, 0.0)]
+        live = 1
+        for _, demand, forecast, burn in rows:
+            d = eng.decide(clock(), live=live, demand_tok_s=demand,
+                           forecast_tok_s=forecast, burn_rate=burn)
+            live += d.count if d.action == "up" else \
+                (-d.count if d.action == "down" else 0)
+        return [d.as_dict() for d in eng.events]
+
+    def test_identical_decisions_under_counting_clock(self):
+        # two fresh engines, two fresh clocks, same observation rows
+        # -> identical journals: the whole byte-identical-sim contract
+        a = self._drive(CountingClock(dt=1.0))
+        b = self._drive(CountingClock(dt=1.0))
+        assert a == b
+        assert any(d["action"] == "up" for d in a)
+
+    def test_verify_replay_accepts_own_journal(self):
+        events = self._drive(CountingClock(dt=1.0))
+        assert verify_replay(
+            events, CAP,
+            policy=AutoscalePolicy(max_replicas=8,
+                                   target_utilization=0.8,
+                                   up_cooldown_s=2.0,
+                                   down_cooldown_s=5.0))
+
+    def test_verify_replay_rejects_tampered_journal(self):
+        events = self._drive(CountingClock(dt=1.0))
+        events[0]["action"] = "down"
+        with pytest.raises(AssertionError):
+            verify_replay(
+                events, CAP,
+                policy=AutoscalePolicy(max_replicas=8,
+                                       target_utilization=0.8,
+                                       up_cooldown_s=2.0,
+                                       down_cooldown_s=5.0))
+
+
+class TestTelemetry:
+    def test_decisions_and_blocks_counted(self):
+        eng = _engine(max_replicas=2)
+        eng.decide(0.0, live=1, demand_tok_s=3000.0)      # up
+        eng.decide(1.0, live=2, demand_tok_s=1e6)          # ceiling
+        eng.decide(2.0, live=1, demand_tok_s=0.0)          # last_replica
+        dec = eng.registry.get("fleet_autoscale_decisions")
+        blocked = eng.registry.get("fleet_autoscale_blocked")
+        assert dec.value(action="up") == 1
+        assert dec.value(action="hold") == 2
+        assert blocked.value(reason="ceiling") == 1
+        assert blocked.value(reason="last_replica") == 1
+        assert eng.registry.get(
+            "fleet_autoscale_desired_replicas").value() == 1.0
+
+    def test_gauges_track_last_observation(self):
+        eng = _engine()
+        eng.decide(5.0, live=3, demand_tok_s=1234.0,
+                   forecast_tok_s=2500.0, burn_rate=0.125)
+        reg = eng.registry
+        assert reg.get("fleet_autoscale_live_replicas").value() == 3.0
+        assert reg.get("fleet_autoscale_demand_tok_s").value() == 1234.0
+        assert reg.get(
+            "fleet_autoscale_forecast_tok_s").value() == 2500.0
+        assert reg.get("fleet_autoscale_burn_rate").value() == 0.125

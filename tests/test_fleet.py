@@ -213,6 +213,120 @@ def test_heartbeat_recovery_after_cooldown():
     fleet.assert_conserved()
 
 
+class _LaggyHandle:
+    """Transport-latency model: ``steps`` observations refresh only on
+    every k-th read (the RPC round-trip), and ``progress_seq`` advances
+    only when a genuinely fresh observation crossed the boundary — the
+    contract real transport handles implement. Between refreshes the
+    router sees a STALE step count, not a stalled replica."""
+
+    def __init__(self, server, every=3):
+        self._srv = server
+        self._every = every
+        self._reads = 0
+        self._seq = 0
+        self._steps = 0
+
+    @property
+    def steps(self):
+        self._reads += 1
+        if self._reads % self._every == 1:
+            self._steps = self._srv.steps
+            self._seq += 1
+        return self._steps
+
+    @property
+    def progress_seq(self):
+        return self._seq
+
+    def __getattr__(self, name):
+        return getattr(self._srv, name)
+
+
+class _WedgedRemote:
+    """The complement: observations are perfectly FRESH (seq advances
+    every read) but the replica genuinely never progresses. Freshness
+    must not shield it — this one has to die."""
+
+    def __init__(self, server):
+        self._srv = server
+        self._n = 0
+
+    @property
+    def steps(self):
+        return 0
+
+    @property
+    def progress_seq(self):
+        self._n += 1
+        return self._n
+
+    def step(self):
+        return 1                       # claims work, does nothing
+
+    def __getattr__(self, name):
+        return getattr(self._srv, name)
+
+
+def test_heartbeat_tolerates_transport_round_trip_latency():
+    """Regression: a healthy REMOTE replica whose step counter is
+    observed through a laggy transport (stale between RPC refreshes)
+    must accrue ZERO heartbeat stalls — before the progress_seq
+    freshness guard, ordinary round-trip latency read as a stall and
+    degraded healthy replicas."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11, 7, 9))
+    base = _baseline(model, prompts, max_new=8)
+
+    t = [0.0]
+    fleet = FleetRouter(
+        [_LaggyHandle(_server(model), every=4), _server(model)],
+        clock=lambda: t[0], probe_every=0,
+        stall_ticks_degraded=2, stall_ticks_dead=4)
+    rids = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    while True:
+        t[0] += 1.0
+        if fleet.step() == 0:
+            break
+    fm = fleet.fleet_metrics()
+    assert fm["heartbeat_stalls"] == 0, \
+        "transport staleness was charged as a stall"
+    assert fm["deaths"] == 0 and fm["degraded_events"] == 0
+    assert all(rep.state == REPLICA_LIVE for rep in fleet._replicas)
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want
+    fleet.assert_conserved()
+
+
+def test_heartbeat_still_kills_wedged_remote_with_fresh_seq():
+    """The guard must not over-correct: a remote replica whose
+    observations ARE fresh (seq advances) but which never progresses is
+    a real wedge — degrade, kill, fail its work over token-exactly."""
+    model, cfg = _model()
+    prompts = _prompts(cfg, lens=(18, 11))
+    base = _baseline(model, prompts, max_new=8)
+
+    t = [0.0]
+    fleet = FleetRouter(
+        [_WedgedRemote(_server(model)), _server(model)],
+        clock=lambda: t[0], probe_every=0,
+        stall_ticks_degraded=2, stall_ticks_dead=4)
+    rids = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    assert [r // RID_STRIDE for r in rids] == [0, 1]
+    rep0 = fleet._replicas[0]
+    for _ in range(4):
+        t[0] += 1.0
+        fleet.step()
+    assert rep0.state == REPLICA_DEAD
+    fm = fleet.fleet_metrics()
+    assert fm["heartbeat_stalls"] == 4 and fm["deaths"] == 1
+    out = fleet.run()
+    for rid, want in zip(rids, base):
+        assert out[rid] == want, "failover diverged from the clean twin"
+    fleet.assert_conserved()
+
+
 # --------------------------------------------------------------------------
 # Live migration: drain (trusted KV), chaos kill (salvage), corruption
 # --------------------------------------------------------------------------

@@ -1,0 +1,217 @@
+"""fleetsim: traffic draws, virtual time, the event loop, and the
+real-fleet slice bridge.
+
+The simulator's load-bearing property is determinism: everything here
+byte-compares reports or signatures across independent runs at one
+seed. The slice test is the cheap in-process version of suite stage 7l
+(which adds real processes and a kill).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fleetsim import (DayTrafficSpec, FleetSimulation,
+                                 ReplicaServiceModel, SessionTrace,
+                                 VirtualClock, draw_day,
+                                 expected_session_rate,
+                                 materialize_session, replay_slice)
+from paddle_tpu.inference.autoscale import (AutoscalePolicy,
+                                            ElasticAutoscaler,
+                                            verify_replay)
+from paddle_tpu.inference.fleet import FleetRouter
+from paddle_tpu.inference.serving import GenerationServer
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+class TestVirtualClock:
+    def test_read_never_advances(self):
+        clk = VirtualClock(5.0)
+        assert clk() == clk() == 5.0 and clk.now == 5.0
+
+    def test_advance_and_advance_to(self):
+        clk = VirtualClock()
+        clk.advance(2.5)
+        clk.advance_to(10.0)
+        assert clk() == 10.0
+
+    def test_monotonicity_enforced(self):
+        clk = VirtualClock(3.0)
+        with pytest.raises(ValueError):
+            clk.advance_to(1.0)
+        with pytest.raises(ValueError):
+            clk.advance(-0.5)
+
+
+class TestTraffic:
+    def test_draw_is_deterministic_per_seed(self):
+        spec = DayTrafficSpec(sessions=50_000, seed=11)
+        a, b = draw_day(spec), draw_day(spec)
+        assert a.signature() == b.signature()
+        assert draw_day(
+            DayTrafficSpec(sessions=50_000, seed=12)
+        ).signature() != a.signature()
+
+    def test_arrivals_sorted_within_day(self):
+        t = draw_day(DayTrafficSpec(sessions=20_000, seed=0))
+        assert len(t) == 20_000
+        assert np.all(np.diff(t.t) >= 0)
+        assert t.t[0] >= 0.0 and t.t[-1] <= t.spec.day_s
+
+    def test_diurnal_shape_peaks_where_told(self):
+        # sessions drawn near the configured peak must outnumber the
+        # trough by roughly the (1+a)/(1-a) intensity ratio
+        spec = DayTrafficSpec(sessions=200_000, seed=3,
+                              diurnal_amplitude=0.6, peak_frac=0.5)
+        t = draw_day(spec).t
+        day = spec.day_s
+        peak = np.sum((t > 0.45 * day) & (t < 0.55 * day))
+        trough = np.sum((t < 0.05 * day) | (t > 0.95 * day))
+        assert peak > 2.0 * trough
+
+    def test_expected_rate_integrates_to_sessions(self):
+        spec = DayTrafficSpec(sessions=100_000, seed=0)
+        grid = np.linspace(0.0, spec.day_s, 10_001)
+        rates = [expected_session_rate(spec, x) for x in grid]
+        total = np.trapezoid(rates, grid)
+        assert abs(total - spec.sessions) / spec.sessions < 1e-6
+
+    def test_tenant_zipf_head_is_heavy(self):
+        t = draw_day(DayTrafficSpec(sessions=100_000, seed=1))
+        counts = np.bincount(t.tenant, minlength=t.spec.tenants)
+        assert counts[0] > counts[-1] * 2
+
+    def test_materialize_shares_population_prefix(self):
+        spec = DayTrafficSpec(sessions=5_000, seed=2,
+                              shared_prefix_tokens=16)
+        trace = draw_day(spec)
+        pops = trace.population
+        i = int(np.argmax(pops == pops[0]))
+        j = int(np.argmax((pops == pops[0])
+                          & (np.arange(len(trace)) > i)))
+        k_idx = int(np.argmax(pops != pops[0]))
+        a = materialize_session(trace, i)
+        b = materialize_session(trace, j)
+        c = materialize_session(trace, k_idx)
+        k = min(16, min(len(a.prompt), len(b.prompt)) - 1)
+        assert a.prompt[:k] == b.prompt[:k]          # same population
+        assert c.prompt[:8] != a.prompt[:8]          # different one
+        assert a.prompt != b.prompt                  # unique tails
+
+    def test_materialize_deterministic_and_clipped(self):
+        spec = DayTrafficSpec(sessions=1_000, seed=4)
+        trace = draw_day(spec)
+        r1 = materialize_session(trace, 17, max_len=48)
+        r2 = materialize_session(trace, 17, max_len=48)
+        assert r1.prompt == r2.prompt and r1.tenant == r2.tenant
+        assert len(r1.prompt) + r1.max_new <= 48
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            DayTrafficSpec(sessions=0)
+        with pytest.raises(ValueError):
+            DayTrafficSpec(diurnal_amplitude=1.0)
+        with pytest.raises(ValueError):
+            DayTrafficSpec(longtail_frac=1.5)
+
+
+def _sim(seed=7, sessions=30_000, cap=400.0):
+    spec = DayTrafficSpec(sessions=sessions, seed=seed)
+    policy = AutoscalePolicy(max_replicas=12, up_cooldown_s=120.0,
+                             down_cooldown_s=1200.0)
+    engine = ElasticAutoscaler(cap, policy=policy)
+    model = ReplicaServiceModel(decode_tok_s=cap, prefill_tok_s=8 * cap,
+                                slots=16, spawn_delay_s=30.0)
+    sim = FleetSimulation(draw_day(spec), model, autoscaler=engine,
+                          initial_replicas=2)
+    return sim, engine, policy, cap
+
+
+class TestFleetSimulation:
+    def test_day_completes_every_session(self):
+        sim, _, _, _ = _sim()
+        rep = sim.run()
+        assert rep["completed"] == rep["sim_sessions"] == 30_000
+        assert rep["sim_virtual_hours"] == 24.0
+        assert rep["tokens_served"] > 0
+
+    def test_report_byte_identical_per_seed(self):
+        a = json.dumps(_sim()[0].run(), sort_keys=True)
+        b = json.dumps(_sim()[0].run(), sort_keys=True)
+        assert a == b
+
+    def test_autoscaler_rides_the_diurnal_curve(self):
+        # demand swings (1-a)..(1+a) around ~12 tok/s-per-capacity
+        # replicas: the fleet must grow into the peak and shrink after
+        sim, engine, policy, cap = _sim(sessions=120_000, cap=100.0)
+        rep = sim.run()
+        assert rep["scale_ups"] >= 1 and rep["scale_downs"] >= 1
+        assert rep["peak_replicas"] > 2
+        assert verify_replay(rep["autoscale_events"], cap,
+                             policy=policy)
+
+    def test_elastic_beats_static_with_slo_held(self):
+        # THE acceptance criterion: fewer replica-hours than a fleet
+        # statically sized for the diurnal peak, while every tenant
+        # holds its SLO target
+        rep = _sim(sessions=120_000, cap=100.0)[0].run()
+        assert rep["slo_attained"]
+        assert rep["elastic_beats_static"]
+        assert rep["replica_hours"] < rep["static_replica_hours"]
+
+    def test_slo_rows_cover_every_active_tenant(self):
+        rep = _sim()[0].run()
+        assert rep["slo"]
+        for row in rep["slo"].values():
+            assert 0.0 <= row["ttft"]["attainment"] <= 1.0
+            assert row["sessions"] > 0
+
+    def test_without_autoscaler_fleet_is_static(self):
+        spec = DayTrafficSpec(sessions=10_000, seed=1)
+        model = ReplicaServiceModel(decode_tok_s=400.0,
+                                    prefill_tok_s=3200.0, slots=16)
+        rep = FleetSimulation(draw_day(spec), model,
+                              initial_replicas=2).run()
+        assert rep["autoscale_event_count"] == 0
+        assert rep["replicas_spawned"] == 2
+
+
+def _mk_server():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=160, dtype="float32",
+                      use_flash_attention=False)
+    paddle.seed(7)
+    return GenerationServer(LlamaForCausalLM(cfg), max_batch=2,
+                            max_len=96, cache="paged", block_size=8,
+                            prefill_chunk=16)
+
+
+class TestReplaySlice:
+    def test_slice_token_exact_across_twin_runs(self):
+        # the bridge from simulation to execution: the same trace slice
+        # through two independently built real fleets in fast-time must
+        # produce identical token streams session-for-session
+        spec = DayTrafficSpec(sessions=64, seed=3,
+                              shared_prefix_tokens=8,
+                              prompt_ladder=(12, 16, 20),
+                              longtail_frac=0.0,
+                              max_new_ladder=(4, 6))
+        trace = draw_day(spec)
+
+        def run_once():
+            clock = VirtualClock()
+            fleet = FleetRouter([_mk_server(), _mk_server()],
+                                clock=clock)
+            return replay_slice(trace, fleet, sessions=6, clock=clock,
+                                compress=20000.0, tick_s=1.0,
+                                max_len=96)
+
+        a, b = run_once(), run_once()
+        assert a["rids"] == b["rids"]
+        assert a["results"] == b["results"]
+        assert len(a["rids"]) == 6
+        toks = [a["results"][r] for r in a["rids"]]
+        assert all(len(t) > 0 for t in toks)

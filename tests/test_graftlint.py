@@ -529,6 +529,79 @@ class TestBareTransferGL014:
         """, path="paddle_tpu/parallel/serving_mesh.py")
 
 
+class TestBlockingWallTimeGL015:
+    SIM = "paddle_tpu/fleetsim/sim.py"
+    TRANSPORT = "paddle_tpu/inference/transport.py"
+
+    def test_sleep_in_fleetsim_flagged(self):
+        # one sleep turns a virtual day back into a wall day
+        assert "GL015" in rule_ids("""
+            import time
+
+            def wait_for_replica(rep):
+                while not rep.ready:
+                    time.sleep(0.1)
+        """, path=self.SIM)
+
+    def test_wall_clock_read_in_fleetsim_flagged(self):
+        # the event loop owns time; a wall read couples the seeded
+        # report to the machine it ran on
+        assert "GL015" in rule_ids("""
+            import time
+
+            def stamp(report):
+                report["at"] = time.time()
+                return report
+        """, path=self.SIM)
+
+    def test_sleep_in_transport_flagged(self):
+        # transport waits are socket-timeout-bounded, never sleeps
+        assert "GL015" in rule_ids("""
+            import time
+
+            def retry(sock, frame):
+                time.sleep(0.5)
+                sock.sendall(frame)
+        """, path=self.TRANSPORT)
+
+    def test_imported_sleep_spelling_flagged(self):
+        assert "GL015" in rule_ids("""
+            from time import sleep
+
+            def backoff():
+                sleep(1.0)
+        """, path="paddle_tpu/fleetsim/traffic.py")
+
+    def test_virtual_clock_advance_is_sanctioned(self):
+        # moving the VIRTUAL clock is the whole point — only wall time
+        # is banned
+        assert "GL015" not in rule_ids("""
+            def drive(clock, events):
+                for t, fn in events:
+                    clock.advance_to(t)
+                    fn()
+        """, path=self.SIM)
+
+    def test_socket_timeout_wait_is_sanctioned(self):
+        # bounded blocking on the socket (settimeout + recv) is the
+        # sanctioned transport wait — it is interruptible and carries
+        # no hidden time value into the program
+        assert "GL015" not in rule_ids("""
+            def recv_frame(sock, timeout_s):
+                sock.settimeout(timeout_s)
+                return sock.recv(65536)
+        """, path=self.TRANSPORT)
+
+    def test_outside_scope_sleeps_freely(self):
+        # tools and benchmarks pace themselves however they like
+        assert "GL015" not in rule_ids("""
+            import time
+
+            def poll(url):
+                time.sleep(2.0)
+        """, path="tools/poll_dashboard.py")
+
+
 class TestNonAtomicCkptWriteGL013:
     CKPT = "paddle_tpu/distributed/checkpoint_util.py"
 
@@ -752,7 +825,7 @@ class TestRepoGate:
         assert r.returncode == 0
         for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                     "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                    "GL013", "GL014"):
+                    "GL013", "GL014", "GL015"):
             assert rid in r.stdout
 
 

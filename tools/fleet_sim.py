@@ -1,0 +1,235 @@
+"""Fleet-at-scale driver: the simulated day, and the real-fleet slice.
+
+Two modes, both deterministic at a seed:
+
+**Pure simulation** (default): draw a seeded day of traffic
+(``paddle_tpu.fleetsim.draw_day`` — a million sessions by default) and
+run it through the discrete-event :class:`FleetSimulation` under the
+elastic autoscaler, entirely in virtual time. Emits the FULL report
+(including the journaled ``autoscale_events``, which are replay-verified
+before printing) as one JSON document. Two runs at one seed are
+byte-identical.
+
+**Execute-slice** (``--execute-slice N``): materialize the first N
+sessions of the SAME trace into real prompts and push them through a
+real :class:`FleetRouter` of engine replicas in fast-time — in-process
+handles by default, real OS processes over the socket transport with
+``--transport subprocess``. The measured fleet takes a scripted
+mid-run process kill (``--kill-tick``) and an autoscaler that is forced
+through at least one scale-up (a third replica spawns mid-run) and one
+token-exact drain; an UNDISTURBED in-process twin runs the identical
+slice, and the report carries per-session token mismatches (must be 0:
+journal salvage after the kill and evacuate-based drain are both
+token-exact), watchdog findings, and a results fingerprint over the
+submit-order token streams. This is suite stage 7l's engine.
+
+Wall time appears nowhere in the reports — fleet time is the virtual
+clock, engine time is the counting clock — so ``--json`` output
+byte-compares across same-seed runs.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+MODEL_CFG = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, max_position_embeddings=160,
+                 dtype="float32", use_flash_attention=False)
+SERVER_KW = dict(max_batch=2, max_len=96, cache="paged", block_size=8,
+                 prefill_chunk=16)
+
+
+def sim_day(args) -> dict:
+    from paddle_tpu.fleetsim import (DayTrafficSpec, FleetSimulation,
+                                     ReplicaServiceModel, draw_day)
+    from paddle_tpu.inference.autoscale import (AutoscalePolicy,
+                                                ElasticAutoscaler,
+                                                verify_replay)
+
+    spec = DayTrafficSpec(sessions=args.sessions, seed=args.seed)
+    policy = AutoscalePolicy(min_replicas=1,
+                             max_replicas=args.max_replicas,
+                             up_cooldown_s=120.0, down_cooldown_s=1200.0)
+    engine = ElasticAutoscaler(args.capacity, policy=policy)
+    model = ReplicaServiceModel(decode_tok_s=args.capacity,
+                                prefill_tok_s=8.0 * args.capacity,
+                                slots=16, spawn_delay_s=30.0)
+    report = FleetSimulation(draw_day(spec), model, autoscaler=engine,
+                             initial_replicas=2,
+                             control_interval_s=60.0,
+                             forecast_horizon_s=900.0).run()
+    verify_replay(report["autoscale_events"], args.capacity,
+                  policy=policy)
+    report["mode"] = "sim"
+    report["seed"] = args.seed
+    report["traffic"] = spec.to_dict()
+    return report
+
+
+def _make_inproc_server():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import GenerationServer
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(**MODEL_CFG)
+    paddle.seed(7)
+    return GenerationServer(LlamaForCausalLM(cfg), **SERVER_KW)
+
+
+def _make_handle(transport: str):
+    if transport == "subprocess":
+        from paddle_tpu.inference.transport import SubprocessReplica
+
+        spec = {"model": {"config": dict(MODEL_CFG), "seed": 7},
+                "server": dict(SERVER_KW, clock="counting")}
+        return SubprocessReplica(spec)
+    from paddle_tpu.inference.transport import InProcessReplica
+
+    return InProcessReplica(_make_inproc_server())
+
+
+def execute_slice(args) -> dict:
+    from paddle_tpu.fleetsim import (DayTrafficSpec, VirtualClock,
+                                     draw_day, replay_slice)
+    from paddle_tpu.inference.autoscale import (AutoscalePolicy,
+                                                ElasticAutoscaler,
+                                                FleetAutoscaler)
+    from paddle_tpu.inference.fleet import FleetRouter
+
+    spec = DayTrafficSpec(sessions=max(64, args.execute_slice),
+                          seed=args.seed, shared_prefix_tokens=8,
+                          prompt_ladder=(12, 16, 20), longtail_frac=0.0,
+                          max_new_ladder=(4, 6, 8))
+    trace = draw_day(spec)
+    n = args.execute_slice
+
+    # measured fleet: 2 replicas on the chosen transport, an autoscaler
+    # scripted through >=1 up and >=1 drain, one mid-run process kill
+    clock = VirtualClock()
+    handles = [_make_handle(args.transport) for _ in range(2)]
+    fleet = FleetRouter(handles, clock=clock)
+    engine = ElasticAutoscaler(
+        400.0, policy=AutoscalePolicy(max_replicas=4, up_cooldown_s=0.0,
+                                      down_cooldown_s=0.0))
+    scaler = FleetAutoscaler(fleet, engine,
+                             spawn=lambda: _make_handle(args.transport))
+    killed = []
+
+    def on_tick(tick, now, submitted):
+        if tick == args.kill_tick and not killed:
+            h = handles[0]
+            if hasattr(h, "kill_process"):
+                h.kill_process()   # real SIGKILL mid-decode
+            else:
+                h.fail("scripted mid-run kill")
+            killed.append(tick)
+        elif tick == args.kill_tick + 2:
+            # diurnal ramp, compressed: demand spikes -> scale-up
+            scaler.control(now, demand_tok_s=1e6)
+        elif tick == args.kill_tick + 6:
+            # ...and falls off -> one token-exact drain
+            scaler.control(now, demand_tok_s=1.0)
+
+    out = replay_slice(trace, fleet, sessions=n, clock=clock,
+                       compress=20000.0, tick_s=1.0, max_len=96,
+                       on_tick=on_tick)
+
+    # undisturbed twin: same slice, in-process, no kill, no autoscaler
+    tclock = VirtualClock()
+    twin = FleetRouter([_make_inproc_server() for _ in range(2)],
+                       clock=tclock)
+    tout = replay_slice(trace, twin, sessions=n, clock=tclock,
+                        compress=20000.0, tick_s=1.0, max_len=96)
+
+    # per-session comparison in submit order: placement (and therefore
+    # rid) legitimately differs once the autoscaler reshapes the fleet,
+    # but the TOKENS of session i may not
+    mismatches = sum(
+        1 for i in range(n)
+        if out["results"].get(out["rids"][i])
+        != tout["results"].get(tout["rids"][i]))
+    fingerprint = hashlib.sha256(json.dumps(
+        [out["results"].get(r) for r in out["rids"]]).encode()
+    ).hexdigest()[:16]
+
+    fm = fleet.fleet_metrics()
+    watchdog = []
+    for rep in fleet._replicas:
+        if rep.state in ("live", "degraded"):
+            watchdog.extend(rep.server.watchdog_findings())
+    ups = sum(1 for d in engine.events if d.action == "up")
+    downs = sum(1 for d in engine.events if d.action == "down")
+    events = [d.as_dict() for d in engine.events]
+    for ev in events:
+        for k in ("t", "demand_tok_s", "forecast_tok_s", "burn_rate"):
+            ev[k] = round(ev[k], 6)
+    report = {"mode": "execute-slice", "transport": args.transport,
+              "sessions": n, "ticks": out["ticks"],
+              "twin_ticks": tout["ticks"],
+              "token_mismatches": mismatches,
+              "results_fingerprint": fingerprint,
+              "fleet_states": fm["states"],
+              "deaths": fm["deaths"],
+              "migrated_requests": fm["migrated_requests"],
+              "heartbeat_stalls": fm["heartbeat_stalls"],
+              "watchdog_findings": len(watchdog),
+              "scale_ups": ups, "scale_downs": downs,
+              "autoscale_events": events,
+              "kill_tick": args.kill_tick,
+              "seed": args.seed,
+              "traffic_signature": trace.signature()}
+    # tear down every process (added replicas included)
+    for rep in fleet._replicas:
+        close = getattr(rep.server, "close", None)
+        if close is not None:
+            close()
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", type=int, default=1_000_000,
+                    help="sessions in the simulated day (sim mode)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--capacity", type=float, default=400.0,
+                    help="analytic per-replica decode tok/s (sim mode)")
+    ap.add_argument("--max-replicas", type=int, default=12)
+    ap.add_argument("--execute-slice", type=int, default=0, metavar="N",
+                    help="replay the first N sessions through a REAL "
+                         "fleet in fast-time instead of simulating")
+    ap.add_argument("--transport", choices=("inproc", "subprocess"),
+                    default="inproc",
+                    help="replica backend for --execute-slice: "
+                         "in-process servers or real OS processes over "
+                         "the socket transport")
+    ap.add_argument("--kill-tick", type=int, default=3,
+                    help="router tick at which the scripted kill lands "
+                         "on replica 0 (--execute-slice)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit exactly one JSON document on stdout")
+    args = ap.parse_args()
+
+    report = execute_slice(args) if args.execute_slice else sim_day(args)
+    print(json.dumps(report, sort_keys=True))
+    if not args.json:
+        if report["mode"] == "sim":
+            print(f"[sim] {report['sim_sessions']} sessions, elastic "
+                  f"{report['replica_hours']}h vs static "
+                  f"{report['static_replica_hours']}h, SLO "
+                  f"{report['slo_attained']}", file=sys.stderr)
+        else:
+            print(f"[slice/{report['transport']}] {report['sessions']} "
+                  f"sessions, mismatches {report['token_mismatches']}, "
+                  f"deaths {report['deaths']}, ups {report['scale_ups']} "
+                  f"downs {report['scale_downs']}, watchdog "
+                  f"{report['watchdog_findings']}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -239,7 +239,7 @@ print(f"deaths {r['fleet_deaths']} (states {r['fleet_states']}), "
       f"mismatches {r['token_mismatches']}, recompiles "
       f"{r['drain_recompiles']}/{r['ref_drain_recompiles']} (fleet/ref), "
       f"tok/s {r['value']} vs twin {r['ref_tok_s']}")
-assert r.get("schema_version") == 5, "benchmark schema drifted"
+assert r.get("schema_version") == 6, "benchmark schema drifted"
 assert r.get("config_fingerprint"), "missing config fingerprint"
 assert r["fleet_deaths"] == 1, "seeded kill never landed — gate vacuous"
 assert r["fleet_states"]["dead"] == 1 and r["fleet_states"]["live"] == 1
@@ -338,7 +338,7 @@ print(f"tp1 {t1['value']} tok/s vs tp2 {t2['value']} "
       f"handoffs {dg['handoffs']}, salvage lat p95 "
       f"{dg['migration_latency_p95_s']}s, mismatches "
       f"{dg['token_mismatches']}")
-assert t1.get("schema_version") == t2.get("schema_version") == 5
+assert t1.get("schema_version") == t2.get("schema_version") == 6
 assert t1["tp"] == 1 and t2["tp"] == 2 and t2["mesh"] == "tp2"
 assert t1["tokens_fingerprint"] == t2["tokens_fingerprint"], \
     "tp=2 serving diverged from single-chip tokens"
@@ -415,7 +415,7 @@ print(f"cp1 {c1['value']} tok/s vs cp2 {c2['value']} "
       f"{c1['tokens_fingerprint']}/{c2['tokens_fingerprint']}; tiered "
       f"dem {td['tier_demotions']} pro {td['tier_promotions']}, "
       f"hit rates {td['tier_hit_rate']}")
-assert all(x.get("schema_version") == 5 for x in (c1, c2, q1, q2, td)), \
+assert all(x.get("schema_version") == 6 for x in (c1, c2, q1, q2, td)), \
     "benchmark schema drifted"
 assert c1["cp"] == 1 and c2["cp"] == 2 and c2["mesh"] == "tp1cp2"
 assert c1["tokens_fingerprint"] == c2["tokens_fingerprint"], \
@@ -593,6 +593,69 @@ print(f"{len(swept)} sweep rows parity-clean "
 if on_tpu:
     slow = [r for r in swept if r["geometry_speedup"] < 1.0]
     assert not slow, f"geometry winner slower than default on TPU: {slow}"
+PY
+
+echo "== 7l. fleet-at-scale gate (2-process socket fleet, fast-time slice, mid-run kill vs in-process twin; 1M-session simulated day) =="
+# deliberately pinned to CPU: cross-process token-exactness needs both
+# sides of the twin on one backend, and the gate must not serialize on
+# the chip lock — the fleet layer under test is backend-agnostic
+JAX_PLATFORMS=cpu python tools/fleet_sim.py --execute-slice 10 \
+  --transport subprocess --kill-tick 3 --seed 0 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/fleet_slice.json \
+  || { echo "fleet slice FAILED (transport, salvage, or twin divergence)"; exit 1; }
+JAX_PLATFORMS=cpu python tools/fleet_sim.py --execute-slice 10 \
+  --transport subprocess --kill-tick 3 --seed 0 --json 2>/dev/null \
+  > /tmp/tpu_runs/fleet_slice_2.json
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --sim \
+  --sim-sessions 1000000 --seed 0 --json 2>/dev/null \
+  | tee /tmp/tpu_runs/fleetsim_day.json
+JAX_PLATFORMS=cpu python tools/serving_benchmark.py --sim \
+  --sim-sessions 1000000 --seed 0 --json 2>/dev/null \
+  > /tmp/tpu_runs/fleetsim_day_2.json
+python - <<'PY'
+# fleet-at-scale gate: the measured fleet (real OS processes over the
+# socket transport, one SIGKILL mid-decode, autoscaler forced through a
+# scale-up and a drain) must be token-exact against the undisturbed
+# in-process twin, watchdog-clean, and byte-identical across two
+# same-seed runs; the simulated day must clear 1M sessions with the
+# elastic fleet beating the static peak-sized fleet on replica-hours
+# while every tenant holds its SLO
+import json
+a = open("/tmp/tpu_runs/fleet_slice.json").read()
+b = open("/tmp/tpu_runs/fleet_slice_2.json").read()
+assert a == b, "same-seed fleet slice runs are not byte-identical"
+r = json.loads(a)
+day = json.load(open("/tmp/tpu_runs/fleetsim_day.json"))
+assert r["transport"] == "subprocess"
+assert r["deaths"] == 1, "scripted kill never landed — gate vacuous"
+assert r["token_mismatches"] == 0, \
+    "process kill / autoscale drain changed tokens vs twin"
+assert r["migrated_requests"] >= 1, "kill salvaged nothing — vacuous"
+assert r["scale_ups"] >= 1 and r["scale_downs"] >= 1, \
+    "autoscaler never exercised both directions"
+assert r["watchdog_findings"] == 0, "watchdog not clean after slice"
+assert r["heartbeat_stalls"] == 0, \
+    "transport round-trips tripped the heartbeat"
+assert day["schema_version"] == 6 and day["sim_sessions"] == 1000000
+# the day line is byte-identical per seed modulo the two documented
+# wall-time keys (value = simulator wall throughput, wall_s)
+day2 = json.load(open("/tmp/tpu_runs/fleetsim_day_2.json"))
+strip = lambda d: json.dumps(
+    {k: v for k, v in d.items() if k not in ("value", "wall_s")},
+    sort_keys=True)
+assert strip(day) == strip(day2), \
+    "same-seed simulated days diverged beyond wall-time keys"
+assert day["slo_attained"], "simulated day violated a tenant SLO"
+assert day["elastic_beats_static"], \
+    "elastic fleet used more replica-hours than the static peak fleet"
+print(f"slice: {r['sessions']} sessions over {r['transport']}, "
+      f"mismatches {r['token_mismatches']}, deaths {r['deaths']}, "
+      f"salvaged {r['migrated_requests']}, ups {r['scale_ups']} / "
+      f"downs {r['scale_downs']}, watchdog {r['watchdog_findings']}; "
+      f"day: {day['sim_sessions']} sessions in {day['wall_s']}s wall, "
+      f"elastic {day['replica_hours']}h vs static "
+      f"{day['static_replica_hours']}h ({day['scale_ups']} ups, "
+      f"{day['scale_downs']} downs)")
 PY
 
 echo "== 8. training chaos gate (seeded kills + torn writes + bit-flip reads vs unkilled twin) =="

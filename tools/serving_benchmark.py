@@ -156,7 +156,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 #: paged microbench lines gain ``megakernel_active`` (the eager guard's
 #: verdict) plus ``megakernel_tok_s`` / ``megakernel_dispatch_us`` (the
 #: whole-tick program at server shapes) when the rung engaged.
-SCHEMA_VERSION = 5
+#: 6 = fleet scale: ``--fleet`` lines now carry ``slo`` (per-tenant
+#: TTFT/TPOT attainment + burn rate from the router's roll-up) on every
+#: line, not only under --strict; the new ``--sim`` mode emits a
+#: ``serving_fleetsim_sessions_s`` line (discrete-event day simulation,
+#: no engine) with ``sim_sessions`` / ``sim_virtual_hours`` /
+#: ``replica_hours`` / ``autoscale_events`` / per-tenant ``slo``.
+#: Every v5 key is still present with its v5 meaning.
+SCHEMA_VERSION = 6
 
 
 def config_fingerprint(args) -> str:
@@ -277,6 +284,78 @@ def kernel_microbench(server, cfg, args, iters: int = 10):
         out["megakernel_tok_s"] = round(B / mk_s, 1)
         out["megakernel_dispatch_us"] = round(mk_s * 1e6, 1)
     return out
+
+
+def sim_main(args):
+    """--sim: the discrete-event day simulation (paddle_tpu.fleetsim) —
+    a million seeded session arrivals against the analytic replica
+    model under the elastic autoscaler, in virtual time. Emits one
+    ``serving_fleetsim_sessions_s`` JSON line whose payload (including
+    ``autoscale_events`` and per-tenant ``slo``) is byte-identical per
+    seed; ``value`` is the only wall-time-dependent key (simulator
+    throughput, sessions per wall second)."""
+    from paddle_tpu.fleetsim import (DayTrafficSpec, FleetSimulation,
+                                     ReplicaServiceModel, draw_day)
+    from paddle_tpu.inference.autoscale import (AutoscalePolicy,
+                                                ElasticAutoscaler,
+                                                verify_replay)
+
+    spec = DayTrafficSpec(sessions=args.sim_sessions, seed=args.seed)
+    cap = float(args.sim_capacity)
+    policy = AutoscalePolicy(min_replicas=1,
+                             max_replicas=args.sim_max_replicas,
+                             up_cooldown_s=120.0, down_cooldown_s=1200.0)
+    engine = ElasticAutoscaler(cap, policy=policy)
+    model = ReplicaServiceModel(decode_tok_s=cap, prefill_tok_s=8.0 * cap,
+                                slots=16, spawn_delay_s=30.0)
+    t0 = time.perf_counter()
+    trace = draw_day(spec)
+    report = FleetSimulation(trace, model, autoscaler=engine,
+                             initial_replicas=2,
+                             control_interval_s=60.0,
+                             forecast_horizon_s=900.0).run()
+    wall = time.perf_counter() - t0
+    # the journal must replay before it is reported — an event log that
+    # does not reproduce its own decisions is a log of accidents
+    verify_replay(report["autoscale_events"], cap, policy=policy)
+    line = {"metric": "serving_fleetsim_sessions_s",
+            "value": round(args.sim_sessions / wall, 1),
+            "unit": f"simulated sessions / wall second "
+                    f"({args.sim_sessions} sessions, "
+                    f"{report['sim_virtual_hours']}h virtual, "
+                    f"cap={cap:g} tok/s, "
+                    f"max={args.sim_max_replicas} replicas)",
+            "sim_sessions": report["sim_sessions"],
+            "sim_virtual_hours": report["sim_virtual_hours"],
+            "replica_hours": report["replica_hours"],
+            "static_replicas": report["static_replicas"],
+            "static_replica_hours": report["static_replica_hours"],
+            "elastic_beats_static": report["elastic_beats_static"],
+            "autoscale_events": report["autoscale_event_count"],
+            "scale_ups": report["scale_ups"],
+            "scale_downs": report["scale_downs"],
+            "peak_replicas": report["peak_replicas"],
+            "completed": report["completed"],
+            "mean_ttft_s": report["mean_ttft_s"],
+            "tokens_served": report["tokens_served"],
+            "slo": report["slo"],
+            "slo_attained": report["slo_attained"],
+            "slo_target": report["slo_target"],
+            "traffic_signature": report["traffic_signature"],
+            "wall_s": round(wall, 2),
+            "seed": args.seed,
+            "schema_version": SCHEMA_VERSION,
+            "kernels": args.kernels,
+            "config_fingerprint": config_fingerprint(args)}
+    print(json.dumps(line))
+    if not args.json:
+        print(f"[fleetsim] {args.sim_sessions} sessions / "
+              f"{report['sim_virtual_hours']}h virtual in {wall:.2f}s "
+              f"wall; elastic {report['replica_hours']}h vs static "
+              f"{report['static_replica_hours']}h replica-hours "
+              f"({report['scale_ups']} ups, {report['scale_downs']} "
+              f"downs, peak {report['peak_replicas']}), SLO attained: "
+              f"{report['slo_attained']}", file=sys.stderr)
 
 
 def main():
@@ -501,10 +580,36 @@ def main():
                          "watchdog finding — over the measured drain, or "
                          "(under --chaos) over a post-plan recovery burst, "
                          "which must come back clean")
+    ap.add_argument("--sim", action="store_true",
+                    help="discrete-event fleet simulation instead of an "
+                         "engine run: draw a seeded day of traffic "
+                         "(paddle_tpu.fleetsim), replay it against the "
+                         "analytic replica model under the elastic "
+                         "autoscaler in fast-time, and emit one "
+                         "serving_fleetsim_sessions_s line — no model, "
+                         "no chip, byte-identical per --seed")
+    ap.add_argument("--sim-sessions", type=int, default=1_000_000,
+                    help="sessions in the simulated day (default 1M)")
+    ap.add_argument("--sim-capacity", type=float, default=400.0,
+                    metavar="TOK_S",
+                    help="analytic per-replica decode capacity for --sim "
+                         "(tokens/s; the cost model supplies this on a "
+                         "real deployment via capacity_tok_s)")
+    ap.add_argument("--sim-max-replicas", type=int, default=12,
+                    help="autoscaler ceiling for --sim")
     ap.add_argument("--json", action="store_true",
                     help="emit exactly one machine-readable JSON line "
                          "(bench.py style) on stdout and nothing else")
     args = ap.parse_args()
+    if args.sim:
+        if args.fleet or args.chaos or args.paged or args.spec \
+                or args.tune is not None or args.profile is not None:
+            ap.error("--sim is the pure fast-time simulator — it takes "
+                     "no engine knobs (--paged/--fleet/--chaos/--spec/"
+                     "--profile/--tune); size it with --sim-sessions/"
+                     "--sim-capacity/--sim-max-replicas and --seed")
+        sim_main(args)
+        return
     if args.chaos and not args.paged:
         ap.error("--chaos requires --paged (the fault sites live in the "
                  "paged substrate)")
@@ -1153,7 +1258,23 @@ def main():
                 "fleet_deaths": fm["deaths"],
                 "fleet_heartbeat_stalls": fm["heartbeat_stalls"],
                 "quarantined": fm["quarantined"],
-                "replicas": fm["replicas"]}
+                "replicas": fm["replicas"],
+                # schema v6: per-tenant SLO attainment on EVERY fleet
+                # line (the roll-up the canary gate and the autoscaler's
+                # burn-rate input both read)
+                "slo": {tenant: {
+                    "target": row["target"],
+                    "ttft": {"attainment": round(
+                                 row["ttft"]["attainment"], 6),
+                             "burn_rate": round(
+                                 row["ttft"]["burn_rate"], 6),
+                             "samples": row["ttft"]["samples"]},
+                    "tpot": {"attainment": round(
+                                 row["tpot"]["attainment"], 6),
+                             "burn_rate": round(
+                                 row["tpot"]["burn_rate"], 6),
+                             "samples": row["tpot"]["samples"]}}
+                    for tenant, row in fm["slo"].items()}}
         strict = None
         if args.chaos:
             failed = [r for r in rids if fleet.status(r) == "failed"]
